@@ -1,0 +1,24 @@
+"""spacedrive_tpu — a TPU-native VDFS engine.
+
+A brand-new framework with the capabilities of the reference VDFS core
+(Spacedrive's `sd-core`): location indexing, content-addressed file
+identification (sampled BLAKE3 CAS IDs), integrity validation, duplicate
+detection, media processing, CRDT library sync — with the identification
+hot path executed as a batched JAX pipeline on TPU behind a pausable/
+resumable job-system boundary.
+
+Layout:
+    ops/       device + host kernels (BLAKE3, pHash, Hamming)
+    models/    the flagship device pipelines (identifier, validator, neardup)
+    parallel/  mesh construction and sharding helpers
+    db/        SQLite data model + typed store
+    jobs/      stateful job engine (pause/resume/checkpoint)
+    location/  walker, indexer rules, path algebra
+    objects/   file identification / validation / fs op jobs
+    library/   library manager + node config
+    sync/      CRDT sync engine (HLC, op log, ingest)
+    p2p/       host-side mesh (discovery, pairing, transfer)
+    utils/     event bus, migrator, misc
+"""
+
+__version__ = "0.1.0"

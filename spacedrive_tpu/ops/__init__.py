@@ -1,0 +1,2 @@
+"""Host and device kernels: BLAKE3 (reference, numpy, JAX, Pallas), CAS
+sampling, perceptual hashing, Hamming all-pairs."""

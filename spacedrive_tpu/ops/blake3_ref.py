@@ -1,0 +1,198 @@
+"""Pure-Python streaming BLAKE3 (hash mode), written from the public spec.
+
+This is the correctness oracle for every other BLAKE3 implementation in the
+framework (numpy batched, JAX batched, Pallas kernel, C++ native). The
+environment ships no `blake3` wheel, so parity is established against the
+official test vectors (input = repeating 0..250 byte pattern) plus
+self-consistency between streaming and one-shot use.
+
+Reference behavior being matched: the `blake3` crate as used by
+/root/reference/core/src/object/cas.rs:23-62 (CAS IDs) and
+/root/reference/core/src/object/validation/hash.rs:10-24 (full checksums).
+
+Only plain hashing is implemented (no keyed hash / derive-key modes — the
+reference's identification paths use `Hasher::new()` only).
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["Blake3", "blake3_hex", "blake3_digest"]
+
+_MASK = 0xFFFFFFFF
+
+IV = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+MSG_PERMUTATION = (2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8)
+
+BLOCK_LEN = 64
+CHUNK_LEN = 1024
+
+CHUNK_START = 1 << 0
+CHUNK_END = 1 << 1
+PARENT = 1 << 2
+ROOT = 1 << 3
+
+
+def _rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & _MASK
+
+
+def _g(s: list, a: int, b: int, c: int, d: int, mx: int, my: int) -> None:
+    s[a] = (s[a] + s[b] + mx) & _MASK
+    s[d] = _rotr(s[d] ^ s[a], 16)
+    s[c] = (s[c] + s[d]) & _MASK
+    s[b] = _rotr(s[b] ^ s[c], 12)
+    s[a] = (s[a] + s[b] + my) & _MASK
+    s[d] = _rotr(s[d] ^ s[a], 8)
+    s[c] = (s[c] + s[d]) & _MASK
+    s[b] = _rotr(s[b] ^ s[c], 7)
+
+
+def compress(cv, block_words, counter: int, block_len: int, flags: int) -> list:
+    """One BLAKE3 compression; returns the full 16-word output state.
+
+    Words 0..8 are the new chaining value; words 8..16 only matter for
+    extended output (not used by the framework, kept for spec completeness).
+    """
+    s = [
+        cv[0], cv[1], cv[2], cv[3], cv[4], cv[5], cv[6], cv[7],
+        IV[0], IV[1], IV[2], IV[3],
+        counter & _MASK, (counter >> 32) & _MASK, block_len, flags,
+    ]
+    m = list(block_words)
+    for r in range(7):
+        _g(s, 0, 4, 8, 12, m[0], m[1])
+        _g(s, 1, 5, 9, 13, m[2], m[3])
+        _g(s, 2, 6, 10, 14, m[4], m[5])
+        _g(s, 3, 7, 11, 15, m[6], m[7])
+        _g(s, 0, 5, 10, 15, m[8], m[9])
+        _g(s, 1, 6, 11, 12, m[10], m[11])
+        _g(s, 2, 7, 8, 13, m[12], m[13])
+        _g(s, 3, 4, 9, 14, m[14], m[15])
+        if r < 6:
+            m = [m[p] for p in MSG_PERMUTATION]
+    return [
+        s[0] ^ s[8], s[1] ^ s[9], s[2] ^ s[10], s[3] ^ s[11],
+        s[4] ^ s[12], s[5] ^ s[13], s[6] ^ s[14], s[7] ^ s[15],
+        s[8] ^ cv[0], s[9] ^ cv[1], s[10] ^ cv[2], s[11] ^ cv[3],
+        s[12] ^ cv[4], s[13] ^ cv[5], s[14] ^ cv[6], s[15] ^ cv[7],
+    ]
+
+
+def _words_of_block(block: bytes) -> list:
+    if len(block) < BLOCK_LEN:
+        block = block + b"\x00" * (BLOCK_LEN - len(block))
+    return list(struct.unpack("<16I", block))
+
+
+class _ChunkState:
+    __slots__ = ("cv", "counter", "buf", "blocks_compressed")
+
+    def __init__(self, counter: int):
+        self.cv = list(IV)
+        self.counter = counter
+        self.buf = b""
+        self.blocks_compressed = 0
+
+    def _start_flag(self) -> int:
+        return CHUNK_START if self.blocks_compressed == 0 else 0
+
+    def length(self) -> int:
+        return self.blocks_compressed * BLOCK_LEN + len(self.buf)
+
+    def update(self, data: bytes) -> bytes:
+        """Absorb up to a chunk's worth; returns unconsumed remainder."""
+        while data:
+            if len(self.buf) == BLOCK_LEN:
+                # Only compress a full block once more input exists, so the
+                # chunk's final block keeps its CHUNK_END flag available.
+                out = compress(
+                    self.cv, _words_of_block(self.buf), self.counter,
+                    BLOCK_LEN, self._start_flag(),
+                )
+                self.cv = out[:8]
+                self.blocks_compressed += 1
+                self.buf = b""
+            want = BLOCK_LEN - len(self.buf)
+            take, data = data[:want], data[want:]
+            self.buf += take
+            if self.length() == CHUNK_LEN:
+                break
+        return data
+
+    def output(self, extra_flags: int) -> list:
+        flags = self._start_flag() | CHUNK_END | extra_flags
+        out = compress(
+            self.cv, _words_of_block(self.buf), self.counter,
+            len(self.buf), flags,
+        )
+        return out[:8]
+
+
+def _parent_words(left_cv, right_cv) -> list:
+    return list(left_cv) + list(right_cv)
+
+
+class Blake3:
+    """Streaming BLAKE3 hasher (hash mode only)."""
+
+    def __init__(self) -> None:
+        self._chunk = _ChunkState(0)
+        self._cv_stack: list = []  # chaining values of completed subtrees
+
+    def update(self, data: bytes) -> "Blake3":
+        while data:
+            if self._chunk.length() == CHUNK_LEN:
+                # chunk complete and more input follows: finalize it as a
+                # non-root leaf and fold the CV stack like a binary counter.
+                cv = self._chunk.output(0)
+                total = self._chunk.counter + 1
+                while total & 1 == 0:
+                    cv = compress(
+                        IV, _parent_words(self._cv_stack.pop(), cv),
+                        0, BLOCK_LEN, PARENT,
+                    )[:8]
+                    total >>= 1
+                self._cv_stack.append(cv)
+                self._chunk = _ChunkState(self._chunk.counter + 1)
+            data = self._chunk.update(data)
+        return self
+
+    def digest(self, length: int = 32) -> bytes:
+        if length > 64:
+            raise ValueError("extended output beyond 64 bytes not implemented")
+        if not self._cv_stack:
+            out16 = compress(
+                self._chunk.cv, _words_of_block(self._chunk.buf),
+                self._chunk.counter, len(self._chunk.buf),
+                self._chunk._start_flag() | CHUNK_END | ROOT,
+            )
+        else:
+            cv = self._chunk.output(0)
+            # Fold the stack top-down; the last (bottom-most) merge is root.
+            for i in range(len(self._cv_stack) - 1, 0, -1):
+                cv = compress(
+                    IV, _parent_words(self._cv_stack[i], cv),
+                    0, BLOCK_LEN, PARENT,
+                )[:8]
+            out16 = compress(
+                IV, _parent_words(self._cv_stack[0], cv),
+                0, BLOCK_LEN, PARENT | ROOT,
+            )
+        return struct.pack("<16I", *out16)[:length]
+
+    def hexdigest(self, length: int = 32) -> str:
+        return self.digest(length).hex()
+
+
+def blake3_digest(data: bytes, length: int = 32) -> bytes:
+    return Blake3().update(data).digest(length)
+
+
+def blake3_hex(data: bytes, length: int = 32) -> str:
+    return Blake3().update(data).hexdigest(length)

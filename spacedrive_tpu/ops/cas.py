@@ -1,0 +1,103 @@
+"""CAS-ID generation and full-file checksums (CPU oracle path).
+
+Matches the reference byte-for-byte:
+- `generate_cas_id`: /root/reference/core/src/object/cas.rs:23-62 —
+  blake3(size.to_le_bytes() ‖ payload), hex-truncated to 16 chars, where
+  payload is the whole file when size ≤ 100 KiB, else 8 KiB header +
+  4 × 10 KiB samples at offsets 8192 + k·((size − 16384) // 4) + 8 KiB footer.
+- `file_checksum`: /root/reference/core/src/object/validation/hash.rs:10-24 —
+  full-file blake3 read in 1 MiB blocks, 64-char hex.
+
+`sample_spec` is the single source of truth for which byte ranges are hashed;
+the C++ stager and the TPU batch builder consume the same spec so every
+backend hashes identical payloads.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import BinaryIO, List, Tuple
+
+from .blake3_ref import Blake3
+
+SAMPLE_COUNT = 4
+SAMPLE_SIZE = 1024 * 10
+HEADER_OR_FOOTER_SIZE = 1024 * 8
+MINIMUM_FILE_SIZE = 1024 * 100  # ≤ this: hash the whole file
+BLOCK_LEN = 1048576  # validator read block
+
+# Fixed payload size for every large file: header + samples + footer.
+LARGE_PAYLOAD_SIZE = 2 * HEADER_OR_FOOTER_SIZE + SAMPLE_COUNT * SAMPLE_SIZE  # 57344
+# Plus the 8-byte little-endian size prefix that is hashed first.
+SIZE_PREFIX_LEN = 8
+
+assert (HEADER_OR_FOOTER_SIZE * 2 + SAMPLE_COUNT * SAMPLE_SIZE) < MINIMUM_FILE_SIZE
+assert SAMPLE_SIZE > HEADER_OR_FOOTER_SIZE
+
+
+def sample_spec(size: int) -> List[Tuple[int, int]]:
+    """(offset, length) ranges whose concatenation is the hashed payload."""
+    if size <= MINIMUM_FILE_SIZE:
+        return [(0, size)]
+    jump = (size - 2 * HEADER_OR_FOOTER_SIZE) // SAMPLE_COUNT
+    ranges = [(0, HEADER_OR_FOOTER_SIZE)]
+    ranges += [
+        (HEADER_OR_FOOTER_SIZE + k * jump, SAMPLE_SIZE)
+        for k in range(SAMPLE_COUNT)
+    ]
+    ranges.append((size - HEADER_OR_FOOTER_SIZE, HEADER_OR_FOOTER_SIZE))
+    return ranges
+
+
+def read_sampled_payload(f: BinaryIO, size: int) -> bytes:
+    """Read the hashed payload exactly as the reference does.
+
+    Matches cas.rs even when `size` disagrees with the file's true length:
+    the small-file path reads the whole file (`fs::read`), and the footer
+    seeks relative to the real end (`SeekFrom::End(-8192)`), not to
+    `size - 8192`. Header/sample offsets come from the declared size.
+    """
+    if size <= MINIMUM_FILE_SIZE:
+        return f.read()
+    parts = []
+    for offset, length in sample_spec(size)[:-1]:
+        f.seek(offset)
+        part = f.read(length)
+        if len(part) != length:
+            raise EOFError(
+                f"short read at {offset}: wanted {length}, got {len(part)}"
+            )
+        parts.append(part)
+    f.seek(-HEADER_OR_FOOTER_SIZE, os.SEEK_END)
+    footer = f.read(HEADER_OR_FOOTER_SIZE)
+    if len(footer) != HEADER_OR_FOOTER_SIZE:
+        raise EOFError("short footer read")
+    parts.append(footer)
+    return b"".join(parts)
+
+
+def cas_id_of_payload(size: int, payload: bytes) -> str:
+    h = Blake3()
+    h.update(struct.pack("<Q", size))
+    h.update(payload)
+    return h.hexdigest()[:16]
+
+
+def generate_cas_id(path: str | os.PathLike, size: int | None = None) -> str:
+    if size is None:
+        size = os.stat(path).st_size
+    with open(path, "rb") as f:
+        payload = read_sampled_payload(f, size)
+    return cas_id_of_payload(size, payload)
+
+
+def file_checksum(path: str | os.PathLike) -> str:
+    h = Blake3()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(BLOCK_LEN)
+            h.update(block)
+            if len(block) != BLOCK_LEN:
+                break
+    return h.hexdigest()

@@ -1,0 +1,50 @@
+"""Oracle correctness: official BLAKE3 test vectors + streaming consistency."""
+
+import random
+
+from spacedrive_tpu.ops.blake3_ref import Blake3, blake3_hex
+
+# Official test-vector input: byte i is (i % 251).
+def tv_input(n: int) -> bytes:
+    return bytes(i % 251 for i in range(n))
+
+
+# Official BLAKE3 test vectors (first 32 bytes of output) for lengths 1,
+# 1024, 2048; the 0-length value is pinned from this implementation after
+# the others were verified (single-chunk/parent/root paths all covered).
+KNOWN = {
+    0: "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262",
+    1: "2d3adedff11b61f14c886e35afa036736dcd87a74d27b5c1510225d0f592e213",
+    1024: "42214739f095a406f3fc83deb889744ac00df831c10daa55189b5d121c855af7",
+    2048: "e776b6028c7cd22a4d0ba182a8bf62205d2ef576467e838ed6f2529b85fba24a",
+}
+
+
+def test_known_vectors():
+    for n, want in KNOWN.items():
+        assert blake3_hex(tv_input(n)) == want, f"len={n}"
+
+
+def test_streaming_matches_oneshot():
+    rng = random.Random(7)
+    for n in [0, 1, 63, 64, 65, 1023, 1024, 1025, 3072, 5000, 16384, 70000]:
+        data = bytes(rng.randrange(256) for _ in range(min(n, 4096))) * (
+            1 if n <= 4096 else (n // 4096 + 1)
+        )
+        data = data[:n]
+        oneshot = blake3_hex(data)
+        h = Blake3()
+        i = 0
+        while i < len(data):
+            step = rng.randrange(1, 1500)
+            h.update(data[i : i + step])
+            i += step
+        assert h.hexdigest() == oneshot, f"len={n}"
+
+
+def test_boundary_lengths_distinct():
+    seen = set()
+    for n in [0, 1, 64, 65, 1024, 1025, 2048, 2049, 4096]:
+        d = blake3_hex(tv_input(n))
+        assert d not in seen
+        seen.add(d)

@@ -1,0 +1,80 @@
+"""CAS-ID sampling semantics vs the reference algorithm (cas.rs)."""
+
+import os
+import random
+import struct
+
+from spacedrive_tpu.ops.blake3_ref import Blake3
+from spacedrive_tpu.ops.cas import (
+    HEADER_OR_FOOTER_SIZE,
+    LARGE_PAYLOAD_SIZE,
+    MINIMUM_FILE_SIZE,
+    SAMPLE_COUNT,
+    SAMPLE_SIZE,
+    file_checksum,
+    generate_cas_id,
+    sample_spec,
+)
+
+
+def make_file(tmp_path, name, data: bytes):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return p
+
+
+def test_small_file_spec():
+    assert sample_spec(0) == [(0, 0)]
+    assert sample_spec(MINIMUM_FILE_SIZE) == [(0, MINIMUM_FILE_SIZE)]
+
+
+def test_large_file_spec_shape():
+    for size in [MINIMUM_FILE_SIZE + 1, 200_000, 10_000_000, 5_000_000_001]:
+        spec = sample_spec(size)
+        assert len(spec) == 2 + SAMPLE_COUNT
+        assert spec[0] == (0, HEADER_OR_FOOTER_SIZE)
+        assert spec[-1] == (size - HEADER_OR_FOOTER_SIZE, HEADER_OR_FOOTER_SIZE)
+        jump = (size - 2 * HEADER_OR_FOOTER_SIZE) // SAMPLE_COUNT
+        for k in range(SAMPLE_COUNT):
+            off, ln = spec[1 + k]
+            assert ln == SAMPLE_SIZE
+            assert off == HEADER_OR_FOOTER_SIZE + k * jump
+            assert off + ln <= size  # read_exact must succeed
+        assert sum(ln for _, ln in spec) == LARGE_PAYLOAD_SIZE
+
+
+def manual_cas(data: bytes) -> str:
+    """Independent re-derivation: hash prefix + explicitly sliced payload."""
+    size = len(data)
+    h = Blake3()
+    h.update(struct.pack("<Q", size))
+    if size <= MINIMUM_FILE_SIZE:
+        h.update(data)
+    else:
+        jump = (size - 2 * HEADER_OR_FOOTER_SIZE) // SAMPLE_COUNT
+        h.update(data[:HEADER_OR_FOOTER_SIZE])
+        for k in range(SAMPLE_COUNT):
+            off = HEADER_OR_FOOTER_SIZE + k * jump
+            h.update(data[off : off + SAMPLE_SIZE])
+        h.update(data[size - HEADER_OR_FOOTER_SIZE :])
+    return h.hexdigest()[:16]
+
+
+def test_cas_id_matches_manual(tmp_path):
+    rng = random.Random(42)
+    for size in [0, 1, 1000, MINIMUM_FILE_SIZE, MINIMUM_FILE_SIZE + 1, 150_000, 400_000]:
+        data = os.urandom(size)
+        p = make_file(tmp_path, f"f{size}", data)
+        got = generate_cas_id(p)
+        assert got == manual_cas(data), f"size={size}"
+        assert len(got) == 16
+
+
+def test_checksum(tmp_path):
+    data = os.urandom(3_000_000)  # spans multiple 1 MiB blocks
+    p = make_file(tmp_path, "big", data)
+    from spacedrive_tpu.ops.blake3_ref import blake3_hex
+
+    got = file_checksum(p)
+    assert got == blake3_hex(data)
+    assert len(got) == 64
