@@ -1,0 +1,307 @@
+"""Batched pad-and-mask BLAKE3 over arrays — backend-generic core.
+
+One algorithm, two array backends (numpy for the CPU baseline, jax.numpy
+for the TPU path). The structure is chosen for SIMD/VPU execution rather
+than translated from the reference's streaming Rust (cas.rs drives the
+`blake3` crate per file; here whole batches hash at once):
+
+- Every 32-bit state/message word is its own ``[B, C]`` array (B files,
+  C chunks), so the compression function is pure elementwise arithmetic
+  with zero gathers — ideal for the TPU VPU and for numpy vectorization.
+- Chunk stage: all chunks of all files compress in parallel; only the 16
+  blocks within a chunk are sequential (a real data dependency).
+- Tree stage: BLAKE3's "left subtree = largest power of two" rule is
+  equivalent to repeated adjacent pairing with odd-tail promotion, so the
+  merge is ceil(log2(C)) vectorized parent compressions with per-lane
+  ROOT flags (different files can root at different levels).
+
+Inputs are zero-padded ``uint32`` little-endian word grids plus per-file
+byte lengths; inactive blocks/chunks are masked with ``where`` selects.
+Chunk counters are 32-bit here: single-call messages are bounded by the
+grid size, and the streaming validator path passes an explicit
+``counter_base`` (supports files up to 2^32 chunks = 4 TiB).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .blake3_ref import (
+    BLOCK_LEN,
+    CHUNK_END,
+    CHUNK_LEN,
+    CHUNK_START,
+    IV,
+    MSG_PERMUTATION,
+    PARENT,
+    ROOT,
+)
+
+BLOCKS_PER_CHUNK = CHUNK_LEN // BLOCK_LEN  # 16
+WORDS_PER_BLOCK = BLOCK_LEN // 4  # 16
+WORDS_PER_CHUNK = CHUNK_LEN // 4  # 256
+
+
+def _rotr(x, n: int):
+    # uint32 rotate right by a static amount.
+    return (x >> n) | (x << (32 - n))
+
+
+def _ground(R0, R1, R2, R3, MX, MY):
+    """The G mixing function applied to all four columns at once.
+
+    R0..R3 are the four rows of the 4×4 state matrix, shape [4, ...] with
+    axis 0 = column index. This is the standard SIMD formulation of
+    BLAKE-family compression: 2 vector G calls per round instead of 8
+    scalar ones, which keeps both numpy op count and XLA graph size small
+    (the naive 16-scalar-word DAG sends XLA-CPU's optimizer into
+    exponential territory).
+    """
+    R0 = R0 + R1 + MX
+    R3 = _rotr(R3 ^ R0, 16)
+    R2 = R2 + R3
+    R1 = _rotr(R1 ^ R2, 12)
+    R0 = R0 + R1 + MY
+    R3 = _rotr(R3 ^ R0, 8)
+    R2 = R2 + R3
+    R1 = _rotr(R1 ^ R2, 7)
+    return R0, R1, R2, R3
+
+
+def compress_cv(xp, cv, m, counter_lo, counter_hi, block_len, flags):
+    """Vectorized BLAKE3 compression returning the 8-word chaining value.
+
+    cv: list of 8 arrays; m: list of 16 arrays; counter/block_len/flags:
+    arrays (or scalars) broadcastable against them. All uint32.
+    """
+    u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
+    parts = (
+        list(cv)
+        + list(m)
+        + [u32(counter_lo), u32(counter_hi), u32(block_len), u32(flags)]
+    )
+    shape = np.broadcast_shapes(*[getattr(a, "shape", ()) for a in parts])
+    bc = lambda a: xp.broadcast_to(xp.asarray(a, dtype=xp.uint32), shape)  # noqa: E731
+
+    cv = [bc(w) for w in cv]
+    m = [bc(w) for w in m]
+    R0 = xp.stack(cv[0:4])
+    R1 = xp.stack(cv[4:8])
+    R2 = xp.stack([bc(IV[0]), bc(IV[1]), bc(IV[2]), bc(IV[3])])
+    R3 = xp.stack([bc(counter_lo), bc(counter_hi), bc(block_len), bc(flags)])
+
+    for r in range(7):
+        MXc = xp.stack([m[0], m[2], m[4], m[6]])
+        MYc = xp.stack([m[1], m[3], m[5], m[7]])
+        MXd = xp.stack([m[8], m[10], m[12], m[14]])
+        MYd = xp.stack([m[9], m[11], m[13], m[15]])
+        # column step
+        R0, R1, R2, R3 = _ground(R0, R1, R2, R3, MXc, MYc)
+        # diagonal step: rotate rows so diagonals become columns
+        R1 = xp.roll(R1, -1, axis=0)
+        R2 = xp.roll(R2, -2, axis=0)
+        R3 = xp.roll(R3, -3, axis=0)
+        R0, R1, R2, R3 = _ground(R0, R1, R2, R3, MXd, MYd)
+        R1 = xp.roll(R1, 1, axis=0)
+        R2 = xp.roll(R2, 2, axis=0)
+        R3 = xp.roll(R3, 3, axis=0)
+        if r < 6:
+            m = [m[p] for p in MSG_PERMUTATION]
+
+    lo = R0 ^ R2  # out[i] = s[i] ^ s[i+8]
+    hi = R1 ^ R3
+    return [lo[0], lo[1], lo[2], lo[3], hi[0], hi[1], hi[2], hi[3]]
+
+
+def _select(xp, cond, a, b):
+    """where() over parallel lists of word arrays."""
+    return [xp.where(cond, x, y) for x, y in zip(a, b)]
+
+
+def split_counter_base(counter_base):
+    """Normalize a chunk-counter base to a (lo, hi) uint32 pair.
+
+    Accepts a python int, a numpy uint64 array, or an already-split pair.
+    Chunk counters are 64-bit in BLAKE3; device code carries them as two
+    uint32 words since TPU jax runs without x64.
+    """
+    if isinstance(counter_base, tuple):
+        return counter_base
+    base = np.asarray(counter_base, dtype=np.uint64)
+    lo = (base & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (base >> np.uint64(32)).astype(np.uint32)
+    return lo, hi
+
+
+def chunk_prelude(xp, lengths, C, counter_base=0):
+    """Shared per-chunk metadata for the chunk stage (numpy and JAX paths).
+
+    Returns (chunk_bytes [B,C], n_chunks [B], single [B,1],
+    k_last [B,C], counter_lo [B,C], counter_hi [B,C], empty0 [B,C]).
+    `single` is true only for a complete one-chunk message hashed from
+    counter 0 — a streaming window that happens to hold one chunk must
+    NOT be root-finalized.
+    """
+    u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
+    lengths = xp.asarray(lengths, dtype=xp.int32)
+    len_b = lengths[:, None]
+    chunk_index = xp.arange(C, dtype=xp.int32)[None, :]
+    chunk_bytes = xp.clip(len_b - chunk_index * CHUNK_LEN, 0, CHUNK_LEN)
+    n_chunks = xp.maximum((lengths + (CHUNK_LEN - 1)) // CHUNK_LEN, 1)
+    base_lo, base_hi = split_counter_base(counter_base)
+    base_lo = u32(base_lo)
+    base_hi = u32(base_hi)
+    if getattr(base_lo, "ndim", 0) == 1:  # per-file bases: [B] → [B, 1]
+        base_lo = base_lo[:, None]
+        base_hi = base_hi[:, None]
+    at_zero = (base_lo == 0) & (base_hi == 0)  # scalar or [B, 1]
+    single = (n_chunks[:, None] == 1) & at_zero  # [B, 1]
+    k_last = xp.maximum((chunk_bytes + (BLOCK_LEN - 1)) // BLOCK_LEN - 1, 0)
+    idx_u32 = u32(chunk_index)
+    counter_lo = (base_lo + idx_u32) * xp.ones_like(chunk_bytes, dtype=xp.uint32)
+    carry = xp.where(counter_lo < idx_u32, u32(1), u32(0))
+    counter_hi = (base_hi + carry) * xp.ones_like(chunk_bytes, dtype=xp.uint32)
+    empty0 = (len_b == 0) & (chunk_index == 0)
+    return chunk_bytes, n_chunks, single, k_last, counter_lo, counter_hi, empty0
+
+
+def block_meta(xp, chunk_bytes, k_last, single, empty0, k):
+    """(block_len, active, flags) for block index k of every chunk.
+
+    `k` may be a python int (unrolled numpy path) or a traced scalar
+    (lax.scan path) — the arithmetic is identical, which keeps the two
+    backends incapable of diverging on masking/flag semantics.
+    """
+    u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
+    block_len = xp.clip(chunk_bytes - k * BLOCK_LEN, 0, BLOCK_LEN)
+    is_last = k_last == k
+    is_first = k == 0  # bool or traced bool
+    # A block participates if it holds real bytes, or it is the all-zero
+    # block 0 of chunk 0 for an empty message.
+    active = (block_len > 0) | (is_first & empty0)
+    flags = (
+        xp.where(is_first, u32(CHUNK_START), u32(0))
+        + xp.where(is_last, u32(CHUNK_END), u32(0))
+        + xp.where(is_last & single, u32(ROOT), u32(0))
+    )
+    return block_len, active, flags
+
+
+def chunk_cvs(xp, words, lengths, counter_base=0):
+    """Compute per-chunk chaining values for a batch.
+
+    words:   [B, C, 256] uint32, little-endian packed, zero padded.
+    lengths: [B] int32 — true message byte length of each file.
+    counter_base: absolute index of chunk 0 (int, uint64 array, or
+        pre-split (lo, hi) uint32 pair) for streaming windows.
+
+    Returns (cvs, n_chunks): cvs is a list of 8 [B, C] uint32 arrays,
+    n_chunks is [B]. If the whole message is a single chunk hashed from
+    counter 0, that chunk's final block was compressed WITH the ROOT
+    flag, so cvs[:, 0] is already the final digest for those lanes (and
+    tree_reduce passes it through untouched).
+    """
+    B, C, W = words.shape
+    assert W == WORDS_PER_CHUNK
+    u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
+    (
+        chunk_bytes, n_chunks, single, k_last,
+        counter_lo, counter_hi, empty0,
+    ) = chunk_prelude(xp, lengths, C, counter_base)
+
+    cv = [u32(IV[i]) * xp.ones((B, C), dtype=xp.uint32) for i in range(8)]
+    for k in range(BLOCKS_PER_CHUNK):
+        block_len, active, flags = block_meta(
+            xp, chunk_bytes, k_last, single, empty0, k
+        )
+        m = [words[:, :, k * WORDS_PER_BLOCK + j] for j in range(WORDS_PER_BLOCK)]
+        new_cv = compress_cv(
+            xp, cv, m, counter_lo, counter_hi, u32(block_len), flags
+        )
+        cv = _select(xp, active, new_cv, cv)
+    return cv, n_chunks
+
+
+def tree_reduce(xp, cvs, n_chunks):
+    """Fold per-chunk CVs into root digests via adjacent pairing.
+
+    cvs: list of 8 [B, C] arrays; n_chunks: [B]. Returns list of 8 [B]
+    arrays — the first 32 bytes of each file's BLAKE3 digest. Lanes with
+    n_chunks == 1 pass through untouched (their ROOT compression already
+    happened in the chunk stage).
+    """
+    B, C = cvs[0].shape
+    u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
+    n = xp.asarray(n_chunks, dtype=xp.int32)
+    while C > 1:
+        half = (C + 1) // 2
+        pad = half * 2 - C
+        if pad:
+            cvs = [
+                xp.concatenate([w, xp.zeros((B, pad), dtype=xp.uint32)], axis=1)
+                for w in cvs
+            ]
+        left = [w[:, 0::2] for w in cvs]  # [B, half]
+        right = [w[:, 1::2] for w in cvs]
+        pair_index = xp.arange(half, dtype=xp.int32)[None, :]
+        merged_real = (pair_index * 2 + 1) < n[:, None]
+        is_root = (n[:, None] == 2) & (pair_index == 0)
+        flags = u32(PARENT) + xp.where(is_root, u32(ROOT), u32(0))
+        iv_cv = [u32(IV[i]) * xp.ones((B, half), dtype=xp.uint32) for i in range(8)]
+        parent = compress_cv(
+            xp,
+            iv_cv,
+            left + right,  # parent block = left_cv ‖ right_cv
+            xp.zeros((B, half), dtype=xp.uint32),
+            xp.zeros((B, half), dtype=xp.uint32),
+            u32(BLOCK_LEN),
+            flags,
+        )
+        cvs = _select(xp, merged_real, parent, left)
+        n = xp.maximum((n + 1) // 2, 1)
+        C = half
+    return [w[:, 0] for w in cvs]
+
+
+def blake3_batch(xp, words, lengths):
+    """Full batched BLAKE3: [B, C, 256] words + [B] lengths → 8×[B] words."""
+    cvs, n_chunks = chunk_cvs(xp, words, lengths)
+    return tree_reduce(xp, cvs, n_chunks)
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch packing (always numpy).
+
+
+def pack_messages(messages, max_chunks=None):
+    """Pack variable-length byte strings into a padded word grid.
+
+    Returns (words [B, C, 256] uint32, lengths [B] int32).
+    """
+    B = len(messages)
+    longest = max((len(m) for m in messages), default=0)
+    C = max(1, -(-longest // CHUNK_LEN))
+    if max_chunks is not None:
+        assert C <= max_chunks, (C, max_chunks)
+        C = max_chunks
+    buf = np.zeros((B, C * CHUNK_LEN), dtype=np.uint8)
+    lengths = np.zeros((B,), dtype=np.int32)
+    for i, m in enumerate(messages):
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lengths[i] = len(m)
+    words = buf.view("<u4").reshape(B, C, WORDS_PER_CHUNK)
+    return words, lengths
+
+
+def digest_words_to_bytes(word_lists) -> list:
+    """8×[B] uint32 word arrays → list of 32-byte digests."""
+    stacked = np.stack([np.asarray(w) for w in word_lists], axis=1)  # [B, 8]
+    le = stacked.astype("<u4")
+    return [le[i].tobytes() for i in range(le.shape[0])]
+
+
+def blake3_batch_np(messages) -> list:
+    """CPU batched BLAKE3 of a list of byte strings → 32-byte digests."""
+    words, lengths = pack_messages(messages)
+    out = blake3_batch(np, words, lengths)
+    return digest_words_to_bytes(out)

@@ -1,0 +1,5 @@
+"""Mesh construction and sharding helpers for multi-chip execution."""
+
+from .mesh import batch_mesh, pad_to_multiple, tile_mesh
+
+__all__ = ["batch_mesh", "tile_mesh", "pad_to_multiple"]

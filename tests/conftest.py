@@ -1,15 +1,31 @@
 """Test configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
-exercised without TPU hardware (the driver separately dry-runs the multichip
-path). Must be set before jax is imported anywhere.
+exercised without TPU hardware (the driver separately dry-runs the
+multichip path). XLA_FLAGS must be set before jax initializes a backend;
+platform selection must go through jax.config because the axon TPU
+plugin overrides the JAX_PLATFORMS env var at interpreter start.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pytest
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin registers at interpreter start (sitecustomize) and
+# sets jax_platforms="axon,cpu", so merely calling jax.devices() would
+# initialize the TPU tunnel (slow, single-client). Tests never need the
+# real chip: restrict platforms to cpu BEFORE any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def cpu_devices():
+    return jax.devices("cpu")
