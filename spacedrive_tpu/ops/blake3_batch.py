@@ -133,14 +133,16 @@ def split_counter_base(counter_base):
     return lo, hi
 
 
-def chunk_prelude(xp, lengths, C, counter_base=0):
+def chunk_prelude(xp, lengths, C, counter_base=0, whole=True):
     """Shared per-chunk metadata for the chunk stage (numpy and JAX paths).
 
     Returns (chunk_bytes [B,C], n_chunks [B], single [B,1],
     k_last [B,C], counter_lo [B,C], counter_hi [B,C], empty0 [B,C]).
     `single` is true only for a complete one-chunk message hashed from
-    counter 0 — a streaming window that happens to hold one chunk must
-    NOT be root-finalized.
+    counter 0. A streaming window that happens to hold one chunk but is a
+    prefix of a longer message must NOT be root-finalized: such callers
+    pass ``whole=False`` (counter_base==0 alone cannot distinguish the
+    first window of a long stream from a genuine one-chunk message).
     """
     u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
     lengths = xp.asarray(lengths, dtype=xp.int32)
@@ -155,7 +157,7 @@ def chunk_prelude(xp, lengths, C, counter_base=0):
         base_lo = base_lo[:, None]
         base_hi = base_hi[:, None]
     at_zero = (base_lo == 0) & (base_hi == 0)  # scalar or [B, 1]
-    single = (n_chunks[:, None] == 1) & at_zero  # [B, 1]
+    single = (n_chunks[:, None] == 1) & at_zero & whole  # [B, 1]
     k_last = xp.maximum((chunk_bytes + (BLOCK_LEN - 1)) // BLOCK_LEN - 1, 0)
     idx_u32 = u32(chunk_index)
     counter_lo = (base_lo + idx_u32) * xp.ones_like(chunk_bytes, dtype=xp.uint32)
@@ -187,13 +189,15 @@ def block_meta(xp, chunk_bytes, k_last, single, empty0, k):
     return block_len, active, flags
 
 
-def chunk_cvs(xp, words, lengths, counter_base=0):
+def chunk_cvs(xp, words, lengths, counter_base=0, whole=True):
     """Compute per-chunk chaining values for a batch.
 
     words:   [B, C, 256] uint32, little-endian packed, zero padded.
     lengths: [B] int32 — true message byte length of each file.
     counter_base: absolute index of chunk 0 (int, uint64 array, or
         pre-split (lo, hi) uint32 pair) for streaming windows.
+    whole: False when this grid is a window of a longer stream, so a
+        one-chunk window at counter 0 is not root-finalized.
 
     Returns (cvs, n_chunks): cvs is a list of 8 [B, C] uint32 arrays,
     n_chunks is [B]. If the whole message is a single chunk hashed from
@@ -207,7 +211,7 @@ def chunk_cvs(xp, words, lengths, counter_base=0):
     (
         chunk_bytes, n_chunks, single, k_last,
         counter_lo, counter_hi, empty0,
-    ) = chunk_prelude(xp, lengths, C, counter_base)
+    ) = chunk_prelude(xp, lengths, C, counter_base, whole)
 
     cv = [u32(IV[i]) * xp.ones((B, C), dtype=xp.uint32) for i in range(8)]
     for k in range(BLOCKS_PER_CHUNK):

@@ -32,7 +32,7 @@ SMALL_MSG_MAX = cas.SIZE_PREFIX_LEN + cas.MINIMUM_FILE_SIZE  # 102408
 SMALL_CHUNKS = -(-SMALL_MSG_MAX // CHUNK_LEN)  # 101
 
 
-def _chunk_cvs_scan(words, lengths, counter_base=0):
+def _chunk_cvs_scan(words, lengths, counter_base=0, whole=True):
     """JAX-shaped chunk stage: lax.scan over the 16 blocks of every chunk.
 
     Same math as blake3_batch.chunk_cvs (the numpy oracle path) — the
@@ -57,7 +57,7 @@ def _chunk_cvs_scan(words, lengths, counter_base=0):
     (
         chunk_bytes, n_chunks, single, k_last,
         counter_lo, counter_hi, empty0,
-    ) = chunk_prelude(jnp, lengths, C, counter_base)
+    ) = chunk_prelude(jnp, lengths, C, counter_base, whole)
 
     blocks = jnp.moveaxis(
         words.reshape(B, C, BLOCKS_PER_CHUNK, WORDS_PER_BLOCK), 2, 0
@@ -84,13 +84,18 @@ def _chunk_cvs_scan(words, lengths, counter_base=0):
     return list(cv), n_chunks
 
 
-@jax.jit
-def blake3_words(words, lengths):
-    """[B, C, 256] uint32 words + [B] int32 lengths → [B, 8] uint32 digests."""
+def _blake3_impl(words, lengths):
+    """Shared body of the jitted and shard_mapped entry points."""
     from .blake3_batch import tree_reduce
 
     cvs, n_chunks = _chunk_cvs_scan(words, lengths)
     return jnp.stack(tree_reduce(jnp, cvs, n_chunks), axis=1)
+
+
+@jax.jit
+def blake3_words(words, lengths):
+    """[B, C, 256] uint32 words + [B] int32 lengths → [B, 8] uint32 digests."""
+    return _blake3_impl(words, lengths)
 
 
 def make_sharded_blake3(mesh, axis: str = "data"):
@@ -102,20 +107,14 @@ def make_sharded_blake3(mesh, axis: str = "data"):
     """
     P = jax.sharding.PartitionSpec
 
-    @jax.jit
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=P(axis),
+    return jax.jit(
+        functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(axis),
+        )(_blake3_impl)
     )
-    def sharded(words, lengths):
-        from .blake3_batch import tree_reduce
-
-        cvs, n_chunks = _chunk_cvs_scan(words, lengths)
-        return jnp.stack(tree_reduce(jnp, cvs, n_chunks), axis=1)
-
-    return sharded
 
 
 # ---------------------------------------------------------------------------
@@ -136,6 +135,13 @@ def build_cas_messages(payloads: np.ndarray, sizes: np.ndarray, payload_lens=Non
     B, P = payloads.shape
     if payload_lens is None:
         payload_lens = np.full((B,), P, dtype=np.int32)
+    else:
+        # Zero stale bytes past each row's payload: the compression always
+        # consumes full 16-word blocks (block_len only clips the count), so
+        # a reused buffer with residue would silently change the digest.
+        payload_lens = np.asarray(payload_lens, dtype=np.int32)
+        mask = np.arange(P, dtype=np.int32)[None, :] < payload_lens[:, None]
+        payloads = np.where(mask, payloads, 0).astype(np.uint8)
     msg_len = cas.SIZE_PREFIX_LEN + P
     C = max(1, -(-msg_len // CHUNK_LEN))
     buf = np.zeros((B, C * CHUNK_LEN), dtype=np.uint8)

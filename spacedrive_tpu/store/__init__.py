@@ -1,0 +1,7 @@
+from .db import Database, now_ts, rows_to_dicts, uuid_bytes
+from .models import MODELS, Model, SyncMode
+
+__all__ = [
+    "Database", "MODELS", "Model", "SyncMode",
+    "now_ts", "rows_to_dicts", "uuid_bytes",
+]

@@ -1,0 +1,176 @@
+"""SQLite store: one database file per library, single-writer discipline.
+
+The reference talks to SQLite through a generated Prisma client and leans
+on batched writes because "db is single threaded, nerd"
+(/root/reference/core/src/job/manager.rs:31). Here the equivalent is a
+thin typed wrapper over the stdlib sqlite3 driver in WAL mode with one
+process-wide write lock per database; all workload writes go through
+`tx()` batches exactly like the reference's `_batch` calls.
+
+Rows come back as sqlite3.Row (dict-style access). The DDL comes from the
+model registry (store/models.py), mirroring core/prisma/schema.prisma.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from . import models
+
+
+def uuid_bytes(u: Optional[uuid.UUID] = None) -> bytes:
+    """Stable 16-byte id, like sd_utils::uuid_to_bytes."""
+    return (u or uuid.uuid4()).bytes
+
+
+def now_ts() -> int:
+    return int(time.time())
+
+
+class Database:
+    """A single SQLite database with serialized writes.
+
+    Connections are per-thread (sqlite3 objects cannot cross threads);
+    writes additionally serialize on one lock so batched transactions
+    from concurrent jobs never deadlock on SQLITE_BUSY.
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = str(path)
+        self._write_lock = threading.RLock()
+        self._local = threading.local()
+        conn = self._conn()
+        with self._write_lock:
+            for stmt in models.all_ddl():
+                conn.execute(stmt)
+            conn.commit()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- reads ------------------------------------------------------------
+
+    def query(self, sql: str, params: Sequence = ()) -> List[sqlite3.Row]:
+        return self._conn().execute(sql, params).fetchall()
+
+    def query_one(self, sql: str, params: Sequence = ()) -> Optional[sqlite3.Row]:
+        return self._conn().execute(sql, params).fetchone()
+
+    # -- writes -----------------------------------------------------------
+
+    @contextmanager
+    def tx(self):
+        """Serialized write transaction; the unit of atomic batching."""
+        conn = self._conn()
+        with self._write_lock:
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+                yield conn
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
+
+    def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
+        with self.tx() as conn:
+            return conn.execute(sql, params)
+
+    # -- typed helpers over the model registry ----------------------------
+
+    def insert(self, table: str, row: Dict[str, Any],
+               conn: Optional[sqlite3.Connection] = None) -> int:
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        sql = f"INSERT INTO {table} ({cols}) VALUES ({ph})"
+        if conn is not None:
+            return conn.execute(sql, list(row.values())).lastrowid
+        with self.tx() as c:
+            return c.execute(sql, list(row.values())).lastrowid
+
+    def insert_many(self, table: str, rows: List[Dict[str, Any]],
+                    conn: Optional[sqlite3.Connection] = None,
+                    ignore_conflicts: bool = False) -> int:
+        """Batched create_many; returns number of rows inserted."""
+        if not rows:
+            return 0
+        # Union of keys across all rows (heterogeneous batches are natural:
+        # dirs lack extension, some paths lack cas_id); missing keys → NULL.
+        cols = list(dict.fromkeys(k for r in rows for k in r))
+        ph = ", ".join("?" for _ in cols)
+        conflict = " OR IGNORE" if ignore_conflicts else ""
+        sql = (
+            f"INSERT{conflict} INTO {table} ({', '.join(cols)}) "
+            f"VALUES ({ph})"
+        )
+        vals = [[r.get(c) for c in cols] for r in rows]
+        if conn is not None:
+            cur = conn.executemany(sql, vals)
+            return cur.rowcount
+        with self.tx() as c:
+            cur = c.executemany(sql, vals)
+            return cur.rowcount
+
+    def update(self, table: str, row_id: Any, values: Dict[str, Any],
+               conn: Optional[sqlite3.Connection] = None,
+               id_col: str = "id") -> None:
+        if not values:
+            return
+        sets = ", ".join(f"{k} = ?" for k in values)
+        sql = f"UPDATE {table} SET {sets} WHERE {id_col} = ?"
+        params = list(values.values()) + [row_id]
+        if conn is not None:
+            conn.execute(sql, params)
+        else:
+            with self.tx() as c:
+                c.execute(sql, params)
+
+    def upsert(self, table: str, key: Dict[str, Any], values: Dict[str, Any],
+               conn: Optional[sqlite3.Connection] = None) -> None:
+        cols = list(key) + list(values)
+        ph = ", ".join("?" for _ in cols)
+        sets = ", ".join(f"{k} = excluded.{k}" for k in values) or \
+            f"{list(key)[0]} = excluded.{list(key)[0]}"
+        sql = (
+            f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph}) "
+            f"ON CONFLICT ({', '.join(key)}) DO UPDATE SET {sets}"
+        )
+        params = list(key.values()) + list(values.values())
+        if conn is not None:
+            conn.execute(sql, params)
+        else:
+            with self.tx() as c:
+                c.execute(sql, params)
+
+    def delete(self, table: str, row_id: Any,
+               conn: Optional[sqlite3.Connection] = None,
+               id_col: str = "id") -> None:
+        sql = f"DELETE FROM {table} WHERE {id_col} = ?"
+        if conn is not None:
+            conn.execute(sql, (row_id,))
+        else:
+            with self.tx() as c:
+                c.execute(sql, (row_id,))
+
+
+def rows_to_dicts(rows: Iterable[sqlite3.Row]) -> List[Dict[str, Any]]:
+    return [dict(r) for r in rows]

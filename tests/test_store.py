@@ -1,0 +1,95 @@
+"""Store layer: DDL generation, CRUD, batching, constraint semantics."""
+
+import sqlite3
+import threading
+
+import pytest
+
+from spacedrive_tpu.store import Database, MODELS, SyncMode, uuid_bytes
+
+
+@pytest.fixture
+def db(tmp_path):
+    return Database(tmp_path / "library.db")
+
+
+def test_all_tables_created(db):
+    names = {
+        r["name"]
+        for r in db.query("SELECT name FROM sqlite_master WHERE type='table'")
+    }
+    for model in MODELS:
+        assert model in names
+
+
+def test_file_path_unique_constraints(db):
+    loc = db.insert("location", {"pub_id": uuid_bytes(), "name": "home",
+                                 "path": "/home"})
+    row = {
+        "pub_id": uuid_bytes(), "location_id": loc,
+        "materialized_path": "a/b/", "name": "f", "extension": "txt",
+        "is_dir": 0,
+    }
+    db.insert("file_path", row)
+    # same (location, path, name, ext) → reject, like schema.prisma:197
+    dup = dict(row, pub_id=uuid_bytes())
+    with pytest.raises(sqlite3.IntegrityError):
+        db.insert("file_path", dup)
+    assert db.insert_many("file_path", [dup], ignore_conflicts=True) == 0
+
+
+def test_insert_many_and_query(db):
+    loc = db.insert("location", {"pub_id": uuid_bytes(), "path": "/x"})
+    rows = [
+        {"pub_id": uuid_bytes(), "location_id": loc,
+         "materialized_path": "", "name": f"f{i}", "extension": "bin"}
+        for i in range(1000)
+    ]
+    assert db.insert_many("file_path", rows) == 1000
+    n = db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"]
+    assert n == 1000
+
+
+def test_atomic_tx_rollback(db):
+    with pytest.raises(RuntimeError):
+        with db.tx() as conn:
+            db.insert("object", {"pub_id": uuid_bytes()}, conn=conn)
+            raise RuntimeError("abort")
+    assert db.query_one("SELECT COUNT(*) AS n FROM object")["n"] == 0
+
+
+def test_upsert_preference(db):
+    db.upsert("preference", {"key": "theme"}, {"value": b"dark"})
+    db.upsert("preference", {"key": "theme"}, {"value": b"light"})
+    rows = db.query("SELECT * FROM preference")
+    assert len(rows) == 1 and rows[0]["value"] == b"light"
+
+
+def test_concurrent_writers(db):
+    """Write lock serializes threads; no SQLITE_BUSY surfacing."""
+    errors = []
+
+    def work(i):
+        try:
+            for j in range(20):
+                db.insert("object", {"pub_id": uuid_bytes()})
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert db.query_one("SELECT COUNT(*) AS n FROM object")["n"] == 160
+
+
+def test_sync_metadata_registry():
+    fp = MODELS["file_path"]
+    assert fp.sync is SyncMode.SHARED and fp.sync_id == ("pub_id",)
+    assert MODELS["tag_on_object"].sync is SyncMode.RELATION
+    assert MODELS["job"].sync is SyncMode.LOCAL
+    # local_only fields never sync (location.instance_id)
+    loc = MODELS["location"]
+    assert "instance_id" not in [f.name for f in loc.synced_fields]
