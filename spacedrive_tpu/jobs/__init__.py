@@ -1,0 +1,18 @@
+from .job import (
+    EarlyFinish,
+    JOB_REGISTRY,
+    JobContext,
+    JobError,
+    JobState,
+    StatefulJob,
+    StepOutcome,
+    register_job,
+)
+from .manager import AlreadyRunning, JobBuilder, JobManager, MAX_WORKERS
+from .report import JobReport, JobStatus
+
+__all__ = [
+    "AlreadyRunning", "EarlyFinish", "JOB_REGISTRY", "JobBuilder",
+    "JobContext", "JobError", "JobManager", "JobReport", "JobState",
+    "JobStatus", "MAX_WORKERS", "StatefulJob", "StepOutcome", "register_job",
+]

@@ -1,0 +1,192 @@
+"""StatefulJob contract: resumable, checkpointable units of work.
+
+This is the framework's stable workload boundary, mirroring the semantics
+of the reference's `StatefulJob` trait
+(/root/reference/core/src/job/mod.rs:68-110): `init` produces work steps,
+`execute_step` runs one step (and may append more), `finalize` reports
+metadata. The whole job state — init args, working data, remaining steps,
+step number, run metadata — is msgpack-serializable, so jobs pause,
+survive process death, and cold-resume (mod.rs:694-775 semantics).
+
+Differences from the reference, chosen for the TPU design rather than
+ported: jobs are asyncio-native (the driver loop lives in
+jobs/worker.py), steps must be *idempotent* (an interrupted step replays
+on resume — required because a device batch in flight cannot be
+serialized mid-kernel, SURVEY.md §7 hard-part 3), and device work runs on
+an executor thread so the event loop stays responsive while XLA blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid as uuid_mod
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Type
+
+import msgpack
+
+
+class JobError(Exception):
+    pass
+
+
+class EarlyFinish(JobError):
+    """Job has nothing to do; complete cleanly (file_identifier_job.rs:131)."""
+
+
+@dataclass
+class StepOutcome:
+    """Result of one execute_step call.
+
+    more_steps are appended to the back of the queue (the indexer defers
+    directory walks this way); errors are non-fatal and accumulate into
+    the report (JobRunErrors semantics, job/mod.rs:31).
+    """
+
+    more_steps: List[Any] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+class StatefulJob:
+    """Base class for every workload job.
+
+    Subclasses set NAME (stable, used for DB dispatch on resume) and
+    implement init/execute_step/finalize. `init_args` must be a
+    msgpack-serializable dict: it is both the checkpoint identity and the
+    dedup hash input (job/manager.rs:107-122 semantics).
+    """
+
+    NAME: str = ""
+    IS_BATCHED: bool = False  # task_count counts batches, not items
+
+    def __init__(self, **init_args: Any):
+        self.init_args = init_args
+
+    # -- identity ---------------------------------------------------------
+
+    def hash(self) -> str:
+        """Dedup hash over (NAME, init args)."""
+        payload = msgpack.packb(
+            {"name": self.NAME, "init": self.init_args}, use_bin_type=True
+        )
+        return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+    # -- lifecycle (override) --------------------------------------------
+
+    async def init(self, ctx: "JobContext") -> tuple[Dict[str, Any], List[Any]]:
+        """Return (data, steps). Raise EarlyFinish when there is no work."""
+        raise NotImplementedError
+
+    async def execute_step(
+        self, ctx: "JobContext", data: Dict[str, Any], step: Any, step_number: int
+    ) -> Optional[StepOutcome]:
+        raise NotImplementedError
+
+    async def finalize(
+        self, ctx: "JobContext", data: Dict[str, Any], metadata: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        return metadata or None
+
+
+@dataclass
+class JobState:
+    """Everything needed to resume a job after pause or process death.
+
+    `next_chain` persists queued follow-up jobs as (name, init_args)
+    pairs so a paused indexer still triggers its identifier after a
+    process restart (the reference keeps next_jobs inside the serialized
+    JobState too, core/src/job/mod.rs:248-254).
+    """
+
+    init_args: Dict[str, Any]
+    data: Dict[str, Any]
+    steps: Deque[Any]
+    step_number: int
+    run_metadata: Dict[str, Any]
+    next_chain: List[Any] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        return msgpack.packb(
+            {
+                "init": self.init_args,
+                "data": self.data,
+                "steps": list(self.steps),
+                "step_number": self.step_number,
+                "run_metadata": self.run_metadata,
+                "next_chain": [
+                    [name, init] for name, init in self.next_chain
+                ],
+            },
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def deserialize(cls, blob: bytes) -> "JobState":
+        raw = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+        return cls(
+            init_args=raw["init"],
+            data=raw["data"],
+            steps=deque(raw["steps"]),
+            step_number=raw["step_number"],
+            run_metadata=raw["run_metadata"],
+            next_chain=[tuple(p) for p in raw.get("next_chain", [])],
+        )
+
+    @classmethod
+    def fresh(cls, init_args: Dict[str, Any],
+              next_chain: Optional[List[Any]] = None) -> "JobState":
+        """Pre-init state written at ingest so QUEUED jobs survive restarts."""
+        return cls(
+            init_args=init_args, data={}, steps=deque(), step_number=0,
+            run_metadata={}, next_chain=list(next_chain or []),
+        )
+
+
+class JobContext:
+    """Services visible to a running job: the library, progress, events.
+
+    `library` duck-types {db, sync, ...}; `services` carries node-level
+    actors (thumbnailer, staging pool) without jobs importing the node.
+    """
+
+    def __init__(self, library: Any, report_progress=None, services: Optional[dict] = None):
+        self.library = library
+        self.services = services or {}
+        self._report_progress = report_progress or (lambda **kw: None)
+
+    @property
+    def db(self):
+        return self.library.db
+
+    def progress(self, *, task_count: Optional[int] = None,
+                 completed: Optional[int] = None,
+                 message: Optional[str] = None) -> None:
+        """Report progress; the worker throttles and adds ETA."""
+        self._report_progress(
+            task_count=task_count, completed=completed, message=message
+        )
+
+
+# -- registry: NAME → class, for cold-resume dispatch ----------------------
+# (the reference does this with a macro over its 8 job types,
+#  core/src/job/manager.rs:362-399)
+
+JOB_REGISTRY: Dict[str, Type[StatefulJob]] = {}
+
+
+def register_job(cls: Type[StatefulJob]) -> Type[StatefulJob]:
+    assert cls.NAME, cls
+    JOB_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def job_from_state(name: str, state: JobState) -> StatefulJob:
+    cls = JOB_REGISTRY[name]
+    job = cls(**state.init_args)
+    return job
+
+
+def new_job_id() -> bytes:
+    return uuid_mod.uuid4().bytes

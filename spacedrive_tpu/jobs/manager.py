@@ -1,0 +1,279 @@
+"""Job manager: worker pool, dedup, FIFO queue, chaining, cold resume.
+
+Semantics mirrored from /root/reference/core/src/job/manager.rs — at most
+MAX_WORKERS jobs run concurrently (manager.rs:32), a job whose
+(name, init) hash matches a running or queued job is rejected
+(manager.rs:107-122), completed jobs trigger their queued `next_jobs`
+chain, and `cold_resume` re-hydrates Paused/Running/Queued reports from
+the DB at startup, failing those without a state blob
+(manager.rs:269-319).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .job import (
+    JOB_REGISTRY,
+    JobState,
+    StatefulJob,
+    new_job_id,
+)
+from .report import JobReport, JobStatus
+from .worker import Worker, WorkerCommand
+
+MAX_WORKERS = 5  # manager.rs:32
+
+
+class JobManagerError(Exception):
+    pass
+
+
+class AlreadyRunning(JobManagerError):
+    pass
+
+
+class JobBuilder:
+    """Compose a job with chained next-jobs, then dispatch it.
+
+    Mirrors scan_location's JobBuilder chain (core/src/location/mod.rs:429-445):
+    `JobBuilder(a).queue_next(b).queue_next(c).spawn(manager, library)`.
+    """
+
+    def __init__(self, job: StatefulJob, action: Optional[str] = None):
+        self.job = job
+        self.action = action
+        self.next_jobs: List[StatefulJob] = []
+
+    def queue_next(self, job: StatefulJob) -> "JobBuilder":
+        self.next_jobs.append(job)
+        return self
+
+    async def spawn(self, manager: "JobManager", library: Any) -> bytes:
+        return await manager.ingest(
+            library, self.job, next_jobs=self.next_jobs, action=self.action
+        )
+
+
+class _Entry:
+    def __init__(self, job, report, library, next_jobs, resume_state=None):
+        self.job = job
+        self.report = report
+        self.library = library
+        self.next_jobs: List[StatefulJob] = next_jobs
+        self.resume_state = resume_state
+
+
+class JobManager:
+    def __init__(self, on_event: Optional[Callable[[dict], None]] = None,
+                 services: Optional[dict] = None,
+                 max_workers: int = MAX_WORKERS):
+        self.max_workers = max_workers
+        self.on_event = on_event or (lambda e: None)
+        self.services = services or {}
+        self.running: Dict[bytes, Worker] = {}
+        self._tasks: Dict[bytes, asyncio.Task] = {}
+        self._entries: Dict[bytes, _Entry] = {}
+        self.queue: deque[_Entry] = deque()
+        self._hashes: Dict[str, bytes] = {}  # job.hash() → job id
+        self._final_status: Dict[bytes, JobStatus] = {}
+        self._shutting_down = False
+
+    # -- ingestion --------------------------------------------------------
+
+    async def ingest(self, library: Any, job: StatefulJob,
+                     next_jobs: Optional[List[StatefulJob]] = None,
+                     action: Optional[str] = None) -> bytes:
+        h = job.hash()
+        if h in self._hashes:
+            raise AlreadyRunning(f"{job.NAME} already running/queued")
+        next_jobs = list(next_jobs or [])
+        # Persist a pre-init state blob so a job that dies while QUEUED
+        # (or is shut down before starting) cold-resumes instead of
+        # failing with "lost state" — the blob also carries the chain.
+        state = JobState.fresh(
+            job.init_args,
+            [(j.NAME, j.init_args) for j in next_jobs],
+        )
+        report = JobReport(
+            id=new_job_id(), name=job.NAME, action=action,
+            data=state.serialize(),
+        )
+        report.create(library.db)
+        entry = _Entry(job, report, library, next_jobs, resume_state=state)
+        self._hashes[h] = report.id
+        self._admit(entry)
+        return report.id
+
+    def _admit(self, entry: _Entry) -> None:
+        self._entries[entry.report.id] = entry
+        if len(self.running) < self.max_workers and not self._shutting_down:
+            self._start(entry)
+        else:
+            entry.report.status = JobStatus.QUEUED
+            entry.report.update(entry.library.db)
+            self.queue.append(entry)
+
+    def _start(self, entry: _Entry) -> None:
+        worker = Worker(
+            entry.job, entry.report, entry.library,
+            on_event=self.on_event, services=self.services,
+            resume_state=entry.resume_state,
+        )
+        self.running[entry.report.id] = worker
+        task = asyncio.ensure_future(worker.run())
+        self._tasks[entry.report.id] = task
+        task.add_done_callback(
+            lambda t, jid=entry.report.id: self._on_done(jid, t)
+        )
+
+    def _on_done(self, job_id: bytes, task: asyncio.Task) -> None:
+        self.running.pop(job_id, None)
+        self._tasks.pop(job_id, None)
+        entry = self._entries.pop(job_id, None)
+        status = entry.report.status if entry else JobStatus.FAILED
+        self._final_status[job_id] = status
+        if entry is not None:
+            if status != JobStatus.PAUSED:
+                # Paused jobs keep their dedup hash so an identical ingest
+                # still collides with the paused run until it is resumed
+                # or cancelled.
+                self._hashes.pop(entry.job.hash(), None)
+            if status in (JobStatus.COMPLETED,
+                          JobStatus.COMPLETED_WITH_ERRORS) and \
+                    entry.next_jobs and not self._shutting_down:
+                head, *rest = entry.next_jobs
+                if head.hash() in self._hashes:
+                    self.on_event({
+                        "type": "JobError",
+                        "id": entry.report.id,
+                        "message": f"chained job {head.NAME} skipped: "
+                                   "identical job already running/queued",
+                    })
+                else:
+                    nxt_state = JobState.fresh(
+                        head.init_args,
+                        [(j.NAME, j.init_args) for j in rest],
+                    )
+                    nxt_report = JobReport(
+                        id=new_job_id(), name=head.NAME,
+                        parent_id=entry.report.id,
+                        data=nxt_state.serialize(),
+                    )
+                    nxt_report.create(entry.library.db)
+                    nxt = _Entry(head, nxt_report, entry.library, rest,
+                                 resume_state=nxt_state)
+                    self._hashes[head.hash()] = nxt_report.id
+                    self._admit(nxt)
+        while (self.queue and len(self.running) < self.max_workers
+               and not self._shutting_down):
+            self._start(self.queue.popleft())
+
+    # -- control ----------------------------------------------------------
+
+    def pause(self, job_id: bytes) -> None:
+        self._worker(job_id).command(WorkerCommand.PAUSE)
+
+    async def resume(self, library: Any, job_id: bytes) -> None:
+        """Resume a paused job, re-hydrating from the DB if needed."""
+        if job_id in self.running:
+            # Cancels a pending not-yet-actioned pause (latest command wins).
+            self.running[job_id].command(WorkerCommand.RESUME)
+            return
+        row = library.db.query_one("SELECT * FROM job WHERE id = ?", (job_id,))
+        if row is None:
+            raise JobManagerError("no such job")
+        report = JobReport.from_row(row)
+        if report.status != JobStatus.PAUSED or not report.data:
+            raise JobManagerError("job is not resumable")
+        self._admit_from_state(library, report)
+
+    def _admit_from_state(self, library: Any, report: JobReport) -> None:
+        state = JobState.deserialize(report.data)
+        job = JOB_REGISTRY[report.name](**state.init_args)
+        next_jobs = [
+            JOB_REGISTRY[name](**init) for name, init in state.next_chain
+            if name in JOB_REGISTRY
+        ]
+        entry = _Entry(job, report, library, next_jobs, resume_state=state)
+        self._hashes.setdefault(job.hash(), report.id)
+        self._final_status.pop(report.id, None)
+        self._admit(entry)
+
+    def cancel(self, job_id: bytes) -> None:
+        if job_id in self.running:
+            self._worker(job_id).command(WorkerCommand.CANCEL)
+            return
+        for entry in list(self.queue):
+            if entry.report.id == job_id:
+                self.queue.remove(entry)
+                self._entries.pop(job_id, None)
+                self._hashes.pop(entry.job.hash(), None)
+                entry.report.status = JobStatus.CANCELED
+                entry.report.update(entry.library.db)
+                return
+        raise JobManagerError("no such running/queued job")
+
+    def _worker(self, job_id: bytes) -> Worker:
+        if job_id not in self.running:
+            raise JobManagerError("no such running job")
+        return self.running[job_id]
+
+    async def wait(self, job_id: bytes) -> JobStatus:
+        """Await a job reaching a terminal-or-paused state."""
+        task = self._tasks.get(job_id)
+        if task is not None:
+            return await asyncio.shield(task)
+        for entry in self.queue:
+            if entry.report.id == job_id:
+                # queued and no worker yet: poll admission
+                while job_id not in self._tasks and \
+                        job_id not in self._final_status:
+                    await asyncio.sleep(0.01)
+                return await self.wait(job_id)
+        if job_id in self._final_status:
+            return self._final_status[job_id]
+        raise JobManagerError("unknown job")
+
+    async def wait_idle(self) -> None:
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks.values()),
+                                 return_exceptions=True)
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def shutdown(self) -> None:
+        """Pause everything running; queued jobs stay QUEUED in the DB."""
+        self._shutting_down = True
+        for w in list(self.running.values()):
+            w.command(WorkerCommand.SHUTDOWN)
+        await self.wait_idle()
+
+    async def cold_resume(self, library: Any) -> List[bytes]:
+        """Re-hydrate interrupted jobs from the DB (manager.rs:269-319).
+
+        Paused/Running/Queued reports with a state blob are resumed;
+        those without are marked Failed.
+        """
+        resumed = []
+        rows = library.db.query(
+            "SELECT * FROM job WHERE status IN (?, ?, ?)",
+            (int(JobStatus.PAUSED), int(JobStatus.RUNNING),
+             int(JobStatus.QUEUED)),
+        )
+        for row in rows:
+            report = JobReport.from_row(row)
+            if not report.data or report.name not in JOB_REGISTRY:
+                report.status = JobStatus.FAILED
+                report.errors_text.append("job lost state; cannot resume")
+                report.update(library.db)
+                continue
+            state = JobState.deserialize(report.data)
+            job = JOB_REGISTRY[report.name](**state.init_args)
+            if job.hash() in self._hashes:
+                continue
+            self._admit_from_state(library, report)
+            resumed.append(report.id)
+        return resumed

@@ -1,0 +1,109 @@
+"""Job reports: the DB-persisted record of every job run.
+
+Mirrors the semantics of /root/reference/core/src/job/report.rs:41-257 —
+status enum values are kept numerically identical so dashboards and
+tests can compare against the reference's conventions.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import msgpack
+
+from ..store import Database
+
+# Record separator for the errors_text TEXT column: tracebacks contain
+# blank lines, so a plain "\n\n" join would split one error into many.
+_ERR_SEP = "\n\x1e\n"
+
+
+class JobStatus(enum.IntEnum):
+    QUEUED = 0
+    RUNNING = 1
+    COMPLETED = 2
+    CANCELED = 3
+    FAILED = 4
+    PAUSED = 5
+    COMPLETED_WITH_ERRORS = 6
+
+    @property
+    def is_final(self) -> bool:
+        return self in (
+            JobStatus.COMPLETED,
+            JobStatus.CANCELED,
+            JobStatus.FAILED,
+            JobStatus.COMPLETED_WITH_ERRORS,
+        )
+
+
+@dataclass
+class JobReport:
+    id: bytes
+    name: str
+    status: JobStatus = JobStatus.QUEUED
+    action: Optional[str] = None
+    errors_text: list = field(default_factory=list)
+    data: Optional[bytes] = None  # serialized JobState for resume
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    parent_id: Optional[bytes] = None
+    task_count: int = 0
+    completed_task_count: int = 0
+    date_created: Optional[int] = None
+    date_started: Optional[int] = None
+    date_completed: Optional[int] = None
+    date_estimated_completion: Optional[int] = None
+
+    # -- persistence ------------------------------------------------------
+
+    def create(self, db: Database) -> None:
+        self.date_created = int(time.time())
+        db.insert("job", self._row())
+
+    def update(self, db: Database) -> None:
+        db.update("job", self.id, self._row(exclude_id=True))
+
+    def _row(self, exclude_id: bool = False) -> Dict[str, Any]:
+        row = {
+            "name": self.name,
+            "action": self.action,
+            "status": int(self.status),
+            "errors_text": _ERR_SEP.join(self.errors_text) or None,
+            "data": self.data,
+            "metadata": msgpack.packb(self.metadata, use_bin_type=True)
+            if self.metadata else None,
+            "parent_id": self.parent_id,
+            "task_count": self.task_count,
+            "completed_task_count": self.completed_task_count,
+            "date_estimated_completion": self.date_estimated_completion,
+            "date_created": self.date_created,
+            "date_started": self.date_started,
+            "date_completed": self.date_completed,
+        }
+        if not exclude_id:
+            row = {"id": self.id, **row}
+        return row
+
+    @classmethod
+    def from_row(cls, row) -> "JobReport":
+        meta = row["metadata"]
+        return cls(
+            id=row["id"],
+            name=row["name"],
+            status=JobStatus(row["status"] or 0),
+            action=row["action"],
+            errors_text=row["errors_text"].split(_ERR_SEP)
+            if row["errors_text"] else [],
+            data=row["data"],
+            metadata=msgpack.unpackb(meta, raw=False) if meta else {},
+            parent_id=row["parent_id"],
+            task_count=row["task_count"] or 0,
+            completed_task_count=row["completed_task_count"] or 0,
+            date_created=row["date_created"],
+            date_started=row["date_started"],
+            date_completed=row["date_completed"],
+            date_estimated_completion=row["date_estimated_completion"],
+        )

@@ -1,0 +1,273 @@
+"""The job driver loop: one asyncio task per running job.
+
+Multiplexes the in-flight step against worker commands the way the
+reference's driver does with tokio::select!
+(/root/reference/core/src/job/mod.rs:494-901): commands win, and on
+Pause/Shutdown the remaining steps — including the interrupted one, which
+is cancelled and pushed back — are serialized into the job report
+(mod.rs:694-775). Steps are therefore contractually idempotent.
+
+Progress reporting matches worker.rs:228-292: events are throttled to
+500 ms, carry task counts and an ETA extrapolated from elapsed/completed,
+and every status transition is persisted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .job import (
+    EarlyFinish,
+    JobContext,
+    JobError,
+    JobState,
+    StatefulJob,
+    StepOutcome,
+)
+from .report import JobReport, JobStatus
+
+PROGRESS_THROTTLE_S = 0.5  # worker.rs:273
+
+
+class WorkerCommand:
+    PAUSE = "pause"
+    RESUME = "resume"
+    CANCEL = "cancel"
+    SHUTDOWN = "shutdown"
+
+
+class Worker:
+    """Drives one job to completion, pause, cancellation, or failure."""
+
+    def __init__(
+        self,
+        job: StatefulJob,
+        report: JobReport,
+        library: Any,
+        on_event: Callable[[dict], None],
+        services: Optional[dict] = None,
+        resume_state: Optional[JobState] = None,
+    ):
+        self.job = job
+        self.report = report
+        self.library = library
+        self.on_event = on_event
+        self.services = services or {}
+        self.resume_state = resume_state
+        self.commands: asyncio.Queue = asyncio.Queue()
+        self._last_progress_emit = 0.0
+        self._started_at = 0.0
+        self.done = asyncio.get_event_loop().create_future()
+
+    # -- control ----------------------------------------------------------
+
+    def command(self, cmd: str) -> None:
+        self.commands.put_nowait(cmd)
+
+    # -- progress ---------------------------------------------------------
+
+    def _progress(self, task_count=None, completed=None, message=None) -> None:
+        r = self.report
+        if task_count is not None:
+            r.task_count = task_count
+        if completed is not None:
+            r.completed_task_count = completed
+        now = time.monotonic()
+        if r.completed_task_count and r.task_count:
+            per_task = (now - self._started_at) / r.completed_task_count
+            remaining = per_task * (r.task_count - r.completed_task_count)
+            r.date_estimated_completion = int(time.time() + remaining)
+        if now - self._last_progress_emit >= PROGRESS_THROTTLE_S:
+            self._last_progress_emit = now
+            self.on_event({
+                "type": "JobProgress",
+                "id": r.id,
+                "name": r.name,
+                "task_count": r.task_count,
+                "completed_task_count": r.completed_task_count,
+                "message": message,
+                "estimated_completion": r.date_estimated_completion,
+            })
+
+    # -- driver -----------------------------------------------------------
+
+    async def run(self) -> JobStatus:
+        try:
+            status = await self._run_inner()
+        except asyncio.CancelledError:
+            status = await self._persist_paused_or_fail("worker task cancelled")
+        except Exception as e:  # noqa: BLE001 — job-level catch-all
+            self.report.status = JobStatus.FAILED
+            self.report.errors_text.append(
+                "".join(traceback.format_exception(e)).strip()
+            )
+            self.report.date_completed = int(time.time())
+            self.report.data = None
+            self.report.update(self.library.db)
+        else:
+            self.report.status = status
+        self._emit_final()
+        if not self.done.done():
+            self.done.set_result(self.report.status)
+        return self.report.status
+
+    def _emit_final(self) -> None:
+        self.on_event({
+            "type": "JobUpdate",
+            "id": self.report.id,
+            "name": self.report.name,
+            "status": int(self.report.status),
+        })
+
+    async def _run_inner(self) -> JobStatus:
+        r = self.report
+        ctx = JobContext(self.library, report_progress=self._progress,
+                         services=self.services)
+        self._started_at = time.monotonic()
+        r.status = JobStatus.RUNNING
+        r.date_started = int(time.time())
+        r.update(self.library.db)
+
+        errors: List[str] = []
+        if self.resume_state is not None and (
+            self.resume_state.steps or self.resume_state.step_number
+        ):
+            state = self.resume_state
+            errors = list(r.errors_text)
+        else:
+            # Fresh run — including a QUEUED job resumed from the DB whose
+            # state blob was written at ingest, before init ever ran.
+            try:
+                data, steps = await self.job.init(ctx)
+            except EarlyFinish:
+                r.status = JobStatus.COMPLETED
+                r.date_completed = int(time.time())
+                r.update(self.library.db)
+                return JobStatus.COMPLETED
+            next_chain = (
+                self.resume_state.next_chain if self.resume_state else []
+            )
+            state = JobState(
+                init_args=self.job.init_args,
+                data=data,
+                steps=deque(steps),
+                step_number=0,
+                run_metadata={},
+                next_chain=next_chain,
+            )
+        if not r.task_count:
+            r.task_count = len(state.steps)
+
+        while state.steps:
+            # Commands take priority over starting the next step.
+            cmd = self._drain_commands()
+            if cmd == WorkerCommand.CANCEL:
+                return await self._finish_cancel(state)
+            if cmd in (WorkerCommand.PAUSE, WorkerCommand.SHUTDOWN):
+                return await self._persist_paused(state, errors)
+
+            step = state.steps[0]
+            step_task = asyncio.ensure_future(
+                self.job.execute_step(ctx, state.data, step, state.step_number)
+            )
+            cmd_task = asyncio.ensure_future(self.commands.get())
+            await asyncio.wait(
+                {step_task, cmd_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not step_task.done():
+                # A command arrived mid-step.
+                cmd = cmd_task.result()
+                if cmd == WorkerCommand.RESUME:
+                    # Spurious (job is running): let the step finish and
+                    # fall through to normal outcome handling below.
+                    await asyncio.wait({step_task})
+                else:
+                    step_task.cancel()
+                    try:
+                        await step_task
+                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                        pass
+                    if cmd == WorkerCommand.CANCEL:
+                        return await self._finish_cancel(state)
+                    # interrupted step stays at the front for idempotent replay
+                    return await self._persist_paused(state, errors)
+            elif cmd_task.done():
+                # Command landed in the same tick the step finished;
+                # cancel() would silently drop it. Re-queue so the next
+                # loop iteration's drain handles it.
+                self.commands.put_nowait(cmd_task.result())
+            else:
+                cmd_task.cancel()
+            try:
+                outcome = step_task.result()
+            except JobError:
+                raise
+            except Exception as e:  # noqa: BLE001 — non-fatal step error
+                errors.append(
+                    f"step {state.step_number}: "
+                    + "".join(traceback.format_exception(e)).strip()
+                )
+                outcome = None
+            if isinstance(outcome, StepOutcome):
+                state.steps.extend(outcome.more_steps)
+                r.task_count += len(outcome.more_steps)
+                errors.extend(outcome.errors)
+                for k, v in outcome.metadata.items():
+                    state.run_metadata[k] = v
+            state.steps.popleft()
+            state.step_number += 1
+            self._progress(completed=state.step_number)
+
+        meta = await self.job.finalize(ctx, state.data, state.run_metadata)
+        if meta:
+            r.metadata.update(meta)
+        r.errors_text = errors
+        r.completed_task_count = state.step_number
+        r.data = None
+        r.date_completed = int(time.time())
+        r.status = (
+            JobStatus.COMPLETED_WITH_ERRORS if errors else JobStatus.COMPLETED
+        )
+        r.update(self.library.db)
+        return r.status
+
+    def _drain_commands(self) -> Optional[str]:
+        """Pop the latest pending command (latest wins: a RESUME sent after
+        a not-yet-actioned PAUSE cancels it)."""
+        cmd = None
+        while not self.commands.empty():
+            cmd = self.commands.get_nowait()
+        return cmd
+
+    async def _persist_paused(self, state: JobState,
+                              errors: List[str]) -> JobStatus:
+        self.report.status = JobStatus.PAUSED
+        self.report.data = state.serialize()
+        self.report.errors_text = list(errors)
+        self.report.completed_task_count = state.step_number
+        self.report.update(self.library.db)
+        return JobStatus.PAUSED
+
+    async def _persist_paused_or_fail(self, why: str) -> JobStatus:
+        # Hard cancellation of the worker task (process shutdown): we have
+        # no state object in scope — report as paused if a checkpoint was
+        # already written, else failed.
+        if self.report.data is not None:
+            self.report.status = JobStatus.PAUSED
+        else:
+            self.report.status = JobStatus.FAILED
+            self.report.errors_text.append(why)
+        self.report.update(self.library.db)
+        return self.report.status
+
+    async def _finish_cancel(self, state: JobState) -> JobStatus:
+        self.report.status = JobStatus.CANCELED
+        self.report.data = None
+        self.report.completed_task_count = state.step_number
+        self.report.date_completed = int(time.time())
+        self.report.update(self.library.db)
+        return JobStatus.CANCELED

@@ -1,0 +1,318 @@
+"""Job engine: pause/resume/cancel, checkpointing, dedup, chaining.
+
+The golden scenario (SURVEY.md §7 phase 2): pause mid-run, drop the
+manager (process death), cold-resume from the DB with a fresh manager,
+and the job completes with the identical result it would have produced
+uninterrupted.
+"""
+
+import asyncio
+
+import pytest
+
+from spacedrive_tpu.jobs import (
+    AlreadyRunning,
+    EarlyFinish,
+    JobBuilder,
+    JobManager,
+    JobStatus,
+    StatefulJob,
+    StepOutcome,
+    register_job,
+)
+from spacedrive_tpu.store import Database
+
+
+class FakeLibrary:
+    def __init__(self, db):
+        self.db = db
+
+
+@pytest.fixture
+def library(tmp_path):
+    return FakeLibrary(Database(tmp_path / "lib.db"))
+
+
+SINK = {}  # job results land here keyed by init tag
+
+
+@register_job
+class CountJob(StatefulJob):
+    """Appends step indexes to SINK[tag]; optionally dawdles per step."""
+
+    NAME = "count"
+
+    async def init(self, ctx):
+        n = self.init_args["n"]
+        if n == 0:
+            raise EarlyFinish
+        SINK.setdefault(self.init_args["tag"], [])
+        return {"tag": self.init_args["tag"]}, list(range(n))
+
+    async def execute_step(self, ctx, data, step, step_number):
+        await asyncio.sleep(self.init_args.get("delay", 0))
+        SINK[data["tag"]].append(step)
+        ctx.progress(completed=step_number + 1)
+        return StepOutcome(metadata={"last": step})
+
+
+@register_job
+class FailingStepJob(StatefulJob):
+    NAME = "flaky"
+
+    async def init(self, ctx):
+        return {}, list(range(4))
+
+    async def execute_step(self, ctx, data, step, step_number):
+        if step == 2:
+            raise ValueError("boom")
+        return None
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_run_to_completion(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, CountJob(tag="basic", n=5))
+        status = await m.wait(jid)
+        assert status == JobStatus.COMPLETED
+        row = library.db.query_one("SELECT * FROM job")
+        assert row["status"] == int(JobStatus.COMPLETED)
+        assert row["completed_task_count"] == 5
+        assert SINK["basic"] == [0, 1, 2, 3, 4]
+
+    run(main())
+
+
+def test_early_finish(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, CountJob(tag="ef", n=0))
+        assert await m.wait(jid) == JobStatus.COMPLETED
+
+    run(main())
+
+
+def test_nonfatal_step_errors(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, FailingStepJob())
+        assert await m.wait(jid) == JobStatus.COMPLETED_WITH_ERRORS
+        row = library.db.query_one("SELECT * FROM job")
+        assert "boom" in row["errors_text"]
+        # all 4 steps consumed despite the failure
+        assert row["completed_task_count"] == 4
+
+    run(main())
+
+
+def test_dedup_by_init_hash(library):
+    async def main():
+        m = JobManager()
+        await m.ingest(library, CountJob(tag="dd", n=3, delay=0.05))
+        with pytest.raises(AlreadyRunning):
+            await m.ingest(library, CountJob(tag="dd", n=3, delay=0.05))
+        # different init → fine
+        await m.ingest(library, CountJob(tag="dd2", n=1))
+        await m.wait_idle()
+
+    run(main())
+
+
+def test_queue_beyond_max_workers(library):
+    async def main():
+        m = JobManager(max_workers=2)
+        ids = []
+        for i in range(5):
+            ids.append(
+                await m.ingest(library, CountJob(tag=f"q{i}", n=2, delay=0.01))
+            )
+        assert len(m.running) == 2 and len(m.queue) == 3
+        await m.wait_idle()
+        for i in range(5):
+            assert SINK[f"q{i}"] == [0, 1]
+
+    run(main())
+
+
+def test_chaining(library):
+    async def main():
+        m = JobManager()
+        await JobBuilder(CountJob(tag="c1", n=2)) \
+            .queue_next(CountJob(tag="c2", n=2)) \
+            .queue_next(CountJob(tag="c3", n=1)) \
+            .spawn(m, library)
+        await m.wait_idle()
+        while m._tasks or m.queue:
+            await m.wait_idle()
+        # chained jobs ran in order, children carry parent_id
+        assert SINK["c1"] == [0, 1] and SINK["c2"] == [0, 1]
+        assert SINK["c3"] == [0]
+        rows = library.db.query(
+            "SELECT parent_id FROM job ORDER BY date_created, rowid")
+        assert rows[0]["parent_id"] is None
+        assert rows[1]["parent_id"] is not None
+
+    run(main())
+
+
+def test_cancel(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, CountJob(tag="cx", n=50, delay=0.02))
+        await asyncio.sleep(0.05)
+        m.cancel(jid)
+        status = await m.wait(jid)
+        assert status == JobStatus.CANCELED
+        assert len(SINK["cx"]) < 50
+
+    run(main())
+
+
+def test_pause_resume_live(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, CountJob(tag="pr", n=30, delay=0.01))
+        await asyncio.sleep(0.05)
+        m.pause(jid)
+        status = await m.wait(jid)
+        assert status == JobStatus.PAUSED
+        done_at_pause = len(SINK["pr"])
+        assert 0 < done_at_pause < 30
+        row = library.db.query_one("SELECT * FROM job")
+        assert row["status"] == int(JobStatus.PAUSED)
+        assert row["data"] is not None  # serialized state blob
+        # resume from DB (the worker task already exited)
+        await m.resume(library, jid)
+        status = await m.wait(jid)
+        assert status == JobStatus.COMPLETED
+        # idempotent replay may repeat the interrupted step, but the
+        # sequence of step values must cover 0..29 in order
+        assert sorted(set(SINK["pr"])) == list(range(30))
+
+    run(main())
+
+
+def test_cold_resume_after_process_death(library):
+    async def phase1():
+        m = JobManager()
+        jid = await m.ingest(library, CountJob(tag="cold", n=40, delay=0.01))
+        await asyncio.sleep(0.06)
+        m.pause(jid)
+        await m.wait(jid)
+        # manager dropped here = process death
+
+    async def phase2():
+        m2 = JobManager()
+        resumed = await m2.cold_resume(library)
+        assert len(resumed) == 1
+        await m2.wait_idle()
+
+    run(phase1())
+    progress_before = len(SINK["cold"])
+    assert 0 < progress_before < 40
+    run(phase2())
+    assert sorted(set(SINK["cold"])) == list(range(40))
+    row = library.db.query_one("SELECT * FROM job")
+    assert row["status"] == int(JobStatus.COMPLETED)
+    assert row["data"] is None  # checkpoint cleared on completion
+
+
+def test_cold_resume_fails_stateless_running_job(library):
+    # a RUNNING report with no data blob (hard crash before checkpoint)
+    from spacedrive_tpu.jobs.report import JobReport
+
+    r = JobReport(id=b"x" * 16, name="count", status=JobStatus.RUNNING)
+    r.create(library.db)
+    library.db.update("job", r.id, {"status": int(JobStatus.RUNNING)})
+
+    async def main():
+        m = JobManager()
+        resumed = await m.cold_resume(library)
+        assert resumed == []
+
+    run(main())
+    row = library.db.query_one("SELECT * FROM job")
+    assert row["status"] == int(JobStatus.FAILED)
+
+
+def test_queued_job_survives_restart(library):
+    """A job still QUEUED at shutdown cold-resumes instead of failing."""
+
+    async def phase1():
+        m = JobManager(max_workers=1)
+        await m.ingest(library, CountJob(tag="qr1", n=20, delay=0.01))
+        await m.ingest(library, CountJob(tag="qr2", n=2))
+        await asyncio.sleep(0.03)
+        await m.shutdown()
+
+    run(phase1())
+    SINK.setdefault("qr2", [])
+    assert SINK["qr2"] == []  # never started
+
+    async def phase2():
+        m = JobManager()
+        resumed = await m.cold_resume(library)
+        assert len(resumed) == 2
+        await m.wait_idle()
+
+    run(phase2())
+    assert sorted(set(SINK["qr1"])) == list(range(20))
+    assert SINK["qr2"] == [0, 1]
+
+
+def test_chain_survives_pause_and_restart(library):
+    async def phase1():
+        m = JobManager()
+        jid = await JobBuilder(CountJob(tag="ch1", n=30, delay=0.01)) \
+            .queue_next(CountJob(tag="ch2", n=2)) \
+            .spawn(m, library)
+        await asyncio.sleep(0.05)
+        m.pause(jid)
+        await m.wait(jid)
+
+    run(phase1())
+    assert "ch2" not in SINK
+
+    async def phase2():
+        m = JobManager()
+        await m.cold_resume(library)
+        await m.wait_idle()
+        while m._tasks or m.queue:
+            await m.wait_idle()
+
+    run(phase2())
+    assert sorted(set(SINK["ch1"])) == list(range(30))
+    assert SINK["ch2"] == [0, 1]
+
+
+@register_job
+class SlowFlaky(StatefulJob):
+    NAME = "slow_flaky"
+
+    async def init(self, ctx):
+        return {}, list(range(20))
+
+    async def execute_step(self, ctx, data, step, step_number):
+        await asyncio.sleep(0.01)
+        if step == 1:
+            raise ValueError("pre-pause error")
+
+
+def test_errors_survive_pause(library):
+    async def main():
+        m = JobManager()
+        jid = await m.ingest(library, SlowFlaky())
+        await asyncio.sleep(0.06)
+        m.pause(jid)
+        assert await m.wait(jid) == JobStatus.PAUSED
+        row = library.db.query_one("SELECT errors_text FROM job")
+        assert "pre-pause error" in (row["errors_text"] or "")
+        await m.resume(library, jid)
+        status = await m.wait(jid)
+        assert status == JobStatus.COMPLETED_WITH_ERRORS
+
+    run(main())
