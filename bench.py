@@ -1,0 +1,65 @@
+"""Round benchmark: batched CAS-ID generation throughput on device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload is the FileIdentifierJob hot kernel (SURVEY.md §3.3): for a
+batch of large files, hash the 8-byte size prefix + 57,344 sampled bytes
+with BLAKE3 and truncate to 16 hex chars
+(/root/reference/core/src/object/cas.rs:23-62 semantics). `vs_baseline`
+is the speedup over the in-repo vectorized numpy CPU implementation of
+the identical algorithm — the measurable stand-in for the reference's CPU
+path (the reference publishes no numbers, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from spacedrive_tpu.ops import blake3_batch as bb
+    from spacedrive_tpu.ops import blake3_jax as bj
+
+    B = 2048
+    rng = np.random.default_rng(0)
+    payloads = rng.integers(0, 256, size=(B, 57344), dtype=np.uint8)
+    sizes = rng.integers(200_000, 50_000_000, size=B).astype(np.uint64)
+    words, lengths = bj.build_cas_messages(payloads, sizes)
+
+    # Device path (jit warms on the first call).
+    out = bj.blake3_words(words, lengths)
+    out.block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = bj.blake3_words(words, lengths)
+    out.block_until_ready()
+    device_fps = B * iters / (time.perf_counter() - t0)
+
+    # Correctness spot check against the streaming oracle.
+    cas_ids = bj.digests_to_cas_ids(out)
+    from spacedrive_tpu.ops.cas import cas_id_of_payload
+
+    for i in (0, B // 2, B - 1):
+        expect = cas_id_of_payload(int(sizes[i]), payloads[i].tobytes())
+        assert cas_ids[i] == expect, (i, cas_ids[i], expect)
+
+    # CPU baseline: same algorithm, vectorized numpy, smaller batch.
+    Bc = 128
+    t0 = time.perf_counter()
+    bb.blake3_batch(np, words[:Bc], lengths[:Bc])
+    cpu_fps = Bc / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "cas_ids_per_sec_large_files",
+        "value": round(device_fps, 1),
+        "unit": "files/s",
+        "vs_baseline": round(device_fps / cpu_fps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

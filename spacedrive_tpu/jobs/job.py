@@ -19,12 +19,20 @@ an executor thread so the event loop stays responsive while XLA blocks.
 from __future__ import annotations
 
 import hashlib
-import uuid as uuid_mod
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Type
 
 import msgpack
+
+
+def _canonical(v: Any) -> Any:
+    """Recursively sort dict keys so msgpack bytes are order-stable."""
+    if isinstance(v, dict):
+        return {k: _canonical(v[k]) for k in sorted(v)}
+    if isinstance(v, (list, tuple)):
+        return [_canonical(x) for x in v]
+    return v
 
 
 class JobError(Exception):
@@ -67,9 +75,10 @@ class StatefulJob:
     # -- identity ---------------------------------------------------------
 
     def hash(self) -> str:
-        """Dedup hash over (NAME, init args)."""
+        """Dedup hash over (NAME, init args), insensitive to kwarg order."""
         payload = msgpack.packb(
-            {"name": self.NAME, "init": self.init_args}, use_bin_type=True
+            {"name": self.NAME, "init": _canonical(self.init_args)},
+            use_bin_type=True,
         )
         return hashlib.blake2b(payload, digest_size=16).hexdigest()
 
@@ -186,7 +195,3 @@ def job_from_state(name: str, state: JobState) -> StatefulJob:
     cls = JOB_REGISTRY[name]
     job = cls(**state.init_args)
     return job
-
-
-def new_job_id() -> bytes:
-    return uuid_mod.uuid4().bytes

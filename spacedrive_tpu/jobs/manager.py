@@ -15,12 +15,8 @@ import asyncio
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from .job import (
-    JOB_REGISTRY,
-    JobState,
-    StatefulJob,
-    new_job_id,
-)
+from ..store import uuid_bytes as new_job_id
+from .job import JOB_REGISTRY, JobState, StatefulJob
 from .report import JobReport, JobStatus
 from .worker import Worker, WorkerCommand
 
@@ -79,6 +75,7 @@ class JobManager:
         self.queue: deque[_Entry] = deque()
         self._hashes: Dict[str, bytes] = {}  # job.hash() → job id
         self._final_status: Dict[bytes, JobStatus] = {}
+        self._paused: Dict[bytes, _Entry] = {}  # paused this session
         self._shutting_down = False
 
     # -- ingestion --------------------------------------------------------
@@ -136,6 +133,8 @@ class JobManager:
         status = entry.report.status if entry else JobStatus.FAILED
         self._final_status[job_id] = status
         if entry is not None:
+            if status == JobStatus.PAUSED:
+                self._paused[job_id] = entry
             if status != JobStatus.PAUSED:
                 # Paused jobs keep their dedup hash so an identical ingest
                 # still collides with the paused run until it is resumed
@@ -182,6 +181,9 @@ class JobManager:
             # Cancels a pending not-yet-actioned pause (latest command wins).
             self.running[job_id].command(WorkerCommand.RESUME)
             return
+        if job_id in self._entries:
+            return  # already re-admitted (double resume)
+        self._paused.pop(job_id, None)
         row = library.db.query_one("SELECT * FROM job WHERE id = ?", (job_id,))
         if row is None:
             raise JobManagerError("no such job")
@@ -210,11 +212,16 @@ class JobManager:
             if entry.report.id == job_id:
                 self.queue.remove(entry)
                 self._entries.pop(job_id, None)
-                self._hashes.pop(entry.job.hash(), None)
-                entry.report.status = JobStatus.CANCELED
-                entry.report.update(entry.library.db)
-                return
-        raise JobManagerError("no such running/queued job")
+                break
+        else:
+            entry = self._paused.pop(job_id, None)
+            if entry is None:
+                raise JobManagerError("no such running/queued/paused job")
+        self._hashes.pop(entry.job.hash(), None)
+        self._final_status[job_id] = JobStatus.CANCELED
+        entry.report.status = JobStatus.CANCELED
+        entry.report.data = None
+        entry.report.update(entry.library.db)
 
     def _worker(self, job_id: bytes) -> Worker:
         if job_id not in self.running:

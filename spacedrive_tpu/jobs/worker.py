@@ -61,7 +61,6 @@ class Worker:
         self.commands: asyncio.Queue = asyncio.Queue()
         self._last_progress_emit = 0.0
         self._started_at = 0.0
-        self.done = asyncio.get_event_loop().create_future()
 
     # -- control ----------------------------------------------------------
 
@@ -111,8 +110,6 @@ class Worker:
         else:
             self.report.status = status
         self._emit_final()
-        if not self.done.done():
-            self.done.set_result(self.report.status)
         return self.report.status
 
     def _emit_final(self) -> None:
@@ -145,6 +142,7 @@ class Worker:
                 data, steps = await self.job.init(ctx)
             except EarlyFinish:
                 r.status = JobStatus.COMPLETED
+                r.data = None  # clear the at-ingest state blob
                 r.date_completed = int(time.time())
                 r.update(self.library.db)
                 return JobStatus.COMPLETED
@@ -221,6 +219,12 @@ class Worker:
             state.steps.popleft()
             state.step_number += 1
             self._progress(completed=state.step_number)
+
+        # A command that landed in the same tick the FINAL step finished was
+        # re-queued above and would otherwise be dropped. CANCEL is still
+        # honored (finalize hasn't run); PAUSE on a finished job is moot.
+        if self._drain_commands() == WorkerCommand.CANCEL:
+            return await self._finish_cancel(state)
 
         meta = await self.job.finalize(ctx, state.data, state.run_metadata)
         if meta:
