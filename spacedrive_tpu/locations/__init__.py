@@ -1,0 +1,3 @@
+from .paths import IsolatedPath, accept_file_name, materialized_path_str
+
+__all__ = ["IsolatedPath", "accept_file_name", "materialized_path_str"]

@@ -1,0 +1,90 @@
+"""Extension taxonomy + magic-byte tests.
+
+Mirrors the reference's test coverage
+(/root/reference/crates/file-ext/src/extensions.rs:364-390: jpg known,
+ts conflicting, unknown ext) plus magic-byte resolution on synthetic
+fixture files (the reference uses a fixture corpus; we synthesize headers).
+"""
+
+import pytest
+
+from spacedrive_tpu.files import (
+    ObjectKind,
+    extension_candidates,
+    kind_for_extension,
+    resolve_kind,
+    verify_magic,
+)
+
+
+def test_known_single_extension():
+    assert extension_candidates("jpg") == ["image"]
+    assert kind_for_extension("jpg") == ObjectKind.IMAGE
+    assert kind_for_extension("JPG") == ObjectKind.IMAGE
+
+
+def test_conflicting_ts():
+    # extensions.rs:380-386 — ts is claimed by both video and code.
+    assert extension_candidates("ts") == ["video", "code"]
+    assert extension_candidates("mts") == ["video", "code"]
+
+
+def test_unknown_extension():
+    assert extension_candidates("jeff") == []
+    assert kind_for_extension("jeff") == ObjectKind.UNKNOWN
+
+
+def test_magic_ts_video_vs_code(tmp_path):
+    # MPEG-TS sync byte 0x47 → video; plain text → code (magic.rs:222-229).
+    video = tmp_path / "video.ts"
+    video.write_bytes(b"\x47" + b"\x00" * 187)
+    code = tmp_path / "module.ts"
+    code.write_bytes(b"export const x = 1;\n")
+    assert resolve_kind(video) == ObjectKind.VIDEO
+    assert resolve_kind(code) == ObjectKind.CODE
+
+
+def test_magic_with_offset(tmp_path):
+    # m4v magic sits at offset 4 (extensions.rs:52).
+    f = tmp_path / "clip.m4v"
+    f.write_bytes(b"\x00\x00\x00\x20ftypM4V \x00\x00")
+    header = f.read_bytes()
+    assert verify_magic("video", "m4v", header)
+    assert resolve_kind(f) == ObjectKind.VIDEO
+
+
+def test_magic_wildcards():
+    # webp: RIFF....WEBP with 4 wildcard length bytes.
+    header = b"RIFF\x12\x34\x56\x78WEBPVP8 "
+    assert verify_magic("image", "webp", header)
+    assert not verify_magic("image", "webp", b"RIFF\x12\x34\x56\x78WAVE")
+
+
+def test_magic_short_read_fails():
+    assert not verify_magic("image", "png", b"\x89PN")
+
+
+@pytest.mark.parametrize("ext,kind", [
+    ("pdf", ObjectKind.DOCUMENT),
+    ("mp3", ObjectKind.AUDIO),
+    ("zip", ObjectKind.ARCHIVE),
+    ("py", ObjectKind.CODE),
+    ("sqlite", ObjectKind.DATABASE),
+    ("epub", ObjectKind.BOOK),
+    ("json", ObjectKind.CONFIG),
+    ("ttf", ObjectKind.FONT),
+    ("obj", ObjectKind.MESH),
+    ("pem", ObjectKind.KEY),
+    ("txt", ObjectKind.TEXT),
+    ("webm", ObjectKind.VIDEO),
+    ("heic", ObjectKind.IMAGE),
+    ("7z", ObjectKind.ARCHIVE),
+])
+def test_kind_table(ext, kind):
+    assert kind_for_extension(ext) == kind
+
+
+def test_resolve_kind_no_extension(tmp_path):
+    f = tmp_path / "README"
+    f.write_text("hi")
+    assert resolve_kind(f) == ObjectKind.UNKNOWN

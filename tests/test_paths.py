@@ -1,0 +1,90 @@
+"""Path algebra tests — ported case-for-case from the reference's own
+table tests (isolated_file_path_data.rs:582-746: new_method, parent_method,
+extract_normalized_materialized_path)."""
+
+import pytest
+
+from spacedrive_tpu.locations import IsolatedPath, materialized_path_str
+
+LOC = "/spacedrive/location"
+
+
+@pytest.mark.parametrize("full,is_dir,mat,name,ext,rel", [
+    (LOC, True, "/", "", "", ""),
+    (f"{LOC}/file.txt", False, "/", "file", "txt", "file.txt"),
+    (f"{LOC}/dir", True, "/", "dir", "", "dir"),
+    (f"{LOC}/dir/file.txt", False, "/dir/", "file", "txt", "dir/file.txt"),
+    (f"{LOC}/dir/dir2", True, "/dir/", "dir2", "", "dir/dir2"),
+    (f"{LOC}/dir/dir2/dir3", True, "/dir/dir2/", "dir3", "", "dir/dir2/dir3"),
+    (f"{LOC}/dir/dir2/dir3/file.txt", False, "/dir/dir2/dir3/", "file", "txt",
+     "dir/dir2/dir3/file.txt"),
+])
+def test_new(full, is_dir, mat, name, ext, rel):
+    p = IsolatedPath.new(1, LOC, full, is_dir)
+    assert (p.materialized_path, p.name, p.extension, p.is_dir) == \
+        (mat, name, ext, is_dir)
+    assert p.relative_path == rel
+    assert p.join_on(LOC).rstrip("/") == full
+
+
+@pytest.mark.parametrize("full,is_dir,mat,name", [
+    (LOC, True, "/", ""),
+    (f"{LOC}/file.txt", False, "/", ""),
+    (f"{LOC}/dir", True, "/", ""),
+    (f"{LOC}/dir/file.txt", False, "/", "dir"),
+    (f"{LOC}/dir/dir2", True, "/", "dir"),
+    (f"{LOC}/dir/dir2/dir3", True, "/dir/", "dir2"),
+    (f"{LOC}/dir/dir2/dir3/file.txt", False, "/dir/dir2/", "dir3"),
+])
+def test_parent(full, is_dir, mat, name):
+    p = IsolatedPath.new(1, LOC, full, is_dir).parent()
+    assert p.is_dir
+    assert (p.materialized_path, p.name, p.extension) == (mat, name, "")
+
+
+@pytest.mark.parametrize("full,expected", [
+    (LOC, "/"),
+    (f"{LOC}/file.txt", "/"),
+    (f"{LOC}/dir", "/"),
+    (f"{LOC}/dir/file.txt", "/dir/"),
+    (f"{LOC}/dir/dir2", "/dir/"),
+    (f"{LOC}/dir/dir2/dir3", "/dir/dir2/"),
+    (f"{LOC}/dir/dir2/dir3/file.txt", "/dir/dir2/dir3/"),
+])
+def test_materialized_path(full, expected):
+    assert materialized_path_str(LOC, full) == expected
+
+
+def test_hidden_file_has_no_extension():
+    p = IsolatedPath.new(1, LOC, f"{LOC}/.gitignore", False)
+    assert (p.name, p.extension) == (".gitignore", "")
+
+
+def test_from_relative_roundtrip():
+    p = IsolatedPath.from_relative(7, "dir/sub/file.tar.gz")
+    assert (p.materialized_path, p.name, p.extension) == ("/dir/sub/", "file.tar", "gz")
+    d = IsolatedPath.from_relative(7, "dir/sub/")
+    assert d.is_dir and d.name == "sub" and d.materialized_path == "/dir/"
+    root = IsolatedPath.from_relative(7, "/")
+    assert root.is_root
+
+
+def test_from_db_row_matches_new():
+    a = IsolatedPath.new(1, LOC, f"{LOC}/dir/file.txt", False)
+    b = IsolatedPath.from_db_row(1, False, "/dir/", "file", "txt")
+    assert a == b
+    assert b.relative_path == "dir/file.txt"
+
+
+def test_children_materialized_path():
+    root = IsolatedPath.new(1, LOC, LOC, True)
+    assert root.materialized_path_for_children() == "/"
+    d = IsolatedPath.new(1, LOC, f"{LOC}/dir", True)
+    assert d.materialized_path_for_children() == "/dir/"
+    f = IsolatedPath.new(1, LOC, f"{LOC}/file.txt", False)
+    assert f.materialized_path_for_children() is None
+
+
+def test_outside_location_rejected():
+    with pytest.raises(ValueError):
+        IsolatedPath.new(1, LOC, "/elsewhere/file.txt", False)
