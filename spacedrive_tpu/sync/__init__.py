@@ -1,0 +1,13 @@
+from .hlc import HLC, ntp64_now
+from .crdt import (
+    CRDTOperation,
+    OpKind,
+    RelationOp,
+    SharedOp,
+)
+from .manager import GetOpsArgs, SyncManager
+
+__all__ = [
+    "HLC", "ntp64_now", "CRDTOperation", "OpKind", "SharedOp",
+    "RelationOp", "SyncManager", "GetOpsArgs",
+]

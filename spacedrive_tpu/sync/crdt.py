@@ -1,0 +1,141 @@
+"""CRDT operation vocabulary.
+
+Mirrors the reference's `sd-sync` crate types
+(/root/reference/crates/sync/src/crdt.rs:25-131): a `CRDTOperation` is
+(instance uuid, NTP64 timestamp, op uuid, payload), where the payload is a
+Shared op (model + record sync-id + create/update/delete) or a Relation op
+(relation name + item/group sync-ids + create/update/delete). Kind strings
+are "c", "u:<field>", "d" (crdt.rs:15-22) and index the op log for LWW
+comparisons.
+
+Wire/DB encoding is msgpack (the reference uses rmp_serde for DB blobs and
+serde_json for record ids; we use msgpack for both — values must be
+msgpack-serializable plain data).
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid as uuidlib
+from dataclasses import dataclass
+from typing import Any, Optional, Union
+
+import msgpack
+
+
+class OpKind:
+    CREATE = "c"
+    DELETE = "d"
+
+    @staticmethod
+    def update(field: str) -> str:
+        return f"u:{field}"
+
+
+def _pack(v: Any) -> bytes:
+    return msgpack.packb(v, use_bin_type=True)
+
+
+def _unpack(b: bytes) -> Any:
+    return msgpack.unpackb(b, raw=False, strict_map_key=False)
+
+
+@dataclass(frozen=True)
+class SharedOp:
+    model: str                  # model name in the registry
+    record_id: Any              # sync id value (e.g. pub_id bytes)
+    field: Optional[str] = None  # None+value None = create/delete
+    value: Any = None
+    delete: bool = False
+
+    @property
+    def kind(self) -> str:
+        if self.delete:
+            return OpKind.DELETE
+        if self.field is not None:
+            return OpKind.update(self.field)
+        return OpKind.CREATE
+
+
+@dataclass(frozen=True)
+class RelationOp:
+    relation: str               # relation model name
+    item_id: Any                # item sync id
+    group_id: Any               # group sync id
+    field: Optional[str] = None
+    value: Any = None
+    delete: bool = False
+
+    @property
+    def kind(self) -> str:
+        if self.delete:
+            return OpKind.DELETE
+        if self.field is not None:
+            return OpKind.update(self.field)
+        return OpKind.CREATE
+
+
+@dataclass(frozen=True)
+class CRDTOperation:
+    instance: bytes             # instance pub_id (16 bytes)
+    timestamp: int              # NTP64
+    id: bytes                   # op uuid bytes
+    typ: Union[SharedOp, RelationOp]
+
+    @classmethod
+    def new(cls, instance: bytes, timestamp: int,
+            typ: Union[SharedOp, RelationOp]) -> "CRDTOperation":
+        return cls(instance, timestamp, uuidlib.uuid4().bytes, typ)
+
+    # -- wire encoding -----------------------------------------------------
+
+    def to_wire(self) -> dict:
+        t = self.typ
+        base = {
+            "instance": self.instance,
+            "timestamp": self.timestamp,
+            "id": self.id,
+        }
+        if isinstance(t, SharedOp):
+            base["shared"] = {
+                "model": t.model, "record_id": t.record_id,
+                "field": t.field, "value": t.value, "delete": t.delete,
+            }
+        else:
+            base["relation"] = {
+                "relation": t.relation, "item_id": t.item_id,
+                "group_id": t.group_id, "field": t.field,
+                "value": t.value, "delete": t.delete,
+            }
+        return base
+
+    @classmethod
+    def from_wire(cls, raw: dict) -> "CRDTOperation":
+        if "shared" in raw:
+            s = raw["shared"]
+            typ: Union[SharedOp, RelationOp] = SharedOp(
+                s["model"], s["record_id"], s["field"], s["value"],
+                s["delete"],
+            )
+        else:
+            r = raw["relation"]
+            typ = RelationOp(
+                r["relation"], r["item_id"], r["group_id"], r["field"],
+                r["value"], r["delete"],
+            )
+        return cls(raw["instance"], raw["timestamp"], raw["id"], typ)
+
+    def pack(self) -> bytes:
+        return _pack(self.to_wire())
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "CRDTOperation":
+        return cls.from_wire(_unpack(blob))
+
+
+def pack_value(v: Any) -> bytes:
+    return _pack(v)
+
+
+def unpack_value(b: bytes) -> Any:
+    return _unpack(b)

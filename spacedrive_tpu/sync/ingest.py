@@ -1,0 +1,112 @@
+"""Ingest actor: the receiving half of the sync plane.
+
+State machine mirroring the reference's ingest Actor
+(/root/reference/core/crates/sync/src/ingest.rs:30-108):
+
+    WaitingForNotification → RetrievingMessages → Ingesting → (loop)
+
+On a notification it emits `Request.Messages(timestamps)` upstream (the
+p2p responder turns that into a wire GetOperations), waits for a
+`MessagesEvent`, ingests each op through the manager's LWW path, and asks
+for more pages while `has_more`. Transport is an interface: tests drive it
+with plain asyncio queues (the blueprint of the reference's in-process
+two-node test, core/crates/sync/tests/lib.rs:102-217).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from .crdt import CRDTOperation
+from .manager import SyncManager
+
+
+class ReqKind(enum.Enum):
+    MESSAGES = "messages"
+    INGESTED = "ingested"
+    FINISHED = "finished_ingesting"
+
+
+@dataclass
+class Request:
+    kind: ReqKind
+    timestamps: List[Tuple[bytes, int]] = field(default_factory=list)
+
+
+@dataclass
+class MessagesEvent:
+    instance: bytes
+    messages: List[CRDTOperation]
+    has_more: bool
+
+
+class Ingester:
+    """Owns the notification→retrieve→ingest loop for one library."""
+
+    def __init__(self, sync: SyncManager):
+        self.sync = sync
+        self.events: asyncio.Queue = asyncio.Queue()
+        self.requests: asyncio.Queue = asyncio.Queue()
+        self.errors: List[str] = []
+        self._task: Optional[asyncio.Task] = None
+
+    # -- inputs ------------------------------------------------------------
+
+    def notify(self) -> None:
+        """Event::Notification — a peer has new ops."""
+        self.events.put_nowait(("notification", None))
+
+    def deliver(self, event: MessagesEvent) -> None:
+        """Event::Messages — a page of ops arrived."""
+        self.events.put_nowait(("messages", event))
+
+    # -- actor loop --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            # WaitingForNotification
+            await self._wait("notification")
+            # RetrievingMessages / Ingesting page loop
+            has_more = True
+            while has_more:
+                await self.requests.put(Request(
+                    ReqKind.MESSAGES,
+                    timestamps=list(self.sync.timestamps.items())))
+                event = await self._wait("messages")
+                for op in event.messages:
+                    # A malformed remote op (unknown model/field/instance)
+                    # must not kill the actor or hang the responder.
+                    try:
+                        applied = await asyncio.to_thread(
+                            self.sync.receive_crdt_operation, op)
+                    except Exception as e:
+                        self.errors.append(f"ingest {op.typ!r}: {e}")
+                        continue
+                    if applied:
+                        await self.requests.put(Request(ReqKind.INGESTED))
+                has_more = event.has_more
+            await self.requests.put(Request(ReqKind.FINISHED))
+
+    async def _wait(self, kind: str):
+        """wait! macro semantics (ingest.rs:48,63): drop events of the
+        wrong kind while waiting for the expected one."""
+        while True:
+            k, payload = await self.events.get()
+            if k == kind:
+                return payload

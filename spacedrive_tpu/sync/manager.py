@@ -1,0 +1,362 @@
+"""Sync manager: atomic domain+op-log writes, op retrieval, LWW apply.
+
+Behavioral equivalent of `sd-core-sync`'s Manager
+(/root/reference/core/crates/sync/src/manager.rs:62-199) plus the apply
+half of the generated `ModelSyncData` logic
+(/root/reference/crates/sync-generator/src/lib.rs:24-80): because our data
+model lives in a Python registry (store/models.py), the CRDT emit/apply
+code is generic over that registry instead of codegen'd per model.
+
+Key contracts kept from the reference:
+- `write_ops` batches domain queries and op-log inserts in ONE transaction
+  (manager.rs:87) and broadcasts a created-message afterwards;
+- `get_ops` merges the shared+relation op tables, filtered by per-instance
+  HLC watermarks, ordered by (timestamp, instance) (manager.rs:130-199);
+- FK fields on shared models sync as the referenced row's pub_id, resolved
+  back to local row ids on apply (the sync-generator's `@relation`/FK
+  handling).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..store import models as M
+from ..store.db import Database
+from .crdt import CRDTOperation, OpKind, RelationOp, SharedOp, pack_value, unpack_value
+from .hlc import HLC
+
+
+@dataclass
+class GetOpsArgs:
+    """(instance pub_id → NTP64 watermark) + page size
+    (manager.rs:24-28; OPS_PER_REQUEST=1000 at p2p/sync/mod.rs:403)."""
+
+    clocks: List[Tuple[bytes, int]]
+    count: int = 1000
+
+
+def _fk_target(f: M.Field) -> Optional[str]:
+    """Referenced table name for FK fields (e.g. 'location')."""
+    if not f.references:
+        return None
+    return f.references.split("(", 1)[0]
+
+
+class SyncManager:
+    def __init__(self, db: Database, instance_pub_id: bytes,
+                 emit_messages: bool = True):
+        self.db = db
+        self.instance = instance_pub_id
+        self.clock = HLC()
+        self.emit_messages = emit_messages
+        self._on_created: List[Callable[[], None]] = []
+        # instance pub_id → local row id, and → last-seen NTP64.
+        self._instance_ids: Dict[bytes, int] = {}
+        self.timestamps: Dict[bytes, int] = {}
+        self._load_instances()
+
+    def _load_instances(self) -> None:
+        for row in self.db.query("SELECT id, pub_id, timestamp FROM instance"):
+            self._instance_ids[row["pub_id"]] = row["id"]
+            if row["timestamp"]:
+                self.timestamps[row["pub_id"]] = row["timestamp"]
+                self.clock.update_with_timestamp(row["timestamp"])
+
+    def _instance_row_id(self, pub_id: bytes, conn=None) -> int:
+        rid = self._instance_ids.get(pub_id)
+        if rid is None:
+            q = "SELECT id FROM instance WHERE pub_id = ?"
+            row = (conn.execute(q, (pub_id,)).fetchone() if conn is not None
+                   else self.db.query_one(q, (pub_id,)))
+            if row is None:
+                raise KeyError(f"unknown instance {pub_id.hex()}")
+            rid = row["id"]
+            self._instance_ids[pub_id] = rid
+        return rid
+
+    def on_created(self, cb: Callable[[], None]) -> None:
+        """Subscribe to SyncMessage::Created broadcasts (manager.rs:89)."""
+        self._on_created.append(cb)
+
+    def _notify_created(self) -> None:
+        for cb in list(self._on_created):
+            cb()
+
+    # -- op factory (crates/sync/src/factory.rs:22-120) --------------------
+
+    def _new_op(self, typ) -> CRDTOperation:
+        return CRDTOperation.new(self.instance, self.clock.new_timestamp(), typ)
+
+    def shared_create(self, model: str, record_id: Any,
+                      values: Optional[Dict[str, Any]] = None
+                      ) -> List[CRDTOperation]:
+        """Create = one "c" op + one "u:<field>" op per field
+        (factory.rs:34-50)."""
+        ops = [self._new_op(SharedOp(model, record_id))]
+        for k, v in (values or {}).items():
+            ops.append(self._new_op(SharedOp(model, record_id, field=k, value=v)))
+        return ops
+
+    def shared_update(self, model: str, record_id: Any, field: str,
+                      value: Any) -> CRDTOperation:
+        return self._new_op(SharedOp(model, record_id, field=field, value=value))
+
+    def shared_delete(self, model: str, record_id: Any) -> CRDTOperation:
+        return self._new_op(SharedOp(model, record_id, delete=True))
+
+    def relation_create(self, relation: str, item_id: Any, group_id: Any,
+                        values: Optional[Dict[str, Any]] = None
+                        ) -> List[CRDTOperation]:
+        ops = [self._new_op(RelationOp(relation, item_id, group_id))]
+        for k, v in (values or {}).items():
+            ops.append(self._new_op(
+                RelationOp(relation, item_id, group_id, field=k, value=v)))
+        return ops
+
+    def relation_update(self, relation: str, item_id: Any, group_id: Any,
+                        field: str, value: Any) -> CRDTOperation:
+        return self._new_op(
+            RelationOp(relation, item_id, group_id, field=field, value=value))
+
+    def relation_delete(self, relation: str, item_id: Any,
+                        group_id: Any) -> CRDTOperation:
+        return self._new_op(RelationOp(relation, item_id, group_id, delete=True))
+
+    # -- write path --------------------------------------------------------
+
+    @contextmanager
+    def write_ops(self, ops: Sequence[CRDTOperation]):
+        """One atomic transaction for domain writes + op-log rows
+        (manager.rs:62-99). Usage:
+
+            with sync.write_ops(ops) as conn:
+                db.insert_many("file_path", rows, conn=conn)
+        """
+        with self.db.tx() as conn:
+            yield conn
+            if self.emit_messages:
+                self._insert_op_rows(conn, ops)
+        if self.emit_messages and ops:
+            self._notify_created()
+
+    def _insert_op_rows(self, conn, ops: Iterable[CRDTOperation]) -> None:
+        my_id = self._instance_row_id(self.instance, conn)
+        for op in ops:
+            self._insert_op_row(conn, op, my_id)
+
+    def _insert_op_row(self, conn, op: CRDTOperation, instance_row_id: int) -> None:
+        t = op.typ
+        data = pack_value({"field": t.field, "value": t.value,
+                           "delete": t.delete, "op_id": op.id})
+        if isinstance(t, SharedOp):
+            conn.execute(
+                "INSERT INTO shared_operation "
+                "(timestamp, model, record_id, kind, data, instance_id) "
+                "VALUES (?, ?, ?, ?, ?, ?)",
+                (op.timestamp, t.model, pack_value(t.record_id), t.kind,
+                 data, instance_row_id),
+            )
+        else:
+            conn.execute(
+                "INSERT INTO relation_operation "
+                "(timestamp, relation, item_id, group_id, kind, data, "
+                "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (op.timestamp, t.relation, pack_value(t.item_id),
+                 pack_value(t.group_id), t.kind, data, instance_row_id),
+            )
+
+    # -- read path (manager.rs:130-199) ------------------------------------
+
+    def get_ops(self, args: GetOpsArgs) -> List[CRDTOperation]:
+        """Ops newer than the given per-instance watermarks, plus all ops
+        from instances absent from the watermark list, ordered by
+        (timestamp, instance), limited to args.count."""
+        clock_ids = [pub for pub, _ in args.clocks]
+        results: List[Tuple[int, bytes, CRDTOperation]] = []
+        for table, is_shared in (("shared_operation", True),
+                                 ("relation_operation", False)):
+            conds, params = [], []
+            for pub, ts in args.clocks:
+                conds.append(
+                    "(i.pub_id = ? AND o.timestamp > ?)")
+                params.extend([pub, ts])
+            if clock_ids:
+                ph = ",".join("?" for _ in clock_ids)
+                conds.append(f"i.pub_id NOT IN ({ph})")
+                params.extend(clock_ids)
+            where = " OR ".join(conds) if conds else "1=1"
+            rows = self.db.query(
+                f"SELECT o.*, i.pub_id AS instance_pub_id FROM {table} o "
+                f"JOIN instance i ON i.id = o.instance_id "
+                f"WHERE {where} ORDER BY o.timestamp ASC LIMIT ?",
+                params + [args.count],
+            )
+            for row in rows:
+                results.append(
+                    (row["timestamp"], row["instance_pub_id"],
+                     self._row_to_op(row, is_shared)))
+        results.sort(key=lambda t: (t[0], t[1]))
+        return [op for _, _, op in results[:args.count]]
+
+    def _row_to_op(self, row, is_shared: bool) -> CRDTOperation:
+        data = unpack_value(row["data"])
+        if is_shared:
+            typ: Any = SharedOp(
+                row["model"], unpack_value(row["record_id"]),
+                data.get("field"), data.get("value"),
+                bool(data.get("delete")),
+            )
+        else:
+            typ = RelationOp(
+                row["relation"], unpack_value(row["item_id"]),
+                unpack_value(row["group_id"]), data.get("field"),
+                data.get("value"), bool(data.get("delete")),
+            )
+        return CRDTOperation(
+            row["instance_pub_id"], row["timestamp"],
+            data.get("op_id", b""), typ)
+
+    # -- ingest (core/crates/sync/src/ingest.rs:110-233) -------------------
+
+    def register_instance(self, pub_id: bytes, **fields: Any) -> int:
+        """Insert an instance row if unknown; returns local row id."""
+        row = self.db.query_one(
+            "SELECT id FROM instance WHERE pub_id = ?", (pub_id,))
+        if row is not None:
+            self._instance_ids[pub_id] = row["id"]
+            return row["id"]
+        import time
+        defaults = {
+            "pub_id": pub_id, "identity": fields.pop("identity", b""),
+            "node_id": fields.pop("node_id", b""),
+            "node_name": fields.pop("node_name", "?"),
+            "node_platform": fields.pop("node_platform", 0),
+            "last_seen": fields.pop("last_seen", int(time.time())),
+            "date_created": fields.pop("date_created", int(time.time())),
+        }
+        defaults.update(fields)
+        rid = self.db.insert("instance", defaults)
+        self._instance_ids[pub_id] = rid
+        return rid
+
+    def receive_crdt_operation(self, op: CRDTOperation) -> bool:
+        """Ingest one remote op; returns True if applied, False if stale
+        (receive_crdt_operation, ingest.rs:110-160)."""
+        self.clock.update_with_timestamp(op.timestamp)
+        ts = max(self.timestamps.get(op.instance, op.timestamp), op.timestamp)
+
+        is_old = self._compare_message(op)
+        applied = False
+        if not is_old:
+            self._apply_op(op)
+            applied = True
+
+        self.db.execute(
+            "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
+            (ts, op.instance))
+        self.timestamps[op.instance] = ts
+        return applied
+
+    def _compare_message(self, op: CRDTOperation) -> bool:
+        """LWW check: is there an op in the log at or after this one for
+        the same (model, record, kind)? (ingest.rs:188-233). Unlike the
+        reference — which re-applies identical-timestamp ops idempotently —
+        an exact-timestamp hit also counts as old, so redelivered pages
+        don't duplicate op-log rows."""
+        t = op.typ
+        if isinstance(t, SharedOp):
+            row = self.db.query_one(
+                "SELECT timestamp FROM shared_operation WHERE timestamp >= ? "
+                "AND model = ? AND record_id = ? AND kind = ? "
+                "ORDER BY timestamp DESC LIMIT 1",
+                (op.timestamp, t.model, pack_value(t.record_id), t.kind))
+        else:
+            # Unlike ingest.rs:209-224 (item-only), group_id participates:
+            # ops on different groups of one item are independent records.
+            row = self.db.query_one(
+                "SELECT timestamp FROM relation_operation "
+                "WHERE timestamp >= ? AND relation = ? AND item_id = ? "
+                "AND group_id = ? AND kind = ? "
+                "ORDER BY timestamp DESC LIMIT 1",
+                (op.timestamp, t.relation, pack_value(t.item_id),
+                 pack_value(t.group_id), t.kind))
+        return row is not None
+
+    # -- generic ModelSyncData apply ---------------------------------------
+
+    def _resolve_fk(self, conn, table: str, pub_id: Any) -> Optional[int]:
+        if pub_id is None:
+            return None
+        row = conn.execute(
+            f"SELECT id FROM {table} WHERE pub_id = ?", (pub_id,)).fetchone()
+        return row["id"] if row else None
+
+    def _apply_op(self, op: CRDTOperation) -> None:
+        """Apply a remote op to the domain tables + insert it into the op
+        log, atomically (apply_op, ingest.rs:162-186)."""
+        t = op.typ
+        with self.db.tx() as conn:
+            if isinstance(t, SharedOp):
+                self._apply_shared(conn, t)
+            else:
+                self._apply_relation(conn, t)
+            remote_id = self._instance_row_id(op.instance, conn)
+            self._insert_op_row(conn, op, remote_id)
+
+    def _apply_shared(self, conn, t: SharedOp) -> None:
+        model = M.MODELS[t.model]
+        assert model.sync == M.SyncMode.SHARED, t.model
+        sync_col = model.sync_id[0]
+        if t.delete:
+            conn.execute(
+                f"DELETE FROM {t.model} WHERE {sync_col} = ?", (t.record_id,))
+            return
+        if t.field is None:  # create
+            conn.execute(
+                f"INSERT OR IGNORE INTO {t.model} ({sync_col}) VALUES (?)",
+                (t.record_id,))
+            return
+        f = model.field(t.field)
+        value = t.value
+        target = _fk_target(f)
+        if target is not None and M.MODELS[target].sync == M.SyncMode.SHARED:
+            value = self._resolve_fk(conn, target, value)
+        # Upsert semantics: updates may arrive when the create was judged
+        # stale, so ensure the row exists.
+        conn.execute(
+            f"INSERT OR IGNORE INTO {t.model} ({sync_col}) VALUES (?)",
+            (t.record_id,))
+        conn.execute(
+            f"UPDATE {t.model} SET {t.field} = ? WHERE {sync_col} = ?",
+            (value, t.record_id))
+
+    def _apply_relation(self, conn, t: RelationOp) -> None:
+        model = M.MODELS[t.relation]
+        assert model.sync == M.SyncMode.RELATION and model.relation
+        item_field, group_field = model.relation
+        item_table = _fk_target(model.field(item_field))
+        group_table = _fk_target(model.field(group_field))
+        item_local = self._resolve_fk(conn, item_table, t.item_id)
+        group_local = self._resolve_fk(conn, group_table, t.group_id)
+        if item_local is None or group_local is None:
+            return  # referenced rows not here yet; op stays in the log
+        where = f"{item_field} = ? AND {group_field} = ?"
+        if t.delete:
+            conn.execute(
+                f"DELETE FROM {t.relation} WHERE {where}",
+                (item_local, group_local))
+            return
+        conn.execute(
+            f"INSERT OR IGNORE INTO {t.relation} "
+            f"({item_field}, {group_field}) VALUES (?, ?)",
+            (item_local, group_local))
+        if t.field is not None:
+            # Validate the wire-controlled field name against the registry
+            # before it reaches SQL (same guard as _apply_shared).
+            f = model.field(t.field)
+            conn.execute(
+                f"UPDATE {t.relation} SET {f.name} = ? WHERE {where}",
+                (t.value, item_local, group_local))

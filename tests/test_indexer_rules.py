@@ -1,0 +1,154 @@
+"""Indexer rule tests — scenarios ported from the reference's rule suite
+(/root/reference/core/src/location/indexer/rules/mod.rs:623-838) using real
+tempdir fixtures, plus globset-semantics unit tests for the glob engine."""
+
+import os
+
+from spacedrive_tpu.locations.glob import Glob, GlobSet
+from spacedrive_tpu.locations.rules import (
+    IndexerRule,
+    RuleKind,
+    RulePerKind,
+    apply_all,
+    no_git,
+    no_hidden,
+    no_os_protected,
+    only_images,
+    seed_system_rules,
+)
+
+
+# -- glob engine (globset default semantics) -------------------------------
+
+def test_star_crosses_separators():
+    # literal_separator=false: `*` matches `/` too.
+    assert Glob("*.png").is_match("/tmp/photos/img.png")
+    assert not Glob("*.png").is_match("/tmp/photos/img.jpg")
+
+
+def test_double_star_components():
+    g = Glob("**/.git")
+    assert g.is_match("/repo/.git")
+    assert g.is_match("/a/b/c/.git")
+    assert g.is_match(".git")
+    assert not g.is_match("/repo/.github")
+
+
+def test_alternation():
+    g = Glob("**/{.git,.gitignore,.gitmodules}")
+    assert g.is_match("/x/.gitignore")
+    assert g.is_match("/x/y/.gitmodules")
+    assert not g.is_match("/x/.gitattr")
+
+
+def test_char_class():
+    g = Glob("**/FOUND.[0-9][0-9][0-9]")
+    assert g.is_match("/c/FOUND.123")
+    assert not g.is_match("/c/FOUND.12a")
+
+
+def test_brace_nested():
+    g = Glob("{a,b{c,d}}x")
+    assert g.is_match("ax") and g.is_match("bcx") and g.is_match("bdx")
+    assert not g.is_match("bx")
+
+
+def test_globset_any():
+    gs = GlobSet(["*.jpg", "*.png"])
+    assert gs.is_match("a.png") and gs.is_match("b.jpg")
+    assert not gs.is_match("c.gif")
+
+
+# -- rule application on fixture trees (rules/mod.rs:623-838) --------------
+
+def _paths(tmp_path):
+    (tmp_path / "rust_project").mkdir()
+    (tmp_path / "rust_project" / ".git").mkdir()
+    (tmp_path / "rust_project" / "src").mkdir()
+    (tmp_path / "inner").mkdir()
+    (tmp_path / "inner" / "node_project").mkdir()
+    (tmp_path / "inner" / "node_project" / ".git").mkdir()
+    (tmp_path / "photos").mkdir()
+    (tmp_path / "photos" / "photo1.png").write_bytes(b"p")
+    (tmp_path / "photos" / "photo2.jpg").write_bytes(b"p")
+    (tmp_path / "photos" / "text.txt").write_bytes(b"t")
+    (tmp_path / ".hidden").write_bytes(b"h")
+
+
+def _rejected(rule: IndexerRule, path) -> bool:
+    results = apply_all([rule], path)
+    rej = results.get(RuleKind.REJECT_FILES_BY_GLOB)
+    return bool(rej) and not all(rej)
+
+
+def _accepted(rule: IndexerRule, path) -> bool:
+    results = apply_all([rule], path)
+    acc = results.get(RuleKind.ACCEPT_FILES_BY_GLOB)
+    return acc is None or any(acc)
+
+
+def test_reject_hidden_file(tmp_path):
+    _paths(tmp_path)
+    rule = no_hidden()
+    assert _rejected(rule, tmp_path / ".hidden")
+    assert _rejected(rule, tmp_path / "rust_project" / ".git")
+    assert not _rejected(rule, tmp_path / "photos" / "photo1.png")
+
+
+def test_reject_git(tmp_path):
+    _paths(tmp_path)
+    rule = no_git()
+    assert _rejected(rule, tmp_path / "rust_project" / ".git")
+    assert _rejected(rule, tmp_path / "inner" / "node_project" / ".git")
+    assert not _rejected(rule, tmp_path / "rust_project" / "src")
+
+
+def test_only_photos(tmp_path):
+    _paths(tmp_path)
+    rule = only_images()
+    assert _accepted(rule, tmp_path / "photos" / "photo1.png")
+    assert _accepted(rule, tmp_path / "photos" / "photo2.jpg")
+    assert not _accepted(rule, tmp_path / "photos" / "text.txt")
+
+
+def test_os_protected_linux(tmp_path):
+    rule = no_os_protected()
+    assert _rejected(rule, "/proc")
+    assert _rejected(rule, "/sys")
+    assert _rejected(rule, str(tmp_path / "x" / "lost+found"))
+    assert _rejected(rule, str(tmp_path / "file~"))
+    assert not _rejected(rule, str(tmp_path / "normal.txt"))
+
+
+def test_children_present_rules(tmp_path):
+    _paths(tmp_path)
+    accept = RulePerKind(
+        RuleKind.ACCEPT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT, (".git",))
+    kind, ok = accept.apply(tmp_path / "rust_project")
+    assert ok
+    kind, ok = accept.apply(tmp_path / "photos")
+    assert not ok
+
+    reject = RulePerKind(
+        RuleKind.REJECT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT, (".git",))
+    kind, ok = reject.apply(tmp_path / "rust_project")
+    assert not ok  # rejected
+    kind, ok = reject.apply(tmp_path / "photos")
+    assert ok
+
+
+# -- persistence roundtrip + seeding ---------------------------------------
+
+def test_rule_serialize_roundtrip(tmp_path):
+    from spacedrive_tpu.store.db import Database
+    db = Database(tmp_path / "lib.db")
+    seed_system_rules(db)
+    rows = db.query("SELECT * FROM indexer_rule ORDER BY id")
+    assert [r["name"] for r in rows] == [
+        "No OS protected", "No Hidden", "No Git", "Only Images"]
+    rule = IndexerRule.from_row(rows[2])
+    assert rule.name == "No Git"
+    assert _rejected(rule, "/a/b/.git")
+    # Seeding twice must not duplicate (upsert semantics, seed.rs:57-66).
+    seed_system_rules(db)
+    assert len(db.query("SELECT * FROM indexer_rule")) == 4
