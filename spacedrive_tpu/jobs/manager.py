@@ -245,9 +245,15 @@ class JobManager:
         raise JobManagerError("unknown job")
 
     async def wait_idle(self) -> None:
-        while self._tasks:
+        # During shutdown queued entries intentionally stay QUEUED in the
+        # DB (cold_resume picks them up), so only running tasks gate exit.
+        while self._tasks or (self.queue and not self._shutting_down):
             await asyncio.gather(*list(self._tasks.values()),
                                  return_exceptions=True)
+            # Awaiting already-done tasks returns without yielding to the
+            # loop, so the call_soon-scheduled _on_done that pops _tasks
+            # (and admits chained jobs) would never run — always yield.
+            await asyncio.sleep(0)
 
     # -- lifecycle --------------------------------------------------------
 

@@ -1,0 +1,254 @@
+"""IndexerJob: walk a location and persist file_path rows in batches.
+
+Behavioral equivalent of the reference's indexer job
+(/root/reference/core/src/location/indexer/indexer_job.rs:140-621):
+init walks up to INIT_WALK_LIMIT entries and emits Save steps (chunks of
+BATCH_SIZE=1000 creates), Update steps, and one Walk step per deferred
+directory; Walk steps call keep_walking and append more steps. Stale rows
+found by the walker are deleted. Dir sizes accumulate across steps and are
+written in finalize.
+
+All writes go through the sync manager (create/update/delete ops), unlike
+the reference which TODOs sync for removals (indexer_job.rs:232).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
+from ..store import uuid_bytes
+from .paths import IsolatedPath
+from .rules import load_rules_for_location
+from .walker import ToWalkEntry, WalkedEntry, Walker, WalkResult
+
+BATCH_SIZE = 1000       # indexer_job.rs:48
+INIT_WALK_LIMIT = 50_000  # indexer_job.rs:205
+
+
+def _entry_to_row(e: WalkedEntry, location_id: int) -> Dict[str, Any]:
+    m = e.metadata
+    return {
+        "pub_id": e.pub_id,
+        "location_id": location_id,
+        "is_dir": int(e.iso.is_dir),
+        "materialized_path": e.iso.materialized_path,
+        "name": e.iso.name,
+        "extension": e.iso.extension,
+        "inode": int(m.inode).to_bytes(8, "big"),
+        "size_in_bytes_bytes": int(m.size_in_bytes).to_bytes(8, "big"),
+        "date_created": m.created_at,
+        "date_modified": m.modified_at,
+        "date_indexed": time.time(),
+    }
+
+
+def _row_sync_values(row: Dict[str, Any]) -> Dict[str, Any]:
+    """Synced field subset (location_id handled as the location pub_id by
+    callers; local ids never go on the wire)."""
+    return {k: row[k] for k in (
+        "is_dir", "materialized_path", "name", "extension",
+        "size_in_bytes_bytes", "date_created", "date_modified",
+        "date_indexed",
+    )}
+
+
+def make_db_fetchers(db, location_id: int):
+    """The walker's injected DB seams, backed by the real store
+    (file_paths_db_fetcher_fn!/to_remove_db_fetcher_fn!,
+    indexer/mod.rs macros)."""
+
+    def existing(paths):
+        out = []
+        for p in paths:
+            row = db.query_one(
+                "SELECT * FROM file_path WHERE location_id = ? AND "
+                "materialized_path = ? AND name = ? AND extension = ?",
+                p.db_key())
+            if row is not None:
+                out.append(dict(row))
+        return out
+
+    def to_remove(parent_iso, iso_paths):
+        """Rows directly under parent_iso that the walker did not see."""
+        children_mat = parent_iso.materialized_path_for_children()
+        if children_mat is None:
+            return []
+        rows = db.query(
+            "SELECT pub_id, cas_id, materialized_path, name, extension "
+            "FROM file_path WHERE location_id = ? AND materialized_path = ?",
+            (location_id, children_mat))
+        seen = {(p.materialized_path, p.name, p.extension)
+                for p in iso_paths}
+        return [dict(r) for r in rows
+                if (r["materialized_path"], r["name"], r["extension"] or "")
+                not in seen]
+
+    return existing, to_remove
+
+
+@register_job
+class IndexerJob(StatefulJob):
+    NAME = "indexer"
+    IS_BATCHED = True
+
+    def __init__(self, *, location_id: int, sub_path: Optional[str] = None):
+        super().__init__(location_id=location_id, sub_path=sub_path)
+        self.location_id = location_id
+        self.sub_path = sub_path
+
+    # -- helpers -----------------------------------------------------------
+
+    def _walker(self, ctx: JobContext, location_path: str) -> Walker:
+        # One Walker per run: rules can't change mid-job, and per-step
+        # reconstruction would re-query the rule tables for every
+        # deferred directory.
+        cached = getattr(self, "_walker_cache", None)
+        if cached is not None and cached.location_path == location_path:
+            return cached
+        db = ctx.db
+        rules = load_rules_for_location(db, self.location_id)
+        existing, to_remove = make_db_fetchers(db, self.location_id)
+        self._walker_cache = Walker(
+            self.location_id, location_path, rules=rules,
+            existing_paths_fetcher=existing, to_remove_fetcher=to_remove,
+        )
+        return self._walker_cache
+
+    def _result_to_steps(self, res: WalkResult, data: Dict[str, Any]
+                         ) -> List[Any]:
+        steps: List[Any] = []
+        save_rows = [_entry_to_row(e, self.location_id) for e in res.walked]
+        for i in range(0, len(save_rows), BATCH_SIZE):
+            steps.append({"kind": "save", "rows": save_rows[i:i + BATCH_SIZE]})
+        upd_rows = [_entry_to_row(e, self.location_id) for e in res.to_update]
+        for i in range(0, len(upd_rows), BATCH_SIZE):
+            steps.append({"kind": "update",
+                          "rows": upd_rows[i:i + BATCH_SIZE]})
+        for w in res.to_walk:
+            steps.append({"kind": "walk", "path": w.path,
+                          "accepted": w.parent_dir_accepted_by_its_children,
+                          "parent": w.maybe_parent})
+        if res.to_remove:
+            steps.append({"kind": "remove",
+                          "rows": [{"pub_id": r["pub_id"]}
+                                   for r in res.to_remove]})
+        for p, s in res.paths_and_sizes.items():
+            data["dir_sizes"][p] = data["dir_sizes"].get(p, 0) + s
+        return steps
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def init(self, ctx: JobContext):
+        db = ctx.db
+        loc = db.query_one(
+            "SELECT * FROM location WHERE id = ?", (self.location_id,))
+        if loc is None or not loc["path"]:
+            raise EarlyFinish(f"location {self.location_id} gone")
+        location_path = loc["path"]
+        to_walk_path = location_path
+        if self.sub_path:
+            iso = IsolatedPath.new(
+                self.location_id, location_path,
+                f"{location_path.rstrip('/')}/{self.sub_path.strip('/')}",
+                True)
+            to_walk_path = iso.join_on(location_path)
+        data: Dict[str, Any] = {
+            "location_path": location_path,
+            "location_pub_id": loc["pub_id"],
+            "dir_sizes": {},
+            "total_saved": 0, "total_updated": 0, "total_removed": 0,
+        }
+        walker = self._walker(ctx, location_path)
+        res = await asyncio.to_thread(
+            walker.walk, to_walk_path, INIT_WALK_LIMIT)
+        steps = self._result_to_steps(res, data)
+        if not steps:
+            raise EarlyFinish("nothing to index")
+        return data, steps
+
+    async def execute_step(self, ctx: JobContext, data, step, step_number):
+        kind = step["kind"]
+        if kind == "save":
+            return await asyncio.to_thread(self._save, ctx, data, step)
+        if kind == "update":
+            return await asyncio.to_thread(self._update, ctx, data, step)
+        if kind == "remove":
+            return await asyncio.to_thread(self._remove, ctx, data, step)
+        # walk step: descend one deferred directory.
+        walker = self._walker(ctx, data["location_path"])
+        res = await asyncio.to_thread(
+            walker.keep_walking,
+            ToWalkEntry(step["path"], step.get("accepted"), step.get("parent")),
+        )
+        more = self._result_to_steps(res, data)
+        return StepOutcome(more_steps=more, errors=list(res.errors))
+
+    def _save(self, ctx: JobContext, data, step) -> StepOutcome:
+        db, sync = ctx.db, ctx.library.sync
+        rows = step["rows"]
+        loc_pub = data["location_pub_id"]
+        ops = []
+        for row in rows:
+            values = _row_sync_values(row)
+            values["location_id"] = loc_pub  # FK syncs as pub_id
+            ops.extend(sync.shared_create("file_path", row["pub_id"], values))
+        with sync.write_ops(ops) as conn:
+            # Unique collisions (replayed step after pause) are ignored.
+            n = db.insert_many("file_path", rows, conn=conn,
+                               ignore_conflicts=True)
+        data["total_saved"] += n
+        ctx.progress(message=f"saved {data['total_saved']} paths")
+        return StepOutcome(metadata={"indexed_count": data["total_saved"]})
+
+    def _update(self, ctx: JobContext, data, step) -> StepOutcome:
+        db, sync = ctx.db, ctx.library.sync
+        ops = []
+        with db.tx() as conn:
+            for row in step["rows"]:
+                values = {k: row[k] for k in (
+                    "inode", "size_in_bytes_bytes", "date_modified",
+                    "date_indexed", "is_dir")}
+                db.update("file_path", row["pub_id"], values, conn=conn,
+                          id_col="pub_id")
+                for k, v in values.items():
+                    ops.append(sync.shared_update(
+                        "file_path", row["pub_id"], k, v))
+            sync._insert_op_rows(conn, ops)
+        data["total_updated"] += len(step["rows"])
+        return StepOutcome(metadata={"updated_count": data["total_updated"]})
+
+    def _remove(self, ctx: JobContext, data, step) -> StepOutcome:
+        db, sync = ctx.db, ctx.library.sync
+        pub_ids = [r["pub_id"] for r in step["rows"]]
+        ops = [sync.shared_delete("file_path", p) for p in pub_ids]
+        with sync.write_ops(ops) as conn:
+            for p in pub_ids:
+                db.delete("file_path", p, conn=conn, id_col="pub_id")
+        data["total_removed"] += len(pub_ids)
+        return StepOutcome(metadata={"removed_count": data["total_removed"]})
+
+    async def finalize(self, ctx: JobContext, data, metadata):
+        """Write accumulated dir sizes onto their file_path rows
+        (indexer_job.rs finalize semantics) + location totals."""
+        db = ctx.db
+        loc_path = data["location_path"]
+        with db.tx() as conn:
+            for path, size in data["dir_sizes"].items():
+                try:
+                    iso = IsolatedPath.new(
+                        self.location_id, loc_path, path, True)
+                except ValueError:
+                    continue
+                conn.execute(
+                    "UPDATE file_path SET size_in_bytes_bytes = ? WHERE "
+                    "location_id = ? AND materialized_path = ? AND "
+                    "name = ? AND extension = ?",
+                    (int(size).to_bytes(8, "big"), iso.location_id,
+                     iso.materialized_path, iso.name, iso.extension))
+        metadata.setdefault("indexed_count", data["total_saved"])
+        metadata.setdefault("updated_count", data["total_updated"])
+        metadata.setdefault("removed_count", data["total_removed"])
+        return metadata

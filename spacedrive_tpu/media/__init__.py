@@ -1,0 +1,4 @@
+from .thumbnail import generate_thumbnail, thumbnail_path
+from .processor import MediaProcessorJob
+
+__all__ = ["generate_thumbnail", "thumbnail_path", "MediaProcessorJob"]

@@ -1,0 +1,120 @@
+"""EXIF media-data extraction.
+
+Covers the behavior of the reference's media-data extractor
+(/root/reference/core/src/object/media/media_data_extractor.rs:50-90 and
+crates/media-metadata image path): pull resolution, capture date, GPS
+location, and camera data from image files into `media_data` rows.
+PIL's Exif reader replaces the Rust `kamadak-exif` stack.
+"""
+
+from __future__ import annotations
+
+import msgpack
+from typing import Any, Dict, Optional
+
+# Extensions eligible for media-data extraction
+# (media_data_extractor.rs:50-56); HEIF family needs a codec PIL lacks
+# here, but extraction failures are non-fatal per-file errors anyway.
+MEDIA_DATA_EXTENSIONS = {
+    "tiff", "dng", "jpeg", "jpg", "heif", "heifs", "heic", "avif",
+    "avcs", "avci", "hif", "png", "webp",
+}
+
+_TAG = {
+    "DateTimeOriginal": 0x9003,
+    "Make": 0x010F,
+    "Model": 0x0110,
+    "Software": 0x0131,
+    "Orientation": 0x0112,
+    "FNumber": 0x829D,
+    "ExposureTime": 0x829A,
+    "ISOSpeedRatings": 0x8827,
+    "FocalLength": 0x920A,
+    "LensMake": 0xA433,
+    "LensModel": 0xA434,
+}
+
+
+def _ratio(v) -> Optional[float]:
+    try:
+        return float(v)
+    except (TypeError, ValueError, ZeroDivisionError):
+        return None
+
+
+def _gps_to_degrees(values, ref: str) -> Optional[float]:
+    try:
+        d, m, s = (float(x) for x in values)
+        deg = d + m / 60 + s / 3600
+        return -deg if ref in ("S", "W") else deg
+    except Exception:
+        return None
+
+
+def extract_media_data(path: str) -> Optional[Dict[str, Any]]:
+    """Returns a media_data row dict (without object_id), or None when the
+    file has no readable EXIF."""
+    from PIL import Image
+    try:
+        with Image.open(path) as im:
+            width, height = im.size
+            exif = im.getexif()
+    except Exception:
+        return None
+
+    row: Dict[str, Any] = {
+        "resolution": msgpack.packb({"width": width, "height": height}),
+    }
+    if not exif:
+        return row
+
+    ifd = {}
+    try:
+        ifd = dict(exif.get_ifd(0x8769))  # Exif sub-IFD
+    except Exception:
+        pass
+    merged = {**dict(exif), **ifd}
+
+    date = merged.get(_TAG["DateTimeOriginal"])
+    if date:
+        row["media_date"] = msgpack.packb(str(date))
+    camera = {
+        k: str(merged[t]) for k, t in (
+            ("make", _TAG["Make"]), ("model", _TAG["Model"]),
+            ("software", _TAG["Software"]),
+            ("lens_make", _TAG["LensMake"]),
+            ("lens_model", _TAG["LensModel"]),
+        ) if merged.get(t)
+    }
+    for k, t in (("f_number", _TAG["FNumber"]),
+                 ("exposure_time", _TAG["ExposureTime"]),
+                 ("focal_length", _TAG["FocalLength"])):
+        v = _ratio(merged.get(t))
+        if v is not None:
+            camera[k] = v
+    iso = merged.get(_TAG["ISOSpeedRatings"])
+    if iso is not None:
+        try:
+            camera["iso"] = int(iso if not isinstance(iso, tuple) else iso[0])
+        except (TypeError, ValueError):
+            pass
+    orient = merged.get(_TAG["Orientation"])
+    if orient is not None:
+        try:
+            camera["orientation"] = int(orient)
+        except (TypeError, ValueError):
+            pass
+    if camera:
+        row["camera_data"] = msgpack.packb(camera)
+
+    try:
+        gps = exif.get_ifd(0x8825)  # GPS IFD
+        if gps:
+            lat = _gps_to_degrees(gps.get(2), str(gps.get(1, "N")))
+            lon = _gps_to_degrees(gps.get(4), str(gps.get(3, "E")))
+            if lat is not None and lon is not None:
+                row["media_location"] = msgpack.packb(
+                    {"latitude": lat, "longitude": lon})
+    except Exception:
+        pass
+    return row

@@ -1,0 +1,98 @@
+"""MediaProcessorJob: unified media-data extraction + thumbnail pass.
+
+Mirrors the reference job
+(/root/reference/core/src/object/media/media_processor/job.rs:34-67 and
+media_processor/mod.rs:75-103): one pass over the location's image paths
+in batches of BATCH_SIZE = 10, extracting EXIF into `media_data` rows and
+generating webp thumbnails keyed by cas_id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
+from ..locations.paths import IsolatedPath
+from .exif import MEDIA_DATA_EXTENSIONS, extract_media_data
+from .thumbnail import (
+    THUMBNAILABLE_EXTENSIONS,
+    ensure_thumbnail_dir,
+    generate_thumbnail,
+)
+
+BATCH_SIZE = 10  # media_processor/job.rs:34
+
+
+@register_job
+class MediaProcessorJob(StatefulJob):
+    NAME = "media_processor"
+    IS_BATCHED = True
+
+    def __init__(self, *, location_id: int, sub_path: Optional[str] = None):
+        super().__init__(location_id=location_id, sub_path=sub_path)
+        self.location_id = location_id
+        self.sub_path = sub_path
+
+    async def init(self, ctx: JobContext):
+        db = ctx.db
+        from ..locations.file_path_helper import job_prologue
+        exts = sorted(MEDIA_DATA_EXTENSIONS | THUMBNAILABLE_EXTENSIONS)
+        ph = ",".join("?" for _ in exts)
+        loc, where, params = job_prologue(
+            db, self.location_id, self.sub_path,
+            f"location_id = ? AND is_dir = 0 AND object_id IS NOT NULL "
+            f"AND LOWER(extension) IN ({ph})",
+            [self.location_id, *exts])
+        rows = db.query(
+            f"SELECT id, pub_id, object_id, cas_id, materialized_path, "
+            f"name, extension FROM file_path WHERE {where} ORDER BY id",
+            params)
+        if not rows:
+            raise EarlyFinish("no media files")
+        steps = []
+        for i in range(0, len(rows), BATCH_SIZE):
+            steps.append({"rows": [dict(r) for r in rows[i:i + BATCH_SIZE]]})
+        data = {"location_path": loc["path"], "extracted": 0, "thumbs": 0}
+        ctx.progress(task_count=len(steps))
+        return data, steps
+
+    async def execute_step(self, ctx, data, step, step_number):
+        return await asyncio.to_thread(self._step, ctx, data, step)
+
+    def _step(self, ctx: JobContext, data, step) -> StepOutcome:
+        db = ctx.db
+        data_dir = ctx.services.get("data_dir")
+        errors: List[str] = []
+        for r in step["rows"]:
+            ext = (r["extension"] or "").lower()
+            iso = IsolatedPath.from_db_row(
+                self.location_id, False, r["materialized_path"],
+                r["name"] or "", r["extension"] or "")
+            full = iso.join_on(data["location_path"])
+            if ext in MEDIA_DATA_EXTENSIONS:
+                existing = db.query_one(
+                    "SELECT id FROM media_data WHERE object_id = ?",
+                    (r["object_id"],))
+                if existing is None:
+                    md = extract_media_data(full)
+                    if md is not None:
+                        md["object_id"] = r["object_id"]
+                        try:
+                            db.insert("media_data", md)
+                            data["extracted"] += 1
+                        except Exception as e:  # unique race: another path
+                            errors.append(f"media_data {full}: {e}")
+            if data_dir and r["cas_id"] and ext in THUMBNAILABLE_EXTENSIONS:
+                ensure_thumbnail_dir(data_dir)
+                if generate_thumbnail(full, data_dir, r["cas_id"]):
+                    data["thumbs"] += 1
+        ctx.progress(message=(
+            f"media: {data['extracted']} exif, {data['thumbs']} thumbs"))
+        return StepOutcome(errors=errors, metadata={
+            "media_data_extracted": data["extracted"],
+            "thumbnails_generated": data["thumbs"],
+        })
+
+    async def finalize(self, ctx, data, metadata):
+        return metadata

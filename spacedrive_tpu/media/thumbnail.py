@@ -1,0 +1,92 @@
+"""Thumbnail generation: webp thumbnails in a 256-way sharded cache.
+
+Mirrors the reference's thumbnailer output contract
+(/root/reference/core/src/object/media/thumbnail/mod.rs:47-56,113,117 and
+shard.rs:4): thumbnails live at
+`<data_dir>/thumbnails/<cas_id[0:2]>/<cas_id>.webp`, scaled so
+width*height ≈ TARGET_PX = 262,144 px², encoded webp at quality 30.
+Decode/encode is PIL (the reference uses the sd-images Rust crate +
+webp encoder); batch resize can move on-device later — decode stays CPU.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Tuple
+
+TARGET_PX = 262144.0    # thumbnail/mod.rs:113
+TARGET_QUALITY = 30     # thumbnail/mod.rs:117
+WEBP_EXTENSION = "webp"
+VERSION_FILE = "version.txt"
+THUMBNAIL_CACHE_VERSION = 1
+
+# Image extensions PIL can thumbnail here (subset of the reference's
+# sd-images handlers — no HEIF/SVG/PDF codecs in this image).
+THUMBNAILABLE_EXTENSIONS = {
+    "jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico", "apng",
+}
+
+
+def shard_hex(cas_id: str) -> str:
+    """Two-char shard dir (shard.rs:4)."""
+    return cas_id[:2]
+
+
+def thumbnail_path(data_dir: str, cas_id: str) -> str:
+    return os.path.join(
+        data_dir, "thumbnails", shard_hex(cas_id),
+        f"{cas_id}.{WEBP_EXTENSION}")
+
+
+def ensure_thumbnail_dir(data_dir: str) -> str:
+    root = os.path.join(data_dir, "thumbnails")
+    os.makedirs(root, exist_ok=True)
+    version_file = os.path.join(root, VERSION_FILE)
+    if not os.path.exists(version_file):
+        with open(version_file, "w") as f:
+            f.write(str(THUMBNAIL_CACHE_VERSION))
+    return root
+
+
+def scale_dimensions(w: float, h: float,
+                     target_px: float = TARGET_PX) -> Tuple[int, int]:
+    """Scale preserving aspect ratio to ~target_px total pixels
+    (thumbnail/mod.rs:142)."""
+    ratio = math.sqrt(target_px / (w * h)) if w * h > 0 else 1.0
+    ratio = min(ratio, 1.0)  # never upscale
+    return max(1, round(w * ratio)), max(1, round(h * ratio))
+
+
+def generate_thumbnail(input_path: str, data_dir: str,
+                       cas_id: str) -> Optional[str]:
+    """Decode → scale → webp encode → sharded cache. Returns the output
+    path, or None if the format is unsupported. Skips work if the
+    thumbnail already exists (actor.rs skip semantics)."""
+    out = thumbnail_path(data_dir, cas_id)
+    if os.path.exists(out):
+        return out
+    from PIL import Image
+    try:
+        with Image.open(input_path) as im:
+            im = im.convert("RGB")
+            w, h = scale_dimensions(im.width, im.height)
+            im = im.resize((w, h), Image.LANCZOS)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            tmp = out + ".tmp"
+            im.save(tmp, "WEBP", quality=TARGET_QUALITY)
+            os.replace(tmp, out)
+            return out
+    except Exception:
+        return None
+
+
+def remove_thumbnails_by_cas_ids(data_dir: str, cas_ids) -> int:
+    """Thumbnailer::remove_cas_ids (actor API)."""
+    n = 0
+    for cas_id in cas_ids:
+        p = thumbnail_path(data_dir, cas_id)
+        if os.path.exists(p):
+            os.remove(p)
+            n += 1
+    return n

@@ -1,0 +1,210 @@
+"""Node bootstrap: construct every service in order, own the event bus.
+
+Mirrors `Node::new` (/root/reference/core/src/lib.rs:58-144): config
+manager → libraries → job manager → (p2p later), with the library-load
+hook wiring cold-resume, exactly the ordering the reference marks
+ordering-sensitive (lib.rs:134-138). The event bus is the CoreEvent
+channel (api/mod.rs:17-23) as a plain callback fan-out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid as uuidlib
+from typing import Any, Callable, Dict, List, Optional
+
+from .jobs.manager import JobManager
+from .library import Libraries, Library
+from .store.db import uuid_bytes
+
+NODE_CONFIG_VERSION = 1
+NODE_CONFIG_NAME = "node_state.sdconfig"
+
+
+class EventBus:
+    """CoreEvent fan-out: JobProgress / JobUpdate / InvalidateOperation."""
+
+    def __init__(self):
+        self._subs: List[Callable[[dict], None]] = []
+
+    def subscribe(self, cb: Callable[[dict], None]) -> Callable[[], None]:
+        self._subs.append(cb)
+        return lambda: self._subs.remove(cb)
+
+    def emit(self, event: dict) -> None:
+        for cb in list(self._subs):
+            try:
+                cb(event)
+            except Exception:
+                pass
+
+    def invalidate_query(self, library_id, key: str) -> None:
+        """invalidate_query! macro semantics (api/utils/invalidate.rs:131)."""
+        self.emit({"type": "InvalidateOperation",
+                   "library_id": str(library_id), "key": key})
+
+
+def migrate_node_config(raw: dict) -> dict:
+    """Versioned config migrator (util/migrator.rs:33-41 semantics):
+    upgrade step by step from raw['version'] to NODE_CONFIG_VERSION."""
+    version = raw.get("version", 0)
+    if version > NODE_CONFIG_VERSION:
+        raise ValueError(
+            f"config version {version} is newer than supported "
+            f"{NODE_CONFIG_VERSION} (time traveling backwards?)")
+    while version < NODE_CONFIG_VERSION:
+        if version == 0:
+            raw.setdefault("id", uuidlib.uuid4().hex)
+            raw.setdefault("name", "spacedrive-tpu-node")
+            raw.setdefault("features", [])
+        version += 1
+        raw["version"] = version
+    return raw
+
+
+class NodeConfig:
+    """node_state.sdconfig (node/config.rs:22-43)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+        else:
+            raw = {}
+        raw = migrate_node_config(raw)
+        self.raw = raw
+        self.save()
+
+    @property
+    def id(self) -> bytes:
+        return bytes.fromhex(self.raw["id"])
+
+    @property
+    def name(self) -> str:
+        return self.raw["name"]
+
+    @property
+    def features(self) -> List[str]:
+        return list(self.raw.get("features", []))
+
+    def toggle_feature(self, feature: str) -> bool:
+        """BackendFeature toggle (api/mod.rs:28-48); returns new state."""
+        feats = set(self.raw.get("features", []))
+        if feature in feats:
+            feats.remove(feature)
+            enabled = False
+        else:
+            feats.add(feature)
+            enabled = True
+        self.raw["features"] = sorted(feats)
+        self.save()
+        return enabled
+
+    def save(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.raw, f, indent=2)
+        os.replace(tmp, self.path)
+
+
+class OrphanRemover:
+    """Deletes objects with zero file_paths; 1-minute tick or on demand
+    (core/src/object/orphan_remover.rs:17-40)."""
+
+    TICK_S = 60
+
+    def __init__(self, library: Library):
+        self.library = library
+        self._task: Optional[asyncio.Task] = None
+
+    def invoke(self) -> int:
+        db = self.library.db
+        rows = db.query(
+            "SELECT o.id, o.pub_id FROM object o "
+            "LEFT JOIN file_path fp ON fp.object_id = o.id "
+            "WHERE fp.id IS NULL LIMIT 512")
+        if not rows:
+            return 0
+        sync = self.library.sync
+        ops = [sync.shared_delete("object", r["pub_id"]) for r in rows]
+        with sync.write_ops(ops) as conn:
+            for r in rows:
+                conn.execute("DELETE FROM object WHERE id = ?", (r["id"],))
+        return len(rows)
+
+    def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.TICK_S)
+                await asyncio.to_thread(self.invoke)
+        self._task = asyncio.get_running_loop().create_task(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+class Node:
+    def __init__(self, data_dir: str):
+        self.data_dir = os.path.abspath(data_dir)
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.config = NodeConfig(os.path.join(self.data_dir, NODE_CONFIG_NAME))
+        self.events = EventBus()
+        self.libraries = Libraries(self.data_dir)
+        self.jobs = JobManager(
+            on_event=self.events.emit,
+            services={"data_dir": self.data_dir, "node": self},
+        )
+        self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
+        self._started = False
+        self.libraries.on_event(self._on_library_event)
+
+    # -- lifecycle (ordering-sensitive: lib.rs:134-138) --------------------
+
+    async def start(self) -> None:
+        """Load libraries, cold-resume their interrupted jobs, start
+        actors."""
+        self._started = True
+        self.libraries.init()
+        for lib in self.libraries.list():
+            await self.jobs.cold_resume(lib)
+            self._ensure_actors(lib)
+
+    def _on_library_event(self, kind: str, library: Library) -> None:
+        if kind == "load":
+            self._ensure_actors(library)
+        elif kind == "delete":
+            remover = self.orphan_removers.pop(library.id, None)
+            if remover:
+                remover.stop()
+        # query invalidation for the frontend
+        self.events.invalidate_query(library.id, "library.list")
+
+    def _ensure_actors(self, library: Library) -> None:
+        if library.id not in self.orphan_removers:
+            remover = OrphanRemover(library)
+            try:
+                remover.start()
+            except RuntimeError:
+                pass  # no running loop (sync tests); invoke() still works
+            self.orphan_removers[library.id] = remover
+
+    async def shutdown(self) -> None:
+        """Node::shutdown (lib.rs:205): pause jobs, stop actors."""
+        await self.jobs.shutdown()
+        for remover in self.orphan_removers.values():
+            remover.stop()
+        for lib in self.libraries.list():
+            lib.db.close()
+
+    # -- convenience -------------------------------------------------------
+
+    def create_library(self, name: str) -> Library:
+        lib = self.libraries.create(
+            name, node_name=self.config.name, node_pub_id=self.config.id)
+        return lib

@@ -1,0 +1,4 @@
+from .identifier import FileIdentifierJob
+from .validator import ObjectValidatorJob
+
+__all__ = ["FileIdentifierJob", "ObjectValidatorJob"]

@@ -1,0 +1,194 @@
+"""FileIdentifierJob: CAS-ID every orphan file_path, link/create objects.
+
+The flagship hot path (SURVEY.md §3.3). Behavior mirrors the reference job
+(/root/reference/core/src/object/file_identifier/file_identifier_job.rs:72-309
+and mod.rs:100-331): cursor-paginated chunks of CHUNK_SIZE orphans
+(object_id IS NULL, is_dir = 0), per chunk: compute kind + CAS ID, write
+cas_ids via sync, link file_paths to existing objects matching by cas_id,
+create objects for the rest.
+
+TPU-first deviations:
+- the per-chunk hashing is a *batched* staged pipeline
+  (ops/staging.cas_ids_for_files) on the configured backend
+  ("oracle" | "numpy" | "jax" | "auto") instead of per-file streaming;
+- files in one chunk sharing a cas_id share ONE new object (the reference
+  creates an object per file_path and only dedups against earlier chunks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid as uuidlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..files import resolve_kind
+from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
+from ..locations.file_path_helper import materialized_like, sub_path_children_mat
+from ..locations.paths import IsolatedPath
+from ..ops.staging import cas_ids_for_files
+
+CHUNK_SIZE = 100  # file_identifier/mod.rs:36
+
+
+def orphan_filters(location_id: int, cursor: int,
+                   sub_mat_path: Optional[str]) -> Tuple[str, list]:
+    """WHERE clause for orphan file_paths
+    (orphan_path_filters, file_identifier_job.rs:245-270)."""
+    where = ("object_id IS NULL AND is_dir = 0 AND location_id = ? "
+             "AND id >= ?")
+    params: list = [location_id, cursor]
+    where = materialized_like(where, params, sub_mat_path)
+    return where, params
+
+
+@register_job
+class FileIdentifierJob(StatefulJob):
+    NAME = "file_identifier"
+    IS_BATCHED = True
+
+    def __init__(self, *, location_id: int, sub_path: Optional[str] = None,
+                 backend: str = "auto"):
+        super().__init__(location_id=location_id, sub_path=sub_path,
+                         backend=backend)
+        self.location_id = location_id
+        self.sub_path = sub_path
+        self.backend = backend
+
+    async def init(self, ctx: JobContext):
+        db = ctx.db
+        from ..locations.file_path_helper import load_location
+        loc = load_location(db, self.location_id)
+        sub_mat = sub_path_children_mat(self.location_id, self.sub_path)
+        where, params = orphan_filters(self.location_id, 0, sub_mat)
+        count = db.query_one(
+            f"SELECT COUNT(*) AS n FROM file_path WHERE {where}", params)["n"]
+        if count == 0:
+            raise EarlyFinish("no orphan file paths")
+        data = {
+            "location_path": loc["path"],
+            "sub_mat_path": sub_mat,
+            "cursor": 0,
+            "linked": 0, "created": 0, "skipped": 0, "total_orphans": count,
+        }
+        steps = [{"chunk": i} for i in range(-(-count // CHUNK_SIZE))]
+        ctx.progress(task_count=len(steps),
+                     message=f"identifying {count} orphan paths")
+        return data, steps
+
+    async def execute_step(self, ctx, data, step, step_number):
+        return await asyncio.to_thread(self._step, ctx, data)
+
+    def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
+        db, sync = ctx.db, ctx.library.sync
+        where, params = orphan_filters(
+            self.location_id, data["cursor"], data["sub_mat_path"])
+        rows = [dict(r) for r in db.query(
+            f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
+            params + [CHUNK_SIZE])]
+        if not rows:
+            return StepOutcome()
+        loc_path = data["location_path"]
+        files: List[Tuple[str, int]] = []
+        for r in rows:
+            iso = IsolatedPath.from_db_row(
+                self.location_id, False, r["materialized_path"],
+                r["name"] or "", r["extension"] or "")
+            size = int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
+            files.append((iso.join_on(loc_path), size))
+
+        # ---- batched hashing (the TPU-fed kernel) ----
+        ids, errors = cas_ids_for_files(files, backend=self.backend)
+        kinds = {
+            i: int(resolve_kind(files[i][0], ext=rows[i]["extension"] or ""))
+            for i in ids
+        }
+
+        # ---- 1. write cas_ids through sync (mod.rs:144-165) ----
+        ops = []
+        with db.tx() as conn:
+            for i, cas_id in ids.items():
+                conn.execute(
+                    "UPDATE file_path SET cas_id = ? WHERE id = ?",
+                    (cas_id, rows[i]["id"]))
+                ops.append(sync.shared_update(
+                    "file_path", rows[i]["pub_id"], "cas_id", cas_id))
+            sync._insert_op_rows(conn, ops)
+
+        # ---- 2. link to existing objects by cas_id (mod.rs:167-225) ----
+        cas_list = sorted({c for c in ids.values() if c})
+        existing: Dict[str, Tuple[int, bytes]] = {}
+        if cas_list:
+            ph = ",".join("?" for _ in cas_list)
+            for r in db.query(
+                f"SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
+                f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
+                f"WHERE fp.cas_id IN ({ph})", cas_list):
+                existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
+        linked = 0
+        ops = []
+        with db.tx() as conn:
+            for i, cas_id in ids.items():
+                if cas_id is None or cas_id not in existing:
+                    continue
+                oid, opub = existing[cas_id]
+                conn.execute(
+                    "UPDATE file_path SET object_id = ? WHERE id = ?",
+                    (oid, rows[i]["id"]))
+                ops.append(sync.shared_update(
+                    "file_path", rows[i]["pub_id"], "object_id", opub))
+                linked += 1
+            sync._insert_op_rows(conn, ops)
+
+        # ---- 3. create objects for the rest (mod.rs:231-331) ----
+        need_new = [i for i, c in ids.items()
+                    if c is None or c not in existing]
+        created = 0
+        ops = []
+        with db.tx() as conn:
+            by_cas: Dict[str, Tuple[int, bytes]] = {}
+            for i in need_new:
+                cas_id = ids[i]
+                if cas_id is not None and cas_id in by_cas:
+                    oid, opub = by_cas[cas_id]  # same-chunk duplicate
+                else:
+                    opub = uuidlib.uuid4().bytes
+                    date_created = rows[i]["date_created"]
+                    oid = conn.execute(
+                        "INSERT INTO object (pub_id, kind, date_created) "
+                        "VALUES (?, ?, ?)",
+                        (opub, kinds[i], date_created)).lastrowid
+                    ops.extend(sync.shared_create(
+                        "object", opub,
+                        {"kind": kinds[i], "date_created": date_created}))
+                    created += 1
+                    if cas_id is not None:
+                        by_cas[cas_id] = (oid, opub)
+                conn.execute(
+                    "UPDATE file_path SET object_id = ? WHERE id = ?",
+                    (oid, rows[i]["id"]))
+                ops.append(sync.shared_update(
+                    "file_path", rows[i]["pub_id"], "object_id", opub))
+            sync._insert_op_rows(conn, ops)
+        if ops:
+            sync._notify_created()
+
+        data["cursor"] = rows[-1]["id"] + 1
+        data["linked"] += linked
+        data["created"] += created
+        data["skipped"] += len(errors)
+        ctx.progress(message=(
+            f"identified {data['linked'] + data['created']} of "
+            f"{data['total_orphans']} paths"))
+        return StepOutcome(
+            errors=[e for e in errors.values()],
+            metadata={
+                "total_objects_linked": data["linked"],
+                "total_objects_created": data["created"],
+                "total_skipped": data["skipped"],
+                "cursor": data["cursor"],
+            },
+        )
+
+    async def finalize(self, ctx, data, metadata):
+        return metadata

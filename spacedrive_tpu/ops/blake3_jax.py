@@ -23,7 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import cas
-from .blake3_batch import CHUNK_LEN, WORDS_PER_CHUNK
+from .blake3_batch import (  # noqa: F401 — re-exported for callers
+    CHUNK_LEN,
+    WORDS_PER_CHUNK,
+    build_cas_messages,
+    digests_to_cas_ids,
+    digests_to_hex,
+)
 
 # Canonical chunk-grid sizes for the two CAS modes.
 LARGE_MSG_LEN = cas.SIZE_PREFIX_LEN + cas.LARGE_PAYLOAD_SIZE  # 57352
@@ -115,53 +121,6 @@ def make_sharded_blake3(mesh, axis: str = "data"):
             out_specs=P(axis),
         )(_blake3_impl)
     )
-
-
-# ---------------------------------------------------------------------------
-# Host-side message building for the CAS pipeline.
-
-
-def build_cas_messages(payloads: np.ndarray, sizes: np.ndarray, payload_lens=None):
-    """Prefix payload rows with the 8-byte LE file size and pack to words.
-
-    payloads: [B, P] uint8, zero-padded past each row's payload length.
-    sizes:    [B] uint64 — true file sizes (hashed as the prefix).
-    payload_lens: [B] — bytes of real payload per row (default: P).
-
-    Returns (words [B, C, 256] uint32, lengths [B] int32) where C is the
-    grid for P (57 for the large-file mode, 101 for small).
-    """
-    payloads = np.ascontiguousarray(payloads, dtype=np.uint8)
-    B, P = payloads.shape
-    if payload_lens is None:
-        payload_lens = np.full((B,), P, dtype=np.int32)
-    else:
-        # Zero stale bytes past each row's payload: the compression always
-        # consumes full 16-word blocks (block_len only clips the count), so
-        # a reused buffer with residue would silently change the digest.
-        payload_lens = np.asarray(payload_lens, dtype=np.int32)
-        mask = np.arange(P, dtype=np.int32)[None, :] < payload_lens[:, None]
-        payloads = np.where(mask, payloads, 0).astype(np.uint8)
-    msg_len = cas.SIZE_PREFIX_LEN + P
-    C = max(1, -(-msg_len // CHUNK_LEN))
-    buf = np.zeros((B, C * CHUNK_LEN), dtype=np.uint8)
-    buf[:, : cas.SIZE_PREFIX_LEN] = (
-        np.asarray(sizes, dtype="<u8").reshape(B, 1).view(np.uint8)
-    )
-    buf[:, cas.SIZE_PREFIX_LEN : cas.SIZE_PREFIX_LEN + P] = payloads
-    lengths = (cas.SIZE_PREFIX_LEN + np.asarray(payload_lens, dtype=np.int32))
-    return buf.view("<u4").reshape(B, C, WORDS_PER_CHUNK), lengths
-
-
-def digests_to_cas_ids(digests) -> list:
-    """[B, 8] uint32 device digests → 16-hex-char CAS IDs."""
-    le = np.asarray(digests).astype("<u4")
-    return [le[i].tobytes()[:8].hex() for i in range(le.shape[0])]
-
-
-def digests_to_hex(digests) -> list:
-    le = np.asarray(digests).astype("<u4")
-    return [le[i].tobytes().hex() for i in range(le.shape[0])]
 
 
 def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=blake3_words) -> list:

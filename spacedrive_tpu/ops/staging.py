@@ -1,0 +1,206 @@
+"""CAS staging: files → dense payload batches → CAS IDs, per backend.
+
+This is the feeding layer between the filesystem and the batched BLAKE3
+backends (SURVEY.md §7 phase 5 / hard-part 2 "feeding the beast"): the
+reference hashes one file at a time inside per-file async tasks
+(/root/reference/core/src/object/file_identifier/mod.rs:107-134 →
+core/src/object/cas.rs:23-62); here whole batches are staged into dense
+arrays and hashed at once.
+
+Size classes keep device grids canonical (two compiled shapes only):
+- LARGE (> 100 KiB): exactly 57,344 sampled bytes per row → [B, 57344].
+- SMALL (≤ 100 KiB): whole file, zero-padded → [B, 102400] with lens.
+Empty files get no CAS ID (cas_id = None), matching FileMetadata::new
+(mod.rs:80-88).
+
+Backends:
+- "oracle": streaming pure-Python blake3 per file (the parity oracle).
+- "numpy":  batched pad-and-mask blake3 on CPU.
+- "jax":    the jitted device path (TPU when available).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import cas
+
+_STAGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _STAGE_POOL
+    if _STAGE_POOL is None:
+        _STAGE_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, (os.cpu_count() or 4) * 2),
+            thread_name_prefix="cas-stage",
+        )
+    return _STAGE_POOL
+
+
+@dataclass
+class StagedBatch:
+    """Dense payload arrays for one size class."""
+
+    indexes: List[int]          # positions in the caller's file list
+    payloads: np.ndarray        # [B, P] uint8, zero-padded
+    sizes: np.ndarray           # [B] uint64 declared file sizes
+    payload_lens: np.ndarray    # [B] int32 real payload bytes per row
+
+
+def _read_large(path: str, size: int, out: np.ndarray) -> None:
+    """Sampled read into a 57,344-byte row (cas.rs:23-59 spec)."""
+    with open(path, "rb") as f:
+        pos = 0
+        spec = cas.sample_spec(size)
+        for offset, length in spec[:-1]:
+            f.seek(offset)
+            chunk = f.read(length)
+            if len(chunk) != length:
+                raise EOFError(f"{path}: short read at {offset}")
+            out[pos:pos + length] = np.frombuffer(chunk, dtype=np.uint8)
+            pos += length
+        f.seek(-cas.HEADER_OR_FOOTER_SIZE, os.SEEK_END)
+        chunk = f.read(cas.HEADER_OR_FOOTER_SIZE)
+        if len(chunk) != cas.HEADER_OR_FOOTER_SIZE:
+            raise EOFError(f"{path}: short footer read")
+        out[pos:pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+
+
+def stage_files(
+    files: Sequence[Tuple[str, int]],
+) -> Tuple[StagedBatch, StagedBatch, List[int], Dict[int, str]]:
+    """Stage (path, size) pairs into dense per-class batches.
+
+    Returns (large_batch, small_batch, empty_indexes, errors) where
+    errors maps file index → message (unreadable files are skipped, the
+    caller records them as non-fatal job errors — JobRunErrors semantics).
+    """
+    large_idx = [i for i, (_, s) in enumerate(files)
+                 if s > cas.MINIMUM_FILE_SIZE]
+    small_idx = [i for i, (_, s) in enumerate(files)
+                 if 0 < s <= cas.MINIMUM_FILE_SIZE]
+    empty_idx = [i for i, (_, s) in enumerate(files) if s == 0]
+    errors: Dict[int, str] = {}
+
+    large = np.zeros((len(large_idx), cas.LARGE_PAYLOAD_SIZE), dtype=np.uint8)
+    small = np.zeros((len(small_idx), cas.MINIMUM_FILE_SIZE), dtype=np.uint8)
+    small_lens = np.zeros((len(small_idx),), dtype=np.int32)
+
+    def read_one(kind: str, row: int, idx: int) -> None:
+        path, size = files[idx]
+        try:
+            if kind == "large":
+                _read_large(path, size, large[row])
+            else:
+                with open(path, "rb") as f:
+                    data = f.read(cas.MINIMUM_FILE_SIZE + 1)
+                if len(data) > cas.MINIMUM_FILE_SIZE:
+                    raise EOFError(
+                        f"{path}: grew past declared size {size}")
+                small[row, :len(data)] = np.frombuffer(data, dtype=np.uint8)
+                small_lens[row] = len(data)
+        except OSError as e:
+            errors[idx] = f"{path}: {e}"
+        except EOFError as e:
+            errors[idx] = str(e)
+
+    futures = [
+        _pool().submit(read_one, "large", row, idx)
+        for row, idx in enumerate(large_idx)
+    ] + [
+        _pool().submit(read_one, "small", row, idx)
+        for row, idx in enumerate(small_idx)
+    ]
+    for fut in futures:
+        fut.result()
+
+    sizes = np.array([s for _, s in files], dtype=np.uint64)
+    large_batch = StagedBatch(
+        large_idx, large, sizes[large_idx] if large_idx else
+        np.zeros((0,), np.uint64),
+        np.full((len(large_idx),), cas.LARGE_PAYLOAD_SIZE, dtype=np.int32))
+    small_batch = StagedBatch(
+        small_idx, small, sizes[small_idx] if small_idx else
+        np.zeros((0,), np.uint64), small_lens)
+    return large_batch, small_batch, empty_idx, errors
+
+
+# -- backends --------------------------------------------------------------
+
+
+def _cas_ids_oracle(files, large, small) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for batch in (large, small):
+        for row, idx in enumerate(batch.indexes):
+            payload = batch.payloads[row, :batch.payload_lens[row]].tobytes()
+            out[idx] = cas.cas_id_of_payload(int(batch.sizes[row]), payload)
+    return out
+
+
+def _cas_ids_numpy(files, large, small) -> Dict[int, str]:
+    # Deliberately jax-free: this is the fallback when jax is unavailable.
+    from . import blake3_batch as bb
+    out: Dict[int, str] = {}
+    for batch in (large, small):
+        if not batch.indexes:
+            continue
+        words, lengths = bb.build_cas_messages(
+            batch.payloads, batch.sizes, batch.payload_lens)
+        cvs = bb.blake3_batch(np, words, lengths)
+        digests = np.stack(cvs, axis=1)
+        for row, cid in enumerate(bb.digests_to_cas_ids(digests)):
+            out[batch.indexes[row]] = cid
+    return out
+
+
+def _cas_ids_jax(files, large, small) -> Dict[int, str]:
+    from .blake3_jax import cas_ids_jax
+    out: Dict[int, str] = {}
+    for batch in (large, small):
+        if not batch.indexes:
+            continue
+        ids = cas_ids_jax(batch.payloads, batch.sizes, batch.payload_lens)
+        out.update(zip(batch.indexes, ids))
+    return out
+
+
+_BACKENDS = {
+    "oracle": _cas_ids_oracle,
+    "numpy": _cas_ids_numpy,
+    "jax": _cas_ids_jax,
+}
+
+
+def default_backend() -> str:
+    """"jax" when an accelerator (or any usable jax backend) is importable,
+    else the batched numpy path."""
+    try:
+        import jax  # noqa: F401
+        return "jax"
+    except Exception:
+        return "numpy"
+
+
+def cas_ids_for_files(
+    files: Sequence[Tuple[str, int]], backend: str = "auto",
+) -> Tuple[Dict[int, Optional[str]], Dict[int, str]]:
+    """(path, size) pairs → {index: cas_id | None for empty}, {index: error}.
+
+    The identifier job's per-chunk kernel: stage + batch hash + format.
+    """
+    if backend == "auto":
+        backend = default_backend()
+    large, small, empty_idx, errors = stage_files(files)
+    ids: Dict[int, Optional[str]] = dict(
+        _BACKENDS[backend](files, large, small))
+    for idx in empty_idx:
+        ids[idx] = None  # "We can't do shit with empty files" (mod.rs:86)
+    for idx in errors:
+        ids.pop(idx, None)
+    return ids, errors

@@ -1,0 +1,235 @@
+"""End-to-end VDFS slice: node → library → location → indexer →
+identifier → media processor, with CAS parity against the oracle.
+
+This is SURVEY.md §7's "minimum end-to-end slice" as a test. Hashing uses
+the batched numpy backend (same algorithm as the device path; the jax
+backend is exercised in test_blake3_jax.py / the driver's compile check).
+"""
+
+import asyncio
+import os
+import uuid
+
+import pytest
+
+from spacedrive_tpu.jobs.report import JobStatus
+from spacedrive_tpu.locations.manager import (
+    LocationError,
+    create_location,
+    delete_location,
+    scan_location,
+)
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.ops.cas import generate_cas_id
+from spacedrive_tpu.files import ObjectKind
+
+
+def _corpus(root):
+    os.makedirs(f"{root}/docs", exist_ok=True)
+    os.makedirs(f"{root}/photos", exist_ok=True)
+    rng = __import__("random").Random(7)
+    # small file (oracle whole-file path)
+    with open(f"{root}/docs/small.txt", "wb") as f:
+        f.write(bytes(rng.randrange(256) for _ in range(5000)))
+    # large file (sampled path) — >100 KiB
+    with open(f"{root}/docs/large.bin", "wb") as f:
+        f.write(bytes(rng.randrange(256) for _ in range(150_000)))
+    # exact duplicate of the large file in another dir
+    with open(f"{root}/photos/large_copy.bin", "wb") as f:
+        with open(f"{root}/docs/large.bin", "rb") as src:
+            f.write(src.read())
+    # an empty file (no cas_id, still gets an object)
+    open(f"{root}/docs/empty", "wb").close()
+    # a real png for the media pass
+    from PIL import Image
+    Image.new("RGB", (64, 48), (200, 10, 10)).save(f"{root}/photos/red.png")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def env(tmp_path):
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    _corpus(str(corpus))
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("test")
+    return node, lib, str(corpus)
+
+
+def test_full_scan_chain(env):
+    node, lib, corpus = env
+
+    async def main():
+        loc_id = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc_id, backend="numpy")
+        await node.jobs.wait_idle()
+        return loc_id
+
+    loc_id = _run(main())
+    db = lib.db
+
+    # All five files indexed (+ 2 dirs + root-less entries).
+    files = db.query("SELECT * FROM file_path WHERE is_dir = 0")
+    assert len(files) == 5
+
+    # CAS parity with the oracle on every non-empty file.
+    for r in files:
+        rel = f"{r['materialized_path'][1:]}{r['name']}" + (
+            f".{r['extension']}" if r["extension"] else "")
+        full = os.path.join(corpus, rel)
+        size = os.path.getsize(full)
+        if size == 0:
+            assert r["cas_id"] is None
+        else:
+            assert r["cas_id"] == generate_cas_id(full, size), rel
+
+    # Every file got an object; duplicates share one.
+    assert all(r["object_id"] is not None for r in files)
+    large = db.query_one(
+        "SELECT object_id FROM file_path WHERE name = 'large'")
+    copy = db.query_one(
+        "SELECT object_id FROM file_path WHERE name = 'large_copy'")
+    assert large["object_id"] == copy["object_id"]
+    objects = db.query("SELECT * FROM object")
+    assert len(objects) == 4  # 5 files, 2 sharing one object
+
+    # Kinds resolved: png → IMAGE, txt → TEXT.
+    png = db.query_one(
+        "SELECT o.kind FROM object o JOIN file_path fp ON fp.object_id=o.id "
+        "WHERE fp.name = 'red'")
+    assert png["kind"] == int(ObjectKind.IMAGE)
+    txt = db.query_one(
+        "SELECT o.kind FROM object o JOIN file_path fp ON fp.object_id=o.id "
+        "WHERE fp.name = 'small'")
+    assert txt["kind"] == int(ObjectKind.TEXT)
+
+    # Media pass: media_data row + sharded webp thumbnail for the png.
+    md = db.query_one("SELECT * FROM media_data")
+    assert md is not None
+    png_row = db.query_one("SELECT cas_id FROM file_path WHERE name='red'")
+    from spacedrive_tpu.media.thumbnail import thumbnail_path
+    assert os.path.exists(thumbnail_path(node.data_dir, png_row["cas_id"]))
+
+    # Sync ops were emitted for every write path.
+    n_ops = db.query_one("SELECT COUNT(*) AS n FROM shared_operation")["n"]
+    assert n_ops > len(files)
+
+    # Statistics aggregate.
+    stats = lib.statistics()
+    assert stats["total_object_count"] == 4
+    assert int(stats["total_bytes_used"]) > int(stats["total_unique_bytes"])
+
+
+def test_rescan_is_idempotent(env):
+    node, lib, corpus = env
+
+    async def main():
+        loc_id = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc_id, backend="numpy",
+                            with_media=False)
+        await node.jobs.wait_idle()
+        counts1 = (
+            lib.db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"],
+            lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"],
+        )
+        # Second scan: indexer EarlyFinishes (or no-ops), identifier finds
+        # no orphans, nothing duplicates.
+        await scan_location(node.jobs, lib, loc_id, backend="numpy",
+                            with_media=False)
+        await node.jobs.wait_idle()
+        counts2 = (
+            lib.db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"],
+            lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"],
+        )
+        assert counts1 == counts2
+    _run(main())
+
+
+def test_validator_job(env):
+    node, lib, corpus = env
+
+    async def main():
+        loc_id = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc_id, backend="numpy",
+                            with_media=False)
+        await node.jobs.wait_idle()
+        from spacedrive_tpu.objects.validator import ObjectValidatorJob
+        jid = await node.jobs.ingest(
+            lib, ObjectValidatorJob(location_id=loc_id))
+        status = await node.jobs.wait(jid)
+        assert status == JobStatus.COMPLETED
+    _run(main())
+
+    from spacedrive_tpu.ops.cas import file_checksum
+    rows = lib.db.query(
+        "SELECT * FROM file_path WHERE is_dir = 0")
+    for r in rows:
+        rel = f"{r['materialized_path'][1:]}{r['name']}" + (
+            f".{r['extension']}" if r["extension"] else "")
+        assert r["integrity_checksum"] == \
+            file_checksum(os.path.join(corpus, rel))
+
+
+def test_orphan_remover(env):
+    node, lib, corpus = env
+
+    async def main():
+        loc_id = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc_id, backend="numpy",
+                            with_media=False)
+        await node.jobs.wait_idle()
+        return loc_id
+    loc_id = _run(main())
+    # Delete the location → file_paths cascade → objects orphaned.
+    delete_location(lib, loc_id)
+    assert lib.db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"] == 0
+    remover = node.orphan_removers[lib.id]
+    removed = remover.invoke()
+    assert removed == 4
+    assert lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"] == 0
+
+
+def test_location_overlap_rejected(env):
+    node, lib, corpus = env
+    create_location(lib, corpus)
+    with pytest.raises(LocationError):
+        create_location(lib, corpus)
+    with pytest.raises(LocationError):
+        create_location(lib, os.path.join(corpus, "docs"))
+
+
+def test_cold_resume_after_kill(env):
+    """Pause the identifier mid-run, rebuild node, cold-resume, converge."""
+    node, lib, corpus = env
+
+    async def phase1():
+        loc_id = create_location(lib, corpus)
+        # Index only first.
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+        jid = await node.jobs.ingest(lib, IndexerJob(location_id=loc_id))
+        await node.jobs.wait(jid)
+        # Start identifier and immediately shut down (pauses it).
+        from spacedrive_tpu.objects.identifier import FileIdentifierJob
+        jid2 = await node.jobs.ingest(
+            lib, FileIdentifierJob(location_id=loc_id, backend="numpy"))
+        await node.jobs.shutdown()
+        return loc_id, jid2
+
+    loc_id, jid2 = _run(phase1())
+
+    # "Process death": fresh Node over the same data dir.
+    node2 = Node(node.data_dir)
+
+    async def phase2():
+        await node2.start()
+        lib2 = node2.libraries.list()[0]
+        await node2.jobs.wait_idle()
+        return lib2
+
+    lib2 = _run(phase2())
+    files = lib2.db.query("SELECT * FROM file_path WHERE is_dir = 0")
+    assert len(files) == 5
+    assert all(r["object_id"] is not None for r in files)
