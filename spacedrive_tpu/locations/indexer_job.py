@@ -77,8 +77,9 @@ def make_db_fetchers(db, location_id: int):
         if children_mat is None:
             return []
         rows = db.query(
-            "SELECT pub_id, cas_id, materialized_path, name, extension "
-            "FROM file_path WHERE location_id = ? AND materialized_path = ?",
+            "SELECT pub_id, cas_id, is_dir, materialized_path, name, "
+            "extension FROM file_path "
+            "WHERE location_id = ? AND materialized_path = ?",
             (location_id, children_mat))
         seen = {(p.materialized_path, p.name, p.extension)
                 for p in iso_paths}
@@ -87,6 +88,82 @@ def make_db_fetchers(db, location_id: int):
                 not in seen]
 
     return existing, to_remove
+
+
+# -- shared write choreography (used by the job steps AND shallow.py) ------
+
+SYNCED_UPDATE_FIELDS = ("inode", "size_in_bytes_bytes", "date_modified",
+                        "date_indexed", "is_dir")
+
+
+def save_file_path_rows(library, location_pub_id: bytes,
+                        rows: List[Dict[str, Any]]) -> int:
+    """Batched create through sync; replayed steps' unique collisions are
+    ignored (IS_BATCHED idempotency)."""
+    if not rows:
+        return 0
+    db, sync = library.db, library.sync
+    ops = []
+    for row in rows:
+        values = _row_sync_values(row)
+        values["location_id"] = location_pub_id  # FK syncs as pub_id
+        ops.extend(sync.shared_create("file_path", row["pub_id"], values))
+    with sync.write_ops(ops) as conn:
+        return db.insert_many("file_path", rows, conn=conn,
+                              ignore_conflicts=True)
+
+
+def update_file_path_rows(library, rows: List[Dict[str, Any]]) -> int:
+    if not rows:
+        return 0
+    db, sync = library.db, library.sync
+    ops = []
+    with db.tx() as conn:
+        for row in rows:
+            values = {k: row[k] for k in SYNCED_UPDATE_FIELDS}
+            db.update("file_path", row["pub_id"], values, conn=conn,
+                      id_col="pub_id")
+            for k, v in values.items():
+                ops.append(sync.shared_update(
+                    "file_path", row["pub_id"], k, v))
+        sync._insert_op_rows(conn, ops)
+    if ops:
+        sync._notify_created()
+    return len(rows)
+
+
+def remove_file_path_rows(library, location_id: int,
+                          removed: List[Dict[str, Any]]) -> int:
+    """Delete stale rows; a removed DIRECTORY also deletes every
+    descendant row by materialized_path prefix (the walker only reports
+    the dir itself — without this, rm -rf'd subtrees leave ghost rows)."""
+    if not removed:
+        return 0
+    db, sync = library.db, library.sync
+    from .file_path_helper import materialized_like
+    ops = [sync.shared_delete("file_path", r["pub_id"]) for r in removed]
+    n = 0
+    with db.tx() as conn:
+        for r in removed:
+            if r.get("is_dir") and r.get("materialized_path") is not None:
+                children_mat = (f"{r['materialized_path']}{r['name']}/")
+                where, params = "location_id = ?", [location_id]
+                where = materialized_like(where, params, children_mat)
+                desc = conn.execute(
+                    f"SELECT pub_id FROM file_path WHERE {where}",
+                    params).fetchall()
+                for d in desc:
+                    ops.append(sync.shared_delete("file_path", d["pub_id"]))
+                cur = conn.execute(
+                    f"DELETE FROM file_path WHERE {where}", params)
+                n += cur.rowcount
+            conn.execute("DELETE FROM file_path WHERE pub_id = ?",
+                         (r["pub_id"],))
+            n += 1
+        sync._insert_op_rows(conn, ops)
+    if ops:
+        sync._notify_created()
+    return n
 
 
 @register_job
@@ -133,8 +210,9 @@ class IndexerJob(StatefulJob):
                           "parent": w.maybe_parent})
         if res.to_remove:
             steps.append({"kind": "remove",
-                          "rows": [{"pub_id": r["pub_id"]}
-                                   for r in res.to_remove]})
+                          "rows": [{k: r.get(k) for k in (
+                              "pub_id", "is_dir", "materialized_path",
+                              "name")} for r in res.to_remove]})
         for p, s in res.paths_and_sizes.items():
             data["dir_sizes"][p] = data["dir_sizes"].get(p, 0) + s
         return steps
@@ -187,47 +265,20 @@ class IndexerJob(StatefulJob):
         return StepOutcome(more_steps=more, errors=list(res.errors))
 
     def _save(self, ctx: JobContext, data, step) -> StepOutcome:
-        db, sync = ctx.db, ctx.library.sync
-        rows = step["rows"]
-        loc_pub = data["location_pub_id"]
-        ops = []
-        for row in rows:
-            values = _row_sync_values(row)
-            values["location_id"] = loc_pub  # FK syncs as pub_id
-            ops.extend(sync.shared_create("file_path", row["pub_id"], values))
-        with sync.write_ops(ops) as conn:
-            # Unique collisions (replayed step after pause) are ignored.
-            n = db.insert_many("file_path", rows, conn=conn,
-                               ignore_conflicts=True)
+        n = save_file_path_rows(
+            ctx.library, data["location_pub_id"], step["rows"])
         data["total_saved"] += n
         ctx.progress(message=f"saved {data['total_saved']} paths")
         return StepOutcome(metadata={"indexed_count": data["total_saved"]})
 
     def _update(self, ctx: JobContext, data, step) -> StepOutcome:
-        db, sync = ctx.db, ctx.library.sync
-        ops = []
-        with db.tx() as conn:
-            for row in step["rows"]:
-                values = {k: row[k] for k in (
-                    "inode", "size_in_bytes_bytes", "date_modified",
-                    "date_indexed", "is_dir")}
-                db.update("file_path", row["pub_id"], values, conn=conn,
-                          id_col="pub_id")
-                for k, v in values.items():
-                    ops.append(sync.shared_update(
-                        "file_path", row["pub_id"], k, v))
-            sync._insert_op_rows(conn, ops)
-        data["total_updated"] += len(step["rows"])
+        data["total_updated"] += update_file_path_rows(
+            ctx.library, step["rows"])
         return StepOutcome(metadata={"updated_count": data["total_updated"]})
 
     def _remove(self, ctx: JobContext, data, step) -> StepOutcome:
-        db, sync = ctx.db, ctx.library.sync
-        pub_ids = [r["pub_id"] for r in step["rows"]]
-        ops = [sync.shared_delete("file_path", p) for p in pub_ids]
-        with sync.write_ops(ops) as conn:
-            for p in pub_ids:
-                db.delete("file_path", p, conn=conn, id_col="pub_id")
-        data["total_removed"] += len(pub_ids)
+        data["total_removed"] += remove_file_path_rows(
+            ctx.library, self.location_id, step["rows"])
         return StepOutcome(metadata={"removed_count": data["total_removed"]})
 
     async def finalize(self, ctx: JobContext, data, metadata):

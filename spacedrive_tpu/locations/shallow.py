@@ -1,0 +1,84 @@
+"""light_scan_location: inline shallow index + identify of one directory.
+
+The reference's shallow variants (indexer/shallow.rs:26,
+file_identifier/shallow.rs:26, location/mod.rs:489) run inline rather
+than as jobs — they service watcher events and Explorer navigation where
+job-queue latency would be felt. Here the walker's shallow mode feeds the
+same save/update/remove writes the IndexerJob uses, then the identifier's
+chunk kernel runs over the new orphans in that one directory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..objects.identifier import CHUNK_SIZE, identify_chunk, orphan_filters
+from .file_path_helper import load_location
+from .indexer_job import (
+    _entry_to_row,
+    make_db_fetchers,
+    remove_file_path_rows,
+    save_file_path_rows,
+    update_file_path_rows,
+)
+from .paths import IsolatedPath
+from .rules import load_rules_for_location
+from .walker import Walker
+
+
+def light_scan_location(library, location_id: int,
+                        sub_path: Optional[str] = None,
+                        backend: str = "auto") -> dict:
+    """Shallow rescan of one directory: index changes + identify orphans.
+
+    Returns {"saved", "updated", "removed", "linked", "created", "errors"}.
+    """
+    db, sync = library.db, library.sync
+    loc = load_location(db, location_id)
+    location_path = loc["path"]
+    target = location_path
+    sub_iso = None
+    if sub_path:
+        sub_iso = IsolatedPath.from_relative(
+            location_id, sub_path.strip("/") + "/")
+        target = sub_iso.join_on(location_path)
+
+    rules = load_rules_for_location(db, location_id)
+    existing, to_remove = make_db_fetchers(db, location_id)
+    walker = Walker(location_id, location_path, rules=rules,
+                    existing_paths_fetcher=existing,
+                    to_remove_fetcher=to_remove)
+    res = walker.walk_single_dir(target, add_root=bool(sub_path))
+    errors = list(res.errors)
+
+    rows = [_entry_to_row(e, location_id) for e in res.walked]
+    save_file_path_rows(library, loc["pub_id"], rows)
+    upd = [_entry_to_row(e, location_id) for e in res.to_update]
+    update_file_path_rows(library, upd)
+    removed = remove_file_path_rows(library, location_id,
+                                    list(res.to_remove))
+
+    # identify new orphans in this directory only
+    sub_mat = sub_iso.materialized_path_for_children() if sub_iso else "/"
+    linked = created = 0
+    cursor = 0  # advances past unreadable rows so they can't loop forever
+    while True:
+        where, params = orphan_filters(location_id, cursor, None)
+        where += " AND materialized_path = ?"
+        params.append(sub_mat)
+        chunk = [dict(r) for r in db.query(
+            f"SELECT * FROM file_path WHERE {where} ORDER BY id LIMIT ?",
+            params + [CHUNK_SIZE])]
+        if not chunk:
+            break
+        lk, cr, errs = identify_chunk(
+            library, location_id, location_path, chunk, backend)
+        linked += lk
+        created += cr
+        errors.extend(errs)
+        cursor = chunk[-1]["id"] + 1
+        if len(chunk) < CHUNK_SIZE:
+            break
+
+    return {"saved": len(rows), "updated": len(upd), "removed": removed,
+            "linked": linked, "created": created, "errors": errors}

