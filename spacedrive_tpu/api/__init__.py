@@ -1,0 +1,3 @@
+from .router import Procedure, Router, RpcError, mount_router
+
+__all__ = ["Router", "Procedure", "RpcError", "mount_router"]

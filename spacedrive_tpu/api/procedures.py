@@ -1,0 +1,1046 @@
+"""Every API procedure, namespace by namespace.
+
+Parity target: the reference's rspc procedure inventory (SURVEY.md §2.1
+"rspc API"; names enumerated from /root/reference/core/src/api/*.rs —
+`keys.` is commented out there and therefore omitted here; `p2p.` mounts
+from the p2p module when it lands). Net-new additions beyond the
+reference: `search.duplicates` / `search.nearDuplicates` /
+`jobs.nearDupDetector` exposing the device dedup analytics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid as uuidlib
+from typing import Any, Dict, List, Optional
+
+from .. import backups as backups_mod
+from ..jobs.report import JobStatus
+from ..library import Library
+from ..locations import manager as loc_manager
+from ..locations.file_path_helper import materialized_like
+from ..locations.non_indexed import walk_ephemeral
+from ..locations.paths import IsolatedPath
+from ..locations.rules import IndexerRule, RuleKind, RulePerKind
+from ..media.exif import extract_media_data
+from ..store.db import uuid_bytes
+from ..volume import get_volumes
+from .router import Router, RpcError
+from .serialization import file_path_display, row_to_dict, rows_to_dicts
+
+BUILD_VERSION = "0.1.0"
+
+
+def register_all(router: Router) -> None:
+    _core(router)
+    _libraries(router)
+    _volumes(router)
+    _tags(router)
+    _categories(router)
+    _locations(router)
+    _files(router)
+    _jobs(router)
+    _search(router)
+    _sync(router)
+    _preferences(router)
+    _notifications(router)
+    _nodes(router)
+    _auth(router)
+    _backups(router)
+    _invalidation(router)
+
+
+# -- unscoped core (api/mod.rs buildInfo/nodeState/toggleFeatureFlag) ------
+
+def _core(r: Router) -> None:
+    @r.query("buildInfo")
+    def build_info(node, _input):
+        return {"version": BUILD_VERSION, "commit": "unknown"}
+
+    @r.query("nodeState")
+    def node_state(node, _input):
+        return {
+            "id": node.config.id.hex(),
+            "name": node.config.name,
+            "data_path": node.data_dir,
+            "features": node.config.features,
+        }
+
+    @r.mutation("toggleFeatureFlag")
+    def toggle_feature(node, input):
+        return node.config.toggle_feature(str(input["feature"]))
+
+
+# -- library. (api/libraries.rs) -------------------------------------------
+
+def _libraries(r: Router) -> None:
+    def _lib_info(lib: Library) -> Dict[str, Any]:
+        return {"uuid": str(lib.id), "config": lib.config.to_json()}
+
+    @r.query("library.list")
+    def lib_list(node, _input):
+        return [_lib_info(lib) for lib in node.libraries.list()]
+
+    @r.mutation("library.create", invalidates=["library.list"])
+    def lib_create(node, input):
+        lib = node.create_library(str(input["name"]))
+        return _lib_info(lib)
+
+    @r.mutation("library.edit", invalidates=["library.list"])
+    def lib_edit(node, input):
+        lib = node.libraries.edit(
+            uuidlib.UUID(str(input["id"])),
+            name=input.get("name"), description=input.get("description"))
+        return _lib_info(lib)
+
+    @r.mutation("library.delete", invalidates=["library.list"])
+    def lib_delete(node, input):
+        node.libraries.delete(uuidlib.UUID(str(input["id"])))
+        return None
+
+    @r.query("library.statistics", library=True)
+    def lib_statistics(node, library, _input):
+        return library.statistics()
+
+
+# -- volumes. --------------------------------------------------------------
+
+def _volumes(r: Router) -> None:
+    @r.query("volumes.list")
+    def volumes_list(node, _input):
+        return get_volumes()
+
+
+# -- tags. (api/tags.rs) ---------------------------------------------------
+
+def _tags(r: Router) -> None:
+    @r.query("tags.list", library=True)
+    def tags_list(node, library, _input):
+        return rows_to_dicts(library.db.query("SELECT * FROM tag"))
+
+    @r.query("tags.get", library=True)
+    def tags_get(node, library, input):
+        row = library.db.query_one(
+            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        return row_to_dict(row) if row else None
+
+    @r.query("tags.getForObject", library=True)
+    def tags_for_object(node, library, input):
+        return rows_to_dicts(library.db.query(
+            "SELECT t.* FROM tag t JOIN tag_on_object to2 "
+            "ON to2.tag_id = t.id WHERE to2.object_id = ?",
+            (int(input["object_id"]),)))
+
+    @r.query("tags.getWithObjects", library=True)
+    def tags_with_objects(node, library, input):
+        tags = rows_to_dicts(library.db.query("SELECT * FROM tag"))
+        for t in tags:
+            t["object_ids"] = [
+                row["object_id"] for row in library.db.query(
+                    "SELECT object_id FROM tag_on_object WHERE tag_id = ?",
+                    (t["id"],))
+            ]
+        return tags
+
+    @r.mutation("tags.create", library=True, invalidates=["tags.list"])
+    def tags_create(node, library, input):
+        pub_id = uuid_bytes()
+        sync = library.sync
+        values = {"name": str(input["name"]),
+                  "color": input.get("color"),
+                  "date_created": int(time.time())}
+        with sync.write_ops(
+                sync.shared_create("tag", pub_id, values)) as conn:
+            tag_id = library.db.insert(
+                "tag", {"pub_id": pub_id, **values}, conn=conn)
+        return {"id": tag_id, "pub_id": pub_id.hex(), **values}
+
+    @r.mutation("tags.update", library=True, invalidates=["tags.list"])
+    def tags_update(node, library, input):
+        tag = library.db.query_one(
+            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        if tag is None:
+            raise RpcError("NOT_FOUND", "no such tag")
+        sync = library.sync
+        values = {k: input[k] for k in ("name", "color") if k in input}
+        ops = [sync.shared_update("tag", tag["pub_id"], k, v)
+               for k, v in values.items()]
+        with sync.write_ops(ops) as conn:
+            library.db.update("tag", tag["id"], values, conn=conn)
+        return None
+
+    @r.mutation("tags.delete", library=True, invalidates=["tags.list"])
+    def tags_delete(node, library, input):
+        tag = library.db.query_one(
+            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        if tag is None:
+            return None
+        sync = library.sync
+        with sync.write_ops(
+                [sync.shared_delete("tag", tag["pub_id"])]) as conn:
+            conn.execute("DELETE FROM tag_on_object WHERE tag_id = ?",
+                         (tag["id"],))
+            library.db.delete("tag", tag["id"], conn=conn)
+        return None
+
+    @r.mutation("tags.assign", library=True,
+                invalidates=["tags.getForObject"])
+    def tags_assign(node, library, input):
+        tag = library.db.query_one(
+            "SELECT * FROM tag WHERE id = ?", (int(input["tag_id"]),))
+        obj = library.db.query_one(
+            "SELECT * FROM object WHERE id = ?", (int(input["object_id"]),))
+        if tag is None or obj is None:
+            raise RpcError("NOT_FOUND", "tag or object missing")
+        sync = library.sync
+        if input.get("unassign"):
+            ops = [sync.relation_delete(
+                "tag_on_object", obj["pub_id"], tag["pub_id"])]
+            with sync.write_ops(ops) as conn:
+                conn.execute(
+                    "DELETE FROM tag_on_object WHERE tag_id = ? AND "
+                    "object_id = ?", (tag["id"], obj["id"]))
+        else:
+            ops = sync.relation_create(
+                "tag_on_object", obj["pub_id"], tag["pub_id"])
+            with sync.write_ops(ops) as conn:
+                conn.execute(
+                    "INSERT OR IGNORE INTO tag_on_object "
+                    "(tag_id, object_id) VALUES (?, ?)",
+                    (tag["id"], obj["id"]))
+        return None
+
+
+# -- categories. (api/categories.rs: object-kind counts) -------------------
+
+def _categories(r: Router) -> None:
+    @r.query("categories.list", library=True)
+    def categories_list(node, library, _input):
+        from ..files import ObjectKind
+        counts = {int(k): 0 for k in ObjectKind}
+        for row in library.db.query(
+                "SELECT kind, COUNT(*) AS n FROM object GROUP BY kind"):
+            if row["kind"] is not None:
+                counts[int(row["kind"])] = row["n"]
+        return {ObjectKind(k).name.title().replace("_", ""): n
+                for k, n in counts.items()}
+
+
+# -- locations. (api/locations.rs incl. indexer_rules sub-router) ----------
+
+def _locations(r: Router) -> None:
+    @r.query("locations.list", library=True)
+    def locations_list(node, library, _input):
+        return rows_to_dicts(library.db.query("SELECT * FROM location"))
+
+    @r.query("locations.get", library=True)
+    def locations_get(node, library, input):
+        row = library.db.query_one(
+            "SELECT * FROM location WHERE id = ?",
+            (int(input["location_id"]),))
+        return row_to_dict(row) if row else None
+
+    @r.query("locations.getWithRules", library=True)
+    def locations_get_with_rules(node, library, input):
+        row = library.db.query_one(
+            "SELECT * FROM location WHERE id = ?",
+            (int(input["location_id"]),))
+        if row is None:
+            return None
+        out = row_to_dict(row)
+        out["indexer_rules"] = rows_to_dicts(library.db.query(
+            "SELECT ir.* FROM indexer_rule ir "
+            "JOIN indexer_rule_in_location irl "
+            "ON irl.indexer_rule_id = ir.id WHERE irl.location_id = ?",
+            (row["id"],)))
+        return out
+
+    @r.mutation("locations.create", library=True,
+                invalidates=["locations.list"])
+    async def locations_create(node, library, input):
+        try:
+            loc_id = loc_manager.create_location(
+                library, str(input["path"]),
+                indexer_rule_ids=input.get("indexer_rules_ids", []),
+                name=input.get("name"))
+        except loc_manager.LocationError as e:
+            raise RpcError("BAD_REQUEST", str(e))
+        if input.get("dry_run"):
+            return loc_id
+        await loc_manager.scan_location(node.jobs, library, loc_id)
+        return loc_id
+
+    @r.mutation("locations.update", library=True,
+                invalidates=["locations.list"])
+    def locations_update(node, library, input):
+        loc = library.db.query_one(
+            "SELECT * FROM location WHERE id = ?", (int(input["id"]),))
+        if loc is None:
+            raise RpcError("NOT_FOUND", "no such location")
+        sync = library.sync
+        values = {k: input[k] for k in ("name", "hidden") if k in input}
+        ops = [sync.shared_update("location", loc["pub_id"], k, v)
+               for k, v in values.items()]
+        with sync.write_ops(ops) as conn:
+            library.db.update("location", loc["id"], values, conn=conn)
+        # rule re-attachment
+        if "indexer_rules_ids" in input:
+            with library.db.tx() as conn:
+                conn.execute(
+                    "DELETE FROM indexer_rule_in_location WHERE "
+                    "location_id = ?", (loc["id"],))
+                for rid in input["indexer_rules_ids"]:
+                    conn.execute(
+                        "INSERT OR IGNORE INTO indexer_rule_in_location "
+                        "(location_id, indexer_rule_id) VALUES (?, ?)",
+                        (loc["id"], int(rid)))
+        return None
+
+    @r.mutation("locations.delete", library=True,
+                invalidates=["locations.list"])
+    def locations_delete(node, library, input):
+        loc_manager.delete_location(library, int(input["location_id"]))
+        return None
+
+    @r.mutation("locations.relink", library=True,
+                invalidates=["locations.list"])
+    def locations_relink(node, library, input):
+        loc_manager.relink_location(
+            library, int(input["location_id"]), str(input["path"]))
+        return None
+
+    @r.mutation("locations.addLibrary", library=True,
+                invalidates=["locations.list"])
+    async def locations_add_library(node, library, input):
+        # Same as create, addressed at an explicit library (locations.rs).
+        return await locations_create(node, library, input)
+
+    @r.mutation("locations.fullRescan", library=True)
+    async def locations_full_rescan(node, library, input):
+        await loc_manager.scan_location(
+            node.jobs, library, int(input["location_id"]))
+        return None
+
+    @r.mutation("locations.quickRescan", library=True)
+    async def locations_quick_rescan(node, library, input):
+        from ..locations.shallow import light_scan_location
+        return await asyncio.to_thread(
+            light_scan_location, library, int(input["location_id"]),
+            input.get("sub_path") or None)
+
+    @r.mutation("locations.subPathRescan", library=True)
+    async def locations_sub_path_rescan(node, library, input):
+        await loc_manager.scan_location_sub_path(
+            node.jobs, library, int(input["location_id"]),
+            str(input.get("sub_path", "")))
+        return None
+
+    @r.query("locations.online", library=True)
+    def locations_online(node, library, _input):
+        out = []
+        for row in library.db.query("SELECT id, path FROM location"):
+            if row["path"] and os.path.isdir(row["path"]):
+                out.append(row["id"])
+        return out
+
+    @r.mutation("locations.createDirectory", library=True)
+    def locations_create_directory(node, library, input):
+        loc = library.db.query_one(
+            "SELECT path FROM location WHERE id = ?",
+            (int(input["location_id"]),))
+        if loc is None:
+            raise RpcError("NOT_FOUND", "no such location")
+        target = os.path.join(
+            loc["path"], str(input["sub_path"]).strip("/"))
+        os.makedirs(target, exist_ok=False)
+        return None
+
+    # indexer_rules sub-router (locations.rs mounts it under
+    # locations.indexer_rules.*)
+    @r.query("locations.indexer_rules.list", library=True)
+    def rules_list(node, library, _input):
+        return rows_to_dicts(
+            library.db.query("SELECT * FROM indexer_rule"))
+
+    @r.query("locations.indexer_rules.get", library=True)
+    def rules_get(node, library, input):
+        row = library.db.query_one(
+            "SELECT * FROM indexer_rule WHERE id = ?", (int(input["id"]),))
+        return row_to_dict(row) if row else None
+
+    @r.query("locations.indexer_rules.listForLocation", library=True)
+    def rules_for_location(node, library, input):
+        return rows_to_dicts(library.db.query(
+            "SELECT ir.* FROM indexer_rule ir "
+            "JOIN indexer_rule_in_location irl "
+            "ON irl.indexer_rule_id = ir.id WHERE irl.location_id = ?",
+            (int(input["location_id"]),)))
+
+    @r.mutation("locations.indexer_rules.create", library=True,
+                invalidates=["locations.indexer_rules.list"])
+    def rules_create(node, library, input):
+        rule = IndexerRule(
+            name=str(input["name"]),
+            rules=[RulePerKind(RuleKind(int(k)), tuple(params))
+                   for k, params in input["rules"]],
+        )
+        rid = library.db.insert("indexer_rule", {
+            "pub_id": uuid_bytes(),
+            "name": rule.name,
+            "default_rule": int(bool(input.get("default", False))),
+            "rules_per_kind": rule.serialize_rules(),
+            "date_created": int(time.time()),
+            "date_modified": int(time.time()),
+        })
+        return rid
+
+    @r.mutation("locations.indexer_rules.delete", library=True,
+                invalidates=["locations.indexer_rules.list"])
+    def rules_delete(node, library, input):
+        row = library.db.query_one(
+            "SELECT default_rule FROM indexer_rule WHERE id = ?",
+            (int(input["id"]),))
+        if row is None:
+            return None
+        if row["default_rule"]:
+            raise RpcError("BAD_REQUEST", "cannot delete a system rule")
+        library.db.delete("indexer_rule", int(input["id"]))
+        return None
+
+
+# -- files. (api/files.rs) -------------------------------------------------
+
+def _file_path_row(library, file_path_id: int):
+    row = library.db.query_one(
+        "SELECT * FROM file_path WHERE id = ?", (file_path_id,))
+    if row is None:
+        raise RpcError("NOT_FOUND", f"file_path {file_path_id} not found")
+    return row
+
+
+def _object_row(library, object_id: int):
+    row = library.db.query_one(
+        "SELECT * FROM object WHERE id = ?", (object_id,))
+    if row is None:
+        raise RpcError("NOT_FOUND", f"object {object_id} not found")
+    return row
+
+
+def _files(r: Router) -> None:
+    @r.query("files.get", library=True)
+    def files_get(node, library, input):
+        obj = library.db.query_one(
+            "SELECT * FROM object WHERE id = ?", (int(input["id"]),))
+        if obj is None:
+            return None
+        out = row_to_dict(obj)
+        out["file_paths"] = rows_to_dicts(library.db.query(
+            "SELECT * FROM file_path WHERE object_id = ?", (obj["id"],)))
+        md = library.db.query_one(
+            "SELECT * FROM media_data WHERE object_id = ?", (obj["id"],))
+        out["media_data"] = row_to_dict(md) if md else None
+        return out
+
+    @r.query("files.getPath", library=True)
+    def files_get_path(node, library, input):
+        row = _file_path_row(library, int(input["id"]))
+        loc = library.db.query_one(
+            "SELECT path FROM location WHERE id = ?", (row["location_id"],))
+        if loc is None or not loc["path"]:
+            return None
+        iso = IsolatedPath.from_db_row(
+            row["location_id"], bool(row["is_dir"]),
+            row["materialized_path"], row["name"] or "",
+            row["extension"] or "")
+        return iso.join_on(loc["path"])
+
+    @r.query("files.getMediaData", library=True)
+    def files_get_media_data(node, library, input):
+        md = library.db.query_one(
+            "SELECT * FROM media_data WHERE object_id = ?",
+            (int(input["id"]),))
+        return row_to_dict(md) if md else None
+
+    @r.query("files.getEphemeralMediaData")
+    def files_get_ephemeral_media_data(node, input):
+        return extract_media_data(str(input["path"]))
+
+    @r.mutation("files.setNote", library=True, invalidates=["search.objects"])
+    def files_set_note(node, library, input):
+        obj = _object_row(library, int(input["id"]))
+        sync = library.sync
+        note = input.get("note")
+        with sync.write_ops([sync.shared_update(
+                "object", obj["pub_id"], "note", note)]) as conn:
+            library.db.update("object", obj["id"], {"note": note}, conn=conn)
+        return None
+
+    @r.mutation("files.setFavorite", library=True,
+                invalidates=["search.objects"])
+    def files_set_favorite(node, library, input):
+        obj = _object_row(library, int(input["id"]))
+        sync = library.sync
+        fav = int(bool(input.get("favorite")))
+        with sync.write_ops([sync.shared_update(
+                "object", obj["pub_id"], "favorite", fav)]) as conn:
+            library.db.update("object", obj["id"], {"favorite": fav},
+                              conn=conn)
+        return None
+
+    @r.mutation("files.updateAccessTime", library=True)
+    def files_update_access_time(node, library, input):
+        now = int(time.time())
+        with library.db.tx() as conn:
+            for oid in input["ids"]:
+                conn.execute(
+                    "UPDATE object SET date_accessed = ? WHERE id = ?",
+                    (now, int(oid)))
+        return None
+
+    @r.mutation("files.removeAccessTime", library=True)
+    def files_remove_access_time(node, library, input):
+        with library.db.tx() as conn:
+            for oid in input["ids"]:
+                conn.execute(
+                    "UPDATE object SET date_accessed = NULL WHERE id = ?",
+                    (int(oid),))
+        return None
+
+    @r.mutation("files.renameFile", library=True,
+                invalidates=["search.paths"])
+    def files_rename(node, library, input):
+        row = _file_path_row(library, int(input["file_path_id"]))
+        loc = library.db.query_one(
+            "SELECT * FROM location WHERE id = ?", (row["location_id"],))
+        iso = IsolatedPath.from_db_row(
+            row["location_id"], bool(row["is_dir"]),
+            row["materialized_path"], row["name"] or "",
+            row["extension"] or "")
+        old_full = iso.join_on(loc["path"])
+        new_name = str(input["new_name"])
+        if "/" in new_name or "\x00" in new_name:
+            raise RpcError("BAD_REQUEST", "invalid file name")
+        new_full = os.path.join(os.path.dirname(old_full), new_name)
+        if os.path.exists(new_full):
+            raise RpcError("BAD_REQUEST", "target name already exists")
+        os.rename(old_full, new_full)
+        if row["is_dir"]:
+            name, ext = new_name, ""
+        else:
+            dot = new_name.rfind(".")
+            name, ext = (new_name, "") if dot <= 0 else \
+                (new_name[:dot], new_name[dot + 1:])
+        sync = library.sync
+        ops = [sync.shared_update("file_path", row["pub_id"], "name", name),
+               sync.shared_update("file_path", row["pub_id"], "extension",
+                                  ext)]
+        with sync.write_ops(ops) as conn:
+            library.db.update("file_path", row["id"],
+                              {"name": name, "extension": ext}, conn=conn)
+            if row["is_dir"]:
+                # descendants' materialized_path prefix changes too
+                old_mat = f"{row['materialized_path']}{row['name']}/"
+                new_mat = f"{row['materialized_path']}{name}/"
+                conn.execute(
+                    "UPDATE file_path SET materialized_path = "
+                    "REPLACE(materialized_path, ?, ?) WHERE location_id = ? "
+                    "AND materialized_path LIKE ? ESCAPE '\\'",
+                    (old_mat, new_mat, row["location_id"],
+                     old_mat.replace("\\", "\\\\").replace("%", r"\%")
+                     .replace("_", r"\_") + "%"))
+        return None
+
+    @r.mutation("files.createFolder", library=True,
+                invalidates=["search.paths"])
+    def files_create_folder(node, library, input):
+        loc = library.db.query_one(
+            "SELECT * FROM location WHERE id = ?",
+            (int(input["location_id"]),))
+        if loc is None:
+            raise RpcError("NOT_FOUND", "no such location")
+        target = os.path.join(loc["path"],
+                              str(input["sub_path"]).strip("/"),
+                              str(input["name"]))
+        os.makedirs(target, exist_ok=False)
+        from ..locations.shallow import light_scan_location
+        light_scan_location(library, loc["id"],
+                            str(input["sub_path"]).strip("/") or None)
+        return target
+
+    @r.mutation("files.createEphemeralFolder")
+    def files_create_ephemeral_folder(node, input):
+        target = os.path.join(str(input["path"]), str(input["name"]))
+        os.makedirs(target, exist_ok=False)
+        return target
+
+    async def _spawn_fs_job(node, library, job):
+        return (await node.jobs.ingest(library, job)).hex()
+
+    @r.mutation("files.deleteFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_delete(node, library, input):
+        from ..objects.fs_ops import FileDeleterJob
+        return await _spawn_fs_job(node, library, FileDeleterJob(
+            location_id=int(input["location_id"]),
+            file_path_ids=[int(i) for i in input["file_path_ids"]]))
+
+    @r.mutation("files.eraseFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_erase(node, library, input):
+        from ..objects.fs_ops import FileEraserJob
+        return await _spawn_fs_job(node, library, FileEraserJob(
+            location_id=int(input["location_id"]),
+            file_path_ids=[int(i) for i in input["file_path_ids"]],
+            passes=int(input.get("passes", 1))))
+
+    @r.mutation("files.copyFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_copy(node, library, input):
+        from ..objects.fs_ops import FileCopierJob
+        return await _spawn_fs_job(node, library, FileCopierJob(
+            location_id=int(input["source_location_id"]),
+            file_path_ids=[int(i) for i in input["sources_file_path_ids"]],
+            target_location_id=int(input["target_location_id"]),
+            target_relative_directory=str(
+                input.get("target_location_relative_directory_path", ""))))
+
+    @r.mutation("files.cutFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_cut(node, library, input):
+        from ..objects.fs_ops import FileCutterJob
+        return await _spawn_fs_job(node, library, FileCutterJob(
+            location_id=int(input["source_location_id"]),
+            file_path_ids=[int(i) for i in input["sources_file_path_ids"]],
+            target_location_id=int(input["target_location_id"]),
+            target_relative_directory=str(
+                input.get("target_location_relative_directory_path", ""))))
+
+    @r.mutation("files.duplicateFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_duplicate(node, library, input):
+        from ..objects.fs_ops import FileCopierJob
+        return await _spawn_fs_job(node, library, FileCopierJob(
+            location_id=int(input["location_id"]),
+            file_path_ids=[int(i) for i in input["file_path_ids"]],
+            target_location_id=int(input["location_id"]),
+            target_relative_directory=str(
+                input.get("target_relative_directory", ""))))
+
+    @r.query("files.getConvertableImageExtensions")
+    def files_convertable(node, _input):
+        return ["png", "jpeg", "jpg", "webp", "bmp", "gif", "tiff"]
+
+    @r.mutation("files.convertImage", library=True)
+    def files_convert_image(node, library, input):
+        row = _file_path_row(library, int(input["file_path_id"]))
+        loc = library.db.query_one(
+            "SELECT path FROM location WHERE id = ?", (row["location_id"],))
+        iso = IsolatedPath.from_db_row(
+            row["location_id"], bool(row["is_dir"]),
+            row["materialized_path"], row["name"] or "",
+            row["extension"] or "")
+        src = iso.join_on(loc["path"])
+        to_ext = str(input["to_extension"]).lower()
+        if to_ext not in ("png", "jpeg", "jpg", "webp", "bmp", "gif",
+                          "tiff"):
+            raise RpcError("BAD_REQUEST", f"unsupported target {to_ext}")
+        from PIL import Image
+        dst = os.path.splitext(src)[0] + "." + to_ext
+        if os.path.exists(dst):
+            from ..objects.fs_ops import find_available_filename_for_duplicate
+            dst = find_available_filename_for_duplicate(dst)
+        with Image.open(src) as im:
+            fmt = {"jpg": "JPEG"}.get(to_ext, to_ext.upper())
+            im.convert("RGB" if fmt == "JPEG" else im.mode).save(dst, fmt)
+        return dst
+
+
+# -- jobs. (api/jobs.rs) ---------------------------------------------------
+
+def _jobs(r: Router) -> None:
+    @r.query("jobs.reports", library=True)
+    def jobs_reports(node, library, _input):
+        rows = library.db.query(
+            "SELECT id, name, action, status, task_count, "
+            "completed_task_count, errors_text, metadata, parent_id, "
+            "date_created, date_started, date_completed, "
+            "date_estimated_completion FROM job "
+            "ORDER BY date_created DESC LIMIT 100")
+        return rows_to_dicts(rows)
+
+    @r.query("jobs.isActive", library=True)
+    def jobs_is_active(node, library, _input):
+        return bool(node.jobs.running)
+
+    @r.subscription("jobs.progress")
+    def jobs_progress(node, _input, emit):
+        def on_event(e):
+            if e.get("type") in ("JobProgress", "JobUpdate"):
+                emit(e)
+        return node.events.subscribe(on_event)
+
+    @r.subscription("jobs.newThumbnail")
+    def jobs_new_thumbnail(node, _input, emit):
+        def on_event(e):
+            if e.get("type") == "NewThumbnail":
+                emit(e)
+        return node.events.subscribe(on_event)
+
+    @r.mutation("jobs.pause", library=True, invalidates=["jobs.reports"])
+    def jobs_pause(node, library, input):
+        node.jobs.pause(bytes.fromhex(str(input["id"])))
+        return None
+
+    @r.mutation("jobs.resume", library=True, invalidates=["jobs.reports"])
+    async def jobs_resume(node, library, input):
+        await node.jobs.resume(library, bytes.fromhex(str(input["id"])))
+        return None
+
+    @r.mutation("jobs.cancel", library=True, invalidates=["jobs.reports"])
+    def jobs_cancel(node, library, input):
+        node.jobs.cancel(bytes.fromhex(str(input["id"])))
+        return None
+
+    @r.mutation("jobs.clear", library=True, invalidates=["jobs.reports"])
+    def jobs_clear(node, library, input):
+        library.db.execute(
+            "DELETE FROM job WHERE id = ? AND status NOT IN (?, ?, ?)",
+            (bytes.fromhex(str(input["id"])), int(JobStatus.RUNNING),
+             int(JobStatus.PAUSED), int(JobStatus.QUEUED)))
+        return None
+
+    @r.mutation("jobs.clearAll", library=True, invalidates=["jobs.reports"])
+    def jobs_clear_all(node, library, _input):
+        library.db.execute(
+            "DELETE FROM job WHERE status NOT IN (?, ?, ?)",
+            (int(JobStatus.RUNNING), int(JobStatus.PAUSED),
+             int(JobStatus.QUEUED)))
+        return None
+
+    @r.mutation("jobs.generateThumbsForLocation", library=True)
+    async def jobs_gen_thumbs(node, library, input):
+        from ..media.processor import MediaProcessorJob
+        jid = await node.jobs.ingest(library, MediaProcessorJob(
+            location_id=int(input["id"]),
+            sub_path=input.get("path") or None))
+        return jid.hex()
+
+    @r.mutation("jobs.objectValidator", library=True)
+    async def jobs_object_validator(node, library, input):
+        from ..objects.validator import ObjectValidatorJob
+        jid = await node.jobs.ingest(library, ObjectValidatorJob(
+            location_id=int(input["id"]),
+            sub_path=input.get("path") or None))
+        return jid.hex()
+
+    @r.mutation("jobs.identifyUniqueFiles", library=True)
+    async def jobs_identify(node, library, input):
+        from ..objects.identifier import FileIdentifierJob
+        jid = await node.jobs.ingest(library, FileIdentifierJob(
+            location_id=int(input["id"]),
+            sub_path=input.get("path") or None))
+        return jid.hex()
+
+    @r.mutation("jobs.nearDupDetector", library=True)
+    async def jobs_near_dup(node, library, input):
+        from ..objects.dedup import NearDupDetectorJob
+        jid = await node.jobs.ingest(library, NearDupDetectorJob(
+            location_id=int(input["id"]),
+            threshold=int(input.get("threshold", 10))))
+        return jid.hex()
+
+
+# -- search. (api/search.rs:364-750) ---------------------------------------
+
+def _search_paths_where(input) -> tuple:
+    where, params = "1=1", []
+    f = input.get("filter") or {}
+    if "location_id" in f:
+        where += " AND fp.location_id = ?"
+        params.append(int(f["location_id"]))
+    if f.get("search"):
+        where += " AND fp.name LIKE ?"
+        params.append(f"%{f['search']}%")
+    if "is_dir" in f:
+        where += " AND fp.is_dir = ?"
+        params.append(int(bool(f["is_dir"])))
+    if f.get("extension"):
+        where += " AND LOWER(fp.extension) = ?"
+        params.append(str(f["extension"]).lower())
+    if f.get("materialized_path"):
+        where += " AND fp.materialized_path = ?"
+        params.append(f["materialized_path"])
+    if f.get("object_kind"):
+        ph = ",".join("?" for _ in f["object_kind"])
+        where += (f" AND fp.object_id IN "
+                  f"(SELECT id FROM object WHERE kind IN ({ph}))")
+        params.extend(int(k) for k in f["object_kind"])
+    if f.get("tags"):
+        ph = ",".join("?" for _ in f["tags"])
+        where += (f" AND fp.object_id IN (SELECT object_id FROM "
+                  f"tag_on_object WHERE tag_id IN ({ph}))")
+        params.extend(int(t) for t in f["tags"])
+    return where, params
+
+
+def _search(r: Router) -> None:
+    @r.query("search.paths", library=True)
+    def search_paths(node, library, input):
+        input = input or {}
+        where, params = _search_paths_where(input)
+        take = min(int(input.get("take", 100)), 500)
+        cursor = int(input.get("cursor", 0))
+        rows = library.db.query(
+            f"SELECT fp.* FROM file_path fp WHERE {where} AND fp.id > ? "
+            f"ORDER BY fp.id LIMIT ?", params + [cursor, take])
+        items = rows_to_dicts(rows)
+        for it in items:
+            it["thumbnail_key"] = it.get("cas_id")
+        return {
+            "items": items,
+            "cursor": items[-1]["id"] if len(items) == take else None,
+        }
+
+    @r.query("search.pathsCount", library=True)
+    def search_paths_count(node, library, input):
+        where, params = _search_paths_where(input or {})
+        return library.db.query_one(
+            f"SELECT COUNT(*) AS n FROM file_path fp WHERE {where}",
+            params)["n"]
+
+    def _objects_where(input) -> tuple:
+        where, params = "1=1", []
+        f = (input or {}).get("filter") or {}
+        if f.get("favorite") is not None:
+            where += " AND o.favorite = ?"
+            params.append(int(bool(f["favorite"])))
+        if f.get("hidden") is not None:
+            where += " AND o.hidden = ?"
+            params.append(int(bool(f["hidden"])))
+        if f.get("kind"):
+            ph = ",".join("?" for _ in f["kind"])
+            where += f" AND o.kind IN ({ph})"
+            params.extend(int(k) for k in f["kind"])
+        if f.get("tags"):
+            ph = ",".join("?" for _ in f["tags"])
+            where += (f" AND o.id IN (SELECT object_id FROM tag_on_object "
+                      f"WHERE tag_id IN ({ph}))")
+            params.extend(int(t) for t in f["tags"])
+        return where, params
+
+    @r.query("search.objects", library=True)
+    def search_objects(node, library, input):
+        input = input or {}
+        where, params = _objects_where(input)
+        take = min(int(input.get("take", 100)), 500)
+        cursor = int(input.get("cursor", 0))
+        rows = library.db.query(
+            f"SELECT o.* FROM object o WHERE {where} AND o.id > ? "
+            f"ORDER BY o.id LIMIT ?", params + [cursor, take])
+        items = rows_to_dicts(rows)
+        for it in items:
+            fps = library.db.query(
+                "SELECT * FROM file_path WHERE object_id = ?", (it["id"],))
+            it["file_paths"] = rows_to_dicts(fps)
+        return {
+            "items": items,
+            "cursor": items[-1]["id"] if len(items) == take else None,
+        }
+
+    @r.query("search.objectsCount", library=True)
+    def search_objects_count(node, library, input):
+        where, params = _objects_where(input or {})
+        return library.db.query_one(
+            f"SELECT COUNT(*) AS n FROM object o WHERE {where}", params)["n"]
+
+    @r.query("search.ephemeralPaths")
+    def search_ephemeral(node, input):
+        path = str(input["path"])
+        if not os.path.isdir(path):
+            raise RpcError("BAD_REQUEST", f"{path} is not a directory")
+        return walk_ephemeral(
+            path, with_hidden_files=bool(input.get("with_hidden_files")))
+
+    # Net-new: device dedup analytics surfaces.
+    @r.query("search.duplicates", library=True)
+    def search_duplicates(node, library, input):
+        from ..objects.dedup import exact_duplicate_groups
+        return exact_duplicate_groups(
+            library, location_id=(input or {}).get("location_id"))
+
+    @r.query("search.nearDuplicates", library=True)
+    def search_near_duplicates(node, library, input):
+        from ..objects.dedup import near_duplicates
+        return near_duplicates(
+            library,
+            max_distance=int((input or {}).get("max_distance", 10)))
+
+
+# -- sync. (api/sync.rs) ---------------------------------------------------
+
+def _sync(r: Router) -> None:
+    @r.query("sync.messages", library=True)
+    def sync_messages(node, library, _input):
+        from ..sync.manager import GetOpsArgs
+        ops = library.sync.get_ops(GetOpsArgs(clocks=[], count=1000))
+        return [
+            {"instance": op.instance.hex(), "timestamp": op.timestamp,
+             "kind": op.typ.kind,
+             "model": getattr(op.typ, "model",
+                              getattr(op.typ, "relation", None))}
+            for op in ops
+        ]
+
+    @r.subscription("sync.newMessage", library=True)
+    def sync_new_message(node, library, _input, emit):
+        def cb():
+            emit({"type": "SyncMessageCreated"})
+        library.sync.on_created(cb)
+        return lambda: library.sync._on_created.remove(cb)
+
+
+# -- preferences. (api/preferences.rs; KV per library) ---------------------
+
+def _preferences(r: Router) -> None:
+    import msgpack
+
+    @r.query("preferences.get", library=True)
+    def preferences_get(node, library, _input):
+        out = {}
+        for row in library.db.query("SELECT * FROM preference"):
+            out[row["key"]] = msgpack.unpackb(row["value"], raw=False) \
+                if row["value"] else None
+        return out
+
+    @r.mutation("preferences.update", library=True,
+                invalidates=["preferences.get"])
+    def preferences_update(node, library, input):
+        with library.db.tx() as conn:
+            for k, v in (input.get("values") or {}).items():
+                if v is None:
+                    conn.execute(
+                        "DELETE FROM preference WHERE key = ?", (str(k),))
+                else:
+                    library.db.upsert(
+                        "preference", {"key": str(k)},
+                        {"value": msgpack.packb(v, use_bin_type=True)},
+                        conn=conn)
+        return None
+
+
+# -- notifications. (api/notifications.rs) ---------------------------------
+
+def _notifications(r: Router) -> None:
+    @r.query("notifications.get")
+    def notifications_get(node, _input):
+        out = []
+        for lib in node.libraries.list():
+            for row in lib.db.query(
+                    "SELECT * FROM notification ORDER BY id DESC LIMIT 50"):
+                d = row_to_dict(row)
+                d["library_id"] = str(lib.id)
+                out.append(d)
+        return out
+
+    @r.mutation("notifications.dismiss", library=True,
+                invalidates=["notifications.get"])
+    def notifications_dismiss(node, library, input):
+        library.db.execute(
+            "UPDATE notification SET read = 1 WHERE id = ?",
+            (int(input["id"]),))
+        return None
+
+    @r.mutation("notifications.dismissAll",
+                invalidates=["notifications.get"])
+    def notifications_dismiss_all(node, _input):
+        for lib in node.libraries.list():
+            lib.db.execute("UPDATE notification SET read = 1")
+        return None
+
+    @r.subscription("notifications.listen")
+    def notifications_listen(node, _input, emit):
+        def on_event(e):
+            if e.get("type") == "Notification":
+                emit(e)
+        return node.events.subscribe(on_event)
+
+    @r.mutation("notifications.test")
+    def notifications_test(node, _input):
+        node.events.emit({"type": "Notification",
+                          "data": {"kind": "test", "message": "test"}})
+        return None
+
+    @r.mutation("notifications.testLibrary", library=True)
+    def notifications_test_library(node, library, _input):
+        import msgpack
+        library.db.insert("notification", {
+            "data": msgpack.packb({"kind": "test"}, use_bin_type=True),
+        })
+        node.events.emit({"type": "Notification",
+                          "data": {"kind": "test",
+                                   "library_id": str(library.id)}})
+        return None
+
+
+# -- nodes. (api/nodes.rs) -------------------------------------------------
+
+def _nodes(r: Router) -> None:
+    @r.mutation("nodes.edit", invalidates=["nodeState"])
+    def nodes_edit(node, input):
+        if input.get("name"):
+            node.config.raw["name"] = str(input["name"])
+            node.config.save()
+        return None
+
+    @r.query("nodes.listLocations", library=True)
+    def nodes_list_locations(node, library, input):
+        return rows_to_dicts(library.db.query("SELECT * FROM location"))
+
+
+# -- auth. (api/auth.rs — OAuth device flow; offline stubs) ----------------
+
+def _auth(r: Router) -> None:
+    @r.query("auth.me")
+    def auth_me(node, _input):
+        raise RpcError("UNAUTHORIZED", "not logged in (offline build)")
+
+    @r.subscription("auth.loginSession")
+    def auth_login(node, _input, emit):
+        emit({"state": "Error", "message": "auth unavailable offline"})
+        return lambda: None
+
+
+# -- backups. (api/backups.rs) ---------------------------------------------
+
+def _backups(r: Router) -> None:
+    @r.query("backups.getAll")
+    def backups_get_all(node, _input):
+        return backups_mod.list_backups(node)
+
+    @r.mutation("backups.backup", library=True,
+                invalidates=["backups.getAll"])
+    async def backups_backup(node, library, _input):
+        return await asyncio.to_thread(backups_mod.do_backup, node, library)
+
+    @r.mutation("backups.restore", invalidates=["backups.getAll",
+                                                "library.list"])
+    async def backups_restore(node, input):
+        return await asyncio.to_thread(
+            backups_mod.restore_backup, node, str(input["backup_id"]))
+
+    @r.mutation("backups.delete", invalidates=["backups.getAll"])
+    def backups_delete(node, input):
+        return backups_mod.delete_backup(node, str(input["backup_id"]))
+
+
+# -- invalidation. (api/utils/invalidate.rs) -------------------------------
+
+def _invalidation(r: Router) -> None:
+    @r.subscription("invalidation.listen")
+    def invalidation_listen(node, _input, emit):
+        def on_event(e):
+            if e.get("type") == "InvalidateOperation":
+                emit(e)
+        return node.events.subscribe(on_event)

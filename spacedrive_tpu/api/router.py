@@ -1,0 +1,129 @@
+"""Typed RPC router: the framework's L3 API surface.
+
+Covers the role of the reference's rspc router
+(/root/reference/core/src/api/mod.rs:103-200): ~90 procedures in dotted
+namespaces, each a query / mutation / subscription, with library-scoped
+procedures resolved through middleware
+(core/src/api/utils/library.rs semantics: the input carries the library
+id, the handler receives the Library). Procedures are plain async
+functions; the transport (api/server.py websocket, or direct calls in
+tests) is independent of the router, mirroring how rspc mounts under
+axum, Tauri IPC, or the React-Native bridge.
+
+Query invalidation (core/src/api/utils/invalidate.rs): mutations declare
+which query keys they invalidate; the router emits
+CoreEvent::InvalidateOperation on the node event bus after success.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+class RpcError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class Procedure:
+    name: str
+    kind: str                      # query | mutation | subscription
+    handler: Callable
+    library_scoped: bool
+    invalidates: List[str] = field(default_factory=list)
+
+
+class Router:
+    def __init__(self, node):
+        self.node = node
+        self.procedures: Dict[str, Procedure] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def _register(self, name: str, kind: str, library: bool,
+                  invalidates: Optional[List[str]] = None):
+        def deco(fn):
+            assert name not in self.procedures, name
+            self.procedures[name] = Procedure(
+                name, kind, fn, library, list(invalidates or []))
+            return fn
+        return deco
+
+    def query(self, name, library=False):
+        return self._register(name, "query", library)
+
+    def mutation(self, name, library=False, invalidates=None):
+        return self._register(name, "mutation", library, invalidates)
+
+    def subscription(self, name, library=False):
+        return self._register(name, "subscription", library)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _resolve_library(self, input: Any):
+        if not isinstance(input, dict) or "library_id" not in input:
+            raise RpcError("BAD_REQUEST",
+                           "library-scoped procedure needs library_id")
+        try:
+            lib_id = uuidlib.UUID(str(input["library_id"]))
+        except ValueError:
+            raise RpcError("BAD_REQUEST", "invalid library_id")
+        lib = self.node.libraries.get(lib_id)
+        if lib is None:
+            raise RpcError("NOT_FOUND", f"library {lib_id} not loaded")
+        return lib
+
+    async def dispatch(self, path: str, input: Any = None) -> Any:
+        """Run a query or mutation; returns its JSON-safe result."""
+        proc = self.procedures.get(path)
+        if proc is None:
+            raise RpcError("NOT_FOUND", f"no such procedure: {path}")
+        if proc.kind == "subscription":
+            raise RpcError("BAD_REQUEST",
+                           f"{path} is a subscription; use subscribe()")
+        args = [self.node]
+        if proc.library_scoped:
+            args.append(self._resolve_library(input))
+        try:
+            result = proc.handler(*args, input)
+            if inspect.isawaitable(result):
+                result = await result
+        except RpcError:
+            raise
+        except (KeyError, ValueError) as e:
+            raise RpcError("BAD_REQUEST", str(e))
+        if proc.kind == "mutation" and proc.invalidates:
+            lib_id = (input or {}).get("library_id") \
+                if isinstance(input, dict) else None
+            for key in proc.invalidates:
+                self.node.events.invalidate_query(lib_id, key)
+        return result
+
+    async def subscribe(self, path: str, input: Any,
+                        emit: Callable[[Any], None]) -> Callable[[], None]:
+        """Start a subscription; returns an unsubscribe callable."""
+        proc = self.procedures.get(path)
+        if proc is None or proc.kind != "subscription":
+            raise RpcError("NOT_FOUND", f"no such subscription: {path}")
+        args = [self.node]
+        if proc.library_scoped:
+            args.append(self._resolve_library(input))
+        result = proc.handler(*args, input, emit)
+        if inspect.isawaitable(result):
+            result = await result
+        return result if callable(result) else (lambda: None)
+
+
+def mount_router(node) -> Router:
+    """Build the full router over a node (api/mod.rs:103-200's mount)."""
+    from . import procedures
+    router = Router(node)
+    procedures.register_all(router)
+    return router

@@ -1,0 +1,251 @@
+"""HTTP/websocket host: the framework's `apps/server` equivalent.
+
+Mirrors the reference's axum host (/root/reference/apps/server/src/main.rs:40-63):
+- `GET /health` — liveness;
+- `GET/WS /rspc` — the RPC transport (websocket JSON frames; HTTP GET/POST
+  for one-shot queries/mutations);
+- `GET /spacedrive/thumbnail/<cas_id>.webp` and
+  `GET /spacedrive/file/<library_id>/<location_id>/<file_path_id>` — the
+  custom_uri plane (core/src/custom_uri/mod.rs:149-330) serving
+  thumbnails and original files with HTTP Range support.
+
+Wire protocol (JSON frames over the websocket):
+  → {"id": 1, "type": "query"|"mutation", "path": "...", "input": {...}}
+  ← {"id": 1, "type": "response", "result": ...}
+  ← {"id": 1, "type": "error", "code": "...", "message": "..."}
+  → {"id": 2, "type": "subscription", "path": "...", "input": {...}}
+  ← {"id": 2, "type": "event", "data": ...}   (repeatedly)
+  → {"id": 2, "type": "subscriptionStop"}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import mimetypes
+import os
+from typing import Any, Dict, Optional
+
+from aiohttp import WSMsgType, web
+
+from ..locations.paths import IsolatedPath
+from ..media.thumbnail import thumbnail_path
+from .router import Router, RpcError, mount_router
+
+RANGE_CHUNK = 1 << 20
+
+
+class ApiServer:
+    def __init__(self, node, router: Optional[Router] = None):
+        self.node = node
+        self.router = router or mount_router(node)
+        self.app = web.Application()
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/rspc", self._rspc_ws)
+        self.app.router.add_post("/rspc/{path}", self._rspc_http)
+        self.app.router.add_get("/rspc/{path}", self._rspc_http)
+        self.app.router.add_get(
+            "/spacedrive/thumbnail/{cas_id}.webp", self._thumbnail)
+        self.app.router.add_get(
+            "/spacedrive/file/{library_id}/{location_id}/{file_path_id}",
+            self._file)
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8080) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.Response(text="OK")
+
+    async def _rspc_http(self, request: web.Request) -> web.Response:
+        path = request.match_info["path"]
+        if request.method == "POST":
+            try:
+                input = await request.json()
+            except json.JSONDecodeError:
+                input = None
+        else:
+            raw = request.query.get("input")
+            input = json.loads(raw) if raw else None
+        try:
+            result = await self.router.dispatch(path, input)
+            return web.json_response({"result": result})
+        except RpcError as e:
+            return web.json_response(
+                {"error": {"code": e.code, "message": e.message}},
+                status=400 if e.code == "BAD_REQUEST" else 404
+                if e.code == "NOT_FOUND" else 500)
+
+    async def _rspc_ws(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        subscriptions: Dict[Any, Any] = {}
+        loop = asyncio.get_running_loop()
+
+        async def handle(msg: dict) -> None:
+            mid = msg.get("id")
+            mtype = msg.get("type")
+            try:
+                if mtype in ("query", "mutation"):
+                    result = await self.router.dispatch(
+                        msg["path"], msg.get("input"))
+                    await ws.send_json(
+                        {"id": mid, "type": "response", "result": result})
+                elif mtype == "subscription":
+                    def emit(data, _mid=mid):
+                        # Thread-safe: event bus callbacks may fire from
+                        # worker threads.
+                        loop.call_soon_threadsafe(
+                            lambda: loop.create_task(ws.send_json(
+                                {"id": _mid, "type": "event",
+                                 "data": data})))
+                    unsub = await self.router.subscribe(
+                        msg["path"], msg.get("input"), emit)
+                    subscriptions[mid] = unsub
+                    await ws.send_json(
+                        {"id": mid, "type": "response", "result": None})
+                elif mtype == "subscriptionStop":
+                    unsub = subscriptions.pop(mid, None)
+                    if unsub:
+                        unsub()
+                else:
+                    raise RpcError("BAD_REQUEST",
+                                   f"unknown frame type {mtype}")
+            except RpcError as e:
+                await ws.send_json({"id": mid, "type": "error",
+                                    "code": e.code, "message": e.message})
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                await ws.send_json({"id": mid, "type": "error",
+                                    "code": "INTERNAL", "message": str(e)})
+
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    try:
+                        frame = json.loads(msg.data)
+                    except json.JSONDecodeError:
+                        continue
+                    await handle(frame)
+                elif msg.type == WSMsgType.ERROR:
+                    break
+        finally:
+            for unsub in subscriptions.values():
+                try:
+                    unsub()
+                except Exception:
+                    pass
+        return ws
+
+    async def _thumbnail(self, request: web.Request) -> web.Response:
+        cas_id = request.match_info["cas_id"]
+        if not cas_id.isalnum():
+            raise web.HTTPBadRequest()
+        p = thumbnail_path(self.node.data_dir, cas_id)
+        if not os.path.exists(p):
+            raise web.HTTPNotFound()
+        return web.FileResponse(p, headers={"Content-Type": "image/webp"})
+
+    async def _file(self, request: web.Request) -> web.StreamResponse:
+        """Original file serving with Range support
+        (custom_uri/mod.rs:149-330)."""
+        import uuid as uuidlib
+        try:
+            lib = self.node.libraries.get(
+                uuidlib.UUID(request.match_info["library_id"]))
+            location_id = int(request.match_info["location_id"])
+            file_path_id = int(request.match_info["file_path_id"])
+        except (ValueError, KeyError):
+            raise web.HTTPBadRequest()
+        if lib is None:
+            raise web.HTTPNotFound()
+        row = lib.db.query_one(
+            "SELECT * FROM file_path WHERE id = ? AND location_id = ?",
+            (file_path_id, location_id))
+        loc = lib.db.query_one(
+            "SELECT path FROM location WHERE id = ?", (location_id,))
+        if row is None or loc is None or not loc["path"]:
+            raise web.HTTPNotFound()
+        iso = IsolatedPath.from_db_row(
+            location_id, bool(row["is_dir"]), row["materialized_path"],
+            row["name"] or "", row["extension"] or "")
+        full = iso.join_on(loc["path"])
+        if not os.path.isfile(full):
+            raise web.HTTPNotFound()
+        size = os.path.getsize(full)
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+
+        rng = request.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            try:
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                start = int(start_s) if start_s else 0
+                end = int(end_s) if end_s else size - 1
+            except ValueError:
+                raise web.HTTPBadRequest()
+            end = min(end, size - 1)
+            if start > end or start >= size:
+                raise web.HTTPRequestRangeNotSatisfiable()
+            resp = web.StreamResponse(
+                status=206,
+                headers={
+                    "Content-Type": ctype,
+                    "Content-Range": f"bytes {start}-{end}/{size}",
+                    "Content-Length": str(end - start + 1),
+                    "Accept-Ranges": "bytes",
+                })
+            await resp.prepare(request)
+            with open(full, "rb") as f:
+                f.seek(start)
+                remaining = end - start + 1
+                while remaining > 0:
+                    chunk = f.read(min(RANGE_CHUNK, remaining))
+                    if not chunk:
+                        break
+                    await resp.write(chunk)
+                    remaining -= len(chunk)
+            await resp.write_eof()
+            return resp
+        return web.FileResponse(full, headers={
+            "Content-Type": ctype, "Accept-Ranges": "bytes"})
+
+
+async def serve(data_dir: str, host: str = "127.0.0.1",
+                port: int = 8080) -> None:
+    """CLI entry: run a node + API server until cancelled."""
+    from ..node import Node
+    node = Node(data_dir)
+    await node.start()
+    server = ApiServer(node)
+    actual = await server.start(host, port)
+    print(f"spacedrive_tpu server listening on {host}:{actual}")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await server.stop()
+        await node.shutdown()
+
+
+if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args()
+    asyncio.run(serve(args.data_dir, args.host, args.port))

@@ -1,0 +1,66 @@
+"""Ephemeral (non-indexed) directory browsing.
+
+Mirrors `walk` in /root/reference/core/src/location/non_indexed.rs:91:
+list an arbitrary directory not belonging to any location, returning
+typed entries (kind, size, dates) without touching the library DB, plus
+thumbnail keys for images so the Explorer can show previews of
+un-indexed folders.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..files import ObjectKind, kind_for_extension
+from ..ops.cas import generate_cas_id
+
+
+def walk_ephemeral(path: str, with_hidden_files: bool = False,
+                   compute_cas_ids: bool = False) -> List[Dict]:
+    """List one directory as ephemeral entries.
+
+    compute_cas_ids also derives CAS IDs for image files (used for the
+    ephemeral thumbnail queue — thumbnails are keyed by cas_id).
+    """
+    entries: List[Dict] = []
+    with os.scandir(path) as it:
+        for dirent in sorted(it, key=lambda e: e.name):
+            if not with_hidden_files and dirent.name.startswith("."):
+                continue
+            try:
+                if dirent.is_symlink():
+                    continue
+                st = dirent.stat()
+                is_dir = dirent.is_dir()
+            except OSError:
+                continue
+            name = dirent.name
+            ext = ""
+            if not is_dir:
+                dot = name.rfind(".")
+                if dot > 0:
+                    ext = name[dot + 1:]
+            kind = ObjectKind.FOLDER if is_dir else kind_for_extension(ext)
+            entry = {
+                "name": name if is_dir else
+                (name[:name.rfind(".")] if "." in name[1:] else name),
+                "extension": ext,
+                "path": dirent.path,
+                "is_dir": is_dir,
+                "kind": int(kind),
+                "size_in_bytes": st.st_size,
+                "date_created": getattr(st, "st_birthtime", st.st_ctime),
+                "date_modified": st.st_mtime,
+                "hidden": name.startswith("."),
+                "cas_id": None,
+            }
+            if (compute_cas_ids and not is_dir and st.st_size > 0
+                    and kind == ObjectKind.IMAGE):
+                try:
+                    entry["cas_id"] = generate_cas_id(
+                        dirent.path, st.st_size)
+                except OSError:
+                    pass
+            entries.append(entry)
+    return entries

@@ -160,8 +160,15 @@ class LocationWatcher:
                     self._mark_dirty(full)
                 elif mask & (IN_DELETE | IN_MOVED_FROM):
                     self._unwatch(full)
-            if mask & (IN_DELETE_SELF | IN_MOVE_SELF) and parent == self.root:
-                self._mark_dirty(self.root)
+            if mask & (IN_DELETE_SELF | IN_MOVE_SELF):
+                # The dir itself is gone — scanning it would only error.
+                # Its PARENT's listing changed; dirty that (root included:
+                # a deleted location root rescans as root, surfacing the
+                # missing-path state).
+                if parent == self.root:
+                    self._mark_dirty(self.root)
+                else:
+                    self._mark_dirty(os.path.dirname(parent))
                 continue
             self._mark_dirty(parent)
 

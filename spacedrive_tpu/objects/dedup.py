@@ -1,0 +1,234 @@
+"""Duplicate analytics: exact CAS-ID groups + perceptual near-dup job.
+
+Exact duplicates mirror the identifier's linking invariant (same cas_id →
+same object, /root/reference/core/src/object/file_identifier/mod.rs:167-225):
+`exact_duplicate_groups` reports objects with multiple file_paths, the
+dedup view a file manager shows for "reclaimable space".
+
+Near-dup search is net-new (BASELINE.json config 4): a StatefulJob that
+pHashes every image (ops/phash: DCT matmuls on device), persists hashes
+on media_data rows, then runs the tiled Hamming all-pairs
+(ops/hamming.near_dup_pairs; LSH banding beyond ~100k) and stores pairs
+in near_dup_pair.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
+from ..locations.file_path_helper import job_prologue
+from ..locations.paths import IsolatedPath
+from ..media.exif import MEDIA_DATA_EXTENSIONS
+from ..ops.phash import phash_files, phash_from_bytes, phash_to_bytes
+
+PHASH_BATCH = 256
+DEFAULT_THRESHOLD = 10
+# Beyond this many hashes, exact all-pairs gives way to LSH bucketing
+# (SURVEY.md §7 hard-part 4).
+ALL_PAIRS_LIMIT = 100_000
+
+PHASHABLE_EXTENSIONS = sorted(
+    MEDIA_DATA_EXTENSIONS | {"bmp", "gif", "ico", "tif"})
+
+
+def exact_duplicate_groups(library, location_id: Optional[int] = None,
+                           limit: int = 1000) -> List[Dict[str, Any]]:
+    """Objects whose cas_id is shared by multiple file_paths —
+    [{cas_id, object_pub_id, count, total_bytes, paths:[...]}]."""
+    where = "fp.cas_id IS NOT NULL"
+    params: List[Any] = []
+    if location_id is not None:
+        where += " AND fp.location_id = ?"
+        params.append(location_id)
+    rows = library.db.query(
+        f"SELECT fp.cas_id AS cas_id, COUNT(*) AS n, "
+        f"o.pub_id AS object_pub_id "
+        f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
+        f"WHERE {where} GROUP BY fp.cas_id HAVING n > 1 "
+        f"ORDER BY n DESC LIMIT ?", params + [limit])
+    out = []
+    for r in rows:
+        paths = library.db.query(
+            "SELECT materialized_path, name, extension, location_id, "
+            "size_in_bytes_bytes FROM file_path WHERE cas_id = ?",
+            (r["cas_id"],))
+        sizes = [int.from_bytes(p["size_in_bytes_bytes"] or b"", "big")
+                 for p in paths]
+        out.append({
+            "cas_id": r["cas_id"],
+            "object_pub_id": r["object_pub_id"],
+            "count": r["n"],
+            "total_bytes": sum(sizes),
+            "reclaimable_bytes": sum(sizes) - (sizes[0] if sizes else 0),
+            "paths": [
+                f"{p['materialized_path']}{p['name']}"
+                + (f".{p['extension']}" if p["extension"] else "")
+                for p in paths
+            ],
+        })
+    return out
+
+
+@register_job
+class NearDupDetectorJob(StatefulJob):
+    """Two phases: (1) pHash every un-hashed image in PHASH_BATCH chunks,
+    (2) one compare step running the device all-pairs and persisting
+    near_dup_pair rows."""
+
+    NAME = "near_dup_detector"
+    IS_BATCHED = True
+
+    def __init__(self, *, location_id: int,
+                 threshold: int = DEFAULT_THRESHOLD,
+                 sub_path: Optional[str] = None, backend: str = "auto"):
+        super().__init__(location_id=location_id, threshold=threshold,
+                         sub_path=sub_path, backend=backend)
+        self.location_id = location_id
+        self.threshold = threshold
+        self.sub_path = sub_path
+        self.backend = backend
+
+    async def init(self, ctx: JobContext):
+        db = ctx.db
+        ph = ",".join("?" for _ in PHASHABLE_EXTENSIONS)
+        loc, where, params = job_prologue(
+            db, self.location_id, self.sub_path,
+            f"fp.location_id = ? AND fp.is_dir = 0 AND "
+            f"fp.object_id IS NOT NULL AND LOWER(fp.extension) IN ({ph})",
+            [self.location_id, *PHASHABLE_EXTENSIONS])
+        where = where.replace("materialized_path LIKE",
+                              "fp.materialized_path LIKE")
+        rows = db.query(
+            f"SELECT fp.id, fp.object_id, fp.materialized_path, fp.name, "
+            f"fp.extension, md.phash AS phash "
+            f"FROM file_path fp "
+            f"LEFT JOIN media_data md ON md.object_id = fp.object_id "
+            f"WHERE {where} ORDER BY fp.id", params)
+        if not rows:
+            raise EarlyFinish("no images to hash")
+        to_hash = [
+            {"id": r["id"], "object_id": r["object_id"],
+             "materialized_path": r["materialized_path"],
+             "name": r["name"] or "", "extension": r["extension"] or ""}
+            for r in rows if r["phash"] is None
+        ]
+        steps: List[Any] = []
+        for i in range(0, len(to_hash), PHASH_BATCH):
+            steps.append({"kind": "hash",
+                          "rows": to_hash[i:i + PHASH_BATCH]})
+        steps.append({"kind": "compare"})
+        data = {"location_path": loc["path"], "hashed": 0,
+                "pairs_found": 0, "total_images": len(rows)}
+        ctx.progress(task_count=len(steps))
+        return data, steps
+
+    async def execute_step(self, ctx, data, step, step_number):
+        if step["kind"] == "hash":
+            return await asyncio.to_thread(self._hash_step, ctx, data, step)
+        return await asyncio.to_thread(self._compare_step, ctx, data)
+
+    def _hash_step(self, ctx: JobContext, data, step) -> StepOutcome:
+        db = ctx.db
+        rows = step["rows"]
+        paths = []
+        for r in rows:
+            iso = IsolatedPath.from_db_row(
+                self.location_id, False, r["materialized_path"],
+                r["name"], r["extension"])
+            paths.append(iso.join_on(data["location_path"]))
+        hashes, errors = phash_files(paths, backend=self.backend)
+        with db.tx() as conn:
+            for i, words in hashes.items():
+                blob = phash_to_bytes(words)
+                cur = conn.execute(
+                    "UPDATE media_data SET phash = ? WHERE object_id = ?",
+                    (blob, rows[i]["object_id"]))
+                if cur.rowcount == 0:
+                    conn.execute(
+                        "INSERT OR IGNORE INTO media_data "
+                        "(object_id, phash) VALUES (?, ?)",
+                        (rows[i]["object_id"], blob))
+        data["hashed"] += len(hashes)
+        ctx.progress(message=f"hashed {data['hashed']} images")
+        return StepOutcome(errors=errors,
+                           metadata={"hashed": data["hashed"]})
+
+    def _compare_step(self, ctx: JobContext, data) -> StepOutcome:
+        import numpy as np
+        from ..ops.hamming import near_dup_pairs, phash_bands
+        db = ctx.db
+        rows = db.query(
+            "SELECT DISTINCT md.object_id AS object_id, md.phash AS phash "
+            "FROM media_data md "
+            "JOIN file_path fp ON fp.object_id = md.object_id "
+            "WHERE md.phash IS NOT NULL AND fp.location_id = ?",
+            (self.location_id,))
+        if len(rows) < 2:
+            return StepOutcome(metadata={"pairs": 0})
+        object_ids = [r["object_id"] for r in rows]
+        digests = np.stack([phash_from_bytes(r["phash"]) for r in rows])
+
+        if len(rows) <= ALL_PAIRS_LIMIT:
+            pairs = near_dup_pairs(digests, self.threshold)
+        else:
+            # LSH bucket, then exact all-pairs inside each bucket.
+            pairs_set = set()
+            for _, idxs in phash_bands(digests).items():
+                sub = digests[idxs]
+                for a, b in near_dup_pairs(sub, self.threshold):
+                    i, j = idxs[a], idxs[b]
+                    pairs_set.add((min(i, j), max(i, j)))
+            pairs = sorted(pairs_set)
+
+        now = int(time.time())
+        with db.tx() as conn:
+            for i, j in pairs:
+                a, b = sorted((object_ids[i], object_ids[j]))
+                if a == b:
+                    continue  # two file_paths of one object: exact dup
+                d = int(np.sum(np.unpackbits(
+                    (digests[i] ^ digests[j]).astype(">u4").view(np.uint8))))
+                conn.execute(
+                    "INSERT INTO near_dup_pair "
+                    "(object_a_id, object_b_id, distance, date_detected) "
+                    "VALUES (?, ?, ?, ?) "
+                    "ON CONFLICT (object_a_id, object_b_id) "
+                    "DO UPDATE SET distance = excluded.distance",
+                    (a, b, d, now))
+        data["pairs_found"] = len(pairs)
+        return StepOutcome(metadata={"pairs": len(pairs)})
+
+    async def finalize(self, ctx, data, metadata):
+        metadata.setdefault("hashed", data["hashed"])
+        metadata["pairs"] = data["pairs_found"]
+        metadata["total_images"] = data["total_images"]
+        return metadata
+
+
+def near_duplicates(library, location_id: Optional[int] = None,
+                    max_distance: int = DEFAULT_THRESHOLD,
+                    limit: int = 1000) -> List[Dict[str, Any]]:
+    """Query stored near-dup pairs with object/file detail."""
+    rows = library.db.query(
+        "SELECT * FROM near_dup_pair WHERE distance <= ? "
+        "ORDER BY distance ASC LIMIT ?", (max_distance, limit))
+    out = []
+    for r in rows:
+        def paths_of(oid):
+            return [
+                f"{p['materialized_path']}{p['name']}"
+                + (f".{p['extension']}" if p["extension"] else "")
+                for p in library.db.query(
+                    "SELECT materialized_path, name, extension "
+                    "FROM file_path WHERE object_id = ?", (oid,))
+            ]
+        out.append({
+            "distance": r["distance"],
+            "object_a": r["object_a_id"], "object_b": r["object_b_id"],
+            "paths_a": paths_of(r["object_a_id"]),
+            "paths_b": paths_of(r["object_b_id"]),
+        })
+    return out

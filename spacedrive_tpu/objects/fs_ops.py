@@ -148,9 +148,11 @@ class FileDeleterJob(_FsJobBase):
 
     async def execute_step(self, ctx, data, step, step_number):
         def run():
+            # Idempotent: a replayed step whose target already vanished is
+            # a no-op (steps replay after pause/crash, jobs/job.py).
             full = step["full_path"]
             if step["is_dir"]:
-                shutil.rmtree(full, ignore_errors=False)
+                shutil.rmtree(full, ignore_errors=True)
             elif os.path.lexists(full):
                 os.remove(full)
         await asyncio.to_thread(run)
@@ -183,6 +185,11 @@ class FileEraserJob(_FsJobBase):
             # they get synthetic steps without DB rows.
             more = []
             for entry in os.scandir(step["full_path"]):
+                if entry.is_symlink():
+                    # NEVER scrub through a symlink — the target may live
+                    # outside the erase scope. Remove just the link.
+                    os.remove(entry.path)
+                    continue
                 is_dir = entry.is_dir(follow_symlinks=False)
                 child = _child_step(
                     ctx.db, self.location_id, data["location_path"],
@@ -197,6 +204,11 @@ class FileEraserJob(_FsJobBase):
 
         def erase():
             full = step["full_path"]
+            if os.path.islink(full):
+                os.remove(full)
+                return
+            if not os.path.exists(full):
+                return  # replayed step: already erased
             size = os.path.getsize(full)
             with open(full, "r+b") as f:
                 for _ in range(max(1, self.passes)):
@@ -281,6 +293,23 @@ class FileCopierJob(_CopyBase):
                 more.append(child)
             return StepOutcome(more_steps=more)
         if os.path.exists(target):
+            same_file = False
+            try:
+                same_file = os.path.samefile(src, target)
+            except OSError:
+                pass
+            if not same_file:
+                # Replay detection (idempotent steps): an interrupted-
+                # then-replayed copy finds its own completed output —
+                # identical size+mtime — and must not spawn a ' (N)'
+                # duplicate. (duplicateFiles into the same dir hits the
+                # samefile branch above and always dedup-names.)
+                try:
+                    import filecmp
+                    if filecmp.cmp(src, target, shallow=True):
+                        return StepOutcome()
+                except OSError:
+                    pass
             try:
                 target = find_available_filename_for_duplicate(target)
             except FsJobError as e:
@@ -300,6 +329,10 @@ class FileCutterJob(_CopyBase):
             if os.path.normpath(src) == os.path.normpath(target):
                 return StepOutcome(
                     errors=[f"source and target are the same: {src}"])
+            if not os.path.lexists(src):
+                if os.path.exists(target):
+                    return StepOutcome()  # replayed step: move completed
+                return StepOutcome(errors=[f"source missing: {src}"])
             if os.path.exists(target):
                 target2 = find_available_filename_for_duplicate(target)
             else:

@@ -1,0 +1,129 @@
+"""Batched perceptual hashing (pHash) — DCT-as-matmul for the MXU.
+
+Net-new capability vs the reference (SURVEY.md §2.1 "Duplicate
+detection": pHash near-dup is not in Spacedrive). The classic pHash
+recipe, restructured for TPU batching:
+
+1. decode + downsample each image to a 32×32 grayscale grid (CPU/PIL —
+   decode stays host-side like the reference's thumbnailer);
+2. 2-D DCT-II of the whole batch as two matmuls `D @ X @ Dᵀ` — one
+   [B,32,32] einsum pair that XLA maps straight onto the MXU, instead of
+   the per-image scipy calls a port would make;
+3. keep the top-left 8×8 low-frequency block, drop the DC term, threshold
+   against the per-image median → a 64-bit hash, packed [B, 2] uint32 for
+   ops/hamming.py's all-pairs XOR+popcount.
+
+Backends mirror ops/staging: numpy (always available) and jax (jitted).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+HASH_EDGE = 8            # 8×8 low-frequency block → 64 bits
+INPUT_EDGE = 32          # downsampled grid edge
+
+
+def dct_matrix(n: int = INPUT_EDGE) -> np.ndarray:
+    """Orthonormal DCT-II matrix [n, n] (float32)."""
+    k = np.arange(n)[:, None].astype(np.float64)
+    i = np.arange(n)[None, :].astype(np.float64)
+    m = np.cos(np.pi / n * (i + 0.5) * k)
+    m[0] *= 1.0 / math.sqrt(2.0)
+    return (m * math.sqrt(2.0 / n)).astype(np.float32)
+
+
+_DCT32 = dct_matrix(INPUT_EDGE)
+
+
+def _phash_core(xp, grids, dct):
+    """[B, 32, 32] float grids → [B, 64] bool bits. Backend-generic."""
+    coeffs = xp.einsum("ij,bjk,lk->bil", dct, grids, dct)
+    low = coeffs[:, :HASH_EDGE, :HASH_EDGE].reshape(
+        grids.shape[0], HASH_EDGE * HASH_EDGE)
+    # Median over the AC terms (DC dominates brightness, excluded).
+    ac = low[:, 1:]
+    med = xp.median(ac, axis=1, keepdims=True)
+    return ac > med
+
+
+def _bits_to_words(bits: np.ndarray) -> np.ndarray:
+    """[B, 63] bool → [B, 2] uint32 (63 AC bits + 1 zero pad)."""
+    B = bits.shape[0]
+    padded = np.zeros((B, 64), dtype=np.uint8)
+    padded[:, :bits.shape[1]] = bits.astype(np.uint8)
+    packed = np.packbits(padded, axis=1)  # [B, 8] bytes
+    return packed.view(">u4").astype(np.uint32).reshape(B, 2)
+
+
+def phash_numpy(grids: np.ndarray) -> np.ndarray:
+    """[B, 32, 32] float32 → [B, 2] uint32 pHashes."""
+    bits = _phash_core(np, grids.astype(np.float32), _DCT32)
+    return _bits_to_words(np.asarray(bits))
+
+
+_jax_phash = None
+
+
+def phash_jax(grids: np.ndarray) -> np.ndarray:
+    global _jax_phash
+    import jax
+    import jax.numpy as jnp
+    if _jax_phash is None:
+        dct = jnp.asarray(_DCT32)
+
+        @jax.jit
+        def run(g):
+            coeffs = jnp.einsum("ij,bjk,lk->bil", dct, g, dct)
+            low = coeffs[:, :HASH_EDGE, :HASH_EDGE].reshape(
+                g.shape[0], HASH_EDGE * HASH_EDGE)
+            ac = low[:, 1:]
+            med = jnp.median(ac, axis=1, keepdims=True)
+            return ac > med
+        _jax_phash = run
+    bits = np.asarray(_jax_phash(np.asarray(grids, dtype=np.float32)))
+    return _bits_to_words(bits)
+
+
+def image_to_grid(path: str) -> Optional[np.ndarray]:
+    """Decode + grayscale + resize to [32, 32] float32; None on failure."""
+    try:
+        from PIL import Image
+        with Image.open(path) as im:
+            g = im.convert("L").resize(
+                (INPUT_EDGE, INPUT_EDGE), Image.LANCZOS)
+            return np.asarray(g, dtype=np.float32)
+    except Exception:
+        return None
+
+
+def phash_files(paths: Sequence[str], backend: str = "auto",
+                ) -> Tuple[dict, List[str]]:
+    """paths → ({index: [2] uint32 hash}, errors). Batched decode + hash."""
+    grids, idxs, errors = [], [], []
+    for i, p in enumerate(paths):
+        g = image_to_grid(p)
+        if g is None:
+            errors.append(f"phash decode failed: {p}")
+        else:
+            grids.append(g)
+            idxs.append(i)
+    if not grids:
+        return {}, errors
+    batch = np.stack(grids)
+    if backend == "auto":
+        from .staging import default_backend
+        backend = default_backend(len(grids))
+    words = phash_jax(batch) if backend == "jax" else phash_numpy(batch)
+    return {i: words[row] for row, i in enumerate(idxs)}, errors
+
+
+def phash_to_bytes(words: np.ndarray) -> bytes:
+    return np.asarray(words, dtype=">u4").tobytes()
+
+
+def phash_from_bytes(blob: bytes) -> np.ndarray:
+    return np.frombuffer(blob, dtype=">u4").astype(np.uint32)

@@ -45,6 +45,8 @@ class Database:
         self.path = str(path)
         self._write_lock = threading.RLock()
         self._local = threading.local()
+        self._all_conns: list[sqlite3.Connection] = []
+        self._closed = False
         conn = self._conn()
         with self._write_lock:
             for stmt in models.all_ddl():
@@ -52,21 +54,41 @@ class Database:
             conn.commit()
 
     def _conn(self) -> sqlite3.Connection:
+        if self._closed:
+            raise sqlite3.ProgrammingError("database is closed")
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self.path, timeout=30.0)
+            # check_same_thread=False so close() can tear down every
+            # thread's connection (backup restore swaps the file under
+            # us); normal use keeps one conn per thread regardless.
+            conn = sqlite3.connect(self.path, timeout=30.0,
+                                   check_same_thread=False)
             conn.row_factory = sqlite3.Row
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA foreign_keys=ON")
             conn.execute("PRAGMA synchronous=NORMAL")
+            with self._write_lock:
+                # Re-check under the lock: close() may have won the race
+                # after the unlocked check above (restore swaps the file).
+                if self._closed:
+                    conn.close()
+                    raise sqlite3.ProgrammingError("database is closed")
+                self._all_conns.append(conn)
             self._local.conn = conn
         return conn
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        """Close EVERY thread's connection. Any later use of this
+        Database object raises — restore swaps in a new instance."""
+        with self._write_lock:
+            self._closed = True
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.Error:
+                    pass
+            self._all_conns.clear()
+            self._local = threading.local()
 
     # -- reads ------------------------------------------------------------
 
@@ -94,6 +116,12 @@ class Database:
     def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
         with self.tx() as conn:
             return conn.execute(sql, params)
+
+    def checkpoint(self) -> None:
+        """Flush the WAL into the main DB file (for backups). Must NOT run
+        inside a transaction — wal_checkpoint fails under BEGIN."""
+        with self._write_lock:
+            self._conn().execute("PRAGMA wal_checkpoint(TRUNCATE)")
 
     # -- typed helpers over the model registry ----------------------------
 

@@ -267,7 +267,27 @@ register(Model(
         Field("copyright", "TEXT"),
         Field("exif_version", "TEXT"),
         Field("epoch_time", "INTEGER"),
+        # Net-new vs the reference: 64-bit perceptual hash (big-endian
+        # bytes) for device-side near-dup search (BASELINE.json config 4).
+        Field("phash", "BLOB"),
     ),
+))
+
+# --- Near-dup pairs (net-new capability; no reference analog). ------------
+
+register(Model(
+    "near_dup_pair",
+    (
+        _id(),
+        Field("object_a_id", "INTEGER", nullable=False,
+              references="object(id)", on_delete="CASCADE"),
+        Field("object_b_id", "INTEGER", nullable=False,
+              references="object(id)", on_delete="CASCADE"),
+        Field("distance", "INTEGER", nullable=False),
+        Field("date_detected", "INTEGER"),
+    ),
+    uniques=(("object_a_id", "object_b_id"),),
+    indexes=(("object_a_id",), ("object_b_id",)),
 ))
 
 # --- Tags (@shared; TagOnObject @relation — schema.prisma:331,349). -------

@@ -82,6 +82,8 @@ class SyncManager:
         self._on_created.append(cb)
 
     def _notify_created(self) -> None:
+        if not self.emit_messages:
+            return
         for cb in list(self._on_created):
             cb()
 
@@ -143,6 +145,11 @@ class SyncManager:
             self._notify_created()
 
     def _insert_op_rows(self, conn, ops: Iterable[CRDTOperation]) -> None:
+        """Append local ops to the log — no-op when message emission is
+        disabled (SyncEmitMessages feature flag, manager.rs:69), so every
+        direct caller respects the flag without its own guard."""
+        if not self.emit_messages:
+            return
         my_id = self._instance_row_id(self.instance, conn)
         for op in ops:
             self._insert_op_row(conn, op, my_id)

@@ -1,0 +1,72 @@
+"""Volume detection: mounted filesystem enumeration.
+
+Mirrors `get_volumes` (/root/reference/core/src/volume/mod.rs:101,241 —
+sysinfo-based): enumerate mount points with capacity/availability,
+filtering pseudo-filesystems. Linux implementation reads /proc/mounts +
+statvfs (no sysinfo crate here).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+_PSEUDO_FS = {
+    "proc", "sysfs", "devtmpfs", "devpts", "tmpfs", "cgroup", "cgroup2",
+    "pstore", "securityfs", "debugfs", "tracefs", "overlay", "squashfs",
+    "fusectl", "configfs", "mqueue", "hugetlbfs", "bpf", "autofs",
+    "binfmt_misc", "rpc_pipefs", "nsfs", "efivarfs", "ramfs",
+}
+
+
+def get_volumes() -> List[Dict]:
+    """Enumerate real mounted volumes with capacity info."""
+    volumes = []
+    seen_mounts = set()
+    try:
+        with open("/proc/mounts") as f:
+            lines = f.readlines()
+    except OSError:
+        lines = []
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        device, mount_point, fstype = parts[0], parts[1], parts[2]
+        if fstype in _PSEUDO_FS or mount_point in seen_mounts:
+            continue
+        mount_point = mount_point.encode().decode("unicode_escape")
+        try:
+            st = os.statvfs(mount_point)
+        except OSError:
+            continue
+        total = st.f_blocks * st.f_frsize
+        if total == 0:
+            continue
+        seen_mounts.add(mount_point)
+        volumes.append({
+            "name": os.path.basename(device) or device,
+            "mount_point": mount_point,
+            "filesystem": fstype,
+            "total_bytes_capacity": str(total),
+            "total_bytes_available": str(st.f_bavail * st.f_frsize),
+            "is_system": mount_point == "/",
+            "disk_type": None,
+        })
+    return volumes
+
+
+def save_volumes(db) -> int:
+    """Upsert detected volumes into the @local volume table."""
+    vols = get_volumes()
+    for v in vols:
+        db.upsert(
+            "volume",
+            {"mount_point": v["mount_point"], "name": v["name"]},
+            {
+                "filesystem": v["filesystem"],
+                "total_bytes_capacity": v["total_bytes_capacity"],
+                "total_bytes_available": v["total_bytes_available"],
+                "is_system": int(v["is_system"]),
+            })
+    return len(vols)

@@ -1,0 +1,247 @@
+"""API surface tests: router dispatch, procedures across namespaces, and
+the websocket/HTTP server host with custom_uri file serving."""
+
+import asyncio
+import json
+import os
+import uuid
+
+import aiohttp
+import pytest
+
+from spacedrive_tpu.api.router import RpcError, mount_router
+from spacedrive_tpu.node import Node
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _corpus(root):
+    os.makedirs(f"{root}/docs", exist_ok=True)
+    with open(f"{root}/docs/hello.txt", "wb") as f:
+        f.write(b"hello world " * 400)
+    from PIL import Image
+    Image.new("RGB", (80, 60), (10, 120, 200)).save(f"{root}/pic.png")
+
+
+@pytest.fixture
+def env(tmp_path):
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    _corpus(str(corpus))
+    node = Node(str(tmp_path / "data"))
+    router = mount_router(node)
+    return node, router, str(corpus)
+
+
+def test_router_basics(env):
+    node, router, corpus = env
+
+    async def main():
+        info = await router.dispatch("buildInfo")
+        assert info["version"]
+        state = await router.dispatch("nodeState")
+        assert state["name"]
+        with pytest.raises(RpcError):
+            await router.dispatch("nope.nope")
+        # library-scoped without library_id
+        with pytest.raises(RpcError):
+            await router.dispatch("locations.list", {})
+    _run(main())
+
+
+def test_full_api_flow(env):
+    node, router, corpus = env
+
+    async def main():
+        lib = await router.dispatch("library.create", {"name": "api-lib"})
+        lid = lib["uuid"]
+        libs = await router.dispatch("library.list")
+        assert [x["uuid"] for x in libs] == [lid]
+
+        # invalidation events fired for mutations
+        events = []
+        node.events.subscribe(events.append)
+
+        loc_id = await router.dispatch("locations.create", {
+            "library_id": lid, "path": corpus, "dry_run": True})
+        assert isinstance(loc_id, int)
+        # full rescan via jobs
+        await router.dispatch("locations.fullRescan",
+                              {"library_id": lid, "location_id": loc_id})
+        await node.jobs.wait_idle()
+
+        paths = await router.dispatch("search.paths", {"library_id": lid})
+        names = {p["name"] for p in paths["items"]}
+        assert {"hello", "pic", "docs"} <= names
+        count = await router.dispatch(
+            "search.pathsCount", {"library_id": lid})
+        assert count == len(paths["items"])
+
+        objs = await router.dispatch("search.objects", {"library_id": lid})
+        assert len(objs["items"]) == 2
+        cats = await router.dispatch("categories.list", {"library_id": lid})
+        assert cats["Image"] == 1 and cats["Text"] == 1
+
+        # tags roundtrip
+        tag = await router.dispatch("tags.create", {
+            "library_id": lid, "name": "important", "color": "#f00"})
+        obj_id = objs["items"][0]["id"]
+        await router.dispatch("tags.assign", {
+            "library_id": lid, "tag_id": tag["id"], "object_id": obj_id})
+        got = await router.dispatch("tags.getForObject", {
+            "library_id": lid, "object_id": obj_id})
+        assert [t["name"] for t in got] == ["important"]
+        await router.dispatch("tags.assign", {
+            "library_id": lid, "tag_id": tag["id"], "object_id": obj_id,
+            "unassign": True})
+        assert await router.dispatch("tags.getForObject", {
+            "library_id": lid, "object_id": obj_id}) == []
+
+        # files procedures
+        fp = next(p for p in paths["items"] if p["name"] == "hello")
+        full = await router.dispatch("files.getPath", {
+            "library_id": lid, "id": fp["id"]})
+        assert full.endswith("docs/hello.txt")
+        await router.dispatch("files.setFavorite", {
+            "library_id": lid, "id": fp["object_id"], "favorite": True})
+        favs = await router.dispatch("search.objects", {
+            "library_id": lid, "filter": {"favorite": True}})
+        assert len(favs["items"]) == 1
+
+        # rename + DB consistency
+        await router.dispatch("files.renameFile", {
+            "library_id": lid, "file_path_id": fp["id"],
+            "new_name": "renamed.txt"})
+        assert os.path.exists(f"{corpus}/docs/renamed.txt")
+
+        # jobs reports exist; statistics aggregate
+        reports = await router.dispatch("jobs.reports", {"library_id": lid})
+        assert any(r["name"] == "indexer" for r in reports)
+        stats = await router.dispatch(
+            "library.statistics", {"library_id": lid})
+        assert stats["total_object_count"] == 2
+
+        # volumes + ephemeral
+        vols = await router.dispatch("volumes.list")
+        assert any(v["mount_point"] == "/" for v in vols)
+        eph = await router.dispatch("search.ephemeralPaths", {
+            "path": corpus})
+        assert any(e["name"] == "pic" for e in eph)
+
+        # preferences
+        await router.dispatch("preferences.update", {
+            "library_id": lid, "values": {"theme": "dark"}})
+        prefs = await router.dispatch(
+            "preferences.get", {"library_id": lid})
+        assert prefs["theme"] == "dark"
+
+        # invalidation events were emitted for the mutations above
+        keys = {e.get("key") for e in events
+                if e.get("type") == "InvalidateOperation"}
+        assert "tags.list" in keys and "locations.list" in keys
+    _run(main())
+
+
+def test_backup_restore_roundtrip(env):
+    node, router, corpus = env
+
+    async def main():
+        lib = await router.dispatch("library.create", {"name": "bk"})
+        lid = lib["uuid"]
+        await router.dispatch("tags.create", {
+            "library_id": lid, "name": "keepme"})
+        backup_id = await router.dispatch(
+            "backups.backup", {"library_id": lid})
+        assert (await router.dispatch("backups.getAll"))[0]["id"] == backup_id
+        # destroy the tag, then restore
+        lib_obj = node.libraries.get(uuid.UUID(lid))
+        lib_obj.db.execute("DELETE FROM tag")
+        assert await router.dispatch("tags.list", {"library_id": lid}) == []
+        await router.dispatch("backups.restore", {"backup_id": backup_id})
+        tags = await router.dispatch("tags.list", {"library_id": lid})
+        assert [t["name"] for t in tags] == ["keepme"]
+        assert await router.dispatch("backups.delete",
+                                     {"backup_id": backup_id})
+    _run(main())
+
+
+def test_server_ws_and_custom_uri(env, tmp_path):
+    node, router, corpus = env
+
+    async def main():
+        from spacedrive_tpu.api.server import ApiServer
+        server = ApiServer(node, router)
+        port = await server.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as http:
+            # health + one-shot HTTP rpc
+            async with http.get(f"{base}/health") as resp:
+                assert resp.status == 200
+            async with http.post(f"{base}/rspc/library.create",
+                                 json={"name": "ws-lib"}) as resp:
+                lid = (await resp.json())["result"]["uuid"]
+
+            # websocket: subscription + mutation + query
+            async with http.ws_connect(f"{base}/rspc") as ws:
+                await ws.send_json({"id": 1, "type": "subscription",
+                                    "path": "invalidation.listen"})
+                assert (await ws.receive_json())["type"] == "response"
+                await ws.send_json({
+                    "id": 2, "type": "mutation",
+                    "path": "locations.create",
+                    "input": {"library_id": lid, "path": corpus,
+                              "dry_run": True}})
+                got_invalidate = got_response = False
+                loc_id = None
+                for _ in range(4):
+                    frame = await asyncio.wait_for(
+                        ws.receive_json(), timeout=5)
+                    if frame["type"] == "event" and \
+                            frame["data"]["key"] == "locations.list":
+                        got_invalidate = True
+                    if frame["type"] == "response" and frame["id"] == 2:
+                        got_response = True
+                        loc_id = frame["result"]
+                    if got_invalidate and got_response:
+                        break
+                assert got_invalidate and got_response
+
+                await ws.send_json({
+                    "id": 3, "type": "mutation",
+                    "path": "locations.fullRescan",
+                    "input": {"library_id": lid, "location_id": loc_id}})
+                while (await ws.receive_json()).get("id") != 3:
+                    pass
+            await node.jobs.wait_idle()
+
+            # custom_uri: original file with Range
+            lib = node.libraries.get(uuid.UUID(lid))
+            fp = lib.db.query_one(
+                "SELECT id, location_id FROM file_path WHERE name='hello'")
+            url = (f"{base}/spacedrive/file/{lid}/"
+                   f"{fp['location_id']}/{fp['id']}")
+            async with http.get(url) as resp:
+                assert resp.status == 200
+                body = await resp.read()
+                assert body.startswith(b"hello world ")
+            async with http.get(
+                    url, headers={"Range": "bytes=6-10"}) as resp:
+                assert resp.status == 206
+                assert await resp.read() == b"world"
+                assert resp.headers["Content-Range"].startswith("bytes 6-10/")
+
+            # thumbnail plane
+            from spacedrive_tpu.media.thumbnail import generate_thumbnail
+            pic = lib.db.query_one(
+                "SELECT cas_id FROM file_path WHERE name='pic'")
+            generate_thumbnail(f"{corpus}/pic.png", node.data_dir,
+                               pic["cas_id"])
+            async with http.get(
+                    f"{base}/spacedrive/thumbnail/"
+                    f"{pic['cas_id']}.webp") as resp:
+                assert resp.status == 200
+                assert (await resp.read())[:4] == b"RIFF"
+        await server.stop()
+    _run(main())

@@ -1,0 +1,135 @@
+"""Dedup analytics: pHash properties, exact groups, near-dup job E2E."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.ops.phash import (
+    dct_matrix,
+    phash_files,
+    phash_from_bytes,
+    phash_numpy,
+    phash_to_bytes,
+)
+
+
+def _img(path, seed, size=(256, 192), noise=0):
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    # Smooth low-frequency image: noise-robust pHash needs structure.
+    base = rng.normal(size=(12, 16))
+    arr = np.kron(base, np.ones((16, 16)))[:size[1], :size[0]]
+    arr = (arr - arr.min()) / (np.ptp(arr) + 1e-9) * 255
+    if noise:
+        arr = np.clip(arr + rng.normal(scale=noise, size=arr.shape), 0, 255)
+    Image.fromarray(arr.astype(np.uint8), "L").convert("RGB").save(path)
+
+
+def _dist(a, b):
+    return int(np.unpackbits(
+        (a ^ b).astype(">u4").view(np.uint8)).sum())
+
+
+def test_dct_matrix_orthonormal():
+    d = dct_matrix(32)
+    assert np.allclose(d @ d.T, np.eye(32), atol=1e-5)
+
+
+def test_phash_deterministic_and_discriminative(tmp_path):
+    _img(tmp_path / "a.png", seed=1)
+    _img(tmp_path / "a_copy.png", seed=1)
+    _img(tmp_path / "a_noisy.png", seed=1, noise=6)
+    _img(tmp_path / "b.png", seed=2)
+    hashes, errors = phash_files([
+        str(tmp_path / "a.png"), str(tmp_path / "a_copy.png"),
+        str(tmp_path / "a_noisy.png"), str(tmp_path / "b.png"),
+    ], backend="numpy")
+    assert not errors and len(hashes) == 4
+    assert _dist(hashes[0], hashes[1]) == 0          # identical
+    assert _dist(hashes[0], hashes[2]) <= 10         # noisy variant near
+    assert _dist(hashes[0], hashes[3]) > 16          # different image far
+
+
+def test_phash_jax_matches_numpy(tmp_path):
+    _img(tmp_path / "x.png", seed=5)
+    from spacedrive_tpu.ops.phash import image_to_grid, phash_jax
+    grid = image_to_grid(str(tmp_path / "x.png"))[None]
+    a = phash_numpy(grid)
+    b = phash_jax(grid)
+    # Median thresholding can flip bits whose AC term sits exactly at the
+    # median under float reordering; allow a tiny tolerance.
+    assert _dist(a[0], b[0]) <= 2
+
+
+def test_phash_blob_roundtrip(tmp_path):
+    _img(tmp_path / "x.png", seed=3)
+    hashes, _ = phash_files([str(tmp_path / "x.png")], backend="numpy")
+    blob = phash_to_bytes(hashes[0])
+    assert len(blob) == 8
+    assert np.array_equal(phash_from_bytes(blob), hashes[0])
+
+
+@pytest.fixture
+def env(tmp_path):
+    from spacedrive_tpu.node import Node
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    _img(corpus / "photo.png", seed=1)
+    _img(corpus / "photo_near.png", seed=1, noise=5)
+    _img(corpus / "other.png", seed=9)
+    # An exact duplicate pair (same bytes).
+    (corpus / "dup1.bin").write_bytes(b"D" * 5000)
+    (corpus / "dup2.bin").write_bytes(b"D" * 5000)
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+    return node, lib, str(corpus)
+
+
+def test_exact_and_near_dup_jobs(env):
+    node, lib, corpus = env
+    from spacedrive_tpu.jobs.report import JobStatus
+    from spacedrive_tpu.locations.manager import create_location, scan_location
+    from spacedrive_tpu.objects.dedup import (
+        NearDupDetectorJob,
+        exact_duplicate_groups,
+        near_duplicates,
+    )
+
+    async def main():
+        loc = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc, backend="numpy")
+        await node.jobs.wait_idle()
+        jid = await node.jobs.ingest(lib, NearDupDetectorJob(
+            location_id=loc, threshold=12, backend="numpy"))
+        status = await node.jobs.wait(jid)
+        assert status == JobStatus.COMPLETED, status
+        return loc
+    loc = asyncio.run(main())
+
+    groups = exact_duplicate_groups(lib)
+    assert len(groups) == 1
+    assert groups[0]["count"] == 2
+    assert groups[0]["reclaimable_bytes"] == 5000
+    assert sorted(groups[0]["paths"]) == ["/dup1.bin", "/dup2.bin"]
+
+    pairs = near_duplicates(lib)
+    assert len(pairs) >= 1
+    flat = {tuple(sorted((p["object_a"], p["object_b"]))) for p in pairs}
+    # photo & photo_near are the near pair; other must not pair with them
+    # at this threshold.
+    rows = {r["name"]: r["object_id"] for r in lib.db.query(
+        "SELECT name, object_id FROM file_path WHERE extension = 'png'")}
+    expected = tuple(sorted((rows["photo"], rows["photo_near"])))
+    assert expected in flat
+    bad_a = tuple(sorted((rows["photo"], rows["other"])))
+    assert bad_a not in flat
+
+    # Re-running skips hashing (phashes persisted) and converges.
+    async def rerun():
+        jid = await node.jobs.ingest(lib, NearDupDetectorJob(
+            location_id=loc, threshold=12, backend="numpy"))
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    asyncio.run(rerun())
+    assert len(near_duplicates(lib)) == len(pairs)

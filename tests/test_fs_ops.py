@@ -80,9 +80,28 @@ def test_copy_file_and_dir(env):
     _run(main())
     assert open(f"{dst}/a.txt").read() == "alpha"
     assert open(f"{dst}/sub/b.txt").read() == "beta"
-    # Copy again → " (1)" dedup name for the file.
+    # Re-copying identical content is an idempotent no-op (replay
+    # semantics), NOT a " (1)" duplicate.
     _run(main())
-    assert os.path.exists(f"{dst}/a (1).txt")
+    assert not os.path.exists(f"{dst}/a (1).txt")
+    # But a changed source under the same name dedup-names.
+    with open(f"{dst}/a.txt", "w") as f:
+        f.write("different")
+    _run(main())
+    assert open(f"{dst}/a (1).txt").read() == "alpha"
+
+
+def test_duplicate_in_same_dir(env):
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        job = FileCopierJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "a")],
+            target_location_id=sid)  # same location, same dir
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    assert open(f"{src}/a (1).txt").read() == "alpha"
 
 
 def test_cut(env):
