@@ -1,0 +1,4 @@
+from .identity import Identity, RemoteIdentity
+from .manager import P2PManager
+
+__all__ = ["Identity", "RemoteIdentity", "P2PManager"]

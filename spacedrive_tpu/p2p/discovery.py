@@ -1,0 +1,141 @@
+"""Peer discovery: UDP multicast beacons.
+
+Covers the role of the reference's mDNS discovery
+(/root/reference/crates/p2p/src/discovery/mdns.rs): each node
+periodically multicasts a signed beacon (node identity, TCP port,
+metadata incl. owned instance identities); listeners maintain a
+peer table with expiry. Multicast on 239.255.41.42:41420 replaces the
+mdns-sd service since this environment has no zeroconf stack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import time
+from typing import Callable, Dict, Optional
+
+import msgpack
+
+from .identity import Identity, RemoteIdentity
+
+MCAST_GRP = "239.255.41.42"
+MCAST_PORT = 41420
+BEACON_INTERVAL_S = 2.0
+PEER_EXPIRY_S = 10.0
+
+
+class DiscoveredPeer:
+    def __init__(self, identity: RemoteIdentity, addr: str, port: int,
+                 metadata: dict):
+        self.identity = identity
+        self.addr = addr
+        self.port = port
+        self.metadata = metadata
+        self.last_seen = time.monotonic()
+
+    def __repr__(self) -> str:
+        return f"Peer({self.identity!r} @ {self.addr}:{self.port})"
+
+
+class Discovery:
+    """Multicast beacon sender + listener with a peer table."""
+
+    def __init__(self, identity: Identity, service_port: int,
+                 metadata: Optional[dict] = None,
+                 group: str = MCAST_GRP, port: int = MCAST_PORT):
+        self.identity = identity
+        self.service_port = service_port
+        self.metadata = metadata or {}
+        self.group = group
+        self.port = port
+        self.peers: Dict[RemoteIdentity, DiscoveredPeer] = {}
+        self.on_discovered: Optional[Callable[[DiscoveredPeer], None]] = None
+        self.on_expired: Optional[Callable[[RemoteIdentity], None]] = None
+        self._transport = None
+        self._tasks: list = []
+
+    def _beacon(self) -> bytes:
+        body = msgpack.packb({
+            "identity": self.identity.to_remote_identity().to_bytes(),
+            "port": self.service_port,
+            "metadata": self.metadata,
+            "ts": time.time(),
+        }, use_bin_type=True)
+        return msgpack.packb(
+            {"body": body, "sig": self.identity.sign(body)},
+            use_bin_type=True)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM,
+                             socket.IPPROTO_UDP)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        except (AttributeError, OSError):
+            pass
+        sock.bind(("", self.port))
+        mreq = struct.pack("4sl", socket.inet_aton(self.group),
+                           socket.INADDR_ANY)
+        sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+        sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+        sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+        sock.setblocking(False)
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(proto_self, data, addr):
+                self._on_datagram(data, addr)
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            Proto, sock=sock)
+        self._tasks = [loop.create_task(self._beacon_loop()),
+                       loop.create_task(self._expire_loop())]
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        try:
+            outer = msgpack.unpackb(data, raw=False)
+            body = msgpack.unpackb(outer["body"], raw=False)
+            remote = RemoteIdentity(body["identity"])
+            if remote == self.identity.to_remote_identity():
+                return  # our own beacon
+            if not remote.verify(outer["sig"], outer["body"]):
+                return
+        except Exception:
+            return
+        is_new = remote not in self.peers
+        peer = DiscoveredPeer(remote, addr[0], body["port"],
+                              body.get("metadata") or {})
+        self.peers[remote] = peer
+        if is_new and self.on_discovered:
+            self.on_discovered(peer)
+
+    async def _beacon_loop(self) -> None:
+        while True:
+            self._transport.sendto(
+                self._beacon(), (self.group, self.port))
+            await asyncio.sleep(BEACON_INTERVAL_S)
+
+    async def _expire_loop(self) -> None:
+        while True:
+            await asyncio.sleep(PEER_EXPIRY_S / 2)
+            now = time.monotonic()
+            for key in [k for k, p in self.peers.items()
+                        if now - p.last_seen > PEER_EXPIRY_S]:
+                self.peers.pop(key, None)
+                if self.on_expired:
+                    self.on_expired(key)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None

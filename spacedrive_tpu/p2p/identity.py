@@ -1,0 +1,65 @@
+"""Ed25519 node/instance identities.
+
+Mirrors `spacetunnel`'s identity types
+(/root/reference/crates/p2p/src/spacetunnel/identity.rs:19-60): an
+`Identity` is an ed25519 keypair whose public half (`RemoteIdentity`) is
+how peers and library instances are addressed and verified.
+"""
+
+from __future__ import annotations
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.exceptions import InvalidSignature
+
+
+class RemoteIdentity:
+    """A peer's public identity (32 raw bytes)."""
+
+    def __init__(self, public_bytes: bytes):
+        assert len(public_bytes) == 32, "ed25519 public key is 32 bytes"
+        self._raw = public_bytes
+        self._key = Ed25519PublicKey.from_public_bytes(public_bytes)
+
+    def to_bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, signature: bytes, message: bytes) -> bool:
+        try:
+            self._key.verify(signature, message)
+            return True
+        except InvalidSignature:
+            return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, RemoteIdentity) and self._raw == other._raw
+
+    def __hash__(self) -> int:
+        return hash(self._raw)
+
+    def __repr__(self) -> str:
+        return f"RemoteIdentity({self._raw.hex()[:12]}…)"
+
+
+class Identity:
+    def __init__(self, private_bytes: bytes | None = None):
+        if private_bytes is None:
+            self._key = Ed25519PrivateKey.generate()
+        else:
+            self._key = Ed25519PrivateKey.from_private_bytes(private_bytes)
+
+    def to_bytes(self) -> bytes:
+        return self._key.private_bytes(
+            serialization.Encoding.Raw,
+            serialization.PrivateFormat.Raw,
+            serialization.NoEncryption())
+
+    def to_remote_identity(self) -> RemoteIdentity:
+        return RemoteIdentity(self._key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw))
+
+    def sign(self, message: bytes) -> bytes:
+        return self._key.sign(message)

@@ -1,0 +1,286 @@
+"""P2PManager: the node's peer-to-peer service.
+
+Covers the reference's p2p glue
+(/root/reference/core/src/p2p/p2p_manager.rs:88-340 and
+crates/p2p/src/manager.rs): a TCP listener whose accepted streams run the
+authenticated tunnel handshake and then dispatch on a `Header`
+discriminator (protocol.rs:13-27: Ping / Spacedrop / Pair / Sync / File),
+plus discovery wiring and outbound stream helpers. QUIC→TCP is the one
+transport substitution (see proto.py).
+
+Spacedrop (p2p_manager.rs:88: send/accept/reject), file requests
+(request_file), pairing, and the library sync plane (sync_net.py) all
+ride these streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid as uuidlib
+from typing import Any, Callable, Dict, Optional
+
+from .discovery import Discovery, DiscoveredPeer
+from .identity import Identity, RemoteIdentity
+from .proto import Tunnel, tunnel_handshake
+from .spaceblock import (
+    SpaceblockRequest,
+    receive_file,
+    send_file,
+)
+
+SPACEDROP_TIMEOUT_S = 60
+
+
+class P2PManager:
+    def __init__(self, node, identity: Optional[Identity] = None,
+                 enable_discovery: bool = True):
+        self.node = node
+        self.identity = identity or Identity()
+        self.enable_discovery = enable_discovery
+        self.discovery: Optional[Discovery] = None
+        self.server: Optional[asyncio.base_events.Server] = None
+        self.port: Optional[int] = None
+        # Spacedrop decision hook: (peer, request) -> save-path | None.
+        self.on_spacedrop: Callable[
+            [RemoteIdentity, SpaceblockRequest],
+            Optional[str]] = lambda peer, req: None
+        # Pairing decision hook: (peer, library_info) -> bool.
+        self.on_pairing_request: Callable[
+            [RemoteIdentity, dict], bool] = lambda peer, info: False
+        self._spacedrop_cancel: Dict[str, bool] = {}
+        self.networked = None  # set by sync_net.NetworkedLibraries
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        self.server = await asyncio.start_server(
+            self._on_connection, host, port)
+        self.port = self.server.sockets[0].getsockname()[1]
+        if self.enable_discovery:
+            self.discovery = Discovery(
+                self.identity, self.port,
+                metadata={"name": self.node.config.name,
+                          "node_id": self.node.config.id.hex()})
+            await self.discovery.start()
+        return self.port
+
+    async def stop(self) -> None:
+        if self.discovery is not None:
+            await self.discovery.stop()
+        if self.server is not None:
+            self.server.close()
+            await self.server.wait_closed()
+            self.server = None
+
+    # -- outbound ----------------------------------------------------------
+
+    async def open_stream(self, addr: str, port: int,
+                          expected: Optional[RemoteIdentity] = None
+                          ) -> Tunnel:
+        reader, writer = await asyncio.open_connection(addr, port)
+        return await tunnel_handshake(
+            reader, writer, self.identity, initiator=True, expected=expected)
+
+    async def ping(self, addr: str, port: int) -> float:
+        t0 = time.monotonic()
+        tunnel = await self.open_stream(addr, port)
+        await tunnel.send({"t": "ping"})
+        assert await tunnel.recv() == {"t": "pong"}
+        tunnel.close()
+        return time.monotonic() - t0
+
+    async def spacedrop(self, addr: str, port: int, file_path: str,
+                        on_progress=None) -> str:
+        """Send a file to a peer; returns 'sent'|'rejected'|'cancelled'
+        (p2p_manager.rs spacedrop flow)."""
+        size = os.path.getsize(file_path)
+        req = SpaceblockRequest(os.path.basename(file_path), size)
+        tunnel = await self.open_stream(addr, port)
+        try:
+            drop_id = uuidlib.uuid4().hex
+            await tunnel.send({"t": "spacedrop", "id": drop_id,
+                              "req": req.to_wire()})
+            verdict = await asyncio.wait_for(
+                tunnel.recv(), timeout=SPACEDROP_TIMEOUT_S)
+            if verdict != "accept":
+                return "rejected"
+            with open(file_path, "rb") as f:
+                ok = await send_file(tunnel, req, f, on_progress)
+            return "sent" if ok else "cancelled"
+        finally:
+            tunnel.close()
+
+    async def request_file(self, addr: str, port: int, library_id: str,
+                           location_id: int, file_path_id: int,
+                           out_path: str,
+                           range_start: Optional[int] = None,
+                           range_end: Optional[int] = None) -> bool:
+        """Fetch a file from a remote node's library
+        (files-over-p2p, custom_uri proxy path)."""
+        tunnel = await self.open_stream(addr, port)
+        try:
+            await tunnel.send({
+                "t": "file", "library_id": library_id,
+                "location_id": location_id, "file_path_id": file_path_id,
+                "range_start": range_start, "range_end": range_end})
+            resp = await tunnel.recv()
+            if not isinstance(resp, dict) or resp.get("status") != "ok":
+                return False
+            req = SpaceblockRequest.from_wire(resp["req"])
+            with open(out_path, "wb") as out:
+                return await receive_file(tunnel, req, out)
+        finally:
+            tunnel.close()
+
+    async def pair(self, addr: str, port: int, library) -> bool:
+        """Pair a library with a peer: exchange instance rows so sync can
+        flow (core/src/p2p/pairing/mod.rs protocol v1, simplified to one
+        round-trip of signed instance info)."""
+        sync = library.sync
+        me = library.db.query_one(
+            "SELECT * FROM instance WHERE pub_id = ?", (sync.instance,))
+        tunnel = await self.open_stream(addr, port)
+        try:
+            await tunnel.send({
+                "t": "pair",
+                "library_id": str(library.id),
+                "library_name": library.config.name,
+                "instance": {
+                    "pub_id": me["pub_id"], "identity":
+                        self.identity.to_remote_identity().to_bytes(),
+                    "node_id": self.node.config.id,
+                    "node_name": self.node.config.name,
+                },
+            })
+            resp = await tunnel.recv()
+            if not isinstance(resp, dict) or resp.get("status") != "accepted":
+                return False
+            inst = resp["instance"]
+            library.sync.register_instance(
+                inst["pub_id"], identity=inst["identity"],
+                node_id=inst["node_id"], node_name=inst["node_name"])
+            if self.networked is not None:
+                self.networked.learn_instance(
+                    library.id, inst["pub_id"],
+                    RemoteIdentity(inst["identity"]))
+            return True
+        finally:
+            tunnel.close()
+
+    # -- inbound dispatch (p2p_manager.rs event loop match Header) ---------
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        try:
+            tunnel = await tunnel_handshake(
+                reader, writer, self.identity, initiator=False)
+        except Exception:
+            writer.close()
+            return
+        try:
+            header = await tunnel.recv()
+            t = header.get("t") if isinstance(header, dict) else None
+            if t == "ping":
+                await tunnel.send({"t": "pong"})
+            elif t == "spacedrop":
+                await self._handle_spacedrop(tunnel, header)
+            elif t == "pair":
+                await self._handle_pair(tunnel, header)
+            elif t == "file":
+                await self._handle_file(tunnel, header)
+            elif t == "sync":
+                if self.networked is not None:
+                    await self.networked.handle_sync_stream(tunnel, header)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:
+            self.node.events.emit({"type": "P2PError", "error": str(e)})
+        finally:
+            tunnel.close()
+
+    async def _handle_spacedrop(self, tunnel: Tunnel, header: dict) -> None:
+        req = SpaceblockRequest.from_wire(header["req"])
+        save_path = self.on_spacedrop(tunnel.remote, req)
+        if save_path is None:
+            await tunnel.send("reject")
+            return
+        await tunnel.send("accept")
+        drop_id = header.get("id", "")
+        self._spacedrop_cancel[drop_id] = False
+        try:
+            with open(save_path, "wb") as out:
+                await receive_file(
+                    tunnel, req, out,
+                    should_cancel=lambda: self._spacedrop_cancel.get(
+                        drop_id, False))
+        finally:
+            self._spacedrop_cancel.pop(drop_id, None)
+        self.node.events.emit({
+            "type": "SpacedropReceived", "name": req.name,
+            "path": save_path, "from": tunnel.remote.to_bytes().hex()})
+
+    def cancel_spacedrop(self, drop_id: str) -> None:
+        if drop_id in self._spacedrop_cancel:
+            self._spacedrop_cancel[drop_id] = True
+
+    async def _handle_pair(self, tunnel: Tunnel, header: dict) -> None:
+        if not self.on_pairing_request(tunnel.remote, header):
+            await tunnel.send({"status": "rejected"})
+            return
+        lib = None
+        for candidate in self.node.libraries.list():
+            if str(candidate.id) == header["library_id"]:
+                lib = candidate
+                break
+        if lib is None:
+            # Pairing into a library we don't have yet: create it local.
+            lib = self.node.create_library(header.get(
+                "library_name", "paired library"))
+        inst = header["instance"]
+        lib.sync.register_instance(
+            inst["pub_id"], identity=inst["identity"],
+            node_id=inst["node_id"], node_name=inst["node_name"])
+        if self.networked is not None:
+            self.networked.learn_instance(
+                lib.id, inst["pub_id"], RemoteIdentity(inst["identity"]))
+        me = lib.db.query_one(
+            "SELECT * FROM instance WHERE pub_id = ?", (lib.sync.instance,))
+        await tunnel.send({"status": "accepted", "instance": {
+            "pub_id": me["pub_id"],
+            "identity": self.identity.to_remote_identity().to_bytes(),
+            "node_id": self.node.config.id,
+            "node_name": self.node.config.name,
+        }})
+
+    async def _handle_file(self, tunnel: Tunnel, header: dict) -> None:
+        from ..locations.paths import IsolatedPath
+        lib = self.node.libraries.get(
+            uuidlib.UUID(str(header["library_id"])))
+        if lib is None:
+            await tunnel.send({"status": "not_found"})
+            return
+        row = lib.db.query_one(
+            "SELECT * FROM file_path WHERE id = ? AND location_id = ?",
+            (int(header["file_path_id"]), int(header["location_id"])))
+        loc = lib.db.query_one(
+            "SELECT path FROM location WHERE id = ?",
+            (int(header["location_id"]),))
+        if row is None or loc is None or not loc["path"]:
+            await tunnel.send({"status": "not_found"})
+            return
+        iso = IsolatedPath.from_db_row(
+            int(header["location_id"]), bool(row["is_dir"]),
+            row["materialized_path"], row["name"] or "",
+            row["extension"] or "")
+        full = iso.join_on(loc["path"])
+        if not os.path.isfile(full):
+            await tunnel.send({"status": "not_found"})
+            return
+        req = SpaceblockRequest(
+            os.path.basename(full), os.path.getsize(full),
+            header.get("range_start"), header.get("range_end"))
+        await tunnel.send({"status": "ok", "req": req.to_wire()})
+        with open(full, "rb") as f:
+            await send_file(tunnel, req, f)

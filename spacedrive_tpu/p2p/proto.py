@@ -1,0 +1,158 @@
+"""Wire framing + authenticated-encrypted tunnel.
+
+Covers the roles of the reference's `proto.rs` (length-prefixed
+encode/decode helpers, /root/reference/crates/p2p/src/proto.rs) and
+`spacetunnel/tunnel.rs` (encrypted peer tunnel — a placeholder in the
+reference, real here): frames are u32-length-prefixed msgpack values; the
+tunnel runs an authenticated X25519 handshake (each side signs its
+ephemeral key with its ed25519 identity), derives directional
+ChaCha20-Poly1305 keys via HKDF, and seals every frame with a counter
+nonce. The reference's QUIC transport maps to asyncio TCP streams — the
+control plane stays host-side (SURVEY.md §2.6), ICI/DCN is only for
+device collectives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from typing import Any, Optional, Tuple
+
+import msgpack
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from .identity import Identity, RemoteIdentity
+
+MAX_FRAME = 64 * 1024 * 1024  # sanity cap
+
+
+class ProtoError(Exception):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME:
+        raise ProtoError(f"frame too large: {length}")
+    return await reader.readexactly(length)
+
+
+def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(struct.pack(">I", len(payload)) + payload)
+
+
+async def read_msg(reader: asyncio.StreamReader) -> Any:
+    return msgpack.unpackb(await read_frame(reader), raw=False,
+                           strict_map_key=False)
+
+
+def write_msg(writer: asyncio.StreamWriter, msg: Any) -> None:
+    write_frame(writer, msgpack.packb(msg, use_bin_type=True))
+
+
+class Tunnel:
+    """Encrypted, identity-authenticated frame stream over TCP."""
+
+    def __init__(self, reader, writer, send_key: bytes, recv_key: bytes,
+                 remote: RemoteIdentity):
+        self.reader = reader
+        self.writer = writer
+        self.remote = remote
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_ctr = 0
+        self._recv_ctr = 0
+
+    @staticmethod
+    def _nonce(counter: int) -> bytes:
+        return counter.to_bytes(12, "big")
+
+    async def send(self, msg: Any) -> None:
+        plain = msgpack.packb(msg, use_bin_type=True)
+        sealed = self._send.encrypt(self._nonce(self._send_ctr), plain, None)
+        self._send_ctr += 1
+        write_frame(self.writer, sealed)
+        await self.writer.drain()
+
+    async def recv(self) -> Any:
+        sealed = await read_frame(self.reader)
+        plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
+        self._recv_ctr += 1
+        return msgpack.unpackb(plain, raw=False, strict_map_key=False)
+
+    async def send_raw(self, data: bytes) -> None:
+        sealed = self._send.encrypt(self._nonce(self._send_ctr), data, None)
+        self._send_ctr += 1
+        write_frame(self.writer, sealed)
+        await self.writer.drain()
+
+    async def recv_raw(self) -> bytes:
+        sealed = await read_frame(self.reader)
+        plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
+        self._recv_ctr += 1
+        return plain
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+def _x25519_pub_bytes(key: X25519PrivateKey) -> bytes:
+    return key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+
+def _derive_keys(shared: bytes, salt: bytes) -> Tuple[bytes, bytes]:
+    okm = HKDF(algorithm=hashes.SHA256(), length=64, salt=salt,
+               info=b"spacedrive-tpu-tunnel-v1").derive(shared)
+    return okm[:32], okm[32:]
+
+
+async def tunnel_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    identity: Identity,
+    initiator: bool,
+    expected: Optional[RemoteIdentity] = None,
+) -> Tunnel:
+    """Authenticated key exchange → Tunnel.
+
+    Each side sends (identity_pub, ephemeral_pub, sig(ephemeral_pub ‖
+    transcript-nonce)) and verifies the peer's signature — a signed
+    ephemeral Diffie-Hellman, the real version of spacetunnel's
+    placeholder (tunnel.rs:17-42).
+    """
+    eph = X25519PrivateKey.generate()
+    my_pub = identity.to_remote_identity().to_bytes()
+    nonce = os.urandom(16)
+    write_msg(writer, {
+        "identity": my_pub,
+        "ephemeral": _x25519_pub_bytes(eph),
+        "nonce": nonce,
+        "sig": identity.sign(_x25519_pub_bytes(eph) + nonce),
+    })
+    await writer.drain()
+    hello = await read_msg(reader)
+    remote = RemoteIdentity(hello["identity"])
+    if expected is not None and remote != expected:
+        raise ProtoError("peer identity mismatch")
+    if not remote.verify(hello["sig"], hello["ephemeral"] + hello["nonce"]):
+        raise ProtoError("peer handshake signature invalid")
+    shared = eph.exchange(X25519PublicKey.from_public_bytes(
+        hello["ephemeral"]))
+    # Both sides derive the same salt; key order flips by role.
+    salt_material = sorted([nonce, hello["nonce"]])
+    salt = salt_material[0] + salt_material[1]
+    k1, k2 = _derive_keys(shared, salt)
+    send_key, recv_key = (k1, k2) if initiator else (k2, k1)
+    return Tunnel(reader, writer, send_key, recv_key, remote)
