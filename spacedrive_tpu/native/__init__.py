@@ -1,0 +1,256 @@
+"""ctypes bindings to the native I/O plane (native/sdio.cpp → libsdio.so).
+
+The native library supplies the batched file-staging and CPU-hash plane
+that the reference implements in Rust (tokio::fs + the blake3 crate,
+/root/reference/core/src/object/cas.rs, validation/hash.rs). Every entry
+point degrades gracefully: if the shared library is missing and no C++
+toolchain is available, `available()` is False and callers fall back to
+the pure-Python paths (ops/cas.py, ops/staging.py).
+
+pybind11 is not in this image, so the ABI is plain C over ctypes with
+numpy arrays as buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Status codes — must match `enum Status` in native/sdio.cpp.
+OK = 0
+ERR_OPEN = -1
+ERR_SHORT_READ = -2
+ERR_GREW = -3
+ERR_EMPTY = -4
+ERR_IO = -5
+
+STATUS_MESSAGES = {
+    ERR_OPEN: "cannot open file",
+    ERR_SHORT_READ: "short read",
+    ERR_GREW: "file grew past its declared size class",
+    ERR_EMPTY: "empty file",
+    ERR_IO: "I/O error",
+}
+
+# Mirrors of the constants baked into native/sdio.cpp; sourced from the
+# oracle module so a change there fails loudly here instead of silently
+# diverging from the compiled library.
+from ..ops.cas import LARGE_PAYLOAD_SIZE as LARGE_PAYLOAD  # noqa: E402
+from ..ops.cas import MINIMUM_FILE_SIZE as SMALL_CAP  # noqa: E402
+
+assert LARGE_PAYLOAD == 57344 and SMALL_CAP == 102400, (
+    "ops.cas sampling constants diverged from native/sdio.cpp — rebuild "
+    "and update the C++ constants together")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _native_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native")
+
+
+def _lib_path() -> str:
+    env = os.environ.get("SD_NATIVE_LIB")
+    if env:
+        return env
+    return os.path.join(_native_dir(), "build", "libsdio.so")
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    charpp = ctypes.POINTER(ctypes.c_char_p)
+
+    lib.sd_blake3.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.sd_blake3.restype = None
+    lib.sd_blake3_many.argtypes = [
+        ctypes.c_int64, u8p, ctypes.c_int64, i32p, u64p, u8p, ctypes.c_int]
+    lib.sd_blake3_many.restype = None
+    lib.sd_stage_large.argtypes = [
+        ctypes.c_int64, charpp, u64p, u8p, i32p, ctypes.c_int]
+    lib.sd_stage_large.restype = None
+    lib.sd_stage_small.argtypes = [
+        ctypes.c_int64, charpp, ctypes.c_uint64, u8p, i32p, i32p,
+        ctypes.c_int]
+    lib.sd_stage_small.restype = None
+    lib.sd_cas_digests.argtypes = [
+        ctypes.c_int64, charpp, u64p, u8p, i32p, ctypes.c_int]
+    lib.sd_cas_digests.restype = None
+    lib.sd_checksum_files.argtypes = [
+        ctypes.c_int64, charpp, u8p, i32p, ctypes.c_int]
+    lib.sd_checksum_files.restype = None
+    lib.sd_secure_erase.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.sd_secure_erase.restype = ctypes.c_int32
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _lib_path()
+        if "SD_NATIVE_LIB" not in os.environ:
+            # Always run make: its dependency tracking is a ~no-op when
+            # the .so is fresh and rebuilds it when sdio.cpp changed
+            # (loading a stale binary would silently diverge from the
+            # wrapper). Callers that must never block on a cold build
+            # warm this up at bootstrap (Node.__init__).
+            try:
+                subprocess.run(
+                    ["make", "-C", _native_dir()], check=True,
+                    capture_output=True, timeout=120)
+            except Exception:
+                if not os.path.exists(path):
+                    return None
+        try:
+            _lib = _declare(ctypes.CDLL(path))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _paths_array(paths: Sequence[str]):
+    arr = (ctypes.c_char_p * len(paths))()
+    arr[:] = [os.fsencode(p) for p in paths]
+    return arr
+
+
+def blake3_digest(data: bytes) -> bytes:
+    lib = _load()
+    assert lib is not None
+    out = np.zeros(32, dtype=np.uint8)
+    buf = np.frombuffer(data, dtype=np.uint8) if data else \
+        np.zeros(0, dtype=np.uint8)
+    lib.sd_blake3(_u8(buf), len(data), _u8(out))
+    return out.tobytes()
+
+
+def blake3_many(payloads: np.ndarray, lens: np.ndarray,
+                prefix_sizes: Optional[np.ndarray] = None,
+                n_threads: int = 0) -> np.ndarray:
+    """Hash each row of a dense [n, stride] uint8 array → [n, 32] digests.
+
+    With `prefix_sizes`, row i hashes le64(prefix_sizes[i]) ‖ row bytes —
+    the CAS-ID preimage (cas.rs:33).
+    """
+    lib = _load()
+    assert lib is not None
+    payloads = np.ascontiguousarray(payloads, dtype=np.uint8)
+    n, stride = payloads.shape
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    out = np.zeros((n, 32), dtype=np.uint8)
+    pre = None
+    if prefix_sizes is not None:
+        pre = np.ascontiguousarray(prefix_sizes, dtype=np.uint64)
+    lib.sd_blake3_many(
+        n, _u8(payloads), stride, _i32(lens),
+        _u64(pre) if pre is not None else None, _u8(out), n_threads)
+    return out
+
+
+def stage_large(paths: Sequence[str], sizes: np.ndarray,
+                n_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Sampled reads → ([n, 57344] uint8 payloads, [n] int32 status)."""
+    lib = _load()
+    assert lib is not None
+    n = len(paths)
+    sizes = np.ascontiguousarray(sizes, dtype=np.uint64)
+    out = np.zeros((n, LARGE_PAYLOAD), dtype=np.uint8)
+    status = np.zeros(n, dtype=np.int32)
+    if n:
+        lib.sd_stage_large(n, _paths_array(paths), _u64(sizes), _u8(out),
+                           _i32(status), n_threads)
+    return out, status
+
+
+def stage_small(paths: Sequence[str], cap: int = SMALL_CAP,
+                n_threads: int = 0
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Whole-file reads → ([n, cap+1] payloads, [n] lens, [n] status).
+
+    The extra column lets the native side detect files that grew past the
+    size class (ERR_GREW); callers slice [:, :cap].
+    """
+    lib = _load()
+    assert lib is not None
+    n = len(paths)
+    out = np.zeros((n, cap + 1), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    status = np.zeros(n, dtype=np.int32)
+    if n:
+        lib.sd_stage_small(n, _paths_array(paths), cap, _u8(out),
+                           _i32(lens), _i32(status), n_threads)
+    return out, lens, status
+
+
+def cas_digests(paths: Sequence[str], sizes: np.ndarray,
+                n_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused stage+hash: ([n, 32] digests, [n] status). ERR_EMPTY marks
+    empty files (no CAS ID)."""
+    lib = _load()
+    assert lib is not None
+    n = len(paths)
+    sizes = np.ascontiguousarray(sizes, dtype=np.uint64)
+    digests = np.zeros((n, 32), dtype=np.uint8)
+    status = np.zeros(n, dtype=np.int32)
+    if n:
+        lib.sd_cas_digests(n, _paths_array(paths), _u64(sizes),
+                           _u8(digests), _i32(status), n_threads)
+    return digests, status
+
+
+def checksum_files(paths: Sequence[str],
+                   n_threads: int = 0) -> Tuple[List[Optional[str]],
+                                                np.ndarray]:
+    """Full-file BLAKE3 checksums → ([n] hex-or-None, [n] status)."""
+    lib = _load()
+    assert lib is not None
+    n = len(paths)
+    digests = np.zeros((n, 32), dtype=np.uint8)
+    status = np.zeros(n, dtype=np.int32)
+    if n:
+        lib.sd_checksum_files(n, _paths_array(paths), _u8(digests),
+                              _i32(status), n_threads)
+    hexes: List[Optional[str]] = [
+        digests[i].tobytes().hex() if status[i] == OK else None
+        for i in range(n)
+    ]
+    return hexes, status
+
+
+def secure_erase(path: str, passes: int = 1) -> None:
+    lib = _load()
+    assert lib is not None
+    rc = lib.sd_secure_erase(os.fsencode(path), passes)
+    if rc != OK:
+        raise OSError(
+            f"secure_erase({path!r}): "
+            f"{STATUS_MESSAGES.get(rc, f'status {rc}')}")

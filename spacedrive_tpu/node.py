@@ -163,6 +163,10 @@ class Node:
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
         self._started = False
         self.libraries.on_event(self._on_library_event)
+        # Warm the native I/O plane at bootstrap (may compile libsdio.so
+        # once) so watcher-triggered hot paths never hit a cold build.
+        from . import native as _native
+        _native.available()
 
     # -- lifecycle (ordering-sensitive: lib.rs:134-138) --------------------
 

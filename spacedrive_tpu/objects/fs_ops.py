@@ -209,6 +209,11 @@ class FileEraserJob(_FsJobBase):
                 return
             if not os.path.exists(full):
                 return  # replayed step: already erased
+            from .. import native
+            if native.available():
+                native.secure_erase(full, passes=max(1, self.passes))
+                os.remove(full)
+                return
             size = os.path.getsize(full)
             with open(full, "r+b") as f:
                 for _ in range(max(1, self.passes)):

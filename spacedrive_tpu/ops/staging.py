@@ -72,6 +72,50 @@ def _read_large(path: str, size: int, out: np.ndarray) -> None:
         out[pos:pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
 
 
+def _stage_files_native(
+    files, large_idx, small_idx, empty_idx,
+) -> Tuple[StagedBatch, StagedBatch, List[int], Dict[int, str]]:
+    """Native plane staging (native/sdio.cpp): pooled pread into dense
+    rows, no Python in the per-file loop."""
+    from .. import native
+
+    errors: Dict[int, str] = {}
+    sizes = np.array([s for _, s in files], dtype=np.uint64)
+
+    lpaths = [files[i][0] for i in large_idx]
+    large, lstatus = native.stage_large(
+        lpaths, sizes[large_idx] if large_idx else np.zeros(0, np.uint64))
+    spaths = [files[i][0] for i in small_idx]
+    small_wide, slens, sstatus = native.stage_small(
+        spaths, cap=cas.MINIMUM_FILE_SIZE)
+    small = small_wide[:, :cas.MINIMUM_FILE_SIZE]
+
+    def filter_ok(idx_list, payloads, status, lens=None):
+        bad_rows = np.nonzero(status != native.OK)[0]
+        for row in bad_rows:
+            errors[idx_list[row]] = (
+                f"{files[idx_list[row]][0]}: "
+                f"{native.STATUS_MESSAGES.get(int(status[row]), 'error')}")
+        if len(bad_rows) == 0:
+            return idx_list, payloads, lens
+        ok = np.nonzero(status == native.OK)[0]
+        return ([idx_list[r] for r in ok], payloads[ok],
+                lens[ok] if lens is not None else None)
+
+    large_idx, large, _ = filter_ok(large_idx, large, lstatus)
+    small_idx, small, slens = filter_ok(small_idx, small, sstatus, slens)
+
+    large_batch = StagedBatch(
+        large_idx, large,
+        sizes[large_idx] if large_idx else np.zeros(0, np.uint64),
+        np.full((len(large_idx),), cas.LARGE_PAYLOAD_SIZE, dtype=np.int32))
+    small_batch = StagedBatch(
+        small_idx, small,
+        sizes[small_idx] if small_idx else np.zeros(0, np.uint64),
+        slens if slens is not None else np.zeros(0, np.int32))
+    return large_batch, small_batch, empty_idx, errors
+
+
 def stage_files(
     files: Sequence[Tuple[str, int]],
 ) -> Tuple[StagedBatch, StagedBatch, List[int], Dict[int, str]]:
@@ -87,6 +131,10 @@ def stage_files(
                  if 0 < s <= cas.MINIMUM_FILE_SIZE]
     empty_idx = [i for i, (_, s) in enumerate(files) if s == 0]
     errors: Dict[int, str] = {}
+
+    from .. import native as _native
+    if _native.available():
+        return _stage_files_native(files, large_idx, small_idx, empty_idx)
 
     large = np.zeros((len(large_idx), cas.LARGE_PAYLOAD_SIZE), dtype=np.uint8)
     small = np.zeros((len(small_idx), cas.MINIMUM_FILE_SIZE), dtype=np.uint8)
@@ -178,21 +226,43 @@ _BACKENDS = {
 
 
 # Below this batch size the device round-trip (dispatch + possible first
-# compile) costs more than the numpy path; watcher-triggered single-file
+# compile) costs more than the CPU path; watcher-triggered single-file
 # updates must never block on accelerator init.
 JAX_MIN_BATCH = 64
 
 
 def default_backend(batch_size: int = JAX_MIN_BATCH) -> str:
-    """"jax" for device-worthy batches when jax is importable, else the
-    batched numpy path."""
+    """"jax" for device-worthy batches when jax is importable; below that
+    the fused native C++ path when built, else batched numpy."""
+    from .. import native as _native
     if batch_size < JAX_MIN_BATCH:
-        return "numpy"
+        return "native" if _native.available() else "numpy"
     try:
         import jax  # noqa: F401
         return "jax"
     except Exception:
-        return "numpy"
+        return "native" if _native.available() else "numpy"
+
+
+def _cas_ids_native_fused(
+    files: Sequence[Tuple[str, int]],
+) -> Tuple[Dict[int, Optional[str]], Dict[int, str]]:
+    """Fused native stage+hash — one C call for the whole batch."""
+    from .. import native
+
+    digests, status = native.cas_digests(
+        [p for p, _ in files], np.array([s for _, s in files], np.uint64))
+    ids: Dict[int, Optional[str]] = {}
+    errors: Dict[int, str] = {}
+    for i, st in enumerate(status):
+        if st == native.OK:
+            ids[i] = digests[i].tobytes().hex()[:16]
+        elif st == native.ERR_EMPTY:
+            ids[i] = None  # no CAS ID for empty files (mod.rs:86)
+        else:
+            errors[i] = (f"{files[i][0]}: "
+                         f"{native.STATUS_MESSAGES.get(int(st), 'error')}")
+    return ids, errors
 
 
 def cas_ids_for_files(
@@ -204,6 +274,8 @@ def cas_ids_for_files(
     """
     if backend == "auto":
         backend = default_backend(len(files))
+    if backend == "native":
+        return _cas_ids_native_fused(files)
     large, small, empty_idx, errors = stage_files(files)
     ids: Dict[int, Optional[str]] = dict(
         _BACKENDS[backend](files, large, small))

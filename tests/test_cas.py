@@ -78,3 +78,27 @@ def test_checksum(tmp_path):
     got = file_checksum(p)
     assert got == blake3_hex(data)
     assert len(got) == 64
+
+
+def test_backends_agree_on_real_files(tmp_path):
+    """oracle / numpy / native(if built) produce identical CAS IDs."""
+    from spacedrive_tpu import native
+    from spacedrive_tpu.ops.staging import cas_ids_for_files
+
+    rng = random.Random(5)
+    files = []
+    for i, size in enumerate([0, 17, 1024, MINIMUM_FILE_SIZE,
+                              MINIMUM_FILE_SIZE + 1, 250_000, 800_000]):
+        p = make_file(tmp_path, f"b{i}.bin",
+                      bytes(rng.getrandbits(8) for _ in range(size)))
+        files.append((str(p), size))
+
+    oracle, err = cas_ids_for_files(files, backend="oracle")
+    assert not err
+    numpy_ids, err = cas_ids_for_files(files, backend="numpy")
+    assert not err
+    assert numpy_ids == oracle
+    if native.available():
+        native_ids, err = cas_ids_for_files(files, backend="native")
+        assert not err
+        assert native_ids == oracle

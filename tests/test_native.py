@@ -1,0 +1,119 @@
+"""Native plane (native/sdio.cpp) parity vs the Python oracle.
+
+Everything here is skipped when no C++ toolchain/shared library is
+available; the framework then runs on its pure-Python fallbacks.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu import native
+from spacedrive_tpu.ops import cas
+from spacedrive_tpu.ops.blake3_ref import blake3_hex
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native libsdio unavailable")
+
+
+def _pattern(n: int) -> bytes:
+    # Official BLAKE3 test-vector input: repeating 0..250 byte pattern.
+    return bytes(i % 251 for i in range(n))
+
+
+@pytest.mark.parametrize("n", [0, 1, 63, 64, 65, 1023, 1024, 1025,
+                               2048, 3072, 5000, 102400, 200000])
+def test_blake3_one_shot_parity(n):
+    data = _pattern(n)
+    assert native.blake3_digest(data).hex() == blake3_hex(data)
+
+
+def test_blake3_many_with_prefix():
+    rng = np.random.default_rng(7)
+    payloads = rng.integers(0, 256, size=(5, 3000), dtype=np.uint8)
+    lens = np.array([0, 1, 64, 1500, 3000], dtype=np.int32)
+    sizes = np.array([10, 20, 30, 40, 50], dtype=np.uint64)
+    out = native.blake3_many(payloads, lens, sizes)
+    for i in range(5):
+        expect = cas.cas_id_of_payload(
+            int(sizes[i]), payloads[i, :lens[i]].tobytes())
+        assert out[i].tobytes().hex()[:16] == expect
+
+
+def test_stage_and_cas_digests_parity(tmp_path):
+    rng = np.random.default_rng(3)
+    files = []
+    for i, size in enumerate([0, 5, 1024, 100 * 1024,          # small class
+                              100 * 1024 + 1, 300_000, 1_000_000]):  # large
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        files.append((str(p), size))
+    paths = [p for p, _ in files]
+    sizes = np.array([s for _, s in files], dtype=np.uint64)
+
+    digests, status = native.cas_digests(paths, sizes)
+    for i, (p, s) in enumerate(files):
+        if s == 0:
+            assert status[i] == native.ERR_EMPTY
+        else:
+            assert status[i] == native.OK
+            assert digests[i].tobytes().hex()[:16] == cas.generate_cas_id(p, s)
+
+    # Staging primitives produce the same payloads the oracle hashes.
+    large = [(p, s) for p, s in files if s > cas.MINIMUM_FILE_SIZE]
+    payloads, st = native.stage_large(
+        [p for p, _ in large], np.array([s for _, s in large], np.uint64))
+    assert (st == native.OK).all()
+    for row, (p, s) in enumerate(large):
+        with open(p, "rb") as f:
+            assert payloads[row].tobytes() == cas.read_sampled_payload(f, s)
+
+    small = [(p, s) for p, s in files if 0 < s <= cas.MINIMUM_FILE_SIZE]
+    payloads, lens, st = native.stage_small([p for p, _ in small])
+    assert (st == native.OK).all()
+    for row, (p, s) in enumerate(small):
+        assert lens[row] == s
+        assert payloads[row, :s].tobytes() == open(p, "rb").read()
+
+
+def test_stage_errors(tmp_path):
+    missing = str(tmp_path / "nope.bin")
+    _, status = native.stage_large([missing], np.array([200000], np.uint64))
+    assert status[0] == native.ERR_OPEN
+
+    # Declared far larger than reality → short sampled read.
+    p = tmp_path / "trunc.bin"
+    p.write_bytes(b"x" * 1000)
+    _, status = native.stage_large([str(p)], np.array([500000], np.uint64))
+    assert status[0] == native.ERR_SHORT_READ
+
+    # Small file that grew past its class.
+    p2 = tmp_path / "grew.bin"
+    p2.write_bytes(b"y" * (native.SMALL_CAP + 10))
+    _, _, status = native.stage_small([str(p2)])
+    assert status[0] == native.ERR_GREW
+
+
+def test_checksums_parity(tmp_path):
+    rng = np.random.default_rng(11)
+    paths = []
+    for i, size in enumerate([0, 100, 1 << 20, (1 << 20) + 17]):
+        p = tmp_path / f"c{i}.bin"
+        p.write_bytes(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        paths.append(str(p))
+    hexes, status = native.checksum_files(paths)
+    assert (status == native.OK).all()
+    for p, h in zip(paths, hexes):
+        assert h == cas.file_checksum(p)
+
+
+def test_secure_erase(tmp_path):
+    p = tmp_path / "secret.bin"
+    p.write_bytes(b"top secret" * 1000)
+    size = p.stat().st_size
+    native.secure_erase(str(p), passes=2)
+    data = p.read_bytes()
+    assert len(data) == size
+    assert data == b"\x00" * size  # final pass zeroes
+    assert b"top secret" not in data
