@@ -351,19 +351,6 @@ def build_cas_messages(payloads: np.ndarray, sizes: np.ndarray, payload_lens=Non
 
 
 def digests_to_cas_ids(digests) -> list:
-    """[B, 8] uint32 device digests → 16-hex-char CAS IDs."""
-    le = np.asarray(digests).astype("<u4")
-    return [le[i].tobytes()[:8].hex() for i in range(le.shape[0])]
-
-
-def digests_to_hex(digests) -> list:
-    le = np.asarray(digests).astype("<u4")
-    return [le[i].tobytes().hex() for i in range(le.shape[0])]
-
-
-
-
-def digests_to_cas_ids(digests) -> list:
     """[B, 8] uint32 digests → 16-hex-char CAS IDs (cas.rs:61)."""
     le = np.asarray(digests).astype("<u4")
     return [le[i].tobytes()[:8].hex() for i in range(le.shape[0])]

@@ -1,0 +1,221 @@
+"""Pallas TPU kernel for the BLAKE3 chunk stage.
+
+The jnp path (blake3_jax) expresses the compression as large fused
+elementwise graphs; XLA schedules them well but pays for the stacked
+[4, B, C] row layout, the per-round rolls that realign diagonals, and the
+scan carry. This kernel instead keeps the whole 16-word state in vector
+registers over a [S, 128] lane tile and unrolls the 16 block
+compressions × 7 rounds with a static message-index schedule — zero data
+movement inside a chunk, exactly one VMEM read per message word and one
+write per CV word.
+
+Layout: a "lane" is one chunk of one file. The [B, C, 256] word grid is
+transposed once on device to word-major [256, L] (L = B·C padded to the
+lane-tile size) so that each message word j is a contiguous [S, 128]
+vector load. Per-lane metadata (chunk byte counts, counters, flags
+inputs) comes from the same `chunk_prelude` helper the numpy/jnp
+backends use, so masking and flag semantics cannot diverge.
+
+The tree reduction stays in jnp (blake3_batch.tree_reduce): it is
+≤ 1/16th of the chunk-stage work and bottoms out in log2(C) tiny steps.
+
+Reference semantics: the blake3 crate as driven by
+/root/reference/core/src/object/cas.rs:23-62 and
+core/src/object/validation/hash.rs:10-24.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .blake3_ref import (
+    BLOCK_LEN,
+    CHUNK_END,
+    CHUNK_START,
+    IV,
+    MSG_PERMUTATION,
+    ROOT,
+)
+from .blake3_batch import BLOCKS_PER_CHUNK, WORDS_PER_BLOCK, chunk_prelude
+
+# Lane tile: S sublanes × 128 lanes of uint32. 16 keeps the double-
+# buffered message block (2 × 256×16×128×4 B = 4 MiB) well under VMEM.
+TILE_S = 8
+TILE_LANES = TILE_S * 128
+
+# Static message-index schedule: round r reads word m[_SCHEDULE[r][i]].
+_SCHEDULE = [list(range(16))]
+for _ in range(6):
+    _SCHEDULE.append([_SCHEDULE[-1][p] for p in MSG_PERMUTATION])
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_tile(cv, m, counter_lo, counter_hi, block_len, flags):
+    """One BLAKE3 compression over a lane tile, fully in registers.
+
+    cv: list of 8 [S,128] uint32; m: list of 16; scalars-per-lane for
+    counter/len/flags. Returns the 8-word output CV.
+    """
+    v = list(cv) + [
+        jnp.full_like(cv[0], IV[0]),
+        jnp.full_like(cv[0], IV[1]),
+        jnp.full_like(cv[0], IV[2]),
+        jnp.full_like(cv[0], IV[3]),
+        counter_lo, counter_hi, block_len, flags,
+    ]
+
+    def g(a, b, c, d, mx, my):
+        v[a] = v[a] + v[b] + mx
+        v[d] = _rotr(v[d] ^ v[a], 16)
+        v[c] = v[c] + v[d]
+        v[b] = _rotr(v[b] ^ v[c], 12)
+        v[a] = v[a] + v[b] + my
+        v[d] = _rotr(v[d] ^ v[a], 8)
+        v[c] = v[c] + v[d]
+        v[b] = _rotr(v[b] ^ v[c], 7)
+
+    for r in range(7):
+        s = _SCHEDULE[r]
+        g(0, 4, 8, 12, m[s[0]], m[s[1]])
+        g(1, 5, 9, 13, m[s[2]], m[s[3]])
+        g(2, 6, 10, 14, m[s[4]], m[s[5]])
+        g(3, 7, 11, 15, m[s[6]], m[s[7]])
+        g(0, 5, 10, 15, m[s[8]], m[s[9]])
+        g(1, 6, 11, 12, m[s[10]], m[s[11]])
+        g(2, 7, 8, 13, m[s[12]], m[s[13]])
+        g(3, 4, 9, 14, m[s[14]], m[s[15]])
+
+    return [v[i] ^ v[i + 8] for i in range(8)]
+
+
+def _chunk_kernel(words_ref, cb_ref, klast_ref, single_ref, empty0_ref,
+                  clo_ref, chi_ref, out_ref):
+    """Chunk stage for one lane tile.
+
+    words_ref:  [256, 1, S, 128] — message words, word-major.
+    cb/klast/clo/chi: [1, S, 128] int32/uint32 per-lane metadata.
+    single/empty0:    [1, S, 128] int32 (0/1) flags.
+    out_ref:    [8, 1, S, 128] — the per-chunk chaining value.
+    """
+    chunk_bytes = cb_ref[0]
+    k_last = klast_ref[0]
+    single = single_ref[0] != 0
+    empty0 = empty0_ref[0] != 0
+    counter_lo = clo_ref[0]
+    counter_hi = chi_ref[0]
+
+    u32 = lambda x: jnp.asarray(x, dtype=jnp.uint32)  # noqa: E731
+    cv = [jnp.full_like(counter_lo, IV[i]) for i in range(8)]
+
+    for k in range(BLOCKS_PER_CHUNK):
+        block_len = jnp.clip(chunk_bytes - k * BLOCK_LEN, 0, BLOCK_LEN)
+        is_last = k_last == k
+        active = (block_len > 0) | (empty0 if k == 0 else False)
+        flags = (
+            (u32(CHUNK_START) if k == 0 else u32(0))
+            + jnp.where(is_last, u32(CHUNK_END), u32(0))
+            + jnp.where(is_last & single, u32(ROOT), u32(0))
+        )
+        m = [words_ref[k * WORDS_PER_BLOCK + j, 0]
+             for j in range(WORDS_PER_BLOCK)]
+        new_cv = _compress_tile(
+            cv, m, counter_lo, counter_hi,
+            block_len.astype(jnp.uint32), flags)
+        cv = [jnp.where(active, n, c) for n, c in zip(new_cv, cv)]
+
+    for i in range(8):
+        out_ref[i, 0] = cv[i]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _chunk_cvs_pallas(words, lengths, clo, chi, whole_mask,
+                      interpret: bool = False):
+    """[B, C, 256] words → per-chunk CVs, list of 8 [B, C] uint32.
+
+    clo/chi: [B] uint32 counter base per file; whole_mask: [B] bool.
+    """
+    B, C, W = words.shape
+    (chunk_bytes, n_chunks, single, k_last, counter_lo, counter_hi,
+     empty0) = chunk_prelude(jnp, lengths, C, (clo, chi),
+                             whole_mask[:, None])
+
+    L = B * C
+    NT = -(-L // TILE_LANES)
+    pad = NT * TILE_LANES - L
+
+    def lanes(a, dtype):
+        flat = jnp.broadcast_to(a, (B, C)).astype(dtype).reshape(L)
+        flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(NT, TILE_S, 128)
+
+    words_t = words.reshape(L, W).T  # [256, L]
+    words_t = jnp.pad(words_t, ((0, 0), (0, pad)))
+    words_t = words_t.reshape(W, NT, TILE_S, 128)
+
+    out = pl.pallas_call(
+        _chunk_kernel,
+        grid=(NT,),
+        in_specs=[
+            pl.BlockSpec((W, 1, TILE_S, 128), lambda i: (0, i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ] + [
+            pl.BlockSpec((1, TILE_S, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+            for _ in range(6)
+        ],
+        out_specs=pl.BlockSpec((8, 1, TILE_S, 128), lambda i: (0, i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, NT, TILE_S, 128), jnp.uint32),
+        interpret=interpret,
+    )(
+        words_t,
+        lanes(chunk_bytes, jnp.int32),
+        lanes(k_last, jnp.int32),
+        lanes(single, jnp.int32),
+        lanes(empty0, jnp.int32),
+        lanes(counter_lo, jnp.uint32),
+        lanes(counter_hi, jnp.uint32),
+    )
+
+    cvs = out.reshape(8, NT * TILE_S * 128)[:, :L].reshape(8, B, C)
+    return [cvs[i] for i in range(8)], n_chunks
+
+
+def chunk_cvs_pallas(words, lengths, counter_base=0, whole=True,
+                     interpret: bool = False):
+    """Drop-in device replacement for blake3_batch.chunk_cvs semantics."""
+    from .blake3_batch import split_counter_base
+
+    B = words.shape[0]
+    lo, hi = split_counter_base(counter_base)
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.uint32), (B,))
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.uint32), (B,))
+    whole_mask = jnp.broadcast_to(jnp.asarray(whole, bool), (B,))
+    return _chunk_cvs_pallas(words, jnp.asarray(lengths, jnp.int32),
+                             lo, hi, whole_mask, interpret=interpret)
+
+
+def blake3_words_pallas(words, lengths, interpret: bool = False):
+    """[B, C, 256] words + [B] lengths → [B, 8] digests (Pallas chunk
+    stage + jnp tree reduction)."""
+    from .blake3_batch import tree_reduce
+
+    cvs, n_chunks = chunk_cvs_pallas(words, lengths, interpret=interpret)
+    return jnp.stack(tree_reduce(jnp, cvs, n_chunks), axis=1)
+
+
+def supported() -> bool:
+    """True when the default JAX backend can compile this kernel."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
