@@ -49,6 +49,7 @@ def register_all(router: Router) -> None:
     _nodes(router)
     _auth(router)
     _backups(router)
+    _p2p(router)
     _invalidation(router)
 
 
@@ -628,6 +629,31 @@ def _files(r: Router) -> None:
             target_relative_directory=str(
                 input.get("target_relative_directory", ""))))
 
+    @r.mutation("files.encryptFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_encrypt(node, library, input):
+        from ..objects.crypto_ops import FileEncryptorJob
+        return await _spawn_fs_job(node, library, FileEncryptorJob(
+            location_id=int(input["location_id"]),
+            file_path_ids=[int(i) for i in input["file_path_ids"]],
+            password=str(input["password"]),
+            algorithm=str(input.get("algorithm", "XChaCha20Poly1305")),
+            hashing_algorithm=str(
+                input.get("hashing_algorithm", "Argon2id")),
+            params=str(input.get("params", "Standard")),
+            with_metadata=bool(input.get("with_metadata", True)),
+            erase_original=bool(input.get("erase_original", False))))
+
+    @r.mutation("files.decryptFiles", library=True,
+                invalidates=["search.paths"])
+    async def files_decrypt(node, library, input):
+        from ..objects.crypto_ops import FileDecryptorJob
+        return await _spawn_fs_job(node, library, FileDecryptorJob(
+            location_id=int(input["location_id"]),
+            file_path_ids=[int(i) for i in input["file_path_ids"]],
+            password=str(input["password"]),
+            output_path=input.get("output_path")))
+
     @r.query("files.getConvertableImageExtensions")
     def files_convertable(node, _input):
         return ["png", "jpeg", "jpg", "webp", "bmp", "gif", "tiff"]
@@ -1036,6 +1062,79 @@ def _backups(r: Router) -> None:
 
 
 # -- invalidation. (api/utils/invalidate.rs) -------------------------------
+
+# -- p2p. (api/p2p.rs: events, state, spacedrop, acceptSpacedrop,
+#    cancelSpacedrop, pair) --------------------------------------------------
+
+def _p2p(r: Router) -> None:
+    def _mgr(node):
+        if node.p2p is None:
+            raise RpcError("BAD_REQUEST", "p2p is not started on this node")
+        return node.p2p
+
+    @r.query("p2p.state")
+    def p2p_state(node, _input):
+        if node.p2p is None:
+            return {"enabled": False, "peers": []}
+        disc = node.p2p.discovery
+        peers = []
+        if disc is not None:
+            for peer in disc.peers.values():
+                peers.append({
+                    "identity": peer.identity.to_bytes().hex(),
+                    "addr": peer.addr, "port": peer.port,
+                    "metadata": peer.metadata,
+                })
+        return {
+            "enabled": True,
+            "identity": node.p2p.identity.to_remote_identity()
+                        .to_bytes().hex(),
+            "port": node.p2p.port,
+            "peers": peers,
+        }
+
+    @r.subscription("p2p.events")
+    def p2p_events(node, _input, emit):
+        def on_event(e):
+            if str(e.get("type", "")).startswith(("Spacedrop", "P2P",
+                                                  "Discovered")):
+                emit(e)
+        return node.events.subscribe(on_event)
+
+    @r.mutation("p2p.spacedrop")
+    async def p2p_spacedrop(node, input):
+        mgr = _mgr(node)
+        return await mgr.spacedrop(
+            str(input["addr"]), int(input["port"]),
+            str(input["file_path"]))
+
+    @r.mutation("p2p.acceptSpacedrop")
+    def p2p_accept_spacedrop(node, input):
+        mgr = _mgr(node)
+        drop_id = str(input["id"])
+        # rspc signature: Some(path) accepts, None rejects
+        # (api/p2p.rs acceptSpacedrop).
+        path = input.get("path")
+        if path:
+            return mgr.accept_spacedrop(drop_id, str(path))
+        return mgr.reject_spacedrop(drop_id)
+
+    @r.mutation("p2p.cancelSpacedrop")
+    def p2p_cancel_spacedrop(node, input):
+        _mgr(node).cancel_spacedrop(str(input["id"]))
+        return None
+
+    @r.mutation("p2p.pair", library=True)
+    async def p2p_pair(node, library, input):
+        mgr = _mgr(node)
+        return await mgr.pair(str(input["addr"]), int(input["port"]),
+                              library)
+
+    @r.mutation("p2p.debugPing")
+    async def p2p_debug_ping(node, input):
+        mgr = _mgr(node)
+        return await mgr.ping(str(input["addr"]), int(input["port"]))
+
 
 def _invalidation(r: Router) -> None:
     @r.subscription("invalidation.listen")

@@ -230,9 +230,12 @@ async def serve(data_dir: str, host: str = "127.0.0.1",
     from ..node import Node
     node = Node(data_dir)
     await node.start()
+    p2p_port = await node.start_p2p()
+    node.p2p.interactive_spacedrop = True  # offers flow through p2p.events
     server = ApiServer(node)
     actual = await server.start(host, port)
-    print(f"spacedrive_tpu server listening on {host}:{actual}")
+    print(f"spacedrive_tpu server listening on {host}:{actual} "
+          f"(p2p on {p2p_port})")
     try:
         while True:
             await asyncio.sleep(3600)

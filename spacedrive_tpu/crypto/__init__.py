@@ -1,0 +1,31 @@
+"""Crypto subsystem: stream encryption, key hashing, headers, key manager.
+
+Native-capability equivalent of the reference's `sd-crypto` crate
+(/root/reference/crates/crypto): authenticated STREAM encryption
+(XChaCha20-Poly1305, AES-256-GCM) in 1 MiB blocks, password hashing
+(Argon2id, Balloon-BLAKE3), an encrypted-file header with up to two
+keyslots, a BLAKE3 derive-key KDF with fixed context strings, an
+in-memory key manager with a file-backed keyring, and secure erase.
+
+The wire/header format is this framework's own versioned layout (the
+reference's is tied to Rust aead crate internals); the cryptographic
+constructions match: LE31 STREAM block chaining, 48-byte encrypted master
+keys, 16-byte salts, hashed-password → master-key keyslots.
+"""
+
+from .primitives import (  # noqa: F401
+    AEAD_TAG_LEN,
+    BLOCK_LEN,
+    ENCRYPTED_KEY_LEN,
+    KEY_LEN,
+    SALT_LEN,
+    SECRET_KEY_LEN,
+    Protected,
+    generate_master_key,
+    generate_salt,
+)
+from .stream import Algorithm, Decryptor, Encryptor  # noqa: F401
+from .hashing import HashingAlgorithm, Params, hash_password  # noqa: F401
+from .header import FileHeader, Keyslot  # noqa: F401
+from .keymanager import KeyManager  # noqa: F401
+from .erase import secure_erase  # noqa: F401

@@ -1,0 +1,40 @@
+"""Secure erase: overwrite-then-unlink.
+
+Same contract as the reference's sd-crypto erase
+(crates/crypto/src/fs/erase.rs): overwrite the file's bytes with
+`passes` rounds of random data, fsyncing between rounds, before the
+caller unlinks it. The hot implementation is the native C++ plane
+(native/sdio.cpp sd_secure_erase); this module adds the pure-Python
+fallback so erase works before the native library is built.
+"""
+
+from __future__ import annotations
+
+import os
+
+_BLOCK = 1_048_576
+
+
+def _erase_python(path: str, passes: int) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b", buffering=0) as f:
+        for _ in range(max(1, passes)):
+            f.seek(0)
+            remaining = size
+            while remaining > 0:
+                n = min(_BLOCK, remaining)
+                f.write(os.urandom(n))
+                remaining -= n
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def secure_erase(path: str, passes: int = 1, unlink: bool = False) -> None:
+    from .. import native
+
+    if native.available():
+        native.secure_erase(path, passes)
+    else:
+        _erase_python(path, passes)
+    if unlink:
+        os.unlink(path)

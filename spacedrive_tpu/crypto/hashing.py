@@ -1,0 +1,107 @@
+"""Password hashing: Argon2id and Balloon-BLAKE3.
+
+The reference supports exactly these two algorithms, each at
+Standard/Hardened/Paranoid cost levels
+(crates/crypto/src/types.rs:51-54, keys/hashing.rs). Argon2id runs
+through the installed `argon2` package; Balloon hashing (Boneh–Corrigan-
+Gibbs–Schechter 2016) is implemented here over the framework's own
+BLAKE3 (ops/blake3_ref), single-threaded with the standard delta=3
+neighbor sampling. Both consume an optional 18-byte "secret key" as
+additional keying material, as the reference does.
+
+Cost levels are calibrated for this runtime rather than copied: Argon2id
+uses the reference-class memory costs; Balloon's pure-Python space costs
+are scaled down ~64× (it is a compatibility/portability path, not the
+default) — the parameter block is recorded in the keyslot so hashes
+always re-verify with the parameters they were created with.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+
+from .primitives import KEY_LEN, SALT_LEN, Protected
+
+
+class Params(enum.Enum):
+    STANDARD = "Standard"
+    HARDENED = "Hardened"
+    PARANOID = "Paranoid"
+
+
+# Argon2id: (memory KiB, iterations, parallelism)
+_ARGON2_COSTS = {
+    Params.STANDARD: (131072, 8, 4),
+    Params.HARDENED: (262144, 8, 4),
+    Params.PARANOID: (524288, 8, 4),
+}
+
+# Balloon-BLAKE3: (space_cost blocks of 64 B, time_cost rounds)
+_BALLOON_COSTS = {
+    Params.STANDARD: (2048, 2),
+    Params.HARDENED: (4096, 2),
+    Params.PARANOID: (8192, 2),
+}
+
+
+class HashingAlgorithm(enum.Enum):
+    ARGON2ID = "Argon2id"
+    BALLOON_BLAKE3 = "BalloonBlake3"
+
+    def hash(self, password: Protected, salt: bytes, params: Params,
+             secret: Protected | None = None) -> Protected:
+        if len(salt) != SALT_LEN:
+            raise ValueError("salt must be 16 bytes")
+        pw = password.expose()
+        if secret is not None:
+            pw = pw + secret.expose()
+        if self is HashingAlgorithm.ARGON2ID:
+            return _argon2id(pw, salt, params)
+        return _balloon_blake3(pw, salt, params)
+
+
+def _argon2id(password: bytes, salt: bytes, params: Params) -> Protected:
+    from argon2.low_level import Type, hash_secret_raw
+
+    memory, iters, lanes = _ARGON2_COSTS[params]
+    raw = hash_secret_raw(
+        secret=password, salt=salt, time_cost=iters, memory_cost=memory,
+        parallelism=lanes, hash_len=KEY_LEN, type=Type.ID,
+    )
+    return Protected(bytearray(raw))
+
+
+def _balloon_blake3(password: bytes, salt: bytes,
+                    params: Params) -> Protected:
+    """Balloon hashing with BLAKE3 as H; delta=3 (BCGS16 §3.2)."""
+    from ..ops.blake3_ref import blake3_digest
+
+    space, time = _BALLOON_COSTS[params]
+    h = lambda *parts: blake3_digest(b"".join(parts), 64)  # noqa: E731
+    cnt = 0
+
+    def counter() -> bytes:
+        nonlocal cnt
+        cnt += 1
+        return struct.pack("<Q", cnt - 1)
+
+    buf = [h(counter(), password, salt)]
+    for m in range(1, space):
+        buf.append(h(counter(), buf[m - 1]))
+    for t in range(time):
+        for m in range(space):
+            buf[m] = h(counter(), buf[(m - 1) % space], buf[m])
+            for i in range(3):
+                idx_block = h(counter(), salt,
+                              struct.pack("<QQQ", t, m, i))
+                other = int.from_bytes(idx_block[:8], "little") % space
+                buf[m] = h(counter(), buf[m], buf[other])
+    return Protected(bytearray(buf[space - 1][:KEY_LEN]))
+
+
+def hash_password(algorithm: HashingAlgorithm, password: Protected,
+                  salt: bytes, params: Params = Params.STANDARD,
+                  secret: Protected | None = None) -> Protected:
+    """Password (+ optional secret key) + salt → 32-byte wrapping key."""
+    return algorithm.hash(password, salt, params, secret)

@@ -1,0 +1,283 @@
+"""Encrypted-file header with keyslots, metadata, and preview media.
+
+Capability equivalent of the reference's header module
+(crates/crypto/src/header/{file,keyslot,metadata,preview_media}.rs):
+magic bytes + version + algorithm + stream base nonce, up to two
+keyslots (each: hashing algorithm + params, salt, content salt, and the
+master key sealed under the hashed password), optional AEAD-encrypted
+metadata and preview-media blobs, and the serialized header acting as
+AAD for the first content block.
+
+The byte layout is this framework's own: little-endian, length-prefixed,
+msgpack-free, versioned via a u16. Magic is ``b"sdtpu\\xf5\\x01"`` (the
+reference uses ``b"ballapp"``, file.rs:49 — a different app must use a
+different magic).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import BinaryIO, List, Optional
+
+from .hashing import HashingAlgorithm, Params, hash_password
+from .primitives import Protected, generate_master_key, generate_salt
+from .stream import Algorithm, Decryptor, Encryptor, decrypt_key, encrypt_key
+
+MAGIC = b"sdtpu\xf5\x01"
+HEADER_VERSION = 1
+KEYSLOT_VERSION = 1
+
+
+@dataclass
+class Keyslot:
+    """One password's grip on the master key.
+
+    ``hashed(password, salt) → wrapping key``; the master key is sealed
+    under the wrapping key with `nonce`. `content_salt` feeds any
+    password-derived per-content keys (parity with keyslot.rs fields).
+    """
+
+    version: int
+    algorithm: Algorithm
+    hashing_algorithm: HashingAlgorithm
+    hashing_params: Params
+    salt: bytes
+    content_salt: bytes
+    master_key_nonce: bytes
+    encrypted_master_key: bytes
+
+    @classmethod
+    def new(cls, algorithm: Algorithm,
+            hashing_algorithm: HashingAlgorithm, params: Params,
+            password: Protected, master_key: Protected,
+            secret: Optional[Protected] = None) -> "Keyslot":
+        salt = generate_salt()
+        nonce = algorithm.generate_nonce()
+        wrapping = hash_password(hashing_algorithm, password, salt, params,
+                                 secret)
+        return cls(
+            version=KEYSLOT_VERSION,
+            algorithm=algorithm,
+            hashing_algorithm=hashing_algorithm,
+            hashing_params=params,
+            salt=salt,
+            content_salt=generate_salt(),
+            master_key_nonce=nonce,
+            encrypted_master_key=encrypt_key(master_key, nonce, algorithm,
+                                             wrapping),
+        )
+
+    def unlock(self, password: Protected,
+               secret: Optional[Protected] = None) -> Protected:
+        wrapping = hash_password(self.hashing_algorithm, password,
+                                 self.salt, self.hashing_params, secret)
+        return decrypt_key(self.encrypted_master_key,
+                           self.master_key_nonce, self.algorithm, wrapping)
+
+    def _pack(self) -> bytes:
+        return b"".join([
+            struct.pack("<HBBB", self.version,
+                        _ALG_CODE[self.algorithm],
+                        _HASH_CODE[self.hashing_algorithm],
+                        _PARAM_CODE[self.hashing_params]),
+            _pfx(self.salt), _pfx(self.content_salt),
+            _pfx(self.master_key_nonce), _pfx(self.encrypted_master_key),
+        ])
+
+    @classmethod
+    def _unpack(cls, r: io.BytesIO) -> "Keyslot":
+        version, alg, hsh, par = struct.unpack("<HBBB", _read_exact(r, 5))
+        try:
+            return cls(
+                version=version,
+                algorithm=_ALG_BY_CODE[alg],
+                hashing_algorithm=_HASH_BY_CODE[hsh],
+                hashing_params=_PARAM_BY_CODE[par],
+                salt=_read_pfx(r), content_salt=_read_pfx(r),
+                master_key_nonce=_read_pfx(r),
+                encrypted_master_key=_read_pfx(r),
+            )
+        except KeyError as e:
+            raise ValueError(f"unknown keyslot field code {e}") from e
+
+
+_ALG_CODE = {Algorithm.XCHACHA20_POLY1305: 0, Algorithm.AES_256_GCM: 1}
+_ALG_BY_CODE = {v: k for k, v in _ALG_CODE.items()}
+_HASH_CODE = {HashingAlgorithm.ARGON2ID: 0,
+              HashingAlgorithm.BALLOON_BLAKE3: 1}
+_HASH_BY_CODE = {v: k for k, v in _HASH_CODE.items()}
+_PARAM_CODE = {Params.STANDARD: 0, Params.HARDENED: 1, Params.PARANOID: 2}
+_PARAM_BY_CODE = {v: k for k, v in _PARAM_CODE.items()}
+
+
+# A header (nonces, keyslots, JSON metadata, a preview thumbnail) never
+# legitimately approaches this; anything larger is a corrupt or hostile
+# length prefix, refused before allocation.
+MAX_FIELD_LEN = 64 * 1024 * 1024
+
+
+def _pfx(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+def _read_exact(r, n: int) -> bytes:
+    out = r.read(n)
+    if len(out) != n:
+        raise ValueError("truncated header")
+    return out
+
+
+def _read_pfx(r) -> bytes:
+    (n,) = struct.unpack("<I", _read_exact(r, 4))
+    if n > MAX_FIELD_LEN:
+        raise ValueError(f"header field length {n} exceeds limit")
+    return _read_exact(r, n)
+
+
+@dataclass
+class FileHeader:
+    """Everything needed to decrypt a file, safe to store in plaintext."""
+
+    version: int
+    algorithm: Algorithm
+    nonce: bytes
+    keyslots: List[Keyslot] = field(default_factory=list)
+    metadata: Optional[bytes] = None       # sealed JSON
+    metadata_nonce: Optional[bytes] = None
+    preview_media: Optional[bytes] = None  # sealed bytes
+    preview_media_nonce: Optional[bytes] = None
+
+    MAX_KEYSLOTS = 2
+
+    @classmethod
+    def new(cls, algorithm: Algorithm = Algorithm.XCHACHA20_POLY1305,
+            ) -> "FileHeader":
+        return cls(version=HEADER_VERSION, algorithm=algorithm,
+                   nonce=algorithm.generate_nonce())
+
+    def add_keyslot(self, hashing_algorithm: HashingAlgorithm,
+                    params: Params, password: Protected,
+                    master_key: Protected,
+                    secret: Optional[Protected] = None) -> None:
+        if len(self.keyslots) >= self.MAX_KEYSLOTS:
+            raise ValueError("header already has 2 keyslots")
+        self.keyslots.append(Keyslot.new(
+            self.algorithm, hashing_algorithm, params, password,
+            master_key, secret))
+
+    def decrypt_master_key(self, password: Protected,
+                           secret: Optional[Protected] = None) -> Protected:
+        for slot in self.keyslots:
+            try:
+                return slot.unlock(password, secret)
+            except Exception:
+                continue
+        raise ValueError("no keyslot unlocked with the provided password")
+
+    # -- sealed attachments -------------------------------------------------
+    def add_metadata(self, master_key: Protected, obj) -> None:
+        nonce = self.algorithm.generate_nonce()
+        enc = Encryptor(master_key, nonce, self.algorithm)
+        self.metadata = enc.encrypt_last(json.dumps(obj).encode())
+        self.metadata_nonce = nonce
+
+    def decrypt_metadata(self, master_key: Protected):
+        if self.metadata is None:
+            raise ValueError("header has no metadata")
+        dec = Decryptor(master_key, self.metadata_nonce, self.algorithm)
+        return json.loads(dec.decrypt_last(self.metadata))
+
+    def add_preview_media(self, master_key: Protected, media: bytes) -> None:
+        nonce = self.algorithm.generate_nonce()
+        enc = Encryptor(master_key, nonce, self.algorithm)
+        self.preview_media = enc.encrypt_last(media)
+        self.preview_media_nonce = nonce
+
+    def decrypt_preview_media(self, master_key: Protected) -> bytes:
+        if self.preview_media is None:
+            raise ValueError("header has no preview media")
+        dec = Decryptor(master_key, self.preview_media_nonce, self.algorithm)
+        return dec.decrypt_last(self.preview_media)
+
+    # -- wire format --------------------------------------------------------
+    def serialize(self) -> bytes:
+        body = b"".join([
+            struct.pack("<HB", self.version, _ALG_CODE[self.algorithm]),
+            _pfx(self.nonce),
+            struct.pack("<B", len(self.keyslots)),
+            b"".join(s._pack() for s in self.keyslots),
+            _pfx(self.metadata or b""), _pfx(self.metadata_nonce or b""),
+            _pfx(self.preview_media or b""),
+            _pfx(self.preview_media_nonce or b""),
+        ])
+        return MAGIC + _pfx(body)
+
+    @classmethod
+    def deserialize(cls, reader: BinaryIO) -> "FileHeader":
+        """Read a header from the start of `reader`, leaving it
+        positioned at the first content byte."""
+        magic = reader.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError("not a spacedrive-tpu encrypted file")
+        body = _read_pfx(reader)
+        r = io.BytesIO(body)
+        version, alg = struct.unpack("<HB", _read_exact(r, 3))
+        if version != HEADER_VERSION:
+            raise ValueError(f"unsupported header version {version}")
+        if alg not in _ALG_BY_CODE:
+            raise ValueError(f"unknown algorithm code {alg}")
+        hdr = cls(version=version, algorithm=_ALG_BY_CODE[alg],
+                  nonce=_read_pfx(r))
+        (n_slots,) = struct.unpack("<B", _read_exact(r, 1))
+        if n_slots > cls.MAX_KEYSLOTS:
+            raise ValueError(f"too many keyslots ({n_slots})")
+        for _ in range(n_slots):
+            hdr.keyslots.append(Keyslot._unpack(r))
+        hdr.metadata = _read_pfx(r) or None
+        hdr.metadata_nonce = _read_pfx(r) or None
+        hdr.preview_media = _read_pfx(r) or None
+        hdr.preview_media_nonce = _read_pfx(r) or None
+        return hdr
+
+    def aad(self) -> bytes:
+        """The header bytes that bind the first content block.
+
+        Keyslots/metadata/preview can be edited after the fact (password
+        change), so — like the reference (file.rs:97) — only the
+        immutable prefix (magic, version, algorithm, nonce) is AAD.
+        """
+        return MAGIC + struct.pack("<HB", self.version,
+                                   _ALG_CODE[self.algorithm]) + self.nonce
+
+
+def encrypt_file(src: BinaryIO, dst: BinaryIO, password: Protected,
+                 algorithm: Algorithm = Algorithm.XCHACHA20_POLY1305,
+                 hashing_algorithm: HashingAlgorithm =
+                 HashingAlgorithm.ARGON2ID,
+                 params: Params = Params.STANDARD,
+                 metadata=None, preview_media: bytes | None = None,
+                 master_key: Protected | None = None) -> FileHeader:
+    """Header + sealed stream → dst; returns the written header."""
+    master_key = master_key or generate_master_key()
+    header = FileHeader.new(algorithm)
+    header.add_keyslot(hashing_algorithm, params, password, master_key)
+    if metadata is not None:
+        header.add_metadata(master_key, metadata)
+    if preview_media is not None:
+        header.add_preview_media(master_key, preview_media)
+    dst.write(header.serialize())
+    Encryptor.encrypt_streams(master_key, header.nonce, algorithm, src,
+                              dst, aad=header.aad())
+    return header
+
+
+def decrypt_file(src: BinaryIO, dst: BinaryIO,
+                 password: Protected) -> FileHeader:
+    header = FileHeader.deserialize(src)
+    master_key = header.decrypt_master_key(password)
+    Decryptor.decrypt_streams(master_key, header.nonce, header.algorithm,
+                              src, dst, aad=header.aad())
+    return header

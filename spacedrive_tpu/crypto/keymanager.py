@@ -1,0 +1,234 @@
+"""Key manager: a mounted-keys registry behind a master password.
+
+Capability equivalent of the reference's key manager
+(crates/crypto/src/keys/keymanager.rs): a root key sealed by the master
+password (+ optional secret key), stored keys (each a user password
+sealed under the root key) that can be mounted/unmounted at runtime, and
+a keyring. The OS keychains the reference talks to (macOS Security
+framework / Secret Service) aren't reachable from this runtime, so the
+keyring is a file-backed store of sealed entries under the node data
+dir — same interface, portable backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as uuidlib
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..ops.blake3_ref import derive_key
+from .hashing import HashingAlgorithm, Params, hash_password
+from .primitives import (
+    MASTER_PASSWORD_CONTEXT,
+    ROOT_KEY_CONTEXT,
+    Protected,
+    generate_master_key,
+    generate_salt,
+)
+from .stream import Algorithm, decrypt_key, encrypt_key
+
+
+@dataclass
+class StoredKey:
+    """One sealed key entry (keymanager.rs StoredKey, simplified)."""
+
+    uuid: str
+    version: int
+    algorithm: Algorithm
+    hashing_algorithm: HashingAlgorithm
+    hashing_params: Params
+    salt: bytes
+    master_key_nonce: bytes
+    encrypted_key: bytes  # the actual key material, sealed by root key
+    memory_only: bool = False
+    automount: bool = False
+
+    def to_json(self) -> dict:
+        return {
+            "uuid": self.uuid,
+            "version": self.version,
+            "algorithm": self.algorithm.value,
+            "hashing_algorithm": self.hashing_algorithm.value,
+            "hashing_params": self.hashing_params.value,
+            "salt": self.salt.hex(),
+            "master_key_nonce": self.master_key_nonce.hex(),
+            "encrypted_key": self.encrypted_key.hex(),
+            "automount": self.automount,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "StoredKey":
+        return cls(
+            uuid=d["uuid"], version=d["version"],
+            algorithm=Algorithm(d["algorithm"]),
+            hashing_algorithm=HashingAlgorithm(d["hashing_algorithm"]),
+            hashing_params=Params(d["hashing_params"]),
+            salt=bytes.fromhex(d["salt"]),
+            master_key_nonce=bytes.fromhex(d["master_key_nonce"]),
+            encrypted_key=bytes.fromhex(d["encrypted_key"]),
+            automount=d.get("automount", False),
+        )
+
+
+class KeyManager:
+    """Runtime key registry; locked until `unlock()` provides the master
+    password that reveals the root key."""
+
+    VERSION = 1
+
+    def __init__(self, data_path: Optional[str] = None,
+                 algorithm: Algorithm = Algorithm.XCHACHA20_POLY1305,
+                 hashing_algorithm: HashingAlgorithm =
+                 HashingAlgorithm.ARGON2ID,
+                 params: Params = Params.STANDARD):
+        self.algorithm = algorithm
+        self.hashing_algorithm = hashing_algorithm
+        self.params = params
+        self._data_path = data_path
+        self._root_key: Optional[Protected] = None
+        self._stored: Dict[str, StoredKey] = {}
+        self._mounted: Dict[str, Protected] = {}
+        self._verification: Optional[dict] = None
+        if data_path and os.path.exists(data_path):
+            self._load()
+
+    # -- persistence --------------------------------------------------------
+    def _load(self) -> None:
+        with open(self._data_path, "r") as f:
+            state = json.load(f)
+        self._verification = state.get("verification")
+        for entry in state.get("keys", []):
+            sk = StoredKey.from_json(entry)
+            self._stored[sk.uuid] = sk
+
+    def _save(self) -> None:
+        if not self._data_path:
+            return
+        state = {
+            "verification": self._verification,
+            "keys": [k.to_json() for k in self._stored.values()
+                     if not k.memory_only],
+        }
+        tmp = self._data_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._data_path)
+
+    # -- onboarding / unlock -------------------------------------------------
+    @property
+    def is_unlocked(self) -> bool:
+        return self._root_key is not None
+
+    def initialize(self, master_password: Protected,
+                   secret: Optional[Protected] = None) -> None:
+        """First-run setup: derive the verification entry + root key."""
+        salt = generate_salt()
+        hashed = hash_password(self.hashing_algorithm, master_password,
+                               salt, self.params, secret)
+        wrapping = Protected(derive_key(MASTER_PASSWORD_CONTEXT,
+                                        hashed.expose()))
+        root = generate_master_key()
+        nonce = self.algorithm.generate_nonce()
+        self._verification = {
+            "salt": salt.hex(),
+            "nonce": nonce.hex(),
+            "sealed_root": encrypt_key(root, nonce, self.algorithm,
+                                       wrapping).hex(),
+            "algorithm": self.algorithm.value,
+            "hashing_algorithm": self.hashing_algorithm.value,
+            "hashing_params": self.params.value,
+        }
+        self._root_key = Protected(derive_key(ROOT_KEY_CONTEXT,
+                                              root.expose()))
+        self._save()
+
+    def unlock(self, master_password: Protected,
+               secret: Optional[Protected] = None) -> None:
+        if self._verification is None:
+            raise ValueError("key manager not initialized")
+        v = self._verification
+        hashed = hash_password(
+            HashingAlgorithm(v["hashing_algorithm"]), master_password,
+            bytes.fromhex(v["salt"]), Params(v["hashing_params"]), secret)
+        wrapping = Protected(derive_key(MASTER_PASSWORD_CONTEXT,
+                                        hashed.expose()))
+        # The verification record pins every parameter it was created
+        # with — a manager constructed with different defaults must
+        # still unlock an existing store.
+        algorithm = Algorithm(v.get("algorithm", self.algorithm.value))
+        try:
+            root = decrypt_key(bytes.fromhex(v["sealed_root"]),
+                               bytes.fromhex(v["nonce"]), algorithm,
+                               wrapping)
+        except Exception as e:
+            raise ValueError("incorrect master password") from e
+        self._root_key = Protected(derive_key(ROOT_KEY_CONTEXT,
+                                              root.expose()))
+
+    def lock(self) -> None:
+        """Unmount everything and forget the root key (`set_unlocked(false)`
+        + empty_keymount equivalent)."""
+        for key in self._mounted.values():
+            key.zeroize()
+        self._mounted.clear()
+        if self._root_key is not None:
+            self._root_key.zeroize()
+        self._root_key = None
+
+    def _require_unlocked(self) -> Protected:
+        if self._root_key is None:
+            raise ValueError("key manager is locked")
+        return self._root_key
+
+    # -- stored keys ---------------------------------------------------------
+    def add_key(self, key_material: Protected, *, automount: bool = False,
+                memory_only: bool = False) -> str:
+        root = self._require_unlocked()
+        uid = str(uuidlib.uuid4())
+        nonce = self.algorithm.generate_nonce()
+        sealed = encrypt_key(key_material, nonce, self.algorithm, root,
+                             aad=uid.encode())
+        self._stored[uid] = StoredKey(
+            uuid=uid, version=self.VERSION, algorithm=self.algorithm,
+            hashing_algorithm=self.hashing_algorithm,
+            hashing_params=self.params, salt=generate_salt(),
+            master_key_nonce=nonce, encrypted_key=sealed,
+            memory_only=memory_only, automount=automount)
+        self._save()
+        return uid
+
+    def mount(self, uuid: str) -> None:
+        root = self._require_unlocked()
+        if uuid in self._mounted:
+            return
+        sk = self._stored[uuid]
+        self._mounted[uuid] = decrypt_key(
+            sk.encrypted_key, sk.master_key_nonce, sk.algorithm, root,
+            aad=uuid.encode())
+
+    def unmount(self, uuid: str) -> None:
+        key = self._mounted.pop(uuid, None)
+        if key is not None:
+            key.zeroize()
+
+    def mounted_key(self, uuid: str) -> Protected:
+        return self._mounted[uuid]
+
+    def automount(self) -> None:
+        for uid, sk in self._stored.items():
+            if sk.automount:
+                self.mount(uid)
+
+    def delete_key(self, uuid: str) -> None:
+        self.unmount(uuid)
+        self._stored.pop(uuid, None)
+        self._save()
+
+    def list_keys(self) -> list:
+        return [
+            {"uuid": k.uuid, "mounted": k.uuid in self._mounted,
+             "automount": k.automount, "memory_only": k.memory_only}
+            for k in self._stored.values()
+        ]

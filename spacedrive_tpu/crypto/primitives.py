@@ -1,0 +1,89 @@
+"""Crypto constants and small helpers.
+
+Mirrors the capability constants of the reference's
+/root/reference/crates/crypto/src/primitives.rs:19-68: key/salt/tag
+sizes, the 1 MiB stream block, and the fixed derive-key contexts (ours
+are this framework's own strings — context strings are domain
+separators, so they must NOT be copied between applications).
+"""
+
+from __future__ import annotations
+
+import os
+
+KEY_LEN = 32
+SALT_LEN = 16
+SECRET_KEY_LEN = 18
+AEAD_TAG_LEN = 16
+# Encrypted master key: 32-byte key + 16-byte AEAD tag.
+ENCRYPTED_KEY_LEN = KEY_LEN + AEAD_TAG_LEN
+# STREAM block size — matches the reference's 1 MiB
+# (crates/crypto/src/primitives.rs:27).
+BLOCK_LEN = 1_048_576
+
+APP_IDENTIFIER = "spacedrive-tpu"
+SECRET_KEY_IDENTIFIER = "Secret key"
+
+# Domain-separation contexts for the BLAKE3 derive-key KDF.
+ROOT_KEY_CONTEXT = "spacedrive-tpu 2026-07-30 root key derivation"
+MASTER_PASSWORD_CONTEXT = "spacedrive-tpu 2026-07-30 master password hash"
+FILE_KEY_CONTEXT = "spacedrive-tpu 2026-07-30 file key derivation"
+
+
+def generate_master_key() -> "Protected":
+    return Protected(os.urandom(KEY_LEN))
+
+
+def generate_salt() -> bytes:
+    return os.urandom(SALT_LEN)
+
+
+def generate_secret_key() -> "Protected":
+    return Protected(os.urandom(SECRET_KEY_LEN))
+
+
+class Protected:
+    """Best-effort zeroizing secret container.
+
+    Python equivalent of the reference's `Protected<Vec<u8>>` wrapper
+    (crates/crypto/src/protected.rs): hides the value from repr/logs and
+    overwrites the buffer on `zeroize()`/GC. CPython can't guarantee no
+    copies exist (immutable bytes interning), so secrets are held in a
+    mutable bytearray and exposed only via `.expose()`.
+    """
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, value: bytes | bytearray):
+        self._buf = bytearray(value)
+        if isinstance(value, bytearray):
+            for i in range(len(value)):
+                value[i] = 0
+
+    def expose(self) -> bytes:
+        return bytes(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def zeroize(self) -> None:
+        for i in range(len(self._buf)):
+            self._buf[i] = 0
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.zeroize()
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return "Protected(<redacted>)"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Protected):
+            import hmac
+
+            return hmac.compare_digest(bytes(self._buf), bytes(other._buf))
+        return NotImplemented
+
+    __hash__ = None  # secrets are not dict keys

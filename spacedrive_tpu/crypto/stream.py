@@ -1,0 +1,169 @@
+"""Authenticated STREAM encryption in 1 MiB blocks.
+
+The construction is the LE31 STREAM mode the reference uses via
+`aead::stream::{EncryptorLE31, DecryptorLE31}`
+(crates/crypto/src/crypto/stream.rs:8-14): each block is sealed with a
+per-block nonce = base nonce ‖ le32(counter | last_block << 31), so
+blocks cannot be reordered, truncated, or extended without detection.
+Base-nonce lengths follow the reference (types.rs:22-24): 20 bytes for
+XChaCha20-Poly1305 (24-byte AEAD nonce − 4), 8 for AES-256-GCM (12 − 4).
+
+Sync (bytes in/bytes out) and streaming (file-like reader/writer) APIs;
+the job system wraps the streaming form for encrypt/decrypt jobs.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+from typing import BinaryIO
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .primitives import AEAD_TAG_LEN, BLOCK_LEN, Protected
+from .xchacha import XChaCha20Poly1305
+
+
+class Algorithm(enum.Enum):
+    XCHACHA20_POLY1305 = "XChaCha20Poly1305"
+    AES_256_GCM = "Aes256Gcm"
+
+    @property
+    def nonce_len(self) -> int:
+        return 20 if self is Algorithm.XCHACHA20_POLY1305 else 8
+
+    def generate_nonce(self) -> bytes:
+        return os.urandom(self.nonce_len)
+
+    def _aead(self, key: bytes):
+        if self is Algorithm.XCHACHA20_POLY1305:
+            return XChaCha20Poly1305(key)
+        return AESGCM(key)
+
+
+class _Stream:
+    def __init__(self, key: Protected, nonce: bytes, algorithm: Algorithm):
+        if len(key) != 32:
+            raise ValueError("stream key must be 32 bytes")
+        if len(nonce) != algorithm.nonce_len:
+            raise ValueError(
+                f"nonce length mismatch: {len(nonce)} != "
+                f"{algorithm.nonce_len} for {algorithm.value}")
+        self._aead = algorithm._aead(key.expose())
+        self._base = nonce
+        self._counter = 0
+
+    def _next_nonce(self, last: bool) -> bytes:
+        if self._counter >= 1 << 31:
+            raise OverflowError("STREAM counter exhausted")
+        value = self._counter | (int(last) << 31)
+        self._counter += 1
+        return self._base + struct.pack("<I", value)
+
+
+class Encryptor(_Stream):
+    def encrypt_next(self, plaintext: bytes, aad: bytes = b"",
+                     last: bool = False) -> bytes:
+        return self._aead.encrypt(self._next_nonce(last), plaintext,
+                                  aad or None)
+
+    def encrypt_last(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        return self.encrypt_next(plaintext, aad, last=True)
+
+    @classmethod
+    def encrypt_streams(cls, key: Protected, nonce: bytes,
+                        algorithm: Algorithm, reader: BinaryIO,
+                        writer: BinaryIO, aad: bytes = b"") -> int:
+        """Seal reader → writer in BLOCK_LEN blocks; returns bytes read.
+
+        The AAD (the serialized header in file encryption) binds only the
+        first block, as in the reference (stream.rs encrypt_streams)."""
+        enc = cls(key, nonce, algorithm)
+        total = 0
+        block = reader.read(BLOCK_LEN)
+        first = True
+        while True:
+            nxt = reader.read(BLOCK_LEN)
+            total += len(block)
+            this_aad = aad if first else b""
+            if nxt:
+                writer.write(enc.encrypt_next(block, this_aad))
+            else:
+                writer.write(enc.encrypt_last(block, this_aad))
+                break
+            block, first = nxt, False
+        return total
+
+    @classmethod
+    def encrypt_bytes(cls, key: Protected, nonce: bytes,
+                      algorithm: Algorithm, data: bytes,
+                      aad: bytes = b"") -> bytes:
+        import io
+
+        out = io.BytesIO()
+        cls.encrypt_streams(key, nonce, algorithm, io.BytesIO(data), out,
+                            aad)
+        return out.getvalue()
+
+
+class Decryptor(_Stream):
+    def decrypt_next(self, ciphertext: bytes, aad: bytes = b"",
+                     last: bool = False) -> bytes:
+        return self._aead.decrypt(self._next_nonce(last), ciphertext,
+                                  aad or None)
+
+    def decrypt_last(self, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        return self.decrypt_next(ciphertext, aad, last=True)
+
+    @classmethod
+    def decrypt_streams(cls, key: Protected, nonce: bytes,
+                        algorithm: Algorithm, reader: BinaryIO,
+                        writer: BinaryIO, aad: bytes = b"") -> int:
+        dec = cls(key, nonce, algorithm)
+        sealed = BLOCK_LEN + AEAD_TAG_LEN
+        total = 0
+        block = reader.read(sealed)
+        first = True
+        while True:
+            nxt = reader.read(sealed)
+            this_aad = aad if first else b""
+            if nxt:
+                pt = dec.decrypt_next(block, this_aad)
+            else:
+                pt = dec.decrypt_last(block, this_aad)
+            writer.write(pt)
+            total += len(pt)
+            if not nxt:
+                break
+            block, first = nxt, False
+        return total
+
+    @classmethod
+    def decrypt_bytes(cls, key: Protected, nonce: bytes,
+                      algorithm: Algorithm, data: bytes,
+                      aad: bytes = b"") -> Protected:
+        import io
+
+        out = io.BytesIO()
+        cls.decrypt_streams(key, nonce, algorithm, io.BytesIO(data), out,
+                            aad)
+        return Protected(bytearray(out.getbuffer()))
+
+
+def encrypt_key(master_key: Protected, nonce: bytes, algorithm: Algorithm,
+                wrapping_key: Protected, aad: bytes = b"") -> bytes:
+    """Seal a 32-byte key (one STREAM block → 48 bytes)."""
+    enc = Encryptor(wrapping_key, nonce, algorithm)
+    return enc.encrypt_last(master_key.expose(), aad)
+
+
+def decrypt_key(encrypted: bytes, nonce: bytes, algorithm: Algorithm,
+                wrapping_key: Protected, aad: bytes = b"") -> Protected:
+    dec = Decryptor(wrapping_key, nonce, algorithm)
+    return Protected(bytearray(dec.decrypt_last(encrypted, aad)))
+
+
+__all__ = [
+    "Algorithm", "Encryptor", "Decryptor", "encrypt_key", "decrypt_key",
+]

@@ -1,0 +1,77 @@
+"""XChaCha20-Poly1305 built from HChaCha20 + IETF ChaCha20-Poly1305.
+
+The `cryptography` package ships only the 12-byte-nonce IETF AEAD; the
+24-byte-nonce XChaCha variant (the reference's default algorithm,
+crates/crypto/src/types.rs:22) derives a subkey with HChaCha20 from the
+first 16 nonce bytes, then runs IETF ChaCha20-Poly1305 with nonce
+``b"\\x00"*4 + nonce[16:24]`` (draft-irtf-cfrg-xchacha-03 §2). HChaCha20
+is a single 20-round permutation — pure Python is fine at one call per
+stream block.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+_MASK = 0xFFFFFFFF
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _quarter(s, a, b, c, d):
+    s[a] = (s[a] + s[b]) & _MASK
+    s[d] ^= s[a]
+    s[d] = ((s[d] << 16) | (s[d] >> 16)) & _MASK
+    s[c] = (s[c] + s[d]) & _MASK
+    s[b] ^= s[c]
+    s[b] = ((s[b] << 12) | (s[b] >> 20)) & _MASK
+    s[a] = (s[a] + s[b]) & _MASK
+    s[d] ^= s[a]
+    s[d] = ((s[d] << 8) | (s[d] >> 24)) & _MASK
+    s[c] = (s[c] + s[d]) & _MASK
+    s[b] ^= s[c]
+    s[b] = ((s[b] << 7) | (s[b] >> 25)) & _MASK
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20 subkey derivation (draft-irtf-cfrg-xchacha-03 §2.2)."""
+    if len(key) != 32 or len(nonce16) != 16:
+        raise ValueError("hchacha20 needs a 32-byte key and 16-byte nonce")
+    s = list(_SIGMA) + list(struct.unpack("<8I", key)) + list(
+        struct.unpack("<4I", nonce16))
+    for _ in range(10):
+        _quarter(s, 0, 4, 8, 12)
+        _quarter(s, 1, 5, 9, 13)
+        _quarter(s, 2, 6, 10, 14)
+        _quarter(s, 3, 7, 11, 15)
+        _quarter(s, 0, 5, 10, 15)
+        _quarter(s, 1, 6, 11, 12)
+        _quarter(s, 2, 7, 8, 13)
+        _quarter(s, 3, 4, 9, 14)
+    return struct.pack("<8I", *(s[i] for i in (0, 1, 2, 3, 12, 13, 14, 15)))
+
+
+class XChaCha20Poly1305:
+    """AEAD with 24-byte nonces; API mirrors cryptography's AEAD classes."""
+
+    NONCE_LEN = 24
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("XChaCha20Poly1305 needs a 32-byte key")
+        self._key = key
+
+    def _inner(self, nonce: bytes) -> tuple:
+        if len(nonce) != self.NONCE_LEN:
+            raise ValueError("XChaCha20Poly1305 nonce must be 24 bytes")
+        subkey = hchacha20(self._key, nonce[:16])
+        return ChaCha20Poly1305(subkey), b"\x00" * 4 + nonce[16:]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.encrypt(n12, data, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.decrypt(n12, data, aad)

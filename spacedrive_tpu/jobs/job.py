@@ -68,9 +68,21 @@ class StatefulJob:
 
     NAME: str = ""
     IS_BATCHED: bool = False  # task_count counts batches, not items
+    # Init-arg names that must never touch the DB (secrets like
+    # passwords). They serialize as None; a cold-resumed job gets None
+    # back and must degrade gracefully (step error, not crash).
+    TRANSIENT_ARGS: frozenset = frozenset()
 
     def __init__(self, **init_args: Any):
         self.init_args = init_args
+
+    def persistable_init_args(self) -> Dict[str, Any]:
+        """init_args with TRANSIENT_ARGS values redacted to None — the
+        only form that may be written to the job table."""
+        if not self.TRANSIENT_ARGS:
+            return self.init_args
+        return {k: (None if k in self.TRANSIENT_ARGS else v)
+                for k, v in self.init_args.items()}
 
     # -- identity ---------------------------------------------------------
 

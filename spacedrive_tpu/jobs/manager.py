@@ -91,8 +91,8 @@ class JobManager:
         # (or is shut down before starting) cold-resumes instead of
         # failing with "lost state" — the blob also carries the chain.
         state = JobState.fresh(
-            job.init_args,
-            [(j.NAME, j.init_args) for j in next_jobs],
+            job.persistable_init_args(),
+            [(j.NAME, j.persistable_init_args()) for j in next_jobs],
         )
         report = JobReport(
             id=new_job_id(), name=job.NAME, action=action,
@@ -153,8 +153,8 @@ class JobManager:
                     })
                 else:
                     nxt_state = JobState.fresh(
-                        head.init_args,
-                        [(j.NAME, j.init_args) for j in rest],
+                        head.persistable_init_args(),
+                        [(j.NAME, j.persistable_init_args()) for j in rest],
                     )
                     nxt_report = JobReport(
                         id=new_job_id(), name=head.NAME,
@@ -183,18 +183,23 @@ class JobManager:
             return
         if job_id in self._entries:
             return  # already re-admitted (double resume)
-        self._paused.pop(job_id, None)
+        paused_entry = self._paused.pop(job_id, None)
         row = library.db.query_one("SELECT * FROM job WHERE id = ?", (job_id,))
         if row is None:
             raise JobManagerError("no such job")
         report = JobReport.from_row(row)
         if report.status != JobStatus.PAUSED or not report.data:
             raise JobManagerError("job is not resumable")
-        self._admit_from_state(library, report)
+        live_job = paused_entry.job if paused_entry is not None else None
+        self._admit_from_state(library, report, live_job=live_job)
 
-    def _admit_from_state(self, library: Any, report: JobReport) -> None:
+    def _admit_from_state(self, library: Any, report: JobReport,
+                          live_job: Any = None) -> None:
         state = JobState.deserialize(report.data)
-        job = JOB_REGISTRY[report.name](**state.init_args)
+        # Same-session resume keeps the live job object: the DB blob has
+        # TRANSIENT_ARGS (passwords) redacted to None, but the in-memory
+        # instance still holds them.
+        job = live_job or JOB_REGISTRY[report.name](**state.init_args)
         next_jobs = [
             JOB_REGISTRY[name](**init) for name, init in state.next_chain
             if name in JOB_REGISTRY

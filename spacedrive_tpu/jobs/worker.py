@@ -150,7 +150,7 @@ class Worker:
                 self.resume_state.next_chain if self.resume_state else []
             )
             state = JobState(
-                init_args=self.job.init_args,
+                init_args=self.job.persistable_init_args(),
                 data=data,
                 steps=deque(steps),
                 step_number=0,

@@ -122,8 +122,12 @@ class Libraries:
                 self._load(lib_id)
 
     def create(self, name: str, node_name: str = "node",
-               node_pub_id: bytes = b"") -> Library:
-        lib_id = uuidlib.uuid4()
+               node_pub_id: bytes = b"",
+               lib_id: "Optional[uuidlib.UUID]" = None) -> Library:
+        """`lib_id` is provided when pairing: a paired library keeps the
+        originator's UUID so sync streams address the same library on
+        every node (p2p/pairing semantics)."""
+        lib_id = lib_id or uuidlib.uuid4()
         instance_pub = uuid_bytes()
         cfg = LibraryConfig(name=name, instance_id=instance_pub.hex())
         cfg_path = os.path.join(self.dir, f"{lib_id}.sdlibrary")

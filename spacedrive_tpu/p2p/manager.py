@@ -50,6 +50,11 @@ class P2PManager:
         self.on_pairing_request: Callable[
             [RemoteIdentity, dict], bool] = lambda peer, info: False
         self._spacedrop_cancel: Dict[str, bool] = {}
+        # Interactive mode (api/p2p.rs acceptSpacedrop flow): when the
+        # sync hook declines, park the offer in _pending_drops, emit a
+        # SpacedropRequest event, and wait for accept_/reject_spacedrop.
+        self.interactive_spacedrop = False
+        self._pending_drops: Dict[str, asyncio.Future] = {}
         self.networked = None  # set by sync_net.NetworkedLibraries
 
     # -- lifecycle ---------------------------------------------------------
@@ -200,14 +205,51 @@ class P2PManager:
         finally:
             tunnel.close()
 
+    async def _decide_spacedrop(self, peer: RemoteIdentity,
+                                req: SpaceblockRequest,
+                                drop_id: str) -> Optional[str]:
+        save_path = self.on_spacedrop(peer, req)
+        if save_path is not None or not self.interactive_spacedrop:
+            return save_path
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_drops[drop_id] = fut
+        self.node.events.emit({
+            "type": "SpacedropRequest", "id": drop_id, "name": req.name,
+            "size": req.size, "peer": peer.to_bytes().hex()})
+        try:
+            return await asyncio.wait_for(fut, SPACEDROP_TIMEOUT_S)
+        except asyncio.TimeoutError:
+            self.node.events.emit(
+                {"type": "SpacedropTimedout", "id": drop_id})
+            return None
+        finally:
+            self._pending_drops.pop(drop_id, None)
+
+    def accept_spacedrop(self, drop_id: str, save_path: str) -> bool:
+        fut = self._pending_drops.get(drop_id)
+        if fut is None or fut.done():
+            return False
+        fut.set_result(save_path)
+        return True
+
+    def reject_spacedrop(self, drop_id: str) -> bool:
+        fut = self._pending_drops.get(drop_id)
+        if fut is None or fut.done():
+            return False
+        fut.set_result(None)
+        self.node.events.emit({"type": "SpacedropRejected", "id": drop_id})
+        return True
+
     async def _handle_spacedrop(self, tunnel: Tunnel, header: dict) -> None:
         req = SpaceblockRequest.from_wire(header["req"])
-        save_path = self.on_spacedrop(tunnel.remote, req)
+        # Receiver-minted id: the sender's header id is untrusted input —
+        # colliding/replayed ids must not cross-wire pending offers.
+        drop_id = uuidlib.uuid4().hex
+        save_path = await self._decide_spacedrop(tunnel.remote, req, drop_id)
         if save_path is None:
             await tunnel.send("reject")
             return
         await tunnel.send("accept")
-        drop_id = header.get("id", "")
         self._spacedrop_cancel[drop_id] = False
         try:
             with open(save_path, "wb") as out:
@@ -235,9 +277,12 @@ class P2PManager:
                 lib = candidate
                 break
         if lib is None:
-            # Pairing into a library we don't have yet: create it local.
-            lib = self.node.create_library(header.get(
-                "library_name", "paired library"))
+            # Pairing into a library we don't have yet: create it locally
+            # UNDER THE ORIGINATOR'S UUID — sync streams address
+            # libraries by id, so both sides must agree on it.
+            lib = self.node.create_library(
+                header.get("library_name", "paired library"),
+                lib_id=uuidlib.UUID(str(header["library_id"])))
         inst = header["instance"]
         lib.sync.register_instance(
             inst["pub_id"], identity=inst["identity"],

@@ -1,0 +1,180 @@
+"""NetworkedLibraries: CRDT sync over the p2p mesh.
+
+The instance↔peer plane from the reference
+(/root/reference/core/src/p2p/sync/mod.rs:31-446): each library knows the
+remote instances it is paired with; when local writes create CRDT ops the
+**originator** opens a sync stream to every reachable peer and announces
+`NewOperations`; the remote **responder** then drives a pull loop —
+repeated `GetOperations{clocks, count=1000}` requests answered from the
+originator's op log — feeding each page through the library's ingest
+state machine until drained (OPS_PER_REQUEST at p2p/sync/mod.rs:403).
+
+Peer addressing goes through discovery (mdns in the reference, UDP
+beacons here); tests can inject (addr, port) routes directly, mirroring
+the reference's in-process transport fake
+(core/crates/sync/tests/lib.rs:109-163).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid as uuidlib
+from typing import Dict, Optional, Tuple
+
+from ..sync.ingest import Ingester, MessagesEvent, ReqKind
+from ..sync.manager import GetOpsArgs
+from ..sync.crdt import CRDTOperation
+from .identity import RemoteIdentity
+
+OPS_PER_REQUEST = 1000
+
+
+class NetworkedLibraries:
+    def __init__(self, node, p2p):
+        self.node = node
+        self.p2p = p2p
+        p2p.networked = self
+        # library_id → {instance pub_id → RemoteIdentity}
+        self._instances: Dict[uuidlib.UUID, Dict[bytes, RemoteIdentity]] = {}
+        # identity bytes → (addr, port) route override (tests / static).
+        self._routes: Dict[bytes, Tuple[str, int]] = {}
+        self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
+        self._origin_tasks: set = set()
+        for lib in node.libraries.list():
+            self.watch_library(lib)
+        node.libraries.on_event(self._on_library_event)
+
+    # -- wiring ------------------------------------------------------------
+
+    def _on_library_event(self, kind: str, library) -> None:
+        if kind == "load":
+            self.watch_library(library)
+
+    def watch_library(self, library) -> None:
+        self._instances.setdefault(library.id, {})
+        self._load_known_instances(library)
+        library.sync.on_created(
+            lambda lib=library: self.originate_soon(lib))
+
+    def _load_known_instances(self, library) -> None:
+        """Paired instances persist in the instance table; identities
+        recorded at pairing time re-arm routes after restart."""
+        me = library.sync.instance
+        for row in library.db.query("SELECT * FROM instance"):
+            if row["pub_id"] == me:
+                continue
+            identity = row["identity"]
+            if identity and len(identity) == 32:
+                self._instances[library.id][row["pub_id"]] = (
+                    RemoteIdentity(identity))
+
+    def learn_instance(self, library_id, pub_id: bytes,
+                       identity: RemoteIdentity,
+                       route: Optional[Tuple[str, int]] = None) -> None:
+        self._instances.setdefault(library_id, {})[pub_id] = identity
+        if route is not None:
+            self._routes[identity.to_bytes()] = route
+
+    def set_route(self, identity: RemoteIdentity, addr: str,
+                  port: int) -> None:
+        self._routes[identity.to_bytes()] = (addr, port)
+
+    def _resolve(self, identity: RemoteIdentity
+                 ) -> Optional[Tuple[str, int]]:
+        key = identity.to_bytes()
+        if key in self._routes:
+            return self._routes[key]
+        disc = self.p2p.discovery
+        if disc is not None:
+            for peer in disc.peers.values():
+                if peer.identity.to_bytes() == key:
+                    return (peer.addr, peer.port)
+        return None
+
+    # -- originator (p2p/sync/mod.rs:256-325) ------------------------------
+
+    def originate_soon(self, library) -> None:
+        """Local write hook: fan NewOperations out in the background."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync unit tests): peers poll on reconnect
+        task = loop.create_task(self.originate(library))
+        self._origin_tasks.add(task)
+        task.add_done_callback(self._origin_tasks.discard)
+
+    async def originate(self, library) -> None:
+        peers = list(self._instances.get(library.id, {}).items())
+        for pub_id, identity in peers:
+            route = self._resolve(identity)
+            if route is None:
+                continue
+            try:
+                await self._originate_one(library, identity, route)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                continue  # peer offline; it will pull on reconnect
+
+    async def _originate_one(self, library, identity: RemoteIdentity,
+                             route: Tuple[str, int]) -> None:
+        tunnel = await self.p2p.open_stream(*route, expected=identity)
+        try:
+            await tunnel.send({"t": "sync", "kind": "new_ops",
+                               "library_id": str(library.id)})
+            # Serve the responder's pull loop from our op log.
+            while True:
+                req = await tunnel.recv()
+                if not isinstance(req, dict) or req.get("kind") == "done":
+                    break
+                clocks = [(bytes(i), int(t)) for i, t in req["clocks"]]
+                ops = library.sync.get_ops(GetOpsArgs(
+                    clocks=clocks,
+                    count=min(int(req.get("count", OPS_PER_REQUEST)),
+                              OPS_PER_REQUEST)))
+                await tunnel.send({
+                    "ops": [op.to_wire() for op in ops],
+                    "has_more": len(ops) >= OPS_PER_REQUEST,
+                })
+        finally:
+            tunnel.close()
+
+    # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
+
+    async def handle_sync_stream(self, tunnel, header: dict) -> None:
+        lib = self.node.libraries.get(
+            uuidlib.UUID(str(header["library_id"])))
+        if lib is None:
+            await tunnel.send({"kind": "done"})
+            return
+        lock = self._ingest_locks.setdefault(lib.id, asyncio.Lock())
+        async with lock:
+            await self._pull(lib, tunnel)
+        self.node.events.invalidate_query(lib.id, "search.paths")
+
+    async def _pull(self, library, tunnel) -> None:
+        """Bridge the ingest actor's request queue to the wire: its
+        MESSAGES requests become GetOperations frames, pages come back as
+        MessagesEvents, FINISHED closes the stream."""
+        ingester = Ingester(library.sync)
+        ingester.start()
+        try:
+            ingester.notify()
+            while True:
+                req = await ingester.requests.get()
+                if req.kind == ReqKind.FINISHED:
+                    await tunnel.send({"kind": "done"})
+                    return
+                if req.kind != ReqKind.MESSAGES:
+                    continue
+                await tunnel.send({
+                    "kind": "messages",
+                    "clocks": [[i, t] for i, t in req.timestamps],
+                    "count": OPS_PER_REQUEST,
+                })
+                page = await tunnel.recv()
+                ops = [CRDTOperation.from_wire(raw)
+                       for raw in page.get("ops", [])]
+                ingester.deliver(MessagesEvent(
+                    instance=library.sync.instance, messages=ops,
+                    has_more=bool(page.get("has_more"))))
+        finally:
+            await ingester.stop()

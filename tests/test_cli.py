@@ -1,0 +1,69 @@
+"""CLI host: header inspection + one-shot encrypt/decrypt."""
+
+import pytest
+
+from spacedrive_tpu.cli import main
+
+
+@pytest.fixture(autouse=True)
+def _tiny_balloon_costs(monkeypatch):
+    from spacedrive_tpu.crypto import hashing
+    from spacedrive_tpu.crypto.hashing import HashingAlgorithm, Params
+
+    monkeypatch.setattr(hashing, "_BALLOON_COSTS", {
+        Params.STANDARD: (16, 1),
+        Params.HARDENED: (32, 1),
+        Params.PARANOID: (64, 1),
+    })
+    # CLI defaults to argon2id; keep the test fast by defaulting balloon.
+    import spacedrive_tpu.crypto.header as header_mod
+
+    monkeypatch.setattr(
+        header_mod.encrypt_file, "__defaults__",
+        (header_mod.Algorithm.XCHACHA20_POLY1305,
+         HashingAlgorithm.BALLOON_BLAKE3, Params.STANDARD, None, None,
+         None))
+
+
+def test_encrypt_header_decrypt_roundtrip(tmp_path, capsys):
+    src = tmp_path / "plain.bin"
+    src.write_bytes(b"cli secret" * 50)
+
+    assert main(["encrypt", str(src), "-p", "pw"]) == 0
+    sealed = str(src) + ".sdtpu"
+
+    assert main(["header", sealed]) == 0
+    out = capsys.readouterr().out
+    assert "Header version: 1" in out
+    assert "XChaCha20Poly1305" in out
+    assert "Keyslot 0:" in out
+
+    dst = tmp_path / "roundtrip.bin"
+    assert main(["decrypt", sealed, "-o", str(dst), "-p", "pw"]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+
+
+def test_header_rejects_plain_file(tmp_path, capsys):
+    p = tmp_path / "not_encrypted.txt"
+    p.write_bytes(b"hello world")
+    assert main(["header", str(p)]) == 1
+    assert "error" in capsys.readouterr().err
+
+
+def test_decrypt_wrong_password(tmp_path):
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"x" * 100)
+    assert main(["encrypt", str(src), "-p", "right"]) == 0
+    out = tmp_path / "out.bin"
+    assert main(["decrypt", str(src) + ".sdtpu", "-o", str(out),
+                 "-p", "wrong"]) == 1
+    assert not out.exists()  # failed decrypt leaves nothing behind
+
+
+def test_encrypt_refuses_existing_output(tmp_path, capsys):
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"x")
+    (tmp_path / "a.bin.sdtpu").write_bytes(b"occupied")
+    assert main(["encrypt", str(src), "-p", "pw"]) == 1
+    assert "already exists" in capsys.readouterr().err
+    assert (tmp_path / "a.bin.sdtpu").read_bytes() == b"occupied"

@@ -1,0 +1,283 @@
+"""Crypto subsystem: stream roundtrips, headers, key manager, KDF."""
+
+import io
+import os
+
+import pytest
+
+from spacedrive_tpu.crypto import (
+    Algorithm,
+    Decryptor,
+    Encryptor,
+    FileHeader,
+    HashingAlgorithm,
+    KeyManager,
+    Params,
+    Protected,
+    generate_master_key,
+    generate_salt,
+    hash_password,
+    secure_erase,
+)
+from spacedrive_tpu.crypto.header import decrypt_file, encrypt_file
+from spacedrive_tpu.crypto.xchacha import hchacha20
+from spacedrive_tpu.ops.blake3_ref import blake3_digest, derive_key
+
+
+# Fast hashing for tests: balloon with toy costs (the algorithm's control
+# flow is identical; production costs live in hashing._BALLOON_COSTS).
+HASH = HashingAlgorithm.BALLOON_BLAKE3
+PARAMS = Params.STANDARD
+
+
+@pytest.fixture(autouse=True)
+def _tiny_balloon_costs(monkeypatch):
+    from spacedrive_tpu.crypto import hashing
+
+    monkeypatch.setattr(hashing, "_BALLOON_COSTS", {
+        Params.STANDARD: (16, 1),
+        Params.HARDENED: (32, 1),
+        Params.PARANOID: (64, 1),
+    })
+
+
+def test_hchacha20_rfc_vector():
+    """draft-irtf-cfrg-xchacha-03 §2.2.1 test vector."""
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f")
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    want = bytes.fromhex(
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc")
+    assert hchacha20(key, nonce) == want
+
+
+@pytest.mark.parametrize("alg", list(Algorithm))
+def test_stream_roundtrip_multi_block(alg, monkeypatch):
+    # Shrink the stream block so the multi-block path runs fast.
+    import spacedrive_tpu.crypto.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod, "BLOCK_LEN", 1024)
+    key = generate_master_key()
+    nonce = alg.generate_nonce()
+    data = os.urandom(3 * 1024 + 77)
+    sealed = Encryptor.encrypt_bytes(key, nonce, alg, data, aad=b"hdr")
+    assert len(sealed) == len(data) + 4 * 16  # one tag per block
+    opened = Decryptor.decrypt_bytes(key, nonce, alg, sealed, aad=b"hdr")
+    assert opened.expose() == data
+
+
+def test_stream_rejects_block_reorder(monkeypatch):
+    import spacedrive_tpu.crypto.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod, "BLOCK_LEN", 1024)
+    alg = Algorithm.XCHACHA20_POLY1305
+    key = generate_master_key()
+    nonce = alg.generate_nonce()
+    data = os.urandom(4096)
+    sealed = Encryptor.encrypt_bytes(key, nonce, alg, data)
+    b = 1024 + 16
+    swapped = sealed[b:2 * b] + sealed[:b] + sealed[2 * b:]
+    with pytest.raises(Exception):
+        Decryptor.decrypt_bytes(key, nonce, alg, swapped)
+
+
+def test_stream_rejects_truncation(monkeypatch):
+    import spacedrive_tpu.crypto.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod, "BLOCK_LEN", 1024)
+    alg = Algorithm.AES_256_GCM
+    key = generate_master_key()
+    nonce = alg.generate_nonce()
+    sealed = Encryptor.encrypt_bytes(key, nonce, alg, os.urandom(4096))
+    with pytest.raises(Exception):
+        # Dropping the final block means the last-block flag never
+        # matches during decryption.
+        Decryptor.decrypt_bytes(key, nonce, alg, sealed[:1024 + 16])
+
+
+def test_password_hashing_deterministic_and_salted():
+    pw = Protected(b"correct horse battery staple")
+    salt = generate_salt()
+    k1 = hash_password(HASH, pw, salt, PARAMS)
+    k2 = hash_password(HASH, pw, salt, PARAMS)
+    assert k1 == k2 and len(k1) == 32
+    k3 = hash_password(HASH, pw, generate_salt(), PARAMS)
+    assert k1 != k3
+    k4 = hash_password(HASH, pw, salt, PARAMS,
+                       secret=Protected(b"x" * 18))
+    assert k1 != k4
+
+
+def test_blake3_derive_key_modes_distinct():
+    material = b"m" * 32
+    a = derive_key("context a", material)
+    b = derive_key("context b", material)
+    plain = blake3_digest(material)
+    assert len(a) == 32 and a != b and a != plain
+
+
+def test_blake3_keyed_mode_structure():
+    """No independent keyed-mode oracle exists in this image; these
+    structural checks exercise the keyed paths the plain-mode vectors
+    can't: parent/root compressions must carry KEYED_HASH (multi-chunk
+    streaming == one-shot), and keying with the IV bytes must still
+    differ from plain hashing (flag difference alone)."""
+    import struct as _struct
+
+    from spacedrive_tpu.ops.blake3_ref import IV, Blake3, blake3_keyed
+
+    key = bytes(range(32))
+    data = bytes(i % 251 for i in range(5000))  # 5 chunks → parent nodes
+    oneshot = blake3_keyed(key, data)
+    h = Blake3(key=key)
+    for i in range(0, len(data), 777):
+        h.update(data[i:i + 777])
+    assert h.digest() == oneshot
+
+    iv_bytes = _struct.pack("<8I", *IV)
+    assert blake3_keyed(iv_bytes, data) != blake3_digest(data)
+    assert blake3_keyed(key, data) != blake3_keyed(key[::-1], data)
+    with pytest.raises(ValueError):
+        blake3_keyed(b"short", data)
+
+
+def test_derive_key_multichunk_material():
+    material = bytes(i % 251 for i in range(3000))
+    a = derive_key("ctx", material)
+    b = derive_key("ctx", material)
+    assert a == b and len(a) == 32
+    assert derive_key("ctx", material, 64)[:32] == a
+
+
+def test_header_roundtrip_with_keyslots_metadata_preview():
+    mk = generate_master_key()
+    header = FileHeader.new(Algorithm.XCHACHA20_POLY1305)
+    header.add_keyslot(HASH, PARAMS, Protected(b"pw1"), mk)
+    header.add_keyslot(HASH, PARAMS, Protected(b"pw2"), mk)
+    header.add_metadata(mk, {"name": "x.png", "kind": 5})
+    header.add_preview_media(mk, b"\x89PNG fake")
+    blob = header.serialize()
+
+    r = io.BytesIO(blob + b"CONTENT")
+    parsed = FileHeader.deserialize(r)
+    assert r.read() == b"CONTENT"  # positioned after header
+    for pw in (b"pw1", b"pw2"):
+        got = parsed.decrypt_master_key(Protected(pw))
+        assert got == mk
+    with pytest.raises(ValueError):
+        parsed.decrypt_master_key(Protected(b"wrong"))
+    assert parsed.decrypt_metadata(mk) == {"name": "x.png", "kind": 5}
+    assert parsed.decrypt_preview_media(mk) == b"\x89PNG fake"
+
+
+def test_header_keyslot_limit():
+    mk = generate_master_key()
+    header = FileHeader.new()
+    header.add_keyslot(HASH, PARAMS, Protected(b"a"), mk)
+    header.add_keyslot(HASH, PARAMS, Protected(b"b"), mk)
+    with pytest.raises(ValueError):
+        header.add_keyslot(HASH, PARAMS, Protected(b"c"), mk)
+
+
+def test_encrypt_decrypt_file_end_to_end(tmp_path):
+    src = tmp_path / "plain.bin"
+    data = os.urandom(70_000)
+    src.write_bytes(data)
+    enc_path = tmp_path / "sealed.sdtpu"
+    with open(src, "rb") as fin, open(enc_path, "wb") as fout:
+        encrypt_file(fin, fout, Protected(b"hunter2"),
+                     hashing_algorithm=HASH, params=PARAMS,
+                     metadata={"original": "plain.bin"})
+    assert enc_path.read_bytes()[:5] == b"sdtpu"
+
+    out = io.BytesIO()
+    with open(enc_path, "rb") as fin:
+        hdr = decrypt_file(fin, out, Protected(b"hunter2"))
+    assert out.getvalue() == data
+    mk = hdr.decrypt_master_key(Protected(b"hunter2"))
+    assert hdr.decrypt_metadata(mk)["original"] == "plain.bin"
+
+    with open(enc_path, "rb") as fin:
+        with pytest.raises(ValueError):
+            decrypt_file(fin, io.BytesIO(), Protected(b"wrong"))
+
+
+def test_tampered_content_rejected(tmp_path):
+    src = io.BytesIO(b"secret payload")
+    sealed = io.BytesIO()
+    encrypt_file(src, sealed, Protected(b"pw"), hashing_algorithm=HASH,
+                 params=PARAMS)
+    raw = bytearray(sealed.getvalue())
+    raw[-1] ^= 0x01  # flip a ciphertext bit
+    with pytest.raises(Exception):
+        decrypt_file(io.BytesIO(bytes(raw)), io.BytesIO(), Protected(b"pw"))
+
+
+def test_header_rejects_truncated_and_hostile_input():
+    from spacedrive_tpu.crypto.header import MAGIC
+    import struct as _struct
+
+    # Truncated body after magic.
+    with pytest.raises(ValueError):
+        FileHeader.deserialize(io.BytesIO(MAGIC + b"\x02\x00\x00\x00a"))
+    # Hostile 4 GiB length prefix must refuse before allocating.
+    with pytest.raises(ValueError):
+        FileHeader.deserialize(
+            io.BytesIO(MAGIC + _struct.pack("<I", 0xFFFFFFF0)))
+    # Valid wrapper, garbage inside.
+    with pytest.raises(ValueError):
+        FileHeader.deserialize(io.BytesIO(MAGIC + b"\x03\x00\x00\x00abc"))
+
+
+def test_key_manager_unlocks_across_default_changes(tmp_path):
+    path = str(tmp_path / "keys.json")
+    km = KeyManager(path, algorithm=Algorithm.AES_256_GCM,
+                    hashing_algorithm=HASH, params=PARAMS)
+    km.initialize(Protected(b"master"))
+    # Reopen with different (default) constructor arguments: the
+    # verification record pins the sealing algorithm.
+    km2 = KeyManager(path, hashing_algorithm=HASH, params=PARAMS)
+    km2.unlock(Protected(b"master"))
+    assert km2.is_unlocked
+
+
+def test_key_manager_lifecycle(tmp_path):
+    path = str(tmp_path / "keys.json")
+    km = KeyManager(path, hashing_algorithm=HASH, params=PARAMS)
+    km.initialize(Protected(b"master"))
+    uid = km.add_key(Protected(b"library key material"), automount=True)
+    km.mount(uid)
+    assert km.mounted_key(uid).expose() == b"library key material"
+
+    # Fresh manager from disk: locked until the master password returns.
+    km2 = KeyManager(path, hashing_algorithm=HASH, params=PARAMS)
+    assert not km2.is_unlocked
+    with pytest.raises(ValueError):
+        km2.unlock(Protected(b"nope"))
+    km2.unlock(Protected(b"master"))
+    km2.automount()
+    assert km2.mounted_key(uid).expose() == b"library key material"
+    km2.lock()
+    assert not km2.is_unlocked
+
+
+def test_secure_erase_overwrites(tmp_path):
+    p = tmp_path / "victim.bin"
+    p.write_bytes(b"A" * 4096)
+    secure_erase(str(p), passes=1)
+    after = p.read_bytes()
+    assert len(after) == 4096 and after != b"A" * 4096
+    secure_erase(str(p), passes=1, unlink=True)
+    assert not p.exists()
+
+
+def test_protected_wrapper():
+    buf = bytearray(b"sensitive")
+    p = Protected(buf)
+    assert buf == bytearray(len(buf))  # source zeroized
+    assert p.expose() == b"sensitive"
+    assert "sensitive" not in repr(p)
+    p.zeroize()
+    assert p.expose() == b"\x00" * 9

@@ -1,0 +1,182 @@
+"""File encrypt/decrypt jobs end-to-end through the job system."""
+
+import asyncio
+
+import pytest
+
+from spacedrive_tpu.jobs.report import JobStatus
+from spacedrive_tpu.locations.manager import create_location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects.crypto_ops import FileDecryptorJob, FileEncryptorJob
+
+
+@pytest.fixture(autouse=True)
+def _tiny_balloon_costs(monkeypatch):
+    from spacedrive_tpu.crypto import hashing
+    from spacedrive_tpu.crypto.hashing import Params
+
+    monkeypatch.setattr(hashing, "_BALLOON_COSTS", {
+        Params.STANDARD: (16, 1),
+        Params.HARDENED: (32, 1),
+        Params.PARANOID: (64, 1),
+    })
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def env(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "doc.txt").write_bytes(b"top secret contents" * 100)
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+
+    async def setup():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+
+        sid = create_location(lib, str(src))
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        assert await node.jobs.wait(j) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        return sid
+    sid = _run(setup())
+    return node, lib, src, sid
+
+
+def _fp_id(lib, name):
+    return lib.db.query_one(
+        "SELECT id FROM file_path WHERE name = ?", (name,))["id"]
+
+
+def test_encrypt_then_decrypt_roundtrip(env):
+    node, lib, src, sid = env
+    plain = (src / "doc.txt").read_bytes()
+
+    async def main():
+        job = FileEncryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+            password="pw123", hashing_algorithm="BalloonBlake3",
+            erase_original=True)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+
+    sealed = src / "doc.txt.sdtpu"
+    assert sealed.exists() and not (src / "doc.txt").exists()
+    assert sealed.read_bytes()[:5] == b"sdtpu"
+
+    # Re-index so the sealed file has a row, then decrypt it back.
+    async def reindex_and_decrypt():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        await node.jobs.wait(j)
+        job = FileDecryptorJob(
+            location_id=sid,
+            file_path_ids=[_fp_id(lib, "doc.txt")],  # name incl. orig ext
+            password="pw123")
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(reindex_and_decrypt())
+    assert (src / "doc.txt").read_bytes() == plain
+
+
+def test_decrypt_wrong_password_reports_error(env):
+    node, lib, src, sid = env
+
+    async def main():
+        job = FileEncryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+            password="right", hashing_algorithm="BalloonBlake3",
+            erase_original=True)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        await node.jobs.wait(j)
+        job = FileDecryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc.txt")],
+            password="wrong")
+        jid = await node.jobs.ingest(lib, job)
+        # Per-step errors are non-fatal (JobRunErrors semantics).
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED_WITH_ERRORS
+    _run(main())
+    assert not (src / "doc.txt").exists()
+
+
+def test_encrypted_file_keeps_original_size_plus_overhead(env):
+    node, lib, src, sid = env
+    orig_size = (src / "doc.txt").stat().st_size
+
+    async def main():
+        job = FileEncryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+            password="pw", hashing_algorithm="BalloonBlake3",
+            with_metadata=False)
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    sealed_size = (src / "doc.txt.sdtpu").stat().st_size
+    # header < 1 KiB + one AEAD tag for a single-block file
+    assert orig_size + 16 < sealed_size < orig_size + 1024
+    assert (src / "doc.txt").exists()  # erase_original defaults off
+
+
+def test_cold_resume_registry_includes_crypto_jobs():
+    from spacedrive_tpu.jobs.job import JOB_REGISTRY
+
+    assert "file_encryptor" in JOB_REGISTRY
+    assert "file_decryptor" in JOB_REGISTRY
+
+
+def test_password_never_persisted(env):
+    """The job table must not contain the password (TRANSIENT_ARGS)."""
+    node, lib, src, sid = env
+
+    async def main():
+        job = FileEncryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+            password="sup3r-s3cret-pw", hashing_algorithm="BalloonBlake3")
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(main())
+    for row in lib.db.query("SELECT data FROM job"):
+        assert b"sup3r-s3cret-pw" not in (row["data"] or b"")
+
+
+def test_cold_resumed_job_without_password_degrades(env):
+    node, lib, src, sid = env
+    job = FileEncryptorJob(
+        location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+        password=None, hashing_algorithm="BalloonBlake3")
+
+    async def main():
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED_WITH_ERRORS
+    _run(main())
+    assert not (src / "doc.txt.sdtpu").exists()
+
+
+def test_encrypt_replay_skips_completed_seal(env):
+    """A replayed (idempotent) step finds its finished output and does
+    not spawn ' (1)' duplicates."""
+    node, lib, src, sid = env
+
+    async def once():
+        job = FileEncryptorJob(
+            location_id=sid, file_path_ids=[_fp_id(lib, "doc")],
+            password="pw", hashing_algorithm="BalloonBlake3")
+        jid = await node.jobs.ingest(lib, job)
+        assert await node.jobs.wait(jid) == JobStatus.COMPLETED
+    _run(once())
+    _run(once())  # identical init args → replay-equivalent second run
+    assert (src / "doc.txt.sdtpu").exists()
+    assert not (src / "doc.txt (1).sdtpu").exists()
+    assert not (src / "doc.txt.sdtpu (1)").exists()
+
+
