@@ -882,12 +882,24 @@ def _search(r: Router) -> None:
             f"SELECT COUNT(*) AS n FROM object o WHERE {where}", params)["n"]
 
     @r.query("search.ephemeralPaths")
-    def search_ephemeral(node, input):
+    async def search_ephemeral(node, input):
         path = str(input["path"])
         if not os.path.isdir(path):
             raise RpcError("BAD_REQUEST", f"{path} is not a directory")
-        return walk_ephemeral(
-            path, with_hidden_files=bool(input.get("with_hidden_files")))
+        want_thumbs = bool(input.get("with_thumbnails"))
+        # CAS hashing is file I/O — never on the event loop.
+        entries = await asyncio.to_thread(
+            walk_ephemeral, path,
+            with_hidden_files=bool(input.get("with_hidden_files")),
+            compute_cas_ids=want_thumbs)
+        if want_thumbs and node.thumbnailer.is_running():
+            # Fire-and-forget ephemeral batch (non_indexed.rs spawns the
+            # same way); NewThumbnail events announce completions.
+            batch = [(e["cas_id"], e["path"])
+                     for e in entries if e.get("cas_id")]
+            if batch:
+                await node.thumbnailer.new_ephemeral_batch(batch)
+        return entries
 
     # Net-new: device dedup analytics surfaces.
     @r.query("search.duplicates", library=True)

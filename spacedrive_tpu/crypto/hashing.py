@@ -12,8 +12,10 @@ additional keying material, as the reference does.
 Cost levels are calibrated for this runtime rather than copied: Argon2id
 uses the reference-class memory costs; Balloon's pure-Python space costs
 are scaled down ~64× (it is a compatibility/portability path, not the
-default) — the parameter block is recorded in the keyslot so hashes
-always re-verify with the parameters they were created with.
+default). The actual cost tuple (`HashingAlgorithm.costs`) is persisted
+in every keyslot / key-manager verification record and passed back in at
+verify time, so existing hashes keep working if these tables are
+retuned.
 """
 
 from __future__ import annotations
@@ -49,22 +51,33 @@ class HashingAlgorithm(enum.Enum):
     ARGON2ID = "Argon2id"
     BALLOON_BLAKE3 = "BalloonBlake3"
 
+    def costs(self, params: Params) -> tuple:
+        """Normalized 3-int cost tuple — what keyslots persist so hashes
+        survive future retuning of the tables above: argon2
+        (memory KiB, iterations, lanes); balloon (space, time, 0)."""
+        if self is HashingAlgorithm.ARGON2ID:
+            return tuple(_ARGON2_COSTS[params])
+        space, time = _BALLOON_COSTS[params]
+        return (space, time, 0)
+
     def hash(self, password: Protected, salt: bytes, params: Params,
-             secret: Protected | None = None) -> Protected:
+             secret: Protected | None = None,
+             costs: tuple | None = None) -> Protected:
         if len(salt) != SALT_LEN:
             raise ValueError("salt must be 16 bytes")
         pw = password.expose()
         if secret is not None:
             pw = pw + secret.expose()
+        costs = tuple(costs) if costs else self.costs(params)
         if self is HashingAlgorithm.ARGON2ID:
-            return _argon2id(pw, salt, params)
-        return _balloon_blake3(pw, salt, params)
+            return _argon2id(pw, salt, costs)
+        return _balloon_blake3(pw, salt, costs)
 
 
-def _argon2id(password: bytes, salt: bytes, params: Params) -> Protected:
+def _argon2id(password: bytes, salt: bytes, costs: tuple) -> Protected:
     from argon2.low_level import Type, hash_secret_raw
 
-    memory, iters, lanes = _ARGON2_COSTS[params]
+    memory, iters, lanes = costs
     raw = hash_secret_raw(
         secret=password, salt=salt, time_cost=iters, memory_cost=memory,
         parallelism=lanes, hash_len=KEY_LEN, type=Type.ID,
@@ -73,11 +86,11 @@ def _argon2id(password: bytes, salt: bytes, params: Params) -> Protected:
 
 
 def _balloon_blake3(password: bytes, salt: bytes,
-                    params: Params) -> Protected:
+                    costs: tuple) -> Protected:
     """Balloon hashing with BLAKE3 as H; delta=3 (BCGS16 §3.2)."""
     from ..ops.blake3_ref import blake3_digest
 
-    space, time = _BALLOON_COSTS[params]
+    space, time = costs[0], costs[1]
     h = lambda *parts: blake3_digest(b"".join(parts), 64)  # noqa: E731
     cnt = 0
 
@@ -102,6 +115,10 @@ def _balloon_blake3(password: bytes, salt: bytes,
 
 def hash_password(algorithm: HashingAlgorithm, password: Protected,
                   salt: bytes, params: Params = Params.STANDARD,
-                  secret: Protected | None = None) -> Protected:
-    """Password (+ optional secret key) + salt → 32-byte wrapping key."""
-    return algorithm.hash(password, salt, params, secret)
+                  secret: Protected | None = None,
+                  costs: tuple | None = None) -> Protected:
+    """Password (+ optional secret key) + salt → 32-byte wrapping key.
+
+    `costs` (from a stored keyslot) overrides the live cost tables so
+    old hashes keep verifying after retuning."""
+    return algorithm.hash(password, salt, params, secret, costs)

@@ -44,6 +44,9 @@ class Keyslot:
     algorithm: Algorithm
     hashing_algorithm: HashingAlgorithm
     hashing_params: Params
+    # The concrete KDF cost tuple used at creation time (3 uint32s) —
+    # persisted so retuning the live cost tables never breaks unlocking.
+    kdf_costs: tuple
     salt: bytes
     content_salt: bytes
     master_key_nonce: bytes
@@ -56,13 +59,15 @@ class Keyslot:
             secret: Optional[Protected] = None) -> "Keyslot":
         salt = generate_salt()
         nonce = algorithm.generate_nonce()
+        costs = hashing_algorithm.costs(params)
         wrapping = hash_password(hashing_algorithm, password, salt, params,
-                                 secret)
+                                 secret, costs=costs)
         return cls(
             version=KEYSLOT_VERSION,
             algorithm=algorithm,
             hashing_algorithm=hashing_algorithm,
             hashing_params=params,
+            kdf_costs=costs,
             salt=salt,
             content_salt=generate_salt(),
             master_key_nonce=nonce,
@@ -73,7 +78,8 @@ class Keyslot:
     def unlock(self, password: Protected,
                secret: Optional[Protected] = None) -> Protected:
         wrapping = hash_password(self.hashing_algorithm, password,
-                                 self.salt, self.hashing_params, secret)
+                                 self.salt, self.hashing_params, secret,
+                                 costs=self.kdf_costs)
         return decrypt_key(self.encrypted_master_key,
                            self.master_key_nonce, self.algorithm, wrapping)
 
@@ -83,6 +89,7 @@ class Keyslot:
                         _ALG_CODE[self.algorithm],
                         _HASH_CODE[self.hashing_algorithm],
                         _PARAM_CODE[self.hashing_params]),
+            struct.pack("<III", *self.kdf_costs),
             _pfx(self.salt), _pfx(self.content_salt),
             _pfx(self.master_key_nonce), _pfx(self.encrypted_master_key),
         ])
@@ -90,12 +97,14 @@ class Keyslot:
     @classmethod
     def _unpack(cls, r: io.BytesIO) -> "Keyslot":
         version, alg, hsh, par = struct.unpack("<HBBB", _read_exact(r, 5))
+        costs = struct.unpack("<III", _read_exact(r, 12))
         try:
             return cls(
                 version=version,
                 algorithm=_ALG_BY_CODE[alg],
                 hashing_algorithm=_HASH_BY_CODE[hsh],
                 hashing_params=_PARAM_BY_CODE[par],
+                kdf_costs=costs,
                 salt=_read_pfx(r), content_salt=_read_pfx(r),
                 master_key_nonce=_read_pfx(r),
                 encrypted_master_key=_read_pfx(r),

@@ -32,14 +32,15 @@ from .stream import Algorithm, decrypt_key, encrypt_key
 
 @dataclass
 class StoredKey:
-    """One sealed key entry (keymanager.rs StoredKey, simplified)."""
+    """One sealed key entry (keymanager.rs StoredKey, simplified).
+
+    Entries are sealed directly under the root key (no per-entry
+    password hashing), so the only state is the AEAD triple + flags.
+    """
 
     uuid: str
     version: int
     algorithm: Algorithm
-    hashing_algorithm: HashingAlgorithm
-    hashing_params: Params
-    salt: bytes
     master_key_nonce: bytes
     encrypted_key: bytes  # the actual key material, sealed by root key
     memory_only: bool = False
@@ -50,9 +51,6 @@ class StoredKey:
             "uuid": self.uuid,
             "version": self.version,
             "algorithm": self.algorithm.value,
-            "hashing_algorithm": self.hashing_algorithm.value,
-            "hashing_params": self.hashing_params.value,
-            "salt": self.salt.hex(),
             "master_key_nonce": self.master_key_nonce.hex(),
             "encrypted_key": self.encrypted_key.hex(),
             "automount": self.automount,
@@ -63,9 +61,6 @@ class StoredKey:
         return cls(
             uuid=d["uuid"], version=d["version"],
             algorithm=Algorithm(d["algorithm"]),
-            hashing_algorithm=HashingAlgorithm(d["hashing_algorithm"]),
-            hashing_params=Params(d["hashing_params"]),
-            salt=bytes.fromhex(d["salt"]),
             master_key_nonce=bytes.fromhex(d["master_key_nonce"]),
             encrypted_key=bytes.fromhex(d["encrypted_key"]),
             automount=d.get("automount", False),
@@ -124,9 +119,15 @@ class KeyManager:
     def initialize(self, master_password: Protected,
                    secret: Optional[Protected] = None) -> None:
         """First-run setup: derive the verification entry + root key."""
+        if self._verification is not None:
+            # Re-initializing would mint a new root key and orphan every
+            # stored key sealed under the old one.
+            raise ValueError(
+                "key manager already initialized; unlock() instead")
         salt = generate_salt()
+        costs = self.hashing_algorithm.costs(self.params)
         hashed = hash_password(self.hashing_algorithm, master_password,
-                               salt, self.params, secret)
+                               salt, self.params, secret, costs=costs)
         wrapping = Protected(derive_key(MASTER_PASSWORD_CONTEXT,
                                         hashed.expose()))
         root = generate_master_key()
@@ -139,6 +140,7 @@ class KeyManager:
             "algorithm": self.algorithm.value,
             "hashing_algorithm": self.hashing_algorithm.value,
             "hashing_params": self.params.value,
+            "kdf_costs": list(costs),
         }
         self._root_key = Protected(derive_key(ROOT_KEY_CONTEXT,
                                               root.expose()))
@@ -151,7 +153,8 @@ class KeyManager:
         v = self._verification
         hashed = hash_password(
             HashingAlgorithm(v["hashing_algorithm"]), master_password,
-            bytes.fromhex(v["salt"]), Params(v["hashing_params"]), secret)
+            bytes.fromhex(v["salt"]), Params(v["hashing_params"]), secret,
+            costs=tuple(v["kdf_costs"]) if v.get("kdf_costs") else None)
         wrapping = Protected(derive_key(MASTER_PASSWORD_CONTEXT,
                                         hashed.expose()))
         # The verification record pins every parameter it was created
@@ -192,8 +195,6 @@ class KeyManager:
                              aad=uid.encode())
         self._stored[uid] = StoredKey(
             uuid=uid, version=self.VERSION, algorithm=self.algorithm,
-            hashing_algorithm=self.hashing_algorithm,
-            hashing_params=self.params, salt=generate_salt(),
             master_key_nonce=nonce, encrypted_key=sealed,
             memory_only=memory_only, automount=automount)
         self._save()

@@ -1,0 +1,193 @@
+"""Thumbnailer actor: the long-lived batch-thumbnailing service.
+
+Behavioral equivalent of the reference's standalone thumbnailer actor
+(/root/reference/core/src/object/media/thumbnail/actor.rs:64-586), which
+is deliberately NOT a job: it outlives jobs, owns the 256-way sharded
+webp cache, and serves two queues — indexed batches (cas_id + source
+path, dispatched by the media processor) and ephemeral batches (paths
+browsed outside any library, non_indexed.rs). Completed thumbnails emit
+`NewThumbnail` core events; a periodic clean-up pass removes cache
+entries whose cas_ids appear in no loaded library; a version file
+invalidates the whole cache across format changes
+(thumbnail/directory.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .thumbnail import (
+    THUMBNAIL_CACHE_VERSION,
+    THUMBNAILABLE_EXTENSIONS,
+    VERSION_FILE,
+    ensure_thumbnail_dir,
+    generate_thumbnail,
+    remove_thumbnails_by_cas_ids,
+    thumbnail_path,
+)
+
+BATCH_CONCURRENCY = 4        # actor.rs processing fan-out per batch
+CLEANUP_TICK_S = 1800.0      # periodic clean-up vs library DBs
+
+
+@dataclass
+class ThumbBatch:
+    """One unit of queued work: (cas_id, source path) pairs."""
+
+    entries: List[tuple]     # [(cas_id, full_path), ...]
+    library_id: Optional[object] = None
+    ephemeral: bool = False
+    done: asyncio.Event = field(default_factory=asyncio.Event)
+    generated: int = 0
+
+
+class Thumbnailer:
+    """Actor facade: queue batches, await them, let the loop work."""
+
+    def __init__(self, node):
+        self.node = node
+        self.data_dir = node.data_dir
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._cleanup_task: Optional[asyncio.Task] = None
+        self._migrate_version()
+
+    # -- cache versioning (thumbnail/directory.rs) -------------------------
+
+    def _migrate_version(self) -> None:
+        root = os.path.join(self.data_dir, "thumbnails")
+        vf = os.path.join(root, VERSION_FILE)
+        if os.path.isdir(root):
+            try:
+                with open(vf) as f:
+                    on_disk = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                on_disk = 0
+            if on_disk != THUMBNAIL_CACHE_VERSION:
+                # Format change: the whole cache is regenerable state.
+                shutil.rmtree(root, ignore_errors=True)
+        ensure_thumbnail_dir(self.data_dir)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(self._run())
+        if self._cleanup_task is None or self._cleanup_task.done():
+            self._cleanup_task = loop.create_task(self._cleanup_loop())
+
+    async def stop(self) -> None:
+        for task in (self._task, self._cleanup_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._task = self._cleanup_task = None
+
+    # -- queueing API (actor.rs new_batch / new_ephemeral_batch) -----------
+
+    async def new_batch(self, entries: List[tuple],
+                        library_id=None) -> ThumbBatch:
+        batch = ThumbBatch(entries=list(entries), library_id=library_id)
+        await self.queue.put(batch)
+        return batch
+
+    async def new_ephemeral_batch(self, entries: List[tuple]) -> ThumbBatch:
+        batch = ThumbBatch(entries=list(entries), ephemeral=True)
+        await self.queue.put(batch)
+        return batch
+
+    def remove_cas_ids(self, cas_ids) -> int:
+        return remove_thumbnails_by_cas_ids(self.data_dir, cas_ids)
+
+    # -- the actor loop ----------------------------------------------------
+
+    def is_running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def _run(self) -> None:
+        while True:
+            batch: ThumbBatch = await self.queue.get()
+            try:
+                await self._process(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # One poisoned batch must not kill the actor: jobs
+                # await batch.done with no timeout.
+                self.node.events.emit({
+                    "type": "ThumbnailerError", "error": str(e)})
+            finally:
+                batch.done.set()
+
+    async def _process(self, batch: ThumbBatch) -> None:
+        sem = asyncio.Semaphore(BATCH_CONCURRENCY)
+
+        async def one(cas_id: str, path: str) -> None:
+            ext = os.path.splitext(path)[1].lstrip(".").lower()
+            if ext not in THUMBNAILABLE_EXTENSIONS:
+                return
+            async with sem:
+                out = await asyncio.to_thread(
+                    generate_thumbnail, path, self.data_dir, cas_id)
+            if out:
+                batch.generated += 1
+                self.node.events.emit({
+                    "type": "NewThumbnail", "cas_id": cas_id,
+                    "ephemeral": batch.ephemeral})
+
+        await asyncio.gather(
+            *(one(cas_id, path) for cas_id, path in batch.entries))
+
+    # -- clean-up (actor.rs periodic pass vs all library DBs) --------------
+
+    async def _cleanup_loop(self) -> None:
+        while True:
+            await asyncio.sleep(CLEANUP_TICK_S)
+            try:
+                await asyncio.to_thread(self.clean_up)
+            except Exception:
+                pass  # best-effort janitor; never kill the actor
+
+    def clean_up(self) -> int:
+        """Remove cached thumbnails whose cas_id is referenced by no
+        loaded library. Returns the number removed."""
+        known = set()
+        for lib in self.node.libraries.list():
+            for row in lib.db.query(
+                    "SELECT DISTINCT cas_id FROM file_path "
+                    "WHERE cas_id IS NOT NULL"):
+                known.add(row["cas_id"])
+        removed = 0
+        root = os.path.join(self.data_dir, "thumbnails")
+        if not os.path.isdir(root):
+            return 0
+        for shard in os.listdir(root):
+            shard_dir = os.path.join(root, shard)
+            if not os.path.isdir(shard_dir) or len(shard) != 2:
+                continue
+            for name in os.listdir(shard_dir):
+                if not name.endswith(".webp"):
+                    continue
+                cas_id = name[:-5]
+                if cas_id not in known:
+                    try:
+                        os.remove(os.path.join(shard_dir, name))
+                        removed += 1
+                    except OSError:
+                        pass
+            try:
+                os.rmdir(shard_dir)  # only succeeds when empty
+            except OSError:
+                pass
+        return removed
+
+    def exists(self, cas_id: str) -> bool:
+        return os.path.exists(thumbnail_path(self.data_dir, cas_id))

@@ -1,0 +1,79 @@
+"""Audio/video stream metadata (sd-media-metadata's audio/video side).
+
+The reference ships typed audio/video metadata structs that are mostly
+stubs awaiting an ffmpeg binding (/root/reference/crates/media-metadata/
+src/{audio.rs,video.rs}). Here the same typed rows fill from `ffprobe`
+when it exists (media/video.py gates) and return None otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+from .video import available as ffmpeg_available
+
+
+@dataclass
+class StreamMetadata:
+    duration_seconds: Optional[float] = None
+    bitrate: Optional[int] = None
+    format_name: Optional[str] = None
+    # video stream
+    width: Optional[int] = None
+    height: Optional[int] = None
+    fps: Optional[float] = None
+    video_codec: Optional[str] = None
+    # audio stream
+    audio_codec: Optional[str] = None
+    sample_rate: Optional[int] = None
+    channels: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in asdict(self).items() if v is not None}
+
+
+def probe_media(path: str) -> Optional[StreamMetadata]:
+    """ffprobe → StreamMetadata, or None when unavailable/undecodable."""
+    if not ffmpeg_available():
+        return None
+    try:
+        out = subprocess.run(
+            ["ffprobe", "-v", "quiet", "-print_format", "json",
+             "-show_format", "-show_streams", path],
+            capture_output=True, timeout=30, check=True)
+        raw = json.loads(out.stdout)
+    except Exception:
+        return None
+    md = StreamMetadata()
+    fmt = raw.get("format", {})
+    md.format_name = fmt.get("format_name")
+    try:
+        md.duration_seconds = float(fmt["duration"])
+    except (KeyError, ValueError):
+        pass
+    try:
+        md.bitrate = int(fmt["bit_rate"])
+    except (KeyError, ValueError):
+        pass
+    for stream in raw.get("streams", []):
+        if stream.get("codec_type") == "video" and md.width is None:
+            md.width = stream.get("width")
+            md.height = stream.get("height")
+            md.video_codec = stream.get("codec_name")
+            rate = stream.get("avg_frame_rate", "0/1")
+            try:
+                num, _, den = rate.partition("/")
+                md.fps = float(num) / float(den or 1)
+            except (ValueError, ZeroDivisionError):
+                pass
+        elif stream.get("codec_type") == "audio" and md.audio_codec is None:
+            md.audio_codec = stream.get("codec_name")
+            try:
+                md.sample_rate = int(stream.get("sample_rate", 0)) or None
+            except ValueError:
+                pass
+            md.channels = stream.get("channels")
+    return md

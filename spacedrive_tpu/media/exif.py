@@ -113,8 +113,14 @@ def extract_media_data(path: str) -> Optional[Dict[str, Any]]:
             lat = _gps_to_degrees(gps.get(2), str(gps.get(1, "N")))
             lon = _gps_to_degrees(gps.get(4), str(gps.get(3, "E")))
             if lat is not None and lon is not None:
-                row["media_location"] = msgpack.packb(
-                    {"latitude": lat, "longitude": lon})
+                from .pluscodes import encode as encode_pluscode
+
+                row["media_location"] = msgpack.packb({
+                    "latitude": lat, "longitude": lon,
+                    # Human-shareable plus code, as the reference derives
+                    # (media-metadata pluscodes.rs).
+                    "pluscode": encode_pluscode(lat, lon),
+                })
     except Exception:
         pass
     return row

@@ -1,0 +1,146 @@
+"""Image decode/convert dispatch (the sd-images crate surface).
+
+Mirrors /root/reference/crates/images: `format_image` (decode to a
+canonical RGB(A) image) and `convert_image` (decode + re-encode) route
+by extension through per-format handlers, behind a 192 MiB size guard
+(consts.rs:9). Handler availability is runtime-gated the way the
+reference feature-gates heif/pdfium: the generic raster path is PIL;
+HEIF decodes when a PIL HEIF plugin is importable; SVG rasterizes when
+a cairosvg-like renderer exists; PDF renders when pypdfium2 exists.
+Unavailable handlers raise `UnsupportedFormat` with the reason, so
+callers degrade per-file exactly like the reference's error path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+MIB = 1_048_576
+MAXIMUM_FILE_SIZE = 192 * MIB     # consts.rs:9
+SVG_TARGET_PX = 262_144.0         # consts.rs:31
+PDF_RENDER_WIDTH = 992            # consts.rs:37
+
+GENERIC_EXTENSIONS = {
+    "bmp", "dib", "ff", "gif", "ico", "jpg", "jpeg", "png", "pnm",
+    "qoi", "tga", "icb", "vda", "vst", "tiff", "tif", "webp",
+}
+SVG_EXTENSIONS = {"svg", "svgz"}
+PDF_EXTENSIONS = {"pdf"}
+HEIF_EXTENSIONS = {"heif", "heifs", "heic", "heics", "avif", "avci",
+                   "avcs"}
+
+
+class ImageHandlerError(Exception):
+    pass
+
+
+class UnsupportedFormat(ImageHandlerError):
+    pass
+
+
+def _check_size(path: str) -> None:
+    if os.path.getsize(path) > MAXIMUM_FILE_SIZE:
+        raise ImageHandlerError(
+            f"{path}: exceeds maximum image size (192 MiB)")
+
+
+def _heif_available() -> bool:
+    try:
+        import pillow_heif  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _pdf_available() -> bool:
+    try:
+        import pypdfium2  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _svg_available() -> bool:
+    try:
+        import cairosvg  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def supported_extensions() -> List[str]:
+    """Extensions `format_image` can decode in this runtime."""
+    exts = sorted(GENERIC_EXTENSIONS)
+    if _heif_available():
+        exts += sorted(HEIF_EXTENSIONS)
+    if _svg_available():
+        exts += sorted(SVG_EXTENSIONS)
+    if _pdf_available():
+        exts += sorted(PDF_EXTENSIONS)
+    return exts
+
+
+def format_image(path: str):
+    """Decode any supported image to a PIL Image (handler.rs:18)."""
+    _check_size(path)
+    ext = os.path.splitext(path)[1].lstrip(".").lower()
+    if ext in GENERIC_EXTENSIONS:
+        from PIL import Image
+
+        im = Image.open(path)
+        im.load()
+        return im
+    if ext in HEIF_EXTENSIONS:
+        if not _heif_available():
+            raise UnsupportedFormat(
+                f"{ext}: HEIF decoding needs a PIL HEIF plugin "
+                "(not present in this runtime)")
+        import pillow_heif
+        from PIL import Image
+
+        pillow_heif.register_heif_opener()
+        im = Image.open(path)
+        im.load()
+        return im
+    if ext in SVG_EXTENSIONS:
+        if not _svg_available():
+            raise UnsupportedFormat(
+                f"{ext}: SVG rasterization needs cairosvg "
+                "(not present in this runtime)")
+        import io
+
+        import cairosvg
+        from PIL import Image
+
+        png = cairosvg.svg2png(url=path,
+                               output_width=int(SVG_TARGET_PX ** 0.5))
+        return Image.open(io.BytesIO(png))
+    if ext in PDF_EXTENSIONS:
+        if not _pdf_available():
+            raise UnsupportedFormat(
+                f"{ext}: PDF rendering needs pypdfium2 "
+                "(not present in this runtime)")
+        import pypdfium2
+
+        pdf = pypdfium2.PdfDocument(path)
+        page = pdf[0]
+        scale = PDF_RENDER_WIDTH / page.get_size()[0]
+        return page.render(scale=scale).to_pil()
+    raise UnsupportedFormat(f"unsupported image extension: {ext!r}")
+
+
+def convert_image(path: str, desired_ext: str):
+    """Decode + convert for re-encoding under `desired_ext`
+    (handler.rs:23). Returns a PIL Image ready to `.save()`."""
+    desired = desired_ext.lstrip(".").lower()
+    if desired not in GENERIC_EXTENSIONS:
+        raise UnsupportedFormat(
+            f"cannot encode to {desired_ext!r}")
+    im = format_image(path)
+    if desired in ("jpg", "jpeg") and im.mode != "RGB":
+        im = im.convert("RGB")
+    return im

@@ -58,41 +58,71 @@ class MediaProcessorJob(StatefulJob):
         return data, steps
 
     async def execute_step(self, ctx, data, step, step_number):
-        return await asyncio.to_thread(self._step, ctx, data, step)
+        outcome = await asyncio.to_thread(self._exif_step, ctx, data, step)
+        await self._thumbs_step(ctx, data, step)
+        ctx.progress(message=(
+            f"media: {data['extracted']} exif, {data['thumbs']} thumbs"))
+        outcome.metadata = {
+            "media_data_extracted": data["extracted"],
+            "thumbnails_generated": data["thumbs"],
+        }
+        return outcome
 
-    def _step(self, ctx: JobContext, data, step) -> StepOutcome:
+    def _exif_step(self, ctx: JobContext, data, step) -> StepOutcome:
         db = ctx.db
-        data_dir = ctx.services.get("data_dir")
         errors: List[str] = []
         for r in step["rows"]:
             ext = (r["extension"] or "").lower()
-            iso = IsolatedPath.from_db_row(
-                self.location_id, False, r["materialized_path"],
-                r["name"] or "", r["extension"] or "")
-            full = iso.join_on(data["location_path"])
-            if ext in MEDIA_DATA_EXTENSIONS:
-                existing = db.query_one(
-                    "SELECT id FROM media_data WHERE object_id = ?",
-                    (r["object_id"],))
-                if existing is None:
-                    md = extract_media_data(full)
-                    if md is not None:
-                        md["object_id"] = r["object_id"]
-                        try:
-                            db.insert("media_data", md)
-                            data["extracted"] += 1
-                        except Exception as e:  # unique race: another path
-                            errors.append(f"media_data {full}: {e}")
-            if data_dir and r["cas_id"] and ext in THUMBNAILABLE_EXTENSIONS:
-                ensure_thumbnail_dir(data_dir)
-                if generate_thumbnail(full, data_dir, r["cas_id"]):
+            if ext not in MEDIA_DATA_EXTENSIONS:
+                continue
+            full = self._full_path(data, r)
+            existing = db.query_one(
+                "SELECT id FROM media_data WHERE object_id = ?",
+                (r["object_id"],))
+            if existing is None:
+                md = extract_media_data(full)
+                if md is not None:
+                    md["object_id"] = r["object_id"]
+                    try:
+                        db.insert("media_data", md)
+                        data["extracted"] += 1
+                    except Exception as e:  # unique race: another path
+                        errors.append(f"media_data {full}: {e}")
+        return StepOutcome(errors=errors)
+
+    async def _thumbs_step(self, ctx: JobContext, data, step) -> None:
+        """Dispatch the batch to the thumbnailer actor (job.rs dispatches
+        to the actor, actor.rs:487); inline fallback when the job runs
+        without a node (unit harnesses)."""
+        data_dir = ctx.services.get("data_dir")
+        if not data_dir:
+            return
+        entries = []
+        for r in step["rows"]:
+            ext = (r["extension"] or "").lower()
+            if r["cas_id"] and ext in THUMBNAILABLE_EXTENSIONS:
+                entries.append((r["cas_id"], self._full_path(data, r)))
+        if not entries:
+            return
+        node = ctx.services.get("node")
+        actor = getattr(node, "thumbnailer", None) if node else None
+        if actor is not None and actor.is_running():
+            batch = await actor.new_batch(
+                entries, library_id=getattr(ctx.library, "id", None))
+            await batch.done.wait()
+            data["thumbs"] += batch.generated
+        else:
+            ensure_thumbnail_dir(data_dir)
+            for cas_id, full in entries:
+                if await asyncio.to_thread(
+                        generate_thumbnail, full, data_dir, cas_id):
                     data["thumbs"] += 1
-        ctx.progress(message=(
-            f"media: {data['extracted']} exif, {data['thumbs']} thumbs"))
-        return StepOutcome(errors=errors, metadata={
-            "media_data_extracted": data["extracted"],
-            "thumbnails_generated": data["thumbs"],
-        })
+
+    def _full_path(self, data, r) -> str:
+        iso = IsolatedPath.from_db_row(
+            self.location_id, False, r["materialized_path"],
+            r["name"] or "", r["extension"] or "")
+        return iso.join_on(data["location_path"])
 
     async def finalize(self, ctx, data, metadata):
         return metadata

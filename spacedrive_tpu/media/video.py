@@ -1,0 +1,82 @@
+"""Video thumbnailing via the ffmpeg CLI (capability-gated).
+
+The reference's sd-ffmpeg crate drives raw ffmpeg FFI: seek to 10% of
+the stream, decode one frame, scale, encode webp
+(/root/reference/crates/ffmpeg/src/thumbnailer.rs:11-161,
+movie_decoder.rs:32). This runtime image ships no ffmpeg binary or
+libraries, so the same contract is implemented over the `ffmpeg`/
+`ffprobe` CLIs when present and degrades to None when not —
+`available()` gates the media pipeline's video branch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from functools import lru_cache
+from typing import Optional
+
+from .thumbnail import TARGET_QUALITY, scale_dimensions
+
+SEEK_PERCENTAGE = 0.10  # thumbnailer.rs seek to 10%
+VIDEO_EXTENSIONS = {
+    "mp4", "mkv", "mov", "avi", "webm", "m4v", "mpg", "mpeg", "wmv",
+    "flv", "3gp", "ts", "mts", "m2ts", "ogv",
+}
+
+
+@lru_cache(maxsize=1)
+def available() -> bool:
+    return (shutil.which("ffmpeg") is not None
+            and shutil.which("ffprobe") is not None)
+
+
+def probe_duration(path: str) -> Optional[float]:
+    """Container duration in seconds, or None."""
+    if not available():
+        return None
+    try:
+        out = subprocess.run(
+            ["ffprobe", "-v", "quiet", "-print_format", "json",
+             "-show_format", path],
+            capture_output=True, timeout=30, check=True)
+        return float(json.loads(out.stdout)["format"]["duration"])
+    except Exception:
+        return None
+
+
+def generate_video_thumbnail(input_path: str, out_path: str,
+                             target_px: float = 262144.0
+                             ) -> Optional[str]:
+    """Seek 10%, grab one frame, scale to ~target_px, encode webp.
+
+    Returns out_path on success, None when ffmpeg is missing or the
+    decode fails (the caller records no thumbnail, as the reference does
+    on MovieDecoder errors)."""
+    if not available():
+        return None
+    duration = probe_duration(input_path) or 0.0
+    seek = duration * SEEK_PERCENTAGE
+    # ~512×512-equivalent area; ffmpeg keeps aspect via -2.
+    w, _ = scale_dimensions(1024, 1024, target_px)
+    tmp = out_path + ".tmp"
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        subprocess.run(
+            ["ffmpeg", "-v", "quiet", "-ss", f"{seek:.3f}",
+             "-i", input_path, "-frames:v", "1",
+             "-vf", f"scale='min({w},iw)':-2",
+             "-quality", str(TARGET_QUALITY), "-y", tmp],
+            capture_output=True, timeout=60, check=True)
+        if not os.path.getsize(tmp):
+            raise ValueError("empty frame")
+        os.replace(tmp, out_path)
+        return out_path
+    except Exception:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None

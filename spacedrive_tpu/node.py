@@ -162,6 +162,11 @@ class Node:
         )
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
         self.p2p = None  # created by start_p2p (P2PManager)
+        # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
+        # bootstrap (cache version migration runs here), loop starts with
+        # the node.
+        from .media.actor import Thumbnailer
+        self.thumbnailer = Thumbnailer(self)
         self._started = False
         self.libraries.on_event(self._on_library_event)
         # Warm the native I/O plane at bootstrap (may compile libsdio.so
@@ -175,6 +180,7 @@ class Node:
         """Load libraries, cold-resume their interrupted jobs, start
         actors."""
         self._started = True
+        self.thumbnailer.start()
         self.libraries.init()
         for lib in self.libraries.list():
             await self.jobs.cold_resume(lib)
@@ -217,6 +223,7 @@ class Node:
     async def shutdown(self) -> None:
         """Node::shutdown (lib.rs:205): pause jobs, stop actors."""
         await self.jobs.shutdown()
+        await self.thumbnailer.stop()
         if self.p2p is not None:
             await self.p2p.stop()
         for remover in self.orphan_removers.values():

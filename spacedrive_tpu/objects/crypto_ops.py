@@ -26,21 +26,28 @@ from .fs_ops import _FsJobBase, _file_datas, find_available_filename_for_duplica
 ENCRYPTED_EXT = "sdtpu"
 
 
-def _looks_like_completed_seal(src: str, target: str) -> bool:
-    """Cheap replay detection: `target` is a fully-written seal of a file
-    at least as large as `src` (header parses; sealed stream ≥ source).
-    No password needed, so replays skip without an argon2 round-trip."""
+def _looks_like_completed_seal(src: str, target: str,
+                               password: str | None = None) -> bool:
+    """Replay detection: `target` is a fully-written seal of a file at
+    least as large as `src` (header parses; sealed stream ≥ source;
+    target postdates the source's last write). When `password` is given
+    it must also unlock the header — the cost of one KDF round-trip is
+    nothing next to what the erase_original path would otherwise risk:
+    treating an OLD seal under a DIFFERENT password as this job's output
+    and erasing the only plaintext."""
     from ..crypto.header import FileHeader
+    from ..crypto.primitives import Protected
 
     try:
         with open(target, "rb") as f:
-            FileHeader.deserialize(f)
+            header = FileHeader.deserialize(f)
             header_end = f.tell()
-        # Sealed stream must cover the source AND postdate its last
-        # write — a stale seal of since-modified content doesn't count.
-        return (os.path.getsize(target) - header_end
-                >= os.path.getsize(src)
-                and os.path.getmtime(target) >= os.path.getmtime(src))
+        if (os.path.getsize(target) - header_end < os.path.getsize(src)
+                or os.path.getmtime(target) < os.path.getmtime(src)):
+            return False
+        if password is not None:
+            header.decrypt_master_key(Protected(password.encode()))
+        return True
     except (OSError, ValueError):
         return False
 
@@ -94,7 +101,7 @@ class FileEncryptorJob(_FsJobBase):
                 return StepOutcome(errors=[f"source missing: {src}"])
             target = src + "." + ENCRYPTED_EXT
             if os.path.exists(target):
-                if _looks_like_completed_seal(src, target):
+                if _looks_like_completed_seal(src, target, self.password):
                     # Replayed step (idempotency contract, jobs/job.py):
                     # this step already finished before the interruption —
                     # but a crash between seal and erase must not leave

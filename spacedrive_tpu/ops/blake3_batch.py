@@ -228,13 +228,18 @@ def chunk_cvs(xp, words, lengths, counter_base=0, whole=True):
     return cv, n_chunks
 
 
-def tree_reduce(xp, cvs, n_chunks):
+def tree_reduce(xp, cvs, n_chunks, root=True):
     """Fold per-chunk CVs into root digests via adjacent pairing.
 
     cvs: list of 8 [B, C] arrays; n_chunks: [B]. Returns list of 8 [B]
     arrays — the first 32 bytes of each file's BLAKE3 digest. Lanes with
     n_chunks == 1 pass through untouched (their ROOT compression already
     happened in the chunk stage).
+
+    root=False computes interior-subtree tops instead: no merge ever
+    carries the ROOT flag, so the result can keep merging upward — the
+    local stage of the sequence-parallel (sharded single-file) reduction
+    in ops/seqhash.py.
     """
     B, C = cvs[0].shape
     u32 = lambda v: xp.asarray(v, dtype=xp.uint32)  # noqa: E731
@@ -251,7 +256,7 @@ def tree_reduce(xp, cvs, n_chunks):
         right = [w[:, 1::2] for w in cvs]
         pair_index = xp.arange(half, dtype=xp.int32)[None, :]
         merged_real = (pair_index * 2 + 1) < n[:, None]
-        is_root = (n[:, None] == 2) & (pair_index == 0)
+        is_root = (n[:, None] == 2) & (pair_index == 0) & root
         flags = u32(PARENT) + xp.where(is_root, u32(ROOT), u32(0))
         iv_cv = [u32(IV[i]) * xp.ones((B, half), dtype=xp.uint32) for i in range(8)]
         parent = compress_cv(

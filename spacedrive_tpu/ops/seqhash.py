@@ -1,0 +1,127 @@
+"""Sequence-parallel BLAKE3: one huge file sharded across the mesh.
+
+The long-context analog in this framework (SURVEY.md §5 "long-context /
+sequence parallelism"): where an LLM shards one sequence's tokens across
+devices, the validator shards one file's chunk chain. BLAKE3's tree mode
+makes this exact — the tree over chunk CVs is adjacent pairing with
+odd-promote, so any power-of-two-aligned span of chunks reduces to an
+independent subtree top:
+
+  stage 1 (local, zero collectives): each device hashes its contiguous
+      span of chunks (counter base = global chunk index) and folds them
+      to one subtree top with a no-ROOT tree reduction;
+  stage 2 (one all-gather over ICI): the D shard tops are gathered and
+      the top-of-tree reduction (log2 D tiny parent compressions) runs
+      replicated on every device.
+
+Semantics match the streaming oracle bit-for-bit
+(/root/reference/core/src/object/validation/hash.rs full-file checksum,
+here computed without any single device ever holding the whole file).
+
+Shard capacity must be a power of two chunks so shard boundaries land on
+subtree boundaries; files that fit in a single shard take the ordinary
+batched path instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .blake3_batch import CHUNK_LEN, WORDS_PER_CHUNK, tree_reduce
+from .blake3_jax import _chunk_cvs_scan
+
+DEFAULT_SHARD_CHUNKS = 64  # 64 KiB per device-shard in tests; tune up on TPU
+
+
+def _shard_fn(words_local, length, shard_chunks: int):
+    """Per-device stage: [cps, 256] chunk words → 8-word subtree top.
+
+    Byte offsets are int32 (x64 stays off): one sharded *call* is bounded
+    at 2 GiB; the validator streams larger files through this in 2 GiB
+    windows via the counter_base plumbing.
+    """
+    idx = jax.lax.axis_index("data")
+    start = (idx * shard_chunks * CHUNK_LEN).astype(jnp.int32)
+    local_len = jnp.clip(length - start, 0, shard_chunks * CHUNK_LEN)
+    # Chunk counter base: global chunk index of this shard's first chunk.
+    # Carried as (lo, hi) uint32; hi=0 bounds files at 2^32 chunks (4 TiB).
+    base_lo = (idx * shard_chunks).astype(jnp.uint32)
+    base_hi = jnp.zeros((), jnp.uint32)
+    cvs, n = _chunk_cvs_scan(words_local[None], local_len[None],
+                             counter_base=(base_lo, base_hi), whole=False)
+    top = tree_reduce(jnp, cvs, n, root=False)  # 8 × [1]
+    return jnp.stack([w[0] for w in top])  # [8]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "shard_chunks"))
+def _sharded_blake3(words, length, n_tops, *, mesh: Mesh,
+                    shard_chunks: int):
+    """words: [D*cps, 256] uint32 sharded on chunk axis; length: scalar
+    int64; n_tops: scalar int32 (shards holding real chunks)."""
+    from jax.experimental.shard_map import shard_map
+
+    def inner(words_local):
+        top = _shard_fn(words_local, length, shard_chunks)
+        tops = jax.lax.all_gather(top, "data")  # [D, 8] replicated
+        return tops
+
+    tops = shard_map(
+        inner, mesh=mesh,
+        in_specs=(P("data", None),),
+        out_specs=P(None, None),
+        check_rep=False,
+    )(words)
+    # Top-of-tree: adjacent pairing over shard tops; final merge is ROOT.
+    cvs = [tops[:, i][None, :] for i in range(8)]  # 8 × [1, D]
+    digest = tree_reduce(jnp, cvs, n_tops[None], root=True)
+    return jnp.stack([w[0] for w in digest])  # [8]
+
+
+def make_sharded_checksum(mesh: Mesh,
+                          shard_chunks: int = DEFAULT_SHARD_CHUNKS):
+    """Returns fn(data: bytes) -> 32-byte BLAKE3 digest, computed with
+    the file's chunk chain sharded across `mesh`'s devices."""
+    if shard_chunks & (shard_chunks - 1):
+        raise ValueError("shard_chunks must be a power of two")
+    D = int(np.prod(mesh.devices.shape))
+    capacity = D * shard_chunks * CHUNK_LEN
+
+    def fn(data: bytes) -> bytes:
+        n_chunks = max(1, -(-len(data) // CHUNK_LEN))
+        if n_chunks <= shard_chunks:
+            # Fits one shard: the top stage would need ROOT handling the
+            # sharded path deliberately never applies — use the batched
+            # single-lane path.
+            from .blake3_batch import blake3_batch_np
+
+            return blake3_batch_np([data])[0]
+        if len(data) > capacity:
+            raise ValueError(
+                f"data ({len(data)} B) exceeds mesh capacity "
+                f"({capacity} B); raise shard_chunks")
+        buf = np.zeros(capacity, dtype=np.uint8)
+        buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        words = buf.view("<u4").reshape(D * shard_chunks, WORDS_PER_CHUNK)
+        sharding = NamedSharding(mesh, P("data", None))
+        words_dev = jax.device_put(jnp.asarray(words), sharding)
+        n_tops = np.int32(-(-n_chunks // shard_chunks))
+        digest = _sharded_blake3(
+            words_dev, jnp.asarray(len(data), jnp.int32),
+            jnp.asarray(n_tops), mesh=mesh, shard_chunks=shard_chunks)
+        return np.asarray(digest).astype("<u4").tobytes()
+
+    return fn
+
+
+def sharded_file_checksum(mesh: Mesh, path: str,
+                          shard_chunks: int = DEFAULT_SHARD_CHUNKS) -> str:
+    """Full-file checksum (validator semantics, hash.rs:10-24) with the
+    chunk chain sequence-sharded across the mesh. Returns 64-hex digest."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return make_sharded_checksum(mesh, shard_chunks)(data).hex()

@@ -104,9 +104,7 @@ class P2PManager:
         req = SpaceblockRequest(os.path.basename(file_path), size)
         tunnel = await self.open_stream(addr, port)
         try:
-            drop_id = uuidlib.uuid4().hex
-            await tunnel.send({"t": "spacedrop", "id": drop_id,
-                              "req": req.to_wire()})
+            await tunnel.send({"t": "spacedrop", "req": req.to_wire()})
             verdict = await asyncio.wait_for(
                 tunnel.recv(), timeout=SPACEDROP_TIMEOUT_S)
             if verdict != "accept":
@@ -251,6 +249,12 @@ class P2PManager:
             return
         await tunnel.send("accept")
         self._spacedrop_cancel[drop_id] = False
+        # Announce the receive (with its cancellation id) in BOTH modes —
+        # p2p.cancelSpacedrop needs an id even when a sync hook accepted.
+        self.node.events.emit({
+            "type": "SpacedropStarted", "id": drop_id, "name": req.name,
+            "size": req.size, "path": save_path,
+            "peer": tunnel.remote.to_bytes().hex()})
         try:
             with open(save_path, "wb") as out:
                 await receive_file(

@@ -1,0 +1,59 @@
+"""sd-images facade: dispatch, size guard, runtime gating."""
+
+import os
+
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from spacedrive_tpu.media.images import (  # noqa: E402
+    GENERIC_EXTENSIONS,
+    ImageHandlerError,
+    UnsupportedFormat,
+    convert_image,
+    format_image,
+    supported_extensions,
+)
+
+
+def test_generic_decode_and_convert(tmp_path):
+    p = tmp_path / "a.png"
+    Image.new("RGBA", (20, 10), (1, 2, 3, 255)).save(p)
+    im = format_image(str(p))
+    assert im.size == (20, 10)
+    jpg = convert_image(str(p), "jpeg")
+    assert jpg.mode == "RGB"  # alpha dropped for JPEG
+
+
+def test_unknown_extension_rejected(tmp_path):
+    p = tmp_path / "weird.xyz"
+    p.write_bytes(b"not an image")
+    with pytest.raises(UnsupportedFormat):
+        format_image(str(p))
+    with pytest.raises(UnsupportedFormat):
+        convert_image(str(p), "xyz")
+
+
+def test_size_guard(tmp_path, monkeypatch):
+    import spacedrive_tpu.media.images as images
+
+    monkeypatch.setattr(images, "MAXIMUM_FILE_SIZE", 50)
+    p = tmp_path / "big.png"
+    Image.new("RGB", (64, 64)).save(p)
+    assert p.stat().st_size > 50
+    with pytest.raises(ImageHandlerError):
+        images.format_image(str(p))
+
+
+def test_supported_extensions_contains_generics():
+    exts = supported_extensions()
+    assert GENERIC_EXTENSIONS <= set(exts) | {"jpg", "jpeg"}
+
+
+def test_avmetadata_gates_without_ffmpeg(tmp_path):
+    from spacedrive_tpu.media import avmetadata, video
+
+    if video.available():
+        pytest.skip("ffmpeg present")
+    assert avmetadata.probe_media(str(tmp_path / "x.mp4")) is None

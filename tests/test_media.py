@@ -1,0 +1,64 @@
+"""Media metadata: pluscodes (official OLC vectors), EXIF GPS, video gate."""
+
+import pytest
+
+from spacedrive_tpu.media.pluscodes import encode
+
+
+def test_pluscode_official_vectors():
+    """Vectors from the Open Location Code conformance data."""
+    cases = [
+        ((20.375, 2.775, 6), "7FG49Q00+"),
+        ((20.3700625, 2.7821875, 10), "7FG49QCJ+2V"),
+        ((47.365590, 8.524997, 10), "8FVC9G8F+6X"),
+        ((-41.2730625, 174.7859375, 10), "4VCPPQGP+Q9"),
+        ((20.3701125, 2.782234375, 11), "7FG49QCJ+2VX"),
+        ((90.0, 1.0, 4), "CFX30000+"),
+    ]
+    for (lat, lon, length), want in cases:
+        assert encode(lat, lon, length) == want
+
+
+def test_pluscode_rejects_bad_lengths():
+    with pytest.raises(ValueError):
+        encode(0, 0, 1)
+    with pytest.raises(ValueError):
+        encode(0, 0, 7)  # odd below pair length
+
+
+def test_pluscode_longitude_wraps():
+    assert encode(0, 180.0, 10) == encode(0, -180.0, 10)
+
+
+def test_gps_dms_to_pluscode_pipeline():
+    """EXIF DMS rationals → decimal degrees → plus code (the media-data
+    path that fills media_location.pluscode)."""
+    from spacedrive_tpu.media.exif import _gps_to_degrees
+
+    from fractions import Fraction
+
+    def dms(decimal: str):
+        v = Fraction(decimal)
+        d = int(v)
+        m = int((v - d) * 60)
+        s = (v - d - Fraction(m, 60)) * 3600
+        return Fraction(d), Fraction(m), s
+
+    gps = {
+        1: "N", 2: dms("47.365590"),
+        3: "E", 4: dms("8.524997"),
+    }
+    lat = _gps_to_degrees(gps[2], gps[1])
+    lon = _gps_to_degrees(gps[4], gps[3])
+    assert lat == pytest.approx(47.365590, abs=1e-4)
+    assert lon == pytest.approx(8.524997, abs=1e-4)
+    assert encode(lat, lon, 10) == "8FVC9G8F+6X"
+
+
+def test_video_thumbnailer_gates_without_ffmpeg(tmp_path):
+    from spacedrive_tpu.media import video
+
+    if video.available():
+        pytest.skip("ffmpeg present; gate test is for its absence")
+    assert video.generate_video_thumbnail(
+        str(tmp_path / "clip.mp4"), str(tmp_path / "out.webp")) is None

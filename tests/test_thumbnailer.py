@@ -1,0 +1,144 @@
+"""Thumbnailer actor: batches, events, cleanup, cache versioning."""
+
+import asyncio
+import os
+
+import pytest
+
+from spacedrive_tpu.media.actor import Thumbnailer
+from spacedrive_tpu.media.thumbnail import (
+    THUMBNAIL_CACHE_VERSION,
+    thumbnail_path,
+)
+from spacedrive_tpu.node import Node
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _make_image(path, size=(640, 480)):
+    Image.new("RGB", size, (200, 30, 90)).save(path)
+
+
+@pytest.fixture
+def node(tmp_path):
+    return Node(str(tmp_path / "data"))
+
+
+def test_batch_generates_thumbs_and_events(node, tmp_path):
+    img = tmp_path / "pic.png"
+    _make_image(img)
+    events = []
+    node.events.subscribe(
+        lambda e: e.get("type") == "NewThumbnail" and events.append(e))
+
+    async def main():
+        await node.start()
+        batch = await node.thumbnailer.new_batch(
+            [("a1b2c3d4e5f60718", str(img))])
+        await asyncio.wait_for(batch.done.wait(), 10)
+        assert batch.generated == 1
+        await node.shutdown()
+    _run(main())
+    out = thumbnail_path(node.data_dir, "a1b2c3d4e5f60718")
+    assert os.path.exists(out)
+    # Sharded path: thumbnails/<cas[0:2]>/<cas>.webp (shard.rs:4).
+    assert os.path.basename(os.path.dirname(out)) == "a1"
+    assert events and events[0]["cas_id"] == "a1b2c3d4e5f60718"
+    with Image.open(out) as thumb:
+        assert thumb.format == "WEBP"
+        assert thumb.width * thumb.height <= 262144 * 1.05
+
+
+def test_unsupported_and_missing_files_skipped(node, tmp_path):
+    async def main():
+        await node.start()
+        batch = await node.thumbnailer.new_batch([
+            ("ffffffffffffffff", str(tmp_path / "missing.png")),
+            ("eeeeeeeeeeeeeeee", str(tmp_path / "notes.txt")),
+        ])
+        await asyncio.wait_for(batch.done.wait(), 10)
+        assert batch.generated == 0
+        await node.shutdown()
+    _run(main())
+
+
+def test_cleanup_removes_unreferenced(node, tmp_path):
+    img = tmp_path / "pic.png"
+    _make_image(img)
+
+    async def main():
+        await node.start()
+        lib = node.create_library("t")
+        b = await node.thumbnailer.new_batch([
+            ("11112222333344445", str(img)),
+            ("aaaabbbbccccdddd", str(img)),
+        ])
+        await asyncio.wait_for(b.done.wait(), 10)
+        # Reference one cas_id from the library; the other is orphaned.
+        lib.db.execute(
+            "INSERT INTO location (pub_id, name, path) VALUES (?,?,?)",
+            (os.urandom(16), "l", str(tmp_path)))
+        loc = lib.db.query_one("SELECT id FROM location")["id"]
+        lib.db.execute(
+            "INSERT INTO file_path (pub_id, location_id, cas_id, "
+            "materialized_path, name, extension, is_dir) "
+            "VALUES (?,?,?,?,?,?,0)",
+            (os.urandom(16), loc, "11112222333344445", "/", "pic", "png"))
+        removed = node.thumbnailer.clean_up()
+        assert removed == 1
+        assert node.thumbnailer.exists("11112222333344445")
+        assert not node.thumbnailer.exists("aaaabbbbccccdddd")
+        await node.shutdown()
+    _run(main())
+
+
+def test_cache_version_migration(tmp_path):
+    data_dir = str(tmp_path / "data")
+    os.makedirs(os.path.join(data_dir, "thumbnails", "ab"), exist_ok=True)
+    stale = os.path.join(data_dir, "thumbnails", "ab", "abcd.webp")
+    open(stale, "wb").write(b"old")
+    with open(os.path.join(data_dir, "thumbnails", "version.txt"),
+              "w") as f:
+        f.write("0")  # stale format version
+
+    node = Node(data_dir)  # Thumbnailer.__init__ migrates
+    assert not os.path.exists(stale)
+    vf = os.path.join(data_dir, "thumbnails", "version.txt")
+    assert int(open(vf).read()) == THUMBNAIL_CACHE_VERSION
+    assert node.thumbnailer is not None
+
+
+def test_media_processor_routes_through_actor(node, tmp_path):
+    """End-to-end: index → identify → media processor uses the actor."""
+    from spacedrive_tpu.jobs.report import JobStatus
+    from spacedrive_tpu.locations.manager import create_location
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.media.processor import MediaProcessorJob
+    from spacedrive_tpu.objects.identifier import FileIdentifierJob
+
+    src = tmp_path / "loc"
+    src.mkdir()
+    _make_image(src / "photo.jpg")
+
+    async def main():
+        await node.start()
+        lib = node.create_library("t")
+        loc = create_location(lib, str(src))
+        for job in (IndexerJob(location_id=loc),
+                    FileIdentifierJob(location_id=loc),
+                    MediaProcessorJob(location_id=loc)):
+            jid = await node.jobs.ingest(lib, job)
+            status = await node.jobs.wait(jid)
+            assert status in (JobStatus.COMPLETED,
+                              JobStatus.COMPLETED_WITH_ERRORS), job.NAME
+        row = lib.db.query_one(
+            "SELECT cas_id FROM file_path WHERE name = 'photo'")
+        assert row["cas_id"]
+        assert node.thumbnailer.exists(row["cas_id"])
+        await node.shutdown()
+    _run(main())
