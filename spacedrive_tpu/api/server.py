@@ -40,6 +40,7 @@ class ApiServer:
         self.node = node
         self.router = router or mount_router(node)
         self.app = web.Application()
+        self.app.router.add_get("/", self._index)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/rspc", self._rspc_ws)
         self.app.router.add_post("/rspc/{path}", self._rspc_http)
@@ -71,6 +72,12 @@ class ApiServer:
 
     async def _health(self, _request: web.Request) -> web.Response:
         return web.Response(text="OK")
+
+    async def _index(self, _request: web.Request) -> web.Response:
+        """Embedded web explorer (apps/web equivalent, webui.py)."""
+        from .webui import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def _rspc_http(self, request: web.Request) -> web.Response:
         path = request.match_info["path"]

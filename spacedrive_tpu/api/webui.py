@@ -1,0 +1,195 @@
+"""Embedded web explorer: the minimal L5 surface.
+
+The reference ships a full React frontend (interface/, 21k LoC) behind
+its rspc transport; this framework embeds a single-file explorer served
+at `/` by the server host so every core flow is drivable from a browser
+with zero build tooling: libraries (list/create), locations (add /
+full-rescan), path browsing with thumbnails over the custom_uri routes,
+live job progress via the websocket subscription plane, and the dedup
+analytics views. The page speaks the same `/rspc` protocol the TS client
+of the reference generates bindings for (packages/client).
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>spacedrive-tpu</title>
+<style>
+  :root { color-scheme: dark; }
+  body { font: 14px system-ui, sans-serif; margin: 0; background: #16161d;
+         color: #e3e3ea; display: flex; height: 100vh; }
+  #side { width: 230px; background: #1e1e28; padding: 12px;
+          overflow-y: auto; flex-shrink: 0; }
+  #main { flex: 1; padding: 16px; overflow-y: auto; }
+  h1 { font-size: 15px; margin: 0 0 10px; }
+  h2 { font-size: 13px; text-transform: uppercase; color: #8a8a99;
+       margin: 14px 0 6px; }
+  button { background: #3b82f6; color: white; border: 0; border-radius: 4px;
+           padding: 4px 10px; cursor: pointer; margin: 2px 0; }
+  button.ghost { background: #2c2c3a; }
+  input { background: #12121a; color: #e3e3ea; border: 1px solid #333;
+          border-radius: 4px; padding: 4px 6px; }
+  .item { padding: 4px 6px; border-radius: 4px; cursor: pointer; }
+  .item:hover, .item.sel { background: #2c2c3a; }
+  #grid { display: grid; grid-template-columns: repeat(auto-fill, 110px);
+          gap: 10px; }
+  .cell { width: 110px; text-align: center; }
+  .cell .thumb { width: 100px; height: 80px; background: #22222e;
+                 border-radius: 6px; display: flex; align-items: center;
+                 justify-content: center; margin: 0 auto; overflow: hidden; }
+  .cell img { max-width: 100%; max-height: 100%; }
+  .cell .nm { font-size: 11px; word-break: break-all; margin-top: 3px; }
+  #jobs { position: fixed; bottom: 0; right: 0; width: 320px;
+          background: #1e1e28; padding: 8px 12px; border-radius: 8px 0 0 0;
+          max-height: 40vh; overflow-y: auto; }
+  .job { font-size: 12px; margin: 4px 0; }
+  .bar { height: 4px; background: #2c2c3a; border-radius: 2px; }
+  .bar > div { height: 4px; background: #3b82f6; border-radius: 2px; }
+</style>
+</head>
+<body>
+<div id="side">
+  <h1>spacedrive-tpu</h1>
+  <h2>Libraries</h2>
+  <div id="libs"></div>
+  <button id="newlib">+ library</button>
+  <h2>Locations</h2>
+  <div id="locs"></div>
+  <button id="newloc">+ location</button>
+</div>
+<div id="main">
+  <div id="path" style="margin-bottom:10px;color:#8a8a99"></div>
+  <div id="grid"></div>
+</div>
+<div id="jobs"><h2>Jobs</h2><div id="joblist"></div></div>
+<script>
+let reqId = 0, pending = {}, subs = {};
+const wsProto = location.protocol === "https:" ? "wss" : "ws";
+const ws = new WebSocket(`${wsProto}://${location.host}/rspc`);
+const wsReady = new Promise(res => ws.onopen = res);
+ws.onmessage = (m) => {
+  const f = JSON.parse(m.data);
+  if (f.type === "response" && pending[f.id]) {
+    pending[f.id].resolve(f.result); delete pending[f.id];
+  } else if (f.type === "error" && pending[f.id]) {
+    pending[f.id].reject(new Error(f.message)); delete pending[f.id];
+  } else if (f.type === "event" && subs[f.id]) {
+    subs[f.id](f.data);
+  }
+};
+async function rpc(type, path, input) {
+  await wsReady;
+  const id = ++reqId;
+  ws.send(JSON.stringify({id, type, path, input}));
+  return new Promise((resolve, reject) => pending[id] = {resolve, reject});
+}
+const q = (p, i) => rpc("query", p, i);
+const mut = (p, i) => rpc("mutation", p, i);
+async function sub(path, input, cb) {
+  await wsReady;
+  const id = ++reqId;
+  subs[id] = cb;
+  ws.send(JSON.stringify({id, type: "subscription", path, input}));
+}
+
+let lib = null, loc = null, curPath = "/";
+async function loadLibs() {
+  const libs = await q("library.list");
+  const el = document.getElementById("libs"); el.innerHTML = "";
+  for (const l of libs) {
+    const d = document.createElement("div");
+    d.className = "item" + (lib === l.uuid ? " sel" : "");
+    d.textContent = l.config ? l.config.name : l.name;
+    d.onclick = () => { lib = l.uuid; loadLibs(); loadLocs(); };
+    el.appendChild(d);
+  }
+  if (!lib && libs.length) { lib = libs[0].uuid; loadLocs(); }
+}
+async function loadLocs() {
+  if (!lib) return;
+  const locs = await q("locations.list", {library_id: lib});
+  const el = document.getElementById("locs"); el.innerHTML = "";
+  for (const l of locs) {
+    const d = document.createElement("div");
+    d.className = "item" + (loc === l.id ? " sel" : "");
+    d.textContent = l.name || l.path;
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      await mut("locations.fullRescan", {library_id: lib, location_id: l.id});
+    };
+    d.onclick = () => { loc = l.id; curPath = "/"; browse(); loadLocs(); };
+    el.appendChild(d);
+  }
+}
+async function browse() {
+  if (!lib || loc == null) return;
+  document.getElementById("path").textContent = `location ${loc} · ${curPath}`;
+  const rows = await q("search.paths", {
+    library_id: lib, take: 400,
+    filter: {location_id: loc, materialized_path: curPath},
+  });
+  const grid = document.getElementById("grid"); grid.innerHTML = "";
+  if (curPath !== "/") {
+    grid.appendChild(cell("..", null, true, () => {
+      curPath = curPath.replace(/[^/]+\/$/, ""); browse();
+    }));
+  }
+  for (const r of (rows.items || rows)) {
+    const isDir = !!r.is_dir;
+    const name = r.name + (r.extension ? "." + r.extension : "");
+    grid.appendChild(cell(name, r.cas_id, isDir, () => {
+      if (isDir) { curPath = r.materialized_path + r.name + "/"; browse(); }
+    }));
+  }
+}
+function cell(name, cas, isDir, onclick) {
+  const c = document.createElement("div"); c.className = "cell";
+  const t = document.createElement("div"); t.className = "thumb";
+  if (cas) {
+    const img = document.createElement("img");
+    img.src = `/spacedrive/thumbnail/${cas}.webp`;
+    img.onerror = () => { img.remove(); t.textContent = "🗎"; };
+    t.appendChild(img);
+  } else t.textContent = isDir ? "📁" : "🗎";
+  const n = document.createElement("div"); n.className = "nm";
+  n.textContent = name;
+  c.appendChild(t); c.appendChild(n);
+  c.onclick = onclick;
+  return c;
+}
+document.getElementById("newlib").onclick = async () => {
+  const name = prompt("library name"); if (!name) return;
+  await mut("library.create", {name}); lib = null; loadLibs();
+};
+document.getElementById("newloc").onclick = async () => {
+  const path = prompt("absolute path to index"); if (!path || !lib) return;
+  await mut("locations.create", {library_id: lib, path});
+  loadLocs();
+};
+sub("jobs.progress", null, (e) => {
+  const el = document.getElementById("joblist");
+  let row = document.getElementById("job-" + e.id);
+  if (!row) {
+    row = document.createElement("div"); row.className = "job";
+    row.id = "job-" + e.id;
+    row.innerHTML = `<span></span><div class="bar"><div></div></div>`;
+    el.prepend(row);
+  }
+  row.querySelector("span").textContent =
+    `${e.name || "job"} — ${e.message || ""}`;
+  const pct = e.task_count ? (100 * (e.completed_task_count || 0) /
+                              e.task_count) : 0;
+  row.querySelector(".bar > div").style.width = pct + "%";
+  if (e.task_count && e.completed_task_count >= e.task_count)
+    setTimeout(() => row.remove(), 4000);
+});
+sub("invalidation.listen", null, (e) => {
+  if (e.key === "search.paths") browse();
+  if (e.key === "library.list") loadLibs();
+});
+loadLibs();
+</script>
+</body>
+</html>
+"""

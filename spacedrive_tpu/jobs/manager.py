@@ -147,7 +147,7 @@ class JobManager:
                 if head.hash() in self._hashes:
                     self.on_event({
                         "type": "JobError",
-                        "id": entry.report.id,
+                        "id": entry.report.id.hex(),
                         "message": f"chained job {head.NAME} skipped: "
                                    "identical job already running/queued",
                     })

@@ -84,7 +84,7 @@ class Worker:
             self._last_progress_emit = now
             self.on_event({
                 "type": "JobProgress",
-                "id": r.id,
+                "id": r.id.hex(),  # JSON-safe: ids cross the ws boundary
                 "name": r.name,
                 "task_count": r.task_count,
                 "completed_task_count": r.completed_task_count,
@@ -115,7 +115,7 @@ class Worker:
     def _emit_final(self) -> None:
         self.on_event({
             "type": "JobUpdate",
-            "id": self.report.id,
+            "id": self.report.id.hex(),
             "name": self.report.name,
             "status": int(self.report.status),
         })

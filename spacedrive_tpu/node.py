@@ -182,6 +182,16 @@ class Node:
         self._started = True
         self.thumbnailer.start()
         self.libraries.init()
+        # Dev seed (util/debug_initializer.rs): data-dir init.json.
+        # BEFORE cold_resume so reset_on_startup never deletes a library
+        # whose interrupted jobs were just re-dispatched; errors are
+        # contained — a bad seed file must not become a boot loop.
+        from .debug_init import apply_init_file
+
+        try:
+            await apply_init_file(self)
+        except Exception as e:
+            self.events.emit({"type": "DebugInitError", "error": str(e)})
         for lib in self.libraries.list():
             await self.jobs.cold_resume(lib)
             self._ensure_actors(lib)

@@ -1,0 +1,71 @@
+"""Declarative dev-seed initializer (debug_initializer.rs semantics)."""
+
+import asyncio
+import json
+import os
+
+from spacedrive_tpu.node import Node
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_init_file_creates_library_and_location(tmp_path):
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    (corpus / "a.txt").write_bytes(b"seed data")
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "init.json").write_text(json.dumps({
+        "libraries": [{
+            "name": "dev",
+            "locations": [{"path": str(corpus), "scan": True}],
+        }],
+    }))
+
+    node = Node(str(data_dir))
+
+    async def main():
+        await node.start()
+        await node.jobs.wait_idle()
+        lib = node.libraries.list()[0]
+        assert lib.config.name == "dev"
+        row = lib.db.query_one("SELECT * FROM file_path WHERE name = 'a'")
+        assert row is not None  # the seeded scan indexed the corpus
+        await node.shutdown()
+    _run(main())
+
+
+def test_init_file_idempotent(tmp_path):
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "init.json").write_text(json.dumps({
+        "libraries": [{"name": "dev",
+                       "locations": [{"path": str(corpus),
+                                      "scan": False}]}],
+    }))
+
+    async def boot():
+        node = Node(str(data_dir))
+        await node.start()
+        await node.jobs.wait_idle()
+        assert len(node.libraries.list()) == 1
+        lib = node.libraries.list()[0]
+        n = lib.db.query_one("SELECT COUNT(*) AS n FROM location")["n"]
+        await node.shutdown()
+        return n
+    assert _run(boot()) == 1
+    assert _run(boot()) == 1  # second boot must not duplicate
+
+
+def test_missing_init_file_is_noop(tmp_path):
+    node = Node(str(tmp_path / "data"))
+
+    async def main():
+        await node.start()
+        await node.shutdown()
+    _run(main())
+    assert node.libraries.list() == []
