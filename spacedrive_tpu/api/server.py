@@ -167,6 +167,94 @@ class ApiServer:
             raise web.HTTPNotFound()
         return web.FileResponse(p, headers={"Content-Type": "image/webp"})
 
+    def _location_is_local(self, lib, loc) -> bool:
+        """A location is local when it belongs to this library instance
+        (or predates instance attribution)."""
+        if loc["instance_id"] is None:
+            return True
+        row = lib.db.query_one(
+            "SELECT pub_id FROM instance WHERE id = ?",
+            (loc["instance_id"],))
+        return row is None or row["pub_id"] == lib.sync.instance
+
+    async def _file_over_p2p(self, request, lib, loc, row
+                             ) -> web.StreamResponse:
+        """Proxy a remote node's file over the p2p mesh
+        (custom_uri/mod.rs files_over_p2p_flag path).
+
+        Rows cross the wire as synced pub_ids (local autoincrement ids
+        diverge between nodes); the client's Range maps onto the
+        spaceblock ranged transfer; the fetched bytes stream back in
+        RANGE_CHUNK pieces so multi-GB files never sit in RAM or block
+        the event loop."""
+        import tempfile
+
+        node = self.node
+        if ("filesOverP2P" not in node.config.features
+                or node.p2p is None or node.p2p.networked is None):
+            raise web.HTTPNotFound()
+        inst = lib.db.query_one(
+            "SELECT * FROM instance WHERE id = ?", (loc["instance_id"],))
+        if inst is None or not inst["identity"]:
+            raise web.HTTPNotFound()
+        from ..p2p.identity import RemoteIdentity
+
+        route = node.p2p.networked._resolve(RemoteIdentity(inst["identity"]))
+        if route is None:
+            raise web.HTTPNotFound(text="peer offline")
+
+        range_start = range_end = None
+        rng = request.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            try:
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                range_start = int(start_s) if start_s else 0
+                range_end = int(end_s) + 1 if end_s else None
+            except ValueError:
+                raise web.HTTPBadRequest()
+
+        with tempfile.NamedTemporaryFile(delete=False) as tmp:
+            tmp_path = tmp.name
+        try:
+            ok = await node.p2p.request_file(
+                route[0], route[1], str(lib.id), loc["pub_id"],
+                row["pub_id"], tmp_path,
+                range_start=range_start, range_end=range_end)
+            if not ok:
+                raise web.HTTPNotFound(text="remote fetch failed")
+            name = (row["name"] or "file") + (
+                "." + row["extension"] if row["extension"] else "")
+            ctype = mimetypes.guess_type(name)[0] or \
+                "application/octet-stream"
+            got = os.path.getsize(tmp_path)
+            headers = {"Content-Type": ctype, "X-Served-Via": "p2p",
+                       "Content-Length": str(got),
+                       "Accept-Ranges": "bytes"}
+            status = 200
+            if range_start is not None:
+                end_b = range_start + got - 1
+                raw_size = row["size_in_bytes_bytes"]
+                size_b = (int.from_bytes(raw_size, "big")
+                          if raw_size else "*")
+                headers["Content-Range"] = \
+                    f"bytes {range_start}-{end_b}/{size_b}"
+                status = 206
+            resp = web.StreamResponse(status=status, headers=headers)
+            await resp.prepare(request)
+            with open(tmp_path, "rb") as f:
+                while True:
+                    chunk = await asyncio.to_thread(f.read, RANGE_CHUNK)
+                    if not chunk:
+                        break
+                    await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+        finally:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+
     async def _file(self, request: web.Request) -> web.StreamResponse:
         """Original file serving with Range support
         (custom_uri/mod.rs:149-330)."""
@@ -184,8 +272,15 @@ class ApiServer:
             "SELECT * FROM file_path WHERE id = ? AND location_id = ?",
             (file_path_id, location_id))
         loc = lib.db.query_one(
-            "SELECT path FROM location WHERE id = ?", (location_id,))
-        if row is None or loc is None or not loc["path"]:
+            "SELECT * FROM location WHERE id = ?", (location_id,))
+        if row is None or loc is None:
+            raise web.HTTPNotFound()
+        if not self._location_is_local(lib, loc):
+            # Remote location: proxy the bytes over p2p when the
+            # FilesOverP2P feature is on (custom_uri/mod.rs:149-330
+            # files_over_p2p_flag path).
+            return await self._file_over_p2p(request, lib, loc, row)
+        if not loc["path"]:
             raise web.HTTPNotFound()
         iso = IsolatedPath.from_db_row(
             location_id, bool(row["is_dir"]), row["materialized_path"],

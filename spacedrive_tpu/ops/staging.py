@@ -225,10 +225,13 @@ _BACKENDS = {
 }
 
 
-# Below this batch size the device round-trip (dispatch + possible first
-# compile) costs more than the CPU path; watcher-triggered single-file
-# updates must never block on accelerator init.
-JAX_MIN_BATCH = 64
+# Below this batch size the device round-trip (H2D over the host link +
+# dispatch + possible first compile) costs more than the fused native
+# path; watcher-triggered single-file updates must never block on
+# accelerator init. Identifier steps (100/step, reference parity) stay
+# native — the device backend engages for the large analytics batches
+# (dedup/pHash/bench) or when a job pins backend="jax".
+JAX_MIN_BATCH = 256
 
 
 def default_backend(batch_size: int = JAX_MIN_BATCH) -> str:

@@ -116,17 +116,21 @@ class P2PManager:
             tunnel.close()
 
     async def request_file(self, addr: str, port: int, library_id: str,
-                           location_id: int, file_path_id: int,
+                           location_pub_id: bytes, file_path_pub_id: bytes,
                            out_path: str,
                            range_start: Optional[int] = None,
                            range_end: Optional[int] = None) -> bool:
         """Fetch a file from a remote node's library
-        (files-over-p2p, custom_uri proxy path)."""
+        (files-over-p2p, custom_uri proxy path).
+
+        Rows are addressed by their synced pub_ids — local autoincrement
+        ids diverge between nodes and must never cross the wire."""
         tunnel = await self.open_stream(addr, port)
         try:
             await tunnel.send({
                 "t": "file", "library_id": library_id,
-                "location_id": location_id, "file_path_id": file_path_id,
+                "location_pub_id": location_pub_id,
+                "file_path_pub_id": file_path_pub_id,
                 "range_start": range_start, "range_end": range_end})
             resp = await tunnel.recv()
             if not isinstance(resp, dict) or resp.get("status") != "ok":
@@ -310,17 +314,18 @@ class P2PManager:
         if lib is None:
             await tunnel.send({"status": "not_found"})
             return
-        row = lib.db.query_one(
-            "SELECT * FROM file_path WHERE id = ? AND location_id = ?",
-            (int(header["file_path_id"]), int(header["location_id"])))
         loc = lib.db.query_one(
-            "SELECT path FROM location WHERE id = ?",
-            (int(header["location_id"]),))
-        if row is None or loc is None or not loc["path"]:
+            "SELECT * FROM location WHERE pub_id = ?",
+            (bytes(header["location_pub_id"]),))
+        row = lib.db.query_one(
+            "SELECT * FROM file_path WHERE pub_id = ?",
+            (bytes(header["file_path_pub_id"]),)) if loc else None
+        if (row is None or loc is None or not loc["path"]
+                or row["location_id"] != loc["id"]):
             await tunnel.send({"status": "not_found"})
             return
         iso = IsolatedPath.from_db_row(
-            int(header["location_id"]), bool(row["is_dir"]),
+            loc["id"], bool(row["is_dir"]),
             row["materialized_path"], row["name"] or "",
             row["extension"] or "")
         full = iso.join_on(loc["path"])

@@ -34,12 +34,21 @@ class NetworkedLibraries:
         self.node = node
         self.p2p = p2p
         p2p.networked = self
+        # Captured so originate_soon works from worker threads — most
+        # sync writes happen inside asyncio.to_thread job steps, where
+        # get_running_loop() raises and the announcement would be lost.
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._loop = None
         # library_id → {instance pub_id → RemoteIdentity}
         self._instances: Dict[uuidlib.UUID, Dict[bytes, RemoteIdentity]] = {}
         # identity bytes → (addr, port) route override (tests / static).
         self._routes: Dict[bytes, Tuple[str, int]] = {}
         self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
         self._origin_tasks: set = set()
+        self._origin_pending: set = set()
+        self._origin_redo: set = set()
         for lib in node.libraries.list():
             self.watch_library(lib)
         node.libraries.on_event(self._on_library_event)
@@ -94,14 +103,43 @@ class NetworkedLibraries:
     # -- originator (p2p/sync/mod.rs:256-325) ------------------------------
 
     def originate_soon(self, library) -> None:
-        """Local write hook: fan NewOperations out in the background."""
+        """Local write hook: fan NewOperations out in the background.
+
+        Thread-safe: write_ops fires this from to_thread job steps."""
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
+            loop = self._loop
+        if loop is None or loop.is_closed():
             return  # no loop (sync unit tests): peers poll on reconnect
-        task = loop.create_task(self.originate(library))
-        self._origin_tasks.add(task)
-        task.add_done_callback(self._origin_tasks.discard)
+
+        def spawn() -> None:
+            # Coalesce bursts: while an announcement round is in flight
+            # for this library, a redo mark replaces extra rounds — the
+            # peers' pull loop drains the op log regardless of how many
+            # times it is poked (the reference's ingest actor drops
+            # redundant notifications the same way, ingest.rs wait!).
+            if library.id in self._origin_pending:
+                self._origin_redo.add(library.id)
+                return
+            self._origin_pending.add(library.id)
+
+            async def run() -> None:
+                try:
+                    while True:
+                        self._origin_redo.discard(library.id)
+                        await self.originate(library)
+                        if library.id not in self._origin_redo:
+                            break
+                finally:
+                    self._origin_pending.discard(library.id)
+                    self._origin_redo.discard(library.id)
+
+            task = loop.create_task(run())
+            self._origin_tasks.add(task)
+            task.add_done_callback(self._origin_tasks.discard)
+
+        loop.call_soon_threadsafe(spawn)
 
     async def originate(self, library) -> None:
         peers = list(self._instances.get(library.id, {}).items())

@@ -306,14 +306,15 @@ class SyncManager:
         log, atomically (apply_op, ingest.rs:162-186)."""
         t = op.typ
         with self.db.tx() as conn:
+            remote_id = self._instance_row_id(op.instance, conn)
             if isinstance(t, SharedOp):
-                self._apply_shared(conn, t)
+                self._apply_shared(conn, t, remote_id)
             else:
                 self._apply_relation(conn, t)
-            remote_id = self._instance_row_id(op.instance, conn)
             self._insert_op_row(conn, op, remote_id)
 
-    def _apply_shared(self, conn, t: SharedOp) -> None:
+    def _apply_shared(self, conn, t: SharedOp,
+                      origin_instance_row: Optional[int] = None) -> None:
         model = M.MODELS[t.model]
         assert model.sync == M.SyncMode.SHARED, t.model
         sync_col = model.sync_id[0]
@@ -321,21 +322,38 @@ class SyncManager:
             conn.execute(
                 f"DELETE FROM {t.model} WHERE {sync_col} = ?", (t.record_id,))
             return
+        def seed_row(attribute: bool) -> None:
+            # Owner attribution: a remotely-CREATED row carries the
+            # creating instance in its local-only instance_id (the
+            # reference's instance ownership checks; files-over-p2p
+            # locality decisions key off this). Updates may be written by
+            # any peer, so the update-upsert path seeds unattributed and
+            # the create op — whenever it arrives — backfills the NULL.
+            attribute = attribute and origin_instance_row is not None and \
+                any(f.name == "instance_id" for f in model.fields)
+            if attribute:
+                conn.execute(
+                    f"INSERT OR IGNORE INTO {t.model} "
+                    f"({sync_col}, instance_id) VALUES (?, ?)",
+                    (t.record_id, origin_instance_row))
+                conn.execute(
+                    f"UPDATE {t.model} SET instance_id = ? "
+                    f"WHERE {sync_col} = ? AND instance_id IS NULL",
+                    (origin_instance_row, t.record_id))
+            else:
+                conn.execute(
+                    f"INSERT OR IGNORE INTO {t.model} ({sync_col}) "
+                    f"VALUES (?)", (t.record_id,))
+
         if t.field is None:  # create
-            conn.execute(
-                f"INSERT OR IGNORE INTO {t.model} ({sync_col}) VALUES (?)",
-                (t.record_id,))
+            seed_row(attribute=True)
             return
         f = model.field(t.field)
         value = t.value
         target = _fk_target(f)
         if target is not None and M.MODELS[target].sync == M.SyncMode.SHARED:
             value = self._resolve_fk(conn, target, value)
-        # Upsert semantics: updates may arrive when the create was judged
-        # stale, so ensure the row exists.
-        conn.execute(
-            f"INSERT OR IGNORE INTO {t.model} ({sync_col}) VALUES (?)",
-            (t.record_id,))
+        seed_row(attribute=False)
         conn.execute(
             f"UPDATE {t.model} SET {t.field} = ? WHERE {sync_col} = ?",
             (value, t.record_id))

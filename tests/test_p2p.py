@@ -133,6 +133,58 @@ def test_spacedrop_interactive_reject(two_nodes, tmp_path):
     _run(main())
 
 
+def test_files_over_p2p_proxy(two_nodes, tmp_path):
+    """B serves A's file through its own custom_uri by proxying over the
+    mesh (custom_uri/mod.rs files_over_p2p_flag path)."""
+    import aiohttp
+
+    from spacedrive_tpu.api.server import ApiServer
+    from spacedrive_tpu.jobs.report import JobStatus
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.locations.manager import create_location
+
+    a, b = two_nodes
+    src = tmp_path / "aloc"
+    src.mkdir()
+    payload = os.urandom(30_000)
+    (src / "shared.bin").write_bytes(payload)
+
+    async def main():
+        lib_a, lib_b = await _start_pair(a, b)
+        loc = create_location(lib_a, str(src))
+        jid = await a.jobs.ingest(lib_a, IndexerJob(location_id=loc))
+        assert await a.jobs.wait(jid) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        # Wait until B has ingested the location + file_path rows.
+        for _ in range(100):
+            row = lib_b.db.query_one(
+                "SELECT * FROM file_path WHERE name = 'shared'")
+            if row is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert row is not None
+        loc_b = lib_b.db.query_one(
+            "SELECT * FROM location WHERE id = ?", (row["location_id"],))
+        assert loc_b["instance_id"] is not None  # owner attribution
+
+        if "filesOverP2P" not in b.config.features:
+            b.config.toggle_feature("filesOverP2P")
+        srv = ApiServer(b)
+        port = await srv.start("127.0.0.1", 0)
+        url = (f"http://127.0.0.1:{port}/spacedrive/file/"
+               f"{lib_b.id}/{row['location_id']}/{row['id']}")
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url) as r:
+                body = await r.read()
+                assert r.status == 200, body[:100]
+                assert r.headers.get("X-Served-Via") == "p2p"
+                assert body == payload
+        await srv.stop()
+        await a.shutdown()
+        await b.shutdown()
+    _run(main())
+
+
 def test_p2p_api_state_and_ping(two_nodes):
     a, b = two_nodes
 

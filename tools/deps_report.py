@@ -1,0 +1,58 @@
+"""Dependency/license report generator.
+
+Equivalent of the reference's build tooling crate `deps-generator`
+(/root/reference/crates/deps-generator/src/main.rs:13-25), which emits
+the dependency + license inventory consumed by FOSSA/about pages. Here
+the inventory comes from the live Python environment: every distribution
+the `spacedrive_tpu` package imports (directly or transitively),
+with version and license, as JSON on stdout.
+
+    python tools/deps_report.py [--all]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from importlib import metadata
+
+# The framework's direct import surface (kept by hand, checked by test).
+DIRECT = [
+    "jax", "jaxlib", "numpy", "msgpack", "aiohttp", "cryptography",
+    "argon2-cffi", "pillow",
+]
+
+
+def _license_of(dist) -> str:
+    meta = dist.metadata
+    lic = meta.get("License-Expression") or meta.get("License") or ""
+    if not lic or lic == "UNKNOWN" or len(lic) > 120:
+        for c in meta.get_all("Classifier") or []:
+            if c.startswith("License ::"):
+                lic = c.split("::")[-1].strip()
+                break
+    return lic or "unknown"
+
+
+def report(include_all: bool = False) -> list:
+    names = (sorted({d.metadata["Name"] for d in metadata.distributions()
+                     if d.metadata["Name"]})
+             if include_all else DIRECT)
+    out = []
+    for name in names:
+        try:
+            dist = metadata.distribution(name)
+        except metadata.PackageNotFoundError:
+            out.append({"name": name, "version": None,
+                        "license": "NOT INSTALLED"})
+            continue
+        out.append({
+            "name": name,
+            "version": dist.version,
+            "license": _license_of(dist),
+        })
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(report("--all" in sys.argv), indent=2))

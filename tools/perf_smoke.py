@@ -1,0 +1,102 @@
+"""End-to-end perf smoke: the BASELINE.md benchmark configs, runnable.
+
+Generates a deterministic corpus (tools/make_corpus.py), runs the real
+pipeline through the real job system — index → identify → validate →
+exact-dup — and prints one JSON line per stage with files/sec. This is
+the workload-level complement to bench.py's kernel-level number
+(BASELINE.json configs 1–3; config 4 runs when images are requested,
+config 5 is this with --files 1000000 across multiple locations).
+
+    python tools/perf_smoke.py --files 10000 [--backend auto] [--images 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+async def run(files: int, backend: str, images: int, keep: str | None):
+    from tools.make_corpus import make_corpus
+
+    from spacedrive_tpu.jobs.report import JobStatus
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.locations.manager import create_location
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.dedup import exact_duplicate_groups
+    from spacedrive_tpu.objects.identifier import FileIdentifierJob
+    from spacedrive_tpu.objects.validator import ObjectValidatorJob
+
+    root = keep or tempfile.mkdtemp(prefix="sdtpu-perf-")
+    corpus = os.path.join(root, "corpus")
+    t0 = time.perf_counter()
+    stats = make_corpus(corpus, files=files, dup_rate=0.1, images=images)
+    print(json.dumps({"stage": "corpus", "seconds":
+                      round(time.perf_counter() - t0, 2), **stats}))
+
+    node = Node(os.path.join(root, "data"))
+    await node.start()
+    lib = node.create_library("perf")
+    loc = create_location(lib, corpus)
+
+    async def stage(name, job):
+        t0 = time.perf_counter()
+        jid = await node.jobs.ingest(lib, job)
+        status = await node.jobs.wait(jid)
+        dt = time.perf_counter() - t0
+        assert status in (JobStatus.COMPLETED,
+                          JobStatus.COMPLETED_WITH_ERRORS), (name, status)
+        n = lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0")["n"]
+        print(json.dumps({
+            "stage": name, "seconds": round(dt, 2),
+            "files": n, "files_per_sec": round(n / dt, 1),
+            "status": int(status),
+        }))
+        return dt
+
+    await stage("index", IndexerJob(location_id=loc))
+    await stage("identify", FileIdentifierJob(location_id=loc,
+                                              backend=backend))
+    await stage("validate", ObjectValidatorJob(location_id=loc))
+
+    t0 = time.perf_counter()
+    groups = exact_duplicate_groups(lib, location_id=loc)
+    print(json.dumps({
+        "stage": "exact_dup", "seconds":
+        round(time.perf_counter() - t0, 2),
+        "duplicate_groups": len(groups),
+    }))
+
+    n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
+    n_paths = lib.db.query_one(
+        "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0 "
+        "AND cas_id IS NOT NULL")["n"]
+    print(json.dumps({
+        "stage": "summary", "identified_paths": n_paths,
+        "objects": n_objects,
+        "dedup_collapsed": n_paths - n_objects,
+    }))
+    await node.shutdown()
+    if not keep:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=10000)
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--images", type=int, default=0)
+    ap.add_argument("--keep", help="reuse/keep this directory")
+    args = ap.parse_args()
+    asyncio.run(run(args.files, args.backend, args.images, args.keep))
