@@ -141,12 +141,26 @@ class FileIdentifierJob(StatefulJob):
     IS_BATCHED = True
 
     def __init__(self, *, location_id: int, sub_path: Optional[str] = None,
-                 backend: str = "auto"):
+                 backend: str = "auto",
+                 device_batch: Optional[int] = None):
+        """`device_batch` decouples the device batch from the reference's
+        100-file step (SURVEY.md §7 hard part 2): steps page the cursor
+        in device_batch-file chunks (e.g. 4096-16384), each staged and
+        hashed as ONE batched device call. Checkpointing stays exact —
+        the cursor advances per step, and replayed chunks are idempotent
+        (cas_id/object updates keyed by row id)."""
+        if device_batch is not None and device_batch < 1:
+            raise ValueError(f"device_batch must be >= 1, got {device_batch}")
         super().__init__(location_id=location_id, sub_path=sub_path,
-                         backend=backend)
+                         backend=backend, device_batch=device_batch)
         self.location_id = location_id
         self.sub_path = sub_path
         self.backend = backend
+        self.device_batch = device_batch
+
+    @property
+    def chunk_size(self) -> int:
+        return self.device_batch or CHUNK_SIZE
 
     async def init(self, ctx: JobContext):
         db = ctx.db
@@ -164,7 +178,7 @@ class FileIdentifierJob(StatefulJob):
             "cursor": 0,
             "linked": 0, "created": 0, "skipped": 0, "total_orphans": count,
         }
-        steps = [{"chunk": i} for i in range(-(-count // CHUNK_SIZE))]
+        steps = [{"chunk": i} for i in range(-(-count // self.chunk_size))]
         ctx.progress(task_count=len(steps),
                      message=f"identifying {count} orphan paths")
         return data, steps
@@ -177,7 +191,7 @@ class FileIdentifierJob(StatefulJob):
             self.location_id, data["cursor"], data["sub_mat_path"])
         rows = [dict(r) for r in ctx.db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
-            params + [CHUNK_SIZE])]
+            params + [self.chunk_size])]
         if not rows:
             return StepOutcome()
         linked, created, errors = identify_chunk(

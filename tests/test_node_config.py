@@ -1,0 +1,55 @@
+"""Node config migrator (util/migrator.rs:136-250 test semantics)."""
+
+import json
+import os
+
+import pytest
+
+from spacedrive_tpu.node import (
+    NODE_CONFIG_VERSION,
+    NodeConfig,
+    migrate_node_config,
+)
+
+
+def test_fresh_config_migrates_from_empty(tmp_path):
+    path = str(tmp_path / "node_state.sdconfig")
+    cfg = NodeConfig(path)
+    assert cfg.raw["version"] == NODE_CONFIG_VERSION
+    assert len(cfg.id) == 16 and cfg.name
+    # persisted and reloadable
+    cfg2 = NodeConfig(path)
+    assert cfg2.id == cfg.id
+
+
+def test_existing_fields_survive_migration(tmp_path):
+    path = str(tmp_path / "node_state.sdconfig")
+    with open(path, "w") as f:
+        json.dump({"version": 0, "name": "my node",
+                   "id": "aa" * 16, "features": ["filesOverP2P"]}, f)
+    cfg = NodeConfig(path)
+    assert cfg.name == "my node"
+    assert cfg.raw["version"] == NODE_CONFIG_VERSION
+    assert "filesOverP2P" in cfg.features
+
+
+def test_time_traveling_backwards_rejected():
+    """A config from a NEWER version must refuse to load
+    (migrator.rs 'time traveling backwards' case)."""
+    with pytest.raises(ValueError):
+        migrate_node_config({"version": NODE_CONFIG_VERSION + 1})
+
+
+def test_feature_toggle_persists(tmp_path):
+    path = str(tmp_path / "node_state.sdconfig")
+    cfg = NodeConfig(path)
+    assert cfg.toggle_feature("syncEmitMessages") is True
+    assert cfg.toggle_feature("syncEmitMessages") is False
+    cfg2 = NodeConfig(path)
+    assert "syncEmitMessages" not in cfg2.features
+
+
+def test_atomic_save(tmp_path):
+    path = str(tmp_path / "node_state.sdconfig")
+    NodeConfig(path)
+    assert not os.path.exists(path + ".tmp")  # temp renamed away

@@ -75,9 +75,10 @@ def make_corpus(out_dir: str, files: int = 1000, dup_rate: float = 0.1,
                 rng.randrange(256), rng.randrange(256), rng.randrange(256)))
             draw = ImageDraw.Draw(im)
             for _ in range(6):
+                x0, y0 = rng.randrange(200), rng.randrange(150)
                 draw.rectangle(
-                    [rng.randrange(200), rng.randrange(150),
-                     rng.randrange(56, 256), rng.randrange(42, 192)],
+                    [x0, y0, x0 + rng.randrange(8, 56),
+                     y0 + rng.randrange(8, 42)],
                     fill=(rng.randrange(256), rng.randrange(256),
                           rng.randrange(256)))
             im.save(os.path.join(img_dir, f"img{b:04d}.png"))

@@ -24,7 +24,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-async def run(files: int, backend: str, images: int, keep: str | None):
+async def run(files: int, backend: str, images: int, keep: str | None,
+              device_batch: int | None = None):
     from tools.make_corpus import make_corpus
 
     from spacedrive_tpu.jobs.report import JobStatus
@@ -65,7 +66,8 @@ async def run(files: int, backend: str, images: int, keep: str | None):
 
     await stage("index", IndexerJob(location_id=loc))
     await stage("identify", FileIdentifierJob(location_id=loc,
-                                              backend=backend))
+                                              backend=backend,
+                                              device_batch=device_batch))
     await stage("validate", ObjectValidatorJob(location_id=loc))
 
     t0 = time.perf_counter()
@@ -75,6 +77,17 @@ async def run(files: int, backend: str, images: int, keep: str | None):
         round(time.perf_counter() - t0, 2),
         "duplicate_groups": len(groups),
     }))
+
+    if images:
+        from spacedrive_tpu.objects.dedup import NearDupDetectorJob
+
+        await stage("near_dup",
+                    NearDupDetectorJob(location_id=loc, threshold=10))
+        near = lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM media_data "
+            "WHERE phash IS NOT NULL")["n"]
+        print(json.dumps({"stage": "near_dup_hashed",
+                          "hashed_images": near}))
 
     n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
     n_paths = lib.db.query_one(
@@ -96,7 +109,9 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--files", type=int, default=10000)
     ap.add_argument("--backend", default="auto")
+    ap.add_argument("--device-batch", type=int, default=None)
     ap.add_argument("--images", type=int, default=0)
     ap.add_argument("--keep", help="reuse/keep this directory")
     args = ap.parse_args()
-    asyncio.run(run(args.files, args.backend, args.images, args.keep))
+    asyncio.run(run(args.files, args.backend, args.images, args.keep,
+                    args.device_batch))
