@@ -275,13 +275,17 @@ def cas_ids_for_files(
 
     The identifier job's per-chunk kernel: stage + batch hash + format.
     """
+    from ..tracing import device_span
+
     if backend == "auto":
         backend = default_backend(len(files))
     if backend == "native":
-        return _cas_ids_native_fused(files)
+        with device_span("cas_ids/native", batch=len(files)):
+            return _cas_ids_native_fused(files)
     large, small, empty_idx, errors = stage_files(files)
-    ids: Dict[int, Optional[str]] = dict(
-        _BACKENDS[backend](files, large, small))
+    with device_span(f"cas_ids/{backend}", batch=len(files)):
+        ids: Dict[int, Optional[str]] = dict(
+            _BACKENDS[backend](files, large, small))
     for idx in empty_idx:
         ids[idx] = None  # "We can't do shit with empty files" (mod.rs:86)
     for idx in errors:

@@ -1,0 +1,85 @@
+"""Structured tracing + device profiling.
+
+The reference leans on the `tracing` ecosystem (rolling file logs, span
+timing at debug level — SURVEY.md §5); the TPU-native equivalent is a
+structured span log plus optional `jax.profiler` capture around device
+batches:
+
+- `span(name)` times a block and logs one structured line through the
+  standard logging machinery (and the node event bus when attached);
+- when `SDTPU_PROFILE=/path` is set, `device_span(name)` additionally
+  wraps the block in a jax profiler trace so device batches show up in
+  TensorBoard/xprof with step markers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger("spacedrive_tpu")
+
+_profiler_started = False
+
+
+def _ensure_profiler() -> bool:
+    """Start the jax trace once if SDTPU_PROFILE is set (read at call
+    time so hosts can toggle it after import). jax-less runtimes degrade
+    to plain spans — the native/numpy hashing path must keep working."""
+    global _profiler_started
+    profile_dir = os.environ.get("SDTPU_PROFILE")
+    if not profile_dir:
+        return False
+    if not _profiler_started:
+        try:
+            import jax
+        except ImportError:
+            return False
+        jax.profiler.start_trace(profile_dir)
+        _profiler_started = True
+        import atexit
+
+        # Last-resort flush; hosts call stop_profiler() in shutdown.
+        atexit.register(stop_profiler)
+    return True
+
+
+def stop_profiler() -> None:
+    global _profiler_started
+    if _profiler_started:
+        import jax
+
+        jax.profiler.stop_trace()
+        _profiler_started = False
+
+
+@contextlib.contextmanager
+def span(name: str, events=None, **fields):
+    """Time a block; emit one structured record at debug level (the
+    reference's ad-hoc Instant deltas, job/mod.rs:592,638)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ms = (time.perf_counter() - t0) * 1000
+        record = {"span": name, "ms": round(ms, 2), **fields}
+        logger.debug("span %s", record)
+        if events is not None:
+            events.emit({"type": "TraceSpan", **record})
+
+
+@contextlib.contextmanager
+def device_span(name: str, events=None, **fields):
+    """span() + named jax profiler trace context when profiling is on."""
+    if _ensure_profiler():
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            with span(name, events, **fields):
+                yield
+    else:
+        with span(name, events, **fields):
+            yield

@@ -1,0 +1,72 @@
+"""Structured tracing spans + event-bus emission."""
+
+import logging
+
+from spacedrive_tpu.tracing import device_span, span
+
+
+class _Bus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, e):
+        self.events.append(e)
+
+
+def test_span_times_and_emits():
+    bus = _Bus()
+    with span("unit.work", events=bus, batch=7):
+        x = sum(range(1000))
+    assert x
+    assert len(bus.events) == 1
+    e = bus.events[0]
+    assert e["type"] == "TraceSpan" and e["span"] == "unit.work"
+    assert e["batch"] == 7 and e["ms"] >= 0
+
+
+def test_span_logs_at_debug(caplog):
+    with caplog.at_level(logging.DEBUG, logger="spacedrive_tpu"):
+        with span("logged.work"):
+            pass
+    assert any("logged.work" in r.message for r in caplog.records)
+
+
+def test_device_span_without_profiler_is_plain_span():
+    bus = _Bus()
+    with device_span("dev.work", events=bus):
+        pass
+    assert bus.events[0]["span"] == "dev.work"
+
+
+def test_span_survives_exceptions():
+    bus = _Bus()
+    try:
+        with span("failing", events=bus):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert bus.events and bus.events[0]["span"] == "failing"
+
+
+def test_staging_emits_device_spans(tmp_path):
+    """The identifier's hashing kernel runs inside a device_span."""
+    import logging as _logging
+
+    from spacedrive_tpu.ops.staging import cas_ids_for_files
+
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x" * 5000)
+    logger = _logging.getLogger("spacedrive_tpu")
+    records = []
+    handler = _logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    prev_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(_logging.DEBUG)
+    try:
+        ids, errors = cas_ids_for_files([(str(p), 5000)], backend="numpy")
+        assert not errors and ids[0]
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(prev_level)
+    assert any("cas_ids/numpy" in m for m in records)

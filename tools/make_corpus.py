@@ -26,7 +26,10 @@ import random
 
 
 def make_corpus(out_dir: str, files: int = 1000, dup_rate: float = 0.1,
-                images: int = 0, seed: int = 0, depth: int = 3) -> dict:
+                images: int = 0, seed: int = 0, depth: int = 3,
+                small_only: bool = False) -> dict:
+    """small_only caps files at 8 KiB — the 100k/1M-scale configs, where
+    generating the default multi-MiB tail would dominate the run."""
     rng = random.Random(seed)
     os.makedirs(out_dir, exist_ok=True)
     dirs = [out_dir]
@@ -40,6 +43,8 @@ def make_corpus(out_dir: str, files: int = 1000, dup_rate: float = 0.1,
     blobs = []  # (payload reference) for duplicate sampling
 
     def size_sample() -> int:
+        if small_only:
+            return rng.randrange(256, 8 * 1024)
         r = rng.random()
         if r < 0.50:
             return rng.randrange(256, 100 * 1024)          # whole-file CAS
@@ -104,6 +109,8 @@ if __name__ == "__main__":
     ap.add_argument("--dup-rate", type=float, default=0.1)
     ap.add_argument("--images", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--small", action="store_true")
     args = ap.parse_args()
     print(json.dumps(make_corpus(args.out_dir, args.files, args.dup_rate,
-                                 args.images, args.seed)))
+                                 args.images, args.seed,
+                                 small_only=args.small)))

@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 async def run(files: int, backend: str, images: int, keep: str | None,
-              device_batch: int | None = None):
+              device_batch: int | None = None, small: bool = False):
     from tools.make_corpus import make_corpus
 
     from spacedrive_tpu.jobs.report import JobStatus
@@ -39,7 +39,8 @@ async def run(files: int, backend: str, images: int, keep: str | None,
     root = keep or tempfile.mkdtemp(prefix="sdtpu-perf-")
     corpus = os.path.join(root, "corpus")
     t0 = time.perf_counter()
-    stats = make_corpus(corpus, files=files, dup_rate=0.1, images=images)
+    stats = make_corpus(corpus, files=files, dup_rate=0.1, images=images,
+                        small_only=small)
     print(json.dumps({"stage": "corpus", "seconds":
                       round(time.perf_counter() - t0, 2), **stats}))
 
@@ -112,6 +113,8 @@ if __name__ == "__main__":
     ap.add_argument("--device-batch", type=int, default=None)
     ap.add_argument("--images", type=int, default=0)
     ap.add_argument("--keep", help="reuse/keep this directory")
+    ap.add_argument("--small", action="store_true",
+                    help="small files only (100k/1M-scale runs)")
     args = ap.parse_args()
     asyncio.run(run(args.files, args.backend, args.images, args.keep,
-                    args.device_batch))
+                    args.device_batch, args.small))
