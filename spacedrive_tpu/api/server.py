@@ -344,6 +344,9 @@ async def serve(data_dir: str, host: str = "127.0.0.1",
     finally:
         await server.stop()
         await node.shutdown()
+        from ..tracing import stop_profiler
+
+        stop_profiler()  # process exit: flush any SDTPU_PROFILE trace
 
 
 if __name__ == "__main__":

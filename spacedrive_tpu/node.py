@@ -236,9 +236,6 @@ class Node:
         await self.thumbnailer.stop()
         if self.p2p is not None:
             await self.p2p.stop()
-        from .tracing import stop_profiler
-
-        stop_profiler()  # flush any SDTPU_PROFILE device trace
         for remover in self.orphan_removers.values():
             remover.stop()
         for lib in self.libraries.list():

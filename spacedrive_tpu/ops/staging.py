@@ -282,8 +282,10 @@ def cas_ids_for_files(
     if backend == "native":
         with device_span("cas_ids/native", batch=len(files)):
             return _cas_ids_native_fused(files)
-    large, small, empty_idx, errors = stage_files(files)
+    # Staging (the file reads) belongs INSIDE the span on every backend
+    # so cross-backend span timings stay comparable.
     with device_span(f"cas_ids/{backend}", batch=len(files)):
+        large, small, empty_idx, errors = stage_files(files)
         ids: Dict[int, Optional[str]] = dict(
             _BACKENDS[backend](files, large, small))
     for idx in empty_idx:

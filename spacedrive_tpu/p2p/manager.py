@@ -96,12 +96,32 @@ class P2PManager:
         tunnel.close()
         return time.monotonic() - t0
 
+    def _progress_emitter(self, drop_id: str, total: int, direction: str):
+        """Throttled SpacedropProgress events — same cadence as the job
+        plane (jobs/worker.PROGRESS_THROTTLE_S, worker.rs:273)."""
+        from ..jobs.worker import PROGRESS_THROTTLE_S
+
+        last = [0.0]
+
+        def emit(done: int) -> None:
+            now = time.monotonic()
+            if now - last[0] >= PROGRESS_THROTTLE_S or done >= total:
+                last[0] = now
+                self.node.events.emit({
+                    "type": "SpacedropProgress", "id": drop_id,
+                    "direction": direction, "bytes": done, "total": total})
+        return emit
+
     async def spacedrop(self, addr: str, port: int, file_path: str,
                         on_progress=None) -> str:
         """Send a file to a peer; returns 'sent'|'rejected'|'cancelled'
-        (p2p_manager.rs spacedrop flow)."""
+        (p2p_manager.rs spacedrop flow). A SpacedropStarted event with
+        direction='send' announces the id its progress events carry."""
         size = os.path.getsize(file_path)
         req = SpaceblockRequest(os.path.basename(file_path), size)
+        drop_id = uuidlib.uuid4().hex
+        on_progress = on_progress or self._progress_emitter(
+            drop_id, size, "send")
         tunnel = await self.open_stream(addr, port)
         try:
             await tunnel.send({"t": "spacedrop", "req": req.to_wire()})
@@ -109,6 +129,10 @@ class P2PManager:
                 tunnel.recv(), timeout=SPACEDROP_TIMEOUT_S)
             if verdict != "accept":
                 return "rejected"
+            self.node.events.emit({
+                "type": "SpacedropStarted", "id": drop_id,
+                "direction": "send", "name": req.name, "size": size,
+                "peer": f"{addr}:{port}"})
             with open(file_path, "rb") as f:
                 ok = await send_file(tunnel, req, f, on_progress)
             return "sent" if ok else "cancelled"
@@ -257,12 +281,14 @@ class P2PManager:
         # p2p.cancelSpacedrop needs an id even when a sync hook accepted.
         self.node.events.emit({
             "type": "SpacedropStarted", "id": drop_id, "name": req.name,
-            "size": req.size, "path": save_path,
+            "direction": "receive", "size": req.size, "path": save_path,
             "peer": tunnel.remote.to_bytes().hex()})
         try:
             with open(save_path, "wb") as out:
                 await receive_file(
                     tunnel, req, out,
+                    on_progress=self._progress_emitter(
+                        drop_id, req.size, "receive"),
                     should_cancel=lambda: self._spacedrop_cancel.get(
                         drop_id, False))
         finally:

@@ -22,27 +22,42 @@ from typing import Optional
 
 logger = logging.getLogger("spacedrive_tpu")
 
+import threading
+
 _profiler_started = False
+_profiler_failed = False
+_profiler_lock = threading.Lock()
 
 
 def _ensure_profiler() -> bool:
     """Start the jax trace once if SDTPU_PROFILE is set (read at call
-    time so hosts can toggle it after import). jax-less runtimes degrade
-    to plain spans — the native/numpy hashing path must keep working."""
-    global _profiler_started
+    time so hosts can toggle it after import). ANY profiling problem —
+    no jax, unwritable path, double-start race — degrades to plain
+    spans; device batches run from thread-pool workers, so the start is
+    lock-guarded."""
+    global _profiler_started, _profiler_failed
     profile_dir = os.environ.get("SDTPU_PROFILE")
-    if not profile_dir:
+    if not profile_dir or _profiler_failed:
         return False
-    if not _profiler_started:
+    if _profiler_started:
+        return True
+    with _profiler_lock:
+        if _profiler_started:
+            return True
         try:
             import jax
-        except ImportError:
+
+            jax.profiler.start_trace(profile_dir)
+        except Exception as e:
+            _profiler_failed = True
+            logger.warning("SDTPU_PROFILE disabled: %s", e)
             return False
-        jax.profiler.start_trace(profile_dir)
         _profiler_started = True
         import atexit
 
-        # Last-resort flush; hosts call stop_profiler() in shutdown.
+        # Process-scope flush. Deliberately NOT hooked into per-node
+        # shutdown: the profiler is process-global and multiple nodes
+        # share one process in tests.
         atexit.register(stop_profiler)
     return True
 

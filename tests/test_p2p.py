@@ -94,12 +94,14 @@ def test_spacedrop_interactive_accept(two_nodes, tmp_path):
         pb = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
         b.p2p.interactive_spacedrop = True
 
-        offers = []
+        offers, progress = [], []
 
         def on_event(e):
             if e.get("type") == "SpacedropRequest":
                 offers.append(e)
                 b.p2p.accept_spacedrop(e["id"], str(dst))
+            elif e.get("type") == "SpacedropProgress":
+                progress.append(e)
         b.events.subscribe(on_event)
 
         result = await a.p2p.spacedrop("127.0.0.1", pb, str(src))
@@ -107,6 +109,9 @@ def test_spacedrop_interactive_accept(two_nodes, tmp_path):
         assert offers and offers[0]["name"] == "gift.bin"
         assert offers[0]["size"] == len(payload)
         assert dst.read_bytes() == payload
+        # receiver emitted throttled progress, ending at the full size
+        assert progress and progress[-1]["bytes"] == len(payload)
+        assert progress[-1]["direction"] == "receive"
         await a.shutdown()
         await b.shutdown()
     _run(main())
