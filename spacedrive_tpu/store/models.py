@@ -345,6 +345,59 @@ register(Model(
     relation=("object_id", "label_id"),
 ))
 
+# --- Space / Album (schema.prisma:389-411, 448-477): object groupings.
+# The reference leaves these sync-UNannotated (its generator emits no
+# sync types for them), so they stay LOCAL here too.
+
+register(Model(
+    "space",
+    (
+        _id(),
+        _pub_id(),
+        Field("name", "TEXT"),
+        Field("description", "TEXT"),
+        Field("date_created", "INTEGER"),
+        Field("date_modified", "INTEGER"),
+    ),
+    sync=SyncMode.LOCAL,
+))
+
+register(Model(
+    "object_in_space",
+    (
+        Field("space_id", "INTEGER", nullable=False, primary_key=True,
+              references="space(id)"),
+        Field("object_id", "INTEGER", nullable=False, primary_key=True,
+              references="object(id)"),
+    ),
+    sync=SyncMode.LOCAL,
+))
+
+register(Model(
+    "album",
+    (
+        _id(),
+        _pub_id(),
+        Field("name", "TEXT"),
+        Field("is_hidden", "INTEGER"),
+        Field("date_created", "INTEGER"),
+        Field("date_modified", "INTEGER"),
+    ),
+    sync=SyncMode.LOCAL,
+))
+
+register(Model(
+    "object_in_album",
+    (
+        Field("album_id", "INTEGER", nullable=False, primary_key=True,
+              references="album(id)"),
+        Field("object_id", "INTEGER", nullable=False, primary_key=True,
+              references="object(id)"),
+        Field("date_created", "INTEGER"),
+    ),
+    sync=SyncMode.LOCAL,
+))
+
 # --- Jobs (@local, schema.prisma:415-441; self-relation for chains). ------
 
 register(Model(
