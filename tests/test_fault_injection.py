@@ -122,18 +122,9 @@ def test_two_node_concurrent_writes_converge(tmp_path):
     b = _Node(str(tmp_path / "b"))
 
     async def main():
-        await a.start()
-        await b.start()
-        pa = await a.start_p2p(host="127.0.0.1", enable_discovery=False)
-        pb = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
-        lib_a = a.create_library("shared")
-        b.p2p.on_pairing_request = lambda peer, info: True
-        assert await a.p2p.pair("127.0.0.1", pb, lib_a)
-        lib_b = b.libraries.list()[0]
-        a.p2p.networked.set_route(
-            b.p2p.identity.to_remote_identity(), "127.0.0.1", pb)
-        b.p2p.networked.set_route(
-            a.p2p.identity.to_remote_identity(), "127.0.0.1", pa)
+        from conftest import pair_two_nodes
+
+        lib_a, lib_b = await pair_two_nodes(a, b, "shared")
 
         pub = os.urandom(16)
         ops = lib_a.sync.shared_create("tag", pub, {"name": "base"})

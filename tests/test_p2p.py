@@ -27,21 +27,9 @@ def two_nodes(tmp_path):
 async def _start_pair(a: Node, b: Node):
     """Start both p2p planes (no discovery: explicit routes) and pair
     a library from A into B. Returns (lib_a, lib_b)."""
-    await a.start()
-    await b.start()
-    pa = await a.start_p2p(host="127.0.0.1", enable_discovery=False)
-    pb = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
-    lib_a = a.create_library("shared")
-    b.p2p.on_pairing_request = lambda peer, info: True
-    ok = await a.p2p.pair("127.0.0.1", pb, lib_a)
-    assert ok
-    lib_b = b.libraries.list()[0]
-    # Explicit routes both ways (discovery is off).
-    a.p2p.networked.set_route(
-        b.p2p.identity.to_remote_identity(), "127.0.0.1", pb)
-    b.p2p.networked.set_route(
-        a.p2p.identity.to_remote_identity(), "127.0.0.1", pa)
-    return lib_a, lib_b
+    from conftest import pair_two_nodes
+
+    return await pair_two_nodes(a, b, "shared")
 
 
 def test_pair_then_sync_over_network(two_nodes, tmp_path):
