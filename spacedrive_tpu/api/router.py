@@ -126,4 +126,14 @@ def mount_router(node) -> Router:
     from . import procedures
     router = Router(node)
     procedures.register_all(router)
+    # Every `invalidates=` key must name a real query — a typo'd key
+    # would silently never refetch (the reference validates invalidation
+    # keys against the router at startup, api/utils/invalidate.rs:82).
+    for proc in router.procedures.values():
+        for key in proc.invalidates:
+            target = router.procedures.get(key)
+            if target is None or target.kind != "query":
+                # Hard error (not assert: -O must not disable the guard).
+                raise RuntimeError(
+                    f"{proc.name} invalidates unknown query {key!r}")
     return router

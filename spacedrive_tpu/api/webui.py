@@ -188,6 +188,20 @@ sub("invalidation.listen", null, (e) => {
   if (e.key === "search.paths") browse();
   if (e.key === "library.list") loadLibs();
 });
+sub("p2p.events", null, async (e) => {
+  if (e.type === "SpacedropRequest") {
+    // The peer-supplied name is untrusted: suggest only its basename,
+    // never a path ("../../etc/x" must not prefill the save prompt).
+    const safe = (e.name || "spacedrop.bin")
+      .split(/[\\/]/).pop().replace(/^\.+/, "") || "spacedrop.bin";
+    const ok = confirm(
+      `Spacedrop: accept "${safe}" (${e.size} bytes) from ${e.peer}?`);
+    // Cancelling/clearing the prompt falls back to the safe name in the
+    // current directory — an accepted drop is never silently rejected.
+    const path = ok ? (prompt("save as", safe) || safe) : null;
+    await mut("p2p.acceptSpacedrop", {id: e.id, path});
+  }
+});
 loadLibs();
 </script>
 </body>

@@ -239,8 +239,13 @@ class P2PManager:
             return save_path
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending_drops[drop_id] = fut
+        # Defense in depth: the peer-chosen name is untrusted input —
+        # every consumer gets a path-free basename (a hostile
+        # "../../x" must never reach a save-path prompt).
+        safe_name = os.path.basename(req.name).lstrip(".") or \
+            "spacedrop.bin"
         self.node.events.emit({
-            "type": "SpacedropRequest", "id": drop_id, "name": req.name,
+            "type": "SpacedropRequest", "id": drop_id, "name": safe_name,
             "size": req.size, "peer": peer.to_bytes().hex()})
         try:
             return await asyncio.wait_for(fut, SPACEDROP_TIMEOUT_S)
