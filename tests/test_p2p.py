@@ -126,6 +126,67 @@ def test_spacedrop_interactive_reject(two_nodes, tmp_path):
     _run(main())
 
 
+def test_relation_ops_sync_over_network(two_nodes):
+    """Tag assignment (a RELATION CRDT op) flows to the peer, resolving
+    pub_ids back to each side's local row ids."""
+    a, b = two_nodes
+
+    async def main():
+        lib_a, lib_b = await _start_pair(a, b)
+        sa = lib_a.sync
+        tag_pub, obj_pub = os.urandom(16), os.urandom(16)
+        ops = (sa.shared_create("tag", tag_pub, {"name": "red"})
+               + sa.shared_create("object", obj_pub, {"kind": 5}))
+        with sa.write_ops(ops) as conn:
+            conn.execute("INSERT INTO tag (pub_id, name) VALUES (?, ?)",
+                         (tag_pub, "red"))
+            conn.execute(
+                "INSERT INTO object (pub_id, kind) VALUES (?, ?)",
+                (obj_pub, 5))
+        ops = sa.relation_create("tag_on_object", obj_pub, tag_pub)
+        with sa.write_ops(ops) as conn:
+            ta = lib_a.db.query_one(
+                "SELECT id FROM tag WHERE pub_id = ?", (tag_pub,))["id"]
+            oa = lib_a.db.query_one(
+                "SELECT id FROM object WHERE pub_id = ?", (obj_pub,))["id"]
+            conn.execute(
+                "INSERT INTO tag_on_object (tag_id, object_id) "
+                "VALUES (?, ?)", (ta, oa))
+
+        row = None
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            row = lib_b.db.query_one(
+                "SELECT t.name FROM tag_on_object tob "
+                "JOIN tag t ON t.id = tob.tag_id "
+                "JOIN object o ON o.id = tob.object_id "
+                "WHERE o.pub_id = ?", (obj_pub,))
+            if row is not None:
+                break
+        assert row is not None and row["name"] == "red"
+
+        # Unassign on A → row disappears on B.
+        ops = [sa.relation_delete("tag_on_object", obj_pub, tag_pub)]
+        with sa.write_ops(ops) as conn:
+            conn.execute(
+                "DELETE FROM tag_on_object WHERE tag_id = ? AND "
+                "object_id = ?", (ta, oa))
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if lib_b.db.query_one(
+                    "SELECT 1 FROM tag_on_object tob JOIN object o "
+                    "ON o.id = tob.object_id WHERE o.pub_id = ?",
+                    (obj_pub,)) is None:
+                break
+        assert lib_b.db.query_one(
+            "SELECT 1 FROM tag_on_object tob JOIN object o "
+            "ON o.id = tob.object_id WHERE o.pub_id = ?",
+            (obj_pub,)) is None
+        await a.shutdown()
+        await b.shutdown()
+    _run(main())
+
+
 def test_files_over_p2p_proxy(two_nodes, tmp_path):
     """B serves A's file through its own custom_uri by proxying over the
     mesh (custom_uri/mod.rs files_over_p2p_flag path)."""
