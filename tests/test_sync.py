@@ -47,19 +47,23 @@ def test_hlc_monotonic():
     assert clock.new_timestamp() > remote
 
 
-def test_shared_create_emits_c_plus_updates(pair):
+def test_shared_create_is_one_value_carrying_op(pair):
+    """Create = ONE "c" op with all initial values batched (the form
+    the reference anticipated at crdt.rs:94 but never shipped)."""
     a, _ = pair
     pub = uuid.uuid4().bytes
     ops = a.shared_create("location", pub, {"name": "Home", "path": "/home"})
-    assert [op.typ.kind for op in ops] == ["c", "u:name", "u:path"]
+    assert [op.typ.kind for op in ops] == ["c"]
+    assert ops[0].typ.values == {"name": "Home", "path": "/home"}
     with a.write_ops(ops) as conn:
         a.db.insert("location", {"pub_id": pub, "name": "Home",
                                  "path": "/home"}, conn=conn)
     rows = a.db.query("SELECT * FROM shared_operation ORDER BY timestamp")
-    assert len(rows) == 3
+    assert len(rows) == 1
     got = a.get_ops(GetOpsArgs(clocks=[]))
-    assert len(got) == 3
+    assert len(got) == 1
     assert got[0].typ.record_id == pub
+    assert got[0].typ.values["path"] == "/home"  # round-trips the log
 
 
 def test_wire_roundtrip(pair):
@@ -141,10 +145,76 @@ def test_relation_ops(pair):
         "SELECT * FROM tag_on_object WHERE object_id = ?", (obj["id"],)) is None
 
 
+def test_stale_create_never_clobbers_newer_update(pair):
+    """Out-of-order delivery: an update (t2) applies before the create
+    (t1) that batches initial values — the create's stale value for the
+    updated field must lose, other fields still fill in."""
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    create_ops = a.shared_create(
+        "location", pub, {"name": "old-name", "path": "/p"})
+    with a.write_ops(create_ops) as conn:
+        a.db.insert("location", {"pub_id": pub, "name": "old-name",
+                                 "path": "/p"}, conn=conn)
+    update_op = a.shared_update("location", pub, "name", "new-name")
+    with a.write_ops([update_op]):
+        pass
+
+    # Deliver to B in the WRONG order: update first, then create.
+    assert b.receive_crdt_operation(update_op)
+    assert b.receive_crdt_operation(create_ops[0])
+    row = b.db.query_one(
+        "SELECT name, path FROM location WHERE pub_id = ?", (pub,))
+    assert row["name"] == "new-name"  # newer update survived
+    assert row["path"] == "/p"        # untouched field applied
+
+
+def test_relation_op_before_referenced_rows_is_parked_then_drained(pair):
+    """A relation op arriving before the rows it references (cross-
+    instance arrival order isn't timestamp-ordered) must not be lost:
+    it parks in pending_relation_op and applies once the creates land."""
+    a, b = pair
+    tag_pub, obj_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    with a.write_ops(a.shared_create("tag", tag_pub, {"name": "t"})) as c:
+        a.db.insert("tag", {"pub_id": tag_pub, "name": "t"}, conn=c)
+    with a.write_ops(a.shared_create("object", obj_pub, {"kind": 4})) as c:
+        a.db.insert("object", {"pub_id": obj_pub, "kind": 4}, conn=c)
+    rel_ops = a.relation_create("tag_on_object", obj_pub, tag_pub)
+    with a.write_ops(rel_ops) as c:
+        tid = a.db.query_one("SELECT id FROM tag WHERE pub_id = ?",
+                             (tag_pub,))["id"]
+        oid = a.db.query_one("SELECT id FROM object WHERE pub_id = ?",
+                             (obj_pub,))["id"]
+        c.execute("INSERT INTO tag_on_object (tag_id, object_id) "
+                  "VALUES (?, ?)", (tid, oid))
+
+    ops = a.get_ops(GetOpsArgs(clocks=[]))
+    rel = [op for op in ops if not hasattr(op.typ, "model")]
+    shared = [op for op in ops if hasattr(op.typ, "model")]
+    # Deliver the relation FIRST — its rows don't exist on B yet.
+    for op in rel:
+        b.receive_crdt_operation(op)
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 1
+    for op in shared:
+        b.receive_crdt_operation(op)
+    # Draining ran on the creates: the link exists and the park is empty.
+    row = b.db.query_one(
+        "SELECT t.name FROM tag_on_object tob "
+        "JOIN tag t ON t.id = tob.tag_id "
+        "JOIN object o ON o.id = tob.object_id WHERE o.pub_id = ?",
+        (obj_pub,))
+    assert row is not None and row["name"] == "t"
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 0
+
+
 def test_get_ops_watermark_filters(pair):
     a, _ = pair
     pub = uuid.uuid4().bytes
     with a.write_ops(a.shared_create("tag", pub, {"name": "x"})):
+        pass
+    with a.write_ops([a.shared_update("tag", pub, "name", "y")]):
         pass
     all_ops = a.get_ops(GetOpsArgs(clocks=[]))
     assert len(all_ops) == 2

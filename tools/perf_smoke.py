@@ -42,7 +42,8 @@ async def run(files: int, backend: str, images: int, keep: str | None,
     stats = make_corpus(corpus, files=files, dup_rate=0.1, images=images,
                         small_only=small)
     print(json.dumps({"stage": "corpus", "seconds":
-                      round(time.perf_counter() - t0, 2), **stats}))
+                      round(time.perf_counter() - t0, 2), **stats}),
+          flush=True)
 
     node = Node(os.path.join(root, "data"))
     await node.start()
@@ -62,7 +63,7 @@ async def run(files: int, backend: str, images: int, keep: str | None,
             "stage": name, "seconds": round(dt, 2),
             "files": n, "files_per_sec": round(n / dt, 1),
             "status": int(status),
-        }))
+        }), flush=True)
         return dt
 
     await stage("index", IndexerJob(location_id=loc))
@@ -77,7 +78,7 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         "stage": "exact_dup", "seconds":
         round(time.perf_counter() - t0, 2),
         "duplicate_groups": len(groups),
-    }))
+    }), flush=True)
 
     if images:
         from spacedrive_tpu.objects.dedup import NearDupDetectorJob
@@ -88,7 +89,7 @@ async def run(files: int, backend: str, images: int, keep: str | None,
             "SELECT COUNT(*) AS n FROM media_data "
             "WHERE phash IS NOT NULL")["n"]
         print(json.dumps({"stage": "near_dup_hashed",
-                          "hashed_images": near}))
+                          "hashed_images": near}), flush=True)
 
     n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
     n_paths = lib.db.query_one(
@@ -98,7 +99,7 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         "stage": "summary", "identified_paths": n_paths,
         "objects": n_objects,
         "dedup_collapsed": n_paths - n_objects,
-    }))
+    }), flush=True)
     await node.shutdown()
     if not keep:
         import shutil
