@@ -50,6 +50,7 @@ def register_all(router: Router) -> None:
     _auth(router)
     _backups(router)
     _p2p(router)
+    _keys(router)
     _invalidation(router)
 
 
@@ -1074,6 +1075,88 @@ def _backups(r: Router) -> None:
 
 
 # -- invalidation. (api/utils/invalidate.rs) -------------------------------
+
+# -- keys. (the key-manager surface; the reference's keys router exists
+#    but ships disabled alongside its commented-out crypto jobs — here
+#    the crypto subsystem works, so the surface is live) --------------------
+
+def _keys(r: Router) -> None:
+    def _km(node):
+        km = getattr(node, "_key_manager", None)
+        if km is None:
+            from ..crypto.keymanager import KeyManager
+
+            km = KeyManager(os.path.join(node.data_dir, "keys.json"))
+            node._key_manager = km
+        return km
+
+    @r.query("keys.isUnlocked")
+    def keys_is_unlocked(node, _input):
+        return _km(node).is_unlocked
+
+    @r.query("keys.isSetup")
+    def keys_is_setup(node, _input):
+        return _km(node)._verification is not None
+
+    @r.mutation("keys.setup",
+                invalidates=["keys.list", "keys.isUnlocked",
+                             "keys.isSetup"])
+    def keys_setup(node, input):
+        from ..crypto.primitives import Protected
+
+        km = _km(node)
+        km.initialize(Protected(str(input["password"]).encode()))
+        km.automount()
+        return None
+
+    @r.mutation("keys.unlock",
+                invalidates=["keys.list", "keys.isUnlocked"])
+    def keys_unlock(node, input):
+        from ..crypto.primitives import Protected
+
+        km = _km(node)
+        km.unlock(Protected(str(input["password"]).encode()))
+        km.automount()  # automount-flagged keys come back on unlock
+        return None
+
+    @r.mutation("keys.lock",
+                invalidates=["keys.list", "keys.isUnlocked"])
+    def keys_lock(node, _input):
+        _km(node).lock()
+        return None
+
+    @r.query("keys.list")
+    def keys_list(node, _input):
+        return _km(node).list_keys()
+
+    @r.mutation("keys.add", invalidates=["keys.list"])
+    def keys_add(node, input):
+        from ..crypto.primitives import Protected
+
+        # ValueError/KeyError → BAD_REQUEST is the router's job.
+        return _km(node).add_key(
+            Protected(str(input["key"]).encode()),
+            automount=bool(input.get("automount")))
+
+    @r.mutation("keys.mount", invalidates=["keys.list"])
+    def keys_mount(node, input):
+        uuid_s = str(input["uuid"])
+        try:
+            _km(node).mount(uuid_s)
+        except KeyError:
+            raise RpcError("NOT_FOUND", "no such key")
+        return None
+
+    @r.mutation("keys.unmount", invalidates=["keys.list"])
+    def keys_unmount(node, input):
+        _km(node).unmount(str(input["uuid"]))
+        return None
+
+    @r.mutation("keys.delete", invalidates=["keys.list"])
+    def keys_delete(node, input):
+        _km(node).delete_key(str(input["uuid"]))
+        return None
+
 
 # -- p2p. (api/p2p.rs: events, state, spacedrop, acceptSpacedrop,
 #    cancelSpacedrop, pair) --------------------------------------------------

@@ -81,6 +81,13 @@ class Library:
         unique = sum(int.from_bytes(r["s"] or b"", "big")
                      for r in unique_rows)
         db_size = os.path.getsize(db.path) if os.path.exists(db.path) else 0
+        # Persist the LATEST statistics snapshot (single row, replaced in
+        # place — a polled query must not grow the table unboundedly).
+        db.execute("DELETE FROM statistics")
+        db.execute(
+            "INSERT INTO statistics (total_object_count, library_db_size,"
+            " total_unique_bytes, total_bytes_used) VALUES (?, ?, ?, ?)",
+            (objs, str(db_size), str(unique), str(total)))
         return {
             "total_object_count": objs,
             "total_path_count": paths,

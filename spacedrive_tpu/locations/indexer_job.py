@@ -99,18 +99,67 @@ SYNCED_UPDATE_FIELDS = ("inode", "size_in_bytes_bytes", "date_modified",
 def save_file_path_rows(library, location_pub_id: bytes,
                         rows: List[Dict[str, Any]]) -> int:
     """Batched create through sync; replayed steps' unique collisions are
-    ignored (IS_BATCHED idempotency)."""
+    ignored (IS_BATCHED idempotency).
+
+    A new path whose inode ALREADY has a row is a move the walker saw
+    from the destination side (cross-directory renames land in different
+    walk steps, so remove-before-save ordering can't cover them): the
+    existing row is re-pathed in place — keeping its object link and
+    cas_id — instead of colliding with the (location_id, inode) unique
+    constraint and being silently dropped."""
     if not rows:
         return 0
     db, sync = library.db, library.sync
-    ops = []
+
+    moved: List[Dict[str, Any]] = []
+    fresh: List[Dict[str, Any]] = []
     for row in rows:
+        inode = row.get("inode")
+        existing = db.query_one(
+            "SELECT pub_id, materialized_path, name, extension "
+            "FROM file_path WHERE location_id = ? AND inode = ?",
+            (row["location_id"], inode)) if inode else None
+        if existing is None:
+            fresh.append(row)
+        elif (existing["materialized_path"] != row["materialized_path"]
+              or existing["name"] != row["name"]
+              or (existing["extension"] or "") != (row["extension"] or "")):
+            moved.append({**row, "pub_id": existing["pub_id"]})
+        # else: identical path replay — the insert below IGNOREs it
+
+    if moved:
+        _repath_rows(library, moved)
+    if not fresh:
+        return len(moved)
+    ops = []
+    for row in fresh:
         values = _row_sync_values(row)
         values["location_id"] = location_pub_id  # FK syncs as pub_id
         ops.extend(sync.shared_create("file_path", row["pub_id"], values))
     with sync.write_ops(ops) as conn:
-        return db.insert_many("file_path", rows, conn=conn,
-                              ignore_conflicts=True)
+        return len(moved) + db.insert_many(
+            "file_path", fresh, conn=conn, ignore_conflicts=True)
+
+
+def _repath_rows(library, rows: List[Dict[str, Any]]) -> int:
+    """Move detected by inode: update the existing row's path identity
+    (+ freshness fields) in place, preserving object link and cas_id."""
+    db, sync = library.db, library.sync
+    fields = ("materialized_path", "name", "extension",
+              *SYNCED_UPDATE_FIELDS)
+    ops = []
+    with db.tx() as conn:
+        for row in rows:
+            values = {k: row[k] for k in fields}
+            db.update("file_path", row["pub_id"], values, conn=conn,
+                      id_col="pub_id")
+            for k, v in values.items():
+                ops.append(sync.shared_update(
+                    "file_path", row["pub_id"], k, v))
+        sync._insert_op_rows(conn, ops)
+    if ops:
+        sync._notify_created()
+    return len(rows)
 
 
 def update_file_path_rows(library, rows: List[Dict[str, Any]]) -> int:
@@ -136,15 +185,29 @@ def remove_file_path_rows(library, location_id: int,
                           removed: List[Dict[str, Any]]) -> int:
     """Delete stale rows; a removed DIRECTORY also deletes every
     descendant row by materialized_path prefix (the walker only reports
-    the dir itself — without this, rm -rf'd subtrees leave ghost rows)."""
+    the dir itself — without this, rm -rf'd subtrees leave ghost rows).
+
+    Path-conditional: a row whose (materialized_path, name) no longer
+    matches what the walker observed was MOVED and re-pathed by a save
+    step since — deleting it by pub_id would destroy the moved file's
+    row and object link. Such rows are skipped."""
     if not removed:
         return 0
     db, sync = library.db, library.sync
     from .file_path_helper import materialized_like
-    ops = [sync.shared_delete("file_path", r["pub_id"]) for r in removed]
+    ops = []
     n = 0
     with db.tx() as conn:
         for r in removed:
+            if r.get("materialized_path") is not None:
+                cur_row = conn.execute(
+                    "SELECT materialized_path, name FROM file_path "
+                    "WHERE pub_id = ?", (r["pub_id"],)).fetchone()
+                if cur_row is None:
+                    continue  # already gone (replayed step)
+                if (cur_row["materialized_path"] != r["materialized_path"]
+                        or cur_row["name"] != r.get("name")):
+                    continue  # re-pathed by a move — keep it
             if r.get("is_dir") and r.get("materialized_path") is not None:
                 children_mat = (f"{r['materialized_path']}{r['name']}/")
                 where, params = "location_id = ?", [location_id]
@@ -157,6 +220,7 @@ def remove_file_path_rows(library, location_id: int,
                 cur = conn.execute(
                     f"DELETE FROM file_path WHERE {where}", params)
                 n += cur.rowcount
+            ops.append(sync.shared_delete("file_path", r["pub_id"]))
             conn.execute("DELETE FROM file_path WHERE pub_id = ?",
                          (r["pub_id"],))
             n += 1
@@ -197,6 +261,16 @@ class IndexerJob(StatefulJob):
     def _result_to_steps(self, res: WalkResult, data: Dict[str, Any]
                          ) -> List[Any]:
         steps: List[Any] = []
+        # Removals are DEFERRED to the end of the job (finalize): a moved
+        # file appears as (new path in some dir's walked) + (old path in
+        # another dir's to_remove), and only after every save step has
+        # had the chance to re-path it by inode can a removal safely
+        # judge — path-conditionally — that a row is truly stale.
+        if res.to_remove:
+            data["pending_removals"].extend(
+                {k: r.get(k) for k in (
+                    "pub_id", "is_dir", "materialized_path", "name")}
+                for r in res.to_remove)
         save_rows = [_entry_to_row(e, self.location_id) for e in res.walked]
         for i in range(0, len(save_rows), BATCH_SIZE):
             steps.append({"kind": "save", "rows": save_rows[i:i + BATCH_SIZE]})
@@ -208,11 +282,6 @@ class IndexerJob(StatefulJob):
             steps.append({"kind": "walk", "path": w.path,
                           "accepted": w.parent_dir_accepted_by_its_children,
                           "parent": w.maybe_parent})
-        if res.to_remove:
-            steps.append({"kind": "remove",
-                          "rows": [{k: r.get(k) for k in (
-                              "pub_id", "is_dir", "materialized_path",
-                              "name")} for r in res.to_remove]})
         for p, s in res.paths_and_sizes.items():
             data["dir_sizes"][p] = data["dir_sizes"].get(p, 0) + s
         return steps
@@ -237,6 +306,7 @@ class IndexerJob(StatefulJob):
             "location_path": location_path,
             "location_pub_id": loc["pub_id"],
             "dir_sizes": {},
+            "pending_removals": [],
             "total_saved": 0, "total_updated": 0, "total_removed": 0,
         }
         walker = self._walker(ctx, location_path)
@@ -282,8 +352,14 @@ class IndexerJob(StatefulJob):
         return StepOutcome(metadata={"removed_count": data["total_removed"]})
 
     async def finalize(self, ctx: JobContext, data, metadata):
-        """Write accumulated dir sizes onto their file_path rows
-        (indexer_job.rs finalize semantics) + location totals."""
+        """Execute deferred removals (every save has had its chance to
+        re-path moved inodes by now), then write accumulated dir sizes
+        onto their file_path rows (indexer_job.rs finalize semantics)."""
+        if data.get("pending_removals"):
+            data["total_removed"] += await asyncio.to_thread(
+                remove_file_path_rows, ctx.library, self.location_id,
+                data["pending_removals"])
+            data["pending_removals"] = []
         db = ctx.db
         loc_path = data["location_path"]
         with db.tx() as conn:

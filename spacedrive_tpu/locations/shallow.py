@@ -51,6 +51,10 @@ def light_scan_location(library, location_id: int,
     res = walker.walk_single_dir(target, add_root=bool(sub_path))
     errors = list(res.errors)
 
+    # Saves FIRST: a renamed file is (new path in walked) + (old path in
+    # to_remove) with the SAME inode — the save re-paths the existing row
+    # in place (keeping its object link), and the path-conditional
+    # removal then recognizes the re-pathed row and leaves it alone.
     rows = [_entry_to_row(e, location_id) for e in res.walked]
     save_file_path_rows(library, loc["pub_id"], rows)
     upd = [_entry_to_row(e, location_id) for e in res.to_update]

@@ -192,3 +192,76 @@ def test_watcher_detects_create_and_delete(env):
             raise AssertionError("watcher never removed the deleted file")
         locations.close()
     _run(main())
+
+
+def test_cross_directory_move_repaths_by_inode(env):
+    """mv A/f B/f between rescans: the row is re-pathed in place (inode
+    match), keeping its object link — not dropped on the unique
+    constraint."""
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+        from spacedrive_tpu.objects.identifier import FileIdentifierJob
+
+        with open(f"{src}/moveme.bin", "wb") as f:
+            f.write(b"move-payload" * 40)
+        for job in (IndexerJob(location_id=sid),
+                    FileIdentifierJob(location_id=sid)):
+            jid = await node.jobs.ingest(lib, job)
+            await node.jobs.wait(jid)
+        before = lib.db.query_one(
+            "SELECT pub_id, object_id, cas_id, inode FROM file_path "
+            "WHERE name='moveme'")
+        assert before["object_id"] is not None
+
+        os.rename(f"{src}/moveme.bin", f"{src}/sub/moveme.bin")
+        jid = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        await node.jobs.wait(jid)
+
+        rows = lib.db.query(
+            "SELECT * FROM file_path WHERE name='moveme'")
+        assert len(rows) == 1, [dict(r) for r in rows]
+        after = rows[0]
+        assert after["pub_id"] == before["pub_id"]  # same row, re-pathed
+        assert after["materialized_path"] == "/sub/"
+        assert after["object_id"] == before["object_id"]
+        assert after["cas_id"] == before["cas_id"]
+    _run(main())
+
+
+@pytest.mark.skipif(not os.path.exists("/proc"), reason="linux only")
+def test_watcher_detects_rename(env):
+    """Cookie-paired MOVED_FROM/MOVED_TO: the old name disappears, the
+    new name appears with the same content identity."""
+    node, lib, src, dst, sid, did = env
+
+    async def main():
+        from spacedrive_tpu.locations.watcher import Locations
+        locations = Locations(node, backend="numpy")
+        assert locations.watch_location(lib, sid)
+        with open(f"{src}/before.bin", "wb") as f:
+            f.write(b"rename-me" * 60)
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            row = lib.db.query_one(
+                "SELECT cas_id FROM file_path WHERE name='before'")
+            if row is not None and row["cas_id"]:
+                break
+        else:
+            raise AssertionError("watcher never indexed the file")
+        old_cas = row["cas_id"]
+        os.rename(f"{src}/before.bin", f"{src}/after.bin")
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            new = lib.db.query_one(
+                "SELECT cas_id FROM file_path WHERE name='after'")
+            gone = lib.db.query_one(
+                "SELECT 1 FROM file_path WHERE name='before'") is None
+            if new is not None and new["cas_id"] and gone:
+                break
+        else:
+            raise AssertionError("rename not reflected")
+        assert new["cas_id"] == old_cas  # same bytes → same identity
+        locations.close()
+    _run(main())

@@ -1,0 +1,82 @@
+"""keys. RPC namespace over the key manager."""
+
+import asyncio
+
+import pytest
+
+from spacedrive_tpu.api.router import mount_router
+from spacedrive_tpu.node import Node
+
+
+@pytest.fixture(autouse=True)
+def _tiny_balloon_costs(monkeypatch):
+    from spacedrive_tpu.crypto import hashing
+    from spacedrive_tpu.crypto.hashing import HashingAlgorithm, Params
+
+    monkeypatch.setattr(hashing, "_BALLOON_COSTS", {
+        Params.STANDARD: (16, 1),
+        Params.HARDENED: (32, 1),
+        Params.PARANOID: (64, 1),
+    })
+    # default manager uses argon2; steer tests to the tiny balloon
+    from spacedrive_tpu.crypto.keymanager import KeyManager
+
+    orig = KeyManager.__init__
+
+    def patched(self, data_path=None, **kw):
+        kw.setdefault("hashing_algorithm",
+                      HashingAlgorithm.BALLOON_BLAKE3)
+        orig(self, data_path, **kw)
+    monkeypatch.setattr(KeyManager, "__init__", patched)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_keys_lifecycle_over_rpc(tmp_path):
+    node = Node(str(tmp_path / "data"))
+    router = mount_router(node)
+
+    async def main():
+        assert await router.dispatch("keys.isSetup", {}) is False
+        await router.dispatch("keys.setup", {"password": "master"})
+        assert await router.dispatch("keys.isSetup", {}) is True
+        assert await router.dispatch("keys.isUnlocked", {}) is True
+
+        uid = await router.dispatch(
+            "keys.add", {"key": "lib-secret", "automount": True})
+        await router.dispatch("keys.mount", {"uuid": uid})
+        keys = await router.dispatch("keys.list", {})
+        assert keys[0]["uuid"] == uid and keys[0]["mounted"]
+
+        await router.dispatch("keys.lock", {})
+        assert await router.dispatch("keys.isUnlocked", {}) is False
+
+        from spacedrive_tpu.api.router import RpcError
+
+        with pytest.raises(RpcError):
+            await router.dispatch("keys.unlock", {"password": "wrong"})
+        await router.dispatch("keys.unlock", {"password": "master"})
+        await router.dispatch("keys.delete", {"uuid": uid})
+        assert await router.dispatch("keys.list", {}) == []
+    _run(main())
+
+
+def test_keys_survive_restart(tmp_path):
+    data = str(tmp_path / "data")
+
+    async def main():
+        node = Node(data)
+        router = mount_router(node)
+        await router.dispatch("keys.setup", {"password": "pw"})
+        uid = await router.dispatch("keys.add", {"key": "k1"})
+
+        node2 = Node(data)
+        router2 = mount_router(node2)
+        assert await router2.dispatch("keys.isSetup", {}) is True
+        assert await router2.dispatch("keys.isUnlocked", {}) is False
+        await router2.dispatch("keys.unlock", {"password": "pw"})
+        await router2.dispatch("keys.mount", {"uuid": uid})
+        assert (await router2.dispatch("keys.list", {}))[0]["mounted"]
+    _run(main())
