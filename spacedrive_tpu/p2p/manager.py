@@ -178,6 +178,9 @@ class P2PManager:
                 "t": "pair",
                 "library_id": str(library.id),
                 "library_name": library.config.name,
+                # Our LISTENING port (the TCP source port is ephemeral):
+                # the responder derives a route back to us from it.
+                "listen_port": self.port,
                 "instance": {
                     "pub_id": me["pub_id"], "identity":
                         self.identity.to_remote_identity().to_bytes(),
@@ -195,7 +198,12 @@ class P2PManager:
             if self.networked is not None:
                 self.networked.learn_instance(
                     library.id, inst["pub_id"],
-                    RemoteIdentity(inst["identity"]))
+                    RemoteIdentity(inst["identity"]),
+                    route=(addr, port))
+                # Backfill: announce immediately so the fresh peer pulls
+                # the library's existing op log — without this, a paired
+                # library stays empty until the NEXT local write.
+                self.networked.originate_soon(library)
             return True
         finally:
             tunnel.close()
@@ -327,8 +335,15 @@ class P2PManager:
             inst["pub_id"], identity=inst["identity"],
             node_id=inst["node_id"], node_name=inst["node_name"])
         if self.networked is not None:
+            # Route back to the initiator: its socket IP + the listening
+            # port it sent (NOT the connection's ephemeral source port).
+            route = None
+            peer = tunnel.writer.get_extra_info("peername")
+            if peer and header.get("listen_port"):
+                route = (peer[0], int(header["listen_port"]))
             self.networked.learn_instance(
-                lib.id, inst["pub_id"], RemoteIdentity(inst["identity"]))
+                lib.id, inst["pub_id"], RemoteIdentity(inst["identity"]),
+                route=route)
         me = lib.db.query_one(
             "SELECT * FROM instance WHERE pub_id = ?", (lib.sync.instance,))
         await tunnel.send({"status": "accepted", "instance": {
@@ -337,6 +352,10 @@ class P2PManager:
             "node_id": self.node.config.id,
             "node_name": self.node.config.name,
         }})
+        if self.networked is not None:
+            # Symmetric backfill: OUR pre-existing ops (re-pairing case)
+            # flow to the initiator without waiting for a local write.
+            self.networked.originate_soon(lib)
 
     async def _handle_file(self, tunnel: Tunnel, header: dict) -> None:
         from ..locations.paths import IsolatedPath

@@ -32,19 +32,17 @@ def cpu_devices():
 
 
 async def pair_two_nodes(a, b, library_name: str = "shared"):
-    """Start both nodes' p2p planes (no discovery), pair a library from
-    A into B, and wire explicit loopback routes both ways. Returns
+    """Start both nodes' p2p planes (no discovery) and pair a library
+    from A into B. Pairing itself records the sync routes both ways
+    (initiator: the dialed address; responder: socket IP + announced
+    listen port), so no manual set_route wiring is needed. Returns
     (lib_a, lib_b). Shared by the p2p/fault/live-loop suites."""
     await a.start()
     await b.start()
-    pa = await a.start_p2p(host="127.0.0.1", enable_discovery=False)
+    await a.start_p2p(host="127.0.0.1", enable_discovery=False)
     pb = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
     lib_a = a.create_library(library_name)
     b.p2p.on_pairing_request = lambda peer, info: True
     assert await a.p2p.pair("127.0.0.1", pb, lib_a)
     lib_b = b.libraries.list()[0]
-    a.p2p.networked.set_route(
-        b.p2p.identity.to_remote_identity(), "127.0.0.1", pb)
-    b.p2p.networked.set_route(
-        a.p2p.identity.to_remote_identity(), "127.0.0.1", pa)
     return lib_a, lib_b

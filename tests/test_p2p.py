@@ -126,6 +126,39 @@ def test_spacedrop_interactive_reject(two_nodes, tmp_path):
     _run(main())
 
 
+def test_pairing_backfills_existing_data(two_nodes):
+    """Data that existed BEFORE pairing reaches the new peer without any
+    further writes on the originator."""
+    a, b = two_nodes
+
+    async def main():
+        await a.start()
+        await b.start()
+        await a.start_p2p(host="127.0.0.1", enable_discovery=False)
+        pb = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
+        lib_a = a.create_library("pre")
+        # Write BEFORE pairing.
+        pub = os.urandom(16)
+        ops = lib_a.sync.shared_create("tag", pub, {"name": "pre-pair"})
+        with lib_a.sync.write_ops(ops) as conn:
+            conn.execute("INSERT INTO tag (pub_id, name) VALUES (?, ?)",
+                         (pub, "pre-pair"))
+        b.p2p.on_pairing_request = lambda peer, info: True
+        assert await a.p2p.pair("127.0.0.1", pb, lib_a)
+        lib_b = b.libraries.list()[0]
+        row = None
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            row = lib_b.db.query_one(
+                "SELECT name FROM tag WHERE pub_id = ?", (pub,))
+            if row is not None:
+                break
+        assert row is not None and row["name"] == "pre-pair"
+        await a.shutdown()
+        await b.shutdown()
+    _run(main())
+
+
 def test_relation_ops_sync_over_network(two_nodes):
     """Tag assignment (a RELATION CRDT op) flows to the peer, resolving
     pub_ids back to each side's local row ids."""
