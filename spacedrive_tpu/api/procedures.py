@@ -759,7 +759,8 @@ def _jobs(r: Router) -> None:
         from ..objects.validator import ObjectValidatorJob
         jid = await node.jobs.ingest(library, ObjectValidatorJob(
             location_id=int(input["id"]),
-            sub_path=input.get("path") or None))
+            sub_path=input.get("path") or None,
+            mode=str(input.get("mode", "fill"))))
         return jid.hex()
 
     @r.mutation("jobs.identifyUniqueFiles", library=True)

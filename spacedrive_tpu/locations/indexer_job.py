@@ -163,6 +163,12 @@ def _repath_rows(library, rows: List[Dict[str, Any]]) -> int:
 
 
 def update_file_path_rows(library, rows: List[Dict[str, Any]]) -> int:
+    """A row lands here when the walker saw its content change
+    (size/mtime drift): besides refreshing those fields, the derived
+    identity — cas_id, integrity_checksum, object link — is INVALIDATED
+    so the identifier re-identifies and the validator re-fills. Without
+    this, stale checksums would read as corruption forever (and stale
+    cas_ids as wrong dedup identity)."""
     if not rows:
         return 0
     db, sync = library.db, library.sync
@@ -170,6 +176,9 @@ def update_file_path_rows(library, rows: List[Dict[str, Any]]) -> int:
     with db.tx() as conn:
         for row in rows:
             values = {k: row[k] for k in SYNCED_UPDATE_FIELDS}
+            if not row.get("is_dir"):
+                values.update(cas_id=None, integrity_checksum=None,
+                              object_id=None)
             db.update("file_path", row["pub_id"], values, conn=conn,
                       id_col="pub_id")
             for k, v in values.items():

@@ -30,23 +30,35 @@ class ObjectValidatorJob(StatefulJob):
     IS_BATCHED = True
 
     def __init__(self, *, location_id: int, sub_path: Optional[str] = None,
-                 backend: str = "auto"):
+                 backend: str = "auto", mode: str = "fill"):
+        """mode="fill" (reference semantics, validator_job.rs:78-218):
+        checksum every file_path whose integrity_checksum IS NULL.
+        mode="verify" (net-new): re-hash files that ALREADY have a
+        checksum and report mismatches — bit-rot/corruption detection,
+        which the reference never does."""
+        if mode not in ("fill", "verify"):
+            raise ValueError(f"unknown validator mode {mode!r}")
         super().__init__(location_id=location_id, sub_path=sub_path,
-                         backend=backend)
+                         backend=backend, mode=mode)
         self.location_id = location_id
         self.sub_path = sub_path
         self.backend = backend
+        self.mode = mode
 
     async def init(self, ctx: JobContext):
         db = ctx.db
         from ..locations.file_path_helper import job_prologue
+        checksum_filter = ("integrity_checksum IS NULL"
+                           if self.mode == "fill"
+                           else "integrity_checksum IS NOT NULL")
         loc, where, params = job_prologue(
             db, self.location_id, self.sub_path,
-            "location_id = ? AND is_dir = 0 AND integrity_checksum IS NULL",
+            f"location_id = ? AND is_dir = 0 AND {checksum_filter}",
             [self.location_id])
         rows = db.query(
-            f"SELECT id, pub_id, materialized_path, name, extension "
-            f"FROM file_path WHERE {where} ORDER BY id", params)
+            f"SELECT id, pub_id, materialized_path, name, extension, "
+            f"integrity_checksum FROM file_path WHERE {where} ORDER BY id",
+            params)
         if not rows:
             raise EarlyFinish("nothing to validate")
         steps = []
@@ -56,13 +68,15 @@ class ObjectValidatorJob(StatefulJob):
                 "id": r["id"], "pub_id": r["pub_id"],
                 "materialized_path": r["materialized_path"],
                 "name": r["name"] or "", "extension": r["extension"] or "",
+                "expected": r["integrity_checksum"],
             })
             if len(batch) == CHUNK_SIZE:
                 steps.append({"rows": batch})
                 batch = []
         if batch:
             steps.append({"rows": batch})
-        data = {"location_path": loc["path"], "validated": 0}
+        data = {"location_path": loc["path"], "validated": 0,
+                "mismatched": 0}
         ctx.progress(task_count=len(steps))
         return data, steps
 
@@ -80,7 +94,7 @@ class ObjectValidatorJob(StatefulJob):
             jobs.append((r, iso.join_on(loc_path)))
 
         errors: List[str] = []
-        results: List[Tuple[dict, str]] = []
+        results: List[Tuple[dict, str, str]] = []  # (row, path, checksum)
 
         from .. import native
         if native.available() and jobs:
@@ -92,10 +106,10 @@ class ObjectValidatorJob(StatefulJob):
                         f"{path}: "
                         f"{native.STATUS_MESSAGES.get(int(st), 'error')}")
                 else:
-                    results.append((r, checksum))
+                    results.append((r, path, checksum))
         else:
             def one(r, path):
-                return r, file_checksum(path)
+                return r, path, file_checksum(path)
 
             with concurrent.futures.ThreadPoolExecutor(
                     max_workers=CHUNK_SIZE) as pool:
@@ -106,9 +120,33 @@ class ObjectValidatorJob(StatefulJob):
                     except OSError as e:
                         errors.append(str(e))
 
+        if self.mode == "verify":
+            # Net-new corruption pass: compare against the stored
+            # checksum; mismatches are non-fatal errors + events, never
+            # silently "repaired" (the stored value is the evidence).
+            node = ctx.services.get("node")
+            for r, path, checksum in results:
+                if checksum != r.get("expected"):
+                    data["mismatched"] += 1
+                    errors.append(
+                        f"CHECKSUM MISMATCH {path}: stored "
+                        f"{r.get('expected')}, current {checksum}")
+                    if node is not None:
+                        node.events.emit({
+                            "type": "IntegrityViolation",
+                            "file_path_id": r["id"], "path": path,
+                        })
+            data["validated"] += len(results)
+            ctx.progress(message=(
+                f"verified {data['validated']} files, "
+                f"{data['mismatched']} mismatches"))
+            return StepOutcome(errors=errors, metadata={
+                "validated": data["validated"],
+                "mismatched": data["mismatched"]})
+
         ops = []
         with db.tx() as conn:
-            for r, checksum in results:
+            for r, _path, checksum in results:
                 conn.execute(
                     "UPDATE file_path SET integrity_checksum = ? "
                     "WHERE id = ? AND integrity_checksum IS NULL",
