@@ -88,8 +88,12 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         near = lib.db.query_one(
             "SELECT COUNT(*) AS n FROM media_data "
             "WHERE phash IS NOT NULL")["n"]
+        pairs = lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM near_dup_pair "
+            "WHERE distance <= 10")["n"]
         print(json.dumps({"stage": "near_dup_hashed",
-                          "hashed_images": near}), flush=True)
+                          "hashed_images": near,
+                          "near_dup_pairs": pairs}), flush=True)
 
     n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
     n_paths = lib.db.query_one(
