@@ -31,6 +31,11 @@ from .job import (
 from .report import JobReport, JobStatus
 
 PROGRESS_THROTTLE_S = 0.5  # worker.rs:273
+# Periodic crash checkpoint: the reference serializes JobState only on
+# pause/shutdown, so a SIGKILL replays the whole job from step 0 (saved
+# only by step idempotency). Persisting the state every few seconds
+# bounds the replay window to the last interval.
+CHECKPOINT_INTERVAL_S = 3.0
 
 
 class WorkerCommand:
@@ -60,6 +65,7 @@ class Worker:
         self.resume_state = resume_state
         self.commands: asyncio.Queue = asyncio.Queue()
         self._last_progress_emit = 0.0
+        self._last_checkpoint = time.monotonic()
         self._started_at = 0.0
 
     # -- control ----------------------------------------------------------
@@ -219,6 +225,19 @@ class Worker:
             state.steps.popleft()
             state.step_number += 1
             self._progress(completed=state.step_number)
+            now = time.monotonic()
+            if now - self._last_checkpoint >= CHECKPOINT_INTERVAL_S:
+                self._last_checkpoint = now
+                # Crash checkpoint: status stays RUNNING; cold_resume
+                # rehydrates from this blob after a hard kill. Strictly
+                # best-effort — an optimization write must never kill a
+                # healthy job — and off the event loop (the blob is
+                # O(remaining steps) for batch jobs).
+                try:
+                    await asyncio.to_thread(
+                        self._persist_state, state, errors)
+                except Exception:  # noqa: BLE001 — retry next interval
+                    pass
 
         # A command that landed in the same tick the FINAL step finished was
         # re-queued above and would otherwise be dropped. CANCEL is still
@@ -247,13 +266,18 @@ class Worker:
             cmd = self.commands.get_nowait()
         return cmd
 
-    async def _persist_paused(self, state: JobState,
-                              errors: List[str]) -> JobStatus:
-        self.report.status = JobStatus.PAUSED
+    def _persist_state(self, state: JobState, errors: List[str]) -> None:
+        """Serialize + write the resumable state blob (shared by the
+        pause path and the periodic crash checkpoint)."""
         self.report.data = state.serialize()
         self.report.errors_text = list(errors)
         self.report.completed_task_count = state.step_number
         self.report.update(self.library.db)
+
+    async def _persist_paused(self, state: JobState,
+                              errors: List[str]) -> JobStatus:
+        self.report.status = JobStatus.PAUSED
+        self._persist_state(state, errors)
         return JobStatus.PAUSED
 
     async def _persist_paused_or_fail(self, why: str) -> JobStatus:

@@ -1,0 +1,53 @@
+"""Child process for the real-crash recovery test: starts a node, kicks
+off a slow job, then waits to be SIGKILLed. Run:
+    python tests/_crash_child.py <data_dir> <corpus_dir>
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu.jobs.job import StatefulJob, StepOutcome, register_job  # noqa: E402
+from spacedrive_tpu.node import Node  # noqa: E402
+
+
+@register_job
+class SlowCountJob(StatefulJob):
+    """Appends one line per step to progress.log — slow enough to be
+    killed mid-run, observable enough to verify exactly-once effects."""
+
+    NAME = "test_slow_count"
+
+    def __init__(self, *, steps: int, log_path: str):
+        super().__init__(steps=steps, log_path=log_path)
+        self.steps = steps
+        self.log_path = log_path
+
+    async def init(self, ctx):
+        return {}, list(range(self.steps))
+
+    async def execute_step(self, ctx, data, step, step_number):
+        await asyncio.sleep(0.05)
+        with open(self.log_path, "a") as f:
+            f.write(f"{step}\n")
+        return StepOutcome()
+
+
+async def main() -> None:
+    data_dir, corpus = sys.argv[1], sys.argv[2]
+    node = Node(data_dir)
+    await node.start()
+    lib = node.libraries.list()[0] if node.libraries.list() else \
+        node.create_library("crash")
+    job = SlowCountJob(steps=100,
+                       log_path=os.path.join(corpus, "progress.log"))
+    await node.jobs.ingest(lib, job)
+    print("STARTED", flush=True)
+    await asyncio.sleep(60)  # parent SIGKILLs us long before this
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
