@@ -1,0 +1,33 @@
+"""XLA environment bootstrap helpers (no jax import — must be callable
+before the first ``import jax`` takes effect).
+
+The virtual host-device count used for CPU-mesh testing and the driver's
+multichip dry-run is carried in ``XLA_FLAGS`` and is only read once, when
+the CPU backend initializes; these helpers centralize the mutation so the
+test conftest and ``__graft_entry__`` can't drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Ensure ``XLA_FLAGS`` requests at least ``n`` virtual CPU devices.
+
+    Appends the flag when absent; raises an existing smaller value to
+    ``n`` (never lowers a larger one). Takes effect only if the CPU
+    backend has not yet initialized in this process.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = f"{flags} --{_FLAG}={n}".strip()
+    elif int(m.group(1)) < n:
+        flags = flags[: m.start(1)] + str(n) + flags[m.end(1):]
+    else:
+        return
+    os.environ["XLA_FLAGS"] = flags

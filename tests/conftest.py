@@ -7,15 +7,11 @@ platform selection must go through jax.config because the axon TPU
 plugin overrides the JAX_PLATFORMS env var at interpreter start.
 """
 
-import os
-
 import pytest
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from spacedrive_tpu.xla_env import ensure_host_device_count
+
+ensure_host_device_count(8)
 
 # The axon TPU plugin registers at interpreter start (sitecustomize) and
 # sets jax_platforms="axon,cpu", so merely calling jax.devices() would
