@@ -126,6 +126,275 @@ static void words_of_block(const uint8_t* data, size_t len, uint32_t w[16]) {
   }
 }
 
+static void le64(uint64_t v, uint8_t out[8]) {
+  for (int i = 0; i < 8; i++) out[i] = (uint8_t)(v >> (8 * i));
+}
+
+// Message-word schedule: SCHED[r][i] is the index into the original block
+// of the word used at position i of round r (the permutation applied r
+// times), so rounds can index the message directly instead of
+// re-permuting 16 vectors between rounds.
+struct Sched {
+  uint8_t v[7][16];
+};
+static constexpr Sched make_sched() {
+  Sched s{};
+  for (int i = 0; i < 16; i++) s.v[0][i] = (uint8_t)i;
+  for (int r = 1; r < 7; r++)
+    for (int i = 0; i < 16; i++) s.v[r][i] = s.v[r - 1][MSG_PERMUTATION[i]];
+  return s;
+}
+static constexpr Sched SCHED = make_sched();
+
+#if defined(__AVX2__)
+// ---------------------------------------------------------------------------
+// 8-lane SIMD BLAKE3: one 32-bit state word per __m256i, eight independent
+// compressions per instruction. Used two ways:
+//   - hash8_leaf_cvs: 8 consecutive chunks of ONE stream (the streaming
+//     hasher's fast path — checksums, small-file CAS);
+//   - blake3_x8: 8 equal-length messages in lockstep, tree and all (the
+//     batched CAS grid, where every large-file message is 57,352 bytes).
+// ---------------------------------------------------------------------------
+#include <immintrin.h>
+
+namespace wide {
+
+static inline __m256i rotr_v(__m256i x, int n) {
+#if defined(__AVX512VL__)
+  return _mm256_ror_epi32(x, n);
+#else
+  return _mm256_or_si256(_mm256_srli_epi32(x, n),
+                         _mm256_slli_epi32(x, 32 - n));
+#endif
+}
+
+#define GV(a, b, c, d, mx, my)                           \
+  do {                                                   \
+    a = _mm256_add_epi32(_mm256_add_epi32(a, b), (mx));  \
+    d = rotr_v(_mm256_xor_si256(d, a), 16);              \
+    c = _mm256_add_epi32(c, d);                          \
+    b = rotr_v(_mm256_xor_si256(b, c), 12);              \
+    a = _mm256_add_epi32(_mm256_add_epi32(a, b), (my));  \
+    d = rotr_v(_mm256_xor_si256(d, a), 8);               \
+    c = _mm256_add_epi32(c, d);                          \
+    b = rotr_v(_mm256_xor_si256(b, c), 7);               \
+  } while (0)
+
+// In-place 8x8 transpose of 32-bit elements (v[r] = row r).
+static inline void transpose8(__m256i v[8]) {
+  __m256i t0 = _mm256_unpacklo_epi32(v[0], v[1]);
+  __m256i t1 = _mm256_unpackhi_epi32(v[0], v[1]);
+  __m256i t2 = _mm256_unpacklo_epi32(v[2], v[3]);
+  __m256i t3 = _mm256_unpackhi_epi32(v[2], v[3]);
+  __m256i t4 = _mm256_unpacklo_epi32(v[4], v[5]);
+  __m256i t5 = _mm256_unpackhi_epi32(v[4], v[5]);
+  __m256i t6 = _mm256_unpacklo_epi32(v[6], v[7]);
+  __m256i t7 = _mm256_unpackhi_epi32(v[6], v[7]);
+  __m256i u0 = _mm256_unpacklo_epi64(t0, t2);
+  __m256i u1 = _mm256_unpackhi_epi64(t0, t2);
+  __m256i u2 = _mm256_unpacklo_epi64(t1, t3);
+  __m256i u3 = _mm256_unpackhi_epi64(t1, t3);
+  __m256i u4 = _mm256_unpacklo_epi64(t4, t6);
+  __m256i u5 = _mm256_unpackhi_epi64(t4, t6);
+  __m256i u6 = _mm256_unpacklo_epi64(t5, t7);
+  __m256i u7 = _mm256_unpackhi_epi64(t5, t7);
+  v[0] = _mm256_permute2x128_si256(u0, u4, 0x20);
+  v[1] = _mm256_permute2x128_si256(u1, u5, 0x20);
+  v[2] = _mm256_permute2x128_si256(u2, u6, 0x20);
+  v[3] = _mm256_permute2x128_si256(u3, u7, 0x20);
+  v[4] = _mm256_permute2x128_si256(u0, u4, 0x31);
+  v[5] = _mm256_permute2x128_si256(u1, u5, 0x31);
+  v[6] = _mm256_permute2x128_si256(u2, u6, 0x31);
+  v[7] = _mm256_permute2x128_si256(u3, u7, 0x31);
+}
+
+// Load one 64-byte block from each of 8 lanes, transposed into message
+// vectors m[w] = word w across lanes (x86 is little-endian, so a plain
+// 32-bit load IS the LE word decode).
+static inline void load_block8(const uint8_t* const p[8], __m256i m[16]) {
+  __m256i lo[8], hi[8];
+  for (int j = 0; j < 8; j++) {
+    lo[j] = _mm256_loadu_si256((const __m256i*)(const void*)p[j]);
+    hi[j] = _mm256_loadu_si256((const __m256i*)(const void*)(p[j] + 32));
+  }
+  transpose8(lo);
+  transpose8(hi);
+  for (int w = 0; w < 8; w++) {
+    m[w] = lo[w];
+    m[8 + w] = hi[w];
+  }
+}
+
+// Eight compressions at once; cv[w] is chaining-value word w across the
+// lanes and is replaced with the new chaining value (low-half output).
+static void compress8_cv(__m256i cv[8], const __m256i m[16], __m256i ctr_lo,
+                         __m256i ctr_hi, uint32_t block_len, uint32_t flags) {
+  __m256i s0 = cv[0], s1 = cv[1], s2 = cv[2], s3 = cv[3];
+  __m256i s4 = cv[4], s5 = cv[5], s6 = cv[6], s7 = cv[7];
+  __m256i s8 = _mm256_set1_epi32((int32_t)IV[0]);
+  __m256i s9 = _mm256_set1_epi32((int32_t)IV[1]);
+  __m256i s10 = _mm256_set1_epi32((int32_t)IV[2]);
+  __m256i s11 = _mm256_set1_epi32((int32_t)IV[3]);
+  __m256i s12 = ctr_lo;
+  __m256i s13 = ctr_hi;
+  __m256i s14 = _mm256_set1_epi32((int32_t)block_len);
+  __m256i s15 = _mm256_set1_epi32((int32_t)flags);
+
+  for (int r = 0; r < 7; r++) {
+    const uint8_t* sc = SCHED.v[r];
+    GV(s0, s4, s8, s12, m[sc[0]], m[sc[1]]);
+    GV(s1, s5, s9, s13, m[sc[2]], m[sc[3]]);
+    GV(s2, s6, s10, s14, m[sc[4]], m[sc[5]]);
+    GV(s3, s7, s11, s15, m[sc[6]], m[sc[7]]);
+    GV(s0, s5, s10, s15, m[sc[8]], m[sc[9]]);
+    GV(s1, s6, s11, s12, m[sc[10]], m[sc[11]]);
+    GV(s2, s7, s8, s13, m[sc[12]], m[sc[13]]);
+    GV(s3, s4, s9, s14, m[sc[14]], m[sc[15]]);
+  }
+
+  cv[0] = _mm256_xor_si256(s0, s8);
+  cv[1] = _mm256_xor_si256(s1, s9);
+  cv[2] = _mm256_xor_si256(s2, s10);
+  cv[3] = _mm256_xor_si256(s3, s11);
+  cv[4] = _mm256_xor_si256(s4, s12);
+  cv[5] = _mm256_xor_si256(s5, s13);
+  cv[6] = _mm256_xor_si256(s6, s14);
+  cv[7] = _mm256_xor_si256(s7, s15);
+}
+
+// Leaf CVs of 8 consecutive FULL chunks of one stream: lane j hashes
+// data[j*1024 .. j*1024+1024) with chunk counter counter0+j. The caller
+// guarantees none of them is the final chunk.
+static void hash8_leaf_cvs(const uint8_t* data, uint64_t counter0,
+                           uint32_t out_cvs[8][8]) {
+  __m256i cv[8];
+  for (int i = 0; i < 8; i++) cv[i] = _mm256_set1_epi32((int32_t)IV[i]);
+  alignas(32) uint32_t clo[8], chi[8];
+  for (int j = 0; j < 8; j++) {
+    clo[j] = (uint32_t)(counter0 + (uint64_t)j);
+    chi[j] = (uint32_t)((counter0 + (uint64_t)j) >> 32);
+  }
+  __m256i ctr_lo = _mm256_load_si256((const __m256i*)clo);
+  __m256i ctr_hi = _mm256_load_si256((const __m256i*)chi);
+
+  const uint8_t* p[8];
+  for (int j = 0; j < 8; j++) p[j] = data + (size_t)j * CHUNK_LEN;
+  for (int b = 0; b < 16; b++) {
+    __m256i m[16];
+    load_block8(p, m);
+    uint32_t flags =
+        (b == 0 ? CHUNK_START : 0u) | (b == 15 ? CHUNK_END : 0u);
+    compress8_cv(cv, m, ctr_lo, ctr_hi, BLOCK_LEN, flags);
+    for (int j = 0; j < 8; j++) p[j] += BLOCK_LEN;
+  }
+  transpose8(cv);  // word-across-lane -> lane rows
+  for (int j = 0; j < 8; j++)
+    _mm256_storeu_si256((__m256i*)(void*)out_cvs[j], cv[j]);
+}
+
+// Chaining values of 8 lanes, one word per vector.
+struct CVv {
+  __m256i w[8];
+};
+
+static inline void merge_parent_v(const CVv& l, const CVv& r, uint32_t flags,
+                                  CVv* out) {
+  __m256i m[16];
+  for (int i = 0; i < 8; i++) {
+    m[i] = l.w[i];
+    m[8 + i] = r.w[i];
+  }
+  CVv cv;
+  for (int i = 0; i < 8; i++) cv.w[i] = _mm256_set1_epi32((int32_t)IV[i]);
+  compress8_cv(cv.w, m, _mm256_setzero_si256(), _mm256_setzero_si256(),
+               BLOCK_LEN, flags);
+  *out = cv;
+}
+
+// Hash 8 equal-length messages in lockstep — identical tree shape, so
+// leaves, parents and root are all 8-wide with no shuffling between
+// stages. Message j is (optional 8-byte LE prefixes[j]) ‖ rows[j];
+// total_len includes the prefix. Digests are 32 bytes per lane.
+static void blake3_x8(const uint8_t* const rows[8], uint64_t total_len,
+                      const uint64_t* prefixes, uint8_t* digests,
+                      int64_t digest_stride) {
+  const uint64_t pre = prefixes ? 8 : 0;
+  const uint64_t n_chunks =
+      total_len == 0 ? 1 : (total_len + CHUNK_LEN - 1) / CHUNK_LEN;
+  CVv stack[64];
+  int sp = 0;
+  alignas(32) uint8_t stage[8][BLOCK_LEN];
+  CVv cv;
+
+  for (uint64_t c = 0; c < n_chunks; c++) {
+    const uint64_t chunk_off = c * CHUNK_LEN;
+    const uint64_t chunk_len =
+        total_len == 0
+            ? 0
+            : std::min<uint64_t>(CHUNK_LEN, total_len - chunk_off);
+    const int n_blocks =
+        chunk_len == 0 ? 1 : (int)((chunk_len + BLOCK_LEN - 1) / BLOCK_LEN);
+    for (int i = 0; i < 8; i++) cv.w[i] = _mm256_set1_epi32((int32_t)IV[i]);
+    __m256i ctr_lo = _mm256_set1_epi32((int32_t)(uint32_t)c);
+    __m256i ctr_hi = _mm256_set1_epi32((int32_t)(uint32_t)(c >> 32));
+
+    for (int b = 0; b < n_blocks; b++) {
+      const uint64_t bo = chunk_off + (uint64_t)b * BLOCK_LEN;
+      const uint32_t blen =
+          (uint32_t)std::min<uint64_t>(BLOCK_LEN, chunk_len - (uint64_t)b * BLOCK_LEN);
+      __m256i m[16];
+      if (blen == BLOCK_LEN && bo >= pre) {
+        const uint8_t* p[8];
+        for (int j = 0; j < 8; j++) p[j] = rows[j] + (bo - pre);
+        load_block8(p, m);
+      } else {
+        for (int j = 0; j < 8; j++) {
+          std::memset(stage[j], 0, BLOCK_LEN);
+          uint64_t o = bo;
+          uint32_t k = 0;
+          if (o < pre) {
+            uint8_t p8[8];
+            le64(prefixes[j], p8);
+            while (o < pre && k < blen) stage[j][k++] = p8[o++];
+          }
+          if (k < blen)
+            std::memcpy(stage[j] + k, rows[j] + (o - pre), blen - k);
+        }
+        const uint8_t* p[8] = {stage[0], stage[1], stage[2], stage[3],
+                               stage[4], stage[5], stage[6], stage[7]};
+        load_block8(p, m);
+      }
+      uint32_t flags = (b == 0 ? CHUNK_START : 0u) |
+                       (b == n_blocks - 1 ? CHUNK_END : 0u);
+      if (n_chunks == 1 && b == n_blocks - 1) flags |= ROOT;
+      compress8_cv(cv.w, m, ctr_lo, ctr_hi, blen, flags);
+    }
+
+    if (n_chunks == 1) break;
+    if (c < n_chunks - 1) {
+      uint64_t total = c + 1;
+      while ((total & 1) == 0) {
+        merge_parent_v(stack[--sp], cv, PARENT, &cv);
+        total >>= 1;
+      }
+      stack[sp++] = cv;
+    } else {
+      while (sp > 1) merge_parent_v(stack[--sp], cv, PARENT, &cv);
+      merge_parent_v(stack[0], cv, PARENT | ROOT, &cv);
+    }
+  }
+
+  __m256i out[8];
+  for (int i = 0; i < 8; i++) out[i] = cv.w[i];
+  transpose8(out);
+  for (int j = 0; j < 8; j++)
+    _mm256_storeu_si256((__m256i*)(void*)(digests + j * digest_stride),
+                        out[j]);
+}
+
+}  // namespace wide
+#endif  // __AVX2__
+
 // Streaming hasher — same state machine as the Python oracle: a chunk
 // state plus a binary-counter CV stack of completed subtrees.
 class Blake3 {
@@ -147,20 +416,20 @@ class Blake3 {
         // non-root leaf, fold the stack like a binary counter.
         uint32_t cv[8];
         chunk_output(0, cv);
-        uint64_t total = chunk_counter_ + 1;
-        while ((total & 1) == 0) {
-          merge_parent(stack_.back().data(), cv, PARENT, cv);
-          stack_.pop_back();
-          total >>= 1;
-        }
-        std::array<uint32_t, 8> entry;
-        std::memcpy(entry.data(), cv, sizeof(cv));
-        stack_.push_back(entry);
-        chunk_counter_++;
-        std::memcpy(chunk_cv_, IV, sizeof(chunk_cv_));
-        buf_len_ = 0;
-        blocks_compressed_ = 0;
+        push_chunk_cv(cv);
       }
+#if defined(__AVX2__)
+      // At a chunk boundary with strictly more than 8 chunks left, 8
+      // full chunks complete here and none can be the final one: hash
+      // them 8-wide and fold their CVs through the same stack.
+      while (chunk_length() == 0 && len > 8 * CHUNK_LEN) {
+        uint32_t cvs[8][8];
+        wide::hash8_leaf_cvs(data, chunk_counter_, cvs);
+        for (int j = 0; j < 8; j++) push_chunk_cv(cvs[j]);
+        data += 8 * CHUNK_LEN;
+        len -= 8 * CHUNK_LEN;
+      }
+#endif
       // Absorb into the current chunk. Only compress a buffered block
       // once more input exists, so CHUNK_END stays available.
       if (buf_len_ == BLOCK_LEN) {
@@ -209,6 +478,26 @@ class Blake3 {
   }
 
  private:
+  // Fold a completed (non-final) chunk's CV into the subtree stack like
+  // a binary counter, then reset the chunk state for the next chunk.
+  void push_chunk_cv(const uint32_t cv_in[8]) {
+    uint32_t cv[8];
+    std::memcpy(cv, cv_in, sizeof(cv));
+    uint64_t total = chunk_counter_ + 1;
+    while ((total & 1) == 0) {
+      merge_parent(stack_.back().data(), cv, PARENT, cv);
+      stack_.pop_back();
+      total >>= 1;
+    }
+    std::array<uint32_t, 8> entry;
+    std::memcpy(entry.data(), cv, sizeof(cv));
+    stack_.push_back(entry);
+    chunk_counter_++;
+    std::memcpy(chunk_cv_, IV, sizeof(chunk_cv_));
+    buf_len_ = 0;
+    blocks_compressed_ = 0;
+  }
+
   size_t chunk_length() const {
     return blocks_compressed_ * BLOCK_LEN + buf_len_;
   }
@@ -337,10 +626,6 @@ static void parallel_for(int64_t n, int n_threads, F&& fn) {
   for (auto& w : workers) w.join();
 }
 
-static void le64(uint64_t v, uint8_t out[8]) {
-  for (int i = 0; i < 8; i++) out[i] = (uint8_t)(v >> (8 * i));
-}
-
 }  // namespace
 
 extern "C" {
@@ -354,18 +639,59 @@ void sd_blake3(const uint8_t* data, uint64_t len, uint8_t* out32) {
 
 // Batched BLAKE3 over rows of a dense array. Row i hashes
 // [optional 8-byte LE prefix_sizes[i]] ‖ payloads[i*stride .. +lens[i]].
+// Groups of 8 equal-length rows go through the lockstep SIMD tree.
 void sd_blake3_many(int64_t n, const uint8_t* payloads, int64_t stride,
                     const int32_t* lens, const uint64_t* prefix_sizes,
                     uint8_t* out, int n_threads) {
-  parallel_for(n, n_threads, [&](int64_t i) {
-    Blake3 h;
-    if (prefix_sizes) {
-      uint8_t pre[8];
-      le64(prefix_sizes[i], pre);
-      h.update(pre, 8);
+  // Grouping by 8 would starve workers when there are fewer groups than
+  // cores — on multicore hosts small batches stay item-parallel.
+  int hw = (int)std::thread::hardware_concurrency();
+  if (hw <= 0) hw = 4;
+  const int eff_threads = n_threads > 0 ? n_threads : hw;
+  const int64_t n_groups =
+      n >= (int64_t)eff_threads * 8 ? (n + 7) / 8 : n;
+  const bool grouped = n_groups != n;
+  parallel_for(n_groups, n_threads, [&](int64_t g) {
+    if (!grouped) {
+      const int64_t i = g;
+      Blake3 h;
+      if (prefix_sizes) {
+        uint8_t pre[8];
+        le64(prefix_sizes[i], pre);
+        h.update(pre, 8);
+      }
+      h.update(payloads + i * stride, (size_t)lens[i]);
+      h.finalize(out + i * 32);
+      return;
     }
-    h.update(payloads + i * stride, (size_t)lens[i]);
-    h.finalize(out + i * 32);
+    const int64_t lo = g * 8;
+    const int64_t hi = std::min<int64_t>(lo + 8, n);
+#if defined(__AVX2__)
+    if (hi - lo == 8) {
+      bool uniform = true;
+      for (int64_t i = lo + 1; i < hi; i++)
+        if (lens[i] != lens[lo]) uniform = false;
+      if (uniform) {
+        const uint8_t* rows[8];
+        for (int j = 0; j < 8; j++) rows[j] = payloads + (lo + j) * stride;
+        wide::blake3_x8(rows,
+                        (uint64_t)lens[lo] + (prefix_sizes ? 8 : 0),
+                        prefix_sizes ? prefix_sizes + lo : nullptr,
+                        out + lo * 32, 32);
+        return;
+      }
+    }
+#endif
+    for (int64_t i = lo; i < hi; i++) {
+      Blake3 h;
+      if (prefix_sizes) {
+        uint8_t pre[8];
+        le64(prefix_sizes[i], pre);
+        h.update(pre, 8);
+      }
+      h.update(payloads + i * stride, (size_t)lens[i]);
+      h.finalize(out + i * 32);
+    }
   });
 }
 
@@ -400,9 +726,67 @@ void sd_stage_small(int64_t n, const char** paths, uint64_t cap, uint8_t* out,
 // Fused CPU CAS path: stage + hash in one pass, one thread-hop per file.
 // digests[i] is the 32-byte blake3(size_le ‖ sampled-or-whole payload);
 // the caller truncates to 16 hex chars (cas.rs:61).
+// Large files all share the 57,344-byte sampled payload shape, so they
+// are staged and hashed in lockstep groups of 8 (wide::blake3_x8).
 void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
                     uint8_t* digests, int32_t* status, int n_threads) {
+#if defined(__AVX2__)
+  std::vector<int64_t> large;
+  large.reserve((size_t)n);
+  for (int64_t i = 0; i < n; i++)
+    if (sizes[i] > MINIMUM_FILE_SIZE) large.push_back(i);
+  const int64_t n_lgroups = (int64_t)large.size() / 8;
+  parallel_for(n_lgroups, n_threads, [&](int64_t g) {
+    std::vector<uint8_t> buf(8 * LARGE_PAYLOAD);
+    const uint8_t* rows[8];
+    uint64_t prefixes[8];
+    bool all_ok = true;
+    for (int j = 0; j < 8; j++) {
+      const int64_t i = large[(size_t)(g * 8 + j)];
+      uint8_t* row = buf.data() + (size_t)j * LARGE_PAYLOAD;
+      rows[j] = row;
+      prefixes[j] = sizes[i];
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        status[i] = ERR_OPEN;
+        all_ok = false;
+        continue;
+      }
+      status[i] = read_sampled(fd, sizes[i], row);
+      close(fd);
+      if (status[i] != OK) all_ok = false;
+    }
+    if (all_ok) {
+      uint8_t dg[8 * 32];
+      wide::blake3_x8(rows, 8 + LARGE_PAYLOAD, prefixes, dg, 32);
+      for (int j = 0; j < 8; j++)
+        std::memcpy(digests + large[(size_t)(g * 8 + j)] * 32, dg + j * 32,
+                    32);
+    } else {
+      for (int j = 0; j < 8; j++) {
+        const int64_t i = large[(size_t)(g * 8 + j)];
+        if (status[i] != OK) continue;
+        Blake3 h;
+        uint8_t pre[8];
+        le64(sizes[i], pre);
+        h.update(pre, 8);
+        h.update(rows[j], LARGE_PAYLOAD);
+        h.finalize(digests + i * 32);
+      }
+    }
+  });
+  const auto handled = [&](int64_t i) {
+    if (sizes[i] <= MINIMUM_FILE_SIZE) return false;
+    // Large files beyond the last full group of 8 fall through to the
+    // scalar path below.
+    auto it = std::lower_bound(large.begin(), large.end(), i);
+    return (it - large.begin()) < n_lgroups * 8;
+  };
+#else
+  const auto handled = [](int64_t) { return false; };
+#endif
   parallel_for(n, n_threads, [&](int64_t i) {
+    if (handled(i)) return;
     if (sizes[i] == 0) {
       status[i] = ERR_EMPTY;
       return;
