@@ -51,3 +51,74 @@ def test_multi_megabyte_vs_numpy_reference(mesh):
     data = rng.integers(0, 256, size=1_500_000, dtype=np.uint8).tobytes()
     fn = make_sharded_checksum(mesh, shard_chunks=256)  # 8×256 KiB
     assert fn(data) == blake3_batch_np([data])[0]
+
+# -- streaming windows (VERDICT r1 item 7) ----------------------------------
+
+
+def test_streaming_multi_window_matches_oracle(mesh):
+    """A stream spanning several windows (8 dev × 2 chunks = 16 KiB
+    window) hashes oracle-exact while never buffering more than one
+    window; odd tail, chunk-unaligned."""
+    from spacedrive_tpu.ops.seqhash import StreamingShardedChecksum
+
+    data = bytes((i * 7 + 3) % 256 for i in range(5 * 16384 + 777))
+    h = StreamingShardedChecksum(mesh, shard_chunks=2)
+    # Feed in awkward increments to exercise buffering.
+    for off in range(0, len(data), 10_000):
+        h.update(data[off:off + 10_000])
+        assert len(h._buf) <= h._window_bytes
+    assert h.hexdigest() == blake3_hex(data)
+
+
+@pytest.mark.parametrize("n_windows,extra", [
+    (1, 0),       # exactly one window → single-call ROOT path
+    (2, 0),       # ends exactly on a window boundary
+    (2, 1),       # one byte into the third window
+    (3, 1024),    # chunk-aligned tail
+    (4, 0),       # power-of-two windows, boundary end
+    (5, 16383),   # nearly-full tail window
+])
+def test_streaming_boundary_cases(mesh, n_windows, extra):
+    from spacedrive_tpu.ops.seqhash import StreamingShardedChecksum
+
+    window = 8 * 2 * 1024  # mesh D=8, shard_chunks=2
+    data = bytes(i % 251 for i in range(n_windows * window + extra))
+    h = StreamingShardedChecksum(mesh, shard_chunks=2)
+    h.update(data)
+    assert h.hexdigest() == blake3_hex(data)
+
+
+def test_streaming_small_stream_falls_back(mesh):
+    from spacedrive_tpu.ops.seqhash import StreamingShardedChecksum
+
+    for n in [0, 1, 4096]:
+        data = os.urandom(n)
+        h = StreamingShardedChecksum(mesh, shard_chunks=2)
+        h.update(data)
+        assert h.hexdigest() == blake3_hex(data)
+
+
+def test_streaming_counter_bases_are_global(mesh):
+    """Two same-bytes windows must produce different tops (chunk counters
+    differ) — a regression guard for the counter_base plumbing."""
+    from spacedrive_tpu.ops.seqhash import StreamingShardedChecksum
+
+    window = 8 * 2 * 1024
+    block = os.urandom(window)
+    h = StreamingShardedChecksum(mesh, shard_chunks=2)
+    h.update(block + block + b"tail")
+    assert h.hexdigest() == blake3_hex(block + block + b"tail")
+
+
+def test_streaming_file_checksum_bounded_memory(mesh, tmp_path):
+    """sharded_file_checksum streams a file bigger than one window."""
+    from spacedrive_tpu.ops.seqhash import sharded_file_checksum
+    from spacedrive_tpu.ops.blake3_batch import blake3_batch_np
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=3 * 8 * 256 * 1024 + 12345,
+                        dtype=np.uint8).tobytes()  # > 3 windows @ 2 MiB
+    p = tmp_path / "big.bin"
+    p.write_bytes(data)
+    got = sharded_file_checksum(mesh, str(p), shard_chunks=256)
+    assert got == blake3_batch_np([data])[0].hex()
