@@ -1,14 +1,27 @@
 """Round benchmark: batched CAS-ID generation throughput on device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 The workload is the FileIdentifierJob hot kernel (SURVEY.md §3.3): for a
 batch of large files, hash the 8-byte size prefix + 57,344 sampled bytes
 with BLAKE3 and truncate to 16 hex chars
-(/root/reference/core/src/object/cas.rs:23-62 semantics). `vs_baseline`
-is the speedup over the in-repo vectorized numpy CPU implementation of
-the identical algorithm — the measurable stand-in for the reference's CPU
-path (the reference publishes no numbers, BASELINE.md).
+(/root/reference/core/src/object/cas.rs:23-62 semantics).
+
+`vs_baseline` is the speedup over THIS REPO'S NATIVE C++ AVX2 PLANE
+(native/sdio.cpp `sd_blake3_many`, 8-way message-parallel AVX2 lanes) on
+the bench host's CPU — the honest stand-in for the reference's CPU path
+(the SIMD `blake3` crate behind cas.rs; the reference publishes no
+numbers, BASELINE.md). Round 1 compared against the repo's numpy
+fallback, which inflated the ratio ~8×; this baseline is the fastest
+CPU implementation in the repo.
+
+Timing methodology: the device number chains ITERS kernel executions
+inside one jitted scan with a loop-carried dependency, timed with a
+single D2H sync — per-call wall timing through the axon tunnel measures
+RPC latency, not the kernel (tools/perf_probe.py documents this). The
+kernel number excludes H2D; `h2d_gbps` and `e2e_overlapped_files_per_sec`
+(steady-state double-buffered pipeline = max(transfer, compute)) are
+reported alongside so the end-to-end story is explicit.
 """
 
 from __future__ import annotations
@@ -18,28 +31,46 @@ import time
 
 import numpy as np
 
+B = 2048
+ITERS = 20
+MSG_BYTES = 57352  # 8-byte size prefix + 57,344 sampled bytes
+
 
 def main() -> None:
-    from spacedrive_tpu.ops import blake3_batch as bb
     from spacedrive_tpu.ops import blake3_jax as bj
 
-    B = 2048
     rng = np.random.default_rng(0)
     payloads = rng.integers(0, 256, size=(B, 57344), dtype=np.uint8)
     sizes = rng.integers(200_000, 50_000_000, size=B).astype(np.uint64)
     words, lengths = bj.build_cas_messages(payloads, sizes)
 
-    # Device path (jit warms on the first call).
-    out = bj.blake3_words(words, lengths)
-    out.block_until_ready()
-    iters = 10
+    # Device path: pallas kernel on TPU (blake3_words dispatches), timed
+    # as ITERS chained executions inside one jit (see module docstring).
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def looped(w, l):
+        def body(acc, _):
+            out = bj._blake3_impl_best(w, l | (acc[0, 0] & 1).astype(l.dtype))
+            return out, None
+        acc, _ = lax.scan(body, jnp.zeros((B, 8), jnp.uint32),
+                          None, length=ITERS)
+        return acc
+
+    w = jax.device_put(words)
+    l = jax.device_put(lengths)
+    r = looped(w, l)
+    np.asarray(r.ravel()[0])  # compile + warm (block_until_ready lies on axon)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = bj.blake3_words(words, lengths)
-    out.block_until_ready()
-    device_fps = B * iters / (time.perf_counter() - t0)
+    r = looped(w, l)
+    np.asarray(r.ravel()[0])
+    t_kernel = (time.perf_counter() - t0) / ITERS
+    device_fps = B / t_kernel
 
     # Correctness spot check against the streaming oracle.
+    out = bj.blake3_words(words, lengths)
     cas_ids = bj.digests_to_cas_ids(out)
     from spacedrive_tpu.ops.cas import cas_id_of_payload
 
@@ -47,17 +78,50 @@ def main() -> None:
         expect = cas_id_of_payload(int(sizes[i]), payloads[i].tobytes())
         assert cas_ids[i] == expect, (i, cas_ids[i], expect)
 
-    # CPU baseline: same algorithm, vectorized numpy, smaller batch.
-    Bc = 128
+    # Honest CPU baseline: the repo's AVX2 C++ plane, same messages.
+    from spacedrive_tpu import native
+
+    if native.available():
+        lens = np.full(B, payloads.shape[1], np.int32)
+        native.blake3_many(payloads[:64], lens[:64], sizes[:64])  # warm
+        t0 = time.perf_counter()
+        nat_iters = 3
+        for _ in range(nat_iters):
+            native.blake3_many(payloads, lens, sizes)
+        cpu_fps = B * nat_iters / (time.perf_counter() - t0)
+        baseline_name = "native C++ AVX2 blake3_many (this repo, bench host CPU)"
+    else:  # no native build: fall back to numpy (and say so)
+        from spacedrive_tpu.ops import blake3_batch as bb
+
+        t0 = time.perf_counter()
+        bb.blake3_batch(np, words[:128], lengths[:128])
+        cpu_fps = 128 / (time.perf_counter() - t0)
+        baseline_name = "numpy batched blake3 (native plane unavailable)"
+
+    # H2D link + steady-state overlapped pipeline estimate.
     t0 = time.perf_counter()
-    bb.blake3_batch(np, words[:Bc], lengths[:Bc])
-    cpu_fps = Bc / (time.perf_counter() - t0)
+    for _ in range(3):
+        wx = jax.device_put(words)
+        np.asarray(wx.ravel()[0])
+    t_h2d = (time.perf_counter() - t0) / 3
+    e2e_fps = B / max(t_kernel, t_h2d)
+
+    # ~0.81M u32 elementwise ops per file (57×16 block compressions +
+    # 56 tree parents, ~840 ops each) vs a ~5e12 ops/s VPU estimate.
+    ops_per_file = (57 * 16 + 56) * 840
+    util = device_fps * ops_per_file / 5e12
 
     print(json.dumps({
         "metric": "cas_ids_per_sec_large_files",
         "value": round(device_fps, 1),
         "unit": "files/s",
         "vs_baseline": round(device_fps / cpu_fps, 2),
+        "baseline": baseline_name,
+        "baseline_files_per_sec": round(cpu_fps, 1),
+        "bytes_per_sec": round(device_fps * MSG_BYTES, 0),
+        "h2d_gbps": round(words.nbytes / t_h2d / 1e9, 2),
+        "e2e_overlapped_files_per_sec": round(e2e_fps, 1),
+        "vpu_utilization_est": round(util, 3),
     }))
 
 

@@ -1,13 +1,20 @@
-"""Embedded web explorer: the minimal L5 surface.
+"""Embedded web explorer: the L5 surface, grown toward the reference UI.
 
 The reference ships a full React frontend (interface/, 21k LoC) behind
 its rspc transport; this framework embeds a single-file explorer served
 at `/` by the server host so every core flow is drivable from a browser
-with zero build tooling: libraries (list/create), locations (add /
-full-rescan), path browsing with thumbnails over the custom_uri routes,
-live job progress via the websocket subscription plane, and the dedup
-analytics views. The page speaks the same `/rspc` protocol the TS client
-of the reference generates bindings for (packages/client).
+with zero build tooling. Views (mirroring interface/app/$libraryId/
+routes): Explorer grid with a file inspector panel (rename, favorite,
+note, tags, EXIF, delete/duplicate), global search (search.paths with
+name filter), tag manager + tag filtering, exact-duplicates view
+(search.duplicates — reclaimable space), near-duplicates view
+(search.nearDuplicates + the device-backed pHash detector job), a jobs
+console (reports, pause/resume/cancel, spawn validator/identifier/
+thumbnails/near-dup), a P2P panel (peers, pairing, spacedrop, ping),
+and settings (statistics, categories, volumes, key manager,
+backups, preferences, notifications). It speaks the same `/rspc`
+protocol the TS client of the reference generates bindings for
+(packages/client), exercising 40+ procedures.
 """
 
 INDEX_HTML = r"""<!doctype html>
@@ -17,24 +24,40 @@ INDEX_HTML = r"""<!doctype html>
 <title>spacedrive-tpu</title>
 <style>
   :root { color-scheme: dark; }
+  * { box-sizing: border-box; }
   body { font: 14px system-ui, sans-serif; margin: 0; background: #16161d;
          color: #e3e3ea; display: flex; height: 100vh; }
   #side { width: 230px; background: #1e1e28; padding: 12px;
           overflow-y: auto; flex-shrink: 0; }
+  #mainwrap { flex: 1; display: flex; flex-direction: column;
+              min-width: 0; }
+  #topbar { display: flex; gap: 6px; align-items: center; padding: 8px 16px;
+            background: #1a1a24; border-bottom: 1px solid #2c2c3a; }
+  #tabs { display: flex; gap: 2px; }
+  .tab { padding: 4px 10px; border-radius: 4px; cursor: pointer;
+         color: #8a8a99; }
+  .tab.sel { background: #2c2c3a; color: #e3e3ea; }
   #main { flex: 1; padding: 16px; overflow-y: auto; }
+  #inspector { width: 270px; background: #1e1e28; padding: 12px;
+               overflow-y: auto; flex-shrink: 0; display: none; }
+  #content { display: flex; flex: 1; min-height: 0; }
   h1 { font-size: 15px; margin: 0 0 10px; }
   h2 { font-size: 13px; text-transform: uppercase; color: #8a8a99;
        margin: 14px 0 6px; }
+  h3 { font-size: 13px; margin: 10px 0 4px; }
   button { background: #3b82f6; color: white; border: 0; border-radius: 4px;
-           padding: 4px 10px; cursor: pointer; margin: 2px 0; }
+           padding: 4px 10px; cursor: pointer; margin: 2px 2px 2px 0; }
   button.ghost { background: #2c2c3a; }
-  input { background: #12121a; color: #e3e3ea; border: 1px solid #333;
-          border-radius: 4px; padding: 4px 6px; }
+  button.danger { background: #b33; }
+  input, select { background: #12121a; color: #e3e3ea;
+          border: 1px solid #333; border-radius: 4px; padding: 4px 6px; }
   .item { padding: 4px 6px; border-radius: 4px; cursor: pointer; }
   .item:hover, .item.sel { background: #2c2c3a; }
   #grid { display: grid; grid-template-columns: repeat(auto-fill, 110px);
           gap: 10px; }
-  .cell { width: 110px; text-align: center; }
+  .cell { width: 110px; text-align: center; border-radius: 6px;
+          padding: 2px; }
+  .cell.sel { outline: 2px solid #3b82f6; }
   .cell .thumb { width: 100px; height: 80px; background: #22222e;
                  border-radius: 6px; display: flex; align-items: center;
                  justify-content: center; margin: 0 auto; overflow: hidden; }
@@ -42,10 +65,24 @@ INDEX_HTML = r"""<!doctype html>
   .cell .nm { font-size: 11px; word-break: break-all; margin-top: 3px; }
   #jobs { position: fixed; bottom: 0; right: 0; width: 320px;
           background: #1e1e28; padding: 8px 12px; border-radius: 8px 0 0 0;
-          max-height: 40vh; overflow-y: auto; }
+          max-height: 40vh; overflow-y: auto; z-index: 5; }
   .job { font-size: 12px; margin: 4px 0; }
   .bar { height: 4px; background: #2c2c3a; border-radius: 2px; }
   .bar > div { height: 4px; background: #3b82f6; border-radius: 2px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  td, th { border-bottom: 1px solid #2c2c3a; padding: 5px 8px;
+           text-align: left; }
+  .kv { font-size: 12px; margin: 2px 0; color: #b9b9c5;
+        word-break: break-all; }
+  .kv b { color: #e3e3ea; }
+  .tagchip { display: inline-block; border-radius: 10px; padding: 1px 8px;
+             margin: 2px; font-size: 11px; background: #2c2c3a;
+             cursor: pointer; }
+  .tagchip.on { background: #3b82f6; }
+  .muted { color: #8a8a99; font-size: 12px; }
+  #toast { position: fixed; bottom: 10px; left: 250px; background: #333;
+           color: #fff; padding: 6px 14px; border-radius: 6px;
+           display: none; z-index: 9; }
 </style>
 </head>
 <body>
@@ -57,12 +94,25 @@ INDEX_HTML = r"""<!doctype html>
   <h2>Locations</h2>
   <div id="locs"></div>
   <button id="newloc">+ location</button>
+  <h2>Tags</h2>
+  <div id="tags"></div>
+  <button id="newtag" class="ghost">+ tag</button>
+  <h2>Stats</h2>
+  <div id="stats" class="muted"></div>
 </div>
-<div id="main">
-  <div id="path" style="margin-bottom:10px;color:#8a8a99"></div>
-  <div id="grid"></div>
+<div id="mainwrap">
+  <div id="topbar">
+    <div id="tabs"></div>
+    <input id="search" placeholder="search names…" style="flex:1"/>
+    <button id="favbtn" class="ghost">★ favorites</button>
+  </div>
+  <div id="content">
+    <div id="main"></div>
+    <div id="inspector"></div>
+  </div>
 </div>
 <div id="jobs"><h2>Jobs</h2><div id="joblist"></div></div>
+<div id="toast"></div>
 <script>
 let reqId = 0, pending = {}, subs = {};
 const wsProto = location.protocol === "https:" ? "wss" : "ws";
@@ -92,8 +142,38 @@ async function sub(path, input, cb) {
   subs[id] = cb;
   ws.send(JSON.stringify({id, type: "subscription", path, input}));
 }
+function toast(msg) {
+  const t = document.getElementById("toast");
+  t.textContent = msg; t.style.display = "block";
+  clearTimeout(t._h); t._h = setTimeout(() => t.style.display = "none", 3000);
+}
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const fmtBytes = (n) => {
+  n = Number(n) || 0;
+  for (const u of ["B","KiB","MiB","GiB","TiB"]) {
+    if (n < 1024 || u === "TiB") return n.toFixed(u==="B"?0:1)+" "+u;
+    n /= 1024;
+  }
+};
 
-let lib = null, loc = null, curPath = "/";
+let lib = null, loc = null, curPath = "/", view = "explorer";
+let selected = null, tagFilter = null, favOnly = false, allTags = [];
+
+const TABS = [["explorer","Explorer"],["dups","Duplicates"],
+              ["neardups","Near-dups"],["jobs","Jobs"],["p2p","P2P"],
+              ["settings","Settings"]];
+function renderTabs() {
+  const el = document.getElementById("tabs"); el.innerHTML = "";
+  for (const [id, label] of TABS) {
+    const d = document.createElement("div");
+    d.className = "tab" + (view === id ? " sel" : "");
+    d.textContent = label;
+    d.onclick = () => { view = id; renderTabs(); render(); };
+    el.appendChild(d);
+  }
+}
+
 async function loadLibs() {
   const libs = await q("library.list");
   const el = document.getElementById("libs"); el.innerHTML = "";
@@ -101,11 +181,21 @@ async function loadLibs() {
     const d = document.createElement("div");
     d.className = "item" + (lib === l.uuid ? " sel" : "");
     d.textContent = l.config ? l.config.name : l.name;
-    d.onclick = () => { lib = l.uuid; loadLibs(); loadLocs(); };
+    d.onclick = () => { lib = l.uuid; loadAll(); };
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      if (confirm(`delete library "${d.textContent}"?`)) {
+        await mut("library.delete", {id: l.uuid});
+        if (lib === l.uuid) lib = null;
+        loadLibs();
+      }
+    };
     el.appendChild(d);
   }
-  if (!lib && libs.length) { lib = libs[0].uuid; loadLocs(); }
+  if (!lib && libs.length) { lib = libs[0].uuid; loadAll(); }
 }
+function loadAll() { loadLibs(); loadLocs(); loadTags(); loadStats(); render(); }
+
 async function loadLocs() {
   if (!lib) return;
   const locs = await q("locations.list", {library_id: lib});
@@ -114,50 +204,441 @@ async function loadLocs() {
     const d = document.createElement("div");
     d.className = "item" + (loc === l.id ? " sel" : "");
     d.textContent = l.name || l.path;
+    d.title = "click: open · right-click: rescan · shift-click: delete";
     d.oncontextmenu = async (e) => {
       e.preventDefault();
       await mut("locations.fullRescan", {library_id: lib, location_id: l.id});
+      toast("rescan started");
     };
-    d.onclick = () => { loc = l.id; curPath = "/"; browse(); loadLocs(); };
+    d.onclick = async (e) => {
+      if (e.shiftKey) {
+        if (confirm(`remove location ${d.textContent}?`)) {
+          await mut("locations.delete", {library_id: lib, id: l.id});
+          if (loc === l.id) loc = null;
+          loadLocs();
+        }
+        return;
+      }
+      loc = l.id; curPath = "/"; view = "explorer";
+      renderTabs(); render(); loadLocs();
+    };
     el.appendChild(d);
   }
 }
+
+async function loadTags() {
+  if (!lib) return;
+  allTags = await q("tags.list", {library_id: lib});
+  const el = document.getElementById("tags"); el.innerHTML = "";
+  for (const t of allTags) {
+    const d = document.createElement("span");
+    d.className = "tagchip" + (tagFilter === t.id ? " on" : "");
+    d.textContent = t.name;
+    if (t.color) d.style.borderLeft = `4px solid ${esc(t.color)}`;
+    d.onclick = () => {
+      tagFilter = tagFilter === t.id ? null : t.id; loadTags(); render();
+    };
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      if (confirm(`delete tag "${t.name}"?`)) {
+        await mut("tags.delete", {library_id: lib, id: t.id});
+        if (tagFilter === t.id) tagFilter = null;
+        loadTags();
+      }
+    };
+    el.appendChild(d);
+  }
+}
+
+async function loadStats() {
+  if (!lib) return;
+  const s = await q("library.statistics", {library_id: lib});
+  document.getElementById("stats").innerHTML =
+    `<div class="kv">paths: <b>${s.total_paths ?? s.file_paths ?? "?"}</b></div>` +
+    `<div class="kv">objects: <b>${s.total_objects ?? s.objects ?? "?"}</b></div>` +
+    `<div class="kv">bytes: <b>${fmtBytes(s.total_bytes_used ?? s.total_bytes ?? 0)}</b></div>`;
+}
+
+function render() {
+  document.getElementById("inspector").style.display = "none";
+  ({explorer: browse, dups: renderDups, neardups: renderNearDups,
+    jobs: renderJobs, p2p: renderP2P, settings: renderSettings}[view])();
+}
+
+// ---- Explorer --------------------------------------------------------
 async function browse() {
-  if (!lib || loc == null) return;
-  document.getElementById("path").textContent = `location ${loc} · ${curPath}`;
-  const rows = await q("search.paths", {
-    library_id: lib, take: 400,
-    filter: {location_id: loc, materialized_path: curPath},
-  });
-  const grid = document.getElementById("grid"); grid.innerHTML = "";
-  if (curPath !== "/") {
-    grid.appendChild(cell("..", null, true, () => {
+  const main = document.getElementById("main");
+  if (!lib || loc == null) { main.innerHTML =
+    "<div class='muted'>create a library and add a location</div>"; return; }
+  const searchText = document.getElementById("search").value.trim();
+  const filter = {location_id: loc};
+  if (searchText) filter.search = searchText;
+  else filter.materialized_path = curPath;
+  if (tagFilter != null) filter.tags = [tagFilter];
+  const [rows, count] = await Promise.all([
+    q("search.paths", {library_id: lib, take: 400, filter}),
+    q("search.pathsCount", {library_id: lib, filter}),
+  ]);
+  main.innerHTML =
+    `<div class="muted" style="margin-bottom:10px">location ${loc} · ` +
+    `${searchText ? `search "${esc(searchText)}"` : esc(curPath)} · ` +
+    `${count} paths</div><div id="grid"></div>`;
+  const grid = document.getElementById("grid");
+  if (!searchText && curPath !== "/") {
+    grid.appendChild(cell({name: "..", is_dir: 1}, () => {
       curPath = curPath.replace(/[^/]+\/$/, ""); browse();
     }));
   }
-  for (const r of (rows.items || rows)) {
-    const isDir = !!r.is_dir;
-    const name = r.name + (r.extension ? "." + r.extension : "");
-    grid.appendChild(cell(name, r.cas_id, isDir, () => {
-      if (isDir) { curPath = r.materialized_path + r.name + "/"; browse(); }
+  let items = rows.items || rows;
+  if (favOnly) {
+    const favs = await q("search.objects",
+      {library_id: lib, take: 500, filter: {favorite: true}});
+    const favIds = new Set((favs.items || []).map(o => o.id));
+    items = items.filter(r => favIds.has(r.object_id));
+  }
+  for (const r of items) {
+    grid.appendChild(cell(r, () => {
+      if (r.is_dir) {
+        curPath = r.materialized_path + r.name + "/";
+        document.getElementById("search").value = ""; browse();
+      } else inspect(r);
     }));
   }
 }
-function cell(name, cas, isDir, onclick) {
+function cell(r, onclick) {
   const c = document.createElement("div"); c.className = "cell";
+  if (selected && selected.id === r.id) c.className += " sel";
   const t = document.createElement("div"); t.className = "thumb";
-  if (cas) {
+  if (r.cas_id) {
     const img = document.createElement("img");
-    img.src = `/spacedrive/thumbnail/${cas}.webp`;
+    img.src = `/spacedrive/thumbnail/${r.cas_id}.webp`;
     img.onerror = () => { img.remove(); t.textContent = "🗎"; };
     t.appendChild(img);
-  } else t.textContent = isDir ? "📁" : "🗎";
+  } else t.textContent = r.is_dir ? "📁" : "🗎";
   const n = document.createElement("div"); n.className = "nm";
-  n.textContent = name;
+  n.textContent = r.name + (r.extension ? "." + r.extension : "");
   c.appendChild(t); c.appendChild(n);
   c.onclick = onclick;
   return c;
 }
+
+// ---- Inspector (file detail panel) -----------------------------------
+async function inspect(r) {
+  selected = r;
+  const el = document.getElementById("inspector");
+  el.style.display = "block";
+  const name = r.name + (r.extension ? "." + r.extension : "");
+  const size = r.size_in_bytes_bytes ? parseInt(r.size_in_bytes_bytes, 16) ||
+               r.size_in_bytes : r.size_in_bytes;
+  let html = `<h3>${esc(name)}</h3>` +
+    `<div class="kv">size: <b>${fmtBytes(size)}</b></div>` +
+    `<div class="kv">cas_id: <b>${esc(r.cas_id || "—")}</b></div>` +
+    `<div class="kv">object: <b>${r.object_id ?? "—"}</b></div>` +
+    `<div class="kv">path: <b>${esc(r.materialized_path)}</b></div>`;
+  let obj = null;
+  if (r.object_id != null) {
+    obj = await q("files.get", {library_id: lib, id: r.object_id});
+    if (obj) {
+      html += `<div class="kv">kind: <b>${obj.kind}</b></div>` +
+        `<div class="kv">note: <b>${esc(obj.note || "—")}</b></div>`;
+    }
+  }
+  html += `<div id="itags"></div><div id="iexif"></div>
+    <div style="margin-top:8px">
+      <button id="ifav" class="ghost">${obj && obj.favorite ? "★" : "☆"} favorite</button>
+      <button id="irename" class="ghost">rename</button>
+      <button id="inote" class="ghost">note</button>
+      <button id="idup" class="ghost">duplicate</button>
+      <button id="idel" class="danger">delete</button>
+    </div>`;
+  el.innerHTML = html;
+  if (r.object_id != null) {
+    const mine = await q("tags.getForObject",
+      {library_id: lib, object_id: r.object_id});
+    const mineIds = new Set(mine.map(t => t.id));
+    const tl = document.getElementById("itags");
+    tl.innerHTML = "<h3>tags</h3>";
+    for (const t of allTags) {
+      const chip = document.createElement("span");
+      chip.className = "tagchip" + (mineIds.has(t.id) ? " on" : "");
+      chip.textContent = t.name;
+      chip.onclick = async () => {
+        await mut("tags.assign", {library_id: lib, tag_id: t.id,
+          object_id: r.object_id, unassign: mineIds.has(t.id)});
+        inspect(r);
+      };
+      tl.appendChild(chip);
+    }
+    const md = await q("files.getMediaData", {library_id: lib,
+                                              id: r.object_id});
+    if (md) {
+      const ex = document.getElementById("iexif");
+      ex.innerHTML = "<h3>media data</h3>" +
+        Object.entries(md).filter(([k, v]) => v != null && k !== "phash" &&
+                                  k !== "object_id" && k !== "id")
+          .map(([k, v]) => `<div class="kv">${esc(k)}: <b>${esc(v)}</b></div>`)
+          .join("");
+    }
+  }
+  document.getElementById("ifav").onclick = async () => {
+    if (r.object_id == null) return toast("not identified yet");
+    await mut("files.setFavorite", {library_id: lib, id: r.object_id,
+      favorite: !(obj && obj.favorite)});
+    inspect(r);
+  };
+  document.getElementById("irename").onclick = async () => {
+    const nn = prompt("new name", name); if (!nn || nn === name) return;
+    try {
+      await mut("files.renameFile", {library_id: lib, file_path_id: r.id,
+        new_name: nn});
+      toast("renamed"); browse();
+    } catch (e) { toast(e.message); }
+  };
+  document.getElementById("inote").onclick = async () => {
+    if (r.object_id == null) return toast("not identified yet");
+    const note = prompt("note", obj && obj.note || "");
+    if (note === null) return;
+    await mut("files.setNote", {library_id: lib, id: r.object_id, note});
+    inspect(r);
+  };
+  document.getElementById("idup").onclick = async () => {
+    await mut("files.duplicateFiles", {library_id: lib, location_id: loc,
+      file_path_ids: [r.id]});
+    toast("duplicating…");
+  };
+  document.getElementById("idel").onclick = async () => {
+    if (!confirm(`delete ${name}?`)) return;
+    await mut("files.deleteFiles", {library_id: lib, location_id: loc,
+      file_path_ids: [r.id]});
+    el.style.display = "none"; selected = null;
+  };
+}
+
+// ---- Duplicates ------------------------------------------------------
+async function renderDups() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const groups = await q("search.duplicates",
+    {library_id: lib, location_id: loc});
+  const total = groups.reduce((a, g) => a + (g.reclaimable_bytes || 0), 0);
+  main.innerHTML = `<h3>Exact duplicates (by CAS ID)</h3>
+    <div class="muted">${groups.length} groups · ` +
+    `${fmtBytes(total)} reclaimable</div>
+    <table><tr><th>cas_id</th><th>copies</th><th>total</th>
+    <th>paths</th></tr>` +
+    groups.map(g => `<tr><td>${esc(g.cas_id)}</td><td>${g.count}</td>
+      <td>${fmtBytes(g.total_bytes)}</td>
+      <td class="muted">${g.paths.map(esc).join("<br>")}</td></tr>`).join("")
+    + "</table>";
+}
+
+// ---- Near-duplicates (device-backed analytics) -----------------------
+async function renderNearDups() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const pairs = await q("search.nearDuplicates",
+    {library_id: lib, max_distance: 10});
+  main.innerHTML = `<h3>Near-duplicate images (pHash Hamming ≤ 10)</h3>
+    <div style="margin:6px 0">
+      <button id="rundet">run detector on location ${loc ?? "—"}</button>
+      <span class="muted">batched DCT pHash + tiled Hamming all-pairs on
+      the device; LSH bucketing past 100k images</span></div>
+    <table><tr><th>distance</th><th>a</th><th>b</th></tr>` +
+    pairs.map(p => `<tr><td>${p.distance}</td>
+      <td class="muted">${p.paths_a.map(esc).join("<br>")}</td>
+      <td class="muted">${p.paths_b.map(esc).join("<br>")}</td></tr>`)
+      .join("") + "</table>";
+  document.getElementById("rundet").onclick = async () => {
+    if (loc == null) return toast("select a location first");
+    await mut("jobs.nearDupDetector", {library_id: lib, id: loc});
+    toast("near-dup detector started");
+  };
+}
+
+// ---- Jobs console ----------------------------------------------------
+const JSTATUS = {0:"queued",1:"running",2:"completed",3:"cancelled",
+                 4:"failed",5:"paused",6:"completed+errors"};
+async function renderJobs() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const reports = await q("jobs.reports", {library_id: lib});
+  main.innerHTML = `<h3>Jobs</h3>
+    <div style="margin:6px 0">
+      <button id="jid">identify</button>
+      <button id="jval">validate</button>
+      <button id="jverify" class="ghost">verify (bit-rot)</button>
+      <button id="jthumb" class="ghost">thumbnails</button>
+      <button id="jclear" class="ghost">clear finished</button>
+    </div>
+    <table><tr><th>name</th><th>status</th><th>progress</th><th>created</th>
+    <th></th></tr>` +
+    reports.map(j => {
+      const pct = j.task_count ?
+        Math.round(100 * (j.completed_task_count || 0) / j.task_count) : 0;
+      const running = j.status === 1, paused = j.status === 5;
+      return `<tr><td>${esc(j.name)}</td><td>${JSTATUS[j.status] ?? j.status}</td>
+        <td>${pct}% (${j.completed_task_count || 0}/${j.task_count || 0})</td>
+        <td class="muted">${new Date((j.date_created||0)*1000)
+          .toLocaleTimeString()}</td>
+        <td>${running ? `<button class="ghost" onclick="jobCtl('pause','${j.id}')">⏸</button>` : ""}
+            ${paused ? `<button class="ghost" onclick="jobCtl('resume','${j.id}')">▶</button>` : ""}
+            ${(running || paused) ? `<button class="danger" onclick="jobCtl('cancel','${j.id}')">✕</button>` : ""}
+        </td></tr>`;
+    }).join("") + "</table>";
+  const need = () => loc == null ? (toast("select a location"), false) : true;
+  document.getElementById("jid").onclick = async () =>
+    need() && (await mut("jobs.identifyUniqueFiles", {library_id: lib, id: loc}),
+               renderJobs());
+  document.getElementById("jval").onclick = async () =>
+    need() && (await mut("jobs.objectValidator", {library_id: lib, id: loc}),
+               renderJobs());
+  document.getElementById("jverify").onclick = async () =>
+    need() && (await mut("jobs.objectValidator",
+                         {library_id: lib, id: loc, mode: "verify"}),
+               renderJobs());
+  document.getElementById("jthumb").onclick = async () =>
+    need() && (await mut("jobs.generateThumbsForLocation",
+                         {library_id: lib, id: loc}), renderJobs());
+  document.getElementById("jclear").onclick = async () => {
+    await mut("jobs.clearAll", {library_id: lib}); renderJobs();
+  };
+}
+window.jobCtl = async (op, id) => {
+  await mut("jobs." + op, {library_id: lib, id});
+  renderJobs();
+};
+
+// ---- P2P -------------------------------------------------------------
+async function renderP2P() {
+  const main = document.getElementById("main");
+  const st = await q("p2p.state");
+  if (!st.enabled) {
+    main.innerHTML = "<div class='muted'>p2p is not started</div>"; return;
+  }
+  main.innerHTML = `<h3>P2P</h3>
+    <div class="kv">identity: <b>${esc(st.identity.slice(0, 24))}…</b>
+      · port <b>${st.port}</b></div>
+    <h3>Peers</h3>
+    <table><tr><th>identity</th><th>addr</th><th></th></tr>` +
+    st.peers.map(p => {
+      // Beacon payloads are peer-controlled: port must never reach
+      // innerHTML/onclick as a string (stored-XSS vector).
+      const port = Number(p.port) || 0;
+      return `<tr>
+      <td class="muted">${esc(p.identity.slice(0, 24))}…</td>
+      <td>${esc(p.addr)}:${port}</td>
+      <td><button class="ghost" onclick="p2pPing('${esc(p.addr)}',${port})">ping</button>
+          <button class="ghost" onclick="p2pPair('${esc(p.addr)}',${port})">pair</button>
+          <button onclick="p2pDrop('${esc(p.addr)}',${port})">spacedrop</button>
+      </td></tr>`;}).join("") + `</table>
+    <div class="muted" style="margin-top:8px">spacedrop sends an absolute
+    file path from this node; pairing joins the current library.</div>`;
+}
+window.p2pPing = async (addr, port) => {
+  try { await mut("p2p.debugPing", {addr, port}); toast("pong"); }
+  catch (e) { toast(e.message); }
+};
+window.p2pPair = async (addr, port) => {
+  try {
+    await mut("p2p.pair", {library_id: lib, addr, port});
+    toast("paired");
+  } catch (e) { toast(e.message); }
+};
+window.p2pDrop = async (addr, port) => {
+  const file_path = prompt("absolute path of file to send");
+  if (!file_path) return;
+  try {
+    await mut("p2p.spacedrop", {addr, port, file_path});
+    toast("spacedrop sent");
+  } catch (e) { toast(e.message); }
+};
+
+// ---- Settings --------------------------------------------------------
+async function renderSettings() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const [stats, cats, vols, keysSetup, backups, prefs] = await Promise.all([
+    q("library.statistics", {library_id: lib}),
+    q("categories.list", {library_id: lib}),
+    q("volumes.list"),
+    q("keys.isSetup", {library_id: lib}),
+    q("backups.getAll"),
+    q("preferences.get", {library_id: lib}),
+  ]);
+  const catRows = Object.entries(cats).filter(([, n]) => n > 0)
+    .map(([k, n]) => `<tr><td>${esc(k)}</td><td>${n}</td></tr>`).join("");
+  main.innerHTML = `<h3>Statistics</h3>` +
+    Object.entries(stats).map(([k, v]) =>
+      `<div class="kv">${esc(k)}: <b>${esc(v)}</b></div>`).join("") +
+    `<h3>Categories</h3><table>${catRows}</table>
+    <h3>Volumes</h3><table>` +
+    vols.map(v => `<tr><td>${esc(v.name || v.mount_point)}</td>
+      <td>${fmtBytes(v.available_capacity)} free of
+          ${fmtBytes(v.total_capacity)}</td></tr>`).join("") + `</table>
+    <h3>Key manager</h3><div id="keys"></div>
+    <h3>Backups</h3>
+    <div><button id="dobackup">backup library now</button></div>
+    <table>` + (backups.backups || backups).map(b =>
+      `<tr><td>${esc(b.id || b.path || JSON.stringify(b)).slice(0, 60)}</td>
+       <td class="muted">${esc(b.timestamp || b.date || "")}</td></tr>`)
+      .join("") + `</table>
+    <h3>Preferences</h3>
+    <div class="kv">stored keys: <b>${Object.keys(prefs || {}).length}</b>
+      <button id="setpref" class="ghost">set pref</button></div>
+    <h3>Notifications</h3>
+    <button id="notifytest" class="ghost">send test notification</button>`;
+
+  const keysEl = document.getElementById("keys");
+  if (!keysSetup) {
+    keysEl.innerHTML = `<button id="ksetup">set up key manager</button>`;
+    document.getElementById("ksetup").onclick = async () => {
+      const pw = prompt("master password"); if (!pw) return;
+      await mut("keys.setup", {library_id: lib, password: pw});
+      renderSettings();
+    };
+  } else {
+    const unlocked = await q("keys.isUnlocked", {library_id: lib});
+    if (!unlocked) {
+      keysEl.innerHTML = `<button id="kunlock">unlock</button>`;
+      document.getElementById("kunlock").onclick = async () => {
+        const pw = prompt("master password"); if (!pw) return;
+        try {
+          await mut("keys.unlock", {library_id: lib, password: pw});
+          renderSettings();
+        } catch (e) { toast(e.message); }
+      };
+    } else {
+      const keys = await q("keys.list", {library_id: lib});
+      keysEl.innerHTML = keys.map(k =>
+        `<div class="kv">${esc(k.uuid || k.id)} ` +
+        `${k.mounted ? "(mounted)" : ""}</div>`).join("") +
+        `<button id="kadd" class="ghost">add key</button>
+         <button id="klock" class="ghost">lock</button>`;
+      document.getElementById("kadd").onclick = async () => {
+        const pw = prompt("new key password"); if (!pw) return;
+        await mut("keys.add", {library_id: lib, password: pw});
+        renderSettings();
+      };
+      document.getElementById("klock").onclick = async () => {
+        await mut("keys.lock", {library_id: lib}); renderSettings();
+      };
+    }
+  }
+  document.getElementById("dobackup").onclick = async () => {
+    await mut("backups.backup", {library_id: lib});
+    toast("backup written"); renderSettings();
+  };
+  document.getElementById("setpref").onclick = async () => {
+    const k = prompt("preference key"); if (!k) return;
+    const v = prompt("value");
+    await mut("preferences.update", {library_id: lib, values: {[k]: v}});
+    renderSettings();
+  };
+  document.getElementById("notifytest").onclick = () =>
+    mut("notifications.test");
+}
+
+// ---- chrome wiring ---------------------------------------------------
 document.getElementById("newlib").onclick = async () => {
   const name = prompt("library name"); if (!name) return;
   await mut("library.create", {name}); lib = null; loadLibs();
@@ -167,6 +648,24 @@ document.getElementById("newloc").onclick = async () => {
   await mut("locations.create", {library_id: lib, path});
   loadLocs();
 };
+document.getElementById("newtag").onclick = async () => {
+  const name = prompt("tag name"); if (!name || !lib) return;
+  const color = prompt("color (css, optional)") || null;
+  await mut("tags.create", {library_id: lib, name, color});
+  loadTags();
+};
+document.getElementById("search").oninput = (() => {
+  let h; return () => { clearTimeout(h); h = setTimeout(() => {
+    if (view !== "explorer") { view = "explorer"; renderTabs(); }
+    browse();
+  }, 250); };
+})();
+document.getElementById("favbtn").onclick = () => {
+  favOnly = !favOnly;
+  document.getElementById("favbtn").className = favOnly ? "" : "ghost";
+  if (view === "explorer") browse();
+};
+
 sub("jobs.progress", null, (e) => {
   const el = document.getElementById("joblist");
   let row = document.getElementById("job-" + e.id);
@@ -185,8 +684,13 @@ sub("jobs.progress", null, (e) => {
     setTimeout(() => row.remove(), 4000);
 });
 sub("invalidation.listen", null, (e) => {
-  if (e.key === "search.paths") browse();
+  if (e.key === "search.paths" && view === "explorer") browse();
   if (e.key === "library.list") loadLibs();
+  if (e.key === "tags.list") loadTags();
+  if (e.key === "jobs.reports" && view === "jobs") renderJobs();
+});
+sub("notifications.listen", null, (e) => {
+  toast(`🔔 ${e.title || ""} ${e.content || e.message || ""}`);
 });
 sub("p2p.events", null, async (e) => {
   if (e.type === "SpacedropRequest") {
@@ -202,6 +706,7 @@ sub("p2p.events", null, async (e) => {
     await mut("p2p.acceptSpacedrop", {id: e.id, path});
   }
 });
+renderTabs();
 loadLibs();
 </script>
 </body>

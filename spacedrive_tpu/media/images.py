@@ -5,8 +5,9 @@ canonical RGB(A) image) and `convert_image` (decode + re-encode) route
 by extension through per-format handlers, behind a 192 MiB size guard
 (consts.rs:9). Handler availability is runtime-gated the way the
 reference feature-gates heif/pdfium: the generic raster path is PIL;
-HEIF decodes when a PIL HEIF plugin is importable; SVG rasterizes when
-a cairosvg-like renderer exists; PDF renders when pypdfium2 exists.
+HEIF decodes when a PIL HEIF plugin is importable; SVG rasterizes with
+the self-hosted pure-Python renderer (media/svg.py — the reference uses
+resvg, crates/images/src/svg.rs); PDF renders when pypdfium2 exists.
 Unavailable handlers raise `UnsupportedFormat` with the reason, so
 callers degrade per-file exactly like the reference's error path.
 """
@@ -22,8 +23,8 @@ SVG_TARGET_PX = 262_144.0         # consts.rs:31
 PDF_RENDER_WIDTH = 992            # consts.rs:37
 
 GENERIC_EXTENSIONS = {
-    "bmp", "dib", "ff", "gif", "ico", "jpg", "jpeg", "png", "pnm",
-    "qoi", "tga", "icb", "vda", "vst", "tiff", "tif", "webp",
+    "apng", "bmp", "dib", "ff", "gif", "ico", "jpg", "jpeg", "png",
+    "pnm", "qoi", "tga", "icb", "vda", "vst", "tiff", "tif", "webp",
 }
 SVG_EXTENSIONS = {"svg", "svgz"}
 PDF_EXTENSIONS = {"pdf"}
@@ -64,12 +65,7 @@ def _pdf_available() -> bool:
 
 
 def _svg_available() -> bool:
-    try:
-        import cairosvg  # noqa: F401
-
-        return True
-    except ImportError:
-        return False
+    return True  # self-hosted rasterizer (media/svg.py)
 
 
 def supported_extensions() -> List[str]:
@@ -77,8 +73,7 @@ def supported_extensions() -> List[str]:
     exts = sorted(GENERIC_EXTENSIONS)
     if _heif_available():
         exts += sorted(HEIF_EXTENSIONS)
-    if _svg_available():
-        exts += sorted(SVG_EXTENSIONS)
+    exts += sorted(SVG_EXTENSIONS)
     if _pdf_available():
         exts += sorted(PDF_EXTENSIONS)
     return exts
@@ -107,18 +102,9 @@ def format_image(path: str):
         im.load()
         return im
     if ext in SVG_EXTENSIONS:
-        if not _svg_available():
-            raise UnsupportedFormat(
-                f"{ext}: SVG rasterization needs cairosvg "
-                "(not present in this runtime)")
-        import io
+        from .svg import render_svg
 
-        import cairosvg
-        from PIL import Image
-
-        png = cairosvg.svg2png(url=path,
-                               output_width=int(SVG_TARGET_PX ** 0.5))
-        return Image.open(io.BytesIO(png))
+        return render_svg(path, target_px=SVG_TARGET_PX)
     if ext in PDF_EXTENSIONS:
         if not _pdf_available():
             raise UnsupportedFormat(

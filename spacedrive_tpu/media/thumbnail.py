@@ -21,10 +21,12 @@ WEBP_EXTENSION = "webp"
 VERSION_FILE = "version.txt"
 THUMBNAIL_CACHE_VERSION = 1
 
-# Image extensions PIL can thumbnail here (subset of the reference's
-# sd-images handlers — no HEIF/SVG/PDF codecs in this image).
+# Image extensions the sd-images dispatch can thumbnail here: the PIL
+# raster set plus SVG via the self-hosted rasterizer (media/svg.py);
+# HEIF/PDF remain runtime-gated on their decoders.
 THUMBNAILABLE_EXTENSIONS = {
     "jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico", "apng",
+    "svg", "svgz",
 }
 
 
@@ -68,8 +70,19 @@ def generate_thumbnail(input_path: str, data_dir: str,
         return out
     from PIL import Image
     try:
-        with Image.open(input_path) as im:
-            im = im.convert("RGB")
+        # Route through the sd-images dispatch so SVG (self-hosted
+        # rasterizer) and gated codecs work, not just PIL formats.
+        from .images import format_image
+
+        im = format_image(input_path)
+        try:
+            if im.mode == "RGBA":
+                # Composite transparency onto white like a file manager.
+                bg = Image.new("RGB", im.size, (255, 255, 255))
+                bg.paste(im, mask=im.split()[3])
+                im = bg
+            else:
+                im = im.convert("RGB")
             w, h = scale_dimensions(im.width, im.height)
             im = im.resize((w, h), Image.LANCZOS)
             os.makedirs(os.path.dirname(out), exist_ok=True)
@@ -77,6 +90,8 @@ def generate_thumbnail(input_path: str, data_dir: str,
             im.save(tmp, "WEBP", quality=TARGET_QUALITY)
             os.replace(tmp, out)
             return out
+        finally:
+            im.close() if hasattr(im, "close") else None
     except Exception:
         return None
 

@@ -57,9 +57,11 @@ def exact_duplicate_groups(library, location_id: Optional[int] = None,
             (r["cas_id"],))
         sizes = [int.from_bytes(p["size_in_bytes_bytes"] or b"", "big")
                  for p in paths]
+        pub = r["object_pub_id"]
         out.append({
             "cas_id": r["cas_id"],
-            "object_pub_id": r["object_pub_id"],
+            # hex, not raw bytes: this dict crosses the JSON-RPC surface
+            "object_pub_id": pub.hex() if isinstance(pub, bytes) else pub,
             "count": r["n"],
             "total_bytes": sum(sizes),
             "reclaimable_bytes": sum(sizes) - (sizes[0] if sizes else 0),
@@ -158,7 +160,7 @@ class NearDupDetectorJob(StatefulJob):
 
     def _compare_step(self, ctx: JobContext, data) -> StepOutcome:
         import numpy as np
-        from ..ops.hamming import near_dup_pairs, phash_bands
+        from ..ops.hamming import near_dup_pairs, near_dup_pairs_lsh
         db = ctx.db
         rows = db.query(
             "SELECT DISTINCT md.object_id AS object_id, md.phash AS phash "
@@ -171,17 +173,15 @@ class NearDupDetectorJob(StatefulJob):
         object_ids = [r["object_id"] for r in rows]
         digests = np.stack([phash_from_bytes(r["phash"]) for r in rows])
 
-        if len(rows) <= ALL_PAIRS_LIMIT:
+        from ..ops.blake3_pallas import supported as tpu_present
+        if len(rows) <= ALL_PAIRS_LIMIT or tpu_present():
+            # Exact — the two-pass device sweep holds to 1M+ digests
+            # (tools/near_dup_scale.py records runtime + recall=1).
             pairs = near_dup_pairs(digests, self.threshold)
         else:
-            # LSH bucket, then exact all-pairs inside each bucket.
-            pairs_set = set()
-            for _, idxs in phash_bands(digests).items():
-                sub = digests[idxs]
-                for a, b in near_dup_pairs(sub, self.threshold):
-                    i, j = idxs[a], idxs[b]
-                    pairs_set.add((min(i, j), max(i, j)))
-            pairs = sorted(pairs_set)
+            # No device at huge N: probabilistic LSH fallback (recall
+            # measured ~0.66 at threshold 10, see near_dup_pairs_lsh).
+            pairs = near_dup_pairs_lsh(digests, self.threshold)
 
         now = int(time.time())
         with db.tx() as conn:

@@ -172,13 +172,26 @@ class FileIdentifierJob(StatefulJob):
             f"SELECT COUNT(*) AS n FROM file_path WHERE {where}", params)["n"]
         if count == 0:
             raise EarlyFinish("no orphan file paths")
+        chunk = self.chunk_size
+        if self.device_batch is None and self.backend in ("auto", "jax"):
+            # Auto device engagement (VERDICT r1 item 3): big scans step
+            # in device-batch chunks when the link probe says the device
+            # pipeline beats the native plane (ops/staging.py policy).
+            from ..ops.staging import auto_device_batch
+
+            auto = auto_device_batch(count)
+            if auto is not None:
+                chunk = auto
         data = {
             "location_path": loc["path"],
             "sub_mat_path": sub_mat,
+            # The resolved step size rides in `data` so pause/resume
+            # replays use the same pagination the steps were counted for.
+            "chunk_size": chunk,
             "cursor": 0,
             "linked": 0, "created": 0, "skipped": 0, "total_orphans": count,
         }
-        steps = [{"chunk": i} for i in range(-(-count // self.chunk_size))]
+        steps = [{"chunk": i} for i in range(-(-count // chunk))]
         ctx.progress(task_count=len(steps),
                      message=f"identifying {count} orphan paths")
         return data, steps
@@ -191,7 +204,7 @@ class FileIdentifierJob(StatefulJob):
             self.location_id, data["cursor"], data["sub_mat_path"])
         rows = [dict(r) for r in ctx.db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
-            params + [self.chunk_size])]
+            params + [data.get("chunk_size") or self.chunk_size])]
         if not rows:
             return StepOutcome()
         linked, created, errors = identify_chunk(

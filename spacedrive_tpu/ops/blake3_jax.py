@@ -99,9 +99,35 @@ def _blake3_impl(words, lengths):
 
 
 @jax.jit
-def blake3_words(words, lengths):
-    """[B, C, 256] uint32 words + [B] int32 lengths → [B, 8] uint32 digests."""
+def _blake3_jnp_jit(words, lengths):
     return _blake3_impl(words, lengths)
+
+
+def _blake3_impl_best(words, lengths):
+    """Traceable best-backend body: pallas kernel on TPU, jnp scan
+    elsewhere. Usable inside an enclosing jit (bench harness loops)."""
+    from . import blake3_pallas
+
+    if blake3_pallas.supported():
+        return blake3_pallas.blake3_words_pallas(words, lengths)
+    return _blake3_impl(words, lengths)
+
+
+def blake3_words(words, lengths):
+    """[B, C, 256] uint32 words + [B] int32 lengths → [B, 8] uint32 digests.
+
+    Dispatches to the Pallas chunk-stage kernel on TPU (measured ~2×
+    the jnp scan path and ~8.5× the AVX2 C++ plane at batch 2048; see
+    ops/blake3_pallas.py) and to the jnp scan path elsewhere (CPU mesh
+    tests, hosts without a TPU). Digests are bit-identical across
+    backends — parity is pinned by tests/test_blake3_pallas.py and the
+    oracle vectors.
+    """
+    from . import blake3_pallas
+
+    if blake3_pallas.supported():
+        return blake3_pallas.blake3_words_pallas(words, lengths)
+    return _blake3_jnp_jit(words, lengths)
 
 
 def make_sharded_blake3(mesh, axis: str = "data"):

@@ -70,26 +70,22 @@ def near_dup_pairs(
     threshold: int,
     tile: int = 4096,
 ) -> List[Tuple[int, int]]:
-    """All (i < j) index pairs with Hamming distance ≤ threshold.
+    """All (i < j) index pairs with Hamming distance ≤ threshold. Exact.
 
-    Streams the upper triangle through [tile, tile] device blocks so N is
-    bounded by O(N·W) HBM, not N². Exact all-pairs — fine to ~100k
-    digests (≈ 300 tiles of 16M comparisons each at 4096); beyond that,
-    bucket with `phash_bands` first (SURVEY.md §7 hard-part 4).
+    One-tile batches run as a single masked call; anything larger
+    delegates to the two-pass tiled sweep (`near_dup_pairs_device`),
+    which keeps the whole tile grid inside one jit — per-tile dispatch
+    through the tunneled bench TPU costs ~2 s of RPC latency per tile,
+    which at 100k digests (325 tiles) measured ~700 s of pure overhead.
     """
     digests = np.ascontiguousarray(digests, dtype=np.uint32)
     N = digests.shape[0]
-    pairs: List[Tuple[int, int]] = []
-    for i0 in range(0, N, tile):
-        xi = digests[i0 : i0 + tile]
-        for j0 in range(i0, N, tile):
-            yj = digests[j0 : j0 + tile]
-            mask = np.asarray(_near_mask_tile(xi, yj, threshold))
-            if i0 == j0:
-                mask = np.triu(mask, k=1)
-            ii, jj = np.nonzero(mask)
-            pairs.extend(zip((ii + i0).tolist(), (jj + j0).tolist()))
-    return pairs
+    if N <= tile:
+        mask = np.triu(np.asarray(
+            _near_mask_tile(digests, digests, threshold)), k=1)
+        ii, jj = np.nonzero(mask)
+        return list(zip(ii.tolist(), jj.tolist()))
+    return near_dup_pairs_device(digests, threshold, tile=tile)
 
 
 def exact_dup_groups(ids: List[str]) -> Dict[str, List[int]]:
@@ -107,15 +103,265 @@ def exact_dup_groups(ids: List[str]) -> Dict[str, List[int]]:
 
 def phash_bands(digests: np.ndarray, n_bands: int = 4) -> Dict[tuple, List[int]]:
     """LSH banding: split each digest into bands; near-dups (small Hamming
-    distance) collide in at least one band with high probability. Use to
-    bucket >100k sets, then run exact near_dup_pairs per bucket."""
+    distance) collide in at least one band with high probability.
+
+    Fully vectorized (VERDICT r1 item 6): per band, the byte-slice is
+    zero-extended into a uint64 key, grouped with one argsort + boundary
+    scan — no per-row Python. Returns {(band, key): [indexes]} for
+    buckets with > 1 member.
+    """
     digests = np.ascontiguousarray(digests, dtype=np.uint32)
     N, W = digests.shape
     bits = digests.view(np.uint8).reshape(N, W * 4)
     per = max(1, (W * 4) // n_bands)
+    assert per <= 8, "band wider than a uint64 key; raise n_bands"
     buckets: Dict[tuple, List[int]] = {}
     for b in range(n_bands):
         band = bits[:, b * per : (b + 1) * per]
-        for i in range(N):
-            buckets.setdefault((b, band[i].tobytes()), []).append(i)
-    return {k: v for k, v in buckets.items() if len(v) > 1}
+        keys = np.zeros((N, 8), dtype=np.uint8)
+        keys[:, : band.shape[1]] = band
+        keys = keys.view("<u8").ravel()
+        order = np.argsort(keys, kind="stable")
+        sk = keys[order]
+        # Boundaries of equal-key runs; keep runs of length > 1.
+        starts = np.flatnonzero(np.r_[True, sk[1:] != sk[:-1]])
+        ends = np.r_[starts[1:], N]
+        for s, e in zip(starts, ends):
+            if e - s > 1:
+                buckets[(b, int(sk[s]))] = order[s:e].tolist()
+    return buckets
+
+
+def lsh_candidate_pairs(digests: np.ndarray, n_bands: int = 4,
+                        max_bucket: int = 4096) -> np.ndarray:
+    """Unique candidate (i < j) pairs from LSH banding, as an [P, 2] array.
+
+    Buckets larger than `max_bucket` (degenerate keys — e.g. thousands of
+    identical digests) are truncated to their first `max_bucket` members
+    to bound P at O(sum k²); truncated members still pair with the kept
+    ones, and identical digests are exact dups the CAS pass already
+    catches.
+    """
+    out = []
+    for (_, _), idxs in phash_bands(digests, n_bands).items():
+        k = min(len(idxs), max_bucket)
+        a = np.asarray(idxs[:k], dtype=np.int64)
+        ii, jj = np.triu_indices(k, k=1)
+        lo = np.minimum(a[ii], a[jj])
+        hi = np.maximum(a[ii], a[jj])
+        out.append(np.stack([lo, hi], axis=1))
+    if not out:
+        return np.zeros((0, 2), dtype=np.int64)
+    pairs = np.concatenate(out, axis=0)
+    # Dedup across bands: pack to one uint64 key per pair.
+    packed = (pairs[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | pairs[:, 1].astype(np.uint64)
+    packed = np.unique(packed)
+    return np.stack([(packed >> np.uint64(32)).astype(np.int64),
+                     (packed & np.uint64(0xFFFFFFFF)).astype(np.int64)],
+                    axis=1)
+
+
+def pair_distances(digests: np.ndarray, pairs: np.ndarray,
+                   chunk: int = 1 << 20) -> np.ndarray:
+    """Hamming distance for each (i, j) row of `pairs` — vectorized
+    XOR + popcount in bounded chunks, [P] int32."""
+    digests = np.ascontiguousarray(digests, dtype=np.uint32)
+    out = np.zeros((len(pairs),), dtype=np.int32)
+    for s in range(0, len(pairs), chunk):
+        p = pairs[s : s + chunk]
+        x = digests[p[:, 0]] ^ digests[p[:, 1]]
+        out[s : s + chunk] = np.bitwise_count(x).sum(axis=1)
+    return out
+
+
+def near_dup_pairs_lsh(digests: np.ndarray, threshold: int,
+                       n_bands: int = 4) -> List[Tuple[int, int]]:
+    """CPU fallback for beyond-all-pairs scale: LSH candidates + one
+    vectorized distance pass. Probabilistic recall — a pair at distance
+    d ≤ threshold is found iff some 16-bit band matches exactly, which
+    for uniformly-spread d=10 flips is only ~25% per pair (measured:
+    0.66 planted recall at 1M with the 0..10 flip mixture —
+    tools/near_dup_scale.py records it per run). The device path
+    (`near_dup_pairs_device`) is EXACT at the same scale and is what the
+    near-dup job uses whenever a TPU is present; this survives only as
+    the no-device fallback."""
+    pairs = lsh_candidate_pairs(digests, n_bands)
+    if not len(pairs):
+        return []
+    d = pair_distances(digests, pairs)
+    keep = pairs[d <= threshold]
+    return [(int(i), int(j)) for i, j in keep]
+
+
+# ---------------------------------------------------------------------------
+# Exact all-pairs at 1M: two single-dispatch device passes on the MXU.
+#
+# Two ideas make this exact search feasible where the naive loop dies:
+#
+# 1. One dispatch per pass, not per tile. Per-tile jit calls pay a
+#    host→device round trip each — 325 calls for 100k digests measured
+#    ~700 s of pure RPC latency through the tunneled bench TPU. Both
+#    passes here sweep their whole tile grid INSIDE one jit.
+#
+# 2. Hamming distance as a matmul. With each bit mapped to ±1,
+#    dot(s_x, s_y) = BITS - 2·hamming(x, y), so the [T, T] distance
+#    tile is one [T, BITS] @ [BITS, T] product — MXU work at int-exact
+#    bf16/f32, ~100× the VPU XOR+popcount formulation. The sum of 64
+#    ±1 terms is exact in f32, so thresholding is still exact.
+#
+#   pass 1: full tile grid → per-tile count of (i < j) pairs ≤
+#           threshold ([NT, NT] int32, a few KB out).
+#   pass 2: only the flagged tiles (host-chosen list, static shape) →
+#           per-tile pair coordinates, padded to the max count.
+# The N×N distance matrix never exists (O(tile²) working set).
+
+
+def _bit_planes(digests) -> jnp.ndarray:
+    """[N, W] uint32 → [N, W*32] bf16 of ±1 (bit b of word w at column
+    w*32+b)."""
+    n, w = digests.shape
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (digests[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    return (bits.astype(jnp.bfloat16) * 2 - 1).reshape(n, w * 32)
+
+
+def _pair_mask(dots, i, j, T, bits: int, threshold: int, n: int):
+    """dots [T, T] f32 → boolean mask of in-range (global i < j) pairs."""
+    gi = i * T + jnp.arange(T, dtype=jnp.int32)
+    gj = j * T + jnp.arange(T, dtype=jnp.int32)
+    return ((dots >= bits - 2 * threshold)
+            & (gi[:, None] < gj[None, :])
+            & (gi[:, None] < n) & (gj[None, :] < n))
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _tile_counts_block(planes, row0, threshold, n, block: int):
+    """Pair counts for `block` consecutive row-tiles starting at row0.
+
+    planes: [NT, T, BITS] ±1 bf16 → [block, NT] int32. `threshold`/`n`/
+    `row0` are traced scalars so one compilation serves every dataset of
+    the same tile grid (a fresh compile per library size measured
+    ~100 s through the tunnel — the matmul sweep itself is ~0.1 s warm
+    for 100k digests). The sweep is dispatched in row blocks because
+    the tunneled TPU worker kills single programs that run for minutes
+    (a full 1M sweep is ~60k matmuls — one program crashed the worker);
+    rows past NT clamp to the last tile and are discarded by the host.
+    """
+    NT, T, BITS = planes.shape
+
+    def row(k):
+        i = jnp.minimum(row0 + k, NT - 1)
+        x = jax.lax.dynamic_index_in_dim(planes, i, keepdims=False)
+
+        def col(j):
+            dots = jax.lax.dot_general(
+                x, planes[j], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return jnp.sum(_pair_mask(dots, i, j, T, BITS, threshold, n),
+                           dtype=jnp.int32)
+
+        return jax.lax.map(col, jnp.arange(NT))
+
+    return jax.lax.map(row, jnp.arange(block))
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _tile_extract(planes, flagged, threshold, n, cap: int):
+    """flagged: [F, 2] int32 tile coords → ([F, cap, 2] global pair
+    indexes, [F] counts); unused slots are (-1, -1). Only `cap` (the
+    nonzero-extraction size) must be static — callers round it up to a
+    power of two so compilations stay bucketed."""
+    NT, T, BITS = planes.shape
+
+    def one(ij):
+        i, j = ij[0], ij[1]
+        x = jax.lax.dynamic_index_in_dim(planes, i, keepdims=False)
+        y = jax.lax.dynamic_index_in_dim(planes, j, keepdims=False)
+        dots = jax.lax.dot_general(
+            x, y, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ok = _pair_mask(dots, i, j, T, BITS, threshold, n)
+        ii, jj = jnp.nonzero(ok.reshape(T, T), size=cap, fill_value=-1)
+        valid = ii >= 0
+        pi = jnp.where(valid, i * T + ii, -1)
+        pj = jnp.where(valid, j * T + jj, -1)
+        return jnp.stack([pi, pj], axis=1), jnp.sum(ok, dtype=jnp.int32)
+
+    return jax.lax.map(one, flagged)
+
+
+# Row-tiles per counts dispatch and flagged tiles per extract dispatch:
+# sized so one dispatch stays well under the tunnel worker's runtime
+# tolerance (~a few thousand [T,T] matmul tiles).
+COUNT_ROWS_PER_DISPATCH = 16
+EXTRACT_TILES_PER_DISPATCH = 256
+# Extraction output budget per dispatch (int32 pairs) and the per-tile
+# truncation bound. One tile of m identical digests holds ~m²/2 pairs
+# (m=4096 → 8M) — a degenerate cluster the CAS exact-dup pass already
+# covers; capping mirrors lsh_candidate_pairs' max_bucket truncation.
+EXTRACT_BUDGET_ELEMS = 32 << 20
+MAX_PAIRS_PER_TILE = 1 << 20
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def near_dup_pairs_device(digests: np.ndarray, threshold: int,
+                          tile: int = 4096) -> List[Tuple[int, int]]:
+    """Exact all-pairs (i < j, distance ≤ threshold) at large N on the
+    device — a bounded number of jit dispatches, each sweeping thousands
+    of tiles (see block comment above). Returns the same pairs as
+    `near_dup_pairs`, validated at 1M by tools/near_dup_scale.py.
+
+    Exactness caveat: a single tile holding more than MAX_PAIRS_PER_TILE
+    (1M) qualifying pairs — a ≥ ~1450-wide cluster of near-identical
+    digests — has its extraction truncated to the cap; such clusters are
+    degenerate for near-dup reporting (the UI shows pairs) and their
+    exact-equality core is already collapsed by the CAS dedup pass."""
+    digests = np.ascontiguousarray(digests, dtype=np.uint32)
+    N, W = digests.shape
+    if N < 2:
+        return []
+    NT = -(-N // tile)
+    padded = np.zeros((NT * tile, W), dtype=np.uint32)
+    padded[:N] = digests
+    planes = _bit_planes(jnp.asarray(padded)).reshape(NT, tile, W * 32)
+
+    thr = jnp.int32(threshold)
+    nn = jnp.int32(N)
+    blocks = []
+    for r0 in range(0, NT, COUNT_ROWS_PER_DISPATCH):
+        blk = np.asarray(_tile_counts_block(
+            planes, jnp.int32(r0), thr, nn, COUNT_ROWS_PER_DISPATCH))
+        blocks.append(blk[: NT - r0])
+    counts = np.concatenate(blocks, axis=0)
+
+    flagged = np.argwhere(counts > 0).astype(np.int32)
+    if len(flagged) == 0:
+        return []
+    # Extract densest tiles first with a per-chunk cap: a single global
+    # cap sized to the worst tile would allocate [chunk, cap, 2] for
+    # every dispatch (a 4096-wide identical-digest cluster → 17 GB).
+    tile_counts = counts[flagged[:, 0], flagged[:, 1]]
+    order = np.argsort(-tile_counts)
+    flagged = flagged[order]
+    tile_counts = tile_counts[order]
+    out = []
+    f0 = 0
+    while f0 < len(flagged):
+        cap = _pow2(min(int(tile_counts[f0]), MAX_PAIRS_PER_TILE))
+        width = min(EXTRACT_TILES_PER_DISPATCH,
+                    max(1, EXTRACT_BUDGET_ELEMS // cap),
+                    len(flagged) - f0)
+        fpad = _pow2(width)  # pad tile list: (F, cap) compile buckets
+        chunk = np.zeros((fpad, 2), dtype=np.int32)
+        chunk[:width] = flagged[f0 : f0 + width]
+        pairs_dev, _ = _tile_extract(planes, jnp.asarray(chunk),
+                                     thr, nn, cap)
+        out.append(np.asarray(pairs_dev[:width]).reshape(-1, 2))
+        f0 += width
+    pairs = np.concatenate(out, axis=0)
+    pairs = pairs[pairs[:, 0] >= 0]
+    return [(int(i), int(j)) for i, j in pairs]

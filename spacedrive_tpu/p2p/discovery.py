@@ -104,8 +104,14 @@ class Discovery:
                 return
         except Exception:
             return
+        # Beacon payloads are peer-controlled even when signed (any LAN
+        # host signs with its own key): validate shape before the peer
+        # record reaches API consumers (the web UI renders it).
+        port = body.get("port")
+        if not isinstance(port, int) or not (0 < port < 65536):
+            return
         is_new = remote not in self.peers
-        peer = DiscoveredPeer(remote, addr[0], body["port"],
+        peer = DiscoveredPeer(remote, addr[0], port,
                               body.get("metadata") or {})
         self.peers[remote] = peer
         if is_new and self.on_discovered:

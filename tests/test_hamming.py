@@ -70,3 +70,111 @@ def test_phash_bands_bucket_near_dups():
     d = _digests_from_u64([a, b, far])
     buckets = phash_bands(d, n_bands=4)
     assert any(set(v) >= {0, 1} for v in buckets.values())
+
+
+# -- LSH at scale (VERDICT r1 item 6) ---------------------------------------
+
+
+def test_phash_bands_vectorized_grouping():
+    from spacedrive_tpu.ops.hamming import phash_bands
+
+    rng = np.random.default_rng(3)
+    d = rng.integers(0, 2**32, size=(500, 2), dtype=np.uint32)
+    d[100] = d[7]  # identical rows collide in every band
+    buckets = phash_bands(d)
+    joint = [set(v) for v in buckets.values()]
+    assert any({7, 100} <= s for s in joint)
+    for (b, _), idxs in buckets.items():
+        assert 0 <= b < 4 and len(idxs) > 1
+
+
+def test_lsh_candidates_unique_and_ordered():
+    from spacedrive_tpu.ops.hamming import lsh_candidate_pairs
+
+    rng = np.random.default_rng(4)
+    d = rng.integers(0, 2**32, size=(1000, 2), dtype=np.uint32)
+    d[10] = d[500] = d[900]  # three-way identical: 3 pairs, deduped
+    pairs = lsh_candidate_pairs(d)
+    assert (pairs[:, 0] < pairs[:, 1]).all()
+    packed = pairs[:, 0] * (1 << 32) + pairs[:, 1]
+    assert len(np.unique(packed)) == len(packed)
+    got = {tuple(p) for p in pairs.tolist()}
+    assert {(10, 500), (10, 900), (500, 900)} <= got
+
+
+def test_lsh_matches_exact_on_planted_neighbors():
+    """Production path: near_dup_pairs_lsh finds planted near-dups and
+    never reports a pair beyond the threshold."""
+    from spacedrive_tpu.ops.hamming import near_dup_pairs, near_dup_pairs_lsh
+
+    rng = np.random.default_rng(5)
+    d = rng.integers(0, 2**32, size=(5000, 2), dtype=np.uint32)
+    planted = []
+    for k in range(50):
+        i, j = 2 * k, 2500 + 2 * k
+        d[j] = d[i]
+        for b in rng.choice(64, size=int(rng.integers(0, 6)), replace=False):
+            d[j, b // 32] ^= np.uint32(1) << np.uint32(b % 32)
+        planted.append((min(i, j), max(i, j)))
+
+    exact = set(near_dup_pairs(d, threshold=10))
+    lsh = set(near_dup_pairs_lsh(d, threshold=10))
+    assert lsh <= exact  # no false positives (distances re-checked)
+    found = sum(1 for p in planted if p in lsh)
+    assert found >= int(0.9 * len(planted)), found  # high recall
+
+
+def test_lsh_max_bucket_truncation_bounds_pairs():
+    from spacedrive_tpu.ops.hamming import lsh_candidate_pairs
+
+    d = np.zeros((10_000, 2), dtype=np.uint32)  # one degenerate bucket
+    pairs = lsh_candidate_pairs(d, max_bucket=64)
+    assert len(pairs) == 64 * 63 // 2
+
+
+def test_device_two_pass_matches_bruteforce():
+    """near_dup_pairs_device (the exact two-pass sweep) vs numpy brute
+    force on a multi-tile batch with planted neighbors and padding."""
+    from spacedrive_tpu.ops.hamming import near_dup_pairs_device
+
+    rng = np.random.default_rng(9)
+    N = 700  # 3 tiles at tile=256, with a ragged tail
+    d = rng.integers(0, 2**32, size=(N, 2), dtype=np.uint32)
+    for k in range(20):
+        i, j = k, 350 + k
+        d[j] = d[i]
+        for b in rng.choice(64, size=int(rng.integers(0, 8)), replace=False):
+            d[j, b // 32] ^= np.uint32(1) << np.uint32(b % 32)
+
+    xor = d[:, None, :] ^ d[None, :, :]
+    dist = np.bitwise_count(xor).sum(axis=-1)
+    ii, jj = np.nonzero(np.triu(dist <= 10, k=1))
+    want = set(zip(ii.tolist(), jj.tolist()))
+
+    got = set(near_dup_pairs_device(d, threshold=10, tile=256))
+    assert got == want
+
+
+def test_near_dup_pairs_delegates_multi_tile():
+    from spacedrive_tpu.ops.hamming import near_dup_pairs
+
+    rng = np.random.default_rng(10)
+    d = rng.integers(0, 2**32, size=(300, 2), dtype=np.uint32)
+    d[250] = d[10]  # distance 0 across tiles at tile=128
+    pairs = near_dup_pairs(d, threshold=0, tile=128)
+    assert (10, 250) in pairs
+
+
+def test_device_extract_chunks_by_density():
+    """A dense cluster tile and sparse tiles extract with per-chunk caps
+    (regression: one global cap sized every dispatch to the worst tile)."""
+    from spacedrive_tpu.ops.hamming import near_dup_pairs_device
+
+    rng = np.random.default_rng(12)
+    d = rng.integers(0, 2**32, size=(600, 2), dtype=np.uint32)
+    d[0:80] = d[0]        # dense identical cluster: 3160 pairs in tile 0
+    d[300] = d[550]       # one sparse cross-tile pair
+    got = set(near_dup_pairs_device(d, threshold=0, tile=256))
+    want = {(i, j) for i in range(80) for j in range(i + 1, 80)}
+    want.add((300, 550))
+    assert got == want
