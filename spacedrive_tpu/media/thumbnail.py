@@ -21,12 +21,13 @@ WEBP_EXTENSION = "webp"
 VERSION_FILE = "version.txt"
 THUMBNAIL_CACHE_VERSION = 1
 
-# Image extensions the sd-images dispatch can thumbnail here: the PIL
-# raster set plus SVG via the self-hosted rasterizer (media/svg.py);
-# HEIF/PDF remain runtime-gated on their decoders.
+# Extensions the media dispatch can thumbnail here: the PIL raster set,
+# SVG via the self-hosted rasterizer (media/svg.py), and MJPEG `.avi`
+# via the self-hosted container parser (media/mjpeg.py — other video
+# codecs need the ffmpeg gate); HEIF/PDF remain runtime-gated.
 THUMBNAILABLE_EXTENSIONS = {
     "jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico", "apng",
-    "svg", "svgz",
+    "svg", "svgz", "avi",
 }
 
 
@@ -60,6 +61,28 @@ def scale_dimensions(w: float, h: float,
     return max(1, round(w * ratio)), max(1, round(h * ratio))
 
 
+def encode_webp(im, out_path: str,
+                target_px: float = TARGET_PX) -> str:
+    """RGB(A)-composite → scale → atomic webp write (the shared tail of
+    every thumbnail path: images, SVG, video frames)."""
+    from PIL import Image
+
+    if im.mode == "RGBA":
+        # Composite transparency onto white like a file manager.
+        bg = Image.new("RGB", im.size, (255, 255, 255))
+        bg.paste(im, mask=im.split()[3])
+        im = bg
+    else:
+        im = im.convert("RGB")
+    w, h = scale_dimensions(im.width, im.height, target_px)
+    im = im.resize((w, h), Image.LANCZOS)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    tmp = out_path + ".tmp"
+    im.save(tmp, "WEBP", quality=TARGET_QUALITY)
+    os.replace(tmp, out_path)
+    return out_path
+
+
 def generate_thumbnail(input_path: str, data_dir: str,
                        cas_id: str) -> Optional[str]:
     """Decode → scale → webp encode → sharded cache. Returns the output
@@ -68,7 +91,13 @@ def generate_thumbnail(input_path: str, data_dir: str,
     out = thumbnail_path(data_dir, cas_id)
     if os.path.exists(out):
         return out
-    from PIL import Image
+    from .video import MJPEG_EXTENSIONS
+
+    ext = os.path.splitext(input_path)[1].lstrip(".").lower()
+    if ext in MJPEG_EXTENSIONS:
+        from .video import generate_video_thumbnail
+
+        return generate_video_thumbnail(input_path, out)
     try:
         # Route through the sd-images dispatch so SVG (self-hosted
         # rasterizer) and gated codecs work, not just PIL formats.
@@ -76,20 +105,7 @@ def generate_thumbnail(input_path: str, data_dir: str,
 
         im = format_image(input_path)
         try:
-            if im.mode == "RGBA":
-                # Composite transparency onto white like a file manager.
-                bg = Image.new("RGB", im.size, (255, 255, 255))
-                bg.paste(im, mask=im.split()[3])
-                im = bg
-            else:
-                im = im.convert("RGB")
-            w, h = scale_dimensions(im.width, im.height)
-            im = im.resize((w, h), Image.LANCZOS)
-            os.makedirs(os.path.dirname(out), exist_ok=True)
-            tmp = out + ".tmp"
-            im.save(tmp, "WEBP", quality=TARGET_QUALITY)
-            os.replace(tmp, out)
-            return out
+            return encode_webp(im, out)
         finally:
             im.close() if hasattr(im, "close") else None
     except Exception:

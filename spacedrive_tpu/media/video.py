@@ -1,12 +1,16 @@
-"""Video thumbnailing via the ffmpeg CLI (capability-gated).
+"""Video thumbnailing: ffmpeg CLI when present, self-hosted MJPEG-AVI
+always.
 
 The reference's sd-ffmpeg crate drives raw ffmpeg FFI: seek to 10% of
 the stream, decode one frame, scale, encode webp
 (/root/reference/crates/ffmpeg/src/thumbnailer.rs:11-161,
 movie_decoder.rs:32). This runtime image ships no ffmpeg binary or
 libraries, so the same contract is implemented over the `ffmpeg`/
-`ffprobe` CLIs when present and degrades to None when not —
-`available()` gates the media pipeline's video branch.
+`ffprobe` CLIs when present — and for Motion-JPEG `.avi` files the
+container is parsed directly (media/mjpeg.py) so the video-thumbnail
+path actually executes here: seek to the frame at 10%, decode the JPEG
+with PIL, scale, encode webp. Other codecs degrade to None without
+ffmpeg, exactly like the reference degrades on MovieDecoder errors.
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ from typing import Optional
 from .thumbnail import TARGET_QUALITY, scale_dimensions
 
 SEEK_PERCENTAGE = 0.10  # thumbnailer.rs seek to 10%
+# Containers the self-hosted MJPEG parser handles without ffmpeg.
+MJPEG_EXTENSIONS = {"avi"}
 VIDEO_EXTENSIONS = {
     "mp4", "mkv", "mov", "avi", "webm", "m4v", "mpg", "mpeg", "wmv",
     "flv", "3gp", "ts", "mts", "m2ts", "ogv",
@@ -47,15 +53,44 @@ def probe_duration(path: str) -> Optional[float]:
         return None
 
 
+def _mjpeg_thumbnail(input_path: str, out_path: str,
+                     target_px: float) -> Optional[str]:
+    """ffmpeg-free path: extract the 10% frame of an MJPEG AVI and webp
+    it (media/mjpeg.py). Returns None for non-MJPEG containers."""
+    import io
+
+    from PIL import Image
+
+    from .mjpeg import frame_at_fraction
+    from .thumbnail import encode_webp
+
+    try:
+        jpeg = frame_at_fraction(input_path, SEEK_PERCENTAGE)
+        if jpeg is None:
+            return None
+        with Image.open(io.BytesIO(jpeg)) as im:
+            return encode_webp(im, out_path, target_px)
+    except Exception:
+        return None
+
+
+def _is_mjpeg_candidate(path: str) -> bool:
+    return (os.path.splitext(path)[1].lstrip(".").lower()
+            in MJPEG_EXTENSIONS)
+
+
 def generate_video_thumbnail(input_path: str, out_path: str,
                              target_px: float = 262144.0
                              ) -> Optional[str]:
     """Seek 10%, grab one frame, scale to ~target_px, encode webp.
 
-    Returns out_path on success, None when ffmpeg is missing or the
+    Returns out_path on success, None when no decoder applies or the
     decode fails (the caller records no thumbnail, as the reference does
-    on MovieDecoder errors)."""
+    on MovieDecoder errors). MJPEG `.avi` decodes without ffmpeg — and
+    is also the fallback when an installed ffmpeg fails on one."""
     if not available():
+        if _is_mjpeg_candidate(input_path):
+            return _mjpeg_thumbnail(input_path, out_path, target_px)
         return None
     duration = probe_duration(input_path) or 0.0
     seek = duration * SEEK_PERCENTAGE
@@ -64,11 +99,12 @@ def generate_video_thumbnail(input_path: str, out_path: str,
     tmp = out_path + ".tmp"
     try:
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        # -f webp: the muxer cannot be inferred from the ".tmp" name.
         subprocess.run(
             ["ffmpeg", "-v", "quiet", "-ss", f"{seek:.3f}",
              "-i", input_path, "-frames:v", "1",
              "-vf", f"scale='min({w},iw)':-2",
-             "-quality", str(TARGET_QUALITY), "-y", tmp],
+             "-quality", str(TARGET_QUALITY), "-f", "webp", "-y", tmp],
             capture_output=True, timeout=60, check=True)
         if not os.path.getsize(tmp):
             raise ValueError("empty frame")
@@ -79,4 +115,6 @@ def generate_video_thumbnail(input_path: str, out_path: str,
             os.remove(tmp)
         except OSError:
             pass
+        if _is_mjpeg_candidate(input_path):
+            return _mjpeg_thumbnail(input_path, out_path, target_px)
         return None
