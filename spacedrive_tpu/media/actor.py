@@ -22,7 +22,7 @@ from typing import List, Optional
 
 from .thumbnail import (
     THUMBNAIL_CACHE_VERSION,
-    THUMBNAILABLE_EXTENSIONS,
+    thumbnailable_extensions,
     VERSION_FILE,
     ensure_thumbnail_dir,
     generate_thumbnail,
@@ -132,7 +132,7 @@ class Thumbnailer:
 
         async def one(cas_id: str, path: str) -> None:
             ext = os.path.splitext(path)[1].lstrip(".").lower()
-            if ext not in THUMBNAILABLE_EXTENSIONS:
+            if ext not in thumbnailable_extensions():
                 return
             async with sem:
                 out = await asyncio.to_thread(

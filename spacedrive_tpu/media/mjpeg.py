@@ -115,10 +115,12 @@ def write_mjpeg_avi(path: str, frames: List, fps: int = 10,
     avih = struct.pack(
         "<14I", us_per_frame, max_bytes * fps, 0, 0x10,  # HASINDEX
         len(jpegs), 0, 1, max_bytes, width, height, 0, 0, 0, 0)
+    # AVISTREAMHEADER: flags, prio/lang, initialFrames, scale, rate,
+    # start, length, bufSize, quality, sampleSize, then rcFrame (56 B).
     strh = (b"vids" + b"MJPG" + struct.pack(
-        "<IHHIIIIIIIII", 0, 0, 0, 0, 1, fps, 0, len(jpegs),
-        max_bytes, 0xFFFFFFFF, 0, 0) + struct.pack("<4H", 0, 0,
-                                                   width, height))
+        "<IHHIIIIIIII", 0, 0, 0, 0, 1, fps, 0, len(jpegs),
+        max_bytes, 0xFFFFFFFF, 0) + struct.pack("<4H", 0, 0,
+                                                width, height))
     strf = struct.pack("<IiiHH4sIiiII", 40, width, height, 1, 24,
                        b"MJPG", width * height * 3, 0, 0, 0, 0)
     hdrl = lst(b"hdrl", chunk(b"avih", avih)

@@ -16,7 +16,7 @@ from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, regist
 from ..locations.paths import IsolatedPath
 from .exif import MEDIA_DATA_EXTENSIONS, extract_media_data
 from .thumbnail import (
-    THUMBNAILABLE_EXTENSIONS,
+    thumbnailable_extensions,
     ensure_thumbnail_dir,
     generate_thumbnail,
 )
@@ -37,7 +37,7 @@ class MediaProcessorJob(StatefulJob):
     async def init(self, ctx: JobContext):
         db = ctx.db
         from ..locations.file_path_helper import job_prologue
-        exts = sorted(MEDIA_DATA_EXTENSIONS | THUMBNAILABLE_EXTENSIONS)
+        exts = sorted(MEDIA_DATA_EXTENSIONS | thumbnailable_extensions())
         ph = ",".join("?" for _ in exts)
         loc, where, params = job_prologue(
             db, self.location_id, self.sub_path,
@@ -100,7 +100,7 @@ class MediaProcessorJob(StatefulJob):
         entries = []
         for r in step["rows"]:
             ext = (r["extension"] or "").lower()
-            if r["cas_id"] and ext in THUMBNAILABLE_EXTENSIONS:
+            if r["cas_id"] and ext in thumbnailable_extensions():
                 entries.append((r["cas_id"], self._full_path(data, r)))
         if not entries:
             return

@@ -21,14 +21,26 @@ WEBP_EXTENSION = "webp"
 VERSION_FILE = "version.txt"
 THUMBNAIL_CACHE_VERSION = 1
 
-# Extensions the media dispatch can thumbnail here: the PIL raster set,
-# SVG via the self-hosted rasterizer (media/svg.py), and MJPEG `.avi`
-# via the self-hosted container parser (media/mjpeg.py — other video
-# codecs need the ffmpeg gate); HEIF/PDF remain runtime-gated.
+# Extensions the media dispatch can always thumbnail here: the PIL
+# raster set, SVG via the self-hosted rasterizer (media/svg.py), and
+# MJPEG `.avi` via the self-hosted container parser (media/mjpeg.py);
+# HEIF/PDF remain runtime-gated. Other video containers join via
+# `thumbnailable_extensions()` when ffmpeg is on PATH.
 THUMBNAILABLE_EXTENSIONS = {
     "jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico", "apng",
     "svg", "svgz", "avi",
 }
+
+
+def thumbnailable_extensions() -> set:
+    """Extensions the thumbnail dispatch can handle in THIS runtime:
+    the static set plus every video container when ffmpeg is present."""
+    from .video import VIDEO_EXTENSIONS, available
+
+    exts = set(THUMBNAILABLE_EXTENSIONS)
+    if available():
+        exts |= VIDEO_EXTENSIONS
+    return exts
 
 
 def shard_hex(cas_id: str) -> str:
@@ -91,10 +103,11 @@ def generate_thumbnail(input_path: str, data_dir: str,
     out = thumbnail_path(data_dir, cas_id)
     if os.path.exists(out):
         return out
-    from .video import MJPEG_EXTENSIONS
+    from .video import VIDEO_EXTENSIONS
 
     ext = os.path.splitext(input_path)[1].lstrip(".").lower()
-    if ext in MJPEG_EXTENSIONS:
+    if ext in VIDEO_EXTENSIONS:
+        # generate_video_thumbnail picks ffmpeg / MJPEG / None itself.
         from .video import generate_video_thumbnail
 
         return generate_video_thumbnail(input_path, out)

@@ -251,3 +251,26 @@ def test_server_ws_and_custom_uri(env, tmp_path):
                 assert (await resp.read())[:4] == b"RIFF"
         await server.stop()
     _run(main())
+
+
+def test_ts_client_generator_covers_every_procedure():
+    """packages/client parity: the generated TS client exposes one
+    method per registered procedure with its metadata as JSDoc."""
+    from spacedrive_tpu.api.procedures import register_all
+    from spacedrive_tpu.api.router import Router
+    from tools.gen_ts_client import generate
+
+    router = Router(node=None)
+    register_all(router)
+    code = generate()
+    n_scoped = 0
+    for name, proc in router.procedures.items():
+        assert f"'{name}'" in code, name
+        if proc.library_scoped:
+            n_scoped += 1
+    # every library-scoped procedure carries the JSDoc contract marker
+    assert code.count("library-scoped (input.library_id required)") \
+        == n_scoped
+    assert code.count("this.call") + code.count("this.subscribe") \
+        >= len(router.procedures)
+    assert "export class SpacedriveClient" in code
