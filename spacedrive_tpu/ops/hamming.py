@@ -181,8 +181,8 @@ def near_dup_pairs_lsh(digests: np.ndarray, threshold: int,
     vectorized distance pass. Probabilistic recall — a pair at distance
     d ≤ threshold is found iff some 16-bit band matches exactly, which
     for uniformly-spread d=10 flips is only ~25% per pair (measured:
-    0.66 planted recall at 1M with the 0..10 flip mixture —
-    tools/near_dup_scale.py records it per run). The device path
+    0.43 recall vs the exact device pass at 1M with a 0..10 flip
+    mixture — tools/near_dup_scale.py records it per run). The device path
     (`near_dup_pairs_device`) is EXACT at the same scale and is what the
     near-dup job uses whenever a TPU is present; this survives only as
     the no-device fallback."""
@@ -226,13 +226,20 @@ def _bit_planes(digests) -> jnp.ndarray:
     return (bits.astype(jnp.bfloat16) * 2 - 1).reshape(n, w * 32)
 
 
-def _pair_mask(dots, i, j, T, bits: int, threshold: int, n: int):
-    """dots [T, T] f32 → boolean mask of in-range (global i < j) pairs."""
-    gi = i * T + jnp.arange(T, dtype=jnp.int32)
-    gj = j * T + jnp.arange(T, dtype=jnp.int32)
+def _origin_pair_mask(dots, oi, oj, size, bits, threshold, n):
+    """dots [size, size] f32 → in-range (global i < j) mask for a block
+    whose rows start at global index oi and columns at oj."""
+    gi = oi + jnp.arange(size, dtype=jnp.int32)
+    gj = oj + jnp.arange(size, dtype=jnp.int32)
     return ((dots >= bits - 2 * threshold)
             & (gi[:, None] < gj[None, :])
             & (gi[:, None] < n) & (gj[None, :] < n))
+
+
+def _pair_mask(dots, i, j, T, bits, threshold, n):
+    """dots [T, T] f32 → boolean mask of in-range (global i < j) pairs
+    for whole-tile coords (the oi=i·T, oj=j·T case of the origin form)."""
+    return _origin_pair_mask(dots, i * T, j * T, T, bits, threshold, n)
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -266,42 +273,63 @@ def _tile_counts_block(planes, row0, threshold, n, block: int):
     return jax.lax.map(row, jnp.arange(block))
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
-def _tile_extract(planes, flagged, threshold, n, cap: int):
-    """flagged: [F, 2] int32 tile coords → ([F, cap, 2] global pair
-    indexes, [F] counts); unused slots are (-1, -1). Only `cap` (the
-    nonzero-extraction size) must be static — callers round it up to a
-    power of two so compilations stay bucketed."""
-    NT, T, BITS = planes.shape
+@functools.partial(jax.jit, static_argnames=("size", "sub"))
+def _refine_counts(flat, coords, threshold, n, size: int, sub: int):
+    """Subdivide count blocks: for each (row0, col0) block origin pair
+    in `coords` (units of `size` rows/cols of the flat plane array),
+    return [F, sub, sub] int32 pair counts of its sub-blocks.
 
-    def one(ij):
-        i, j = ij[0], ij[1]
-        x = jax.lax.dynamic_index_in_dim(planes, i, keepdims=False)
-        y = jax.lax.dynamic_index_in_dim(planes, j, keepdims=False)
+    Pure matmul + reshape-reduce — the extraction pyramid never runs
+    nonzero/cumsum on device (a [4096,4096] nonzero measured ~150 ms
+    per tile; this refinement is ~2 ms per tile).
+    """
+    NP, BITS = flat.shape
+
+    def one(rc):
+        oi = rc[0] * size
+        oj = rc[1] * size
+        x = jax.lax.dynamic_slice_in_dim(flat, oi, size)
+        y = jax.lax.dynamic_slice_in_dim(flat, oj, size)
         dots = jax.lax.dot_general(
             x, y, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ok = _pair_mask(dots, i, j, T, BITS, threshold, n)
-        ii, jj = jnp.nonzero(ok.reshape(T, T), size=cap, fill_value=-1)
-        valid = ii >= 0
-        pi = jnp.where(valid, i * T + ii, -1)
-        pj = jnp.where(valid, j * T + jj, -1)
-        return jnp.stack([pi, pj], axis=1), jnp.sum(ok, dtype=jnp.int32)
+        ok = _origin_pair_mask(dots, oi, oj, size, BITS, threshold, n)
+        k = size // sub
+        return jnp.sum(ok.reshape(sub, k, sub, k), axis=(1, 3),
+                       dtype=jnp.int32)
 
-    return jax.lax.map(one, flagged)
+    return jax.lax.map(one, coords)
 
 
-# Row-tiles per counts dispatch and flagged tiles per extract dispatch:
-# sized so one dispatch stays well under the tunnel worker's runtime
-# tolerance (~a few thousand [T,T] matmul tiles).
+@functools.partial(jax.jit, static_argnames=("size",))
+def _leaf_masks(flat, coords, threshold, n, size: int):
+    """[F, size, size] uint8 pair masks for leaf blocks — tiny enough
+    to ship to the host, where numpy nonzero finishes the job."""
+    NP, BITS = flat.shape
+
+    def one(rc):
+        oi = rc[0] * size
+        oj = rc[1] * size
+        x = jax.lax.dynamic_slice_in_dim(flat, oi, size)
+        y = jax.lax.dynamic_slice_in_dim(flat, oj, size)
+        dots = jax.lax.dot_general(
+            x, y, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return _origin_pair_mask(dots, oi, oj, size, BITS,
+                                 threshold, n).astype(jnp.uint8)
+
+    return jax.lax.map(one, coords)
+
+
+# Row-tiles per counts dispatch and refinement blocks per extract
+# dispatch: sized so one dispatch stays well under the tunnel worker's
+# runtime tolerance (~a few thousand [T,T] matmul tiles).
 COUNT_ROWS_PER_DISPATCH = 16
-EXTRACT_TILES_PER_DISPATCH = 256
-# Extraction output budget per dispatch (int32 pairs) and the per-tile
-# truncation bound. One tile of m identical digests holds ~m²/2 pairs
-# (m=4096 → 8M) — a degenerate cluster the CAS exact-dup pass already
-# covers; capping mirrors lsh_candidate_pairs' max_bucket truncation.
-EXTRACT_BUDGET_ELEMS = 32 << 20
-MAX_PAIRS_PER_TILE = 1 << 20
+REFINE_BLOCKS_PER_DISPATCH = 1024
+REFINE_SUB = 16  # 4096 → 256 → 16-wide leaf blocks
+# Host-side pair-list budget; denser output is degenerate (see
+# near_dup_pairs_device docstring).
+MAX_TOTAL_PAIRS = 4 << 20
 
 
 def _pow2(n: int) -> int:
@@ -315,19 +343,25 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
     of tiles (see block comment above). Returns the same pairs as
     `near_dup_pairs`, validated at 1M by tools/near_dup_scale.py.
 
-    Exactness caveat: a single tile holding more than MAX_PAIRS_PER_TILE
-    (1M) qualifying pairs — a ≥ ~1450-wide cluster of near-identical
-    digests — has its extraction truncated to the cap; such clusters are
-    degenerate for near-dup reporting (the UI shows pairs) and their
-    exact-equality core is already collapsed by the CAS dedup pass."""
+    Output is bounded at MAX_TOTAL_PAIRS: a degenerate near-identical
+    cluster of m digests holds ~m²/2 qualifying pairs (50k burst photos
+    → 1.25e9 pairs → ~100 GB of host tuples); past the budget the
+    densest tiles are dropped with a warning — their exact-equality
+    core is already collapsed by the CAS dedup pass, and a pair list
+    that size is noise for any consumer."""
     digests = np.ascontiguousarray(digests, dtype=np.uint32)
     N, W = digests.shape
     if N < 2:
         return []
+    if tile & (tile - 1):
+        raise ValueError(f"tile must be a power of two, got {tile} "
+                         "(the refinement pyramid subdivides by "
+                         f"{REFINE_SUB})")
     NT = -(-N // tile)
     padded = np.zeros((NT * tile, W), dtype=np.uint32)
     padded[:N] = digests
-    planes = _bit_planes(jnp.asarray(padded)).reshape(NT, tile, W * 32)
+    flat = _bit_planes(jnp.asarray(padded))
+    planes = flat.reshape(NT, tile, W * 32)
 
     thr = jnp.int32(threshold)
     nn = jnp.int32(N)
@@ -338,30 +372,56 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
         blocks.append(blk[: NT - r0])
     counts = np.concatenate(blocks, axis=0)
 
-    flagged = np.argwhere(counts > 0).astype(np.int32)
-    if len(flagged) == 0:
+    coords = np.argwhere(counts > 0).astype(np.int32)
+    if len(coords) == 0:
         return []
-    # Extract densest tiles first with a per-chunk cap: a single global
-    # cap sized to the worst tile would allocate [chunk, cap, 2] for
-    # every dispatch (a 4096-wide identical-digest cluster → 17 GB).
-    tile_counts = counts[flagged[:, 0], flagged[:, 1]]
-    order = np.argsort(-tile_counts)
-    flagged = flagged[order]
-    tile_counts = tile_counts[order]
-    out = []
-    f0 = 0
-    while f0 < len(flagged):
-        cap = _pow2(min(int(tile_counts[f0]), MAX_PAIRS_PER_TILE))
-        width = min(EXTRACT_TILES_PER_DISPATCH,
-                    max(1, EXTRACT_BUDGET_ELEMS // cap),
-                    len(flagged) - f0)
-        fpad = _pow2(width)  # pad tile list: (F, cap) compile buckets
-        chunk = np.zeros((fpad, 2), dtype=np.int32)
-        chunk[:width] = flagged[f0 : f0 + width]
-        pairs_dev, _ = _tile_extract(planes, jnp.asarray(chunk),
-                                     thr, nn, cap)
-        out.append(np.asarray(pairs_dev[:width]).reshape(-1, 2))
-        f0 += width
-    pairs = np.concatenate(out, axis=0)
-    pairs = pairs[pairs[:, 0] >= 0]
-    return [(int(i), int(j)) for i, j in pairs]
+    tile_totals = counts[coords[:, 0], coords[:, 1]]
+    if int(tile_totals.sum()) > MAX_TOTAL_PAIRS:
+        # Keep sparsest tiles first until the pair budget is spent.
+        import warnings
+
+        order = np.argsort(tile_totals)
+        keep = np.cumsum(tile_totals[order]) <= MAX_TOTAL_PAIRS
+        dropped = int(tile_totals.sum()
+                      - tile_totals[order][keep].sum())
+        warnings.warn(
+            f"near_dup_pairs_device: truncating ~{dropped} pairs in "
+            "degenerate near-identical clusters (MAX_TOTAL_PAIRS "
+            f"= {MAX_TOTAL_PAIRS})", RuntimeWarning)
+        coords = coords[order][keep]
+        if len(coords) == 0:
+            return []
+
+    def run_level(fn, coords, *args):
+        """Dispatch a refinement level in pow2-padded chunks."""
+        outs = []
+        for f0 in range(0, len(coords), REFINE_BLOCKS_PER_DISPATCH):
+            chunk = coords[f0 : f0 + REFINE_BLOCKS_PER_DISPATCH]
+            fpad = _pow2(len(chunk))
+            padded_c = np.zeros((fpad, 2), dtype=np.int32)
+            padded_c[: len(chunk)] = chunk
+            res = np.asarray(fn(flat, jnp.asarray(padded_c), thr, nn,
+                                *args))
+            outs.append(res[: len(chunk)])
+        return np.concatenate(outs, axis=0)
+
+    # Refinement pyramid: tile → tile/16 → tile/256 leaf blocks; each
+    # level keeps only sub-blocks whose count is nonzero, so the work
+    # set stays O(pairs), and the leaves ship as tiny host-side masks.
+    size = tile
+    while size > REFINE_SUB:
+        sub_counts = run_level(_refine_counts, coords, size, REFINE_SUB)
+        f, a, b = np.nonzero(sub_counts)
+        coords = np.stack([coords[f, 0] * REFINE_SUB + a,
+                           coords[f, 1] * REFINE_SUB + b],
+                          axis=1).astype(np.int32)
+        size //= REFINE_SUB
+        if len(coords) == 0:
+            return []
+
+    masks = run_level(_leaf_masks, coords, size)
+    f, ii, jj = np.nonzero(masks)
+    pi = coords[f, 0].astype(np.int64) * size + ii
+    pj = coords[f, 1].astype(np.int64) * size + jj
+    order = np.lexsort((pj, pi))
+    return [(int(a), int(b)) for a, b in zip(pi[order], pj[order])]

@@ -1,6 +1,7 @@
 """Dedup analytics: Hamming all-pairs, exact groups, LSH bands."""
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -178,3 +179,35 @@ def test_device_extract_chunks_by_density():
     want = {(i, j) for i in range(80) for j in range(i + 1, 80)}
     want.add((300, 550))
     assert got == want
+
+
+def test_device_pair_budget_truncates_degenerate_clusters():
+    """A pathological identical-digest cluster cannot blow up host
+    memory: the sparsest tiles survive, the dense ones drop, warned."""
+    import warnings
+
+    from spacedrive_tpu.ops import hamming as H
+
+    rng = np.random.default_rng(13)
+    d = rng.integers(0, 2**32, size=(600, 2), dtype=np.uint32)
+    d[0:500] = d[0]          # ~125k pairs in the dense tiles
+    d[520] = d[550]          # one sparse pair elsewhere
+    old = H.MAX_TOTAL_PAIRS
+    try:
+        H.MAX_TOTAL_PAIRS = 1000
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pairs = H.near_dup_pairs_device(d, threshold=0, tile=256)
+        assert any("truncating" in str(x.message) for x in w)
+        assert (520, 550) in pairs           # sparse pair survives
+        assert len(pairs) <= 1000
+    finally:
+        H.MAX_TOTAL_PAIRS = old
+
+
+def test_device_rejects_non_pow2_tile():
+    from spacedrive_tpu.ops.hamming import near_dup_pairs_device
+
+    d = np.zeros((3000, 2), dtype=np.uint32)
+    with pytest.raises(ValueError):
+        near_dup_pairs_device(d, threshold=0, tile=1000)
