@@ -31,8 +31,12 @@ import time
 
 import numpy as np
 
-B = 2048
-ITERS = 20
+# Batch size amortizes the chip's per-dispatch overhead (measured
+# ~7-10 ms under load on the shared bench chip): 2048 → ~0.3-0.5M
+# files/s, 16384 → ~1.1M files/s with the same kernel. 16 K files is
+# also the identifier's device step size (ops/staging.AUTO_DEVICE_BATCH).
+B = 16384
+ITERS = 10
 MSG_BYTES = 57352  # 8-byte size prefix + 57,344 sampled bytes
 
 
@@ -63,10 +67,12 @@ def main() -> None:
     l = jax.device_put(lengths)
     r = looped(w, l)
     np.asarray(r.ravel()[0])  # compile + warm (block_until_ready lies on axon)
-    t0 = time.perf_counter()
-    r = looped(w, l)
-    np.asarray(r.ravel()[0])
-    t_kernel = (time.perf_counter() - t0) / ITERS
+    t_kernel = float("inf")
+    for _ in range(3):  # best-of-3: the tunnel adds run-to-run spread
+        t0 = time.perf_counter()
+        r = looped(w, l)
+        np.asarray(r.ravel()[0])
+        t_kernel = min(t_kernel, (time.perf_counter() - t0) / ITERS)
     device_fps = B / t_kernel
 
     # Correctness spot check against the streaming oracle.
@@ -84,11 +90,11 @@ def main() -> None:
     if native.available():
         lens = np.full(B, payloads.shape[1], np.int32)
         native.blake3_many(payloads[:64], lens[:64], sizes[:64])  # warm
-        t0 = time.perf_counter()
-        nat_iters = 3
-        for _ in range(nat_iters):
+        cpu_fps = 0.0
+        for _ in range(3):  # best-of-3, symmetric with the device side
+            t0 = time.perf_counter()
             native.blake3_many(payloads, lens, sizes)
-        cpu_fps = B * nat_iters / (time.perf_counter() - t0)
+            cpu_fps = max(cpu_fps, B / (time.perf_counter() - t0))
         baseline_name = "native C++ AVX2 blake3_many (this repo, bench host CPU)"
     else:  # no native build: fall back to numpy (and say so)
         from spacedrive_tpu.ops import blake3_batch as bb

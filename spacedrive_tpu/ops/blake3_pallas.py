@@ -14,18 +14,25 @@ tile's DMA by the Pallas grid pipeline.
 Inside a tile the whole 16-word state lives in vector registers over a
 [8, 128] lane tile; the 16 block compressions × 7 rounds are fully
 unrolled with a static message-index schedule, so there is zero data
-movement per round. Per-lane metadata (chunk byte counts, counters, flag
-inputs) comes from the same `chunk_prelude` helper the numpy/jnp
-backends use, so masking and flag semantics cannot diverge.
+movement per round. Two kernels share that body:
 
-Measured on a v5e-1 (batch 2048 × 57,352-byte CAS messages, 20
-kernel executions chained inside one jit so dispatch/transfer noise
-cancels; see tools/perf_probe.py): this kernel + jnp tree reduction runs
-~3.9 ms/batch ≈ 520k files/s ≈ 30 GB/s hashed, vs ~7.8 ms for the jnp
-scan path and ~61k files/s (3.5 GB/s) for the repo's own AVX2 C++ plane
-on the bench host's CPU. Production (ops/staging.py "jax" backend)
-routes through blake3_jax.blake3_words, which dispatches here whenever
-the default backend is a TPU.
+- `_chunk_kernel_meta` (the hot path, whole messages from counter 0 —
+  every CAS call): per-lane chunk metadata is derived IN-KERNEL from
+  two int32 planes (file length, chunk index); per-block metadata
+  comes from the shared `block_meta` helper the numpy/jnp backends
+  use. Two planes instead of six measured ~1.5× the six-plane kernel.
+- `_chunk_kernel` (streaming windows: counter_base ≠ 0 / whole=False):
+  all six per-lane planes precomputed by the shared `chunk_prelude`.
+
+Measured on the (shared) bench v5e-1 chip with executions chained
+inside one jit (tools/perf_probe.py — per-call timing measures tunnel
+RPC latency): the chip adds ~7-10 ms of per-dispatch overhead under
+load, so throughput scales with batch: ~0.3-0.5M files/s at 2048
+files/batch, ~1.25M files/s (71.7 GB/s hashed) at 16384 — against
+~61k files/s (3.5 GB/s) for the repo's AVX2 C++ plane on the host CPU.
+Production (ops/staging.py "jax" backend) routes through
+blake3_jax.blake3_words, which dispatches here whenever the default
+backend is a TPU.
 
 The tree reduction stays in jnp (blake3_batch.tree_reduce): it is
 ≤ 1/16th of the chunk-stage work, and folding it in-kernel measured
@@ -108,6 +115,94 @@ def _compress_tile(cv, m, counter_lo, counter_hi, block_len, flags):
         g(3, 4, 9, 14, m[s[14]], m[s[15]])
 
     return [v[i] ^ v[i + 8] for i in range(8)]
+
+
+def _chunk_kernel_meta(words_ref, len_ref, cidx_ref, out_ref):
+    """Chunk stage for one lane tile, metadata derived in-kernel.
+
+    The hot CAS path always hashes whole messages from chunk counter 0,
+    so per-lane metadata is a pure function of (file length, chunk
+    index) — two int32 planes instead of six, with the byte/flag
+    arithmetic done in registers. Measured ~1.5× the six-plane kernel
+    (3.8 ms vs 5.7-7.3 ms per 2048-file batch) and less run-to-run
+    spread; the six-plane kernel remains for streaming windows
+    (counter_base ≠ 0 / whole=False).
+
+    words_ref: [1, 1024, 256]; len_ref/cidx_ref: [1, S, 128] int32;
+    out_ref: [8, 1, S, 128].
+    """
+    from .blake3_ref import CHUNK_LEN
+
+    w = words_ref[0]
+    wt = w.T.reshape(WORDS_PER_BLOCK * BLOCKS_PER_CHUNK, TILE_S, 128)
+    length = len_ref[0]
+    cidx = cidx_ref[0]
+    u32 = lambda x: jnp.asarray(x, dtype=jnp.uint32)  # noqa: E731
+    from .blake3_batch import block_meta
+
+    chunk_bytes = jnp.clip(length - cidx * CHUNK_LEN, 0, CHUNK_LEN)
+    n_chunks = jnp.maximum((length + CHUNK_LEN - 1) // CHUNK_LEN, 1)
+    single = n_chunks == 1
+    k_last = jnp.maximum(
+        (chunk_bytes + BLOCK_LEN - 1) // BLOCK_LEN - 1, 0)
+    counter_lo = cidx.astype(jnp.uint32)
+    counter_hi = jnp.zeros_like(counter_lo)
+    empty0 = (length == 0) & (cidx == 0)
+    cv = [jnp.full_like(counter_lo, IV[i]) for i in range(8)]
+    for k in range(BLOCKS_PER_CHUNK):
+        block_len, active, flags = block_meta(
+            jnp, chunk_bytes, k_last, single, empty0, k)
+        m = [wt[k * WORDS_PER_BLOCK + j] for j in range(WORDS_PER_BLOCK)]
+        new_cv = _compress_tile(
+            cv, m, counter_lo, counter_hi,
+            block_len.astype(jnp.uint32), flags)
+        cv = [jnp.where(active, n, c) for n, c in zip(new_cv, cv)]
+    for i in range(8):
+        out_ref[i, 0] = cv[i]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _chunk_cvs_pallas_fast(words, lengths, interpret: bool = False):
+    """Whole-message, counter-0 chunk stage (the CAS hot path):
+    [B, C, 256] words → (8 × [B, C] CVs, [B] n_chunks)."""
+    from .blake3_ref import CHUNK_LEN
+
+    B, C, W = words.shape
+    lengths = jnp.asarray(lengths, jnp.int32)
+    L = B * C
+    NT = -(-L // TILE_LANES)
+    pad = NT * TILE_LANES - L
+
+    def lanes(a):
+        flat = jnp.broadcast_to(a, (B, C)).astype(jnp.int32).reshape(L)
+        flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(NT, TILE_S, 128)
+
+    words_n = jnp.pad(words.reshape(L, W), ((0, pad), (0, 0)))
+    words_n = words_n.reshape(NT, TILE_LANES, W)
+    out = pl.pallas_call(
+        _chunk_kernel_meta,
+        grid=(NT,),
+        in_specs=[
+            pl.BlockSpec((1, TILE_LANES, W), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_S, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_S, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, 1, TILE_S, 128), lambda i: (0, i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, NT, TILE_S, 128), jnp.uint32),
+        interpret=interpret,
+    )(
+        words_n,
+        lanes(lengths[:, None]),
+        lanes(jnp.arange(C, dtype=jnp.int32)[None, :]),
+    )
+    n_chunks = jnp.maximum((lengths + CHUNK_LEN - 1) // CHUNK_LEN, 1)
+    cvs = out.reshape(8, NT * TILE_LANES)[:, :L].reshape(8, B, C)
+    return [cvs[i] for i in range(8)], n_chunks
 
 
 def _chunk_kernel(words_ref, cb_ref, klast_ref, single_ref, empty0_ref,
@@ -220,11 +315,12 @@ def chunk_cvs_pallas(words, lengths, counter_base=0, whole=True,
 
 
 def blake3_words_pallas(words, lengths, interpret: bool = False):
-    """[B, C, 256] words + [B] lengths → [B, 8] digests (Pallas chunk
-    stage + jnp tree reduction)."""
+    """[B, C, 256] words + [B] lengths → [B, 8] digests (fast-path
+    Pallas chunk stage + jnp tree reduction)."""
     from .blake3_batch import tree_reduce
 
-    cvs, n_chunks = chunk_cvs_pallas(words, lengths, interpret=interpret)
+    cvs, n_chunks = _chunk_cvs_pallas_fast(words, lengths,
+                                           interpret=interpret)
     return jnp.stack(tree_reduce(jnp, cvs, n_chunks), axis=1)
 
 

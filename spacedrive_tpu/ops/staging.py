@@ -237,7 +237,7 @@ JAX_MIN_BATCH = 256
 # with at least this many orphans consider the device pipeline, stepping
 # in AUTO_DEVICE_BATCH-file chunks so each step is one device call.
 AUTO_DEVICE_MIN_ORPHANS = 4096
-AUTO_DEVICE_BATCH = 8192
+AUTO_DEVICE_BATCH = 16384  # amortizes ~7-10 ms per-dispatch overhead
 
 # The CAS pipeline is H2D-bound end-to-end (the pallas kernel sustains
 # ~30 GB/s, the AVX2 native plane ~3.5 GB/s): shipping bytes to the
