@@ -372,6 +372,11 @@ async function inspect(r) {
     const md = await q("files.getMediaData", {library_id: lib,
                                               id: r.object_id});
     if (md) {
+      if (md.stream_data) {
+        // audio/video container metadata rides as JSON
+        try { Object.assign(md, JSON.parse(md.stream_data)); } catch {}
+        delete md.stream_data;
+      }
       const ex = document.getElementById("iexif");
       ex.innerHTML = "<h3>media data</h3>" +
         Object.entries(md).filter(([k, v]) => v != null && k !== "phash" &&

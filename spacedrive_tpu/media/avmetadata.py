@@ -3,7 +3,10 @@
 The reference ships typed audio/video metadata structs that are mostly
 stubs awaiting an ffmpeg binding (/root/reference/crates/media-metadata/
 src/{audio.rs,video.rs}). Here the same typed rows fill from `ffprobe`
-when it exists (media/video.py gates) and return None otherwise.
+when it exists (media/video.py gates), and otherwise from the
+self-hosted container parsers (media/audio.py: WAV/FLAC/MP3/OGG/Opus/
+AVI) — so the audio/video metadata plane actually runs in this image,
+beyond the reference's stubs.
 """
 
 from __future__ import annotations
@@ -35,10 +38,33 @@ class StreamMetadata:
         return {k: v for k, v in asdict(self).items() if v is not None}
 
 
+def probeable_extensions() -> set:
+    """Audio/video extensions probe_media can actually read in THIS
+    runtime: everything when ffprobe exists, else just the self-hosted
+    parsers' formats — keeps the media job from re-probing thousands of
+    deterministically-unreadable files on every run."""
+    from .audio import AUDIO_EXTENSIONS, _PARSERS
+    from .video import VIDEO_EXTENSIONS
+
+    if ffmpeg_available():
+        return set(AUDIO_EXTENSIONS) | set(VIDEO_EXTENSIONS)
+    return set(_PARSERS)
+
+
 def probe_media(path: str) -> Optional[StreamMetadata]:
-    """ffprobe → StreamMetadata, or None when unavailable/undecodable."""
+    """ffprobe (when installed) else the self-hosted parsers →
+    StreamMetadata; None when neither can read the container."""
     if not ffmpeg_available():
-        return None
+        from .audio import parse_stream_info
+
+        info = parse_stream_info(path)
+        if info is None:
+            return None
+        md = StreamMetadata()
+        for k, v in info.items():
+            if hasattr(md, k):
+                setattr(md, k, v)
+        return md
     try:
         out = subprocess.run(
             ["ffprobe", "-v", "quiet", "-print_format", "json",

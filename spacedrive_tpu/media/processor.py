@@ -37,7 +37,10 @@ class MediaProcessorJob(StatefulJob):
     async def init(self, ctx: JobContext):
         db = ctx.db
         from ..locations.file_path_helper import job_prologue
-        exts = sorted(MEDIA_DATA_EXTENSIONS | thumbnailable_extensions())
+        from .avmetadata import probeable_extensions
+
+        exts = sorted(MEDIA_DATA_EXTENSIONS | thumbnailable_extensions()
+                      | probeable_extensions())
         ph = ",".join("?" for _ in exts)
         loc, where, params = job_prologue(
             db, self.location_id, self.sub_path,
@@ -69,25 +72,40 @@ class MediaProcessorJob(StatefulJob):
         return outcome
 
     def _exif_step(self, ctx: JobContext, data, step) -> StepOutcome:
+        import json as _json
+
+        from .avmetadata import probe_media, probeable_extensions
+
+        av_exts = probeable_extensions()
         db = ctx.db
         errors: List[str] = []
         for r in step["rows"]:
             ext = (r["extension"] or "").lower()
-            if ext not in MEDIA_DATA_EXTENSIONS:
+            is_av = ext in av_exts
+            if ext not in MEDIA_DATA_EXTENSIONS and not is_av:
                 continue
             full = self._full_path(data, r)
             existing = db.query_one(
                 "SELECT id FROM media_data WHERE object_id = ?",
                 (r["object_id"],))
-            if existing is None:
-                md = extract_media_data(full)
-                if md is not None:
+            if existing is not None:
+                continue
+            try:
+                if is_av:
+                    info = probe_media(full)
+                    if info is None:
+                        continue
+                    md = {"object_id": r["object_id"],
+                          "stream_data": _json.dumps(info.to_dict())}
+                else:
+                    md = extract_media_data(full)
+                    if md is None:
+                        continue
                     md["object_id"] = r["object_id"]
-                    try:
-                        db.insert("media_data", md)
-                        data["extracted"] += 1
-                    except Exception as e:  # unique race: another path
-                        errors.append(f"media_data {full}: {e}")
+                db.insert("media_data", md)
+                data["extracted"] += 1
+            except Exception as e:  # unique race: another path
+                errors.append(f"media_data {full}: {e}")
         return StepOutcome(errors=errors)
 
     async def _thumbs_step(self, ctx: JobContext, data, step) -> None:

@@ -49,7 +49,34 @@ class Database:
         self._closed = False
         conn = self._conn()
         with self._write_lock:
-            for stmt in models.all_ddl():
+            ddl = models.all_ddl()
+            tables = [d for d in ddl if not d.lstrip().upper()
+                      .startswith(("CREATE INDEX", "CREATE UNIQUE INDEX"))]
+            indexes = [d for d in ddl if d not in tables]
+            for stmt in tables:
+                conn.execute(stmt)
+            # Additive schema evolution: CREATE TABLE IF NOT EXISTS
+            # leaves pre-existing libraries without newly-registered
+            # columns, so diff each table against the registry and
+            # ALTER in what is missing — BEFORE index DDL, which may
+            # reference a just-added column. Only plain nullable
+            # columns are supported (constraints/FKs can't be ALTERed
+            # in and would silently diverge from fresh schemas).
+            for table, model in models.MODELS.items():
+                have = {row[1] for row in conn.execute(
+                    f"PRAGMA table_info({table})")}
+                for field in model.fields:
+                    if field.name in have:
+                        continue
+                    assert (field.nullable and not field.unique
+                            and field.default is None
+                            and field.references is None), (
+                        f"{table}.{field.name}: additive migration "
+                        "only supports plain nullable columns")
+                    conn.execute(
+                        f"ALTER TABLE {table} ADD COLUMN "
+                        f"{field.name} {field.type}")
+            for stmt in indexes:
                 conn.execute(stmt)
             conn.commit()
 

@@ -284,6 +284,10 @@ register(Model(
         # Net-new vs the reference: 64-bit perceptual hash (big-endian
         # bytes) for device-side near-dup search (BASELINE.json config 4).
         Field("phash", "BLOB"),
+        # Net-new: audio/video container metadata as JSON (the
+        # reference's audio.rs/video.rs structs are stubs; here the
+        # self-hosted parsers in media/audio.py fill them for real).
+        Field("stream_data", "TEXT"),
     ),
 ))
 

@@ -1,0 +1,157 @@
+"""Self-hosted audio/video container metadata (media/audio.py).
+
+The reference's sd-media-metadata audio/video structs are stubs
+(crates/media-metadata/src/{audio,video}.rs); these parsers fill them
+for real from container headers, no codec library needed.
+"""
+
+import math
+import struct
+import wave
+
+import pytest
+
+from spacedrive_tpu.media.audio import (
+    parse_flac, parse_mp3, parse_ogg, parse_stream_info, parse_wav)
+
+
+def make_wav(path, seconds=2.0, rate=22050, channels=2):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        n = int(seconds * rate)
+        frames = b"".join(
+            struct.pack("<h", int(1000 * math.sin(i / 20.0))) * channels
+            for i in range(n))
+        w.writeframes(frames)
+    return path
+
+
+def test_wav_metadata(tmp_path):
+    p = make_wav(tmp_path / "t.wav", seconds=1.5, rate=8000, channels=1)
+    md = parse_wav(str(p))
+    assert md["sample_rate"] == 8000
+    assert md["channels"] == 1
+    assert md["audio_codec"] == "pcm_s16le"
+    assert abs(md["duration_seconds"] - 1.5) < 0.01
+
+
+def test_flac_streaminfo(tmp_path):
+    # Minimal fLaC: STREAMINFO (last block) with 44.1 kHz stereo 16-bit,
+    # 441000 samples = 10 s.
+    rate, channels, depth, total = 44100, 2, 16, 441_000
+    bits = (rate << 44) | ((channels - 1) << 41) | ((depth - 1) << 36) | total
+    streaminfo = (struct.pack(">HHBBB", 4096, 4096, 0, 0, 0) + b"\x00" * 5)
+    streaminfo = struct.pack(">HH", 4096, 4096) + b"\x00" * 6 \
+        + bits.to_bytes(8, "big") + b"\x00" * 16
+    blob = b"fLaC" + bytes([0x80]) + len(streaminfo).to_bytes(3, "big") \
+        + streaminfo
+    p = tmp_path / "t.flac"
+    p.write_bytes(blob)
+    md = parse_flac(str(p))
+    assert md["sample_rate"] == 44100
+    assert md["channels"] == 2
+    assert md["bits_per_sample"] == 16
+    assert abs(md["duration_seconds"] - 10.0) < 0.01
+
+
+def test_mp3_cbr_estimate(tmp_path):
+    # MPEG1 Layer III, 128 kbps, 44.1 kHz: header 0xFF 0xFB 0x90 0x00.
+    frame = bytes([0xFF, 0xFB, 0x90, 0x00]) + b"\x00" * 413
+    p = tmp_path / "t.mp3"
+    p.write_bytes(b"ID3" + b"\x04\x00\x00" + b"\x00\x00\x00\x0a"
+                  + b"\x00" * 10 + frame * 100)
+    md = parse_mp3(str(p))
+    assert md["audio_codec"] == "mp3"
+    assert md["sample_rate"] == 44100
+    assert md["bitrate"] == 128_000
+    # 100 frames × 417 B at 128 kbps ≈ 2.6 s
+    assert 2.0 < md["duration_seconds"] < 3.5
+
+
+def test_ogg_vorbis(tmp_path):
+    # First page: vorbis id header; last page: granule 96000 @ 48 kHz.
+    id_pkt = b"\x01vorbis" + struct.pack("<IB I", 0, 2, 48000) \
+        + b"\x00" * 9
+    page1 = (b"OggS\x00\x02" + struct.pack("<q", 0) + b"\x00" * 12
+             + bytes([1, len(id_pkt)]) + id_pkt)
+    page2 = (b"OggS\x00\x04" + struct.pack("<q", 96000) + b"\x00" * 12
+             + bytes([1, 1]) + b"\x00")
+    p = tmp_path / "t.ogg"
+    p.write_bytes(page1 + page2)
+    md = parse_ogg(str(p))
+    assert md["audio_codec"] == "vorbis"
+    assert md["channels"] == 2
+    assert md["sample_rate"] == 48000
+    assert abs(md["duration_seconds"] - 2.0) < 0.01
+
+
+def test_avi_stream_info(tmp_path):
+    from PIL import Image
+
+    from spacedrive_tpu.media.mjpeg import write_mjpeg_avi
+
+    p = tmp_path / "t.avi"
+    frames = [Image.new("RGB", (160, 120), (i, 0, 0)) for i in range(30)]
+    write_mjpeg_avi(str(p), frames, fps=15)
+    md = parse_stream_info(str(p))
+    assert md["width"] == 160 and md["height"] == 120
+    assert abs(md["fps"] - 15.0) < 0.1
+    assert abs(md["duration_seconds"] - 2.0) < 0.01
+    assert md["video_codec"] == "MJPG"
+
+
+def test_probe_media_falls_back_to_self_hosted(tmp_path, monkeypatch):
+    import spacedrive_tpu.media.avmetadata as av
+
+    monkeypatch.setattr(av, "ffmpeg_available", lambda: False)
+    p = make_wav(tmp_path / "p.wav", seconds=1.0, rate=16000)
+    md = av.probe_media(str(p))
+    assert md is not None and md.sample_rate == 16000
+    assert md.to_dict()["duration_seconds"] == pytest.approx(1.0, 0.01)
+
+
+def test_garbage_returns_none(tmp_path):
+    p = tmp_path / "x.flac"
+    p.write_bytes(b"not a flac")
+    assert parse_stream_info(str(p)) is None
+    assert parse_stream_info(str(tmp_path / "y.xyz")) is None
+
+
+def test_media_processor_persists_stream_data(tmp_path):
+    """e2e: the media processor stores stream_data JSON for audio files
+    through the real scan chain."""
+    import asyncio
+    import json
+
+    from spacedrive_tpu.locations.manager import create_location, scan_location
+    from spacedrive_tpu.node import Node
+
+    corpus = tmp_path / "c"
+    corpus.mkdir()
+    make_wav(corpus / "song.wav", seconds=1.0, rate=8000)
+
+    async def scenario():
+        node = Node(str(tmp_path / "data"))
+        await node.start()
+        try:
+            lib = node.create_library("av")
+            loc = create_location(lib, str(corpus))
+            await scan_location(node.jobs, lib, loc)
+            for _ in range(100):
+                reps = lib.db.query("SELECT status FROM job")
+                if reps and all(r["status"] in (2, 6) for r in reps):
+                    break
+                await asyncio.sleep(0.2)
+            row = lib.db.query_one(
+                "SELECT md.stream_data AS sd FROM media_data md "
+                "JOIN file_path fp ON fp.object_id = md.object_id "
+                "WHERE fp.extension = 'wav'")
+            return json.loads(row["sd"]) if row and row["sd"] else None
+        finally:
+            await node.shutdown()
+
+    info = asyncio.run(scenario())
+    assert info and info["sample_rate"] == 8000
+    assert info["duration_seconds"] == pytest.approx(1.0, 0.01)
