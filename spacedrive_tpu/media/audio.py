@@ -31,12 +31,22 @@ from typing import Dict, Optional
 AUDIO_EXTENSIONS = {"wav", "flac", "mp3", "ogg", "opus", "m4a", "aac",
                     "wma", "aiff"}
 
-_MP3_BITRATES = {  # kbps, MPEG1 layer III
+# Layer III bitrate tables (kbps) by bitrate index; MPEG2 and MPEG2.5
+# share one table, distinct from MPEG1's.
+_MP3_BITRATES_V1 = {
     1: 32, 2: 40, 3: 48, 4: 56, 5: 64, 6: 80, 7: 96, 8: 112,
     9: 128, 10: 160, 11: 192, 12: 224, 13: 256, 14: 320,
 }
-_MP3_RATES_V1 = {0: 44100, 1: 48000, 2: 32000}
-_MP3_RATES_V2 = {0: 22050, 1: 24000, 2: 16000}
+_MP3_BITRATES_V2 = {
+    1: 8, 2: 16, 3: 24, 4: 32, 5: 40, 6: 48, 7: 56, 8: 64,
+    9: 80, 10: 96, 11: 112, 12: 128, 13: 144, 14: 160,
+}
+# Sample rates by version bits (3=MPEG1, 2=MPEG2, 0=MPEG2.5; 1 reserved).
+_MP3_RATES = {
+    3: {0: 44100, 1: 48000, 2: 32000},
+    2: {0: 22050, 1: 24000, 2: 16000},
+    0: {0: 11025, 1: 12000, 2: 8000},
+}
 
 
 def parse_wav(path: str) -> Optional[Dict]:
@@ -83,6 +93,11 @@ def parse_flac(path: str) -> Optional[Dict]:
             last = bool(hdr[0] & 0x80)
             btype = hdr[0] & 0x7F
             size = int.from_bytes(hdr[1:4], "big")
+            if btype != 0:  # only STREAMINFO is read; skip PICTURE etc.
+                f.seek(size, os.SEEK_CUR)
+                if last:
+                    return None
+                continue
             block = f.read(size)
             if btype == 0 and size >= 34:  # STREAMINFO
                 bits = int.from_bytes(block[10:18], "big")
@@ -101,27 +116,37 @@ def parse_flac(path: str) -> Optional[Dict]:
 
 
 def parse_mp3(path: str) -> Optional[Dict]:
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
         data = f.read(256 * 1024)
-    size = os.path.getsize(path)
-    pos = 0
-    if data[:3] == b"ID3" and len(data) > 10:
-        syn = data[6:10]
-        pos = 10 + ((syn[0] & 0x7F) << 21 | (syn[1] & 0x7F) << 14
-                    | (syn[2] & 0x7F) << 7 | (syn[3] & 0x7F))
+        start = 0
+        if data[:3] == b"ID3" and len(data) > 10:
+            syn = data[6:10]
+            start = 10 + ((syn[0] & 0x7F) << 21 | (syn[1] & 0x7F) << 14
+                          | (syn[2] & 0x7F) << 7 | (syn[3] & 0x7F))
+            if start >= len(data):
+                # Oversized ID3 tag (cover art): window past it.
+                f.seek(start)
+                data = f.read(256 * 1024)
+                base, start = start, 0
+            else:
+                base = 0
+        else:
+            base = 0
+    pos = start
     while pos + 4 <= len(data):
         b = data[pos:pos + 4]
         if b[0] == 0xFF and (b[1] & 0xE0) == 0xE0:
-            version = (b[1] >> 3) & 0x3   # 3=MPEG1, 2=MPEG2
+            version = (b[1] >> 3) & 0x3   # 3=MPEG1 2=MPEG2 0=MPEG2.5
             layer = (b[1] >> 1) & 0x3     # 1=III
             br_idx = (b[2] >> 4) & 0xF
             sr_idx = (b[2] >> 2) & 0x3
-            if layer == 1 and br_idx in _MP3_BITRATES and sr_idx < 3:
-                rates = _MP3_RATES_V1 if version == 3 else _MP3_RATES_V2
-                rate = rates[sr_idx]
-                kbps = _MP3_BITRATES[br_idx]
-                if version != 3:
-                    kbps //= 2
+            bitrates = (_MP3_BITRATES_V1 if version == 3
+                        else _MP3_BITRATES_V2)
+            if (layer == 1 and version != 1 and br_idx in bitrates
+                    and sr_idx < 3):
+                rate = _MP3_RATES[version][sr_idx]
+                kbps = bitrates[br_idx]
                 out = {"format_name": "mp3", "audio_codec": "mp3",
                        "sample_rate": rate,
                        "channels": 1 if ((b[3] >> 6) & 0x3) == 3 else 2,
@@ -141,17 +166,29 @@ def parse_mp3(path: str) -> Optional[Dict]:
                                 frames * spf / rate, 3)
                             return out
                 out["duration_seconds"] = round(
-                    (size - pos) * 8 / (kbps * 1000), 3)  # CBR estimate
+                    (size - base - pos) * 8 / (kbps * 1000),
+                    3)  # CBR estimate
                 return out
         pos += 1
     return None
 
 
 def _last_ogg_granule(data: bytes) -> Optional[int]:
-    at = data.rfind(b"OggS")
-    if at < 0 or len(data) < at + 14:
-        return None
-    return struct.unpack("<q", data[at + 6:at + 14])[0]
+    """Granule of the last structurally-plausible page: 'OggS' capture
+    + version 0 + sane header-type bits + granule ≥ 0 (a -1 granule or
+    a chance 'OggS' inside packet data is skipped)."""
+    at = len(data)
+    while True:
+        at = data.rfind(b"OggS", 0, at)
+        if at < 0:
+            return None
+        if (len(data) >= at + 27 and data[at + 4] == 0
+                and data[at + 5] <= 0x07):
+            granule = struct.unpack("<q", data[at + 6:at + 14])[0]
+            if granule >= 0:
+                return granule
+        if at == 0:
+            return None
 
 
 def parse_ogg(path: str) -> Optional[Dict]:

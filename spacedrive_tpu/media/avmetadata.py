@@ -33,6 +33,7 @@ class StreamMetadata:
     audio_codec: Optional[str] = None
     sample_rate: Optional[int] = None
     channels: Optional[int] = None
+    bits_per_sample: Optional[int] = None
 
     def to_dict(self) -> dict:
         return {k: v for k, v in asdict(self).items() if v is not None}
@@ -62,8 +63,9 @@ def probe_media(path: str) -> Optional[StreamMetadata]:
             return None
         md = StreamMetadata()
         for k, v in info.items():
-            if hasattr(md, k):
-                setattr(md, k, v)
+            # Parser keys are the dataclass fields; a mismatch is a bug,
+            # not something to silently drop.
+            setattr(md, k, v)
         return md
     try:
         out = subprocess.run(

@@ -83,6 +83,24 @@ class ObjectValidatorJob(StatefulJob):
     async def execute_step(self, ctx, data, step, step_number):
         return await asyncio.to_thread(self._step, ctx, data, step)
 
+    def _checksums_jax(self, jobs, errors):
+        """Sequence-sharded device checksums, one file at a time in
+        mesh-window streams (window ≈ 8 MiB per device)."""
+        from ..ops.seqhash import sharded_file_checksum
+        from ..parallel.mesh import batch_mesh
+
+        mesh = batch_mesh()
+        D = int(mesh.devices.size)
+        shard_chunks = max(64, (8 << 20) // (D * 1024))
+        # power-of-two shard size for subtree alignment
+        shard_chunks = 1 << (shard_chunks - 1).bit_length()
+        for r, path in jobs:
+            try:
+                yield r, path, sharded_file_checksum(
+                    mesh, path, shard_chunks=shard_chunks)
+            except (OSError, ValueError) as e:
+                errors.append(f"{path}: {e}")
+
     def _step(self, ctx: JobContext, data, step) -> StepOutcome:
         db, sync = ctx.db, ctx.library.sync
         loc_path = data["location_path"]
@@ -97,7 +115,15 @@ class ObjectValidatorJob(StatefulJob):
         results: List[Tuple[dict, str, str]] = []  # (row, path, checksum)
 
         from .. import native
-        if native.available() and jobs:
+        if self.backend == "jax" and jobs:
+            # Device plane: each file's chunk chain is sequence-sharded
+            # across the mesh and streamed in windows (ops/seqhash.py
+            # StreamingShardedChecksum) — bounded memory at any file
+            # size, oracle-exact. Explicit opt-in: on slow host→device
+            # links the native streamer wins (ops/staging.py policy).
+            for r, path, checksum in self._checksums_jax(jobs, errors):
+                results.append((r, path, checksum))
+        elif native.available() and jobs:
             # Batched native plane: one call, pooled pread + C++ BLAKE3.
             hexes, status = native.checksum_files([p for _, p in jobs])
             for (r, path), checksum, st in zip(jobs, hexes, status):

@@ -68,11 +68,12 @@ class Database:
                 for field in model.fields:
                     if field.name in have:
                         continue
-                    assert (field.nullable and not field.unique
+                    if not (field.nullable and not field.unique
                             and field.default is None
-                            and field.references is None), (
-                        f"{table}.{field.name}: additive migration "
-                        "only supports plain nullable columns")
+                            and field.references is None):
+                        raise RuntimeError(
+                            f"{table}.{field.name}: additive migration "
+                            "only supports plain nullable columns")
                     conn.execute(
                         f"ALTER TABLE {table} ADD COLUMN "
                         f"{field.name} {field.type}")

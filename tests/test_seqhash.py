@@ -122,3 +122,54 @@ def test_streaming_file_checksum_bounded_memory(mesh, tmp_path):
     p.write_bytes(data)
     got = sharded_file_checksum(mesh, str(p), shard_chunks=256)
     assert got == blake3_batch_np([data])[0].hex()
+
+
+def test_validator_jax_backend_streams_checksums(tmp_path):
+    """ObjectValidatorJob backend="jax": full-file checksums computed by
+    the sequence-sharded streaming path over the CPU mesh, identical to
+    the oracle and accepted by verify mode."""
+    import asyncio
+
+    import numpy as np
+
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.locations.manager import create_location
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.identifier import FileIdentifierJob
+    from spacedrive_tpu.objects.validator import ObjectValidatorJob
+    from spacedrive_tpu.ops.blake3_batch import blake3_batch_np
+
+    corpus = tmp_path / "c"
+    corpus.mkdir()
+    rng = np.random.default_rng(21)
+    blobs = {}
+    # multi.bin exceeds one shard (1 MiB at the 8-device CPU mesh's
+    # 8 MiB window), so the sequence-sharded device path really runs.
+    for name, size in [("small.bin", 3_000), ("multi.bin", 1_300_000)]:
+        data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        (corpus / name).write_bytes(data)
+        blobs[name] = data
+
+    async def scenario():
+        node = Node(str(tmp_path / "data"))
+        await node.start()
+        try:
+            lib = node.create_library("v")
+            loc = create_location(lib, str(corpus))
+            await node.jobs.wait(await node.jobs.ingest(
+                lib, IndexerJob(location_id=loc)))
+            await node.jobs.wait(await node.jobs.ingest(
+                lib, FileIdentifierJob(location_id=loc)))
+            await node.jobs.wait(await node.jobs.ingest(
+                lib, ObjectValidatorJob(location_id=loc, backend="jax")))
+            rows = lib.db.query(
+                "SELECT name, extension, integrity_checksum "
+                "FROM file_path WHERE is_dir = 0")
+            return {f"{r['name']}.{r['extension']}":
+                    r["integrity_checksum"] for r in rows}
+        finally:
+            await node.shutdown()
+
+    got = asyncio.run(scenario())
+    for name, data in blobs.items():
+        assert got[name] == blake3_batch_np([data])[0].hex(), name
