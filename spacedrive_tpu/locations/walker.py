@@ -15,7 +15,7 @@ event loop responsive (the reference uses tokio's async fs instead).
 from __future__ import annotations
 
 import os
-import uuid as uuidlib
+from ..sync.crdt import uuid4_bytes
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
@@ -171,7 +171,7 @@ class Walker:
                 st = os.stat(root)
                 iso = self._iso(root, True)
                 indexed[iso] = WalkedEntry(
-                    uuidlib.uuid4().bytes, iso,
+                    uuid4_bytes(), iso,
                     FilePathMetadata.from_stat(root, st),
                 )
             except OSError as e:
@@ -257,7 +257,7 @@ class Walker:
                 errors.append(str(e))
                 continue
             buffer[iso] = WalkedEntry(
-                uuidlib.uuid4().bytes, iso,
+                uuid4_bytes(), iso,
                 FilePathMetadata.from_stat(current, st),
             )
 
@@ -279,7 +279,7 @@ class Walker:
                     ancestor = os.path.dirname(ancestor)
                     continue
                 buffer[aiso] = WalkedEntry(
-                    uuidlib.uuid4().bytes, aiso,
+                    uuid4_bytes(), aiso,
                     FilePathMetadata.from_stat(ancestor, ast),
                 )
                 ancestor = os.path.dirname(ancestor)

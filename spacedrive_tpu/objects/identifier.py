@@ -19,13 +19,15 @@ from __future__ import annotations
 
 import asyncio
 import time
-import uuid as uuidlib
 from typing import Any, Dict, List, Optional, Tuple
+
+from ..sync.crdt import uuid4_bytes
 
 from ..files import resolve_kind
 from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
 from ..locations.file_path_helper import materialized_like, sub_path_children_mat
 from ..locations.paths import IsolatedPath
+from ..ops import staging
 from ..ops.staging import cas_ids_for_files
 
 CHUNK_SIZE = 100  # file_identifier/mod.rs:36
@@ -42,14 +44,35 @@ def orphan_filters(location_id: int, cursor: int,
     return where, params
 
 
+def _in_chunks(seq: List, n: int = 900):
+    for i in range(0, len(seq), n):
+        yield seq[i:i + n]
+
+
 def identify_chunk(library, location_id: int, location_path: str,
                    rows: List[Dict[str, Any]], backend: str = "auto",
+                   timings: Optional[Dict[str, float]] = None,
                    ) -> Tuple[int, int, List[str]]:
     """The identifier's per-chunk kernel (identifier_job_step,
     mod.rs:100-331): batched CAS hashing, cas_id writes, object
     linking/creation — all through sync. Returns (linked, created,
-    errors). Shared by the job and the shallow/watcher path."""
+    errors). Shared by the job and the shallow/watcher path.
+
+    All writes land in ONE transaction per chunk (the reference batches
+    per pass, mod.rs:144/167/231; one atomic chunk is strictly tighter
+    and 3× fewer commits), with executemany for the row loops so Python
+    stays out of the per-file statement path. `timings` (optional)
+    accumulates per-phase seconds: prep / hash / db / ops.
+    """
+    t = timings if timings is not None else {}
+
+    def _mark(phase: str, t0: float) -> float:
+        t1 = time.perf_counter()
+        t[phase] = t.get(phase, 0.0) + (t1 - t0)
+        return t1
+
     db, sync = library.db, library.sync
+    tp = time.perf_counter()
     files: List[Tuple[str, int]] = []
     for r in rows:
         iso = IsolatedPath.from_db_row(
@@ -57,80 +80,85 @@ def identify_chunk(library, location_id: int, location_path: str,
             r["name"] or "", r["extension"] or "")
         size = int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
         files.append((iso.join_on(location_path), size))
+    tp = _mark("prep", tp)
 
     # ---- batched hashing (the TPU-fed kernel) ----
     ids, read_errors = cas_ids_for_files(files, backend=backend)
+    tp = _mark("hash", tp)
     kinds = {
         i: int(resolve_kind(files[i][0], ext=rows[i]["extension"] or ""))
         for i in ids
     }
+    tp = _mark("prep", tp)
 
-    # ---- 1. write cas_ids through sync (mod.rs:144-165) ----
-    ops = []
+    linked = created = n_ops = 0
     with db.tx() as conn:
+        # ---- link targets: existing objects by cas_id (mod.rs:167-225) --
+        cas_list = sorted({c for c in ids.values() if c})
+        existing: Dict[str, Tuple[int, bytes]] = {}
+        for chunk in _in_chunks(cas_list):
+            ph = ",".join("?" for _ in chunk)
+            for r in conn.execute(
+                f"SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
+                f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
+                f"WHERE fp.cas_id IN ({ph})", chunk):
+                existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
+        tp = _mark("db", tp)
+
+        # ---- resolve every row to an object: link or create ------------
+        by_cas: Dict[str, bytes] = {}
+        pub_of: Dict[int, bytes] = {}
+        new_objects: List[Tuple[bytes, int, Any]] = []
+        create_specs: List[Tuple] = []
         for i, cas_id in ids.items():
-            conn.execute(
-                "UPDATE file_path SET cas_id = ? WHERE id = ?",
-                (cas_id, rows[i]["id"]))
-            ops.append(sync.shared_update(
-                "file_path", rows[i]["pub_id"], "cas_id", cas_id))
-        sync._insert_op_rows(conn, ops)
-
-    # ---- 2. link to existing objects by cas_id (mod.rs:167-225) ----
-    cas_list = sorted({c for c in ids.values() if c})
-    existing: Dict[str, Tuple[int, bytes]] = {}
-    if cas_list:
-        ph = ",".join("?" for _ in cas_list)
-        for r in db.query(
-            f"SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
-            f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
-            f"WHERE fp.cas_id IN ({ph})", cas_list):
-            existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
-    linked = 0
-    ops = []
-    with db.tx() as conn:
-        for i, cas_id in ids.items():
-            if cas_id is None or cas_id not in existing:
-                continue
-            oid, opub = existing[cas_id]
-            conn.execute(
-                "UPDATE file_path SET object_id = ? WHERE id = ?",
-                (oid, rows[i]["id"]))
-            ops.append(sync.shared_update(
-                "file_path", rows[i]["pub_id"], "object_id", opub))
-            linked += 1
-        sync._insert_op_rows(conn, ops)
-
-    # ---- 3. create objects for the rest (mod.rs:231-331) ----
-    need_new = [i for i, c in ids.items() if c is None or c not in existing]
-    created = 0
-    ops = []
-    with db.tx() as conn:
-        by_cas: Dict[str, Tuple[int, bytes]] = {}
-        for i in need_new:
-            cas_id = ids[i]
-            if cas_id is not None and cas_id in by_cas:
-                oid, opub = by_cas[cas_id]  # same-chunk duplicate
+            if cas_id is not None and cas_id in existing:
+                pub_of[i] = existing[cas_id][1]
+                linked += 1
+            elif cas_id is not None and cas_id in by_cas:
+                pub_of[i] = by_cas[cas_id]  # same-chunk duplicate
             else:
-                opub = uuidlib.uuid4().bytes
+                opub = uuid4_bytes()
                 date_created = rows[i]["date_created"]
-                oid = conn.execute(
-                    "INSERT INTO object (pub_id, kind, date_created) "
-                    "VALUES (?, ?, ?)",
-                    (opub, kinds[i], date_created)).lastrowid
-                ops.extend(sync.shared_create(
-                    "object", opub,
-                    {"kind": kinds[i], "date_created": date_created}))
-                created += 1
+                new_objects.append((opub, kinds[i], date_created))
+                create_specs.append((opub, "c", None, None, {
+                    "kind": kinds[i], "date_created": date_created}))
                 if cas_id is not None:
-                    by_cas[cas_id] = (oid, opub)
-            conn.execute(
-                "UPDATE file_path SET object_id = ? WHERE id = ?",
-                (oid, rows[i]["id"]))
-            ops.append(sync.shared_update(
-                "file_path", rows[i]["pub_id"], "object_id", opub))
-        sync._insert_op_rows(conn, ops)
-    if ops:
+                    by_cas[cas_id] = opub
+                pub_of[i] = opub
+        tp = _mark("ops", tp)
+
+        # ---- domain writes: objects + ONE file_path update pass --------
+        conn.executemany(
+            "INSERT INTO object (pub_id, kind, date_created) "
+            "VALUES (?, ?, ?)", new_objects)
+        created = len(new_objects)
+        oid_of: Dict[bytes, int] = {
+            existing[c][1]: existing[c][0] for c in existing}
+        for chunk in _in_chunks([p for p, _, _ in new_objects]):
+            ph = ",".join("?" for _ in chunk)
+            for r in conn.execute(
+                f"SELECT id, pub_id FROM object WHERE pub_id IN ({ph})",
+                    chunk):
+                oid_of[r["pub_id"]] = r["id"]
+        conn.executemany(
+            "UPDATE file_path SET cas_id = ?, object_id = ? WHERE id = ?",
+            [(cas_id, oid_of[pub_of[i]], rows[i]["id"])
+             for i, cas_id in ids.items()])
+        tp = _mark("db", tp)
+
+        # ---- op log: cas_id updates, object creates, object_id links ---
+        # Same op stream the reference's three passes emit
+        # (mod.rs:144/231/167), appended in one bulk batch each.
+        n_ops += sync.bulk_shared_ops(conn, "file_path", [
+            (rows[i]["pub_id"], "u:cas_id", "cas_id", cas_id, None)
+            for i, cas_id in ids.items()])
+        n_ops += sync.bulk_shared_ops(conn, "object", create_specs)
+        n_ops += sync.bulk_shared_ops(conn, "file_path", [
+            (rows[i]["pub_id"], "u:object_id", "object_id", pub_of[i], None)
+            for i in ids])
+        tp = _mark("ops", tp)
+    _mark("db", tp)  # commit
+    if n_ops:
         sync._notify_created()
     return linked, created, list(read_errors.values())
 
@@ -182,6 +210,18 @@ class FileIdentifierJob(StatefulJob):
             auto = auto_device_batch(count)
             if auto is not None:
                 chunk = auto
+        if (self.device_batch is None and chunk == CHUNK_SIZE
+                and self.backend != "oracle"
+                and count >= staging.AUTO_DEVICE_MIN_ORPHANS):
+            # Big scan staying on the host plane: step in large chunks so
+            # the per-chunk orchestration (page fetch, op build, commit)
+            # amortizes — the wall is the host pipeline, not the hash.
+            # Native-plane only: it streams per file in C++, while the
+            # numpy fallback stages dense [B, 100 KiB] arrays per chunk
+            # (~420 MiB at 4096) and must keep the small reference step.
+            from .. import native as _native
+            if _native.available():
+                chunk = staging.AUTO_NATIVE_BATCH
         data = {
             "location_path": loc["path"],
             "sub_mat_path": sub_mat,
@@ -199,18 +239,45 @@ class FileIdentifierJob(StatefulJob):
     async def execute_step(self, ctx, data, step, step_number):
         return await asyncio.to_thread(self._step, ctx, data)
 
-    def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
+    def _fetch_page(self, ctx: JobContext, data: Dict[str, Any],
+                    cursor: int) -> List[Dict[str, Any]]:
         where, params = orphan_filters(
-            self.location_id, data["cursor"], data["sub_mat_path"])
-        rows = [dict(r) for r in ctx.db.query(
+            self.location_id, cursor, data["sub_mat_path"])
+        # sqlite3.Row supports ["name"] access directly — no dict() copy.
+        return ctx.db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
-            params + [data.get("chunk_size") or self.chunk_size])]
+            params + [data.get("chunk_size") or self.chunk_size])
+
+    def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
+        tf = time.perf_counter()
+        pre = getattr(self, "_prefetch", None)
+        rows = None
+        if pre is not None and pre[0] == data["cursor"]:
+            try:
+                rows = pre[1].result()
+            except Exception:
+                rows = None  # fall through to a synchronous fetch
+        self._prefetch = None
+        if rows is None:
+            rows = self._fetch_page(ctx, data, data["cursor"])
+        timings = data.setdefault("phase_s", {})
+        timings["fetch"] = (timings.get("fetch", 0.0)
+                            + time.perf_counter() - tf)
         if not rows:
             return StepOutcome()
+        # Overlap the next orphan-page SELECT with this chunk's
+        # hash+write work (the page past rows[-1].id is untouched by this
+        # chunk's updates, so the snapshot cannot go stale).
+        from ..ops.staging import _pool
+        self._prefetch = (
+            rows[-1]["id"] + 1,
+            _pool().submit(self._fetch_page, ctx, data, rows[-1]["id"] + 1))
         linked, created, errors = identify_chunk(
             ctx.library, self.location_id, data["location_path"], rows,
-            self.backend)
+            self.backend, timings=timings)
         data["cursor"] = rows[-1]["id"] + 1
+        timings["step_total"] = (timings.get("step_total", 0.0)
+                                 + time.perf_counter() - tf)
         data["linked"] += linked
         data["created"] += created
         data["skipped"] += len(errors)
@@ -228,4 +295,12 @@ class FileIdentifierJob(StatefulJob):
         )
 
     async def finalize(self, ctx, data, metadata):
+        # Publish the per-phase wall-time breakdown (fetch/prep/hash/db/
+        # ops seconds across all chunks) so workload runs can see where
+        # the ms/file goes — the profile VERDICT r2 asked for.
+        phase = data.get("phase_s")
+        if phase:
+            metadata["phase_ms"] = {
+                k: round(v * 1000.0, 1) for k, v in sorted(phase.items())}
+            metadata["chunk_size"] = data.get("chunk_size")
         return metadata

@@ -239,6 +239,13 @@ JAX_MIN_BATCH = 256
 AUTO_DEVICE_MIN_ORPHANS = 4096
 AUTO_DEVICE_BATCH = 16384  # amortizes ~7-10 ms per-dispatch overhead
 
+# When the device pipeline is NOT engaged, big scans still step in large
+# chunks so the per-chunk Python/SQL orchestration (orphan page fetch,
+# op building, transaction commit) amortizes over thousands of files
+# instead of the reference's 100 (file_identifier/mod.rs:36). The native
+# C++ plane streams per file, so chunk size costs no extra memory.
+AUTO_NATIVE_BATCH = 4096
+
 # The CAS pipeline is H2D-bound end-to-end (the pallas kernel sustains
 # ~30 GB/s, the AVX2 native plane ~3.5 GB/s): shipping bytes to the
 # device only pays when the host→device link is faster than the native
@@ -249,28 +256,88 @@ NATIVE_PLANE_GBPS = 3.5
 _H2D_GBPS: Optional[float] = None
 
 
-def h2d_gbps() -> float:
-    """Measured host→device bandwidth, probed once (8 MiB transfer).
+_H2D_PROBE_TTL = 3600.0
 
-    Syncs via a 1-element D2H fetch — on the axon platform
-    `block_until_ready` returns before the transfer lands.
+
+def _h2d_cache_path() -> Optional[str]:
+    """Probe-cache file inside a private 0700 per-user dir (a fixed name
+    directly in world-writable /tmp could be pre-created or symlinked by
+    another local user). Returns None when no safe dir can be had."""
+    import stat
+    import tempfile
+
+    d = os.path.join(tempfile.gettempdir(), f"sdtpu-{os.getuid()}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.lstat(d)
+        if (not stat.S_ISDIR(st.st_mode) or st.st_uid != os.getuid()
+                or st.st_mode & 0o077):
+            return None
+    except OSError:
+        return None
+    return os.path.join(d, "h2d_probe.json")
+
+
+def h2d_gbps() -> float:
+    """Measured host→device link bandwidth, probed once per process and
+    cached on disk for an hour (the probe itself costs a round trip, and
+    every identifier-job init consults it).
+
+    Sync is a FULL D2H fetch of the buffer: `block_until_ready` returns
+    early on the axon platform, and a sliced fetch (`w[0]`) would compile
+    a slice program remotely — seconds through the tunnel. The full
+    round trip measures H2D+D2H; assuming a roughly symmetric link the
+    per-direction rate is 2*nbytes/rt — the right go/no-go signal for
+    the H2D-bound CAS pipeline.
+
+    SDTPU_H2D_GBPS overrides (tests, benchmark pinning).
     """
     global _H2D_GBPS
-    if _H2D_GBPS is None:
-        import time
-
+    if _H2D_GBPS is not None:
+        return _H2D_GBPS
+    env = os.environ.get("SDTPU_H2D_GBPS")
+    if env:
         try:
-            import jax
+            _H2D_GBPS = float(env)
+            return _H2D_GBPS
+        except ValueError:
+            pass
+    import json
+    import time
 
-            buf = np.zeros((8 << 20,), dtype=np.uint8)
-            w = jax.device_put(buf)
-            np.asarray(w[0])  # warm + sync
-            t0 = time.perf_counter()
-            w = jax.device_put(buf)
-            np.asarray(w[0])
-            _H2D_GBPS = buf.nbytes / (time.perf_counter() - t0) / 1e9
+    cache = _h2d_cache_path()
+    if cache is not None:
+        try:
+            with open(cache) as f:
+                saved = json.load(f)
+            if time.time() - saved["t"] < _H2D_PROBE_TTL:
+                _H2D_GBPS = float(saved["gbps"])
+                return _H2D_GBPS
         except Exception:
-            _H2D_GBPS = 0.0
+            pass
+    ok = False
+    try:
+        import jax
+
+        buf = np.zeros((8 << 20,), dtype=np.uint8)
+        np.asarray(jax.device_put(buf))  # warm
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(buf))
+        rt = time.perf_counter() - t0
+        # Round trip moves the buffer twice; assuming a roughly
+        # symmetric link, one direction runs at 2*nbytes/rt.
+        _H2D_GBPS = 2 * buf.nbytes / rt / 1e9
+        ok = True
+    except Exception:
+        _H2D_GBPS = 0.0
+    if ok and cache is not None:
+        # Only successful probes are cached: a transient jax/device
+        # failure must stay per-process, not poison an hour of runs.
+        try:
+            with open(cache, "w") as f:
+                json.dump({"t": time.time(), "gbps": _H2D_GBPS}, f)
+        except OSError:
+            pass
     return _H2D_GBPS
 
 
@@ -349,6 +416,13 @@ def cas_ids_for_files(
 
     if backend == "auto":
         backend = default_backend(len(files))
+        if backend == "jax" and not device_pipeline_worthwhile():
+            # The CAS pipeline is H2D-bound: a device-worthy *batch size*
+            # is not enough when the host→device link is slower than the
+            # native plane hashes (compute-bound callers like phash make
+            # their own call via default_backend directly).
+            from .. import native as _native
+            backend = "native" if _native.available() else "numpy"
     if backend == "native":
         with device_span("cas_ids/native", batch=len(files)):
             return _cas_ids_native_fused(files)

