@@ -41,6 +41,7 @@ class ApiServer:
         self.router = router or mount_router(node)
         self.app = web.Application()
         self.app.router.add_get("/", self._index)
+        self.app.router.add_get("/manifest.webmanifest", self._manifest)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/rspc", self._rspc_ws)
         self.app.router.add_post("/rspc/{path}", self._rspc_http)
@@ -78,6 +79,22 @@ class ApiServer:
         from .webui import INDEX_HTML
 
         return web.Response(text=INDEX_HTML, content_type="text/html")
+
+    async def _manifest(self, _request: web.Request) -> web.Response:
+        """PWA manifest: with the reconnecting websocket client this
+        makes the web UI an installable standalone app — the honest
+        stand-in for the reference's Tauri desktop shell
+        (apps/desktop/src-tauri) in a runtime with no webview toolkit."""
+        return web.json_response({
+            "name": "Spacedrive TPU",
+            "short_name": "sdtpu",
+            "start_url": "/",
+            "display": "standalone",
+            "background_color": "#16161d",
+            "theme_color": "#16161d",
+            "description": "TPU-native virtual distributed filesystem",
+            "icons": [],
+        }, content_type="application/manifest+json")
 
     async def _rspc_http(self, request: web.Request) -> web.Response:
         path = request.match_info["path"]

@@ -21,6 +21,9 @@ INDEX_HTML = r"""<!doctype html>
 <html>
 <head>
 <meta charset="utf-8"/>
+<meta name="viewport" content="width=device-width, initial-scale=1"/>
+<meta name="theme-color" content="#16161d"/>
+<link rel="manifest" href="/manifest.webmanifest"/>
 <title>spacedrive-tpu</title>
 <style>
   :root { color-scheme: dark; }
@@ -83,6 +86,27 @@ INDEX_HTML = r"""<!doctype html>
   #toast { position: fixed; bottom: 10px; left: 250px; background: #333;
            color: #fff; padding: 6px 14px; border-radius: 6px;
            display: none; z-index: 9; }
+  #grid.media { grid-template-columns: repeat(auto-fill, 180px); }
+  #grid.media .cell { width: 180px; }
+  #grid.media .cell .thumb { width: 170px; height: 130px; }
+  tr.row { cursor: pointer; }
+  tr.row:hover { background: #22222e; }
+  tr.row.sel { background: #2a3550; }
+  #ctxmenu { position: fixed; background: #23232f; border: 1px solid #39394a;
+             border-radius: 6px; padding: 4px 0; z-index: 20; display: none;
+             min-width: 160px; box-shadow: 0 4px 16px #0008; }
+  #ctxmenu .mi { padding: 5px 14px; cursor: pointer; font-size: 13px; }
+  #ctxmenu .mi:hover { background: #3b82f6; }
+  #ctxmenu .sep { border-top: 1px solid #39394a; margin: 4px 0; }
+  .viewbtn { background: #2c2c3a; padding: 4px 8px; }
+  .viewbtn.on { background: #3b82f6; }
+  #onboard { position: fixed; inset: 0; background: #16161dee; z-index: 30;
+             display: flex; align-items: center; justify-content: center; }
+  #onboard .card { background: #1e1e28; border-radius: 12px; padding: 28px
+                   36px; width: 430px; }
+  #onboard h1 { font-size: 20px; }
+  .gear { float: right; opacity: .5; cursor: pointer; }
+  .gear:hover { opacity: 1; }
 </style>
 </head>
 <body>
@@ -104,6 +128,10 @@ INDEX_HTML = r"""<!doctype html>
   <div id="topbar">
     <div id="tabs"></div>
     <input id="search" placeholder="search names…" style="flex:1"/>
+    <button id="vgrid" class="viewbtn" title="grid view">▦</button>
+    <button id="vlist" class="viewbtn" title="list view">☰</button>
+    <button id="vmedia" class="viewbtn" title="media view">🖼</button>
+    <button id="pastebtn" class="ghost" style="display:none">paste</button>
     <button id="favbtn" class="ghost">★ favorites</button>
   </div>
   <div id="content">
@@ -112,22 +140,51 @@ INDEX_HTML = r"""<!doctype html>
   </div>
 </div>
 <div id="jobs"><h2>Jobs</h2><div id="joblist"></div></div>
+<div id="ctxmenu"></div>
 <div id="toast"></div>
 <script>
-let reqId = 0, pending = {}, subs = {};
+let reqId = 0, pending = {}, subs = {}, subSpecs = [];
 const wsProto = location.protocol === "https:" ? "wss" : "ws";
-const ws = new WebSocket(`${wsProto}://${location.host}/rspc`);
-const wsReady = new Promise(res => ws.onopen = res);
-ws.onmessage = (m) => {
-  const f = JSON.parse(m.data);
-  if (f.type === "response" && pending[f.id]) {
-    pending[f.id].resolve(f.result); delete pending[f.id];
-  } else if (f.type === "error" && pending[f.id]) {
-    pending[f.id].reject(new Error(f.message)); delete pending[f.id];
-  } else if (f.type === "event" && subs[f.id]) {
-    subs[f.id](f.data);
-  }
-};
+let ws = null, wsReady = null, reconnectDelay = 500;
+
+function connect() {
+  ws = new WebSocket(`${wsProto}://${location.host}/rspc`);
+  wsReady = new Promise(res => ws.onopen = () => {
+    reconnectDelay = 500;
+    // standing subscriptions survive reconnects (the standalone-client
+    // contract: the UI must keep working across server restarts)
+    for (const s of subSpecs) {
+      const id = ++reqId; subs[id] = s.cb;
+      ws.send(JSON.stringify({id, type: "subscription",
+                              path: s.path, input: s.input}));
+    }
+    res();
+  });
+  ws.onmessage = (m) => {
+    const f = JSON.parse(m.data);
+    if (f.type === "response" && pending[f.id]) {
+      pending[f.id].resolve(f.result); delete pending[f.id];
+    } else if (f.type === "error" && pending[f.id]) {
+      pending[f.id].reject(new Error(f.message)); delete pending[f.id];
+    } else if (f.type === "event" && subs[f.id]) {
+      subs[f.id](f.data);
+    }
+  };
+  ws.onclose = () => {
+    for (const id in pending) {
+      pending[id].reject(new Error("connection lost")); delete pending[id];
+    }
+    subs = {};
+    // Park wsReady on a fresh pending promise NOW: rpc() calls made
+    // during the backoff window must wait for the next socket, not
+    // send into the closed one and hang.
+    wsReady = new Promise(() => {});
+    toast(`reconnecting in ${Math.round(reconnectDelay / 1000)}s…`);
+    setTimeout(connect, reconnectDelay);
+    reconnectDelay = Math.min(reconnectDelay * 2, 15000);
+  };
+}
+connect();
 async function rpc(type, path, input) {
   await wsReady;
   const id = ++reqId;
@@ -136,19 +193,21 @@ async function rpc(type, path, input) {
 }
 const q = (p, i) => rpc("query", p, i);
 const mut = (p, i) => rpc("mutation", p, i);
-async function sub(path, input, cb) {
-  await wsReady;
-  const id = ++reqId;
-  subs[id] = cb;
-  ws.send(JSON.stringify({id, type: "subscription", path, input}));
+function sub(path, input, cb) {
+  subSpecs.push({path, input, cb});
+  if (ws && ws.readyState === 1) {  // otherwise onopen replays subSpecs
+    const id = ++reqId;
+    subs[id] = cb;
+    ws.send(JSON.stringify({id, type: "subscription", path, input}));
+  }
 }
 function toast(msg) {
   const t = document.getElementById("toast");
   t.textContent = msg; t.style.display = "block";
   clearTimeout(t._h); t._h = setTimeout(() => t.style.display = "none", 3000);
 }
-const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
-  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const fmtBytes = (n) => {
   n = Number(n) || 0;
   for (const u of ["B","KiB","MiB","GiB","TiB"]) {
@@ -159,6 +218,12 @@ const fmtBytes = (n) => {
 
 let lib = null, loc = null, curPath = "/", view = "explorer";
 let selected = null, tagFilter = null, favOnly = false, allTags = [];
+let viewMode = "grid";         // grid | list | media (explorer modes)
+let selection = new Set();     // multi-select: file_path ids
+let lastRows = [];             // rows rendered by the last browse()
+let lastClickId = null;        // shift-range anchor
+let clipboard = null;          // {op: "copy"|"cut", ids, locId}
+let settingsLoc = null;        // location id open in per-location settings
 
 const TABS = [["explorer","Explorer"],["dups","Duplicates"],
               ["neardups","Near-dups"],["jobs","Jobs"],["p2p","P2P"],
@@ -174,8 +239,47 @@ function renderTabs() {
   }
 }
 
+// ---- Onboarding (create library → add location, the reference's
+// interface/app/onboarding flow) ---------------------------------------
+function showOnboarding() {
+  if (document.getElementById("onboard")) return;
+  const o = document.createElement("div");
+  o.id = "onboard";
+  o.innerHTML = `<div class="card">
+    <h1>Welcome to spacedrive-tpu</h1>
+    <p class="muted">A library is your private database of every file
+      it indexes. Create one, then point it at a folder.</p>
+    <h3>1 · Create your library</h3>
+    <p><input id="oblib" placeholder="library name" value="My Library"
+              style="width:100%"/></p>
+    <h3>2 · Add a first location</h3>
+    <p><input id="obloc" placeholder="/path/to/files (optional)"
+              style="width:100%"/></p>
+    <p style="text-align:right"><button id="obgo">Create</button></p>
+    <div id="oberr" class="muted"></div>
+  </div>`;
+  document.body.appendChild(o);
+  document.getElementById("obgo").onclick = async () => {
+    const name = document.getElementById("oblib").value.trim();
+    if (!name) return;
+    try {
+      const l = await mut("library.create", {name});
+      lib = l.uuid;
+      const path = document.getElementById("obloc").value.trim();
+      if (path) {
+        loc = await mut("locations.create", {library_id: lib, path});
+        toast("indexing started");
+      }
+      o.remove(); loadAll();
+    } catch (err) {
+      document.getElementById("oberr").textContent = String(err);
+    }
+  };
+}
+
 async function loadLibs() {
   const libs = await q("library.list");
+  if (!libs.length) showOnboarding();
   const el = document.getElementById("libs"); el.innerHTML = "";
   for (const l of libs) {
     const d = document.createElement("div");
@@ -204,6 +308,14 @@ async function loadLocs() {
     const d = document.createElement("div");
     d.className = "item" + (loc === l.id ? " sel" : "");
     d.textContent = l.name || l.path;
+    const gear = document.createElement("span");
+    gear.className = "gear"; gear.textContent = "⚙";
+    gear.title = "location settings";
+    gear.onclick = (e) => {
+      e.stopPropagation();
+      settingsLoc = l.id; view = "locsettings"; renderTabs(); render();
+    };
+    d.prepend(gear);
     d.title = "click: open · right-click: rescan · shift-click: delete";
     d.oncontextmenu = async (e) => {
       e.preventDefault();
@@ -261,8 +373,10 @@ async function loadStats() {
 
 function render() {
   document.getElementById("inspector").style.display = "none";
+  hideCtx();
   ({explorer: browse, dups: renderDups, neardups: renderNearDups,
-    jobs: renderJobs, p2p: renderP2P, settings: renderSettings}[view])();
+    jobs: renderJobs, p2p: renderP2P, settings: renderSettings,
+    locsettings: renderLocSettings}[view])();
 }
 
 // ---- Explorer --------------------------------------------------------
@@ -296,18 +410,175 @@ async function browse() {
     const favIds = new Set((favs.items || []).map(o => o.id));
     items = items.filter(r => favIds.has(r.object_id));
   }
-  for (const r of items) {
-    grid.appendChild(cell(r, () => {
-      if (r.is_dir) {
-        curPath = r.materialized_path + r.name + "/";
-        document.getElementById("search").value = ""; browse();
-      } else inspect(r);
-    }));
+  if (viewMode === "media") {
+    const mediaExt = new Set(["png","jpg","jpeg","gif","webp","bmp","tiff",
+      "tif","heic","heif","avif","svg","svgz","pdf","avi","mp4","mkv",
+      "mov","webm"]);
+    items = items.filter(r => !r.is_dir
+      && mediaExt.has((r.extension || "").toLowerCase()));
+    grid.className = "media";
+  } else grid.className = "";
+  lastRows = items;
+  if (viewMode === "list") {
+    main.removeChild(grid);
+    const tbl = document.createElement("table");
+    tbl.innerHTML = "<tr><th></th><th>name</th><th>kind</th>" +
+      "<th>size</th><th>modified</th></tr>";
+    if (!searchText && curPath !== "/") {
+      const up = document.createElement("tr");
+      up.className = "row";
+      up.innerHTML = "<td>📁</td><td>..</td><td></td><td></td><td></td>";
+      up.onclick = () => { curPath = curPath.replace(/[^/]+\/$/, "");
+                           browse(); };
+      tbl.appendChild(up);
+    }
+    for (const r of items) tbl.appendChild(listRow(r));
+    main.appendChild(tbl);
+  } else {
+    for (const r of items) grid.appendChild(cell(r, null));
   }
+}
+
+function openEntry(r) {
+  if (r.is_dir) {
+    curPath = r.materialized_path + r.name + "/";
+    document.getElementById("search").value = ""; clearSel(); browse();
+  } else inspect(r);
+}
+
+// ---- multi-select + context menu -------------------------------------
+function clearSel() { selection.clear(); lastClickId = null; }
+function updateSelClasses() {
+  // selection changes repaint in place — no refetch, no DOM rebuild
+  document.querySelectorAll("[data-fpid]").forEach(el =>
+    el.classList.toggle("sel", selection.has(+el.dataset.fpid)));
+}
+function entryClick(r, e) {
+  if (e.shiftKey && lastClickId != null) {
+    const ids = lastRows.map(x => x.id);
+    const a = ids.indexOf(lastClickId), b = ids.indexOf(r.id);
+    if (a >= 0 && b >= 0) {
+      for (let k = Math.min(a, b); k <= Math.max(a, b); k++)
+        selection.add(ids[k]);
+    }
+    updateSelClasses();
+  } else if (e.ctrlKey || e.metaKey) {
+    selection.has(r.id) ? selection.delete(r.id) : selection.add(r.id);
+    lastClickId = r.id;
+    updateSelClasses();
+  } else {
+    selection.clear(); selection.add(r.id); lastClickId = r.id;
+    updateSelClasses();
+    openEntry(r);
+  }
+}
+function selRows() {
+  const rows = lastRows.filter(r => selection.has(r.id) && !r.is_dir);
+  return rows.length ? rows : [];
+}
+function hideCtx() {
+  const m = document.getElementById("ctxmenu");
+  if (m) m.style.display = "none";
+}
+document.addEventListener("click", hideCtx);
+document.addEventListener("keydown", (e) => {
+  if (e.key === "Escape") { clearSel(); hideCtx(); updateSelClasses(); }
+});
+function showCtx(r, e) {
+  e.preventDefault();
+  if (!selection.has(r.id)) {
+    selection.clear(); selection.add(r.id); lastClickId = r.id;
+    updateSelClasses();
+  }
+  const m = document.getElementById("ctxmenu");
+  const rows = selRows();
+  const n = rows.length;
+  const items = [
+    ["Open / inspect", () => openEntry(r)],
+    ["sep"],
+    [`Copy (${n})`, () => { clipboard = {op: "copy",
+       ids: rows.map(x => x.id), locId: loc}; pasteBtn(); }],
+    [`Cut (${n})`, () => { clipboard = {op: "cut",
+       ids: rows.map(x => x.id), locId: loc}; pasteBtn(); }],
+    [`Duplicate (${n})`, async () => {
+       await mut("files.duplicateFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id)});
+       toast("duplicating…"); }],
+    ["sep"],
+    [`★ Favorite (${n})`, async () => {
+       for (const x of rows) if (x.object_id != null)
+         await mut("files.setFavorite",
+                   {library_id: lib, id: x.object_id, favorite: true});
+       toast("favorited"); }],
+    [`Validate (${n})`, async () => {
+       await mut("jobs.objectValidator",
+                 {library_id: lib, id: loc, mode: "fill"});
+       toast("validator started"); }],
+    ["sep"],
+    [`Delete (${n})`, async () => {
+       if (!confirm(`delete ${n} file(s)?`)) return;
+       await mut("files.deleteFiles", {library_id: lib, location_id: loc,
+         file_path_ids: rows.map(x => x.id)});
+       toast("deleting…"); clearSel();
+       setTimeout(browse, 400); }],
+  ];
+  m.innerHTML = "";
+  for (const [label, fn] of items) {
+    if (label === "sep") {
+      const s = document.createElement("div"); s.className = "sep";
+      m.appendChild(s); continue;
+    }
+    const d = document.createElement("div");
+    d.className = "mi"; d.textContent = label;
+    d.onclick = (ev) => { ev.stopPropagation(); hideCtx(); fn(); };
+    m.appendChild(d);
+  }
+  m.style.left = Math.min(e.clientX, innerWidth - 180) + "px";
+  m.style.top = Math.min(e.clientY, innerHeight - items.length * 28) + "px";
+  m.style.display = "block";
+}
+function pasteBtn() {
+  const b = document.getElementById("pastebtn");
+  b.style.display = clipboard ? "" : "none";
+  if (clipboard) b.textContent =
+    `paste ${clipboard.ids.length} (${clipboard.op})`;
+}
+async function doPaste() {
+  if (!clipboard || loc == null) return;
+  const rel = curPath === "/" ? "" : curPath.slice(1);
+  const input = {library_id: lib, source_location_id: clipboard.locId,
+    sources_file_path_ids: clipboard.ids, target_location_id: loc,
+    target_location_relative_directory_path: rel};
+  await mut(clipboard.op === "cut" ? "files.cutFiles" : "files.copyFiles",
+            input);
+  toast(clipboard.op === "cut" ? "moving…" : "copying…");
+  if (clipboard.op === "cut") clipboard = null;
+  pasteBtn();
+  setTimeout(browse, 500);
+}
+
+function listRow(r) {
+  const tr = document.createElement("tr");
+  tr.className = "row" + (selection.has(r.id) ? " sel" : "");
+  const kindName = r.is_dir ? "folder" : (r.extension || "file");
+  const size = r.is_dir ? "" : fmtBytes(r.size_in_bytes || 0);
+  const dm = r.date_modified
+    ? new Date(r.date_modified * 1000).toISOString().slice(0, 16)
+        .replace("T", " ") : "";
+  tr.dataset.fpid = r.id;
+  tr.innerHTML = `<td>${r.is_dir ? "📁" : "🗎"}</td>` +
+    `<td>${esc(r.name)}${r.extension ? "." + esc(r.extension) : ""}</td>` +
+    `<td>${esc(kindName)}</td><td>${size}</td><td>${dm}</td>`;
+  tr.onclick = (e) => entryClick(r, e);
+  tr.ondblclick = () => openEntry(r);
+  tr.oncontextmenu = (e) => showCtx(r, e);
+  return tr;
 }
 function cell(r, onclick) {
   const c = document.createElement("div"); c.className = "cell";
-  if (selected && selected.id === r.id) c.className += " sel";
+  if (!onclick) c.dataset.fpid = r.id;
+  if (selection.has(r.id) || (selected && selected.id === r.id))
+    c.className += " sel";
   const t = document.createElement("div"); t.className = "thumb";
   if (r.cas_id) {
     const img = document.createElement("img");
@@ -318,8 +589,126 @@ function cell(r, onclick) {
   const n = document.createElement("div"); n.className = "nm";
   n.textContent = r.name + (r.extension ? "." + r.extension : "");
   c.appendChild(t); c.appendChild(n);
-  c.onclick = onclick;
+  if (onclick) c.onclick = onclick;       // the ".." up-cell
+  else {
+    c.onclick = (e) => entryClick(r, e);
+    c.ondblclick = () => openEntry(r);
+    c.oncontextmenu = (e) => showCtx(r, e);
+  }
   return c;
+}
+
+// ---- Per-location settings (indexer-rule editor, rescans) ------------
+const RULE_KINDS = [[0, "accept glob"], [1, "reject glob"],
+  [2, "accept if children"], [3, "reject if children"]];
+async function renderLocSettings() {
+  const main = document.getElementById("main");
+  if (!lib || settingsLoc == null) {
+    main.innerHTML = "<div class='muted'>no location selected</div>"; return;
+  }
+  const [l, allRules] = await Promise.all([
+    q("locations.getWithRules",
+      {library_id: lib, location_id: settingsLoc}),
+    q("locations.indexer_rules.list", {library_id: lib}),
+  ]);
+  if (!l) { main.innerHTML = "<div class='muted'>gone</div>"; return; }
+  const attached = new Set((l.indexer_rules || []).map(r => r.id));
+  main.innerHTML = `
+    <h1>Location settings — ${esc(l.name || l.path)}</h1>
+    <div class="kv">path: <b>${esc(l.path)}</b></div>
+    <div class="kv">id: <b>${l.id}</b> · hidden: <b>${l.hidden ? "yes"
+      : "no"}</b></div>
+    <p>
+      <input id="lsname" value="${esc(l.name || "")}"
+             placeholder="display name"/>
+      <button id="lsrename">rename</button>
+      <button id="lshide" class="ghost">${l.hidden ? "unhide" : "hide"}
+      </button>
+    </p>
+    <p>
+      <button id="lsfull">full rescan</button>
+      <button id="lsquick" class="ghost">quick rescan</button>
+      <button id="lsdelete" class="danger">remove location</button>
+    </p>
+    <h2>Indexer rules</h2>
+    <div class="muted">checked rules apply when this location is
+      indexed</div>
+    <div id="lsrules"></div>
+    <h3>New rule</h3>
+    <p>
+      <input id="nrname" placeholder="rule name" style="width:130px"/>
+      <select id="nrkind">${RULE_KINDS.map(([v, t]) =>
+        `<option value="${v}">${t}</option>`).join("")}</select>
+      <input id="nrglob" placeholder="glob, e.g. **/*.tmp"
+             style="width:160px"/>
+      <button id="nradd">add rule</button>
+    </p>`;
+  const rulesEl = document.getElementById("lsrules");
+  for (const r of allRules) {
+    const d = document.createElement("div"); d.className = "kv";
+    const cb = document.createElement("input");
+    cb.type = "checkbox"; cb.checked = attached.has(r.id);
+    cb.onchange = async () => {
+      const ids = new Set(attached);
+      cb.checked ? ids.add(r.id) : ids.delete(r.id);
+      await mut("locations.update", {library_id: lib, id: l.id,
+        indexer_rules_ids: [...ids]});
+      renderLocSettings();
+    };
+    d.appendChild(cb);
+    d.append(` ${r.name} `);
+    if (r.default_rule) {
+      const s = document.createElement("span");
+      s.className = "muted"; s.textContent = "(system)";
+      d.appendChild(s);
+    } else {
+      const del = document.createElement("button");
+      del.className = "danger"; del.textContent = "×";
+      del.onclick = async () => {
+        await mut("locations.indexer_rules.delete",
+                  {library_id: lib, id: r.id});
+        renderLocSettings();
+      };
+      d.appendChild(del);
+    }
+    rulesEl.appendChild(d);
+  }
+  document.getElementById("lsrename").onclick = async () => {
+    await mut("locations.update", {library_id: lib, id: l.id,
+      name: document.getElementById("lsname").value});
+    loadLocs(); renderLocSettings();
+  };
+  document.getElementById("lshide").onclick = async () => {
+    await mut("locations.update", {library_id: lib, id: l.id,
+      hidden: l.hidden ? 0 : 1});
+    renderLocSettings();
+  };
+  document.getElementById("lsfull").onclick = async () => {
+    await mut("locations.fullRescan",
+              {library_id: lib, location_id: l.id});
+    toast("full rescan started");
+  };
+  document.getElementById("lsquick").onclick = async () => {
+    await mut("locations.quickRescan",
+              {library_id: lib, location_id: l.id, sub_path: "/"});
+    toast("quick rescan started");
+  };
+  document.getElementById("lsdelete").onclick = async () => {
+    if (!confirm("remove this location from the library?")) return;
+    await mut("locations.delete", {library_id: lib, id: l.id});
+    if (loc === l.id) loc = null;
+    settingsLoc = null; view = "explorer"; renderTabs();
+    loadLocs(); render();
+  };
+  document.getElementById("nradd").onclick = async () => {
+    const name = document.getElementById("nrname").value.trim();
+    const glob = document.getElementById("nrglob").value.trim();
+    const kind = parseInt(document.getElementById("nrkind").value);
+    if (!name || !glob) { toast("name + glob required"); return; }
+    await mut("locations.indexer_rules.create", {library_id: lib,
+      name, rules: [[kind, [glob]]]});
+    renderLocSettings();
+  };
 }
 
 // ---- Inspector (file detail panel) -----------------------------------
@@ -670,6 +1059,19 @@ document.getElementById("favbtn").onclick = () => {
   document.getElementById("favbtn").className = favOnly ? "" : "ghost";
   if (view === "explorer") browse();
 };
+function setViewMode(m) {
+  viewMode = m;
+  for (const [id, mm] of [["vgrid","grid"],["vlist","list"],
+                          ["vmedia","media"]])
+    document.getElementById(id).className =
+      "viewbtn" + (viewMode === mm ? " on" : "");
+  if (view === "explorer") browse();
+}
+document.getElementById("vgrid").onclick = () => setViewMode("grid");
+document.getElementById("vlist").onclick = () => setViewMode("list");
+document.getElementById("vmedia").onclick = () => setViewMode("media");
+document.getElementById("pastebtn").onclick = doPaste;
+setViewMode("grid");
 
 sub("jobs.progress", null, (e) => {
   const el = document.getElementById("joblist");

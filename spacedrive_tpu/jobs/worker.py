@@ -246,6 +246,10 @@ class Worker:
             return await self._finish_cancel(state)
 
         meta = await self.job.finalize(ctx, state.data, state.run_metadata)
+        # Bulk jobs run with wal_autocheckpoint off (store/db.py); fold
+        # the accumulated WAL back now, off the event loop and without
+        # blocking concurrent writers.
+        await asyncio.to_thread(self.library.db.checkpoint_passive)
         if meta:
             r.metadata.update(meta)
         r.errors_text = errors

@@ -51,6 +51,33 @@ def _gps_to_degrees(values, ref: str) -> Optional[float]:
         return None
 
 
+def _heif_exif_fallback(path: str):
+    """(width, height, PIL Exif) for HEIF containers PIL cannot open —
+    the EXIF item + `ispe` size are readable without an HEVC decoder
+    (media/isobmff.py; the reference extracts HEIF EXIF via kamadak-exif
+    in sd-media-metadata)."""
+    from PIL import Image
+
+    ext = path.rsplit(".", 1)[-1].lower()
+    from .images import HEIF_EXTENSIONS
+
+    if ext not in HEIF_EXTENSIONS:
+        return None
+    try:
+        from .isobmff import heif_dimensions, heif_exif
+
+        with open(path, "rb") as f:
+            data = f.read()
+        dims = heif_dimensions(data) or (0, 0)
+        tiff = heif_exif(data)
+        exif = Image.Exif()
+        if tiff is not None:
+            exif.load(b"Exif\x00\x00" + tiff)
+        return dims[0], dims[1], exif
+    except Exception:
+        return None
+
+
 def extract_media_data(path: str) -> Optional[Dict[str, Any]]:
     """Returns a media_data row dict (without object_id), or None when the
     file has no readable EXIF."""
@@ -60,7 +87,10 @@ def extract_media_data(path: str) -> Optional[Dict[str, Any]]:
             width, height = im.size
             exif = im.getexif()
     except Exception:
-        return None
+        heif = _heif_exif_fallback(path)
+        if heif is None:
+            return None
+        width, height, exif = heif
 
     row: Dict[str, Any] = {
         "resolution": msgpack.packb({"width": width, "height": height}),

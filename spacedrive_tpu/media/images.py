@@ -69,14 +69,14 @@ def _svg_available() -> bool:
 
 
 def supported_extensions() -> List[str]:
-    """Extensions `format_image` can decode in this runtime."""
-    exts = sorted(GENERIC_EXTENSIONS)
-    if _heif_available():
-        exts += sorted(HEIF_EXTENSIONS)
-    exts += sorted(SVG_EXTENSIONS)
-    if _pdf_available():
-        exts += sorted(PDF_EXTENSIONS)
-    return exts
+    """Extensions `format_image` can decode in this runtime.
+
+    HEIF and PDF are always listed: with no native decoder present the
+    extraction paths (embedded JPEG / image-stream recovery) still
+    produce thumbnails for the common cases, and files outside that
+    envelope degrade per-file via UnsupportedFormat."""
+    return (sorted(GENERIC_EXTENSIONS) + sorted(HEIF_EXTENSIONS)
+            + sorted(SVG_EXTENSIONS) + sorted(PDF_EXTENSIONS))
 
 
 def format_image(path: str):
@@ -90,15 +90,33 @@ def format_image(path: str):
         im.load()
         return im
     if ext in HEIF_EXTENSIONS:
-        if not _heif_available():
-            raise UnsupportedFormat(
-                f"{ext}: HEIF decoding needs a PIL HEIF plugin "
-                "(not present in this runtime)")
-        import pillow_heif
+        if _heif_available():
+            import pillow_heif
+            from PIL import Image
+
+            pillow_heif.register_heif_opener()
+            im = Image.open(path)
+            im.load()
+            return im
+        # Decoder-free path: extract the container's embedded JPEG
+        # (JPEG-coded item or EXIF IFD1 thumbnail) — media/isobmff.py.
+        import io
+
         from PIL import Image
 
-        pillow_heif.register_heif_opener()
-        im = Image.open(path)
+        from .isobmff import BoxError, heif_embedded_jpeg
+
+        with open(path, "rb") as f:
+            data = f.read()
+        try:
+            jpeg = heif_embedded_jpeg(data)
+        except BoxError as e:
+            raise UnsupportedFormat(f"{ext}: {e}") from e
+        if jpeg is None:
+            raise UnsupportedFormat(
+                f"{ext}: no embedded JPEG item or EXIF thumbnail "
+                "(full HEVC decode unavailable in this runtime)")
+        im = Image.open(io.BytesIO(jpeg))
         im.load()
         return im
     if ext in SVG_EXTENSIONS:
@@ -106,16 +124,21 @@ def format_image(path: str):
 
         return render_svg(path, target_px=SVG_TARGET_PX)
     if ext in PDF_EXTENSIONS:
-        if not _pdf_available():
-            raise UnsupportedFormat(
-                f"{ext}: PDF rendering needs pypdfium2 "
-                "(not present in this runtime)")
-        import pypdfium2
+        if _pdf_available():
+            import pypdfium2
 
-        pdf = pypdfium2.PdfDocument(path)
-        page = pdf[0]
-        scale = PDF_RENDER_WIDTH / page.get_size()[0]
-        return page.render(scale=scale).to_pil()
+            pdf = pypdfium2.PdfDocument(path)
+            page = pdf[0]
+            scale = PDF_RENDER_WIDTH / page.get_size()[0]
+            return page.render(scale=scale).to_pil()
+        # Renderer-free path: recover the page's image stream directly
+        # (DCTDecode = embedded JPEG, FlateDecode = raw samples).
+        from .pdf import PdfImageError, pdf_first_image
+
+        try:
+            return pdf_first_image(path)
+        except PdfImageError as e:
+            raise UnsupportedFormat(str(e)) from e
     raise UnsupportedFormat(f"unsupported image extension: {ext!r}")
 
 

@@ -34,6 +34,8 @@ import xml.etree.ElementTree as ET
 from typing import List, Optional, Tuple
 
 SS = 4  # supersampling factor
+# Decompressed .svgz ceiling — same budget as images.MAXIMUM_FILE_SIZE.
+_MAX_DECOMPRESSED = 192 * (1 << 20)
 
 _FLOAT = r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?"
 _NUM_RE = re.compile(_FLOAT)
@@ -396,7 +398,42 @@ def render_svg(path: str, target_px: float = 262_144.0):
     with open(path, "rb") as f:
         head = f.read(2)
         f.seek(0)
-        data = gzip.open(f).read() if head == b"\x1f\x8b" else f.read()
+        if head == b"\x1f\x8b":
+            # Chunked decompress with a hard output ceiling: a tiny
+            # crafted .svgz must not expand past the same 192 MiB budget
+            # that bounds on-disk inputs (images._check_size only guards
+            # the compressed size).
+            chunks, total = [], 0
+            with gzip.open(f) as gz:
+                while True:
+                    chunk = gz.read(1 << 20)
+                    if not chunk:
+                        break
+                    total += len(chunk)
+                    if total > _MAX_DECOMPRESSED:
+                        raise ValueError(
+                            f"{path}: decompressed SVG exceeds "
+                            f"{_MAX_DECOMPRESSED >> 20} MiB")
+                    chunks.append(chunk)
+            data = b"".join(chunks)
+        else:
+            data = f.read()
+    # Reject entity declarations before parsing: xml.etree expands
+    # internal entities, so a billion-laughs/quadratic-blowup document
+    # reached by the automatic thumbnail job could exhaust node memory.
+    # A bare external DOCTYPE (the legacy W3C header every old
+    # Illustrator/Inkscape file carries) is harmless — expat never
+    # fetches external DTDs — so only an internal subset (the "[...]"
+    # block that could hold ENTITY declarations) is refused.
+    if b"<!ENTITY" in data:
+        raise ValueError(f"{path}: SVG with entity declarations "
+                         "is not supported")
+    doc = data.find(b"<!DOCTYPE")
+    if doc != -1:
+        gt = data.find(b">", doc)
+        if gt == -1 or b"[" in data[doc:gt]:
+            raise ValueError(f"{path}: SVG DOCTYPE with internal "
+                             "subset is not supported")
     root = ET.fromstring(data)
     if _strip_ns(root.tag) != "svg":
         raise ValueError(f"{path}: not an SVG document")

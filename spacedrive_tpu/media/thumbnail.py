@@ -24,11 +24,14 @@ THUMBNAIL_CACHE_VERSION = 1
 # Extensions the media dispatch can always thumbnail here: the PIL
 # raster set, SVG via the self-hosted rasterizer (media/svg.py), and
 # MJPEG `.avi` via the self-hosted container parser (media/mjpeg.py);
-# HEIF/PDF remain runtime-gated. Other video containers join via
-# `thumbnailable_extensions()` when ffmpeg is on PATH.
+# HEIF/PDF run decoder-free via embedded-payload extraction
+# (media/isobmff.py, media/pdf.py); files outside that envelope degrade
+# per-file. Other video containers join via `thumbnailable_extensions()`
+# when ffmpeg is on PATH.
 THUMBNAILABLE_EXTENSIONS = {
     "jpg", "jpeg", "png", "gif", "bmp", "tiff", "webp", "ico", "apng",
     "svg", "svgz", "avi",
+    "heic", "heif", "heifs", "heics", "avif", "avci", "avcs", "pdf",
 }
 
 

@@ -174,10 +174,20 @@ class NearDupDetectorJob(StatefulJob):
         digests = np.stack([phash_from_bytes(r["phash"]) for r in rows])
 
         from ..ops.blake3_pallas import supported as tpu_present
+        errors = []
         if len(rows) <= ALL_PAIRS_LIMIT or tpu_present():
             # Exact — the two-pass device sweep holds to 1M+ digests
-            # (tools/near_dup_scale.py records runtime + recall=1).
-            pairs = near_dup_pairs(digests, self.threshold)
+            # (tools/near_dup_scale.py records runtime + recall=1) — up
+            # to the MAX_TOTAL_PAIRS output budget; truncation in
+            # degenerate clusters is surfaced as a job error.
+            stats: dict = {}
+            pairs = near_dup_pairs(digests, self.threshold, stats=stats)
+            if stats.get("truncated_pairs"):
+                errors.append(
+                    f"near-dup pair list truncated: ~"
+                    f"{stats['truncated_pairs']} pairs in degenerate "
+                    "near-identical clusters were dropped "
+                    "(MAX_TOTAL_PAIRS budget)")
         else:
             # No device at huge N: probabilistic LSH fallback (recall
             # measured ~0.43 vs exact at threshold 10, near_dup_pairs_lsh).
@@ -199,7 +209,7 @@ class NearDupDetectorJob(StatefulJob):
                     "DO UPDATE SET distance = excluded.distance",
                     (a, b, d, now))
         data["pairs_found"] = len(pairs)
-        return StepOutcome(metadata={"pairs": len(pairs)})
+        return StepOutcome(errors=errors, metadata={"pairs": len(pairs)})
 
     async def finalize(self, ctx, data, metadata):
         metadata.setdefault("hashed", data["hashed"])

@@ -103,7 +103,7 @@ def identify_chunk(library, location_id: int, location_path: str,
                 f"FROM file_path fp JOIN object o ON o.id = fp.object_id "
                 f"WHERE fp.cas_id IN ({ph})", chunk):
                 existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
-        tp = _mark("db", tp)
+        tp = _mark("db_link", tp)
 
         # ---- resolve every row to an object: link or create ------------
         by_cas: Dict[str, bytes] = {}
@@ -144,7 +144,7 @@ def identify_chunk(library, location_id: int, location_path: str,
             "UPDATE file_path SET cas_id = ?, object_id = ? WHERE id = ?",
             [(cas_id, oid_of[pub_of[i]], rows[i]["id"])
              for i, cas_id in ids.items()])
-        tp = _mark("db", tp)
+        tp = _mark("db_write", tp)
 
         # ---- op log: cas_id updates, object creates, object_id links ---
         # Same op stream the reference's three passes emit
@@ -157,7 +157,7 @@ def identify_chunk(library, location_id: int, location_path: str,
             (rows[i]["pub_id"], "u:object_id", "object_id", pub_of[i], None)
             for i in ids])
         tp = _mark("ops", tp)
-    _mark("db", tp)  # commit
+    _mark("db_commit", tp)
     if n_ops:
         sync._notify_created()
     return linked, created, list(read_errors.values())
@@ -211,7 +211,7 @@ class FileIdentifierJob(StatefulJob):
             if auto is not None:
                 chunk = auto
         if (self.device_batch is None and chunk == CHUNK_SIZE
-                and self.backend != "oracle"
+                and self.backend in ("auto", "native")
                 and count >= staging.AUTO_DEVICE_MIN_ORPHANS):
             # Big scan staying on the host plane: step in large chunks so
             # the per-chunk orchestration (page fetch, op build, commit)

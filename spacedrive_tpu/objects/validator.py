@@ -46,6 +46,11 @@ class ObjectValidatorJob(StatefulJob):
         self.mode = mode
 
     async def init(self, ctx: JobContext):
+        """Cursor-paginated steps (same shape as the identifier): the
+        resumable state is (WHERE, cursor, counters) — O(1) regardless
+        of scan size. The old design serialized every pending row into
+        the step list, which made the 3 s crash checkpoint re-msgpack
+        ~100 MB of state at 1M files."""
         db = ctx.db
         from ..locations.file_path_helper import job_prologue
         checksum_filter = ("integrity_checksum IS NULL"
@@ -55,41 +60,56 @@ class ObjectValidatorJob(StatefulJob):
             db, self.location_id, self.sub_path,
             f"location_id = ? AND is_dir = 0 AND {checksum_filter}",
             [self.location_id])
-        rows = db.query(
-            f"SELECT id, pub_id, materialized_path, name, extension, "
-            f"integrity_checksum FROM file_path WHERE {where} ORDER BY id",
-            params)
-        if not rows:
+        count = db.query_one(
+            f"SELECT COUNT(*) AS n FROM file_path WHERE {where}",
+            params)["n"]
+        if count == 0:
             raise EarlyFinish("nothing to validate")
-        steps = []
-        batch: List[Dict[str, Any]] = []
-        for r in rows:
-            batch.append({
-                "id": r["id"], "pub_id": r["pub_id"],
-                "materialized_path": r["materialized_path"],
-                "name": r["name"] or "", "extension": r["extension"] or "",
-                "expected": r["integrity_checksum"],
-            })
-            if len(batch) == CHUNK_SIZE:
-                steps.append({"rows": batch})
-                batch = []
-        if batch:
-            steps.append({"rows": batch})
-        data = {"location_path": loc["path"], "validated": 0,
-                "mismatched": 0}
+        chunk = CHUNK_SIZE
+        from .. import native
+        if (self.backend in ("auto", "native", "jax")
+                and native.available() and count >= 4096):
+            # Big scans on the batched planes step in large chunks so the
+            # per-step orchestration amortizes (identifier rationale).
+            chunk = 2048
+        data = {"location_path": loc["path"], "where": where,
+                "params": list(params), "cursor": 0, "chunk": chunk,
+                "validated": 0, "mismatched": 0}
+        steps = [{} for _ in range(-(-count // chunk))]
         ctx.progress(task_count=len(steps))
         return data, steps
 
     async def execute_step(self, ctx, data, step, step_number):
         return await asyncio.to_thread(self._step, ctx, data, step)
 
+    def _fetch_rows(self, db, data) -> List[Dict[str, Any]]:
+        rows = db.query(
+            f"SELECT id, pub_id, materialized_path, name, extension, "
+            f"integrity_checksum FROM file_path WHERE {data['where']} "
+            f"AND id >= ? ORDER BY id LIMIT ?",
+            list(data["params"]) + [data["cursor"], data["chunk"]])
+        return [{
+            "id": r["id"], "pub_id": r["pub_id"],
+            "materialized_path": r["materialized_path"],
+            "name": r["name"] or "", "extension": r["extension"] or "",
+            "expected": r["integrity_checksum"],
+        } for r in rows]
+
     def _checksums_jax(self, jobs, errors):
         """Sequence-sharded device checksums, one file at a time in
-        mesh-window streams (window ≈ 8 MiB per device)."""
+        mesh-window streams (whole-mesh window ≈ 8 MiB, i.e. ≈ 8 MiB / D
+        per device)."""
+        import jax
+
         from ..ops.seqhash import sharded_file_checksum
         from ..parallel.mesh import batch_mesh
 
-        mesh = batch_mesh()
+        # Streaming windows need a power-of-two device count (subtree
+        # alignment); on e.g. a 6- or 12-device mesh use the largest
+        # power-of-two subset instead of erroring on every file.
+        devices = list(jax.devices())
+        pow2 = 1 << (len(devices).bit_length() - 1)
+        mesh = batch_mesh(devices[:pow2])
         D = int(mesh.devices.size)
         shard_chunks = max(64, (8 << 20) // (D * 1024))
         # power-of-two shard size for subtree alignment
@@ -104,8 +124,15 @@ class ObjectValidatorJob(StatefulJob):
     def _step(self, ctx: JobContext, data, step) -> StepOutcome:
         db, sync = ctx.db, ctx.library.sync
         loc_path = data["location_path"]
+        rows = self._fetch_rows(db, data)
+        if not rows:
+            return StepOutcome()
+        # Advance past this page only once it is fully processed (end of
+        # this method) — an interrupted step replays the same page, and
+        # the guarded UPDATE keeps the replay idempotent.
+        next_cursor = rows[-1]["id"] + 1
         jobs: List[Tuple[dict, str]] = []
-        for r in step["rows"]:
+        for r in rows:
             iso = IsolatedPath.from_db_row(
                 self.location_id, False, r["materialized_path"],
                 r["name"], r["extension"])
@@ -163,6 +190,7 @@ class ObjectValidatorJob(StatefulJob):
                             "file_path_id": r["id"], "path": path,
                         })
             data["validated"] += len(results)
+            data["cursor"] = next_cursor
             ctx.progress(message=(
                 f"verified {data['validated']} files, "
                 f"{data['mismatched']} mismatches"))
@@ -170,19 +198,18 @@ class ObjectValidatorJob(StatefulJob):
                 "validated": data["validated"],
                 "mismatched": data["mismatched"]})
 
-        ops = []
         with db.tx() as conn:
-            for r, _path, checksum in results:
-                conn.execute(
-                    "UPDATE file_path SET integrity_checksum = ? "
-                    "WHERE id = ? AND integrity_checksum IS NULL",
-                    (checksum, r["id"]))
-                ops.append(sync.shared_update(
-                    "file_path", r["pub_id"], "integrity_checksum", checksum))
-            sync._insert_op_rows(conn, ops)
-        if ops:
+            conn.executemany(
+                "UPDATE file_path SET integrity_checksum = ? "
+                "WHERE id = ? AND integrity_checksum IS NULL",
+                [(checksum, r["id"]) for r, _p, checksum in results])
+            n_ops = sync.bulk_shared_ops(conn, "file_path", [
+                (r["pub_id"], "u:integrity_checksum", "integrity_checksum",
+                 checksum, None) for r, _p, checksum in results])
+        if n_ops:
             sync._notify_created()
         data["validated"] += len(results)
+        data["cursor"] = next_cursor
         ctx.progress(message=f"validated {data['validated']} files")
         return StepOutcome(errors=errors,
                            metadata={"validated": data["validated"]})

@@ -21,7 +21,7 @@ W=8 for full 256-bit BLAKE3).
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,8 +69,14 @@ def near_dup_pairs(
     digests: np.ndarray,
     threshold: int,
     tile: int = 4096,
+    stats: Optional[Dict[str, int]] = None,
 ) -> List[Tuple[int, int]]:
-    """All (i < j) index pairs with Hamming distance ≤ threshold. Exact.
+    """All (i < j) index pairs with Hamming distance ≤ threshold.
+
+    Exact up to the MAX_TOTAL_PAIRS output budget: degenerate
+    near-identical clusters past ~4M qualifying pairs are truncated by
+    the multi-tile sweep, and `stats["truncated_pairs"]` (when a dict is
+    passed) records how many were dropped so job reports can surface it.
 
     One-tile batches run as a single masked call; anything larger
     delegates to the two-pass tiled sweep (`near_dup_pairs_device`),
@@ -85,7 +91,7 @@ def near_dup_pairs(
             _near_mask_tile(digests, digests, threshold)), k=1)
         ii, jj = np.nonzero(mask)
         return list(zip(ii.tolist(), jj.tolist()))
-    return near_dup_pairs_device(digests, threshold, tile=tile)
+    return near_dup_pairs_device(digests, threshold, tile=tile, stats=stats)
 
 
 def exact_dup_groups(ids: List[str]) -> Dict[str, List[int]]:
@@ -273,16 +279,9 @@ def _tile_counts_block(planes, row0, threshold, n, block: int):
     return jax.lax.map(row, jnp.arange(block))
 
 
-@functools.partial(jax.jit, static_argnames=("size", "sub"))
-def _refine_counts(flat, coords, threshold, n, size: int, sub: int):
-    """Subdivide count blocks: for each (row0, col0) block origin pair
-    in `coords` (units of `size` rows/cols of the flat plane array),
-    return [F, sub, sub] int32 pair counts of its sub-blocks.
-
-    Pure matmul + reshape-reduce — the extraction pyramid never runs
-    nonzero/cumsum on device (a [4096,4096] nonzero measured ~150 ms
-    per tile; this refinement is ~2 ms per tile).
-    """
+def _refine_body(flat, coords, threshold, n, size: int, sub: int):
+    """Core of the refinement step, shared by the single-device jit and
+    the shard_map multi-device layout."""
     NP, BITS = flat.shape
 
     def one(rc):
@@ -299,6 +298,80 @@ def _refine_counts(flat, coords, threshold, n, size: int, sub: int):
                        dtype=jnp.int32)
 
     return jax.lax.map(one, coords)
+
+
+@functools.partial(jax.jit, static_argnames=("size", "sub"))
+def _refine_counts(flat, coords, threshold, n, size: int, sub: int):
+    """Subdivide count blocks: for each (row0, col0) block origin pair
+    in `coords` (units of `size` rows/cols of the flat plane array),
+    return [F, sub, sub] int32 pair counts of its sub-blocks.
+
+    Pure matmul + reshape-reduce — the extraction pyramid never runs
+    nonzero/cumsum on device (a [4096,4096] nonzero measured ~150 ms
+    per tile; this refinement is ~2 ms per tile).
+    """
+    return _refine_body(flat, coords, threshold, n, size, sub)
+
+
+def make_sharded_pyramid(mesh):
+    """The near-dup pyramid's counts + refine stages laid out for a
+    1-D device mesh — the multi-chip form of `near_dup_pairs_device`.
+
+    counts: the tile-row axis is sharded (each device owns NT/D row
+    tiles); column tiles arrive by `all_gather` over the mesh axis, so
+    the full [NT, NT] count grid is produced with each device doing an
+    equal slice of the matmul sweep.
+
+    refine: the flagged-block axis is sharded — each device refines its
+    own block set against a replicated plane array (blocks are
+    independent, zero collectives).
+
+    Returns (counts_fn, refine_fn):
+      counts_fn(planes [NT, T, BITS], threshold, n) -> [NT, NT] int32
+      refine_fn(flat [NP, BITS], coords [F, 2], threshold, n) ->
+          [F, sub, sub] int32   (size/sub fixed at tile → REFINE_SUB)
+    """
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("data", None, None), P(), P()),
+        out_specs=P("data", None))
+    def counts_fn(planes_shard, threshold, n):
+        local_nt, T, BITS = planes_shard.shape
+        base = jax.lax.axis_index("data") * local_nt
+        planes_all = jax.lax.all_gather(
+            planes_shard, "data", tiled=True)
+        NT = planes_all.shape[0]
+
+        def row(k):
+            x = planes_shard[k]
+
+            def col(j):
+                dots = jax.lax.dot_general(
+                    x, planes_all[j], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return jnp.sum(
+                    _pair_mask(dots, base + k, j, T, BITS, threshold, n),
+                    dtype=jnp.int32)
+
+            return jax.lax.map(col, jnp.arange(NT))
+
+        return jax.lax.map(row, jnp.arange(local_nt))
+
+    def make_refine(size: int, sub: int):
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, None), P("data", None), P(), P()),
+            out_specs=P("data", None, None))
+        def refine_fn(flat, coords_shard, threshold, n):
+            return _refine_body(flat, coords_shard, threshold, n,
+                                size, sub)
+
+        return refine_fn
+
+    return counts_fn, make_refine
 
 
 @functools.partial(jax.jit, static_argnames=("size",))
@@ -337,7 +410,9 @@ def _pow2(n: int) -> int:
 
 
 def near_dup_pairs_device(digests: np.ndarray, threshold: int,
-                          tile: int = 4096) -> List[Tuple[int, int]]:
+                          tile: int = 4096,
+                          stats: Optional[Dict[str, int]] = None,
+                          ) -> List[Tuple[int, int]]:
     """Exact all-pairs (i < j, distance ≤ threshold) at large N on the
     device — a bounded number of jit dispatches, each sweeping thousands
     of tiles (see block comment above). Returns the same pairs as
@@ -348,7 +423,9 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
     → 1.25e9 pairs → ~100 GB of host tuples); past the budget the
     densest tiles are dropped with a warning — their exact-equality
     core is already collapsed by the CAS dedup pass, and a pair list
-    that size is noise for any consumer."""
+    that size is noise for any consumer. When truncation happens,
+    `stats["truncated_pairs"]` carries the dropped-pair estimate (in
+    addition to the RuntimeWarning) so callers can record it."""
     digests = np.ascontiguousarray(digests, dtype=np.uint32)
     N, W = digests.shape
     if N < 2:
@@ -388,6 +465,9 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
             f"near_dup_pairs_device: truncating ~{dropped} pairs in "
             "degenerate near-identical clusters (MAX_TOTAL_PAIRS "
             f"= {MAX_TOTAL_PAIRS})", RuntimeWarning)
+        if stats is not None:
+            stats["truncated_pairs"] = (
+                stats.get("truncated_pairs", 0) + dropped)
         coords = coords[order][keep]
         if len(coords) == 0:
             return []

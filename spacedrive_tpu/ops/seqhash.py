@@ -182,9 +182,15 @@ class StreamingShardedChecksum:
                 f"window ({self._window_bytes} B) exceeds the 2 GiB "
                 "int32 device bound; lower shard_chunks")
         self._buf = bytearray()
-        self._windows_done = 0     # full windows already folded
+        self._windows_done = 0     # full windows already folded (see
+        # the `windows_folded` property)
         self._stack: list = []     # subtree CVs, shallowest first
         self._sharding = NamedSharding(mesh, P("data", None))
+
+    @property
+    def windows_folded(self) -> int:
+        """Full windows already reduced on-device (diagnostics)."""
+        return self._windows_done
 
     def update(self, data: bytes) -> "StreamingShardedChecksum":
         self._buf += data

@@ -55,6 +55,11 @@ class Model:
     relation: Optional[Tuple[str, str]] = None
     uniques: Tuple[Tuple[str, ...], ...] = ()
     indexes: Tuple[Tuple[str, ...], ...] = ()
+    # Indexes that only serve a subsystem's READ paths (e.g. the op
+    # log's sync-side lookups) and would tax every bulk local write:
+    # excluded from bootstrap DDL, built on first use via
+    # Database.ensure_lazy_indexes(table).
+    lazy_indexes: Tuple[Tuple[str, ...], ...] = ()
 
     def field(self, name: str) -> Field:
         for f in self.fields:
@@ -104,7 +109,13 @@ register(Model(
         Field("instance_id", "INTEGER", nullable=False,
               references="instance(id)"),
     ),
-    indexes=(("timestamp",), ("model", "record_id")),
+    # Both indexes serve only the sync read paths (get_ops watermark
+    # scans, ingest LWW compare). Local bulk writers (identifier/
+    # indexer/validator) append millions of op rows, and the random
+    # (model, record_id) btree inserts were the measured superlinear
+    # cost at 1M files — so the indexes build lazily on first sync use
+    # (SyncManager._ensure_sync_indexes) instead of taxing every scan.
+    lazy_indexes=(("timestamp",), ("model", "record_id")),
 ))
 
 # Relation ops that arrived before the rows they reference (cross-
@@ -134,7 +145,7 @@ register(Model(
         Field("instance_id", "INTEGER", nullable=False,
               references="instance(id)"),
     ),
-    indexes=(("timestamp",),),
+    lazy_indexes=(("timestamp",),),  # sync-side reads only, as above
 ))
 
 # --- Instances (schema.prisma:70-97): one row per (device, library). ------
@@ -533,6 +544,16 @@ def ddl_for(model: Model) -> List[str]:
             "(" + ", ".join(idx) + ")"
         )
     return stmts
+
+
+def lazy_index_ddl(table: str) -> List[str]:
+    """CREATE INDEX statements for a table's lazily-built indexes."""
+    model = MODELS[table]
+    return [
+        f"CREATE INDEX IF NOT EXISTS idx_{model.name}_" + "_".join(idx)
+        + f" ON {model.name} (" + ", ".join(idx) + ")"
+        for idx in model.lazy_indexes
+    ]
 
 
 def all_ddl() -> List[str]:

@@ -57,7 +57,18 @@ class SyncManager:
         # instance pub_id → local row id, and → last-seen NTP64.
         self._instance_ids: Dict[bytes, int] = {}
         self.timestamps: Dict[bytes, int] = {}
+        self._sync_indexes_ready = False
         self._load_instances()
+
+    def _ensure_sync_indexes(self) -> None:
+        """Build the op-log read indexes on first sync use — they are
+        declared lazy (store/models.py) so bulk local writers never pay
+        per-row index maintenance on tables only sync reads."""
+        if self._sync_indexes_ready:
+            return
+        self.db.ensure_lazy_indexes("shared_operation")
+        self.db.ensure_lazy_indexes("relation_operation")
+        self._sync_indexes_ready = True
 
     def _load_instances(self) -> None:
         for row in self.db.query("SELECT id, pub_id, timestamp FROM instance"):
@@ -241,6 +252,7 @@ class SyncManager:
         """Ops newer than the given per-instance watermarks, plus all ops
         from instances absent from the watermark list, ordered by
         (timestamp, instance), limited to args.count."""
+        self._ensure_sync_indexes()
         clock_ids = [pub for pub, _ in args.clocks]
         results: List[Tuple[int, bytes, CRDTOperation]] = []
         for table, is_shared in (("shared_operation", True),
@@ -313,6 +325,7 @@ class SyncManager:
     def receive_crdt_operation(self, op: CRDTOperation) -> bool:
         """Ingest one remote op; returns True if applied, False if stale
         (receive_crdt_operation, ingest.rs:110-160)."""
+        self._ensure_sync_indexes()
         self.clock.update_with_timestamp(op.timestamp)
         ts = max(self.timestamps.get(op.instance, op.timestamp), op.timestamp)
 

@@ -211,3 +211,44 @@ def test_device_rejects_non_pow2_tile():
     d = np.zeros((3000, 2), dtype=np.uint32)
     with pytest.raises(ValueError):
         near_dup_pairs_device(d, threshold=0, tile=1000)
+
+
+def test_sharded_pyramid_matches_single_device():
+    """make_sharded_pyramid (mesh counts via all-gather + sharded
+    refine) must agree with the single-device pyramid kernels on the
+    virtual 8-device mesh."""
+    import jax
+
+    from spacedrive_tpu.ops import hamming as H
+    from spacedrive_tpu.parallel.mesh import batch_mesh
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = batch_mesh(devices[:8])
+
+    T, NT = 32, 8
+    N = T * NT
+    rng = np.random.default_rng(5)
+    d = rng.integers(0, 2**32, size=(N, 2), dtype=np.uint32)
+    d[3] = d[77]  # cross-tile planted pair
+    d[10] = d[11]
+    flat = H._bit_planes(np.asarray(d))
+    planes = np.asarray(flat).reshape(NT, T, 64)
+
+    thr, n = np.int32(4), np.int32(N)
+    counts_fn, make_refine = H.make_sharded_pyramid(mesh)
+    got = np.asarray(counts_fn(planes, thr, n))
+    want = np.asarray(H._tile_counts_block(
+        planes, np.int32(0), thr, n, NT))
+    assert got.shape == want.shape == (NT, NT)
+    assert (got == want).all()
+
+    coords = np.argwhere(want > 0).astype(np.int32)
+    pad = -(-len(coords) // 8) * 8
+    coords_p = np.vstack([coords] + [coords[:1]] * (pad - len(coords)))
+    ref_sharded = np.asarray(make_refine(T, 16)(
+        flat, coords_p, thr, n))[: len(coords)]
+    ref_single = np.asarray(H._refine_counts(
+        flat, coords, thr, n, T, 16))
+    assert (ref_sharded == ref_single).all()

@@ -1,0 +1,141 @@
+"""Build minimal-but-valid HEIC fixtures for the extraction tests.
+
+No HEVC encoder exists in this image, so the fixtures mirror the real
+container shape (ftyp/meta/iloc/iinf/iref/mdat per ISO 14496-12 +
+23008-12) with an hvc1 primary item whose payload is opaque, plus the
+payloads the extractor actually reads:
+
+    fixture "thumb":  a JPEG-coded item `thmb`-referencing the primary
+    fixture "exif":   an Exif item whose TIFF IFD1 embeds a JPEG
+                      thumbnail (the every-camera convention)
+
+    python tools/make_heif_fixture.py <out_dir>
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import sys
+
+
+def box(typ: bytes, payload: bytes) -> bytes:
+    return struct.pack(">I4s", 8 + len(payload), typ) + payload
+
+
+def full_box(typ: bytes, version: int, flags: int, payload: bytes) -> bytes:
+    return box(typ, struct.pack(">I", (version << 24) | flags) + payload)
+
+
+def make_jpeg(size=(64, 48), color=(200, 80, 20)) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, "JPEG", quality=80)
+    return buf.getvalue()
+
+
+def make_exif_with_thumbnail(jpeg: bytes) -> bytes:
+    """ExifDataBlock: u32 tiff offset + "Exif\0\0" + TIFF with IFD0 and
+    an IFD1 carrying JPEGInterchangeFormat/Length."""
+    # TIFF (big-endian MM)
+    # layout: header(8) IFD0(2+12+4) IFD1(2+2*12+4) jpeg
+    ifd0_off = 8
+    ifd0 = struct.pack(">H", 1)
+    ifd0 += struct.pack(">HHI4s", 0x0131, 2, 4, b"sd\x00\x00")  # Software
+    ifd1_off = ifd0_off + 2 + 12 + 4
+    ifd0 += struct.pack(">I", ifd1_off)
+    jpeg_off = ifd1_off + 2 + 2 * 12 + 4
+    ifd1 = struct.pack(">H", 2)
+    ifd1 += struct.pack(">HHII", 0x0201, 4, 1, jpeg_off)
+    ifd1 += struct.pack(">HHII", 0x0202, 4, 1, len(jpeg))
+    ifd1 += struct.pack(">I", 0)
+    tiff = b"MM\x00\x2a" + struct.pack(">I", ifd0_off) + ifd0 + ifd1 + jpeg
+    return struct.pack(">I", 0) + b"Exif\x00\x00" + tiff
+
+
+def _infe(item_id: int, item_type: bytes, content_type: str = "") -> bytes:
+    payload = struct.pack(">HH4s", item_id, 0, item_type) + b"\x00"
+    if content_type:
+        payload += content_type.encode() + b"\x00"
+    return full_box(b"infe", 2, 0, payload)
+
+
+def make_heic(items: list[tuple[int, bytes, str, bytes]],
+              primary: int,
+              refs: list[tuple[bytes, int, list[int]]] = (),
+              ispe: tuple[int, int] | None = (64, 48)) -> bytes:
+    """items: (item_id, item_type, content_type, payload)."""
+    ftyp = box(b"ftyp", b"heic\x00\x00\x00\x00" + b"heicmif1")
+
+    # mdat payload layout (offsets resolved after meta size is known)
+    payloads = [p for _, _, _, p in items]
+
+    def meta_box(mdat_file_off: int) -> bytes:
+        hdlr = full_box(b"hdlr", 0, 0,
+                        b"\x00" * 4 + b"pict" + b"\x00" * 12 + b"\x00")
+        pitm = full_box(b"pitm", 0, 0, struct.pack(">H", primary))
+        iinf = full_box(
+            b"iinf", 0, 0, struct.pack(">H", len(items)) + b"".join(
+                _infe(iid, t, ct) for iid, t, ct, _ in items))
+        # iloc v0: offset_size=4, length_size=4, base_offset_size=0
+        entries = b""
+        off = mdat_file_off + 8  # into the mdat payload
+        for (iid, _t, _ct, payload) in items:
+            entries += struct.pack(">HHH", iid, 0, 1)
+            entries += struct.pack(">II", off, len(payload))
+            off += len(payload)
+        iloc = full_box(b"iloc", 0, 0,
+                        struct.pack(">HH", 0x4400, len(items)) + entries)
+        parts = hdlr + pitm + iinf + iloc
+        if ispe is not None:
+            parts += box(b"iprp", box(b"ipco", full_box(
+                b"ispe", 0, 0, struct.pack(">II", *ispe))))
+        if refs:
+            refpay = b""
+            for rtype, from_id, to_ids in refs:
+                refpay += box(rtype, struct.pack(
+                    ">HH", from_id, len(to_ids)) + b"".join(
+                    struct.pack(">H", t) for t in to_ids))
+            parts += full_box(b"iref", 0, 0, refpay)
+        return full_box(b"meta", 0, 0, parts)
+
+    # two passes: meta size depends only on counts, not offsets
+    probe = meta_box(0)
+    mdat_off = len(ftyp) + len(probe)
+    meta = meta_box(mdat_off)
+    assert len(meta) == len(probe)
+    mdat = box(b"mdat", b"".join(payloads))
+    return ftyp + meta + mdat
+
+
+def write_fixtures(out_dir: str) -> dict:
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    jpeg = make_jpeg()
+    fake_hevc = b"\x00\x00\x00\x01hevc-payload-not-decodable" * 8
+
+    thumb = make_heic(
+        items=[(1, b"hvc1", "", fake_hevc),
+               (2, b"jpeg", "", jpeg)],
+        primary=1,
+        refs=[(b"thmb", 2, [1])])
+    with open(os.path.join(out_dir, "embedded_thumb.heic"), "wb") as f:
+        f.write(thumb)
+
+    exif_payload = make_exif_with_thumbnail(make_jpeg(color=(20, 80, 200)))
+    exif = make_heic(
+        items=[(1, b"hvc1", "", fake_hevc),
+               (2, b"Exif", "", exif_payload)],
+        primary=1,
+        refs=[(b"cdsc", 2, [1])])
+    with open(os.path.join(out_dir, "exif_thumb.heic"), "wb") as f:
+        f.write(exif)
+
+    return {"embedded_thumb.heic": len(thumb), "exif_thumb.heic": len(exif)}
+
+
+if __name__ == "__main__":
+    print(write_fixtures(sys.argv[1] if len(sys.argv) > 1
+                         else "tests/fixtures"))

@@ -32,10 +32,12 @@ def make_digests(n: int, planted: int, threshold: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     digests = rng.integers(0, 2**32, size=(n, 2), dtype=np.uint32)
     # Plant pairs: copy row i to row j with ≤ threshold flipped bits.
-    src = rng.choice(n, size=planted, replace=False)
-    dst = rng.choice(n, size=planted, replace=False)
-    keep = src != dst
-    src, dst = src[keep], dst[keep]
+    # src and dst are drawn as ONE disjoint sample: a dst that doubled
+    # as another pair's src would be overwritten after being copied
+    # (chained overwrite), silently invalidating the earlier plant and
+    # capping measurable recall below 1.0.
+    both = rng.choice(n, size=2 * planted, replace=False)
+    src, dst = both[:planted], both[planted:]
     flips = rng.integers(0, threshold + 1, size=len(src))
     digests[dst] = digests[src]
     for k in range(len(src)):
