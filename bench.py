@@ -35,8 +35,14 @@ import numpy as np
 # ~7-10 ms under load on the shared bench chip): 2048 → ~0.3-0.5M
 # files/s, 16384 → ~1.1M files/s with the same kernel. 16 K files is
 # also the identifier's device step size (ops/staging.AUTO_DEVICE_BATCH).
+# ITERS amortizes the ~74 ms fixed RPC+sync cost of ONE timed call
+# through the tunnel (tools/kernel_ceiling.py sweep: per-iteration time
+# is t_fixed/ITERS + 6.6 ms marginal at B=16K, i.e. the kernel's
+# sustained rate is ~2.5M files/s; ITERS=60 reports within ~12% of it,
+# while keeping each timed program well under the tunnel worker's
+# multi-second crash threshold).
 B = 16384
-ITERS = 10
+ITERS = 60
 MSG_BYTES = 57352  # 8-byte size prefix + 57,344 sampled bytes
 
 
@@ -104,13 +110,37 @@ def main() -> None:
         cpu_fps = 128 / (time.perf_counter() - t0)
         baseline_name = "numpy batched blake3 (native plane unavailable)"
 
-    # H2D link + steady-state overlapped pipeline estimate.
+    # H2D link measurement (marker-synced full fetch; a sliced fetch
+    # would compile a second program through the tunnel).
     t0 = time.perf_counter()
     for _ in range(3):
-        wx = jax.device_put(words)
-        np.asarray(wx.ravel()[0])
+        jax.device_put(words)
+        np.asarray(jax.device_put(np.zeros(16, np.uint8)))
     t_h2d = (time.perf_counter() - t0) / 3
-    e2e_fps = B / max(t_kernel, t_h2d)
+
+    # MEASURED double-buffered pipeline (ops/overlap.py): C++ staging of
+    # batch i+1 overlaps H2D+kernel of batch i, digests retired with a
+    # one-batch lag. Corpus is sparse files sized so the run is ~20-40 s
+    # at the probed link speed (the sum of stage+transfer+kernel serial
+    # would be strictly larger; the bound field is what a perfect
+    # pipeline would sustain from the same run's component times).
+    import shutil
+    import tempfile
+
+    from spacedrive_tpu.ops import overlap
+
+    link_bps = words.nbytes / t_h2d
+    pb = 2048
+    per_batch_s = pb * MSG_BYTES / max(link_bps, 1e6)
+    n_batches = int(max(4, min(12, 30.0 / max(per_batch_s, 0.25))))
+    proot = tempfile.mkdtemp(prefix="sdtpu-overlap-")
+    try:
+        pipeline_batches = overlap.make_sparse_corpus(
+            proot, pb * n_batches, 120_000, pb)
+        _res, pstats = overlap.run_overlapped(pipeline_batches)
+    finally:
+        shutil.rmtree(proot, ignore_errors=True)
+    e2e_fps = pstats.files_per_sec          # measured, not a formula
 
     # ~0.81M u32 elementwise ops per file (57×16 block compressions +
     # 56 tree parents, ~840 ops each) vs a ~5e12 ops/s VPU estimate.
@@ -127,6 +157,13 @@ def main() -> None:
         "bytes_per_sec": round(device_fps * MSG_BYTES, 0),
         "h2d_gbps": round(words.nbytes / t_h2d / 1e9, 2),
         "e2e_overlapped_files_per_sec": round(e2e_fps, 1),
+        "e2e_overlapped_bound_files_per_sec":
+            round(pstats.bound_files_per_sec, 1),
+        "e2e_overlap_components_s": {
+            "stage": round(pstats.t_stage_1, 3),
+            "h2d": round(pstats.t_h2d_1, 3),
+            "kernel_fetch": round(pstats.t_kernel_1, 3),
+        },
         "vpu_utilization_est": round(util, 3),
     }))
 

@@ -145,11 +145,15 @@ INDEX_HTML = r"""<!doctype html>
 <script>
 let reqId = 0, pending = {}, subs = {}, subSpecs = [];
 const wsProto = location.protocol === "https:" ? "wss" : "ws";
-let ws = null, wsReady = null, reconnectDelay = 500;
+let ws = null, reconnectDelay = 500;
+// wsReady always has a live resolver: awaiting rpc() calls parked
+// during a reconnect wake on the SAME promise the next onopen resolves.
+let wsReadyResolve = null;
+let wsReady = new Promise(r => wsReadyResolve = r);
 
 function connect() {
   ws = new WebSocket(`${wsProto}://${location.host}/rspc`);
-  wsReady = new Promise(res => ws.onopen = () => {
+  ws.onopen = () => {
     reconnectDelay = 500;
     // standing subscriptions survive reconnects (the standalone-client
     // contract: the UI must keep working across server restarts)
@@ -158,8 +162,8 @@ function connect() {
       ws.send(JSON.stringify({id, type: "subscription",
                               path: s.path, input: s.input}));
     }
-    res();
-  });
+    wsReadyResolve();
+  };
   ws.onmessage = (m) => {
     const f = JSON.parse(m.data);
     if (f.type === "response" && pending[f.id]) {
@@ -175,10 +179,10 @@ function connect() {
       pending[id].reject(new Error("connection lost")); delete pending[id];
     }
     subs = {};
-    // Park wsReady on a fresh pending promise NOW: rpc() calls made
-    // during the backoff window must wait for the next socket, not
-    // send into the closed one and hang.
-    wsReady = new Promise(() => {});
+    // Park wsReady on a fresh promise NOW (resolver saved for the next
+    // onopen): rpc() calls made during the backoff window suspend here
+    // instead of sending into the closed socket.
+    wsReady = new Promise(r => wsReadyResolve = r);
     toast(`reconnecting in ${Math.round(reconnectDelay / 1000)}s…`);
     setTimeout(connect, reconnectDelay);
     reconnectDelay = Math.min(reconnectDelay * 2, 15000);
@@ -493,7 +497,9 @@ function showCtx(r, e) {
   const m = document.getElementById("ctxmenu");
   const rows = selRows();
   const n = rows.length;
-  const items = [
+  // Directory-only selection: file operations have nothing to act on,
+  // so offer navigation alone instead of "(0)" no-op actions.
+  const items = n === 0 ? [["Open", () => openEntry(r)]] : [
     ["Open / inspect", () => openEntry(r)],
     ["sep"],
     [`Copy (${n})`, () => { clipboard = {op: "copy",

@@ -9,6 +9,8 @@ PIL's Exif reader replaces the Rust `kamadak-exif` stack.
 
 from __future__ import annotations
 
+import os
+
 import msgpack
 from typing import Any, Dict, Optional
 
@@ -64,8 +66,11 @@ def _heif_exif_fallback(path: str):
     if ext not in HEIF_EXTENSIONS:
         return None
     try:
+        from .images import MAXIMUM_FILE_SIZE
         from .isobmff import heif_dimensions, heif_exif
 
+        if os.path.getsize(path) > MAXIMUM_FILE_SIZE:
+            return None  # same 192 MiB budget format_image enforces
         with open(path, "rb") as f:
             data = f.read()
         dims = heif_dimensions(data) or (0, 0)

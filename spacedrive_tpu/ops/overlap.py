@@ -1,0 +1,176 @@
+"""Double-buffered staging → H2D → kernel pipeline, measured for real.
+
+SURVEY.md §7 hard-part 2 ("feeding the beast"): overlap C++ staging,
+host→device copies, and kernel execution so the end-to-end rate is set
+by the slowest stage, not their sum. Round 2 reported the steady-state
+number as a *formula* (`B / max(t_kernel, t_h2d)`); this module is the
+machinery itself, and bench.py now reports its measured rate.
+
+Shape of the pipeline (two batches in flight):
+
+    stager thread:   stage(i+1)          stage(i+2)         ...
+    main thread:     put+dispatch(i) ->  put+dispatch(i+1)  ...
+    retire:          fetch(i-1) while kernel(i) runs
+
+- staging runs on ONE worker thread calling the native C++ plane
+  (pooled pread, GIL released), so it overlaps the device round trip;
+- `jax.device_put` + the jitted kernel dispatch are asynchronous — the
+  only true sync on the axon platform is the D2H fetch, which is
+  deferred one batch so transfer/compute of batch i+1 can proceed
+  while batch i's digests stream back.
+
+On a host whose device link is slower than the native plane, the
+pipeline's measured rate approaches the link bound (that is the honest
+steady state this machinery can deliver there); on a fast-PCIe host the
+same code approaches the kernel bound.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PipelineStats:
+    files: int = 0
+    wall_s: float = 0.0
+    stage_s: float = 0.0      # stall time waiting on the stager thread
+    batches: int = 0
+    batch_files: int = 0
+    # serial reference components, measured on one calibration batch
+    # (t_kernel_1 includes the small digest D2H):
+    t_stage_1: float = 0.0
+    t_h2d_1: float = 0.0
+    t_kernel_1: float = 0.0
+
+    @property
+    def files_per_sec(self) -> float:
+        return self.files / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def bound_files_per_sec(self) -> float:
+        """The max(stage, transfer, kernel+fetch) steady-state bound
+        from the calibration components — what a perfect pipeline
+        would sustain."""
+        denom = max(self.t_stage_1, self.t_h2d_1, self.t_kernel_1)
+        return self.batch_files / denom if denom else 0.0
+
+
+def _stage_batch(paths: Sequence[str], sizes: np.ndarray):
+    """Native-plane staging of one large-class batch → (words, lengths).
+
+    Falls back to the Python reader when the C++ plane is absent."""
+    from . import blake3_jax as bj
+    from . import staging
+
+    large, _small, _empty, errors = staging.stage_files(
+        list(zip(paths, sizes.tolist())))
+    if errors:
+        raise OSError(f"staging errors: {list(errors.values())[:3]}")
+    return bj.build_cas_messages(large.payloads, large.sizes)
+
+
+def run_overlapped(
+    batches: Sequence[Tuple[Sequence[str], np.ndarray]],
+    kernel: Optional[Callable] = None,
+) -> Tuple[List[np.ndarray], PipelineStats]:
+    """Run the staged pipeline over pre-split file batches.
+
+    batches: [(paths, sizes_u64)] — all large-class (> 100 KiB) files.
+    kernel: (words, lengths) -> [B, 8] digests; defaults to the best
+        device implementation (Pallas on TPU).
+    Returns ([per-batch digests], stats). The returned digests are
+    row-aligned with each batch's path order.
+    """
+    import jax
+
+    from . import blake3_jax as bj
+
+    fn = kernel or (lambda w, l: bj._blake3_impl_best(w, l))
+    jfn = jax.jit(fn)
+    stats = PipelineStats(batches=len(batches),
+                          batch_files=len(batches[0][0]))
+
+    # calibration: one serial batch, component-timed (and the compile).
+    # Syncs are FULL fetches of small arrays — a sliced fetch would
+    # compile a second program remotely (~tens of seconds through a
+    # tunneled device); a tiny marker device_put queued after the big
+    # transfer rides the same ordered stream, so fetching it back
+    # bounds the transfer.
+    def _sync_marker() -> None:
+        np.asarray(jax.device_put(np.zeros(16, np.uint8)))
+
+    paths0, sizes0 = batches[0]
+    t0 = time.perf_counter()
+    words, lengths = _stage_batch(paths0, sizes0)
+    stats.t_stage_1 = time.perf_counter() - t0
+    w = jax.device_put(words); l = jax.device_put(lengths)
+    np.asarray(jfn(w, l))  # compile + warm
+    t0 = time.perf_counter()
+    w = jax.device_put(words); l = jax.device_put(lengths)
+    _sync_marker()
+    stats.t_h2d_1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = jfn(w, l)
+    res0 = np.asarray(out)  # kernel + the (small) digest D2H
+    stats.t_kernel_1 = time.perf_counter() - t0
+
+    pool = ThreadPoolExecutor(1, thread_name_prefix="overlap-stage")
+    results: List[Optional[np.ndarray]] = [None] * len(batches)
+    results[0] = res0
+
+    t_wall = time.perf_counter()
+    fut = None
+    if len(batches) > 1:
+        fut = pool.submit(_stage_batch, *batches[1])
+    inflight: List[Tuple[int, object]] = []
+    for i in range(1, len(batches)):
+        ts = time.perf_counter()
+        words, lengths = fut.result()
+        stats.stage_s += time.perf_counter() - ts
+        if i + 1 < len(batches):
+            fut = pool.submit(_stage_batch, *batches[i + 1])
+        w = jax.device_put(words)
+        l = jax.device_put(lengths)
+        out = jfn(w, l)          # async dispatch
+        inflight.append((i, out))
+        if len(inflight) > 1:    # retire with one-batch lag
+            j, prev = inflight.pop(0)
+            results[j] = np.asarray(prev)
+    for j, prev in inflight:
+        results[j] = np.asarray(prev)
+    stats.wall_s = time.perf_counter() - t_wall
+    stats.files = sum(len(p) for p, _ in batches[1:])
+    pool.shutdown()
+    return results, stats
+
+
+def make_sparse_corpus(root: str, n_files: int, file_size: int,
+                       batch: int) -> List[Tuple[List[str], np.ndarray]]:
+    """n_files sparse files of `file_size` bytes, split into batches.
+
+    Sparse (truncate-created) files exercise the exact staging path —
+    open/pread through the C++ plane — at memory speed, so the pipeline
+    measurement reflects staging/transfer/kernel overlap rather than
+    the benchmark host's disk. Real-corpus numbers come from
+    tools/perf_smoke.py."""
+    import os
+
+    os.makedirs(root, exist_ok=True)
+    batches = []
+    for b0 in range(0, n_files, batch):
+        paths = []
+        for i in range(b0, min(b0 + batch, n_files)):
+            p = os.path.join(root, f"f{i:07d}.bin")
+            if not os.path.exists(p):
+                with open(p, "wb") as f:
+                    f.truncate(file_size)
+            paths.append(p)
+        sizes = np.full(len(paths), file_size, dtype=np.uint64)
+        batches.append((paths, sizes))
+    return batches

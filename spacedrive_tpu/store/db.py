@@ -79,6 +79,24 @@ class Database:
                         f"{field.name} {field.type}")
             for stmt in indexes:
                 conn.execute(stmt)
+            # Upgrade path for the lazy-index change: libraries created
+            # when the op-log indexes were bootstrap DDL still carry
+            # them, paying per-row maintenance on every bulk write. An
+            # UNPAIRED library (≤1 instance row) has never synced, so
+            # the indexes are dropped — they rebuild on first sync use.
+            # Paired libraries keep them (a 5M-row rebuild at next sync
+            # would cost more than the maintenance saves).
+            try:
+                n_inst = conn.execute(
+                    "SELECT COUNT(*) FROM instance").fetchone()[0]
+                if n_inst <= 1:
+                    for table, model in models.MODELS.items():
+                        for idx in model.lazy_indexes:
+                            conn.execute(
+                                f"DROP INDEX IF EXISTS "
+                                f"idx_{table}_{'_'.join(idx)}")
+            except sqlite3.Error:
+                pass
             conn.commit()
 
     def _conn(self) -> sqlite3.Connection:

@@ -194,6 +194,52 @@ def test_drive_ui_procedures(served):
                          "file_path_ids": [some_txt["id"]]})
                 await node.jobs.wait_idle()
 
+                # ---- per-location settings + indexer-rule editor ----
+                lw = await q("locations.getWithRules",
+                             {"library_id": lid, "location_id": loc})
+                assert lw["path"] == corpus
+                rule_id = await m("locations.indexer_rules.create",
+                                  {"library_id": lid, "name": "no logs",
+                                   "rules": [[1, ["**/*.log"]]]})
+                await m("locations.update",
+                        {"library_id": lid, "id": loc,
+                         "name": "Main", "indexer_rules_ids": [rule_id]})
+                lw = await q("locations.getWithRules",
+                             {"library_id": lid, "location_id": loc})
+                assert lw["name"] == "Main"
+                assert [x["id"] for x in lw["indexer_rules"]] == [rule_id]
+                rules = await q("locations.indexer_rules.list",
+                                {"library_id": lid})
+                assert any(x["id"] == rule_id for x in rules)
+                await m("locations.indexer_rules.delete",
+                        {"library_id": lid, "id": rule_id})
+
+                # ---- explorer copy/cut (the context-menu paste path) ----
+                os.makedirs(os.path.join(corpus, "dest"), exist_ok=True)
+                await m("locations.fullRescan",
+                        {"library_id": lid, "location_id": loc})
+                await node.jobs.wait_idle()
+                paths2 = await q("search.paths",
+                                 {"library_id": lid, "take": 500})
+                src = next(p for p in paths2["items"]
+                           if p["name"] == "file1")
+                await m("files.copyFiles",
+                        {"library_id": lid, "source_location_id": loc,
+                         "sources_file_path_ids": [src["id"]],
+                         "target_location_id": loc,
+                         "target_location_relative_directory_path":
+                             "dest/"})
+                await node.jobs.wait_idle()
+                assert os.path.exists(
+                    os.path.join(corpus, "dest", "file1.txt"))
+                await m("files.cutFiles",
+                        {"library_id": lid, "source_location_id": loc,
+                         "sources_file_path_ids": [src["id"]],
+                         "target_location_id": loc,
+                         "target_location_relative_directory_path":
+                             "dest/"})
+                await node.jobs.wait_idle()
+
                 # ---- dup + near-dup views ----
                 dups = await q("search.duplicates", {"library_id": lid})
                 assert any(g["count"] >= 2 for g in dups), dups
