@@ -15,6 +15,9 @@ needed, for the formats whose metadata lives in plain sight —
 - Opus  (OpusHead in an Ogg stream, 48 kHz granule clock)
 - AVI   (avih main header: dimensions, fps, frame count → duration;
          the same RIFF walker that powers MJPEG thumbnails)
+- MP4/MOV/M4A/3GP (media/mp4meta.py: moov walk — duration, codec
+         fourccs, dimensions, rotation, fps, audio rate/channels)
+- MKV/WebM (media/mkv.py: EBML walk — the same fields)
 
 Each parser returns a plain dict of present fields; `parse_stream_info`
 dispatches by extension with a magic-byte check. Callers merge this into
@@ -263,18 +266,36 @@ def parse_avi(path: str) -> Optional[Dict]:
     return out if len(out) > 1 else None
 
 
+def _parse_mp4(path: str) -> Optional[Dict]:
+    from .mp4meta import parse_mp4
+
+    return parse_mp4(path)
+
+
+def _parse_mkv(path: str) -> Optional[Dict]:
+    from .mkv import parse_mkv
+
+    return parse_mkv(path)
+
+
 _PARSERS = {
     "wav": parse_wav, "wave": parse_wav,
     "flac": parse_flac,
     "mp3": parse_mp3,
     "ogg": parse_ogg, "oga": parse_ogg, "opus": parse_ogg,
     "avi": parse_avi,
+    # ISO-BMFF family (media/mp4meta.py) + Matroska (media/mkv.py):
+    # the formats that actually hold most of the world's video.
+    "mp4": _parse_mp4, "m4v": _parse_mp4, "mov": _parse_mp4,
+    "m4a": _parse_mp4, "3gp": _parse_mp4,
+    "mkv": _parse_mkv, "webm": _parse_mkv,
 }
 
 
 def parse_stream_info(path: str) -> Optional[Dict]:
-    """Self-hosted container probe by extension; None when the format
-    needs a real demuxer (mp4/mkv/... fall back to the ffprobe gate)."""
+    """Self-hosted container probe by extension — WAV/FLAC/MP3/OGG/
+    Opus/AVI here, MP4/MOV/M4A/3GP via media/mp4meta.py, MKV/WebM via
+    media/mkv.py; None when the container is unreadable."""
     ext = os.path.splitext(path)[1].lstrip(".").lower()
     parser = _PARSERS.get(ext)
     if parser is None:

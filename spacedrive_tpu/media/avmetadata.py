@@ -5,8 +5,9 @@ stubs awaiting an ffmpeg binding (/root/reference/crates/media-metadata/
 src/{audio.rs,video.rs}). Here the same typed rows fill from `ffprobe`
 when it exists (media/video.py gates), and otherwise from the
 self-hosted container parsers (media/audio.py: WAV/FLAC/MP3/OGG/Opus/
-AVI) — so the audio/video metadata plane actually runs in this image,
-beyond the reference's stubs.
+AVI; media/mp4meta.py: MP4/MOV/M4A/3GP; media/mkv.py: MKV/WebM) — so
+the audio/video metadata plane actually runs in this image, beyond the
+reference's stubs.
 """
 
 from __future__ import annotations
@@ -24,11 +25,13 @@ class StreamMetadata:
     duration_seconds: Optional[float] = None
     bitrate: Optional[int] = None
     format_name: Optional[str] = None
+    brand: Optional[str] = None          # ISO-BMFF major brand
     # video stream
     width: Optional[int] = None
     height: Optional[int] = None
     fps: Optional[float] = None
     video_codec: Optional[str] = None
+    rotation: Optional[int] = None       # display rotation, degrees CW
     # audio stream
     audio_codec: Optional[str] = None
     sample_rate: Optional[int] = None
@@ -96,6 +99,20 @@ def probe_media(path: str) -> Optional[StreamMetadata]:
                 num, _, den = rate.partition("/")
                 md.fps = float(num) / float(den or 1)
             except (ValueError, ZeroDivisionError):
+                pass
+            # display rotation: modern ffprobe puts it in side_data,
+            # older ones in tags.rotate — keep parity with the
+            # self-hosted mp4 parser's matrix-derived field
+            try:
+                rot = None
+                for sd in stream.get("side_data_list", []):
+                    if "rotation" in sd:
+                        rot = int(sd["rotation"])
+                if rot is None and "rotate" in stream.get("tags", {}):
+                    rot = int(stream["tags"]["rotate"])
+                if rot is not None:
+                    md.rotation = rot % 360 or None
+            except (TypeError, ValueError):
                 pass
         elif stream.get("codec_type") == "audio" and md.audio_codec is None:
             md.audio_codec = stream.get("codec_name")

@@ -1,0 +1,203 @@
+"""MP4/MOV + MKV/WebM metadata parsers (media/mp4meta.py, media/mkv.py).
+
+Fixtures are built from the container specs in-test (no encoder exists
+in this image); field expectations mirror what ffprobe would report.
+Reference parity target: the stubbed video structs in
+/root/reference/crates/media-metadata/src/video.rs."""
+
+import struct
+
+import pytest
+
+from spacedrive_tpu.media.audio import parse_stream_info
+from spacedrive_tpu.media.mkv import parse_mkv
+from spacedrive_tpu.media.mp4meta import parse_mp4
+
+
+def box(typ: bytes, payload: bytes) -> bytes:
+    return struct.pack(">I4s", 8 + len(payload), typ) + payload
+
+
+def full_box(typ: bytes, version: int, payload: bytes) -> bytes:
+    return box(typ, struct.pack(">I", version << 24) + payload)
+
+
+def _visual_entry(fourcc: bytes, w: int, h: int) -> bytes:
+    body = (b"\x00" * 6 + struct.pack(">H", 1) + b"\x00" * 16
+            + struct.pack(">HH", w, h) + b"\x00" * 50)
+    return struct.pack(">I4s", 8 + len(body), fourcc) + body
+
+
+def _audio_entry(fourcc: bytes, channels: int, rate: int) -> bytes:
+    body = (b"\x00" * 6 + struct.pack(">H", 1) + b"\x00" * 8
+            + struct.pack(">HH", channels, 16) + b"\x00" * 4
+            + struct.pack(">I", rate << 16))
+    return struct.pack(">I4s", 8 + len(body), fourcc) + body
+
+
+def _identity_matrix(rotated: bool = False) -> bytes:
+    if rotated:  # 90° CW: [0, 1; -1, 0]
+        vals = (0, 0x00010000, 0, -0x00010000, 0, 0, 0, 0, 0x40000000)
+    else:
+        vals = (0x00010000, 0, 0, 0, 0x00010000, 0, 0, 0, 0x40000000)
+    return struct.pack(">9i", *vals)
+
+
+def make_mp4(path: str, rotated: bool = False) -> None:
+    timescale, dur = 1000, 12_500              # 12.5 s movie
+    mvhd = full_box(b"mvhd", 0, struct.pack(
+        ">II", 0, 0) + struct.pack(">II", timescale, dur) + b"\x00" * 80)
+
+    def trak(handler: bytes, entry: bytes, ts: int, tdur: int,
+             samples: int) -> bytes:
+        tkhd = full_box(b"tkhd", 0, struct.pack(">III", 0, 0, 1)
+                        + b"\x00" * 4 + struct.pack(">I", tdur)
+                        + b"\x00" * 16 + _identity_matrix(rotated)
+                        + struct.pack(">II", 640 << 16, 360 << 16))
+        hdlr = full_box(b"hdlr", 0, b"\x00" * 4 + handler + b"\x00" * 13)
+        mdhd = full_box(b"mdhd", 0, struct.pack(
+            ">II", 0, 0) + struct.pack(">II", ts, tdur) + b"\x00" * 4)
+        stsd = full_box(b"stsd", 0, struct.pack(">I", 1) + entry)
+        stts = full_box(b"stts", 0, struct.pack(">III", 1, samples, 1))
+        stbl = box(b"stbl", stsd + stts)
+        minf = box(b"minf", stbl)
+        mdia = box(b"mdia", mdhd + hdlr + minf)
+        return box(b"trak", tkhd + mdia)
+
+    vtrak = trak(b"vide", _visual_entry(b"avc1", 1920, 1080),
+                 12800, 160_000, 375)          # 12.5 s @ 30 fps
+    atrak = trak(b"soun", _audio_entry(b"mp4a", 2, 48_000),
+                 48_000, 600_000, 600_000)
+    moov = box(b"moov", mvhd + vtrak + atrak)
+    with open(path, "wb") as f:
+        f.write(box(b"ftyp", b"isom\x00\x00\x02\x00isommp42"))
+        f.write(moov)
+        f.write(box(b"mdat", b"\x00" * 64))
+
+
+def _ebml_id(i: int) -> bytes:
+    n = (i.bit_length() + 7) // 8
+    return i.to_bytes(n, "big")
+
+
+def _ebml_size(n: int) -> bytes:
+    return bytes([0x80 | n]) if n < 0x7F else struct.pack(">BI", 0x08, n)
+
+
+def el(eid: int, payload: bytes) -> bytes:
+    return _ebml_id(eid) + _ebml_size(len(payload)) + payload
+
+
+def make_mkv(path: str) -> None:
+    header = el(0x1A45DFA3, el(0x4282, b"matroska"))
+    info = el(0x1549A966,
+              el(0x2AD7B1, (1_000_000).to_bytes(3, "big"))
+              + el(0x4489, struct.pack(">d", 9500.0)))     # 9.5 s in ms
+    video = el(0xE0, el(0xB0, (1280).to_bytes(2, "big"))
+               + el(0xBA, (720).to_bytes(2, "big")))
+    vtrack = el(0xAE, el(0x83, b"\x01") + el(0x86, b"V_MPEG4/ISO/AVC")
+                + video)
+    audio = el(0xE1, el(0xB5, struct.pack(">f", 44100.0))
+               + el(0x9F, b"\x02"))
+    atrack = el(0xAE, el(0x83, b"\x02") + el(0x86, b"A_AAC") + audio)
+    tracks = el(0x1654AE6B, vtrack + atrack)
+    segment = el(0x18538067, info + tracks)
+    with open(path, "wb") as f:
+        f.write(header + segment)
+
+
+def test_mp4_metadata(tmp_path):
+    p = str(tmp_path / "clip.mp4")
+    make_mp4(p)
+    out = parse_mp4(p)
+    assert out["format_name"] == "mp4"
+    assert out["duration_seconds"] == 12.5
+    assert out["video_codec"] == "avc1"
+    assert (out["width"], out["height"]) == (1920, 1080)
+    assert out["fps"] == 30.0
+    assert out["audio_codec"] == "mp4a"
+    assert out["sample_rate"] == 48_000 and out["channels"] == 2
+    assert "rotation" not in out
+    # the dispatch surface jobs use
+    assert parse_stream_info(p)["video_codec"] == "avc1"
+
+
+def test_mp4_rotation(tmp_path):
+    p = str(tmp_path / "portrait.mp4")
+    make_mp4(p, rotated=True)
+    assert parse_mp4(p)["rotation"] == 90
+
+
+def test_mkv_metadata(tmp_path):
+    p = str(tmp_path / "clip.mkv")
+    make_mkv(p)
+    out = parse_mkv(p)
+    assert out["format_name"] == "matroska"
+    assert out["duration_seconds"] == 9.5
+    assert out["video_codec"] == "V_MPEG4/ISO/AVC"
+    assert (out["width"], out["height"]) == (1280, 720)
+    assert out["audio_codec"] == "A_AAC"
+    assert out["sample_rate"] == 44_100 and out["channels"] == 2
+    assert parse_stream_info(p)["width"] == 1280
+
+
+def test_non_container_rejected(tmp_path):
+    p = tmp_path / "not.mp4"
+    p.write_bytes(b"plainly not a container" * 10)
+    assert parse_mp4(str(p)) is None
+    p2 = tmp_path / "not.mkv"
+    p2.write_bytes(b"\x00" * 100)
+    assert parse_mkv(str(p2)) is None
+
+
+def test_mp4_corrupt_stts_keeps_other_fields(tmp_path):
+    """A lying stts entry_count must not abort the parse or read
+    sibling bytes — clamped to the box payload."""
+    p = str(tmp_path / "bad.mp4")
+    make_mp4(p)
+    data = bytearray(open(p, "rb").read())
+    i = data.find(b"stts")
+    assert i > 0
+    # entry_count lives 8 bytes after the fourcc (version/flags first)
+    data[i + 8:i + 12] = (0xFFFFFFFF).to_bytes(4, "big")
+    open(p, "wb").write(data)
+    out = parse_mp4(p)
+    assert out is not None
+    assert out["video_codec"] == "avc1"       # rest of moov survives
+    assert out["duration_seconds"] == 12.5
+
+
+def test_mp4_empty_moov_is_unreadable(tmp_path):
+    p = str(tmp_path / "empty.mp4")
+    with open(p, "wb") as f:
+        f.write(box(b"ftyp", b"isom\x00\x00\x02\x00"))
+        f.write(box(b"moov", b""))
+    assert parse_mp4(p) is None
+
+
+def test_mkv_nonminimal_size_vint(tmp_path):
+    """A 127-byte element written with a 2-byte size vint (legal,
+    non-minimal EBML) must NOT be misread as unknown-size."""
+    p = str(tmp_path / "nm.mkv")
+    header = el(0x1A45DFA3, el(0x4282, b"matroska"))
+    video = el(0xE0, el(0xB0, (640).to_bytes(2, "big"))
+               + el(0xBA, (480).to_bytes(2, "big")))
+    vbody = el(0x83, b"\x01") + el(0x86, b"V_VP9") + video
+    vbody += b"\xec" + bytes([0x80 | (127 - len(vbody) - 2)]) \
+        + b"\x00" * (127 - len(vbody) - 2)      # Void pad to 127 bytes
+    assert len(vbody) == 127
+    # TrackEntry with 2-byte size vint 0x40 0x7F (value 127)
+    vtrack = _ebml_id(0xAE) + b"\x40\x7f" + vbody
+    audio = el(0xE1, el(0xB5, struct.pack(">f", 22050.0))
+               + el(0x9F, b"\x01"))
+    atrack = el(0xAE, el(0x83, b"\x02") + el(0x86, b"A_OPUS") + audio)
+    tracks = el(0x1654AE6B, vtrack + atrack)
+    seg = el(0x18538067, el(0x1549A966,
+                            el(0x4489, struct.pack(">d", 1000.0)))
+             + tracks)
+    open(p, "wb").write(header + seg)
+    out = parse_mkv(p)
+    assert out["video_codec"] == "V_VP9"
+    # the audio track AFTER the non-minimal-size element still parses
+    assert out["audio_codec"] == "A_OPUS"
+    assert out["sample_rate"] == 22050
