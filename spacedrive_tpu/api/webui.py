@@ -516,6 +516,17 @@ function showCtx(r, e) {
          await mut("files.setFavorite",
                    {library_id: lib, id: x.object_id, favorite: true});
        toast("favorited"); }],
+    [`Tag… (${n})`, async () => {
+       const nm = prompt("tag name" + (allTags.length
+         ? ` (existing: ${allTags.map(t => t.name).join(", ")})` : ""));
+       if (!nm) return;
+       let t = allTags.find(x => x.name === nm);
+       if (!t) t = await mut("tags.create",
+                             {library_id: lib, name: nm, color: null});
+       for (const x of rows) if (x.object_id != null)
+         await mut("tags.assign", {library_id: lib, tag_id: t.id,
+                                   object_id: x.object_id});
+       toast(`tagged ${n}`); loadTags(); }],
     [`Validate (${n})`, async () => {
        await mut("jobs.objectValidator",
                  {library_id: lib, id: loc, mode: "fill"});
@@ -563,6 +574,40 @@ async function doPaste() {
   setTimeout(browse, 500);
 }
 
+// ---- drag & drop: drag files onto a folder to move them --------------
+function wireDnD(el, r) {
+  if (!r.is_dir) {
+    el.draggable = true;
+    el.ondragstart = (e) => {
+      if (!selection.has(r.id)) {
+        selection.clear(); selection.add(r.id); updateSelClasses();
+      }
+      e.dataTransfer.setData("text/sdtpu-ids",
+        JSON.stringify(selRows().map(x => x.id)));
+      e.dataTransfer.effectAllowed = "move";
+    };
+  } else {
+    el.ondragover = (e) => { e.preventDefault(); el.style.outline =
+      "2px dashed #3b82f6"; };
+    el.ondragleave = () => { el.style.outline = ""; };
+    el.ondrop = async (e) => {
+      e.preventDefault(); el.style.outline = "";
+      let ids;
+      try { ids = JSON.parse(e.dataTransfer.getData("text/sdtpu-ids")); }
+      catch { return; }
+      if (!ids || !ids.length) return;
+      const rel = (r.materialized_path + r.name + "/").replace(/^\//, "");
+      await mut("files.cutFiles", {library_id: lib,
+        source_location_id: loc, sources_file_path_ids: ids,
+        target_location_id: loc,
+        target_location_relative_directory_path: rel});
+      toast(`moving ${ids.length} into ${r.name}/`);
+      clearSel();
+      setTimeout(browse, 500);
+    };
+  }
+}
+
 function listRow(r) {
   const tr = document.createElement("tr");
   tr.className = "row" + (selection.has(r.id) ? " sel" : "");
@@ -578,6 +623,7 @@ function listRow(r) {
   tr.onclick = (e) => entryClick(r, e);
   tr.ondblclick = () => openEntry(r);
   tr.oncontextmenu = (e) => showCtx(r, e);
+  wireDnD(tr, r);
   return tr;
 }
 function cell(r, onclick) {
@@ -600,6 +646,7 @@ function cell(r, onclick) {
     c.onclick = (e) => entryClick(r, e);
     c.ondblclick = () => openEntry(r);
     c.oncontextmenu = (e) => showCtx(r, e);
+    wireDnD(c, r);
   }
   return c;
 }

@@ -302,5 +302,6 @@ def parse_stream_info(path: str) -> Optional[Dict]:
         return None
     try:
         return parser(path)
-    except (OSError, struct.error, ValueError):
+    except (OSError, struct.error, ValueError, IndexError):
+        # IndexError: corrupt containers with truncated boxes/elements
         return None

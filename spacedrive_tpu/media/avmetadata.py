@@ -107,7 +107,10 @@ def probe_media(path: str) -> Optional[StreamMetadata]:
                 rot = None
                 for sd in stream.get("side_data_list", []):
                     if "rotation" in sd:
-                        rot = int(sd["rotation"])
+                        # side_data reports CCW (a portrait iPhone clip
+                        # is -90); our field is degrees CW like the
+                        # tkhd matrix and legacy tags.rotate
+                        rot = -int(sd["rotation"])
                 if rot is None and "rotate" in stream.get("tags", {}):
                     rot = int(stream["tags"]["rotate"])
                 if rot is not None:

@@ -153,7 +153,9 @@ def parse_mkv(path: str) -> Optional[Dict]:
                             video = (ts, te)
                         elif tid == _AUDIO:
                             audio = (ts, te)
-                    if ttype == 1 and video:
+                    # first track of each type wins, matching mp4meta
+                    # and the ffprobe branch
+                    if ttype == 1 and video and "video_codec" not in out:
                         if codec:
                             out["video_codec"] = codec
                         for vid, vs, ve in _walk(data, *video):
@@ -161,9 +163,9 @@ def parse_mkv(path: str) -> Optional[Dict]:
                                 out["width"] = _uint(data, vs, ve)
                             elif vid == _PIXEL_H:
                                 out["height"] = _uint(data, vs, ve)
-                    elif ttype == 2 and audio:
+                    elif ttype == 2 and audio and "audio_codec" not in out:
                         if codec:
-                            out.setdefault("audio_codec", codec)
+                            out["audio_codec"] = codec
                         for aid, as_, ae in _walk(data, *audio):
                             if aid == _SAMPLING:
                                 r = _float(data, as_, ae)

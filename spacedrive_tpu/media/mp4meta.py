@@ -145,13 +145,16 @@ def _parse_trak(moov: bytes, ps: int, pe: int, out: Dict) -> None:
             elif typ == b"stts":
                 n = struct.unpack_from(">I", moov, bs + 4)[0]
                 # clamp to what the box actually holds (corrupt counts
-                # must not read sibling bytes) and to a sane VFR bound
-                n = min(n, (be - bs - 8) // 8, 65536)
-                total = 0
-                for k in range(n):
-                    cnt = struct.unpack_from(">I", moov, bs + 8 + 8 * k)[0]
-                    total += cnt
-                sample_count = total
+                # must not read sibling bytes) and to a sane VFR bound;
+                # a clamped count would yield a WRONG fps, so omit it
+                capped = min(n, (be - bs - 8) // 8, 65536)
+                if capped == n:
+                    total = 0
+                    for k in range(capped):
+                        cnt = struct.unpack_from(
+                            ">I", moov, bs + 8 + 8 * k)[0]
+                        total += cnt
+                    sample_count = total
             elif typ in (b"mdia", b"minf", b"stbl"):
                 walk(bs, be, depth + 1)
 
