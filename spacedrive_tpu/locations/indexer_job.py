@@ -111,14 +111,25 @@ def save_file_path_rows(library, location_pub_id: bytes,
         return 0
     db, sync = library.db, library.sync
 
+    # ONE batched lookup for the whole chunk's inodes (a per-row query
+    # costs ~10 µs × 1M rows on big scans). Keys are the 8-byte big-
+    # endian inode blobs as stored (FilePathMetadata.from_stat).
+    from ..objects.identifier import _in_chunks
+
+    inodes = sorted({r["inode"] for r in rows if r.get("inode")})
+    existing_by_inode: Dict[bytes, Any] = {}
+    for chunk in _in_chunks(inodes):
+        ph = ",".join("?" for _ in chunk)
+        for e in db.query(
+            f"SELECT inode, pub_id, materialized_path, name, extension "
+            f"FROM file_path WHERE location_id = ? AND inode IN ({ph})",
+                [rows[0]["location_id"], *chunk]):
+            existing_by_inode[e["inode"]] = e
+
     moved: List[Dict[str, Any]] = []
     fresh: List[Dict[str, Any]] = []
     for row in rows:
-        inode = row.get("inode")
-        existing = db.query_one(
-            "SELECT pub_id, materialized_path, name, extension "
-            "FROM file_path WHERE location_id = ? AND inode = ?",
-            (row["location_id"], inode)) if inode else None
+        existing = existing_by_inode.get(row.get("inode"))
         if existing is None:
             fresh.append(row)
         elif (existing["materialized_path"] != row["materialized_path"]
@@ -131,14 +142,18 @@ def save_file_path_rows(library, location_pub_id: bytes,
         _repath_rows(library, moved)
     if not fresh:
         return len(moved)
-    ops = []
+    specs = []
     for row in fresh:
         values = _row_sync_values(row)
         values["location_id"] = location_pub_id  # FK syncs as pub_id
-        ops.extend(sync.shared_create("file_path", row["pub_id"], values))
-    with sync.write_ops(ops) as conn:
-        return len(moved) + db.insert_many(
+        specs.append((row["pub_id"], "c", None, None, values))
+    with db.tx() as conn:
+        n = db.insert_many(
             "file_path", fresh, conn=conn, ignore_conflicts=True)
+        n_ops = sync.bulk_shared_ops(conn, "file_path", specs)
+    if n_ops:
+        sync._notify_created()
+    return len(moved) + n
 
 
 def _repath_rows(library, rows: List[Dict[str, Any]]) -> int:
