@@ -132,6 +132,7 @@ INDEX_HTML = r"""<!doctype html>
     <button id="vlist" class="viewbtn" title="list view">☰</button>
     <button id="vmedia" class="viewbtn" title="media view">🖼</button>
     <button id="pastebtn" class="ghost" style="display:none">paste</button>
+    <button id="newfolder" class="ghost" title="new folder">+📁</button>
     <button id="favbtn" class="ghost">★ favorites</button>
   </div>
   <div id="content">
@@ -223,13 +224,15 @@ const fmtBytes = (n) => {
 let lib = null, loc = null, curPath = "/", view = "explorer";
 let selected = null, tagFilter = null, favOnly = false, allTags = [];
 let viewMode = "grid";         // grid | list | media (explorer modes)
+let sortKey = null, sortDir = 1;  // list-view column sort
 let selection = new Set();     // multi-select: file_path ids
 let lastRows = [];             // rows rendered by the last browse()
 let lastClickId = null;        // shift-range anchor
 let clipboard = null;          // {op: "copy"|"cut", ids, locId}
 let settingsLoc = null;        // location id open in per-location settings
 
-const TABS = [["explorer","Explorer"],["dups","Duplicates"],
+const TABS = [["explorer","Explorer"],["browse","Browse"],
+              ["dups","Duplicates"],
               ["neardups","Near-dups"],["jobs","Jobs"],["p2p","P2P"],
               ["settings","Settings"]];
 function renderTabs() {
@@ -378,9 +381,55 @@ async function loadStats() {
 function render() {
   document.getElementById("inspector").style.display = "none";
   hideCtx();
-  ({explorer: browse, dups: renderDups, neardups: renderNearDups,
+  ({explorer: browse, browse: renderEphemeral, dups: renderDups,
+    neardups: renderNearDups,
     jobs: renderJobs, p2p: renderP2P, settings: renderSettings,
     locsettings: renderLocSettings}[view])();
+}
+
+// ---- Ephemeral browsing (non-indexed paths, non_indexed.rs) ----------
+let ephPath = "/";
+async function renderEphemeral() {
+  const main = document.getElementById("main");
+  main.innerHTML = `
+    <h1>Browse (not indexed)</h1>
+    <p><input id="ephpath" value="${esc(ephPath)}" style="width:60%"/>
+       <button id="ephgo">go</button>
+       <span class="muted">any directory on this node — nothing is
+       written to the library</span></p>
+    <div id="grid"></div>`;
+  const go = async () => {
+    ephPath = document.getElementById("ephpath").value.trim() || "/";
+    let entries;
+    try {
+      entries = await q("search.ephemeralPaths",
+                        {path: ephPath, with_thumbnails: true});
+    } catch (e) { toast(String(e)); return; }
+    const grid = document.getElementById("grid");
+    grid.innerHTML = "";
+    if (ephPath !== "/") {
+      grid.appendChild(cell({name: "..", is_dir: 1}, () => {
+        ephPath = ephPath.replace(/\/[^/]+\/?$/, "") || "/";
+        document.getElementById("ephpath").value = ephPath;
+        go();
+      }));
+    }
+    for (const e of entries) {
+      const r = {name: e.name, extension: e.extension,
+                 is_dir: e.is_dir, cas_id: e.cas_id, id: -1};
+      grid.appendChild(cell(r, () => {
+        if (e.is_dir) {
+          ephPath = e.path;
+          document.getElementById("ephpath").value = ephPath;
+          go();
+        }
+      }));
+    }
+  };
+  document.getElementById("ephgo").onclick = go;
+  document.getElementById("ephpath").onkeydown =
+    (e) => { if (e.key === "Enter") go(); };
+  go();
 }
 
 // ---- Explorer --------------------------------------------------------
@@ -422,25 +471,58 @@ async function browse() {
       && mediaExt.has((r.extension || "").toLowerCase()));
     grid.className = "media";
   } else grid.className = "";
-  lastRows = items;
+  lastRows = sortItems(items);
   if (viewMode === "list") {
     main.removeChild(grid);
-    const tbl = document.createElement("table");
-    tbl.innerHTML = "<tr><th></th><th>name</th><th>kind</th>" +
-      "<th>size</th><th>modified</th></tr>";
-    if (!searchText && curPath !== "/") {
-      const up = document.createElement("tr");
-      up.className = "row";
-      up.innerHTML = "<td>📁</td><td>..</td><td></td><td></td><td></td>";
-      up.onclick = () => { curPath = curPath.replace(/[^/]+\/$/, "");
-                           browse(); };
-      tbl.appendChild(up);
-    }
-    for (const r of items) tbl.appendChild(listRow(r));
-    main.appendChild(tbl);
+    main.appendChild(buildListTable(!searchText && curPath !== "/"));
   } else {
+    items = lastRows;
     for (const r of items) grid.appendChild(cell(r, null));
   }
+}
+
+function sortItems(items) {
+  if (viewMode !== "list" || !sortKey) return items;
+  const keyf = {name: r => (r.name || "").toLowerCase(),
+                kind: r => r.is_dir ? "" : (r.extension || ""),
+                size: r => r.size_in_bytes || 0,
+                modified: r => r.date_modified || 0}[sortKey];
+  return [...items].sort((a, b) => {
+    const ka = keyf(a), kb = keyf(b);
+    return (ka < kb ? -1 : ka > kb ? 1 : 0) * sortDir;
+  });
+}
+
+function buildListTable(showUp) {
+  // Header clicks re-sort lastRows CLIENT-SIDE and swap the table in
+  // place — no refetch (same repaint-in-place rule as selection).
+  const tbl = document.createElement("table");
+  const hdr = document.createElement("tr");
+  hdr.innerHTML = "<th></th>";
+  for (const k of ["name", "kind", "size", "modified"]) {
+    const th = document.createElement("th");
+    th.style.cursor = "pointer";
+    th.textContent = k + (sortKey === k
+      ? (sortDir > 0 ? " ↑" : " ↓") : "");
+    th.onclick = () => {
+      sortDir = sortKey === k ? -sortDir : 1;
+      sortKey = k;
+      lastRows = sortItems(lastRows);
+      tbl.replaceWith(buildListTable(showUp));
+    };
+    hdr.appendChild(th);
+  }
+  tbl.appendChild(hdr);
+  if (showUp) {
+    const up = document.createElement("tr");
+    up.className = "row";
+    up.innerHTML = "<td>📁</td><td>..</td><td></td><td></td><td></td>";
+    up.onclick = () => { curPath = curPath.replace(/[^/]+\/$/, "");
+                         browse(); };
+    tbl.appendChild(up);
+  }
+  for (const r of lastRows) tbl.appendChild(listRow(r));
+  return tbl;
 }
 
 function openEntry(r) {
@@ -538,6 +620,26 @@ function showCtx(r, e) {
          file_path_ids: rows.map(x => x.id)});
        toast("deleting…"); clearSel();
        setTimeout(browse, 400); }],
+    [`Erase securely (${n})`, async () => {
+       if (!confirm(`overwrite + delete ${n} file(s)? irreversible`))
+         return;
+       await mut("files.eraseFiles", {library_id: lib, location_id: loc,
+         file_path_ids: rows.map(x => x.id), passes: 1});
+       toast("erasing…"); clearSel();
+       setTimeout(browse, 600); }],
+    ["sep"],
+    [`Encrypt… (${n})`, async () => {
+       const pw = prompt("encryption password"); if (!pw) return;
+       await mut("files.encryptFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id),
+         password: pw});
+       toast("encrypting…"); setTimeout(browse, 600); }],
+    [`Decrypt… (${n})`, async () => {
+       const pw = prompt("decryption password"); if (!pw) return;
+       await mut("files.decryptFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id),
+         password: pw});
+       toast("decrypting…"); setTimeout(browse, 600); }],
   ];
   m.innerHTML = "";
   for (const [label, fn] of items) {
@@ -1027,7 +1129,10 @@ async function renderSettings() {
     <div><button id="dobackup">backup library now</button></div>
     <table>` + (backups.backups || backups).map(b =>
       `<tr><td>${esc(b.id || b.path || JSON.stringify(b)).slice(0, 60)}</td>
-       <td class="muted">${esc(b.timestamp || b.date || "")}</td></tr>`)
+       <td class="muted">${esc(b.timestamp || b.date || "")}</td>
+       <td><button class="ghost brestore" data-bid="${esc(b.id)}">restore
+       </button><button class="danger bdelete" data-bid="${esc(b.id)}">×
+       </button></td></tr>`)
       .join("") + `</table>
     <h3>Preferences</h3>
     <div class="kv">stored keys: <b>${Object.keys(prefs || {}).length}</b>
@@ -1075,6 +1180,18 @@ async function renderSettings() {
     await mut("backups.backup", {library_id: lib});
     toast("backup written"); renderSettings();
   };
+  document.querySelectorAll(".brestore").forEach(b => b.onclick =
+    async () => {
+      if (!confirm("restore this backup over the current library?"))
+        return;
+      await mut("backups.restore", {backup_id: b.dataset.bid});
+      toast("backup restored"); loadAll();
+    });
+  document.querySelectorAll(".bdelete").forEach(b => b.onclick =
+    async () => {
+      await mut("backups.delete", {backup_id: b.dataset.bid});
+      renderSettings();
+    });
   document.getElementById("setpref").onclick = async () => {
     const k = prompt("preference key"); if (!k) return;
     const v = prompt("value");
@@ -1124,6 +1241,14 @@ document.getElementById("vgrid").onclick = () => setViewMode("grid");
 document.getElementById("vlist").onclick = () => setViewMode("list");
 document.getElementById("vmedia").onclick = () => setViewMode("media");
 document.getElementById("pastebtn").onclick = doPaste;
+document.getElementById("newfolder").onclick = async () => {
+  if (view !== "explorer") { toast("open the explorer first"); return; }
+  if (loc == null) { toast("select a location"); return; }
+  const name = prompt("folder name"); if (!name) return;
+  await mut("files.createFolder", {library_id: lib, location_id: loc,
+    sub_path: curPath, name});
+  setTimeout(() => { if (view === "explorer") browse(); }, 300);
+};
 setViewMode("grid");
 
 sub("jobs.progress", null, (e) => {

@@ -337,9 +337,58 @@ def test_drive_ui_procedures(served):
                 await q("keys.list")
                 b = await m("backups.backup", {"library_id": lid})
                 assert b
-                assert await q("backups.getAll")
+                all_b = await q("backups.getAll")
+                assert all_b
                 await q("volumes.list")
                 await q("categories.list", {"library_id": lid})
+
+                # ---- ephemeral (non-indexed) browsing ----
+                eph = await q("search.ephemeralPaths", {"path": corpus})
+                assert any(e["name"] == "docs" and e["is_dir"]
+                           for e in eph)
+
+                # ---- new folder + secure erase + encrypt/decrypt ----
+                await m("files.createFolder",
+                        {"library_id": lid, "location_id": loc,
+                         "sub_path": "/", "name": "made_by_ui"})
+                assert os.path.isdir(os.path.join(corpus, "made_by_ui"))
+                paths3 = await q("search.paths",
+                                 {"library_id": lid, "take": 500})
+                victim = next(p for p in paths3["items"]
+                              if p["name"] == "file2")
+                await m("files.eraseFiles",
+                        {"library_id": lid, "location_id": loc,
+                         "file_path_ids": [victim["id"]], "passes": 1})
+                await node.jobs.wait_idle()
+                assert not os.path.exists(
+                    os.path.join(corpus, "docs", "file2.txt"))
+                enc_target = next(p for p in paths3["items"]
+                                  if p["name"] == "file3")
+                await m("files.encryptFiles",
+                        {"library_id": lid, "location_id": loc,
+                         "file_path_ids": [enc_target["id"]],
+                         "password": "pw-ui-test"})
+                await node.jobs.wait_idle()
+                enc_path = os.path.join(corpus, "docs", "file3.txt.sdtpu")
+                assert os.path.exists(enc_path), os.listdir(
+                    os.path.join(corpus, "docs"))
+
+                # ---- backup delete + restore round trip ----
+                bid = (all_b[0] if isinstance(all_b, list)
+                       else all_b["backups"][0])["id"]
+                b2 = await m("backups.backup", {"library_id": lid})
+                await m("backups.delete", {"backup_id": bid})
+                left = await q("backups.getAll")
+                left_ids = [x["id"] for x in (
+                    left if isinstance(left, list) else left["backups"])]
+                assert bid not in left_ids
+                await m("backups.restore", {"backup_id": b2 if isinstance(
+                    b2, str) else b2["id"]})
+                assert [x["uuid"] for x in await q("library.list")] \
+                    == [lid]
+                n_after = await q("search.pathsCount",
+                                  {"library_id": lid})
+                assert n_after > 0
                 await q("p2p.state")
 
                 # ---- subscription round trip (notifications panel) ----
