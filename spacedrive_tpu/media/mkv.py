@@ -177,3 +177,46 @@ def parse_mkv(path: str) -> Optional[Dict]:
         out["duration_seconds"] = round(
             duration_ticks * ts_scale / 1e9, 3)
     return out if len(out) > 1 else None
+
+
+_ATTACHMENTS = 0x1941A469
+_ATTACHED_FILE = 0x61A7
+_FILE_NAME = 0x466E
+_FILE_MIME = 0x4660
+_FILE_DATA = 0x465C
+
+
+def mkv_attachment_image(path: str) -> Optional[bytes]:
+    """First image attachment (cover.jpg convention) from a Matroska
+    file — movie rips routinely attach cover art; no video decode
+    needed. Returns JPEG/PNG bytes or None."""
+    data = _scan(path)
+    if len(data) < 8:
+        return None
+    # attachments usually precede clusters; extend the scan if the
+    # Attachments id is beyond the tracks-bounded head read
+    if _ATTACHMENTS.to_bytes(4, "big") not in data:
+        with open(path, "rb") as f:
+            data = f.read(_SCAN_CAP)
+        if _ATTACHMENTS.to_bytes(4, "big") not in data:
+            return None
+    for eid, ps, pe in _walk(data, 0, len(data)):
+        if eid != 0x18538067:  # Segment
+            continue
+        for sid, bs, be in _walk(data, ps, pe):
+            if sid != _ATTACHMENTS:
+                continue
+            for aid, as_, ae in _walk(data, bs, be):
+                if aid != _ATTACHED_FILE:
+                    continue
+                mime, blob = "", None
+                for fid, fs, fe in _walk(data, as_, ae):
+                    if fid == _FILE_MIME:
+                        mime = data[fs:fe].decode("ascii", "replace")
+                    elif fid == _FILE_DATA:
+                        blob = data[fs:fe]
+                if blob and (mime.startswith("image/")
+                             or blob[:2] == b"\xff\xd8"
+                             or blob[:8] == b"\x89PNG\r\n\x1a\n"):
+                    return blob
+    return None

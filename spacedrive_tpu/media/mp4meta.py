@@ -196,3 +196,46 @@ def _parse_trak(moov: bytes, ps: int, pe: int, out: Dict) -> None:
                 out["channels"] = channels
             if rate:
                 out["sample_rate"] = rate
+
+
+def mp4_cover_art(path: str) -> Optional[bytes]:
+    """Embedded cover image (iTunes-style `covr` in moov/udta/meta/ilst)
+    — JPEG/PNG bytes, or None. Lets MP4/M4V/MOV files carry a real
+    thumbnail with no video decoder (movies/TV rips and anything tagged
+    by iTunes/ffmpeg `-disposition:v attached_pic` muxing carry one)."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        f.seek(0)
+        if f.read(12)[4:8] != b"ftyp":
+            return None
+        moov = None
+        for typ, ps, pe in _file_top_boxes(f, end):
+            if typ == b"moov":
+                if pe - ps > _MOOV_CAP:
+                    return None
+                f.seek(ps)
+                moov = f.read(pe - ps)
+                break
+    if moov is None:
+        return None
+    span = (0, len(moov))
+    for name in (b"udta", b"meta", b"ilst", b"covr", b"data"):
+        found = None
+        for typ, ps, pe in iter_boxes(moov, span[0], span[1]):
+            if typ == name:
+                if name == b"meta":
+                    ps += 4  # FullBox version/flags
+                found = (ps, pe)
+                break
+        if found is None:
+            return None
+        span = found
+    # data box: u32 type (13=jpeg, 14=png), u32 locale, then payload
+    ps, pe = span
+    if pe - ps < 8:
+        return None
+    payload = moov[ps + 8:pe]
+    if payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n":
+        return payload
+    return None

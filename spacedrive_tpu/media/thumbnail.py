@@ -37,10 +37,12 @@ THUMBNAILABLE_EXTENSIONS = {
 
 def thumbnailable_extensions() -> set:
     """Extensions the thumbnail dispatch can handle in THIS runtime:
-    the static set plus every video container when ffmpeg is present."""
-    from .video import VIDEO_EXTENSIONS, available
+    the static set, every video container when ffmpeg is present, and
+    the cover-art containers always (embedded covr/attachment images
+    thumbnail without any decoder; files without one degrade to None)."""
+    from .video import _COVER_EXTENSIONS, VIDEO_EXTENSIONS, available
 
-    exts = set(THUMBNAILABLE_EXTENSIONS)
+    exts = set(THUMBNAILABLE_EXTENSIONS) | set(_COVER_EXTENSIONS)
     if available():
         exts |= VIDEO_EXTENSIONS
     return exts

@@ -79,6 +79,41 @@ def _is_mjpeg_candidate(path: str) -> bool:
             in MJPEG_EXTENSIONS)
 
 
+_COVER_EXTENSIONS = {"mp4", "m4v", "mov", "m4a", "3gp", "mkv", "webm"}
+
+
+def _cover_art_thumbnail(input_path: str, out_path: str,
+                         target_px: float) -> Optional[str]:
+    """Decoder-free fallback for H.264/HEVC containers: embedded cover
+    art (iTunes `covr` in MP4, image attachments in Matroska — the
+    cover.jpg convention of movie files). Returns None when absent."""
+    import io
+
+    from PIL import Image
+
+    from .thumbnail import encode_webp
+
+    ext = os.path.splitext(input_path)[1].lstrip(".").lower()
+    if ext not in _COVER_EXTENSIONS:
+        return None
+    try:
+        if ext in ("mkv", "webm"):
+            from .mkv import mkv_attachment_image
+
+            blob = mkv_attachment_image(input_path)
+        else:
+            from .mp4meta import mp4_cover_art
+
+            blob = mp4_cover_art(input_path)
+        if not blob:
+            return None
+        with Image.open(io.BytesIO(blob)) as im:
+            im.load()
+            return encode_webp(im, out_path, target_px)
+    except Exception:
+        return None
+
+
 def generate_video_thumbnail(input_path: str, out_path: str,
                              target_px: float = 262144.0
                              ) -> Optional[str]:
@@ -91,7 +126,7 @@ def generate_video_thumbnail(input_path: str, out_path: str,
     if not available():
         if _is_mjpeg_candidate(input_path):
             return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return None
+        return _cover_art_thumbnail(input_path, out_path, target_px)
     duration = probe_duration(input_path) or 0.0
     seek = duration * SEEK_PERCENTAGE
     # ~512×512-equivalent area; ffmpeg keeps aspect via -2.
@@ -117,4 +152,4 @@ def generate_video_thumbnail(input_path: str, out_path: str,
             pass
         if _is_mjpeg_candidate(input_path):
             return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return None
+        return _cover_art_thumbnail(input_path, out_path, target_px)

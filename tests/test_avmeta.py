@@ -5,6 +5,7 @@ in this image); field expectations mirror what ffprobe would report.
 Reference parity target: the stubbed video structs in
 /root/reference/crates/media-metadata/src/video.rs."""
 
+import os
 import struct
 
 import pytest
@@ -201,3 +202,90 @@ def test_mkv_nonminimal_size_vint(tmp_path):
     # the audio track AFTER the non-minimal-size element still parses
     assert out["audio_codec"] == "A_OPUS"
     assert out["sample_rate"] == 22050
+
+
+def _jpeg_bytes(color=(10, 200, 90)):
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (60, 45), color).save(buf, "JPEG", quality=85)
+    return buf.getvalue()
+
+
+def make_mp4_with_cover(path: str) -> bytes:
+    jpeg = _jpeg_bytes()
+    make_mp4(path)
+    data = open(path, "rb").read()
+    # append udta/meta/ilst/covr/data inside a rebuilt moov
+    covr = box(b"covr", box(b"data",
+               struct.pack(">II", 13, 0) + jpeg))
+    meta = full_box(b"meta", 0, box(b"hdlr", b"\x00" * 24)
+                    + box(b"ilst", covr))
+    udta = box(b"udta", meta)
+    # splice: find moov, rebuild with udta appended
+    i = data.find(b"moov") - 4
+    size = struct.unpack_from(">I", data, i)[0]
+    moov_payload = data[i + 8:i + size] + udta
+    new_moov = struct.pack(">I4s", 8 + len(moov_payload), b"moov") \
+        + moov_payload
+    open(path, "wb").write(data[:i] + new_moov + data[i + size:])
+    return jpeg
+
+
+def make_mkv_with_attachment(path: str) -> bytes:
+    jpeg = _jpeg_bytes((250, 30, 60))
+    make_mkv(path)
+    data = open(path, "rb").read()
+    attach = el(0x1941A469, el(0x61A7,
+        el(0x466E, "cover.jpg".encode())
+        + el(0x4660, b"image/jpeg")
+        + el(0x465C, jpeg)))
+    # append attachments into the Segment (sizes must be rebuilt)
+    seg_id = (0x18538067).to_bytes(4, "big")
+    i = data.find(seg_id)
+    hdr_end = i + 4
+    # existing segment size vint: our el() writes 1- or 5-byte sizes
+    first = data[hdr_end]
+    slen = 1 if first & 0x80 else 5
+    seg_payload = data[hdr_end + slen:] + attach
+    open(path, "wb").write(
+        data[:i] + el(0x18538067, seg_payload))
+    return jpeg
+
+
+def test_mp4_cover_art_thumbnail(tmp_path):
+    from spacedrive_tpu.media.mp4meta import mp4_cover_art
+    from spacedrive_tpu.media.video import generate_video_thumbnail
+
+    p = str(tmp_path / "movie.mp4")
+    jpeg = make_mp4_with_cover(p)
+    assert mp4_cover_art(p) == jpeg
+    # metadata still parses after the splice
+    assert parse_mp4(p)["video_codec"] == "avc1"
+    out = generate_video_thumbnail(p, str(tmp_path / "t.webp"))
+    assert out and os.path.exists(out)
+    from PIL import Image
+
+    assert Image.open(out).format == "WEBP"
+
+
+def test_mkv_attachment_thumbnail(tmp_path):
+    from spacedrive_tpu.media.mkv import mkv_attachment_image
+    from spacedrive_tpu.media.video import generate_video_thumbnail
+
+    p = str(tmp_path / "movie.mkv")
+    jpeg = make_mkv_with_attachment(p)
+    assert mkv_attachment_image(p) == jpeg
+    assert parse_mkv(p)["video_codec"] == "V_MPEG4/ISO/AVC"
+    out = generate_video_thumbnail(p, str(tmp_path / "t2.webp"))
+    assert out and os.path.exists(out)
+
+
+def test_no_cover_degrades(tmp_path):
+    from spacedrive_tpu.media.video import generate_video_thumbnail
+
+    p = str(tmp_path / "plain.mp4")
+    make_mp4(p)
+    assert generate_video_thumbnail(p, str(tmp_path / "t3.webp")) is None
