@@ -21,7 +21,7 @@ import asyncio
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..sync.crdt import uuid4_bytes
+from ..sync.crdt import OpKind, uuid4_bytes
 
 from ..files import resolve_kind
 from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
@@ -31,6 +31,10 @@ from ..ops import staging
 from ..ops.staging import cas_ids_for_files
 
 CHUNK_SIZE = 100  # file_identifier/mod.rs:36
+
+# The identifier's one op per identified file: cas_id + object link
+# together, per-field LWW on apply (sync/crdt.py OpKind.multi_update).
+LINK_KIND = OpKind.multi_update(("cas_id", "object_id"))
 
 
 def orphan_filters(location_id: int, cursor: int,
@@ -146,16 +150,17 @@ def identify_chunk(library, location_id: int, location_path: str,
              for i, cas_id in ids.items()])
         tp = _mark("db_write", tp)
 
-        # ---- op log: cas_id updates, object creates, object_id links ---
-        # Same op stream the reference's three passes emit
-        # (mod.rs:144/231/167), appended in one bulk batch each.
-        n_ops += sync.bulk_shared_ops(conn, "file_path", [
-            (rows[i]["pub_id"], "u:cas_id", "cas_id", cas_id, None)
-            for i, cas_id in ids.items()])
+        # ---- op log: object creates, then ONE multi-field update per
+        # file_path ({cas_id, object_id} in a single "u:cas_id+object_id"
+        # op — the reference's three per-field passes, mod.rs:144/231/167,
+        # are 3 op rows/file; this is ≤2 and 1 for linked files). Creates
+        # go first so their HLC stamps precede the links and in-order
+        # ingest resolves the object FK.
         n_ops += sync.bulk_shared_ops(conn, "object", create_specs)
         n_ops += sync.bulk_shared_ops(conn, "file_path", [
-            (rows[i]["pub_id"], "u:object_id", "object_id", pub_of[i], None)
-            for i in ids])
+            (rows[i]["pub_id"], LINK_KIND, None, None,
+             {"cas_id": cas_id, "object_id": pub_of[i]})
+            for i, cas_id in ids.items()])
         tp = _mark("ops", tp)
     _mark("db_commit", tp)
     if n_ops:

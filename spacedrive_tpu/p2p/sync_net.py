@@ -24,9 +24,18 @@ from typing import Dict, Optional, Tuple
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind
 from ..sync.manager import GetOpsArgs
 from ..sync.crdt import CRDTOperation
+from ..tracing import logger
 from .identity import RemoteIdentity
 
 OPS_PER_REQUEST = 1000
+
+# Sync wire-format version, checked in BOTH directions: the originator
+# announces it in the new_ops header (responder refuses a mismatch), and
+# the responder echoes it in every pull-request frame (originator refuses
+# to SERVE a mismatch — the direction that matters: a stale decoder
+# pulling v2 ops would silently read multi-field update ops, "u:a+b"
+# kinds, as creates and corrupt its replica's op log).
+SYNC_PROTO = 2
 
 
 class NetworkedLibraries:
@@ -157,11 +166,20 @@ class NetworkedLibraries:
         tunnel = await self.p2p.open_stream(*route, expected=identity)
         try:
             await tunnel.send({"t": "sync", "kind": "new_ops",
-                               "library_id": str(library.id)})
+                               "library_id": str(library.id),
+                               "proto": SYNC_PROTO})
             # Serve the responder's pull loop from our op log.
             while True:
                 req = await tunnel.recv()
                 if not isinstance(req, dict) or req.get("kind") == "done":
+                    break
+                if int(req.get("proto", 1)) != SYNC_PROTO:
+                    # A stale peer would misparse our ops (see SYNC_PROTO)
+                    # — refuse to serve it rather than corrupt its log.
+                    logger.warning(
+                        "not serving sync pull: peer wire proto %s != "
+                        "ours %d", req.get("proto", 1), SYNC_PROTO)
+                    await tunnel.send({"ops": [], "has_more": False})
                     break
                 clocks = [(bytes(i), int(t)) for i, t in req["clocks"]]
                 ops = library.sync.get_ops(GetOpsArgs(
@@ -178,6 +196,13 @@ class NetworkedLibraries:
     # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
 
     async def handle_sync_stream(self, tunnel, header: dict) -> None:
+        proto = int(header.get("proto", 1))
+        if proto != SYNC_PROTO:
+            logger.warning(
+                "refusing sync stream: peer wire proto %d != ours %d",
+                proto, SYNC_PROTO)
+            await tunnel.send({"kind": "done"})
+            return
         lib = self.node.libraries.get(
             uuidlib.UUID(str(header["library_id"])))
         if lib is None:
@@ -207,6 +232,7 @@ class NetworkedLibraries:
                     "kind": "messages",
                     "clocks": [[i, t] for i, t in req.timestamps],
                     "count": OPS_PER_REQUEST,
+                    "proto": SYNC_PROTO,
                 })
                 page = await tunnel.recv()
                 ops = [CRDTOperation.from_wire(raw)

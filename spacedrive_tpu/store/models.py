@@ -104,7 +104,7 @@ register(Model(
         Field("timestamp", "INTEGER", nullable=False),  # HLC as u64 NTP64
         Field("model", "TEXT", nullable=False),
         Field("record_id", "BLOB", nullable=False),  # msgpack sync id
-        Field("kind", "TEXT", nullable=False),  # c | u:<field> | d
+        Field("kind", "TEXT", nullable=False),  # c | u:<field> | u:a+b (multi) | d
         Field("data", "BLOB", nullable=False),  # msgpack payload
         Field("instance_id", "INTEGER", nullable=False,
               references="instance(id)"),

@@ -32,6 +32,24 @@ class OpKind:
     def update(field: str) -> str:
         return f"u:{field}"
 
+    @staticmethod
+    def multi_update(fields) -> str:
+        """Kind string for a multi-field update op: "u:" + the sorted
+        field names joined by "+" (field names cannot contain "+").
+
+        The reference has no such op — its identifier emits one
+        single-field update op per written column (three passes,
+        /root/reference/core/src/object/file_identifier/mod.rs:144-331).
+        Carrying {cas_id, object_id} in ONE op halves the file_path op
+        volume on the flagship job while apply stays per-field LWW
+        (manager._apply_shared filters each field against newer ops)."""
+        return "u:" + "+".join(sorted(fields))
+
+    @staticmethod
+    def update_fields(kind: str) -> list:
+        """Field names covered by an update kind ("u:a+b" → [a, b])."""
+        return kind[2:].split("+") if kind.startswith("u:") else []
+
 
 def uuid4_bytes() -> bytes:
     """Random v4 UUID as 16 bytes, without the uuid.UUID object layer.
@@ -66,6 +84,9 @@ class SharedOp:
     # op-log writes on bulk indexing). Subsequent edits remain per-field
     # LWW updates.
     values: Any = None
+    # update=True + values = a MULTI-FIELD update op (kind "u:a+b"):
+    # one op row carrying several columns, applied per-field LWW.
+    update: bool = False
 
     @property
     def kind(self) -> str:
@@ -73,6 +94,8 @@ class SharedOp:
             return OpKind.DELETE
         if self.field is not None:
             return OpKind.update(self.field)
+        if self.update:
+            return OpKind.multi_update(self.values or {})
         return OpKind.CREATE
 
 
@@ -122,6 +145,8 @@ class CRDTOperation:
                 "field": t.field, "value": t.value, "delete": t.delete,
                 "values": t.values,
             }
+            if t.update:  # key only present on multi-field updates
+                base["shared"]["update"] = True
         else:
             base["relation"] = {
                 "relation": t.relation, "item_id": t.item_id,
@@ -137,7 +162,7 @@ class CRDTOperation:
             s = raw["shared"]
             typ: Union[SharedOp, RelationOp] = SharedOp(
                 s["model"], s["record_id"], s["field"], s["value"],
-                s["delete"], s.get("values"),
+                s["delete"], s.get("values"), bool(s.get("update")),
             )
         else:
             r = raw["relation"]
@@ -153,6 +178,20 @@ class CRDTOperation:
     @classmethod
     def unpack(cls, blob: bytes) -> "CRDTOperation":
         return cls.from_wire(_unpack(blob))
+
+
+def op_payload(field: Optional[str], value: Any, delete: bool,
+               op_id: bytes, values: Any, update: bool = False) -> dict:
+    """The op-log `data` blob's dict, in its one canonical key order.
+
+    Every writer of shared/relation_operation.data MUST build the dict
+    here — _compare_message dedup and backup replay rely on byte-equal
+    packing between the dataclass path and the bulk fast path."""
+    d = {"field": field, "value": value, "delete": delete,
+         "op_id": op_id, "values": values}
+    if update:  # key only present on multi-field update ops
+        d["update"] = True
+    return d
 
 
 def pack_value(v: Any) -> bytes:

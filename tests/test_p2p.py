@@ -32,6 +32,64 @@ async def _start_pair(a: Node, b: Node):
     return await pair_two_nodes(a, b, "shared")
 
 
+def test_sync_stream_refuses_mismatched_proto(two_nodes):
+    """A peer announcing a different sync wire version is refused with a
+    `done` frame before the pull loop starts — a v1 decoder would
+    silently misread multi-field update ops as creates."""
+    a, b = two_nodes
+
+    class FakeTunnel:
+        def __init__(self):
+            self.sent = []
+
+        async def send(self, frame):
+            self.sent.append(frame)
+
+        async def recv(self):
+            raise AssertionError("pull loop must not start on mismatch")
+
+    async def main():
+        lib_a, lib_b = await _start_pair(a, b)
+        t = FakeTunnel()
+        await b.p2p.networked.handle_sync_stream(
+            t, {"t": "sync", "kind": "new_ops",
+                "library_id": str(lib_b.id), "proto": 1})
+        assert t.sent == [{"kind": "done"}]
+
+        # And the direction that matters: the originator must refuse to
+        # SERVE a puller whose request frames lack/mismatch the proto —
+        # a v1 decoder would misread multi-field ops as creates.
+        class V1Puller:
+            def __init__(self):
+                self.sent = []
+                self.frames = [  # a v1 pull request: no "proto" key
+                    {"kind": "messages", "clocks": [], "count": 1000}]
+
+            async def send(self, frame):
+                self.sent.append(frame)
+
+            async def recv(self):
+                return self.frames.pop(0)
+
+            def close(self):
+                pass
+
+        puller = V1Puller()
+
+        async def fake_open_stream(*a, **k):
+            return puller
+
+        a.p2p.open_stream = fake_open_stream
+        from spacedrive_tpu.p2p.identity import RemoteIdentity
+        await a.p2p.networked._originate_one(
+            lib_a, RemoteIdentity(b"\x01" * 32), ("127.0.0.1", 1))
+        # Header announced, then an empty terminal page — no ops served.
+        assert puller.sent[0]["proto"] == 2
+        assert puller.sent[1] == {"ops": [], "has_more": False}
+
+    _run(main())
+
+
 def test_pair_then_sync_over_network(two_nodes, tmp_path):
     a, b = two_nodes
 

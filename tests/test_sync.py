@@ -209,6 +209,110 @@ def test_relation_op_before_referenced_rows_is_parked_then_drained(pair):
         "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 0
 
 
+def test_multi_update_wire_roundtrip(pair):
+    a, _ = pair
+    op = a.shared_multi_update(
+        "file_path", b"\x01" * 16, {"cas_id": "abc", "object_id": b"\x02" * 16})
+    assert op.typ.kind == "u:cas_id+object_id"
+    assert CRDTOperation.unpack(op.pack()) == op
+
+
+def test_multi_update_per_field_lww(pair):
+    """A multi-field update op stays per-field LWW: a newer single-field
+    op beats the stale field it covers, the other field still applies;
+    a fully-covered stale op (single or multi) is rejected outright."""
+    from spacedrive_tpu.sync.crdt import SharedOp
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    multi = a.shared_multi_update("location", pub, {"name": "M", "path": "/m"})
+    newer_name = a.shared_update("location", pub, "name", "N2")
+
+    # Deliver the newer single-field op FIRST, then the stale multi:
+    # name keeps the newer value, path (uncovered) still applies.
+    assert b.receive_crdt_operation(newer_name)
+    assert b.receive_crdt_operation(multi)
+    row = b.db.query_one(
+        "SELECT name, path FROM location WHERE pub_id = ?", (pub,))
+    assert row["name"] == "N2" and row["path"] == "/m"
+
+    # A stale multi whose every field is covered by newer ops is old.
+    stale_multi = CRDTOperation(
+        instance=multi.instance, timestamp=multi.timestamp - 5,
+        id=b"\x03" * 16,
+        typ=SharedOp("location", pub,
+                     values={"name": "OLD", "path": "/old"}, update=True))
+    assert not b.receive_crdt_operation(stale_multi)
+
+    # A stale single-field op loses to the newer multi covering its field.
+    stale_single = CRDTOperation(
+        instance=multi.instance, timestamp=multi.timestamp - 5,
+        id=b"\x04" * 16,
+        typ=SharedOp("location", pub, field="path", value="/stale"))
+    assert not b.receive_crdt_operation(stale_single)
+    row = b.db.query_one(
+        "SELECT name, path FROM location WHERE pub_id = ?", (pub,))
+    assert row["name"] == "N2" and row["path"] == "/m"
+
+
+def test_identifier_link_op_shape_and_remote_replay(tmp_path):
+    """Ingest equivalence for the identifier's ONE-op link shape: a real
+    scan on A emits a single "u:cas_id+object_id" op per identified file
+    (no per-field pair), and replaying A's op log on a fresh B
+    reproduces the same cas_ids and object links, duplicates included."""
+    import random
+    from spacedrive_tpu.locations.manager import create_location, scan_location
+    from spacedrive_tpu.node import Node
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    rng = random.Random(3)
+    for i in range(6):
+        (corpus / f"f{i}.bin").write_bytes(
+            bytes(rng.randrange(256) for _ in range(2000)))
+    (corpus / "dup.bin").write_bytes((corpus / "f0.bin").read_bytes())
+
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+
+    async def main():
+        loc = create_location(lib, str(corpus))
+        await scan_location(node.jobs, lib, loc, backend="numpy",
+                            with_media=False)
+        await node.jobs.wait_idle()
+    asyncio.run(main())
+
+    kinds = [r["kind"] for r in lib.db.query(
+        "SELECT kind FROM shared_operation WHERE model = 'file_path'")]
+    n_files = lib.db.query_one(
+        "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0")["n"]
+    assert kinds.count("u:cas_id+object_id") == n_files == 7
+    assert "u:cas_id" not in kinds and "u:object_id" not in kinds
+
+    b_db = Database(tmp_path / "b.db")
+    b_id = uuid.uuid4().bytes
+    _mk_instance(b_db, b_id)
+    b = SyncManager(b_db, b_id)
+    b.register_instance(lib.sync.instance)
+    while True:
+        ops = lib.sync.get_ops(GetOpsArgs(clocks=list(b.timestamps.items())))
+        if not ops:
+            break
+        for op in ops:
+            b.receive_crdt_operation(op)
+
+    q = ("SELECT fp.pub_id AS p, fp.cas_id AS c, o.pub_id AS op "
+         "FROM file_path fp LEFT JOIN object o ON o.id = fp.object_id "
+         "WHERE fp.is_dir = 0")
+    mine = {r["p"]: (r["c"], r["op"]) for r in lib.db.query(q)}
+    theirs = {r["p"]: (r["c"], r["op"]) for r in b_db.query(q)}
+    assert mine == theirs and len(mine) == 7
+    # The duplicate pair shares one object on the replica too.
+    dups = b_db.query(
+        "SELECT fp.object_id AS o FROM file_path fp "
+        "WHERE fp.name IN ('f0', 'dup')")
+    assert len({r["o"] for r in dups}) == 1 and dups[0]["o"] is not None
+
+
 def test_get_ops_watermark_filters(pair):
     a, _ = pair
     pub = uuid.uuid4().bytes
