@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 from ..store import models as M
 from ..store.db import Database
 from .crdt import (CRDTOperation, OpKind, RelationOp, SharedOp, op_payload,
-                   pack_value, unpack_value, uuid4_bytes)
+                   pack_value, unpack_value, uuid4_bytes, uuid4_bytes_batch)
 from .hlc import HLC
 
 
@@ -225,16 +225,18 @@ class SyncManager:
             return 0
         my_id = self._instance_row_id(self.instance, conn)
         stamps = self.clock.new_timestamps(len(specs))
+        op_ids = uuid4_bytes_batch(len(specs))
 
-        def _data(kind: str, field, value, values) -> bytes:
+        def _data(kind: str, field, value, values, op_id) -> bytes:
             return pack_value(op_payload(
-                field, value, False, uuid4_bytes(), values,
+                field, value, False, op_id, values,
                 update=field is None and kind.startswith("u:")))
 
         rows = [
             (ts, model, pack_value(rid), kind,
-             _data(kind, field, value, values), my_id)
-            for (rid, kind, field, value, values), ts in zip(specs, stamps)
+             _data(kind, field, value, values, op_id), my_id)
+            for (rid, kind, field, value, values), ts, op_id
+            in zip(specs, stamps, op_ids)
         ]
         conn.executemany(
             "INSERT INTO shared_operation "
