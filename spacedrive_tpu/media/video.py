@@ -80,6 +80,31 @@ def _is_mjpeg_candidate(path: str) -> bool:
 
 
 _COVER_EXTENSIONS = {"mp4", "m4v", "mov", "m4a", "3gp", "mkv", "webm"}
+_H264_MP4_EXTENSIONS = {"mp4", "m4v", "mov", "3gp"}
+
+
+def _h264_thumbnail(input_path: str, out_path: str,
+                    target_px: float) -> Optional[str]:
+    """Self-hosted H.264 path: decode the sync sample nearest 10% with
+    the from-spec baseline-I decoder (media/h264.py) and webp it.
+    Returns None for non-H.264 files or streams outside the baseline-I
+    subset (CABAC, high profile) — the caller then tries cover art."""
+    from PIL import Image
+
+    from .h264 import keyframe_from_mp4, yuv420_to_rgb
+    from .thumbnail import encode_webp
+
+    ext = os.path.splitext(input_path)[1].lstrip(".").lower()
+    if ext not in _H264_MP4_EXTENSIONS:
+        return None
+    try:
+        planes = keyframe_from_mp4(input_path, SEEK_PERCENTAGE)
+        if planes is None:
+            return None
+        rgb = yuv420_to_rgb(*planes)
+        return encode_webp(Image.fromarray(rgb), out_path, target_px)
+    except Exception:
+        return None
 
 
 def _cover_art_thumbnail(input_path: str, out_path: str,
@@ -126,7 +151,8 @@ def generate_video_thumbnail(input_path: str, out_path: str,
     if not available():
         if _is_mjpeg_candidate(input_path):
             return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return _cover_art_thumbnail(input_path, out_path, target_px)
+        return (_h264_thumbnail(input_path, out_path, target_px)
+                or _cover_art_thumbnail(input_path, out_path, target_px))
     duration = probe_duration(input_path) or 0.0
     seek = duration * SEEK_PERCENTAGE
     # ~512×512-equivalent area; ffmpeg keeps aspect via -2.
@@ -152,4 +178,5 @@ def generate_video_thumbnail(input_path: str, out_path: str,
             pass
         if _is_mjpeg_candidate(input_path):
             return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return _cover_art_thumbnail(input_path, out_path, target_px)
+        return (_h264_thumbnail(input_path, out_path, target_px)
+                or _cover_art_thumbnail(input_path, out_path, target_px))

@@ -1,0 +1,585 @@
+"""Generate the committed H.264 test fixtures (and their ground truth).
+
+The decoder (spacedrive_tpu/media/h264.py) is validated by BYTE EQUALITY
+against an independent implementation: streams produced here are decoded
+at generation time with OpenCV's FFmpeg (present in this image for
+decode, not encode) and the resulting planes are committed alongside the
+bitstreams. A single shared-table typo cannot hide: the encoder uses the
+repo's CAVLC/intra tables while FFmpeg decodes with its own — any
+disagreement shows up as a generation-time mismatch.
+
+Fixtures (under tests/fixtures/h264/):
+- gradient_ipcm.mp4    I_PCM picture in a minimal MP4 (lossless image)
+- mixed_cavlc.264      I_4x4 + I_16x16 + I_PCM MBs, all intra modes,
+                       random small residuals, mb_qp_delta churn,
+                       two slices — the CAVLC/prediction coverage stream
+- mixed_cavlc.mp4      same picture muxed into MP4 (keyframe-extraction
+                       path target)
+- *.truth.npz          FFmpeg-decoded Y/Cb/Cr for each stream
+
+All streams disable the in-loop deblocking filter (PPS exposes the
+control flag, slices set disable_deblocking_filter_idc=1) so a deblock-
+free decode is bit-exact per the spec.
+
+Usage: python tools/h264_fixture.py [outdir]
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spacedrive_tpu.media import h264 as D  # decode tables reused to encode
+
+
+class BitWriter:
+    def __init__(self):
+        self.bits: list = []
+
+    def u(self, val: int, n: int) -> None:
+        for i in range(n - 1, -1, -1):
+            self.bits.append((val >> i) & 1)
+
+    def put(self, bitstring: str) -> None:
+        self.bits.extend(1 if c == "1" else 0 for c in bitstring)
+
+    def ue(self, v: int) -> None:
+        v += 1
+        n = v.bit_length()
+        self.bits.extend([0] * (n - 1))
+        self.u(v, n)
+
+    def se(self, v: int) -> None:
+        self.ue(2 * v - 1 if v > 0 else -2 * v)
+
+    def align_zero(self) -> None:
+        while len(self.bits) % 8:
+            self.bits.append(0)
+
+    def stop(self) -> None:  # rbsp_trailing_bits
+        self.bits.append(1)
+        while len(self.bits) % 8:
+            self.bits.append(0)
+
+    def bytes(self) -> bytes:
+        out = bytearray()
+        for i in range(0, len(self.bits), 8):
+            b = 0
+            for bit in self.bits[i:i + 8]:
+                b = (b << 1) | bit
+            out.append(b)
+        return bytes(out)
+
+
+def to_nal(rbsp: bytes, nal_type: int, ref_idc: int = 3) -> bytes:
+    out = bytearray([(ref_idc << 5) | nal_type])
+    zeros = 0
+    for b in rbsp:
+        if zeros >= 2 and b <= 3:
+            out.append(3)
+            zeros = 0
+        out.append(b)
+        zeros = zeros + 1 if b == 0 else 0
+    return bytes(out)
+
+
+# -- encode-side VLC tables: invert the decoder's ---------------------------
+
+def _inv(table):
+    return {v: k for k, v in table.items()}
+
+_ENC_CT = {0: _inv(D._COEFF_TOKEN_0), 2: _inv(D._COEFF_TOKEN_2),
+           4: _inv(D._COEFF_TOKEN_4), -1: _inv(D._COEFF_TOKEN_CHROMA_DC)}
+_ENC_TZ = {k: _inv(v) for k, v in D._TOTAL_ZEROS_4x4.items()}
+_ENC_TZC = {k: _inv(v) for k, v in D._TOTAL_ZEROS_CHROMA_DC.items()}
+_ENC_RB = {k: _inv(v) for k, v in D._RUN_BEFORE.items()}
+
+
+def encode_residual(w: BitWriter, coeffs, nC: int, max_coeffs: int) -> None:
+    """CAVLC-encode one block of scan-ordered levels (§9.2 inverse).
+    Levels must stay small enough to avoid the level_prefix escape
+    (|level| <= 7 is always safe at any suffix length)."""
+    nz = [(i, c) for i, c in enumerate(coeffs[:max_coeffs]) if c]
+    total = len(nz)
+    # trailing ones: |1| levels at the highest scan positions (max 3)
+    t1 = 0
+    for i in range(total - 1, -1, -1):
+        if abs(nz[i][1]) == 1 and t1 < 3:
+            t1 += 1
+        else:
+            break
+    # coeff_token
+    if nC == -1:
+        w.put(_ENC_CT[-1][(total, t1)])
+    elif nC < 2:
+        w.put(_ENC_CT[0][(total, t1)])
+    elif nC < 4:
+        w.put(_ENC_CT[2][(total, t1)])
+    elif nC < 8:
+        w.put(_ENC_CT[4][(total, t1)])
+    else:
+        w.u(3 if total == 0 else ((total - 1) << 2) | t1, 6)
+    if total == 0:
+        return
+    coded = nz[::-1]  # highest frequency first
+    for i in range(t1):
+        w.u(1 if coded[i][1] < 0 else 0, 1)
+    suffix_len = 1 if (total > 10 and t1 < 3) else 0
+    for i in range(t1, total):
+        level = coded[i][1]
+        code = 2 * level - 2 if level > 0 else -2 * level - 1
+        if i == t1 and t1 < 3:
+            code -= 2
+        if suffix_len == 0:
+            if code < 14:
+                w.u(1, code + 1)  # prefix zeros then 1
+                # (prefix == code, no suffix)
+                pass
+            else:
+                assert code < 30, "level escape not supported by encoder"
+                w.u(1, 15)  # prefix 14
+                w.u(code - 14, 4)
+        else:
+            prefix = code >> suffix_len
+            assert prefix < 15, "level escape not supported by encoder"
+            w.u(1, prefix + 1)
+            w.u(code & ((1 << suffix_len) - 1), suffix_len)
+        if suffix_len == 0:
+            suffix_len = 1
+        if abs(level) > (3 << (suffix_len - 1)) and suffix_len < 6:
+            suffix_len += 1
+    # total_zeros
+    highest = coded[0][0]
+    total_zeros = highest + 1 - total
+    if total < max_coeffs:
+        if nC == -1:
+            w.put(_ENC_TZC[total][total_zeros])
+        else:
+            w.put(_ENC_TZ[total][total_zeros])
+    # run_before per coded level except the last
+    zeros_left = total_zeros
+    positions = [p for p, _ in coded]
+    for i in range(total - 1):
+        run = positions[i] - positions[i + 1] - 1
+        if zeros_left > 0:
+            w.put(_ENC_RB[min(zeros_left, 7)][run])
+        else:
+            assert run == 0
+        zeros_left -= run
+
+
+# -- parameter sets ---------------------------------------------------------
+
+def make_sps(w_mbs: int, h_mbs: int) -> bytes:
+    w = BitWriter()
+    w.u(66, 8)       # baseline
+    w.u(0xC0, 8)
+    w.u(20, 8)       # level 2.0
+    w.ue(0)          # sps_id
+    w.ue(0)          # log2_max_frame_num_minus4
+    w.ue(2)          # pic_order_cnt_type
+    w.ue(0)          # max_num_ref_frames
+    w.u(0, 1)
+    w.ue(w_mbs - 1)
+    w.ue(h_mbs - 1)
+    w.u(1, 1)        # frame_mbs_only
+    w.u(0, 1)
+    w.u(0, 1)        # no cropping
+    w.u(0, 1)        # no vui
+    w.stop()
+    return to_nal(w.bytes(), 7)
+
+
+def make_pps(qp: int) -> bytes:
+    w = BitWriter()
+    w.ue(0)
+    w.ue(0)
+    w.u(0, 1)        # CAVLC
+    w.u(0, 1)
+    w.ue(0)
+    w.ue(0)
+    w.ue(0)
+    w.u(0, 1)
+    w.u(0, 2)
+    w.se(qp - 26)    # pic_init_qp
+    w.se(0)
+    w.se(0)          # chroma_qp_index_offset
+    w.u(1, 1)        # deblocking_filter_control_present
+    w.u(0, 1)
+    w.u(0, 1)
+    w.stop()
+    return to_nal(w.bytes(), 8)
+
+
+def slice_header(w: BitWriter, first_mb: int, qp: int, pic_init_qp: int
+                 ) -> None:
+    w.ue(first_mb)
+    w.ue(7)          # slice_type I
+    w.ue(0)          # pps_id
+    w.u(0, 4)        # frame_num
+    w.ue(0)          # idr_pic_id
+    w.u(0, 1)        # no_output_of_prior_pics
+    w.u(0, 1)        # long_term_reference
+    w.se(qp - pic_init_qp)      # slice_qp_delta
+    w.ue(1)          # disable_deblocking_filter_idc = 1 (OFF)
+
+
+# -- I_PCM stream -----------------------------------------------------------
+
+def ipcm_idr(y: np.ndarray, cb: np.ndarray, cr: np.ndarray, qp: int
+             ) -> bytes:
+    h_mb, w_mb = y.shape[0] // 16, y.shape[1] // 16
+    w = BitWriter()
+    slice_header(w, 0, qp, qp)
+    for mby in range(h_mb):
+        for mbx in range(w_mb):
+            w.ue(25)
+            w.align_zero()
+            for r in range(16):
+                for c in range(16):
+                    w.u(int(y[mby * 16 + r, mbx * 16 + c]), 8)
+            for plane in (cb, cr):
+                for r in range(8):
+                    for c in range(8):
+                        w.u(int(plane[mby * 8 + r, mbx * 8 + c]), 8)
+    w.stop()
+    return to_nal(w.bytes(), 5)
+
+
+# -- coverage stream: random modes + random residuals -----------------------
+
+def _rand_coeffs(rng: random.Random, max_coeffs: int, density: float
+                 ) -> list:
+    out = [0] * max_coeffs
+    for i in range(max_coeffs):
+        if rng.random() < density:
+            mag = rng.choice([1, 1, 1, 2, 2, 3, 4, 5])
+            out[i] = mag if rng.random() < 0.5 else -mag
+    return out
+
+
+class _NzTracker:
+    """Mirror of the decoder's nC bookkeeping, per plane."""
+
+    def __init__(self, h_blocks: int, w_blocks: int):
+        self.nz = np.full((h_blocks, w_blocks), -1, np.int16)
+
+    def nC(self, by: int, bx: int) -> int:
+        nA = int(self.nz[by, bx - 1]) if bx > 0 and \
+            self.nz[by, bx - 1] >= 0 else None
+        nB = int(self.nz[by - 1, bx]) if by > 0 and \
+            self.nz[by - 1, bx] >= 0 else None
+        if nA is not None and nB is not None:
+            return (nA + nB + 1) >> 1
+        return nA if nA is not None else (nB if nB is not None else 0)
+
+
+def coverage_idr(w_mb: int, h_mb: int, qp0: int, seed: int,
+                 slice_split: int) -> list:
+    """Random-but-valid IDR picture exercising every mb_type class,
+    every intra mode that availability permits, residual CAVLC at
+    several QPs, as 1-2 slices. Returns slice NAL list."""
+    rng = random.Random(seed)
+    nzY = _NzTracker(h_mb * 4, w_mb * 4)
+    nzCb = _NzTracker(h_mb * 2, w_mb * 2)
+    nzCr = _NzTracker(h_mb * 2, w_mb * 2)
+    i4modes = np.full((h_mb * 4, w_mb * 4), -1, np.int16)
+    slice_of = np.full((h_mb, w_mb), -1, np.int32)
+    nals = []
+    w = BitWriter()
+    qp = qp0
+    sid = 0
+    slice_header(w, 0, qp0, qp0)
+    for addr in range(w_mb * h_mb):
+        mby, mbx = divmod(addr, w_mb)
+        if slice_split and addr == slice_split:
+            w.stop()
+            nals.append(to_nal(w.bytes(), 5))
+            w = BitWriter()
+            qp = qp0
+            sid += 1
+            slice_header(w, addr, qp0, qp0)
+            # cross-slice neighbors are unavailable for nC and mode
+            # prediction — fresh trackers give exactly that view
+            nzY = _NzTracker(h_mb * 4, w_mb * 4)
+            nzCb = _NzTracker(h_mb * 2, w_mb * 2)
+            nzCr = _NzTracker(h_mb * 2, w_mb * 2)
+            i4modes = np.full((h_mb * 4, w_mb * 4), -1, np.int16)
+        slice_of[mby, mbx] = sid
+
+        def _same(my, mx):
+            return (0 <= my < h_mb and 0 <= mx < w_mb
+                    and slice_of[my, mx] == sid)
+
+        # neighbors in a different slice are unavailable for intra
+        # prediction AND nC (the decoder mirrors this; FFmpeg enforces
+        # it — a cross-slice mode reference is an illegal stream)
+        up = _same(mby - 1, mbx)
+        left = _same(mby, mbx - 1)
+        upleft = _same(mby - 1, mbx - 1)
+        upright = _same(mby - 1, mbx + 1)
+        kind = rng.choice(["i4", "i4", "i16", "i16", "pcm"])
+        if kind == "pcm":
+            w.ue(25)
+            w.align_zero()
+            for _ in range(256 + 128):
+                w.u(rng.randrange(256), 8)
+            nzY.nz[mby * 4:mby * 4 + 4, mbx * 4:mbx * 4 + 4] = 16
+            nzCb.nz[mby * 2:mby * 2 + 2, mbx * 2:mbx * 2 + 2] = 16
+            nzCr.nz[mby * 2:mby * 2 + 2, mbx * 2:mbx * 2 + 2] = 16
+            i4modes[mby * 4:mby * 4 + 4, mbx * 4:mbx * 4 + 4] = 2
+            continue
+        if kind == "i16":
+            pred = rng.choice([m for m, need in
+                               ((0, up), (1, left), (2, True),
+                                (3, up and left and upleft)) if need])
+            cbp_chroma = rng.choice([0, 1, 2])
+            cbp_luma = rng.choice([0, 15])
+            mb_type = 1 + pred + 4 * (cbp_chroma + 3 * (cbp_luma == 15))
+            w.ue(mb_type)
+            chroma_mode = rng.choice(
+                [m for m, need in ((0, True), (1, left), (2, up),
+                                   (3, up and left and upleft)) if need])
+            w.ue(chroma_mode)
+            dqp = rng.choice([-2, -1, 0, 0, 0, 1, 2])
+            if not (26 <= qp + dqp <= 44):
+                dqp = 0
+            qp += dqp
+            w.se(dqp)
+            # luma DC
+            nc = nzY.nC(mby * 4, mbx * 4)
+            dc = _rand_coeffs(rng, 16, 0.3)
+            encode_residual(w, dc, nc, 16)
+            for k in range(16):
+                br, bc = D._BLK4_ORDER[k]
+                gy, gx = mby * 4 + br, mbx * 4 + bc
+                if cbp_luma:
+                    nc = nzY.nC(gy, gx)
+                    ac = _rand_coeffs(rng, 15, 0.25)
+                    encode_residual(w, ac, nc, 15)
+                    nzY.nz[gy, gx] = sum(1 for c in ac if c)
+                else:
+                    nzY.nz[gy, gx] = 0
+                i4modes[gy, gx] = 2
+        else:  # I_4x4
+            w.ue(0)
+            modes = []
+            for k in range(16):
+                br, bc = D._BLK4_ORDER[k]
+                gy, gx = mby * 4 + br, mbx * 4 + bc
+                lm = i4modes[gy, gx - 1] if gx > 0 else -1
+                tm = i4modes[gy - 1, gx] if gy > 0 else -1
+                predm = 2 if lm < 0 or tm < 0 else min(int(lm), int(tm))
+                # availability for this block (same rules as the decoder)
+                t_ok = (br > 0) or up
+                l_ok = (bc > 0) or left
+                tl_ok = (br > 0 and bc > 0) or (br > 0 and left) or \
+                    (bc > 0 and up) or upleft
+                allowed = [2]
+                if t_ok:
+                    allowed += [0, 3, 7]
+                if l_ok:
+                    allowed += [1, 8]
+                if t_ok and l_ok and tl_ok:
+                    allowed += [4, 5, 6]
+                mode = rng.choice(allowed)
+                i4modes[gy, gx] = mode
+                modes.append(mode)
+                if mode == predm:
+                    w.u(1, 1)
+                else:
+                    w.u(0, 1)
+                    w.u(mode if mode < predm else mode - 1, 3)
+            chroma_mode = rng.choice(
+                [m for m, need in ((0, True), (1, left), (2, up),
+                                   (3, up and left and upleft)) if need])
+            w.ue(chroma_mode)
+            cbp_luma = rng.choice([0, 3, 15, 9, 6])
+            cbp_chroma = rng.choice([0, 1, 2])
+            cbp = cbp_luma | (cbp_chroma << 4)
+            w.ue(D._CBP_INTRA.index(cbp))
+            if cbp:
+                dqp = rng.choice([-1, 0, 0, 1])
+                if not (26 <= qp + dqp <= 44):
+                    dqp = 0
+                qp += dqp
+                w.se(dqp)
+            for k in range(16):
+                br, bc = D._BLK4_ORDER[k]
+                gy, gx = mby * 4 + br, mbx * 4 + bc
+                blk8 = (br // 2) * 2 + (bc // 2)
+                if cbp_luma & (1 << blk8):
+                    nc = nzY.nC(gy, gx)
+                    co = _rand_coeffs(rng, 16, 0.25)
+                    encode_residual(w, co, nc, 16)
+                    nzY.nz[gy, gx] = sum(1 for c in co if c)
+                else:
+                    nzY.nz[gy, gx] = 0
+        # chroma residual (shared by i4/i16)
+        dcs = []
+        for _plane in range(2):
+            if cbp_chroma:
+                dc = _rand_coeffs(rng, 4, 0.4)
+                encode_residual(w, dc, -1, 4)
+            dcs.append(None)
+        for tracker in (nzCb, nzCr):
+            for br in range(2):
+                for bc in range(2):
+                    gy, gx = mby * 2 + br, mbx * 2 + bc
+                    if cbp_chroma == 2:
+                        nc = tracker.nC(gy, gx)
+                        ac = _rand_coeffs(rng, 15, 0.2)
+                        encode_residual(w, ac, nc, 15)
+                        tracker.nz[gy, gx] = sum(1 for c in ac if c)
+                    else:
+                        tracker.nz[gy, gx] = 0
+    w.stop()
+    nals.append(to_nal(w.bytes(), 5))
+    return nals
+
+
+# -- minimal MP4 muxer ------------------------------------------------------
+
+def _box(typ: bytes, payload: bytes) -> bytes:
+    return struct.pack(">I4s", 8 + len(payload), typ) + payload
+
+
+def _full(typ: bytes, version: int, flags: int, payload: bytes) -> bytes:
+    return _box(typ, struct.pack(">B3s", version,
+                                 flags.to_bytes(3, "big")) + payload)
+
+
+def mux_mp4(sps_nal: bytes, pps_nal: bytes, slice_nals: list,
+            width: int, height: int) -> bytes:
+    """One-keyframe MP4: ftyp + mdat(sample) + moov with a full sample
+    table (ISO/IEC 14496-12 + -15 avcC)."""
+    sample = b"".join(struct.pack(">I", len(n)) + n for n in slice_nals)
+    ftyp = _box(b"ftyp", b"isom\x00\x00\x02\x00isomavc1")
+    mdat = _box(b"mdat", sample)
+    sample_off = len(ftyp) + 8  # into mdat payload
+
+    avcc = (b"\x01" + sps_nal[1:4] + b"\xff" +
+            b"\xe1" + struct.pack(">H", len(sps_nal)) + sps_nal +
+            b"\x01" + struct.pack(">H", len(pps_nal)) + pps_nal)
+    avc1 = _box(b"avc1",
+                b"\x00" * 6 + struct.pack(">H", 1) +      # dref index
+                b"\x00" * 16 +
+                struct.pack(">HH", width, height) +
+                struct.pack(">II", 0x480000, 0x480000) +  # dpi
+                b"\x00" * 4 +
+                struct.pack(">H", 1) +                    # frame count
+                b"\x00" * 32 +
+                struct.pack(">H", 0x18) +
+                struct.pack(">h", -1) +
+                _box(b"avcC", avcc))
+    stsd = _full(b"stsd", 0, 0, struct.pack(">I", 1) + avc1)
+    stts = _full(b"stts", 0, 0, struct.pack(">III", 1, 1, 1000))
+    stsc = _full(b"stsc", 0, 0, struct.pack(">IIII", 1, 1, 1, 1))
+    stsz = _full(b"stsz", 0, 0, struct.pack(">III", 0, 1, len(sample)))
+    stco = _full(b"stco", 0, 0, struct.pack(">II", 1, sample_off))
+    stss = _full(b"stss", 0, 0, struct.pack(">II", 1, 1))
+    stbl = _box(b"stbl", stsd + stts + stsc + stsz + stco + stss)
+    url_ = _full(b"url ", 0, 1, b"")
+    dref = _full(b"dref", 0, 0, struct.pack(">I", 1) + url_)
+    dinf = _box(b"dinf", dref)
+    vmhd = _full(b"vmhd", 0, 1, b"\x00" * 8)
+    minf = _box(b"minf", vmhd + dinf + stbl)
+    hdlr = _full(b"hdlr", 0, 0, b"\x00" * 4 + b"vide" + b"\x00" * 12 +
+                 b"sdtpu\x00")
+    mdhd = _full(b"mdhd", 0, 0, struct.pack(">IIIIHH", 0, 0, 1000, 1000,
+                                            0x55C4, 0))
+    mdia = _box(b"mdia", mdhd + hdlr + minf)
+    mat = (struct.pack(">iii", 0x10000, 0, 0) +
+           struct.pack(">iii", 0, 0x10000, 0) +
+           struct.pack(">iii", 0, 0, 0x40000000))
+    tkhd = _full(b"tkhd", 0, 7,
+                 struct.pack(">IIII", 0, 0, 1, 0) +
+                 struct.pack(">I", 1000) + b"\x00" * 8 +
+                 struct.pack(">hhhh", 0, 0, 0, 0) + mat +
+                 struct.pack(">II", width << 16, height << 16))
+    trak = _box(b"trak", tkhd + mdia)
+    mvhd = _full(b"mvhd", 0, 0,
+                 struct.pack(">IIII", 0, 0, 1000, 1000) +
+                 struct.pack(">I", 0x00010000) + struct.pack(">H", 0x0100) +
+                 b"\x00" * 10 + mat + b"\x00" * 24 +
+                 struct.pack(">I", 2))
+    moov = _box(b"moov", mvhd + trak)
+    return ftyp + mdat + moov
+
+
+# -- ground truth via OpenCV/FFmpeg -----------------------------------------
+
+def ffmpeg_truth(annexb: bytes, tmpdir: str, name: str):
+    import cv2
+    p = os.path.join(tmpdir, name + ".264")
+    with open(p, "wb") as f:
+        f.write(annexb)
+    cap = cv2.VideoCapture(p)
+    cap.set(cv2.CAP_PROP_CONVERT_RGB, 0)
+    ok, ypl = cap.read()
+    if not ok:
+        raise RuntimeError(f"FFmpeg refused {name}")
+    cap.release()
+    # second pass for chroma via BGR (lossy conversion — used only as a
+    # sanity bound, Y is the exact plane)
+    cap = cv2.VideoCapture(p)
+    ok, bgr = cap.read()
+    cap.release()
+    return ypl, bgr
+
+
+def main(outdir: str) -> None:
+    os.makedirs(outdir, exist_ok=True)
+    import cv2
+
+    # ---- fixture 1: I_PCM gradient in MP4 -------------------------------
+    H, W = 48, 80
+    yy, xx = np.mgrid[0:H, 0:W]
+    y = ((xx * 3 + yy * 2) % 240 + 8).astype(np.uint8)
+    cb = (np.linspace(60, 180, (H // 2) * (W // 2)) % 255).astype(
+        np.uint8).reshape(H // 2, W // 2)
+    cr = (np.linspace(180, 60, (H // 2) * (W // 2)) % 255).astype(
+        np.uint8).reshape(H // 2, W // 2)
+    sps, pps = make_sps(W // 16, H // 16), make_pps(30)
+    idr = ipcm_idr(y, cb, cr, 30)
+    annexb = b"".join(b"\x00\x00\x00\x01" + n for n in (sps, pps, idr))
+    ypl, _ = ffmpeg_truth(annexb, outdir, "gradient_ipcm")
+    assert np.array_equal(ypl, y), "I_PCM luma must round-trip exactly"
+    mp4 = mux_mp4(sps, pps, [idr], W, H)
+    with open(os.path.join(outdir, "gradient_ipcm.mp4"), "wb") as f:
+        f.write(mp4)
+    # cv2 must also read the MP4 container itself
+    capm = cv2.VideoCapture(os.path.join(outdir, "gradient_ipcm.mp4"))
+    okm, _ = capm.read()
+    capm.release()
+    assert okm, "muxed MP4 unreadable by FFmpeg"
+    np.savez_compressed(os.path.join(outdir, "gradient_ipcm.truth.npz"),
+                        Y=y, Cb=cb, Cr=cr)
+    print("gradient_ipcm: ok (Y exact vs FFmpeg, MP4 readable)")
+
+    # ---- fixture 2: CAVLC/intra coverage --------------------------------
+    W2, H2 = 96, 64  # 6x4 MBs
+    sps2, pps2 = make_sps(W2 // 16, H2 // 16), make_pps(32)
+    nals = coverage_idr(W2 // 16, H2 // 16, 32, seed=1234, slice_split=13)
+    annexb2 = b"".join(b"\x00\x00\x00\x01" + n
+                       for n in [sps2, pps2] + nals)
+    ypl2, bgr2 = ffmpeg_truth(annexb2, outdir, "mixed_cavlc")
+    with open(os.path.join(outdir, "mixed_cavlc.264"), "wb") as f:
+        f.write(annexb2)
+    mp42 = mux_mp4(sps2, pps2, nals, W2, H2)
+    with open(os.path.join(outdir, "mixed_cavlc.mp4"), "wb") as f:
+        f.write(mp42)
+    np.savez_compressed(os.path.join(outdir, "mixed_cavlc.truth.npz"),
+                        Y=ypl2, BGR=bgr2)
+    print("mixed_cavlc: FFmpeg decoded", ypl2.shape,
+          "slices:", len(nals))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "tests/fixtures/h264")
