@@ -105,6 +105,16 @@ class StatefulJob:
     ) -> Optional[StepOutcome]:
         raise NotImplementedError
 
+    async def cleanup(self, ctx: "JobContext",
+                      data: Optional[Dict[str, Any]]) -> None:
+        """Best-effort teardown when the job ends WITHOUT finalize
+        (cancellation or a job-level failure). Jobs that alter
+        library-wide state for the duration of a run (the identifier's
+        bulk index drop) restore it here. Must be idempotent; the
+        worker swallows exceptions. `data` may be None when the job
+        died before any state existed."""
+        return None
+
     async def finalize(
         self, ctx: "JobContext", data: Dict[str, Any], metadata: Dict[str, Any]
     ) -> Optional[Dict[str, Any]]:

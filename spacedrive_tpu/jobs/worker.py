@@ -106,6 +106,7 @@ class Worker:
         except asyncio.CancelledError:
             status = await self._persist_paused_or_fail("worker task cancelled")
         except Exception as e:  # noqa: BLE001 — job-level catch-all
+            await self._cleanup_quietly(None)
             self.report.status = JobStatus.FAILED
             self.report.errors_text.append(
                 "".join(traceback.format_exception(e)).strip()
@@ -296,7 +297,16 @@ class Worker:
         self.report.update(self.library.db)
         return self.report.status
 
+    async def _cleanup_quietly(self, data) -> None:
+        """Run the job's no-finalize teardown hook; never raises."""
+        try:
+            await self.job.cleanup(
+                JobContext(self.library, services=self.services), data)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
+
     async def _finish_cancel(self, state: JobState) -> JobStatus:
+        await self._cleanup_quietly(state.data)
         self.report.status = JobStatus.CANCELED
         self.report.data = None
         self.report.completed_task_count = state.step_number

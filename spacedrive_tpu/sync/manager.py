@@ -227,13 +227,21 @@ class SyncManager:
         stamps = self.clock.new_timestamps(len(specs))
         op_ids = uuid4_bytes_batch(len(specs))
 
+        def _rid(rid) -> bytes:
+            # record ids are almost always 16-byte pub_ids; msgpack
+            # bin8(16) is b"\xc4\x10" + payload — one concat instead of
+            # a packb call per row (byte-identical, ~0.8 s/1.9M rows)
+            if type(rid) is bytes and len(rid) == 16:
+                return b"\xc4\x10" + rid
+            return pack_value(rid)
+
         def _data(kind: str, field, value, values, op_id) -> bytes:
             return pack_value(op_payload(
                 field, value, False, op_id, values,
                 update=field is None and kind.startswith("u:")))
 
         rows = [
-            (ts, model, pack_value(rid), kind,
+            (ts, model, _rid(rid), kind,
              _data(kind, field, value, values, op_id), my_id)
             for (rid, kind, field, value, values), ts, op_id
             in zip(specs, stamps, op_ids)
