@@ -216,15 +216,27 @@ class NetworkedLibraries:
     async def _pull(self, library, tunnel) -> None:
         """Bridge the ingest actor's request queue to the wire: its
         MESSAGES requests become GetOperations frames, pages come back as
-        MessagesEvents, FINISHED closes the stream."""
+        MessagesEvents, FINISHED closes the stream.
+
+        When a pull APPLIED anything, re-announce to our own peers:
+        ingested ops land in our op log (including relayed, other-
+        instance-authored ones), so in an A↔B↔C line B forwards A's
+        writes to C. Announcing only on applied>0 terminates — a node
+        with nothing new never re-fans."""
         ingester = Ingester(library.sync)
         ingester.start()
+        applied = 0
         try:
             ingester.notify()
             while True:
                 req = await ingester.requests.get()
+                if req.kind == ReqKind.INGESTED:
+                    applied += req.count
+                    continue
                 if req.kind == ReqKind.FINISHED:
                     await tunnel.send({"kind": "done"})
+                    if applied:
+                        self.originate_soon(library)
                     return
                 if req.kind != ReqKind.MESSAGES:
                     continue

@@ -32,6 +32,93 @@ async def _start_pair(a: Node, b: Node):
     return await pair_two_nodes(a, b, "shared")
 
 
+def test_three_node_line_partition_heal(tmp_path):
+    """A↔B↔C line over real TCP: ops relay transitively through B's op
+    log (C never pairs with A), a partition of B stalls propagation,
+    and healing converges every pair — rows AND op logs. The reference
+    only ever ships two-instance sync tests
+    (core/crates/sync/tests/lib.rs:102-217)."""
+    from spacedrive_tpu.sync.manager import GetOpsArgs
+
+    nodes = [Node(str(tmp_path / n)) for n in "abc"]
+    a, b, c = nodes
+
+    async def write_tag(lib, name):
+        sync = lib.sync
+        pub = os.urandom(16)
+        ops = sync.shared_create("tag", pub, {"name": name})
+        with sync.write_ops(ops) as conn:
+            conn.execute("INSERT INTO tag (pub_id, name) VALUES (?, ?)",
+                         (pub, name))
+        return pub
+
+    def tag_names(lib):
+        return {r["name"] for r in lib.db.query("SELECT name FROM tag")}
+
+    async def converge(libs, want, timeout=12.0):
+        for _ in range(int(timeout / 0.05)):
+            await asyncio.sleep(0.05)
+            if all(tag_names(lib) == want for lib in libs):
+                return True
+        return False
+
+    async def main():
+        for n in nodes:
+            await n.start()
+        ports = [await n.start_p2p(host="127.0.0.1",
+                                   enable_discovery=False) for n in nodes]
+        # A shares its library into B; B shares the same library into C.
+        b.p2p.on_pairing_request = lambda peer, info: True
+        c.p2p.on_pairing_request = lambda peer, info: True
+        lib_a = a.create_library("mesh")
+        assert await a.p2p.pair("127.0.0.1", ports[1], lib_a)
+        lib_b = b.libraries.list()[0]
+        assert await b.p2p.pair("127.0.0.1", ports[2], lib_b)
+        lib_c = c.libraries.list()[0]
+        libs = [lib_a, lib_b, lib_c]
+
+        # Transitive relay: a write on A must reach C (and C's reach A).
+        await write_tag(lib_a, "from-a")
+        await write_tag(lib_c, "from-c")
+        assert await converge(libs, {"from-a", "from-c"}), \
+            [sorted(tag_names(x)) for x in libs]
+
+        # Partition: B's p2p goes down; A and C write concurrently.
+        await b.p2p.stop()
+        await write_tag(lib_a, "partition-a")
+        await write_tag(lib_c, "partition-c")
+        await asyncio.sleep(0.4)
+        assert "partition-a" not in tag_names(lib_c)
+        assert "partition-c" not in tag_names(lib_a)
+
+        # Heal: B rebinds on a new port; peers re-learn the route (the
+        # discovery plane's job in production; injected here) and the
+        # next write on each side drains everything both ways.
+        new_port = await b.start_p2p(host="127.0.0.1",
+                                     enable_discovery=False)
+        ident_b = b.p2p.identity.to_remote_identity()
+        a.p2p.networked.set_route(ident_b, "127.0.0.1", new_port)
+        c.p2p.networked.set_route(ident_b, "127.0.0.1", new_port)
+        await write_tag(lib_a, "heal-a")
+        await write_tag(lib_c, "heal-c")
+        want = {"from-a", "from-c", "partition-a", "partition-c",
+                "heal-a", "heal-c"}
+        assert await converge(libs, want), \
+            [sorted(tag_names(x)) for x in libs]
+
+        # Op-log equivalence on every pair.
+        logs = []
+        for lib in libs:
+            ops = lib.sync.get_ops(GetOpsArgs(clocks=[], count=10000))
+            logs.append(sorted(
+                (o.timestamp, o.instance, o.typ.kind) for o in ops))
+        assert logs[0] == logs[1] == logs[2]
+        for n in nodes:
+            await n.shutdown()
+
+    _run(main())
+
+
 def test_sync_stream_refuses_mismatched_proto(two_nodes):
     """A peer announcing a different sync wire version is refused with a
     `done` frame before the pull loop starts — a v1 decoder would

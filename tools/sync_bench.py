@@ -1,0 +1,97 @@
+"""Ingest drain-rate benchmark: ops/s through the real paged pull loop.
+
+Builds a library on node A with a large op backlog (default 120k ops:
+tag creates + per-field updates), pairs a FRESH node B over real TCP,
+and times the pairing backfill — the responder's pull loop paging
+GetOperations at 1000 ops/request through the ingest state machine
+(the reference pages at the same size, core/src/p2p/sync/mod.rs:403).
+
+Prints one JSON line: {"metric": "sync_ingest_ops_per_sec", ...}.
+
+Usage: python tools/sync_bench.py [n_ops]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spacedrive_tpu.node import Node  # noqa: E402
+
+
+def build_backlog(lib, n_ops: int) -> int:
+    """Write ~n_ops ops locally: tag creates + name updates, in 1000-op
+    transactions (the shape a long-offline peer accumulates)."""
+    sync = lib.sync
+    total = 0
+    while total < n_ops:
+        batch = min(1000, n_ops - total)
+        ops = []
+        rows = []
+        for _ in range((batch + 1) // 2):
+            pub = os.urandom(16)
+            ops.extend(sync.shared_create("tag", pub, {"name": "t"}))
+            ops.append(sync.shared_update("tag", pub, "name", "t2"))
+            rows.append((pub, "t2"))
+        with sync.write_ops(ops) as conn:
+            conn.executemany(
+                "INSERT INTO tag (pub_id, name) VALUES (?, ?)", rows)
+        total += len(ops)
+    return total
+
+
+async def main(n_ops: int) -> None:
+    tmp = tempfile.mkdtemp(prefix="sync-bench-")
+    a = Node(os.path.join(tmp, "a"))
+    b = Node(os.path.join(tmp, "b"))
+    await a.start()
+    await b.start()
+    lib_a = a.create_library("bench")
+    total = build_backlog(lib_a, n_ops)
+
+    await a.start_p2p(host="127.0.0.1", enable_discovery=False)
+    port_b = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
+    b.p2p.on_pairing_request = lambda peer, info: True
+
+    t0 = time.perf_counter()
+    assert await a.p2p.pair("127.0.0.1", port_b, lib_a)
+    lib_b = b.libraries.list()[0]
+
+    def count_b() -> int:
+        return lib_b.db.query_one(
+            "SELECT COUNT(*) AS n FROM shared_operation")["n"]
+
+    last = -1
+    while True:
+        await asyncio.sleep(0.25)
+        n = count_b()
+        if n >= total:
+            break
+        if n == last:
+            # stalled? poke the originator again (a dropped announce
+            # must not hang the bench)
+            a.p2p.networked.originate_soon(lib_a)
+        last = n
+    dt = time.perf_counter() - t0
+    rows = lib_b.db.query_one("SELECT COUNT(*) AS n FROM tag")["n"]
+    print(json.dumps({
+        "metric": "sync_ingest_ops_per_sec",
+        "value": round(total / dt, 1),
+        "unit": "ops/s",
+        "ops": total,
+        "seconds": round(dt, 2),
+        "pages": -(-total // 1000),
+        "replica_tag_rows": rows,
+    }))
+    await a.shutdown()
+    await b.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 120_000))
