@@ -235,8 +235,6 @@ class NetworkedLibraries:
                     continue
                 if req.kind == ReqKind.FINISHED:
                     await tunnel.send({"kind": "done"})
-                    if applied:
-                        self.originate_soon(library)
                     return
                 if req.kind != ReqKind.MESSAGES:
                     continue
@@ -254,3 +252,12 @@ class NetworkedLibraries:
                     has_more=bool(page.get("has_more"))))
         finally:
             await ingester.stop()
+            while not ingester.requests.empty():  # unread tail counts
+                req = ingester.requests.get_nowait()
+                if req.kind == ReqKind.INGESTED:
+                    applied += req.count
+            if applied:
+                # Fire the relay fan-out even when the stream ended
+                # abnormally (peer drop mid-pull): whatever DID apply
+                # is durably in our log and must still reach our peers.
+                self.originate_soon(library)

@@ -106,8 +106,12 @@ class Ingester:
                     applied, errors = await asyncio.to_thread(
                         self.sync.receive_crdt_operations, event.messages)
                 except Exception as e:  # page-level guard
+                    # A page-level failure (commit error, disk full)
+                    # would repeat forever if we re-requested the same
+                    # clocks — ABORT this pull; the next notification
+                    # retries from the persisted watermarks.
                     self.errors.append(f"ingest page: {e}")
-                    applied, errors = 0, []
+                    break
                 self.errors.extend(errors)
                 if applied:
                     await self.requests.put(

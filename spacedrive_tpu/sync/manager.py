@@ -389,9 +389,6 @@ class SyncManager:
         with self.db.tx() as conn:
             for op in ops:
                 self.clock.update_with_timestamp(op.timestamp)
-                ts = max(self.timestamps.get(op.instance, op.timestamp),
-                         ts_max.get(op.instance, 0), op.timestamp)
-                ts_max[op.instance] = ts
                 try:
                     if not self._compare_message(op):
                         conn.execute("SAVEPOINT ingest_op")
@@ -405,7 +402,15 @@ class SyncManager:
                             conn.execute("RELEASE SAVEPOINT ingest_op")
                         applied += 1
                 except Exception as e:  # noqa: BLE001 — per-op guard
+                    # NO watermark advance for a failed op — advancing
+                    # would make get_ops never re-serve it (silent
+                    # divergence); the next pull retries it.
                     errors.append(f"ingest {op.typ!r}: {e}")
+                    continue
+                # watermark moves only past applied-or-stale ops
+                ts_max[op.instance] = max(
+                    self.timestamps.get(op.instance, op.timestamp),
+                    ts_max.get(op.instance, 0), op.timestamp)
             for pub, ts in ts_max.items():
                 conn.execute(
                     "UPDATE instance SET timestamp = ? WHERE pub_id = ?",

@@ -7,11 +7,20 @@ platform selection must go through jax.config because the axon TPU
 plugin overrides the JAX_PLATFORMS env var at interpreter start.
 """
 
+import os
+
 import pytest
 
 from spacedrive_tpu.xla_env import ensure_host_device_count
 
 ensure_host_device_count(8)
+
+# The identifier's auto mesh-sharded CAS dispatch would compile a fresh
+# shard_map program (~50 s on the CPU mesh) per batch grid across the
+# whole suite; tests pin the single-device program and the sharded
+# dispatch is covered by test_blake3_jax's dedicated case (which flips
+# this back) plus the driver's dryrun_multichip stage 6.
+os.environ.setdefault("SDTPU_SHARDED_CAS", "off")
 
 # The axon TPU plugin registers at interpreter start (sitecustomize) and
 # sets jax_platforms="axon,cpu", so merely calling jax.devices() would
