@@ -1039,17 +1039,80 @@ def _nodes(r: Router) -> None:
         return rows_to_dicts(library.db.query("SELECT * FROM location"))
 
 
-# -- auth. (api/auth.rs — OAuth device flow; offline stubs) ----------------
+# -- auth. (api/auth.rs — the RFC 8628 device flow state machine) ----------
 
 def _auth(r: Router) -> None:
+    from .. import auth as auth_mod
+
     @r.query("auth.me")
     def auth_me(node, _input):
-        raise RpcError("UNAUTHORIZED", "not logged in (offline build)")
+        # api/auth.rs:148-174: stored token → issuer lookup → {id,email}
+        token = auth_mod.stored_token(node)
+        if token is None:
+            raise RpcError("UNAUTHORIZED", "No auth token")
+        user = auth_mod.issuer_for(node).me(token.to_header())
+        if user is None:
+            raise RpcError("UNAUTHORIZED", "token no longer valid")
+        return {"id": user["id"], "email": user["email"]}
+
+    @r.mutation("auth.logout", invalidates=["auth.me"])
+    def auth_logout(node, _input):
+        # api/auth.rs:133-147: clear the persisted token
+        token = auth_mod.stored_token(node)
+        if token is not None:
+            auth_mod.issuer_for(node).revoke(token.access_token)
+        auth_mod.store_token(node, None)
+        return None
 
     @r.subscription("auth.loginSession")
     def auth_login(node, _input, emit):
-        emit({"state": "Error", "message": "auth unavailable offline"})
-        return lambda: None
+        """api/auth.rs:36-131: Start{user_code, urls} → poll the
+        device-code grant → persist token → Complete; pending keeps
+        polling, denial/expiry → Error. `poll_interval` input shortens
+        the reference's 5 s loop for tests/offline issuers."""
+        issuer = auth_mod.issuer_for(node)
+        client_id = node.config.id.hex()
+        interval = 5.0
+        if isinstance(_input, dict) and _input.get("poll_interval"):
+            interval = float(_input["poll_interval"])
+        try:
+            dev = issuer.device_code(client_id)
+        except Exception:
+            emit({"state": "Error"})
+            return lambda: None
+        emit({"state": "Start",
+              "user_code": dev["user_code"],
+              "verification_url": dev["verification_url"],
+              "verification_url_complete": dev["verification_uri_complete"]})
+
+        async def poll():
+            try:
+                while True:
+                    await asyncio.sleep(interval)
+                    status, body = issuer.access_token(
+                        auth_mod.DEVICE_CODE_URN, dev["device_code"],
+                        client_id)
+                    if status == 200:
+                        auth_mod.store_token(
+                            node, auth_mod.OAuthToken.from_raw(body))
+                        node.events.invalidate_query(None, "auth.me")
+                        emit({"state": "Complete"})
+                        return
+                    if body.get("error") == "authorization_pending":
+                        continue
+                    emit({"state": "Error"})
+                    return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # An HTTP-adapter issuer can raise (network) or return
+                # a malformed token body — the subscriber must get a
+                # terminal Error, never a silent hang (api/auth.rs
+                # breaks Response::Error on every failure arm).
+                emit({"state": "Error"})
+
+        task = asyncio.get_running_loop().create_task(poll())
+        return task.cancel
 
 
 # -- backups. (api/backups.rs) ---------------------------------------------

@@ -41,6 +41,7 @@ class ApiServer:
         self.router = router or mount_router(node)
         self.app = web.Application()
         self.app.router.add_get("/", self._index)
+        self.app.router.add_get("/static/{name}", self._static)
         self.app.router.add_get("/manifest.webmanifest", self._manifest)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/rspc", self._rspc_ws)
@@ -75,10 +76,27 @@ class ApiServer:
         return web.Response(text="OK")
 
     async def _index(self, _request: web.Request) -> web.Response:
-        """Embedded web explorer (apps/web equivalent, webui.py)."""
-        from .webui import INDEX_HTML
+        """Web explorer entry (apps/web equivalent; assets from
+        api/static, the reference's embedded-dist pattern,
+        apps/server/src/main.rs:60-63)."""
+        from .webui import index_html
 
-        return web.Response(text=INDEX_HTML, content_type="text/html")
+        return web.Response(text=index_html(), content_type="text/html")
+
+    async def _static(self, request: web.Request) -> web.Response:
+        """Serve the explorer's static assets (no path traversal: the
+        name must resolve inside STATIC_DIR)."""
+        from .webui import STATIC_DIR
+
+        name = request.match_info["name"]
+        path = os.path.realpath(os.path.join(STATIC_DIR, name))
+        if not path.startswith(os.path.realpath(STATIC_DIR) + os.sep) \
+                or not os.path.isfile(path):
+            raise web.HTTPNotFound()
+        ctype = (mimetypes.guess_type(path)[0]
+                 or "application/octet-stream")
+        with open(path, "rb") as f:
+            return web.Response(body=f.read(), content_type=ctype)
 
     async def _manifest(self, _request: web.Request) -> web.Response:
         """PWA manifest: with the reconnecting websocket client this

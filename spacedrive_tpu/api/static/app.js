@@ -1,0 +1,1149 @@
+let reqId = 0, pending = {}, subs = {}, subSpecs = [];
+const wsProto = location.protocol === "https:" ? "wss" : "ws";
+let ws = null, reconnectDelay = 500;
+// wsReady always has a live resolver: awaiting rpc() calls parked
+// during a reconnect wake on the SAME promise the next onopen resolves.
+let wsReadyResolve = null;
+let wsReady = new Promise(r => wsReadyResolve = r);
+
+function connect() {
+  ws = new WebSocket(`${wsProto}://${location.host}/rspc`);
+  ws.onopen = () => {
+    reconnectDelay = 500;
+    // standing subscriptions survive reconnects (the standalone-client
+    // contract: the UI must keep working across server restarts)
+    for (const s of subSpecs) {
+      const id = ++reqId; subs[id] = s.cb;
+      ws.send(JSON.stringify({id, type: "subscription",
+                              path: s.path, input: s.input}));
+    }
+    wsReadyResolve();
+  };
+  ws.onmessage = (m) => {
+    const f = JSON.parse(m.data);
+    if (f.type === "response" && pending[f.id]) {
+      pending[f.id].resolve(f.result); delete pending[f.id];
+    } else if (f.type === "error" && pending[f.id]) {
+      pending[f.id].reject(new Error(f.message)); delete pending[f.id];
+    } else if (f.type === "event" && subs[f.id]) {
+      subs[f.id](f.data);
+    }
+  };
+  ws.onclose = () => {
+    for (const id in pending) {
+      pending[id].reject(new Error("connection lost")); delete pending[id];
+    }
+    subs = {};
+    // Park wsReady on a fresh promise NOW (resolver saved for the next
+    // onopen): rpc() calls made during the backoff window suspend here
+    // instead of sending into the closed socket.
+    wsReady = new Promise(r => wsReadyResolve = r);
+    toast(`reconnecting in ${Math.round(reconnectDelay / 1000)}s…`);
+    setTimeout(connect, reconnectDelay);
+    reconnectDelay = Math.min(reconnectDelay * 2, 15000);
+  };
+}
+connect();
+async function rpc(type, path, input) {
+  await wsReady;
+  const id = ++reqId;
+  ws.send(JSON.stringify({id, type, path, input}));
+  return new Promise((resolve, reject) => pending[id] = {resolve, reject});
+}
+const q = (p, i) => rpc("query", p, i);
+const mut = (p, i) => rpc("mutation", p, i);
+function sub(path, input, cb) {
+  subSpecs.push({path, input, cb});
+  if (ws && ws.readyState === 1) {  // otherwise onopen replays subSpecs
+    const id = ++reqId;
+    subs[id] = cb;
+    ws.send(JSON.stringify({id, type: "subscription", path, input}));
+  }
+}
+function toast(msg) {
+  const t = document.getElementById("toast");
+  t.textContent = msg; t.style.display = "block";
+  clearTimeout(t._h); t._h = setTimeout(() => t.style.display = "none", 3000);
+}
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+const fmtBytes = (n) => {
+  n = Number(n) || 0;
+  for (const u of ["B","KiB","MiB","GiB","TiB"]) {
+    if (n < 1024 || u === "TiB") return n.toFixed(u==="B"?0:1)+" "+u;
+    n /= 1024;
+  }
+};
+
+let lib = null, loc = null, curPath = "/", view = "explorer";
+let selected = null, tagFilter = null, favOnly = false, allTags = [];
+let viewMode = "grid";         // grid | list | media (explorer modes)
+let sortKey = null, sortDir = 1;  // list-view column sort
+let selection = new Set();     // multi-select: file_path ids
+let lastRows = [];             // rows rendered by the last browse()
+let lastClickId = null;        // shift-range anchor
+let clipboard = null;          // {op: "copy"|"cut", ids, locId}
+let settingsLoc = null;        // location id open in per-location settings
+
+const TABS = [["explorer","Explorer"],["browse","Browse"],
+              ["dups","Duplicates"],
+              ["neardups","Near-dups"],["jobs","Jobs"],["p2p","P2P"],
+              ["settings","Settings"]];
+function renderTabs() {
+  const el = document.getElementById("tabs"); el.innerHTML = "";
+  for (const [id, label] of TABS) {
+    const d = document.createElement("div");
+    d.className = "tab" + (view === id ? " sel" : "");
+    d.textContent = label;
+    d.onclick = () => { view = id; renderTabs(); render(); };
+    el.appendChild(d);
+  }
+}
+
+// ---- Onboarding (create library → add location, the reference's
+// interface/app/onboarding flow) ---------------------------------------
+function showOnboarding() {
+  if (document.getElementById("onboard")) return;
+  const o = document.createElement("div");
+  o.id = "onboard";
+  o.innerHTML = `<div class="card">
+    <h1>Welcome to spacedrive-tpu</h1>
+    <p class="muted">A library is your private database of every file
+      it indexes. Create one, then point it at a folder.</p>
+    <h3>1 · Create your library</h3>
+    <p><input id="oblib" placeholder="library name" value="My Library"
+              style="width:100%"/></p>
+    <h3>2 · Add a first location</h3>
+    <p><input id="obloc" placeholder="/path/to/files (optional)"
+              style="width:100%"/></p>
+    <p style="text-align:right"><button id="obgo">Create</button></p>
+    <div id="oberr" class="muted"></div>
+  </div>`;
+  document.body.appendChild(o);
+  document.getElementById("obgo").onclick = async () => {
+    const name = document.getElementById("oblib").value.trim();
+    if (!name) return;
+    try {
+      const l = await mut("library.create", {name});
+      lib = l.uuid;
+      const path = document.getElementById("obloc").value.trim();
+      if (path) {
+        loc = await mut("locations.create", {library_id: lib, path});
+        toast("indexing started");
+      }
+      o.remove(); loadAll();
+    } catch (err) {
+      document.getElementById("oberr").textContent = String(err);
+    }
+  };
+}
+
+async function loadLibs() {
+  const libs = await q("library.list");
+  if (!libs.length) showOnboarding();
+  const el = document.getElementById("libs"); el.innerHTML = "";
+  for (const l of libs) {
+    const d = document.createElement("div");
+    d.className = "item" + (lib === l.uuid ? " sel" : "");
+    d.textContent = l.config ? l.config.name : l.name;
+    d.onclick = () => { lib = l.uuid; loadAll(); };
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      if (confirm(`delete library "${d.textContent}"?`)) {
+        await mut("library.delete", {id: l.uuid});
+        if (lib === l.uuid) lib = null;
+        loadLibs();
+      }
+    };
+    el.appendChild(d);
+  }
+  if (!lib && libs.length) { lib = libs[0].uuid; loadAll(); }
+}
+function loadAll() { loadLibs(); loadLocs(); loadTags(); loadStats(); render(); }
+
+async function loadLocs() {
+  if (!lib) return;
+  const locs = await q("locations.list", {library_id: lib});
+  const el = document.getElementById("locs"); el.innerHTML = "";
+  for (const l of locs) {
+    const d = document.createElement("div");
+    d.className = "item" + (loc === l.id ? " sel" : "");
+    d.textContent = l.name || l.path;
+    const gear = document.createElement("span");
+    gear.className = "gear"; gear.textContent = "⚙";
+    gear.title = "location settings";
+    gear.onclick = (e) => {
+      e.stopPropagation();
+      settingsLoc = l.id; view = "locsettings"; renderTabs(); render();
+    };
+    d.prepend(gear);
+    d.title = "click: open · right-click: rescan · shift-click: delete";
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      await mut("locations.fullRescan", {library_id: lib, location_id: l.id});
+      toast("rescan started");
+    };
+    d.onclick = async (e) => {
+      if (e.shiftKey) {
+        if (confirm(`remove location ${d.textContent}?`)) {
+          await mut("locations.delete", {library_id: lib, id: l.id});
+          if (loc === l.id) loc = null;
+          loadLocs();
+        }
+        return;
+      }
+      loc = l.id; curPath = "/"; view = "explorer";
+      renderTabs(); render(); loadLocs();
+    };
+    el.appendChild(d);
+  }
+}
+
+async function loadTags() {
+  if (!lib) return;
+  allTags = await q("tags.list", {library_id: lib});
+  const el = document.getElementById("tags"); el.innerHTML = "";
+  for (const t of allTags) {
+    const d = document.createElement("span");
+    d.className = "tagchip" + (tagFilter === t.id ? " on" : "");
+    d.textContent = t.name;
+    if (t.color) d.style.borderLeft = `4px solid ${esc(t.color)}`;
+    d.onclick = () => {
+      tagFilter = tagFilter === t.id ? null : t.id; loadTags(); render();
+    };
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      if (confirm(`delete tag "${t.name}"?`)) {
+        await mut("tags.delete", {library_id: lib, id: t.id});
+        if (tagFilter === t.id) tagFilter = null;
+        loadTags();
+      }
+    };
+    el.appendChild(d);
+  }
+}
+
+async function loadStats() {
+  if (!lib) return;
+  const s = await q("library.statistics", {library_id: lib});
+  document.getElementById("stats").innerHTML =
+    `<div class="kv">paths: <b>${s.total_paths ?? s.file_paths ?? "?"}</b></div>` +
+    `<div class="kv">objects: <b>${s.total_objects ?? s.objects ?? "?"}</b></div>` +
+    `<div class="kv">bytes: <b>${fmtBytes(s.total_bytes_used ?? s.total_bytes ?? 0)}</b></div>`;
+}
+
+function render() {
+  document.getElementById("inspector").style.display = "none";
+  hideCtx();
+  ({explorer: browse, browse: renderEphemeral, dups: renderDups,
+    neardups: renderNearDups,
+    jobs: renderJobs, p2p: renderP2P, settings: renderSettings,
+    locsettings: renderLocSettings}[view])();
+}
+
+// ---- Ephemeral browsing (non-indexed paths, non_indexed.rs) ----------
+let ephPath = "/";
+async function renderEphemeral() {
+  const main = document.getElementById("main");
+  main.innerHTML = `
+    <h1>Browse (not indexed)</h1>
+    <p><input id="ephpath" value="${esc(ephPath)}" style="width:60%"/>
+       <button id="ephgo">go</button>
+       <span class="muted">any directory on this node — nothing is
+       written to the library</span></p>
+    <div id="grid"></div>`;
+  const go = async () => {
+    ephPath = document.getElementById("ephpath").value.trim() || "/";
+    let entries;
+    try {
+      entries = await q("search.ephemeralPaths",
+                        {path: ephPath, with_thumbnails: true});
+    } catch (e) { toast(String(e)); return; }
+    const grid = document.getElementById("grid");
+    grid.innerHTML = "";
+    if (ephPath !== "/") {
+      grid.appendChild(cell({name: "..", is_dir: 1}, () => {
+        ephPath = ephPath.replace(/\/[^/]+\/?$/, "") || "/";
+        document.getElementById("ephpath").value = ephPath;
+        go();
+      }));
+    }
+    for (const e of entries) {
+      const r = {name: e.name, extension: e.extension,
+                 is_dir: e.is_dir, cas_id: e.cas_id, id: -1};
+      grid.appendChild(cell(r, () => {
+        if (e.is_dir) {
+          ephPath = e.path;
+          document.getElementById("ephpath").value = ephPath;
+          go();
+        }
+      }));
+    }
+  };
+  document.getElementById("ephgo").onclick = go;
+  document.getElementById("ephpath").onkeydown =
+    (e) => { if (e.key === "Enter") go(); };
+  go();
+}
+
+// ---- Explorer --------------------------------------------------------
+async function browse() {
+  const main = document.getElementById("main");
+  if (!lib || loc == null) { main.innerHTML =
+    "<div class='muted'>create a library and add a location</div>"; return; }
+  const searchText = document.getElementById("search").value.trim();
+  const filter = {location_id: loc};
+  if (searchText) filter.search = searchText;
+  else filter.materialized_path = curPath;
+  if (tagFilter != null) filter.tags = [tagFilter];
+  const [rows, count] = await Promise.all([
+    q("search.paths", {library_id: lib, take: 400, filter}),
+    q("search.pathsCount", {library_id: lib, filter}),
+  ]);
+  main.innerHTML =
+    `<div class="muted" style="margin-bottom:10px">location ${loc} · ` +
+    `${searchText ? `search "${esc(searchText)}"` : esc(curPath)} · ` +
+    `${count} paths</div><div id="grid"></div>`;
+  const grid = document.getElementById("grid");
+  if (!searchText && curPath !== "/") {
+    grid.appendChild(cell({name: "..", is_dir: 1}, () => {
+      curPath = curPath.replace(/[^/]+\/$/, ""); browse();
+    }));
+  }
+  let items = rows.items || rows;
+  if (favOnly) {
+    const favs = await q("search.objects",
+      {library_id: lib, take: 500, filter: {favorite: true}});
+    const favIds = new Set((favs.items || []).map(o => o.id));
+    items = items.filter(r => favIds.has(r.object_id));
+  }
+  if (viewMode === "media") {
+    const mediaExt = new Set(["png","jpg","jpeg","gif","webp","bmp","tiff",
+      "tif","heic","heif","avif","svg","svgz","pdf","avi","mp4","mkv",
+      "mov","webm"]);
+    items = items.filter(r => !r.is_dir
+      && mediaExt.has((r.extension || "").toLowerCase()));
+    grid.className = "media";
+  } else grid.className = "";
+  lastRows = sortItems(items);
+  if (viewMode === "list") {
+    main.removeChild(grid);
+    main.appendChild(buildListTable(!searchText && curPath !== "/"));
+  } else {
+    items = lastRows;
+    for (const r of items) grid.appendChild(cell(r, null));
+  }
+}
+
+function sortItems(items) {
+  if (viewMode !== "list" || !sortKey) return items;
+  const keyf = {name: r => (r.name || "").toLowerCase(),
+                kind: r => r.is_dir ? "" : (r.extension || ""),
+                size: r => r.size_in_bytes || 0,
+                modified: r => r.date_modified || 0}[sortKey];
+  return [...items].sort((a, b) => {
+    const ka = keyf(a), kb = keyf(b);
+    return (ka < kb ? -1 : ka > kb ? 1 : 0) * sortDir;
+  });
+}
+
+function buildListTable(showUp) {
+  // Header clicks re-sort lastRows CLIENT-SIDE and swap the table in
+  // place — no refetch (same repaint-in-place rule as selection).
+  const tbl = document.createElement("table");
+  const hdr = document.createElement("tr");
+  hdr.innerHTML = "<th></th>";
+  for (const k of ["name", "kind", "size", "modified"]) {
+    const th = document.createElement("th");
+    th.style.cursor = "pointer";
+    th.textContent = k + (sortKey === k
+      ? (sortDir > 0 ? " ↑" : " ↓") : "");
+    th.onclick = () => {
+      sortDir = sortKey === k ? -sortDir : 1;
+      sortKey = k;
+      lastRows = sortItems(lastRows);
+      tbl.replaceWith(buildListTable(showUp));
+    };
+    hdr.appendChild(th);
+  }
+  tbl.appendChild(hdr);
+  if (showUp) {
+    const up = document.createElement("tr");
+    up.className = "row";
+    up.innerHTML = "<td>📁</td><td>..</td><td></td><td></td><td></td>";
+    up.onclick = () => { curPath = curPath.replace(/[^/]+\/$/, "");
+                         browse(); };
+    tbl.appendChild(up);
+  }
+  for (const r of lastRows) tbl.appendChild(listRow(r));
+  return tbl;
+}
+
+function openEntry(r) {
+  if (r.is_dir) {
+    curPath = r.materialized_path + r.name + "/";
+    document.getElementById("search").value = ""; clearSel(); browse();
+  } else inspect(r);
+}
+
+// ---- multi-select + context menu -------------------------------------
+function clearSel() { selection.clear(); lastClickId = null; }
+function updateSelClasses() {
+  // selection changes repaint in place — no refetch, no DOM rebuild
+  document.querySelectorAll("[data-fpid]").forEach(el =>
+    el.classList.toggle("sel", selection.has(+el.dataset.fpid)));
+}
+function entryClick(r, e) {
+  if (e.shiftKey && lastClickId != null) {
+    const ids = lastRows.map(x => x.id);
+    const a = ids.indexOf(lastClickId), b = ids.indexOf(r.id);
+    if (a >= 0 && b >= 0) {
+      for (let k = Math.min(a, b); k <= Math.max(a, b); k++)
+        selection.add(ids[k]);
+    }
+    updateSelClasses();
+  } else if (e.ctrlKey || e.metaKey) {
+    selection.has(r.id) ? selection.delete(r.id) : selection.add(r.id);
+    lastClickId = r.id;
+    updateSelClasses();
+  } else {
+    selection.clear(); selection.add(r.id); lastClickId = r.id;
+    updateSelClasses();
+    openEntry(r);
+  }
+}
+function selRows() {
+  const rows = lastRows.filter(r => selection.has(r.id) && !r.is_dir);
+  return rows.length ? rows : [];
+}
+function hideCtx() {
+  const m = document.getElementById("ctxmenu");
+  if (m) m.style.display = "none";
+}
+document.addEventListener("click", hideCtx);
+document.addEventListener("keydown", (e) => {
+  if (e.key === "Escape") { clearSel(); hideCtx(); updateSelClasses(); }
+});
+function showCtx(r, e) {
+  e.preventDefault();
+  if (!selection.has(r.id)) {
+    selection.clear(); selection.add(r.id); lastClickId = r.id;
+    updateSelClasses();
+  }
+  const m = document.getElementById("ctxmenu");
+  const rows = selRows();
+  const n = rows.length;
+  // Directory-only selection: file operations have nothing to act on,
+  // so offer navigation alone instead of "(0)" no-op actions.
+  const items = n === 0 ? [["Open", () => openEntry(r)]] : [
+    ["Open / inspect", () => openEntry(r)],
+    ["sep"],
+    [`Copy (${n})`, () => { clipboard = {op: "copy",
+       ids: rows.map(x => x.id), locId: loc}; pasteBtn(); }],
+    [`Cut (${n})`, () => { clipboard = {op: "cut",
+       ids: rows.map(x => x.id), locId: loc}; pasteBtn(); }],
+    [`Duplicate (${n})`, async () => {
+       await mut("files.duplicateFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id)});
+       toast("duplicating…"); }],
+    ["sep"],
+    [`★ Favorite (${n})`, async () => {
+       for (const x of rows) if (x.object_id != null)
+         await mut("files.setFavorite",
+                   {library_id: lib, id: x.object_id, favorite: true});
+       toast("favorited"); }],
+    [`Tag… (${n})`, async () => {
+       const nm = prompt("tag name" + (allTags.length
+         ? ` (existing: ${allTags.map(t => t.name).join(", ")})` : ""));
+       if (!nm) return;
+       let t = allTags.find(x => x.name === nm);
+       if (!t) t = await mut("tags.create",
+                             {library_id: lib, name: nm, color: null});
+       for (const x of rows) if (x.object_id != null)
+         await mut("tags.assign", {library_id: lib, tag_id: t.id,
+                                   object_id: x.object_id});
+       toast(`tagged ${n}`); loadTags(); }],
+    [`Validate (${n})`, async () => {
+       await mut("jobs.objectValidator",
+                 {library_id: lib, id: loc, mode: "fill"});
+       toast("validator started"); }],
+    ["sep"],
+    [`Delete (${n})`, async () => {
+       if (!confirm(`delete ${n} file(s)?`)) return;
+       await mut("files.deleteFiles", {library_id: lib, location_id: loc,
+         file_path_ids: rows.map(x => x.id)});
+       toast("deleting…"); clearSel();
+       setTimeout(browse, 400); }],
+    [`Erase securely (${n})`, async () => {
+       if (!confirm(`overwrite + delete ${n} file(s)? irreversible`))
+         return;
+       await mut("files.eraseFiles", {library_id: lib, location_id: loc,
+         file_path_ids: rows.map(x => x.id), passes: 1});
+       toast("erasing…"); clearSel();
+       setTimeout(browse, 600); }],
+    ["sep"],
+    [`Encrypt… (${n})`, async () => {
+       const pw = prompt("encryption password"); if (!pw) return;
+       await mut("files.encryptFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id),
+         password: pw});
+       toast("encrypting…"); setTimeout(browse, 600); }],
+    [`Decrypt… (${n})`, async () => {
+       const pw = prompt("decryption password"); if (!pw) return;
+       await mut("files.decryptFiles", {library_id: lib,
+         location_id: loc, file_path_ids: rows.map(x => x.id),
+         password: pw});
+       toast("decrypting…"); setTimeout(browse, 600); }],
+  ];
+  m.innerHTML = "";
+  for (const [label, fn] of items) {
+    if (label === "sep") {
+      const s = document.createElement("div"); s.className = "sep";
+      m.appendChild(s); continue;
+    }
+    const d = document.createElement("div");
+    d.className = "mi"; d.textContent = label;
+    d.onclick = (ev) => { ev.stopPropagation(); hideCtx(); fn(); };
+    m.appendChild(d);
+  }
+  m.style.left = Math.min(e.clientX, innerWidth - 180) + "px";
+  m.style.top = Math.min(e.clientY, innerHeight - items.length * 28) + "px";
+  m.style.display = "block";
+}
+function pasteBtn() {
+  const b = document.getElementById("pastebtn");
+  b.style.display = clipboard ? "" : "none";
+  if (clipboard) b.textContent =
+    `paste ${clipboard.ids.length} (${clipboard.op})`;
+}
+async function doPaste() {
+  if (!clipboard || loc == null) return;
+  const rel = curPath === "/" ? "" : curPath.slice(1);
+  const input = {library_id: lib, source_location_id: clipboard.locId,
+    sources_file_path_ids: clipboard.ids, target_location_id: loc,
+    target_location_relative_directory_path: rel};
+  await mut(clipboard.op === "cut" ? "files.cutFiles" : "files.copyFiles",
+            input);
+  toast(clipboard.op === "cut" ? "moving…" : "copying…");
+  if (clipboard.op === "cut") clipboard = null;
+  pasteBtn();
+  setTimeout(browse, 500);
+}
+
+// ---- drag & drop: drag files onto a folder to move them --------------
+function wireDnD(el, r) {
+  if (!r.is_dir) {
+    el.draggable = true;
+    el.ondragstart = (e) => {
+      if (!selection.has(r.id)) {
+        selection.clear(); selection.add(r.id); updateSelClasses();
+      }
+      e.dataTransfer.setData("text/sdtpu-ids",
+        JSON.stringify(selRows().map(x => x.id)));
+      e.dataTransfer.effectAllowed = "move";
+    };
+  } else {
+    el.ondragover = (e) => { e.preventDefault(); el.style.outline =
+      "2px dashed #3b82f6"; };
+    el.ondragleave = () => { el.style.outline = ""; };
+    el.ondrop = async (e) => {
+      e.preventDefault(); el.style.outline = "";
+      let ids;
+      try { ids = JSON.parse(e.dataTransfer.getData("text/sdtpu-ids")); }
+      catch { return; }
+      if (!ids || !ids.length) return;
+      const rel = (r.materialized_path + r.name + "/").replace(/^\//, "");
+      await mut("files.cutFiles", {library_id: lib,
+        source_location_id: loc, sources_file_path_ids: ids,
+        target_location_id: loc,
+        target_location_relative_directory_path: rel});
+      toast(`moving ${ids.length} into ${r.name}/`);
+      clearSel();
+      setTimeout(browse, 500);
+    };
+  }
+}
+
+function listRow(r) {
+  const tr = document.createElement("tr");
+  tr.className = "row" + (selection.has(r.id) ? " sel" : "");
+  const kindName = r.is_dir ? "folder" : (r.extension || "file");
+  const size = r.is_dir ? "" : fmtBytes(r.size_in_bytes || 0);
+  const dm = r.date_modified
+    ? new Date(r.date_modified * 1000).toISOString().slice(0, 16)
+        .replace("T", " ") : "";
+  tr.dataset.fpid = r.id;
+  tr.innerHTML = `<td>${r.is_dir ? "📁" : "🗎"}</td>` +
+    `<td>${esc(r.name)}${r.extension ? "." + esc(r.extension) : ""}</td>` +
+    `<td>${esc(kindName)}</td><td>${size}</td><td>${dm}</td>`;
+  tr.onclick = (e) => entryClick(r, e);
+  tr.ondblclick = () => openEntry(r);
+  tr.oncontextmenu = (e) => showCtx(r, e);
+  wireDnD(tr, r);
+  return tr;
+}
+function cell(r, onclick) {
+  const c = document.createElement("div"); c.className = "cell";
+  if (!onclick) c.dataset.fpid = r.id;
+  if (selection.has(r.id) || (selected && selected.id === r.id))
+    c.className += " sel";
+  const t = document.createElement("div"); t.className = "thumb";
+  if (r.cas_id) {
+    const img = document.createElement("img");
+    img.src = `/spacedrive/thumbnail/${r.cas_id}.webp`;
+    img.onerror = () => { img.remove(); t.textContent = "🗎"; };
+    t.appendChild(img);
+  } else t.textContent = r.is_dir ? "📁" : "🗎";
+  const n = document.createElement("div"); n.className = "nm";
+  n.textContent = r.name + (r.extension ? "." + r.extension : "");
+  c.appendChild(t); c.appendChild(n);
+  if (onclick) c.onclick = onclick;       // the ".." up-cell
+  else {
+    c.onclick = (e) => entryClick(r, e);
+    c.ondblclick = () => openEntry(r);
+    c.oncontextmenu = (e) => showCtx(r, e);
+    wireDnD(c, r);
+  }
+  return c;
+}
+
+// ---- Per-location settings (indexer-rule editor, rescans) ------------
+const RULE_KINDS = [[0, "accept glob"], [1, "reject glob"],
+  [2, "accept if children"], [3, "reject if children"]];
+async function renderLocSettings() {
+  const main = document.getElementById("main");
+  if (!lib || settingsLoc == null) {
+    main.innerHTML = "<div class='muted'>no location selected</div>"; return;
+  }
+  const [l, allRules] = await Promise.all([
+    q("locations.getWithRules",
+      {library_id: lib, location_id: settingsLoc}),
+    q("locations.indexer_rules.list", {library_id: lib}),
+  ]);
+  if (!l) { main.innerHTML = "<div class='muted'>gone</div>"; return; }
+  const attached = new Set((l.indexer_rules || []).map(r => r.id));
+  main.innerHTML = `
+    <h1>Location settings — ${esc(l.name || l.path)}</h1>
+    <div class="kv">path: <b>${esc(l.path)}</b></div>
+    <div class="kv">id: <b>${l.id}</b> · hidden: <b>${l.hidden ? "yes"
+      : "no"}</b></div>
+    <p>
+      <input id="lsname" value="${esc(l.name || "")}"
+             placeholder="display name"/>
+      <button id="lsrename">rename</button>
+      <button id="lshide" class="ghost">${l.hidden ? "unhide" : "hide"}
+      </button>
+    </p>
+    <p>
+      <button id="lsfull">full rescan</button>
+      <button id="lsquick" class="ghost">quick rescan</button>
+      <button id="lsdelete" class="danger">remove location</button>
+    </p>
+    <h2>Indexer rules</h2>
+    <div class="muted">checked rules apply when this location is
+      indexed</div>
+    <div id="lsrules"></div>
+    <h3>New rule</h3>
+    <p>
+      <input id="nrname" placeholder="rule name" style="width:130px"/>
+      <select id="nrkind">${RULE_KINDS.map(([v, t]) =>
+        `<option value="${v}">${t}</option>`).join("")}</select>
+      <input id="nrglob" placeholder="glob, e.g. **/*.tmp"
+             style="width:160px"/>
+      <button id="nradd">add rule</button>
+    </p>`;
+  const rulesEl = document.getElementById("lsrules");
+  for (const r of allRules) {
+    const d = document.createElement("div"); d.className = "kv";
+    const cb = document.createElement("input");
+    cb.type = "checkbox"; cb.checked = attached.has(r.id);
+    cb.onchange = async () => {
+      const ids = new Set(attached);
+      cb.checked ? ids.add(r.id) : ids.delete(r.id);
+      await mut("locations.update", {library_id: lib, id: l.id,
+        indexer_rules_ids: [...ids]});
+      renderLocSettings();
+    };
+    d.appendChild(cb);
+    d.append(` ${r.name} `);
+    if (r.default_rule) {
+      const s = document.createElement("span");
+      s.className = "muted"; s.textContent = "(system)";
+      d.appendChild(s);
+    } else {
+      const del = document.createElement("button");
+      del.className = "danger"; del.textContent = "×";
+      del.onclick = async () => {
+        await mut("locations.indexer_rules.delete",
+                  {library_id: lib, id: r.id});
+        renderLocSettings();
+      };
+      d.appendChild(del);
+    }
+    rulesEl.appendChild(d);
+  }
+  document.getElementById("lsrename").onclick = async () => {
+    await mut("locations.update", {library_id: lib, id: l.id,
+      name: document.getElementById("lsname").value});
+    loadLocs(); renderLocSettings();
+  };
+  document.getElementById("lshide").onclick = async () => {
+    await mut("locations.update", {library_id: lib, id: l.id,
+      hidden: l.hidden ? 0 : 1});
+    renderLocSettings();
+  };
+  document.getElementById("lsfull").onclick = async () => {
+    await mut("locations.fullRescan",
+              {library_id: lib, location_id: l.id});
+    toast("full rescan started");
+  };
+  document.getElementById("lsquick").onclick = async () => {
+    await mut("locations.quickRescan",
+              {library_id: lib, location_id: l.id, sub_path: "/"});
+    toast("quick rescan started");
+  };
+  document.getElementById("lsdelete").onclick = async () => {
+    if (!confirm("remove this location from the library?")) return;
+    await mut("locations.delete", {library_id: lib, id: l.id});
+    if (loc === l.id) loc = null;
+    settingsLoc = null; view = "explorer"; renderTabs();
+    loadLocs(); render();
+  };
+  document.getElementById("nradd").onclick = async () => {
+    const name = document.getElementById("nrname").value.trim();
+    const glob = document.getElementById("nrglob").value.trim();
+    const kind = parseInt(document.getElementById("nrkind").value);
+    if (!name || !glob) { toast("name + glob required"); return; }
+    await mut("locations.indexer_rules.create", {library_id: lib,
+      name, rules: [[kind, [glob]]]});
+    renderLocSettings();
+  };
+}
+
+// ---- Inspector (file detail panel) -----------------------------------
+async function inspect(r) {
+  selected = r;
+  const el = document.getElementById("inspector");
+  el.style.display = "block";
+  const name = r.name + (r.extension ? "." + r.extension : "");
+  const size = r.size_in_bytes_bytes ? parseInt(r.size_in_bytes_bytes, 16) ||
+               r.size_in_bytes : r.size_in_bytes;
+  let html = `<h3>${esc(name)}</h3>` +
+    `<div class="kv">size: <b>${fmtBytes(size)}</b></div>` +
+    `<div class="kv">cas_id: <b>${esc(r.cas_id || "—")}</b></div>` +
+    `<div class="kv">object: <b>${r.object_id ?? "—"}</b></div>` +
+    `<div class="kv">path: <b>${esc(r.materialized_path)}</b></div>`;
+  let obj = null;
+  if (r.object_id != null) {
+    obj = await q("files.get", {library_id: lib, id: r.object_id});
+    if (obj) {
+      html += `<div class="kv">kind: <b>${obj.kind}</b></div>` +
+        `<div class="kv">note: <b>${esc(obj.note || "—")}</b></div>`;
+    }
+  }
+  html += `<div id="itags"></div><div id="iexif"></div>
+    <div style="margin-top:8px">
+      <button id="ifav" class="ghost">${obj && obj.favorite ? "★" : "☆"} favorite</button>
+      <button id="irename" class="ghost">rename</button>
+      <button id="inote" class="ghost">note</button>
+      <button id="idup" class="ghost">duplicate</button>
+      <button id="idel" class="danger">delete</button>
+    </div>`;
+  el.innerHTML = html;
+  if (r.object_id != null) {
+    const mine = await q("tags.getForObject",
+      {library_id: lib, object_id: r.object_id});
+    const mineIds = new Set(mine.map(t => t.id));
+    const tl = document.getElementById("itags");
+    tl.innerHTML = "<h3>tags</h3>";
+    for (const t of allTags) {
+      const chip = document.createElement("span");
+      chip.className = "tagchip" + (mineIds.has(t.id) ? " on" : "");
+      chip.textContent = t.name;
+      chip.onclick = async () => {
+        await mut("tags.assign", {library_id: lib, tag_id: t.id,
+          object_id: r.object_id, unassign: mineIds.has(t.id)});
+        inspect(r);
+      };
+      tl.appendChild(chip);
+    }
+    const md = await q("files.getMediaData", {library_id: lib,
+                                              id: r.object_id});
+    if (md) {
+      if (md.stream_data) {
+        // audio/video container metadata rides as JSON
+        try { Object.assign(md, JSON.parse(md.stream_data)); } catch {}
+        delete md.stream_data;
+      }
+      const ex = document.getElementById("iexif");
+      ex.innerHTML = "<h3>media data</h3>" +
+        Object.entries(md).filter(([k, v]) => v != null && k !== "phash" &&
+                                  k !== "object_id" && k !== "id")
+          .map(([k, v]) => `<div class="kv">${esc(k)}: <b>${esc(v)}</b></div>`)
+          .join("");
+    }
+  }
+  document.getElementById("ifav").onclick = async () => {
+    if (r.object_id == null) return toast("not identified yet");
+    await mut("files.setFavorite", {library_id: lib, id: r.object_id,
+      favorite: !(obj && obj.favorite)});
+    inspect(r);
+  };
+  document.getElementById("irename").onclick = async () => {
+    const nn = prompt("new name", name); if (!nn || nn === name) return;
+    try {
+      await mut("files.renameFile", {library_id: lib, file_path_id: r.id,
+        new_name: nn});
+      toast("renamed"); browse();
+    } catch (e) { toast(e.message); }
+  };
+  document.getElementById("inote").onclick = async () => {
+    if (r.object_id == null) return toast("not identified yet");
+    const note = prompt("note", obj && obj.note || "");
+    if (note === null) return;
+    await mut("files.setNote", {library_id: lib, id: r.object_id, note});
+    inspect(r);
+  };
+  document.getElementById("idup").onclick = async () => {
+    await mut("files.duplicateFiles", {library_id: lib, location_id: loc,
+      file_path_ids: [r.id]});
+    toast("duplicating…");
+  };
+  document.getElementById("idel").onclick = async () => {
+    if (!confirm(`delete ${name}?`)) return;
+    await mut("files.deleteFiles", {library_id: lib, location_id: loc,
+      file_path_ids: [r.id]});
+    el.style.display = "none"; selected = null;
+  };
+}
+
+// ---- Duplicates ------------------------------------------------------
+async function renderDups() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const groups = await q("search.duplicates",
+    {library_id: lib, location_id: loc});
+  const total = groups.reduce((a, g) => a + (g.reclaimable_bytes || 0), 0);
+  main.innerHTML = `<h3>Exact duplicates (by CAS ID)</h3>
+    <div class="muted">${groups.length} groups · ` +
+    `${fmtBytes(total)} reclaimable</div>
+    <table><tr><th>cas_id</th><th>copies</th><th>total</th>
+    <th>paths</th></tr>` +
+    groups.map(g => `<tr><td>${esc(g.cas_id)}</td><td>${g.count}</td>
+      <td>${fmtBytes(g.total_bytes)}</td>
+      <td class="muted">${g.paths.map(esc).join("<br>")}</td></tr>`).join("")
+    + "</table>";
+}
+
+// ---- Near-duplicates (device-backed analytics) -----------------------
+async function renderNearDups() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const pairs = await q("search.nearDuplicates",
+    {library_id: lib, max_distance: 10});
+  main.innerHTML = `<h3>Near-duplicate images (pHash Hamming ≤ 10)</h3>
+    <div style="margin:6px 0">
+      <button id="rundet">run detector on location ${loc ?? "—"}</button>
+      <span class="muted">batched DCT pHash + tiled Hamming all-pairs on
+      the device; LSH bucketing past 100k images</span></div>
+    <table><tr><th>distance</th><th>a</th><th>b</th></tr>` +
+    pairs.map(p => `<tr><td>${p.distance}</td>
+      <td class="muted">${p.paths_a.map(esc).join("<br>")}</td>
+      <td class="muted">${p.paths_b.map(esc).join("<br>")}</td></tr>`)
+      .join("") + "</table>";
+  document.getElementById("rundet").onclick = async () => {
+    if (loc == null) return toast("select a location first");
+    await mut("jobs.nearDupDetector", {library_id: lib, id: loc});
+    toast("near-dup detector started");
+  };
+}
+
+// ---- Jobs console ----------------------------------------------------
+const JSTATUS = {0:"queued",1:"running",2:"completed",3:"cancelled",
+                 4:"failed",5:"paused",6:"completed+errors"};
+async function renderJobs() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const reports = await q("jobs.reports", {library_id: lib});
+  main.innerHTML = `<h3>Jobs</h3>
+    <div style="margin:6px 0">
+      <button id="jid">identify</button>
+      <button id="jval">validate</button>
+      <button id="jverify" class="ghost">verify (bit-rot)</button>
+      <button id="jthumb" class="ghost">thumbnails</button>
+      <button id="jclear" class="ghost">clear finished</button>
+    </div>
+    <table><tr><th>name</th><th>status</th><th>progress</th><th>created</th>
+    <th></th></tr>` +
+    reports.map(j => {
+      const pct = j.task_count ?
+        Math.round(100 * (j.completed_task_count || 0) / j.task_count) : 0;
+      const running = j.status === 1, paused = j.status === 5;
+      return `<tr><td>${esc(j.name)}</td><td>${JSTATUS[j.status] ?? j.status}</td>
+        <td>${pct}% (${j.completed_task_count || 0}/${j.task_count || 0})</td>
+        <td class="muted">${new Date((j.date_created||0)*1000)
+          .toLocaleTimeString()}</td>
+        <td>${running ? `<button class="ghost" onclick="jobCtl('pause','${j.id}')">⏸</button>` : ""}
+            ${paused ? `<button class="ghost" onclick="jobCtl('resume','${j.id}')">▶</button>` : ""}
+            ${(running || paused) ? `<button class="danger" onclick="jobCtl('cancel','${j.id}')">✕</button>` : ""}
+        </td></tr>`;
+    }).join("") + "</table>";
+  const need = () => loc == null ? (toast("select a location"), false) : true;
+  document.getElementById("jid").onclick = async () =>
+    need() && (await mut("jobs.identifyUniqueFiles", {library_id: lib, id: loc}),
+               renderJobs());
+  document.getElementById("jval").onclick = async () =>
+    need() && (await mut("jobs.objectValidator", {library_id: lib, id: loc}),
+               renderJobs());
+  document.getElementById("jverify").onclick = async () =>
+    need() && (await mut("jobs.objectValidator",
+                         {library_id: lib, id: loc, mode: "verify"}),
+               renderJobs());
+  document.getElementById("jthumb").onclick = async () =>
+    need() && (await mut("jobs.generateThumbsForLocation",
+                         {library_id: lib, id: loc}), renderJobs());
+  document.getElementById("jclear").onclick = async () => {
+    await mut("jobs.clearAll", {library_id: lib}); renderJobs();
+  };
+}
+window.jobCtl = async (op, id) => {
+  await mut("jobs." + op, {library_id: lib, id});
+  renderJobs();
+};
+
+// ---- P2P -------------------------------------------------------------
+async function renderP2P() {
+  const main = document.getElementById("main");
+  const st = await q("p2p.state");
+  if (!st.enabled) {
+    main.innerHTML = "<div class='muted'>p2p is not started</div>"; return;
+  }
+  main.innerHTML = `<h3>P2P</h3>
+    <div class="kv">identity: <b>${esc(st.identity.slice(0, 24))}…</b>
+      · port <b>${st.port}</b></div>
+    <h3>Peers</h3>
+    <table><tr><th>identity</th><th>addr</th><th></th></tr>` +
+    st.peers.map(p => {
+      // Beacon payloads are peer-controlled: port must never reach
+      // innerHTML/onclick as a string (stored-XSS vector).
+      const port = Number(p.port) || 0;
+      return `<tr>
+      <td class="muted">${esc(p.identity.slice(0, 24))}…</td>
+      <td>${esc(p.addr)}:${port}</td>
+      <td><button class="ghost" onclick="p2pPing('${esc(p.addr)}',${port})">ping</button>
+          <button class="ghost" onclick="p2pPair('${esc(p.addr)}',${port})">pair</button>
+          <button onclick="p2pDrop('${esc(p.addr)}',${port})">spacedrop</button>
+      </td></tr>`;}).join("") + `</table>
+    <div class="muted" style="margin-top:8px">spacedrop sends an absolute
+    file path from this node; pairing joins the current library.</div>`;
+}
+window.p2pPing = async (addr, port) => {
+  try { await mut("p2p.debugPing", {addr, port}); toast("pong"); }
+  catch (e) { toast(e.message); }
+};
+window.p2pPair = async (addr, port) => {
+  try {
+    await mut("p2p.pair", {library_id: lib, addr, port});
+    toast("paired");
+  } catch (e) { toast(e.message); }
+};
+window.p2pDrop = async (addr, port) => {
+  const file_path = prompt("absolute path of file to send");
+  if (!file_path) return;
+  try {
+    await mut("p2p.spacedrop", {addr, port, file_path});
+    toast("spacedrop sent");
+  } catch (e) { toast(e.message); }
+};
+
+// ---- Settings --------------------------------------------------------
+async function renderSettings() {
+  const main = document.getElementById("main");
+  if (!lib) return;
+  const [stats, cats, vols, keysSetup, backups, prefs] = await Promise.all([
+    q("library.statistics", {library_id: lib}),
+    q("categories.list", {library_id: lib}),
+    q("volumes.list"),
+    q("keys.isSetup", {library_id: lib}),
+    q("backups.getAll"),
+    q("preferences.get", {library_id: lib}),
+  ]);
+  const catRows = Object.entries(cats).filter(([, n]) => n > 0)
+    .map(([k, n]) => `<tr><td>${esc(k)}</td><td>${n}</td></tr>`).join("");
+  main.innerHTML = `<h3>Statistics</h3>` +
+    Object.entries(stats).map(([k, v]) =>
+      `<div class="kv">${esc(k)}: <b>${esc(v)}</b></div>`).join("") +
+    `<h3>Categories</h3><table>${catRows}</table>
+    <h3>Volumes</h3><table>` +
+    vols.map(v => `<tr><td>${esc(v.name || v.mount_point)}</td>
+      <td>${fmtBytes(v.available_capacity)} free of
+          ${fmtBytes(v.total_capacity)}</td></tr>`).join("") + `</table>
+    <h3>Key manager</h3><div id="keys"></div>
+    <h3>Backups</h3>
+    <div><button id="dobackup">backup library now</button></div>
+    <table>` + (backups.backups || backups).map(b =>
+      `<tr><td>${esc(b.id || b.path || JSON.stringify(b)).slice(0, 60)}</td>
+       <td class="muted">${esc(b.timestamp || b.date || "")}</td>
+       <td><button class="ghost brestore" data-bid="${esc(b.id)}">restore
+       </button><button class="danger bdelete" data-bid="${esc(b.id)}">×
+       </button></td></tr>`)
+      .join("") + `</table>
+    <h3>Preferences</h3>
+    <div class="kv">stored keys: <b>${Object.keys(prefs || {}).length}</b>
+      <button id="setpref" class="ghost">set pref</button></div>
+    <h3>Notifications</h3>
+    <button id="notifytest" class="ghost">send test notification</button>`;
+
+  const keysEl = document.getElementById("keys");
+  if (!keysSetup) {
+    keysEl.innerHTML = `<button id="ksetup">set up key manager</button>`;
+    document.getElementById("ksetup").onclick = async () => {
+      const pw = prompt("master password"); if (!pw) return;
+      await mut("keys.setup", {library_id: lib, password: pw});
+      renderSettings();
+    };
+  } else {
+    const unlocked = await q("keys.isUnlocked", {library_id: lib});
+    if (!unlocked) {
+      keysEl.innerHTML = `<button id="kunlock">unlock</button>`;
+      document.getElementById("kunlock").onclick = async () => {
+        const pw = prompt("master password"); if (!pw) return;
+        try {
+          await mut("keys.unlock", {library_id: lib, password: pw});
+          renderSettings();
+        } catch (e) { toast(e.message); }
+      };
+    } else {
+      const keys = await q("keys.list", {library_id: lib});
+      keysEl.innerHTML = keys.map(k =>
+        `<div class="kv">${esc(k.uuid || k.id)} ` +
+        `${k.mounted ? "(mounted)" : ""}</div>`).join("") +
+        `<button id="kadd" class="ghost">add key</button>
+         <button id="klock" class="ghost">lock</button>`;
+      document.getElementById("kadd").onclick = async () => {
+        const pw = prompt("new key password"); if (!pw) return;
+        await mut("keys.add", {library_id: lib, password: pw});
+        renderSettings();
+      };
+      document.getElementById("klock").onclick = async () => {
+        await mut("keys.lock", {library_id: lib}); renderSettings();
+      };
+    }
+  }
+  document.getElementById("dobackup").onclick = async () => {
+    await mut("backups.backup", {library_id: lib});
+    toast("backup written"); renderSettings();
+  };
+  document.querySelectorAll(".brestore").forEach(b => b.onclick =
+    async () => {
+      if (!confirm("restore this backup over the current library?"))
+        return;
+      await mut("backups.restore", {backup_id: b.dataset.bid});
+      toast("backup restored"); loadAll();
+    });
+  document.querySelectorAll(".bdelete").forEach(b => b.onclick =
+    async () => {
+      await mut("backups.delete", {backup_id: b.dataset.bid});
+      renderSettings();
+    });
+  document.getElementById("setpref").onclick = async () => {
+    const k = prompt("preference key"); if (!k) return;
+    const v = prompt("value");
+    await mut("preferences.update", {library_id: lib, values: {[k]: v}});
+    renderSettings();
+  };
+  document.getElementById("notifytest").onclick = () =>
+    mut("notifications.test");
+}
+
+// ---- chrome wiring ---------------------------------------------------
+document.getElementById("newlib").onclick = async () => {
+  const name = prompt("library name"); if (!name) return;
+  await mut("library.create", {name}); lib = null; loadLibs();
+};
+document.getElementById("newloc").onclick = async () => {
+  const path = prompt("absolute path to index"); if (!path || !lib) return;
+  await mut("locations.create", {library_id: lib, path});
+  loadLocs();
+};
+document.getElementById("newtag").onclick = async () => {
+  const name = prompt("tag name"); if (!name || !lib) return;
+  const color = prompt("color (css, optional)") || null;
+  await mut("tags.create", {library_id: lib, name, color});
+  loadTags();
+};
+document.getElementById("search").oninput = (() => {
+  let h; return () => { clearTimeout(h); h = setTimeout(() => {
+    if (view !== "explorer") { view = "explorer"; renderTabs(); }
+    browse();
+  }, 250); };
+})();
+document.getElementById("favbtn").onclick = () => {
+  favOnly = !favOnly;
+  document.getElementById("favbtn").className = favOnly ? "" : "ghost";
+  if (view === "explorer") browse();
+};
+function setViewMode(m) {
+  viewMode = m;
+  for (const [id, mm] of [["vgrid","grid"],["vlist","list"],
+                          ["vmedia","media"]])
+    document.getElementById(id).className =
+      "viewbtn" + (viewMode === mm ? " on" : "");
+  if (view === "explorer") browse();
+}
+document.getElementById("vgrid").onclick = () => setViewMode("grid");
+document.getElementById("vlist").onclick = () => setViewMode("list");
+document.getElementById("vmedia").onclick = () => setViewMode("media");
+document.getElementById("pastebtn").onclick = doPaste;
+document.getElementById("newfolder").onclick = async () => {
+  if (view !== "explorer") { toast("open the explorer first"); return; }
+  if (loc == null) { toast("select a location"); return; }
+  const name = prompt("folder name"); if (!name) return;
+  await mut("files.createFolder", {library_id: lib, location_id: loc,
+    sub_path: curPath, name});
+  setTimeout(() => { if (view === "explorer") browse(); }, 300);
+};
+setViewMode("grid");
+
+sub("jobs.progress", null, (e) => {
+  const el = document.getElementById("joblist");
+  let row = document.getElementById("job-" + e.id);
+  if (!row) {
+    row = document.createElement("div"); row.className = "job";
+    row.id = "job-" + e.id;
+    row.innerHTML = `<span></span><div class="bar"><div></div></div>`;
+    el.prepend(row);
+  }
+  row.querySelector("span").textContent =
+    `${e.name || "job"} — ${e.message || ""}`;
+  const pct = e.task_count ? (100 * (e.completed_task_count || 0) /
+                              e.task_count) : 0;
+  row.querySelector(".bar > div").style.width = pct + "%";
+  if (e.task_count && e.completed_task_count >= e.task_count)
+    setTimeout(() => row.remove(), 4000);
+});
+sub("invalidation.listen", null, (e) => {
+  if (e.key === "search.paths" && view === "explorer") browse();
+  if (e.key === "library.list") loadLibs();
+  if (e.key === "tags.list") loadTags();
+  if (e.key === "jobs.reports" && view === "jobs") renderJobs();
+});
+sub("notifications.listen", null, (e) => {
+  toast(`🔔 ${e.title || ""} ${e.content || e.message || ""}`);
+});
+sub("p2p.events", null, async (e) => {
+  if (e.type === "SpacedropRequest") {
+    // The peer-supplied name is untrusted: suggest only its basename,
+    // never a path ("../../etc/x" must not prefill the save prompt).
+    const safe = (e.name || "spacedrop.bin")
+      .split(/[\\/]/).pop().replace(/^\.+/, "") || "spacedrop.bin";
+    const ok = confirm(
+      `Spacedrop: accept "${safe}" (${e.size} bytes) from ${e.peer}?`);
+    // Cancelling/clearing the prompt falls back to the safe name in the
+    // current directory — an accepted drop is never silently rejected.
+    const path = ok ? (prompt("save as", safe) || safe) : null;
+    await mut("p2p.acceptSpacedrop", {id: e.id, path});
+  }
+});
+renderTabs();
+loadLibs();

@@ -183,8 +183,12 @@ def test_server_ws_and_custom_uri(env, tmp_path):
                 assert resp.status == 200
                 page = await resp.text()
                 assert "spacedrive-tpu" in page
-                # the page drives the same /rspc ws protocol
-                assert "/rspc" in page and "jobs.progress" in page
+                assert "/static/app.js" in page  # split-asset entry
+            async with http.get(f"{base}/static/app.js") as resp:
+                assert resp.status == 200
+                js = await resp.text()
+                # the app drives the same /rspc ws protocol
+                assert "/rspc" in js and "jobs.progress" in js
             async with http.post(f"{base}/rspc/library.create",
                                  json={"name": "ws-lib"}) as resp:
                 lid = (await resp.json())["result"]["uuid"]
@@ -274,3 +278,82 @@ def test_ts_client_generator_covers_every_procedure():
     assert code.count("this.call") + code.count("this.subscribe") \
         >= len(router.procedures)
     assert "export class SpacedriveClient" in code
+
+
+def test_auth_device_flow(env):
+    """The RFC 8628 state machine (core/src/api/auth.rs:36-174):
+    loginSession streams Start{user_code}, polls pending, the user
+    approves at the issuer, the token persists into node config,
+    auth.me reflects the identity (surviving a config reload), logout
+    clears it; a denied session errors without persisting anything."""
+    node, router, corpus = env
+    from spacedrive_tpu import auth as auth_mod
+
+    async def main():
+        with pytest.raises(RpcError):  # logged out
+            await router.dispatch("auth.me")
+
+        events = []
+        unsub = await router.subscribe(
+            "auth.loginSession", {"poll_interval": 0.02}, events.append)
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if events:
+                break
+        assert events and events[0]["state"] == "Start"
+        user_code = events[0]["user_code"]
+        assert "?user_code=" in events[0]["verification_url_complete"]
+
+        # Polls keep coming back authorization_pending until approval.
+        await asyncio.sleep(0.08)
+        assert len(events) == 1
+
+        assert node.auth_issuer.approve(user_code, "user-1", "u@x.test")
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if len(events) > 1:
+                break
+        assert events[-1]["state"] == "Complete"
+        unsub()
+
+        me = await router.dispatch("auth.me")
+        assert me == {"id": "user-1", "email": "u@x.test"}
+        # Token persisted: a FRESH config object reads it from disk.
+        from spacedrive_tpu.node import NodeConfig
+        reloaded = NodeConfig(node.config.path)
+        assert reloaded.raw.get("auth_token")["access_token"] == \
+            node.config.raw["auth_token"]["access_token"]
+
+        await router.dispatch("auth.logout")
+        with pytest.raises(RpcError):
+            await router.dispatch("auth.me")
+        assert node.config.raw.get("auth_token") is None
+
+        # Denied session → Error, nothing persisted.
+        events2 = []
+        unsub2 = await router.subscribe(
+            "auth.loginSession", {"poll_interval": 0.02}, events2.append)
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if events2:
+                break
+        assert node.auth_issuer.deny(events2[0]["user_code"])
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if len(events2) > 1:
+                break
+        assert events2[-1]["state"] == "Error"
+        unsub2()
+        with pytest.raises(RpcError):
+            await router.dispatch("auth.me")
+
+        # Issuer-side protocol details (expiry + bad grant).
+        iss = auth_mod.DeviceFlowIssuer(ttl=0.0)
+        dev = iss.device_code("c")
+        status, body = iss.access_token(
+            auth_mod.DEVICE_CODE_URN, dev["device_code"], "c")
+        assert (status, body["error"]) == (400, "expired_token")
+        assert iss.access_token("password", "x", "c")[1]["error"] \
+            == "unsupported_grant_type"
+
+    _run(main())

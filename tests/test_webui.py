@@ -25,7 +25,12 @@ import pytest
 
 from spacedrive_tpu.api.router import mount_router
 from spacedrive_tpu.api.server import ApiServer
-from spacedrive_tpu.api.webui import INDEX_HTML
+from spacedrive_tpu.api.webui import asset_path
+
+
+def _ui_js() -> str:
+    with open(asset_path("app.js"), encoding="utf-8") as f:
+        return f.read()
 from spacedrive_tpu.node import Node
 
 
@@ -57,9 +62,10 @@ def _corpus(root: str) -> None:
 def test_ui_procedure_names_resolve():
     """Guard 1: every procedure the UI JS names exists in the router."""
     node = None
-    names = set(re.findall(r'\b(?:q|mut)\(\s*"([a-zA-Z._]+)"', INDEX_HTML))
+    js = _ui_js()
+    names = set(re.findall(r'\b(?:q|mut)\(\s*"([a-zA-Z._]+)"', js))
     names |= set(re.findall(
-        r'"(?:subscription)"\s*,\s*"([a-zA-Z._]+)"', INDEX_HTML))
+        r'"(?:subscription)"\s*,\s*"([a-zA-Z._]+)"', js))
     # dynamic job-control calls are built as "jobs." + verb
     names |= {"jobs.pause", "jobs.resume", "jobs.cancel", "jobs.clear"}
     names = {n for n in names if not n.endswith(".")}
