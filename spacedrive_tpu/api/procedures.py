@@ -467,7 +467,14 @@ def _files(r: Router) -> None:
 
     @r.query("files.getEphemeralMediaData")
     def files_get_ephemeral_media_data(node, input):
-        return extract_media_data(str(input["path"]))
+        md = extract_media_data(str(input["path"]))
+        if not isinstance(md, dict):
+            return md
+        # EXIF extraction can carry raw byte blobs (maker notes,
+        # thumbnails) — hex them at the protocol boundary instead of
+        # blowing up JSON encoding.
+        return {k: (v.hex() if isinstance(v, (bytes, bytearray)) else v)
+                for k, v in md.items()}
 
     @r.mutation("files.setNote", library=True, invalidates=["search.objects"])
     def files_set_note(node, library, input):

@@ -60,6 +60,19 @@ function sub(path, input, cb) {
     ws.send(JSON.stringify({id, type: "subscription", path, input}));
   }
 }
+function subOnce(path, input, cb) {
+  // NOT replayed on reconnect (device-code flows must not silently
+  // restart server-side); caller's cb returns true to stop the stream.
+  if (!ws || ws.readyState !== 1) { toast("not connected"); return; }
+  const id = ++reqId;
+  subs[id] = (data) => {
+    if (cb(data)) {
+      delete subs[id];
+      ws.send(JSON.stringify({id, type: "subscriptionStop"}));
+    }
+  };
+  ws.send(JSON.stringify({id, type: "subscription", path, input}));
+}
 function toast(msg) {
   const t = document.getElementById("toast");
   t.textContent = msg; t.style.display = "block";
@@ -77,6 +90,7 @@ const fmtBytes = (n) => {
 
 let lib = null, loc = null, curPath = "/", view = "explorer";
 let selected = null, tagFilter = null, favOnly = false, allTags = [];
+let kindFilter = null;         // ObjectKind filter from the overview
 let viewMode = "grid";         // grid | list | media (explorer modes)
 let sortKey = null, sortDir = 1;  // list-view column sort
 let selection = new Set();     // multi-select: file_path ids
@@ -84,8 +98,10 @@ let lastRows = [];             // rows rendered by the last browse()
 let lastClickId = null;        // shift-range anchor
 let clipboard = null;          // {op: "copy"|"cut", ids, locId}
 let settingsLoc = null;        // location id open in per-location settings
+let syncSubLib = null;         // library whose sync stream we watch
 
-const TABS = [["explorer","Explorer"],["browse","Browse"],
+const TABS = [["overview","Overview"],
+              ["explorer","Explorer"],["browse","Browse"],
               ["dups","Duplicates"],
               ["neardups","Near-dups"],["jobs","Jobs"],["p2p","P2P"],
               ["settings","Settings"]];
@@ -192,6 +208,7 @@ async function loadLocs() {
         }
         return;
       }
+      kindFilter = null;
       loc = l.id; curPath = "/"; view = "explorer";
       renderTabs(); render(); loadLocs();
     };
@@ -201,15 +218,25 @@ async function loadLocs() {
 
 async function loadTags() {
   if (!lib) return;
-  allTags = await q("tags.list", {library_id: lib});
+  const withObjects = await q("tags.getWithObjects", {library_id: lib});
+  allTags = withObjects;
   const el = document.getElementById("tags"); el.innerHTML = "";
   for (const t of allTags) {
     const d = document.createElement("span");
     d.className = "tagchip" + (tagFilter === t.id ? " on" : "");
-    d.textContent = t.name;
+    const nObj = (t.object_ids || []).length;
+    d.textContent = t.name + (nObj ? ` (${nObj})` : "");
+    d.title = "click: filter · dblclick: edit · right-click: delete";
     if (t.color) d.style.borderLeft = `4px solid ${esc(t.color)}`;
     d.onclick = () => {
       tagFilter = tagFilter === t.id ? null : t.id; loadTags(); render();
+    };
+    d.ondblclick = async () => {
+      const cur = await q("tags.get", {library_id: lib, id: t.id});
+      const name = prompt("tag name", cur.name); if (!name) return;
+      const color = prompt("color (css)", cur.color || "") || null;
+      await mut("tags.update", {library_id: lib, id: t.id, name, color});
+      loadTags();
     };
     d.oncontextmenu = async (e) => {
       e.preventDefault();
@@ -234,11 +261,76 @@ async function loadStats() {
 
 function render() {
   document.getElementById("inspector").style.display = "none";
-  hideCtx();
-  ({explorer: browse, browse: renderEphemeral, dups: renderDups,
+  hideCtx(); closePreview();
+  ({overview: renderOverview,
+    explorer: browse, browse: renderEphemeral, dups: renderDups,
     neardups: renderNearDups,
     jobs: renderJobs, p2p: renderP2P, settings: renderSettings,
     locsettings: renderLocSettings}[view])();
+}
+
+// ---- Overview landing page (interface/app/$libraryId/overview:
+// categories + statistics + recents + node card) -----------------------
+const KIND_NAMES = {0:"Unknown",1:"Document",2:"Folder",3:"Text",
+  4:"Package",5:"Image",6:"Audio",7:"Video",8:"Archive",9:"Executable",
+  10:"Alias",11:"Encrypted",12:"Key",13:"Link",14:"WebPage",15:"Widget",
+  16:"Album",17:"Book",18:"Code",19:"Database",20:"Font",21:"Mesh",
+  22:"Config",23:"Dotfile",24:"Screenshot",25:"Label"};
+async function renderOverview() {
+  const main = document.getElementById("main");
+  if (!lib) { main.innerHTML =
+    "<div class='muted'>create a library first</div>"; return; }
+  const [stats, cats, locs, online, info, nstate, active, nlocs,
+         nObjects] = await Promise.all([
+    q("library.statistics", {library_id: lib}),
+    q("categories.list", {library_id: lib}),
+    q("locations.list", {library_id: lib}),
+    q("locations.online", {library_id: lib}),
+    q("buildInfo"),
+    q("nodeState"),
+    q("jobs.isActive", {library_id: lib}),
+    q("nodes.listLocations", {library_id: lib}),
+    q("search.objectsCount", {library_id: lib, filter: {}}),
+  ]);
+  const onlineSet = new Set(online);
+  const tiles = [
+    ["Objects", nObjects],
+    ["Unique bytes", fmtBytes(+stats.total_unique_bytes || 0)],
+    ["Total bytes", fmtBytes(+stats.total_bytes_used || 0)],
+    ["Capacity", fmtBytes(+stats.total_bytes_capacity || 0)],
+    ["Locations", nlocs.length],
+    ["Jobs", active ? "running" : "idle"],
+  ];
+  const catCells = Object.entries(cats)
+    .filter(([, n]) => n > 0)
+    .sort((a, b) => b[1] - a[1])
+    .map(([k, n]) => `<div class="cat" data-kind="${esc(k)}">
+       <b>${n}</b> ${esc(KIND_NAMES[k] ?? k)}</div>`).join("");
+  main.innerHTML = `<h1>Overview</h1>
+    <div id="tiles">` + tiles.map(([k, v]) =>
+      `<div class="tile"><div class="muted">${esc(k)}</div>
+       <b>${esc(v)}</b></div>`).join("") + `</div>
+    <h2>Categories</h2>
+    <div id="cats">${catCells ||
+      "<span class='muted'>nothing indexed yet</span>"}</div>
+    <h2>Locations</h2>
+    <div id="ovlocs">` + locs.map(l => `
+      <div class="item ovloc" data-lid="${l.id}">
+        ${onlineSet.has(l.id) ? "🟢" : "⚫"} ${esc(l.name || l.path)}
+        <span class="muted">${esc(l.path || "")}</span></div>`).join("") +
+    `</div>
+    <h2>This node</h2>
+    <div class="kv">name: <b>${esc(nstate.name)}</b>
+      · version <b>${esc(info.version)}</b></div>
+    <div class="kv">data: <b>${esc(nstate.data_path)}</b></div>`;
+  document.querySelectorAll(".ovloc").forEach(el => el.onclick = () => {
+    loc = +el.dataset.lid; curPath = "/"; kindFilter = null;
+    view = "explorer"; renderTabs(); render();
+  });
+  document.querySelectorAll(".cat").forEach(el => el.onclick = () => {
+    view = "explorer"; renderTabs();
+    kindFilter = +el.dataset.kind; render();
+  });
 }
 
 // ---- Ephemeral browsing (non-indexed paths, non_indexed.rs) ----------
@@ -249,8 +341,10 @@ async function renderEphemeral() {
     <h1>Browse (not indexed)</h1>
     <p><input id="ephpath" value="${esc(ephPath)}" style="width:60%"/>
        <button id="ephgo">go</button>
+       <button id="ephmkdir" class="ghost">+ folder</button>
        <span class="muted">any directory on this node — nothing is
        written to the library</span></p>
+    <div id="ephmeta" class="muted"></div>
     <div id="grid"></div>`;
   const go = async () => {
     ephPath = document.getElementById("ephpath").value.trim() || "/";
@@ -271,16 +365,30 @@ async function renderEphemeral() {
     for (const e of entries) {
       const r = {name: e.name, extension: e.extension,
                  is_dir: e.is_dir, cas_id: e.cas_id, id: -1};
-      grid.appendChild(cell(r, () => {
+      grid.appendChild(cell(r, async () => {
         if (e.is_dir) {
           ephPath = e.path;
           document.getElementById("ephpath").value = ephPath;
           go();
+        } else {
+          let md = null;
+          try {
+            md = await q("files.getEphemeralMediaData", {path: e.path});
+          } catch (err) { /* unreadable */ }
+          document.getElementById("ephmeta").textContent =
+            `${e.name}: ` + (md ? Object.entries(md)
+              .map(([k, v]) => `${k}=${v}`).join(" · ")
+              : "no media metadata");
         }
       }));
     }
   };
   document.getElementById("ephgo").onclick = go;
+  document.getElementById("ephmkdir").onclick = async () => {
+    const name = prompt("folder name"); if (!name) return;
+    await mut("files.createEphemeralFolder", {path: ephPath, name});
+    go();
+  };
   document.getElementById("ephpath").onkeydown =
     (e) => { if (e.key === "Enter") go(); };
   go();
@@ -289,21 +397,29 @@ async function renderEphemeral() {
 // ---- Explorer --------------------------------------------------------
 async function browse() {
   const main = document.getElementById("main");
-  if (!lib || loc == null) { main.innerHTML =
+  if (!lib || (loc == null && kindFilter == null)) { main.innerHTML =
     "<div class='muted'>create a library and add a location</div>"; return; }
   const searchText = document.getElementById("search").value.trim();
-  const filter = {location_id: loc};
+  // kind drill-down from the overview is LIBRARY-wide (matching the
+  // tile's count); normal browsing scopes to the selected location.
+  const filter = kindFilter != null ? {object_kind: [kindFilter]}
+                                    : {location_id: loc};
   if (searchText) filter.search = searchText;
-  else filter.materialized_path = curPath;
+  else if (kindFilter == null) filter.materialized_path = curPath;
   if (tagFilter != null) filter.tags = [tagFilter];
   const [rows, count] = await Promise.all([
     q("search.paths", {library_id: lib, take: 400, filter}),
     q("search.pathsCount", {library_id: lib, filter}),
   ]);
+  const kindChip = kindFilter == null ? "" :
+    ` · <span class="tagchip on" id="kindchip">kind: ` +
+    `${esc(KIND_NAMES[kindFilter] ?? kindFilter)} ✕</span>`;
   main.innerHTML =
     `<div class="muted" style="margin-bottom:10px">location ${loc} · ` +
     `${searchText ? `search "${esc(searchText)}"` : esc(curPath)} · ` +
-    `${count} paths</div><div id="grid"></div>`;
+    `${count} paths${kindChip}</div><div id="grid"></div>`;
+  const chip = document.getElementById("kindchip");
+  if (chip) chip.onclick = () => { kindFilter = null; browse(); };
   const grid = document.getElementById("grid");
   if (!searchText && curPath !== "/") {
     grid.appendChild(cell({name: "..", is_dir: 1}, () => {
@@ -421,8 +537,112 @@ function hideCtx() {
   if (m) m.style.display = "none";
 }
 document.addEventListener("click", hideCtx);
+
+// ---- quick preview overlay (the reference's space-bar QuickPreview,
+// interface/app/$libraryId/Explorer/QuickPreview) ----------------------
+let previewRow = null;
+const IMG_EXT = new Set(["png","jpg","jpeg","gif","webp","bmp","svg"]);
+function closePreview() {
+  const p = document.getElementById("preview");
+  if (p) p.style.display = "none";
+  previewRow = null;
+}
+async function openPreview(r) {
+  if (!r || r.is_dir) return;
+  previewRow = r;
+  const p = document.getElementById("preview");
+  const ext = (r.extension || "").toLowerCase();
+  const src = IMG_EXT.has(ext)
+    ? `/spacedrive/file/${lib}/${loc}/${r.id}`
+    : (r.cas_id ? `/spacedrive/thumbnail/${r.cas_id}.webp` : null);
+  let pathLine = "";
+  try {
+    const full = await q("files.getPath", {library_id: lib, id: r.id});
+    if (full) pathLine = `<div class="kv pvpath">${esc(full)}</div>`;
+  } catch (e) { /* ephemeral rows have no id */ }
+  p.innerHTML = `<div id="pvbody">
+    <div id="pvmedia">${src
+      ? `<img src="${src}" onerror="this.replaceWith('🗎')"/>` : "🗎"}</div>
+    <div id="pvmeta">
+      <h1>${esc(r.name)}${r.extension ? "." + esc(r.extension) : ""}</h1>
+      <div class="kv">size: <b>${fmtBytes(r.size_in_bytes || 0)}</b></div>
+      <div class="kv">modified: <b>${r.date_modified
+        ? new Date(r.date_modified * 1000).toISOString() : "?"}</b></div>
+      <div class="kv">cas: <b>${esc(r.cas_id || "—")}</b></div>
+      ${pathLine}
+      <div class="muted">space/esc close · ←/→ navigate</div>
+    </div></div>`;
+  p.style.display = "flex";
+  p.onclick = (e) => { if (e.target === p) closePreview(); };
+  if (r.object_id != null)
+    mut("files.updateAccessTime",
+        {library_id: lib, ids: [r.object_id]}).catch(() => {});
+}
+function previewStep(delta) {
+  const files = lastRows.filter(x => !x.is_dir);
+  if (!files.length || !previewRow) return;
+  const i = files.findIndex(x => x.id === previewRow.id);
+  const next = files[(i + delta + files.length) % files.length];
+  selection.clear(); selection.add(next.id); lastClickId = next.id;
+  updateSelClasses();
+  openPreview(next);
+}
+
+// ---- keyboard model: arrows/enter/del/space in grid and list ---------
+function gridColumns() {
+  const g = document.getElementById("grid");
+  if (!g || viewMode === "list") return 1;
+  const cols = getComputedStyle(g).gridTemplateColumns.split(" ").length;
+  return Math.max(1, cols);
+}
+function moveCursor(delta) {
+  if (!lastRows.length) return;
+  let i = lastClickId != null
+    ? lastRows.findIndex(r => r.id === lastClickId) : -1;
+  i = Math.max(0, Math.min(lastRows.length - 1, i + delta));
+  const r = lastRows[i];
+  selection.clear(); selection.add(r.id); lastClickId = r.id;
+  updateSelClasses();
+  const el = document.querySelector(`[data-fpid="${r.id}"]`);
+  if (el) el.scrollIntoView({block: "nearest"});
+  if (previewRow) openPreview(r);
+}
 document.addEventListener("keydown", (e) => {
-  if (e.key === "Escape") { clearSel(); hideCtx(); updateSelClasses(); }
+  if (e.key === "Escape") {
+    closePreview(); clearSel(); hideCtx(); updateSelClasses(); return;
+  }
+  const tag = (document.activeElement || {}).tagName;
+  if (tag === "INPUT" || tag === "TEXTAREA" || view !== "explorer") return;
+  if (e.key === " ") {
+    e.preventDefault();
+    if (previewRow) { closePreview(); return; }
+    const r = lastRows.find(x => selection.has(x.id) && !x.is_dir);
+    if (r) openPreview(r);
+  } else if (e.key === "ArrowRight") {
+    e.preventDefault();
+    previewRow ? previewStep(1) : moveCursor(1);
+  } else if (e.key === "ArrowLeft") {
+    e.preventDefault();
+    previewRow ? previewStep(-1) : moveCursor(-1);
+  } else if (e.key === "ArrowDown") {
+    e.preventDefault(); moveCursor(gridColumns());
+  } else if (e.key === "ArrowUp") {
+    e.preventDefault(); moveCursor(-gridColumns());
+  } else if (e.key === "Enter") {
+    const r = lastRows.find(x => selection.has(x.id));
+    if (r) openEntry(r);
+  } else if (e.key === "Delete") {
+    const rows = selRows();
+    if (!rows.length || !confirm(`delete ${rows.length} file(s)?`)) return;
+    mut("files.deleteFiles", {library_id: lib, location_id: loc,
+      file_path_ids: rows.map(x => x.id)}).then(() => {
+        toast("deleting…"); clearSel(); setTimeout(browse, 400);
+      });
+  } else if ((e.ctrlKey || e.metaKey) && e.key.toLowerCase() === "a") {
+    e.preventDefault();
+    for (const r of lastRows) selection.add(r.id);
+    updateSelClasses();
+  }
 });
 function showCtx(r, e) {
   e.preventDefault();
@@ -435,8 +655,21 @@ function showCtx(r, e) {
   const n = rows.length;
   // Directory-only selection: file operations have nothing to act on,
   // so offer navigation alone instead of "(0)" no-op actions.
-  const items = n === 0 ? [["Open", () => openEntry(r)]] : [
+  const items = n === 0 ? [
+    ["Open", () => openEntry(r)],
+    ["Rescan this folder", async () => {
+       await mut("locations.subPathRescan", {library_id: lib,
+         location_id: loc, sub_path: curPath});
+       toast("rescanning…"); }],
+  ] : [
     ["Open / inspect", () => openEntry(r)],
+    ["Preview (space)", () => { const f = selRows()[0];
+       if (f) openPreview(f); }],
+    ["Copy path", async () => {
+       const full = await q("files.getPath", {library_id: lib, id: r.id});
+       if (full && navigator.clipboard)
+         navigator.clipboard.writeText(full).catch(() => {});
+       toast(full || "no path"); }],
     ["sep"],
     [`Copy (${n})`, () => { clipboard = {op: "copy",
        ids: rows.map(x => x.id), locId: loc}; pasteBtn(); }],
@@ -467,6 +700,19 @@ function showCtx(r, e) {
        await mut("jobs.objectValidator",
                  {library_id: lib, id: loc, mode: "fill"});
        toast("validator started"); }],
+    ["Convert image…", async () => {
+       const exts = await q("files.getConvertableImageExtensions");
+       const to = prompt(`convert to (${exts.join(", ")})`);
+       if (!to || !exts.includes(to.toLowerCase())) return;
+       for (const x of selRows())
+         await mut("files.convertImage", {library_id: lib,
+           file_path_id: x.id, to_extension: to.toLowerCase()});
+       toast("converted"); setTimeout(browse, 400); }],
+    [`Clear access time (${n})`, async () => {
+       const ids = selRows().map(x => x.object_id).filter(v => v != null);
+       if (ids.length)
+         await mut("files.removeAccessTime", {library_id: lib, ids});
+       toast("cleared"); }],
     ["sep"],
     [`Delete (${n})`, async () => {
        if (!confirm(`delete ${n} file(s)?`)) return;
@@ -615,18 +861,27 @@ async function renderLocSettings() {
   if (!lib || settingsLoc == null) {
     main.innerHTML = "<div class='muted'>no location selected</div>"; return;
   }
-  const [l, allRules] = await Promise.all([
-    q("locations.getWithRules",
-      {library_id: lib, location_id: settingsLoc}),
+  const [l, allRules, attachedRules, online] = await Promise.all([
+    q("locations.get", {library_id: lib, location_id: settingsLoc}),
     q("locations.indexer_rules.list", {library_id: lib}),
+    q("locations.indexer_rules.listForLocation",
+      {library_id: lib, location_id: settingsLoc}),
+    q("locations.online", {library_id: lib}),
   ]);
   if (!l) { main.innerHTML = "<div class='muted'>gone</div>"; return; }
-  const attached = new Set((l.indexer_rules || []).map(r => r.id));
+  const isOnline = online.includes(l.id);
+  const attached = new Set((attachedRules || []).map(r => r.id));
   main.innerHTML = `
     <h1>Location settings — ${esc(l.name || l.path)}</h1>
-    <div class="kv">path: <b>${esc(l.path)}</b></div>
+    <div class="kv">path: <b>${esc(l.path)}</b>
+      ${isOnline ? "🟢 online" : "⚫ offline"}
+      ${isOnline ? "" :
+        '<button id="lsrelink" class="ghost">relink…</button>'}</div>
     <div class="kv">id: <b>${l.id}</b> · hidden: <b>${l.hidden ? "yes"
-      : "no"}</b></div>
+      : "no"}</b> · indexed <b>${esc(String(l.date_created || "?"))}
+      </b></div>
+    <div class="kv"><button id="lsaddlib" class="ghost">
+      add to another library…</button></div>
     <p>
       <input id="lsname" value="${esc(l.name || "")}"
              placeholder="display name"/>
@@ -637,6 +892,7 @@ async function renderLocSettings() {
     <p>
       <button id="lsfull">full rescan</button>
       <button id="lsquick" class="ghost">quick rescan</button>
+      <button id="lsmkdir" class="ghost">create subdirectory…</button>
       <button id="lsdelete" class="danger">remove location</button>
     </p>
     <h2>Indexer rules</h2>
@@ -652,6 +908,32 @@ async function renderLocSettings() {
              style="width:160px"/>
       <button id="nradd">add rule</button>
     </p>`;
+  document.getElementById("lsmkdir").onclick = async () => {
+    const sp = prompt("subdirectory path (relative to the location)");
+    if (!sp) return;
+    try {
+      await mut("locations.createDirectory",
+                {library_id: lib, location_id: l.id, sub_path: sp});
+      toast("created");
+    } catch (e) { toast(e.message); }
+  };
+  const relinkBtn = document.getElementById("lsrelink");
+  if (relinkBtn) relinkBtn.onclick = async () => {
+    const path = prompt("new absolute path for this location");
+    if (!path) return;
+    await mut("locations.relink",
+              {library_id: lib, location_id: l.id, path});
+    toast("relinked"); renderLocSettings();
+  };
+  document.getElementById("lsaddlib").onclick = async () => {
+    const target = prompt("target library id (uuid)");
+    if (!target) return;
+    try {
+      await mut("locations.addLibrary",
+                {library_id: target, path: l.path});
+      toast("added to library");
+    } catch (e) { toast(e.message); }
+  };
   const rulesEl = document.getElementById("lsrules");
   for (const r of allRules) {
     const d = document.createElement("div"); d.className = "kv";
@@ -665,7 +947,17 @@ async function renderLocSettings() {
       renderLocSettings();
     };
     d.appendChild(cb);
-    d.append(` ${r.name} `);
+    const nm = document.createElement("span");
+    nm.textContent = ` ${r.name} `;
+    nm.style.cursor = "pointer";
+    nm.title = "click for rule details";
+    nm.onclick = async () => {
+      const full = await q("locations.indexer_rules.get",
+                           {library_id: lib, id: r.id});
+      toast(`${full.name}: ${full.rules_per_kind ? "rules blob "
+        + full.rules_per_kind.length + " B" : "no params"}`);
+    };
+    d.appendChild(nm);
     if (r.default_rule) {
       const s = document.createElement("span");
       s.className = "muted"; s.textContent = "(system)";
@@ -960,20 +1252,57 @@ window.p2pDrop = async (addr, port) => {
 async function renderSettings() {
   const main = document.getElementById("main");
   if (!lib) return;
-  const [stats, cats, vols, keysSetup, backups, prefs] = await Promise.all([
+  const [stats, cats, vols, keysSetup, backups, prefs, nstate, info,
+         notifs, syncOps] = await Promise.all([
     q("library.statistics", {library_id: lib}),
     q("categories.list", {library_id: lib}),
     q("volumes.list"),
     q("keys.isSetup", {library_id: lib}),
     q("backups.getAll"),
     q("preferences.get", {library_id: lib}),
+    q("nodeState"),
+    q("buildInfo"),
+    q("notifications.get"),
+    q("sync.messages", {library_id: lib}),
   ]);
+  let account;
+  try { account = await q("auth.me"); } catch (e) { account = null; }
   const catRows = Object.entries(cats).filter(([, n]) => n > 0)
     .map(([k, n]) => `<tr><td>${esc(k)}</td><td>${n}</td></tr>`).join("");
-  main.innerHTML = `<h3>Statistics</h3>` +
+  main.innerHTML = `<h3>Account</h3><div id="account">` + (account
+    ? `<div class="kv">signed in: <b>${esc(account.email)}</b>
+       (${esc(account.id)})</div>
+       <button id="logoutbtn" class="ghost">log out</button>`
+    : `<button id="loginbtn">log in (device flow)</button>
+       <span id="logincode" class="muted"></span>`) + `</div>
+    <h3>This node</h3>
+    <div class="kv">name: <b>${esc(nstate.name)}</b>
+      <button id="renamenode" class="ghost">rename</button>
+      · v${esc(info.version)}</div>
+    <div class="kv">features: <b>${esc(nstate.features.join(", ") ||
+      "none")}</b>
+      <button id="togglep2pfiles" class="ghost">toggle filesOverP2P
+      </button></div>
+    <h3>Library</h3>
+    <div class="kv"><button id="renamelib" class="ghost">rename library
+      </button></div>
+    <h3>Statistics</h3>` +
     Object.entries(stats).map(([k, v]) =>
       `<div class="kv">${esc(k)}: <b>${esc(v)}</b></div>`).join("") +
     `<h3>Categories</h3><table>${catRows}</table>
+    <h3>Sync</h3>
+    <div class="kv">op log: <b>${syncOps.length}</b> ops (latest page)
+      <span id="synclive" class="muted"></span></div>
+    <h3>Notifications</h3>
+    <button id="notifytest" class="ghost">test (node)</button>
+    <button id="notifytestlib" class="ghost">test (library)</button>
+    <button id="dismissall" class="ghost">dismiss all</button>
+    <table>` + notifs.slice(0, 8).map(nn =>
+      `<tr><td>${esc(nn.kind || nn.title || "notification")}</td>
+       <td class="muted">${nn.read ? "read" : "unread"}</td>
+       <td><button class="ghost ndismiss" data-nid="${nn.id}"
+            data-nlib="${esc(nn.library_id || lib)}">dismiss
+       </button></td></tr>`).join("") + `</table>
     <h3>Volumes</h3><table>` +
     vols.map(v => `<tr><td>${esc(v.name || v.mount_point)}</td>
       <td>${fmtBytes(v.available_capacity)} free of
@@ -990,9 +1319,55 @@ async function renderSettings() {
       .join("") + `</table>
     <h3>Preferences</h3>
     <div class="kv">stored keys: <b>${Object.keys(prefs || {}).length}</b>
-      <button id="setpref" class="ghost">set pref</button></div>
-    <h3>Notifications</h3>
-    <button id="notifytest" class="ghost">send test notification</button>`;
+      <button id="setpref" class="ghost">set pref</button></div>`;
+
+  // account card wiring (the RFC 8628 device flow, api/auth.rs)
+  const loginBtn = document.getElementById("loginbtn");
+  if (loginBtn) loginBtn.onclick = () => {
+    subOnce("auth.loginSession", {poll_interval: 0.3}, (ev) => {
+      const codeEl = document.getElementById("logincode");
+      if (ev.state === "Start") {
+        if (codeEl) codeEl.textContent =
+          ` enter code ${ev.user_code} at ${ev.verification_url}`;
+        return false;              // keep listening
+      }
+      if (ev.state === "Complete") { toast("signed in"); renderSettings(); }
+      else toast("login failed");
+      return true;                 // terminal: stop the stream
+    });
+  };
+  const logoutBtn = document.getElementById("logoutbtn");
+  if (logoutBtn) logoutBtn.onclick = async () => {
+    await mut("auth.logout"); renderSettings();
+  };
+  document.getElementById("renamenode").onclick = async () => {
+    const name = prompt("node name"); if (!name) return;
+    await mut("nodes.edit", {name}); renderSettings();
+  };
+  document.getElementById("togglep2pfiles").onclick = async () => {
+    await mut("toggleFeatureFlag", {feature: "filesOverP2P"});
+    renderSettings();
+  };
+  document.getElementById("renamelib").onclick = async () => {
+    const name = prompt("library name"); if (!name) return;
+    await mut("library.edit", {id: lib, name}); loadLibs();
+  };
+  document.getElementById("notifytestlib").onclick = () =>
+    mut("notifications.testLibrary", {library_id: lib})
+      .then(renderSettings);
+  document.getElementById("dismissall").onclick = () =>
+    mut("notifications.dismissAll").then(renderSettings);
+  document.querySelectorAll(".ndismiss").forEach(b => b.onclick = () =>
+    mut("notifications.dismiss",
+        {library_id: b.dataset.nlib, id: +b.dataset.nid})
+      .then(renderSettings));
+  if (syncSubLib !== lib) {
+    syncSubLib = lib;
+    sub("sync.newMessage", {library_id: lib}, () => {
+      const el = document.getElementById("synclive");
+      if (el) el.textContent = " · live ops arriving";
+    });
+  }
 
   const keysEl = document.getElementById("keys");
   if (!keysSetup) {
@@ -1015,14 +1390,32 @@ async function renderSettings() {
       };
     } else {
       const keys = await q("keys.list", {library_id: lib});
-      keysEl.innerHTML = keys.map(k =>
-        `<div class="kv">${esc(k.uuid || k.id)} ` +
-        `${k.mounted ? "(mounted)" : ""}</div>`).join("") +
+      keysEl.innerHTML = keys.map(k => {
+        const u = esc(k.uuid || k.id);
+        return `<div class="kv">${u} ${k.mounted ? "(mounted)" : ""}
+          <button class="ghost kmnt" data-ku="${u}"
+            data-m="${k.mounted ? 1 : 0}">
+            ${k.mounted ? "unmount" : "mount"}</button>
+          <button class="danger kdel" data-ku="${u}">×</button>
+        </div>`;
+      }).join("") +
         `<button id="kadd" class="ghost">add key</button>
          <button id="klock" class="ghost">lock</button>`;
+      keysEl.querySelectorAll(".kmnt").forEach(b => b.onclick =
+        async () => {
+          await mut(+b.dataset.m ? "keys.unmount" : "keys.mount",
+                    {uuid: b.dataset.ku});
+          renderSettings();
+        });
+      keysEl.querySelectorAll(".kdel").forEach(b => b.onclick =
+        async () => {
+          if (!confirm("delete this key?")) return;
+          await mut("keys.delete", {uuid: b.dataset.ku});
+          renderSettings();
+        });
       document.getElementById("kadd").onclick = async () => {
         const pw = prompt("new key password"); if (!pw) return;
-        await mut("keys.add", {library_id: lib, password: pw});
+        await mut("keys.add", {key: pw});
         renderSettings();
       };
       document.getElementById("klock").onclick = async () => {
@@ -1131,7 +1524,41 @@ sub("invalidation.listen", null, (e) => {
 sub("notifications.listen", null, (e) => {
   toast(`🔔 ${e.title || ""} ${e.content || e.message || ""}`);
 });
+sub("jobs.newThumbnail", null, (e) => {
+  // live-patch just the matching cell's image — a directory of
+  // hundreds of thumbnails must not trigger a refetch per event
+  if (view !== "explorer" || !e.cas_id) return;
+  const r = lastRows.find(x => x.cas_id === e.cas_id);
+  if (!r) return;
+  const el = document.querySelector(`[data-fpid="${r.id}"] .thumb`);
+  if (!el || el.querySelector("img")) return;
+  el.textContent = "";
+  const img = document.createElement("img");
+  img.src = `/spacedrive/thumbnail/${e.cas_id}.webp`;
+  img.onerror = () => { img.remove(); el.textContent = "🗎"; };
+  el.appendChild(img);
+});
 sub("p2p.events", null, async (e) => {
+  if (e.type === "SpacedropProgress") {
+    const el = document.getElementById("joblist");
+    let row = document.getElementById("drop-" + e.id);
+    if (!row) {
+      row = document.createElement("div"); row.className = "job";
+      row.id = "drop-" + e.id;
+      row.innerHTML = `<span></span>
+        <button class="ghost" style="float:right;font-size:10px">cancel
+        </button><div class="bar"><div></div></div>`;
+      row.querySelector("button").onclick = () =>
+        mut("p2p.cancelSpacedrop", {id: e.id}).then(() => row.remove());
+      el.prepend(row);
+    }
+    const pct = e.total ? Math.round(100 * e.bytes / e.total) : 0;
+    row.querySelector("span").textContent =
+      `spacedrop ${e.direction || ""} ${pct}%`;
+    row.querySelector(".bar > div").style.width = pct + "%";
+    if (e.bytes >= e.total) setTimeout(() => row.remove(), 3000);
+    return;
+  }
   if (e.type === "SpacedropRequest") {
     // The peer-supplied name is untrusted: suggest only its basename,
     // never a path ("../../etc/x" must not prefill the save prompt).
