@@ -30,7 +30,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 import numpy as np  # noqa: E402
 
-OPS_PER_FILE = (57 * 16 + 56) * 840  # u32 elementwise ops (bench.py basis)
+OPS_PER_FILE = (57 * 16 + 56) * 1240  # ALU ops: round-4 static mix
+# (1,232 G-function ops + 8-xor output fold per compression; bench.py basis)
 VPU_OPS_EST = 5e12
 
 

@@ -1,0 +1,79 @@
+"""Real-chip jax-validator workload figure (VERDICT r3 item 9).
+
+Runs the ObjectValidatorJob with backend="jax" — each file's chunk
+chain streamed through StreamingShardedChecksum on the LOCAL device
+mesh (one chip on the bench host) — against a small real corpus
+through the full job system, and prints one JSON line with files/s and
+MB/s. This is the honest single-device long-context-plane number the
+virtual-mesh figure in PARITY.md explicitly is not.
+
+Run ALONE (single-client tunnel). Corpus is deliberately small: the
+tunneled link makes every window H2D-bound, which is the point — the
+figure characterizes this host, not the kernel.
+
+Usage: python tools/validator_device_bench.py [n_files] [file_kb]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+async def run(n_files: int, file_kb: int) -> None:
+    from spacedrive_tpu.locations.manager import (create_location,
+                                                  scan_location)
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.validator import ObjectValidatorJob
+
+    tmp = tempfile.mkdtemp(prefix="sdtpu-valbench-")
+    corpus = os.path.join(tmp, "corpus")
+    os.makedirs(corpus)
+    rng = random.Random(3)
+    total_bytes = 0
+    for i in range(n_files):
+        data = rng.randbytes(file_kb * 1024)
+        with open(os.path.join(corpus, f"f{i}.bin"), "wb") as f:
+            f.write(data)
+        total_bytes += len(data)
+
+    node = Node(os.path.join(tmp, "data"))
+    await node.start()
+    lib = node.create_library("valbench")
+    loc = create_location(lib, corpus)
+    await scan_location(node.jobs, lib, loc, backend="native",
+                        with_media=False)
+    await node.jobs.wait_idle()
+
+    t0 = time.perf_counter()
+    jid = await node.jobs.ingest(
+        lib, ObjectValidatorJob(location_id=loc, backend="jax", mode="fill"))
+    await node.jobs.wait(jid)
+    dt = time.perf_counter() - t0
+    n_done = lib.db.query_one(
+        "SELECT COUNT(*) AS n FROM file_path "
+        "WHERE integrity_checksum IS NOT NULL")["n"]
+    print(json.dumps({
+        "metric": "validator_jax_device_files_per_sec",
+        "value": round(n_done / dt, 2),
+        "unit": "files/s",
+        "mb_per_sec": round(total_bytes / dt / 1e6, 2),
+        "files": n_done,
+        "file_kb": file_kb,
+        "seconds": round(dt, 2),
+        "backend": "jax (StreamingShardedChecksum on the local mesh)",
+    }))
+    await node.shutdown()
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    kb = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    asyncio.run(run(n, kb))
