@@ -1214,24 +1214,51 @@ def _decode_chroma(r: BitReader, pps: Dict, frame: _Frame, mby: int,
 # top-level entry points
 # ---------------------------------------------------------------------------
 
-def decode_annexb_iframe(stream: bytes
-                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Decode the first I/IDR picture of an Annex-B stream → (Y,Cb,Cr)."""
+def iter_pictures(stream: bytes):
+    """Yield (sps, pps, slice_nals) per coded picture of an Annex-B
+    stream. Pictures are cut at slices whose first_mb_in_slice restarts
+    at 0 (types 1 AND 5 — non-IDR I slices exist in open-GOP streams),
+    so multi-access-unit windows (TS captures) never mix pictures."""
     sps = pps = None
     slices: List[bytes] = []
     for nal in split_annexb(stream):
+        if not nal:
+            continue
         t = nal[0] & 0x1F
         if t == 7:
-            sps = parse_sps(unescape(nal[1:]))
+            if sps is None:
+                sps = parse_sps(unescape(nal[1:]))
         elif t == 8:
-            pps = parse_pps(unescape(nal[1:]))
+            if pps is None:
+                pps = parse_pps(unescape(nal[1:]))
         elif t in (1, 5):
             if sps is None or pps is None:
-                raise H264Error("slice before parameter sets")
+                continue  # mid-stream window before parameter sets
+            first_mb = BitReader(unescape(nal[1:5])).ue()
+            if first_mb == 0 and slices:
+                yield sps, pps, slices
+                slices = []
             slices.append(nal)
-    if not slices:
-        raise H264Error("no slice NAL")
-    return decode_picture(sps, pps, slices)
+    if slices:
+        yield sps, pps, slices
+
+
+def decode_annexb_iframe(stream: bytes
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Decode the first decodable I/IDR picture of an Annex-B stream →
+    (Y, Cb, Cr). Later pictures are tried (bounded) when the first is
+    a P/B slice the intra decoder rejects."""
+    err: Optional[H264Error] = None
+    for k, (sps, pps, slices) in enumerate(iter_pictures(stream)):
+        if sps is None or pps is None:
+            raise H264Error("slice before parameter sets")
+        try:
+            return decode_picture(sps, pps, slices)
+        except Unsupported as e:
+            err = e  # e.g. a P picture; try the next one
+            if k >= 8:
+                break
+    raise err or H264Error("no decodable I/IDR picture")
 
 
 def keyframe_from_mp4(path: str, fraction: float = 0.10

@@ -40,9 +40,12 @@ def thumbnailable_extensions() -> set:
     the static set, every video container when ffmpeg is present, and
     the cover-art containers always (embedded covr/attachment images
     thumbnail without any decoder; files without one degrade to None)."""
-    from .video import _COVER_EXTENSIONS, VIDEO_EXTENSIONS, available
+    from .rawpreview import RAW_TIFF_EXTENSIONS
+    from .video import (_COVER_EXTENSIONS, _H264_TS_EXTENSIONS,
+                        VIDEO_EXTENSIONS, available)
 
-    exts = set(THUMBNAILABLE_EXTENSIONS) | set(_COVER_EXTENSIONS)
+    exts = (set(THUMBNAILABLE_EXTENSIONS) | set(_COVER_EXTENSIONS)
+            | RAW_TIFF_EXTENSIONS | set(_H264_TS_EXTENSIONS))
     if available():
         exts |= VIDEO_EXTENSIONS
     return exts
@@ -116,6 +119,26 @@ def generate_thumbnail(input_path: str, data_dir: str,
         from .video import generate_video_thumbnail
 
         return generate_video_thumbnail(input_path, out)
+    from .rawpreview import RAW_TIFF_EXTENSIONS
+
+    if ext in RAW_TIFF_EXTENSIONS:
+        # TIFF-structured RAW: largest embedded JPEG preview, no
+        # demosaicer (media/rawpreview.py).
+        import io
+
+        from PIL import Image
+
+        from .rawpreview import extract_preview
+
+        try:
+            blob = extract_preview(input_path)
+            if blob is None:
+                return None
+            with Image.open(io.BytesIO(blob)) as im:
+                im.load()
+                return encode_webp(im, out)
+        except Exception:
+            return None
     try:
         # Route through the sd-images dispatch so SVG (self-hosted
         # rasterizer) and gated codecs work, not just PIL formats.

@@ -81,24 +81,31 @@ def _is_mjpeg_candidate(path: str) -> bool:
 
 _COVER_EXTENSIONS = {"mp4", "m4v", "mov", "m4a", "3gp", "mkv", "webm"}
 _H264_MP4_EXTENSIONS = {"mp4", "m4v", "mov", "3gp"}
+_H264_TS_EXTENSIONS = {"ts", "mts", "m2ts"}
 
 
 def _h264_thumbnail(input_path: str, out_path: str,
                     target_px: float) -> Optional[str]:
-    """Self-hosted H.264 path: decode the sync sample nearest 10% with
-    the from-spec baseline-I decoder (media/h264.py) and webp it.
-    Returns None for non-H.264 files or streams outside the baseline-I
-    subset (CABAC, high profile) — the caller then tries cover art."""
+    """Self-hosted H.264 path: decode the IDR nearest 10% with the
+    from-spec baseline-I decoder (media/h264.py) and webp it — MP4
+    family via the sample tables, transport streams via the TS demux
+    (media/mpegts.py). Returns None for non-H.264 files or streams
+    outside the baseline-I subset (CABAC, high profile) — the caller
+    then tries cover art."""
     from PIL import Image
 
     from .h264 import keyframe_from_mp4, yuv420_to_rgb
     from .thumbnail import encode_webp
 
     ext = os.path.splitext(input_path)[1].lstrip(".").lower()
-    if ext not in _H264_MP4_EXTENSIONS:
+    if ext in _H264_MP4_EXTENSIONS:
+        grab = keyframe_from_mp4
+    elif ext in _H264_TS_EXTENSIONS:
+        from .mpegts import keyframe_from_ts as grab
+    else:
         return None
     try:
-        planes = keyframe_from_mp4(input_path, SEEK_PERCENTAGE)
+        planes = grab(input_path, SEEK_PERCENTAGE)
         if planes is None:
             return None
         rgb = yuv420_to_rgb(*planes)

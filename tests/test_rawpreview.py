@@ -1,0 +1,121 @@
+"""RAW preview extraction (media/rawpreview.py): TIFF IFD walking on
+synthetic-but-spec-shaped RAW files. Real CR2/NEF/DNG are plain TIFF
+containers; the fixtures here build the same structures byte by byte
+(both endians, IFD chain + SubIFDs, strip- and interchange-format
+previews) around real PIL-encoded JPEGs of different sizes."""
+
+import io
+import os
+import struct
+
+from PIL import Image
+
+from spacedrive_tpu.media.rawpreview import extract_preview
+
+
+def _jpeg(w, h, color):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+def _entry(e, tag, typ, count, value):
+    return struct.pack(e + "HHI4s", tag, typ, count, value)
+
+
+def _inline(e, fmt, v):
+    return struct.pack(e + fmt, v).ljust(4, b"\x00")
+
+
+def build_raw(endian="<", with_subifd=True):
+    """TIFF: IFD0 (strip JPEG, compression 6) → IFD1 (interchange
+    thumbnail) with an optional SubIFD carrying the LARGEST preview."""
+    e = endian
+    small = _jpeg(32, 24, (200, 30, 30))      # IFD1 thumbnail
+    mid = _jpeg(160, 120, (30, 200, 30))      # IFD0 strip preview
+    big = _jpeg(320, 240, (30, 30, 200))      # SubIFD preview (largest)
+
+    # layout: header(8) IFD0 IFD1 [subIFD] blobs...
+    def ifd_size(n):
+        return 2 + 12 * n + 4
+
+    n0 = 4 if with_subifd else 3
+    ifd0_off = 8
+    ifd1_off = ifd0_off + ifd_size(n0)
+    sub_off = ifd1_off + ifd_size(2)
+    blobs_off = sub_off + (ifd_size(3) if with_subifd else 0)
+    mid_off = blobs_off
+    small_off = mid_off + len(mid)
+    big_off = small_off + len(small)
+
+    out = bytearray()
+    out += (b"II" if e == "<" else b"MM") + struct.pack(e + "H", 42)
+    out += struct.pack(e + "I", ifd0_off)
+
+    # IFD0: compression=6, strip offset/count = mid, subifds -> sub
+    ifd0 = struct.pack(e + "H", n0)
+    ifd0 += _entry(e, 0x0103, 3, 1, _inline(e, "H", 6))
+    ifd0 += _entry(e, 0x0111, 4, 1, _inline(e, "I", mid_off))
+    ifd0 += _entry(e, 0x0117, 4, 1, _inline(e, "I", len(mid)))
+    if with_subifd:
+        ifd0 += _entry(e, 0x014A, 4, 1, _inline(e, "I", sub_off))
+    ifd0 += struct.pack(e + "I", ifd1_off)
+    out += ifd0
+
+    # IFD1: classic thumbnail pair
+    ifd1 = struct.pack(e + "H", 2)
+    ifd1 += _entry(e, 0x0201, 4, 1, _inline(e, "I", small_off))
+    ifd1 += _entry(e, 0x0202, 4, 1, _inline(e, "I", len(small)))
+    ifd1 += struct.pack(e + "I", 0)
+    out += ifd1
+
+    if with_subifd:
+        sub = struct.pack(e + "H", 3)
+        sub += _entry(e, 0x0103, 3, 1, _inline(e, "H", 6))
+        sub += _entry(e, 0x0111, 4, 1, _inline(e, "I", big_off))
+        sub += _entry(e, 0x0117, 4, 1, _inline(e, "I", len(big)))
+        sub += struct.pack(e + "I", 0)
+        out += sub
+
+    assert len(out) == blobs_off
+    out += mid + small + big
+    return bytes(out), big if with_subifd else mid
+
+
+def test_extract_largest_preview_le(tmp_path):
+    raw, want = build_raw("<")
+    p = tmp_path / "shot.nef"
+    p.write_bytes(raw)
+    got = extract_preview(str(p))
+    assert got == want
+
+
+def test_extract_largest_preview_be(tmp_path):
+    raw, want = build_raw(">", with_subifd=False)
+    p = tmp_path / "shot.dng"
+    p.write_bytes(raw)
+    assert extract_preview(str(p)) == want
+
+
+def test_non_tiff_rejected(tmp_path):
+    p = tmp_path / "junk.cr2"
+    p.write_bytes(os.urandom(512))
+    assert extract_preview(str(p)) is None
+
+
+def test_thumbnail_pipeline_from_raw(tmp_path):
+    """generate_thumbnail produces a webp from the embedded preview —
+    the production dispatch path for raw extensions."""
+    from spacedrive_tpu.media.thumbnail import (generate_thumbnail,
+                                                thumbnail_path,
+                                                thumbnailable_extensions)
+
+    assert {"nef", "cr2", "dng", "arw"} <= thumbnailable_extensions()
+    raw, _ = build_raw("<")
+    src = tmp_path / "shot.cr2"
+    src.write_bytes(raw)
+    out = generate_thumbnail(str(src), str(tmp_path / "data"), "ab12cd")
+    assert out == thumbnail_path(str(tmp_path / "data"), "ab12cd")
+    with Image.open(out) as im:
+        im.load()
+        assert im.size[0] >= 160  # came from the big preview, not IFD1

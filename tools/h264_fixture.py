@@ -513,6 +513,89 @@ def mux_mp4(sps_nal: bytes, pps_nal: bytes, slice_nals: list,
     return ftyp + mdat + moov
 
 
+# -- minimal MPEG-TS muxer --------------------------------------------------
+
+def _crc32_mpeg(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc ^= b << 24
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x04C11DB7) & 0xFFFFFFFF if crc & 0x80000000 \
+                else (crc << 1) & 0xFFFFFFFF
+    return crc
+
+
+def _ts_packet(pid: int, payload: bytes, pusi: bool, cc: int) -> bytes:
+    """One 188-byte packet; short payloads padded via adaptation field."""
+    header = bytes([
+        0x47,
+        (0x40 if pusi else 0) | ((pid >> 8) & 0x1F),
+        pid & 0xFF,
+        0,  # afc+cc filled below
+    ])
+    room = 184
+    if len(payload) < room:
+        stuff = room - len(payload) - 1  # 1 byte af length
+        af = bytes([max(stuff, 0)]) + (b"\x00" + b"\xff" * (stuff - 1)
+                                       if stuff > 0 else b"")
+        body = af + payload
+        afc = 3
+    else:
+        body = payload[:184]
+        afc = 1
+    pkt = bytearray(header + body)
+    pkt[3] = (afc << 4) | (cc & 0x0F)
+    return bytes(pkt)
+
+
+def _psi_section(table_id: int, body: bytes, tsid: int = 1) -> bytes:
+    sec = bytes([table_id]) + struct.pack(
+        ">H", 0xB000 | (len(body) + 9)) + struct.pack(">H", tsid) + \
+        bytes([0xC1, 0x00, 0x00]) + body
+    return b"\x00" + sec + struct.pack(">I", _crc32_mpeg(sec))
+
+
+def mux_ts(slice_nals: list, sps_nal: bytes, pps_nal: bytes,
+           m2ts: bool = False, repeats: int = 3) -> bytes:
+    """H.264 Annex-B access unit(s) in a transport stream: PAT → PMT
+    (stream_type 0x1B on PID 0x100) → PES packets. `repeats` emits the
+    picture several times so a mid-file seek still finds an IDR."""
+    pmt_pid, vpid = 0x1000, 0x100
+    pat = _psi_section(0x00, struct.pack(">HH", 1, 0xE000 | pmt_pid))
+    pmt = _psi_section(0x02, struct.pack(">H", 0xE000 | vpid) +
+                       struct.pack(">H", 0xF000) +
+                       bytes([0x1B]) +
+                       struct.pack(">H", 0xE000 | vpid) +
+                       struct.pack(">H", 0xF000), tsid=1)
+    au = b"".join(b"\x00\x00\x00\x01" + n
+                  for n in [sps_nal, pps_nal] + slice_nals)
+    pes = (b"\x00\x00\x01\xe0" + struct.pack(">H", 0) +
+           bytes([0x80, 0x00, 0x00]) + au)
+
+    out = bytearray()
+    cc = {0: 0, pmt_pid: 0, vpid: 0}
+
+    def emit(pid, payload, pusi):
+        out.extend(_ts_packet(pid, payload, pusi, cc[pid]))
+        cc[pid] = (cc[pid] + 1) & 0x0F
+
+    for _ in range(repeats):
+        emit(0, pat, True)
+        emit(pmt_pid, pmt, True)
+        pos = 0
+        first = True
+        while pos < len(pes):
+            chunk = pes[pos:pos + 184]
+            emit(vpid, chunk, first)
+            first = False
+            pos += 184
+    data = bytes(out)
+    if m2ts:
+        data = b"".join(b"\x00\x00\x00\x00" + data[i:i + 188]
+                        for i in range(0, len(data), 188))
+    return data
+
+
 # -- ground truth via OpenCV/FFmpeg -----------------------------------------
 
 def ffmpeg_truth(annexb: bytes, tmpdir: str, name: str):
@@ -579,6 +662,20 @@ def main(outdir: str) -> None:
                         Y=ypl2, BGR=bgr2)
     print("mixed_cavlc: FFmpeg decoded", ypl2.shape,
           "slices:", len(nals))
+
+    # ---- fixture 3: the same pictures in transport streams --------------
+    ts = mux_ts([idr], sps, pps)
+    with open(os.path.join(outdir, "gradient_ipcm.ts"), "wb") as f:
+        f.write(ts)
+    m2ts = mux_ts(nals, sps2, pps2, m2ts=True)
+    with open(os.path.join(outdir, "mixed_cavlc.m2ts"), "wb") as f:
+        f.write(m2ts)
+    for name in ("gradient_ipcm.ts", "mixed_cavlc.m2ts"):
+        cap = cv2.VideoCapture(os.path.join(outdir, name))
+        okt, _f = cap.read()
+        cap.release()
+        assert okt, f"FFmpeg refused {name}"
+    print("transport streams: ok (FFmpeg reads both)")
 
 
 if __name__ == "__main__":

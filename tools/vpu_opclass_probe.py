@@ -29,10 +29,11 @@ seconds; D2H fetch is the only real sync on this backend.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
@@ -83,7 +84,7 @@ def main() -> None:
     cvd = [jnp.asarray(a) for a in cv0]
     md = [jnp.asarray(a) for a in m0]
     rows = []
-    for iters in (64, 256):
+    for iters in (256, 1024):
         f = make(iters)
         _ = np.asarray(f(cvd, md)).ravel()[0]  # compile+warm
         dt = timed(f, cvd, md)
