@@ -25,8 +25,15 @@ from . import models
 
 
 def uuid_bytes(u: Optional[uuid.UUID] = None) -> bytes:
-    """Stable 16-byte id, like sd_utils::uuid_to_bytes."""
-    return (u or uuid.uuid4()).bytes
+    """Stable 16-byte id, like sd_utils::uuid_to_bytes. Fresh ids are
+    time-ordered (sync/crdt.uuid4_bytes, v7 layout) so bulk inserts
+    into UNIQUE pub_id B-trees append instead of churning random
+    leaves; explicit UUIDs pass through unchanged."""
+    if u is not None:
+        return u.bytes
+    from ..sync.crdt import uuid4_bytes
+
+    return uuid4_bytes()
 
 
 def now_ts() -> int:

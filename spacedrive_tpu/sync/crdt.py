@@ -52,26 +52,43 @@ class OpKind:
 
 
 def uuid4_bytes() -> bytes:
-    """Random v4 UUID as 16 bytes, without the uuid.UUID object layer.
+    """Time-ordered 16-byte id (UUIDv7 layout), cheap single mint.
 
-    ~3 µs/call cheaper than uuid4().bytes — measurable on bulk paths
-    that mint an op id per row (identifier/indexer at 1M files).
+    Name kept for call-site stability; since round 4 ids are v7-style:
+    48-bit ms timestamp + version/variant bits + a 16-bit in-batch
+    counter + 58 random bits. Bulk writers insert MILLIONS of these
+    into UNIQUE B-trees (file_path/object pub_id and the op ids) —
+    v4's uniform randomness made every insert land on a random leaf
+    (page churn measured as the dominant db_write cost at 1M files),
+    while time-prefixed ids append into a hot right-edge page.
+    Uniqueness (58 random bits per ms+counter slot) is what sync
+    correctness needs; nothing requires v4.
     """
     return uuid4_bytes_batch(1)[0]
 
 
 def uuid4_bytes_batch(n: int) -> list:
-    """n random v4 UUIDs from ONE urandom syscall — the per-call
-    getrandom(2) is measurable on paths minting an id per row
-    (identifier/indexer op logs at 1M files)."""
+    """n time-ordered ids from ONE urandom syscall (see uuid4_bytes).
+
+    A 16-bit counter spans b[6] nibble + b[7] + 4 bits of b[8], so
+    batches stay STRICTLY ordered up to 65,536 ids — past the largest
+    bulk batch (the identifier's 16,384 device step)."""
     if n <= 0:
         return []
-    blob = os.urandom(16 * n)
+    import time as _time
+
+    blob = os.urandom(8 * n)
+    ms = _time.time_ns() // 1_000_000
+    ts = ms.to_bytes(6, "big")
     out = []
-    for k in range(0, 16 * n, 16):
-        b = bytearray(blob[k:k + 16])
-        b[6] = (b[6] & 0x0F) | 0x40
-        b[8] = (b[8] & 0x3F) | 0x80
+    for i in range(n):
+        k = 8 * i
+        b = bytearray(16)
+        b[0:6] = ts
+        b[6] = 0x70 | ((i >> 12) & 0x0F)   # version 7 + counter hi
+        b[7] = (i >> 4) & 0xFF             # counter mid
+        b[8] = 0x80 | ((i & 0x0F) << 2) | (blob[k] & 0x03)  # variant+lo
+        b[9:16] = blob[k + 1:k + 8]
         out.append(bytes(b))
     return out
 
