@@ -38,6 +38,7 @@ def register_all(router: Router) -> None:
     _libraries(router)
     _volumes(router)
     _tags(router)
+    _labels(router)
     _categories(router)
     _locations(router)
     _files(router)
@@ -180,8 +181,17 @@ def _tags(r: Router) -> None:
         if tag is None:
             return None
         sync = library.sync
-        with sync.write_ops(
-                [sync.shared_delete("tag", tag["pub_id"])]) as conn:
+        # relation deletes FIRST (earlier HLC stamps): a peer holding
+        # assignments must clear them before the row delete or its
+        # FK constraint rejects the op forever (sync divergence).
+        assigned = library.db.query(
+            "SELECT o.pub_id AS opub FROM tag_on_object tob "
+            "JOIN object o ON o.id = tob.object_id WHERE tob.tag_id = ?",
+            (tag["id"],))
+        ops = [sync.relation_delete("tag_on_object", r["opub"],
+                                    tag["pub_id"]) for r in assigned]
+        ops.append(sync.shared_delete("tag", tag["pub_id"]))
+        with sync.write_ops(ops) as conn:
             conn.execute("DELETE FROM tag_on_object WHERE tag_id = ?",
                          (tag["id"],))
             library.db.delete("tag", tag["id"], conn=conn)
@@ -212,6 +222,86 @@ def _tags(r: Router) -> None:
                     "INSERT OR IGNORE INTO tag_on_object "
                     "(tag_id, object_id) VALUES (?, ?)",
                     (tag["id"], obj["id"]))
+        return None
+
+
+# -- labels. (schema.prisma:362-385 Label/LabelOnObject — the model the
+#    reference ships without an API; CRUD + assignment mirror tags.) -------
+
+def _labels(r: Router) -> None:
+    @r.query("labels.list", library=True)
+    def labels_list(node, library, _input):
+        return rows_to_dicts(library.db.query(
+            "SELECT l.*, COUNT(lo.label_id) AS object_count "
+            "FROM label l LEFT JOIN label_on_object lo "
+            "ON lo.label_id = l.id GROUP BY l.id"))
+
+    @r.query("labels.getForObject", library=True)
+    def labels_for_object(node, library, input):
+        return rows_to_dicts(library.db.query(
+            "SELECT l.* FROM label l JOIN label_on_object lo "
+            "ON lo.label_id = l.id WHERE lo.object_id = ?",
+            (int(input["object_id"]),)))
+
+    @r.mutation("labels.create", library=True, invalidates=["labels.list"])
+    def labels_create(node, library, input):
+        pub_id = uuid_bytes()
+        sync = library.sync
+        values = {"name": str(input["name"]),
+                  "date_created": int(time.time())}
+        with sync.write_ops(
+                sync.shared_create("label", pub_id, values)) as conn:
+            label_id = library.db.insert(
+                "label", {"pub_id": pub_id, **values}, conn=conn)
+        return {"id": label_id, "pub_id": pub_id.hex(), **values}
+
+    @r.mutation("labels.assign", library=True,
+                invalidates=["labels.list", "labels.getForObject"])
+    def labels_assign(node, library, input):
+        lb = library.db.query_one(
+            "SELECT * FROM label WHERE id = ?", (int(input["label_id"]),))
+        obj = library.db.query_one(
+            "SELECT * FROM object WHERE id = ?", (int(input["object_id"]),))
+        if lb is None or obj is None:
+            raise RpcError("NOT_FOUND", "label or object missing")
+        sync = library.sync
+        if input.get("unassign"):
+            ops = [sync.relation_delete(
+                "label_on_object", obj["pub_id"], lb["pub_id"])]
+            with sync.write_ops(ops) as conn:
+                conn.execute(
+                    "DELETE FROM label_on_object WHERE label_id = ? "
+                    "AND object_id = ?", (lb["id"], obj["id"]))
+        else:
+            ops = sync.relation_create(
+                "label_on_object", obj["pub_id"], lb["pub_id"],
+                {"date_created": int(time.time())})
+            with sync.write_ops(ops) as conn:
+                conn.execute(
+                    "INSERT OR IGNORE INTO label_on_object "
+                    "(label_id, object_id, date_created) VALUES (?, ?, ?)",
+                    (lb["id"], obj["id"], int(time.time())))
+        return None
+
+    @r.mutation("labels.delete", library=True, invalidates=["labels.list"])
+    def labels_delete(node, library, input):
+        lb = library.db.query_one(
+            "SELECT * FROM label WHERE id = ?", (int(input["id"]),))
+        if lb is None:
+            return None
+        sync = library.sync
+        # relation deletes first — see tags_delete (FK-safe op order)
+        assigned = library.db.query(
+            "SELECT o.pub_id AS opub FROM label_on_object lo "
+            "JOIN object o ON o.id = lo.object_id WHERE lo.label_id = ?",
+            (lb["id"],))
+        ops = [sync.relation_delete("label_on_object", r["opub"],
+                                    lb["pub_id"]) for r in assigned]
+        ops.append(sync.shared_delete("label", lb["pub_id"]))
+        with sync.write_ops(ops) as conn:
+            conn.execute("DELETE FROM label_on_object WHERE label_id = ?",
+                         (lb["id"],))
+            library.db.delete("label", lb["id"], conn=conn)
         return None
 
 

@@ -175,7 +175,54 @@ async function loadLibs() {
   }
   if (!lib && libs.length) { lib = libs[0].uuid; loadAll(); }
 }
-function loadAll() { loadLibs(); loadLocs(); loadTags(); loadStats(); render(); }
+function loadAll() {
+  loadLibs(); loadLocs(); loadTags(); loadSaved(); loadStats(); render();
+}
+
+// ---- saved searches (stored in library preferences, the reference's
+// interface persists view state the same way) --------------------------
+async function getSavedSearches() {
+  const prefs = await q("preferences.get", {library_id: lib});
+  try { return JSON.parse(prefs.saved_searches || "{}"); }
+  catch (e) { return {}; }
+}
+function putSavedSearches(saved) {
+  return mut("preferences.update", {library_id: lib,
+    values: {saved_searches: JSON.stringify(saved)}});
+}
+async function loadSaved() {
+  if (!lib) return;
+  const saved = await getSavedSearches();
+  const el = document.getElementById("saved"); el.innerHTML = "";
+  for (const [name, spec] of Object.entries(saved)) {
+    const d = document.createElement("div");
+    d.className = "item"; d.textContent = "🔖 " + name;
+    d.title = "click: run · right-click: delete";
+    d.onclick = () => {
+      document.getElementById("search").value = spec.q || "";
+      tagFilter = spec.tag ?? null;
+      kindFilter = spec.kind ?? null;
+      if (spec.loc != null) loc = spec.loc;
+      view = "explorer"; renderTabs(); render();
+    };
+    d.oncontextmenu = async (e) => {
+      e.preventDefault();
+      delete saved[name];
+      await putSavedSearches(saved);
+      loadSaved();
+    };
+    el.appendChild(d);
+  }
+}
+document.getElementById("savesearch").onclick = async () => {
+  if (!lib) return;
+  const name = prompt("name this search"); if (!name) return;
+  const saved = await getSavedSearches();
+  saved[name] = {q: document.getElementById("search").value.trim(),
+                 tag: tagFilter, kind: kindFilter, loc};
+  await putSavedSearches(saved);
+  loadSaved();
+};
 
 async function loadLocs() {
   if (!lib) return;
@@ -1033,7 +1080,8 @@ async function inspect(r) {
         `<div class="kv">note: <b>${esc(obj.note || "—")}</b></div>`;
     }
   }
-  html += `<div id="itags"></div><div id="iexif"></div>
+  html += `<div id="itags"></div><div id="ilabels"></div>
+    <div id="iexif"></div>
     <div style="margin-top:8px">
       <button id="ifav" class="ghost">${obj && obj.favorite ? "★" : "☆"} favorite</button>
       <button id="irename" class="ghost">rename</button>
@@ -1043,22 +1091,55 @@ async function inspect(r) {
     </div>`;
   el.innerHTML = html;
   if (r.object_id != null) {
+    const renderChips = (el, title, items, mineIds, onToggle, onCtx) => {
+      el.innerHTML = `<h3>${title}</h3>`;
+      for (const it of items) {
+        const chip = document.createElement("span");
+        chip.className = "tagchip" + (mineIds.has(it.id) ? " on" : "");
+        chip.textContent = it.name;
+        chip.onclick = () => onToggle(it, mineIds.has(it.id));
+        if (onCtx) chip.oncontextmenu = (ev) => {
+          ev.preventDefault(); onCtx(it);
+        };
+        el.appendChild(chip);
+      }
+      return el;
+    };
     const mine = await q("tags.getForObject",
       {library_id: lib, object_id: r.object_id});
-    const mineIds = new Set(mine.map(t => t.id));
-    const tl = document.getElementById("itags");
-    tl.innerHTML = "<h3>tags</h3>";
-    for (const t of allTags) {
-      const chip = document.createElement("span");
-      chip.className = "tagchip" + (mineIds.has(t.id) ? " on" : "");
-      chip.textContent = t.name;
-      chip.onclick = async () => {
+    renderChips(document.getElementById("itags"), "tags", allTags,
+      new Set(mine.map(t => t.id)), async (t, has) => {
         await mut("tags.assign", {library_id: lib, tag_id: t.id,
-          object_id: r.object_id, unassign: mineIds.has(t.id)});
+          object_id: r.object_id, unassign: has});
         inspect(r);
-      };
-      tl.appendChild(chip);
-    }
+      });
+    // labels (net-new surface over the schema's Label model)
+    const [allLabels, mineL] = await Promise.all([
+      q("labels.list", {library_id: lib}),
+      q("labels.getForObject", {library_id: lib,
+                                object_id: r.object_id}),
+    ]);
+    const ll = renderChips(document.getElementById("ilabels"), "labels",
+      allLabels, new Set(mineL.map(x => x.id)), async (lbl, has) => {
+        await mut("labels.assign", {library_id: lib, label_id: lbl.id,
+          object_id: r.object_id, unassign: has});
+        inspect(r);
+      }, async (lbl) => {
+        if (confirm(`delete label "${lbl.name}" everywhere?`)) {
+          await mut("labels.delete", {library_id: lib, id: lbl.id});
+          inspect(r);
+        }
+      });
+    const addl = document.createElement("span");
+    addl.className = "tagchip"; addl.textContent = "+ label";
+    addl.onclick = async () => {
+      const nm = prompt("label name"); if (!nm) return;
+      const lbl = await mut("labels.create", {library_id: lib, name: nm});
+      await mut("labels.assign", {library_id: lib, label_id: lbl.id,
+        object_id: r.object_id});
+      inspect(r);
+    };
+    ll.appendChild(addl);
     const md = await q("files.getMediaData", {library_id: lib,
                                               id: r.object_id});
     if (md) {

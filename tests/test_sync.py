@@ -374,3 +374,47 @@ async def _two_instance_sync(pair):
     a_ops = [(o.timestamp, o.typ) for o in a.get_ops(GetOpsArgs(clocks=[]))]
     b_ops = [(o.timestamp, o.typ) for o in b.get_ops(GetOpsArgs(clocks=[]))]
     assert a_ops == b_ops
+
+
+def test_tag_delete_with_assignments_syncs_fk_safe(tmp_path):
+    """Deleting a tag/label that peers have ASSIGNED must emit the
+    relation deletes ahead of the row delete — without them the peer's
+    FK constraint rejects the shared delete on every pull, forever
+    (round-4 regression, found live via two-instance repro)."""
+    import asyncio as _a
+
+    from spacedrive_tpu.api.router import mount_router
+    from spacedrive_tpu.node import Node
+
+    a = Node(str(tmp_path / "a"))
+    router = mount_router(a)
+
+    async def setup():
+        lib = a.create_library("t")
+        # one object to hang the tag on
+        oid = lib.db.insert("object", {"pub_id": uuid.uuid4().bytes,
+                                       "kind": 5})
+        tag = await router.dispatch(
+            "tags.create", {"library_id": str(lib.id), "name": "doomed"})
+        await router.dispatch("tags.assign", {
+            "library_id": str(lib.id), "tag_id": tag["id"],
+            "object_id": oid})
+        await router.dispatch("tags.delete", {
+            "library_id": str(lib.id), "id": tag["id"]})
+        return lib
+    lib = _a.run(setup())
+
+    b_db = Database(tmp_path / "b.db")
+    b_id = uuid.uuid4().bytes
+    _mk_instance(b_db, b_id)
+    b = SyncManager(b_db, b_id)
+    b.register_instance(lib.sync.instance)
+    while True:
+        ops = lib.sync.get_ops(GetOpsArgs(clocks=list(b.timestamps.items())))
+        if not ops:
+            break
+        applied, errors = b.receive_crdt_operations(ops)
+        assert not errors, errors  # the FK failure mode shows up here
+    assert b_db.query_one("SELECT COUNT(*) AS n FROM tag")["n"] == 0
+    assert b_db.query_one(
+        "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 0

@@ -500,6 +500,33 @@ def test_drive_ui_procedures(served):
                 assert (await q("tags.get", {"library_id": lid,
                         "id": tag2["id"]}))["name"] == "navy"
 
+                # ---- labels (net-new surface over the Label model) ----
+                lbl = await m("labels.create",
+                              {"library_id": lid, "name": "project-x"})
+                await m("labels.assign", {"library_id": lid,
+                        "label_id": lbl["id"], "object_id": oid})
+                for_obj = await q("labels.getForObject",
+                                  {"library_id": lid, "object_id": oid})
+                assert [x["name"] for x in for_obj] == ["project-x"]
+                lbls = await q("labels.list", {"library_id": lid})
+                assert lbls[0]["object_count"] == 1
+                await m("labels.assign", {"library_id": lid,
+                        "label_id": lbl["id"], "object_id": oid,
+                        "unassign": True})
+                assert (await q("labels.list",
+                                {"library_id": lid}))[0]["object_count"] == 0
+                await m("labels.delete",
+                        {"library_id": lid, "id": lbl["id"]})
+                assert await q("labels.list", {"library_id": lid}) == []
+
+                # ---- saved searches (preferences-backed, round 4) ----
+                await m("preferences.update", {"library_id": lid,
+                        "values": {"saved_searches":
+                                   '{"big docs": {"q": "file", '
+                                   '"tag": null, "kind": null}}'}})
+                prefs2 = await q("preferences.get", {"library_id": lid})
+                assert "big docs" in prefs2["saved_searches"]
+
                 # ---- ephemeral extras (round 4) ----
                 await m("files.createEphemeralFolder",
                         {"path": corpus, "name": "eph_made"})
