@@ -40,6 +40,13 @@ def _split_name_ext(stem: str) -> Tuple[str, str]:
     return stem[:last_dot], stem[last_dot + 1:]
 
 
+def _name_ext(base: str, is_dir: bool):
+    """The one name/extension split rule for every constructor —
+    desynchronizing walk-time and parse-time DB keys is the failure
+    this helper prevents."""
+    return (base, "") if is_dir else _split_name_ext(base)
+
+
 def _relative_to_location(location_path: str, full_path: str) -> str:
     loc = os.path.normpath(os.fspath(location_path))
     full = os.path.normpath(os.fspath(full_path))
@@ -82,11 +89,23 @@ class IsolatedPath:
             return cls(location_id, "/", True, "", "", "")
         mat = materialized_path_str(os.fspath(location_path), os.fspath(full_path))
         base = rel.rsplit("/", 1)[-1]
-        if is_dir:
-            name, ext = base, ""
-        else:
-            name, ext = _split_name_ext(base)
+        name, ext = _name_ext(base, is_dir)
         return cls(location_id, mat, is_dir, name, ext, rel)
+
+    def child(self, base: str, is_dir: bool) -> "IsolatedPath":
+        """Child entry of this DIRECTORY, derived without touching the
+        filesystem path algebra — the walker's per-dirent fast path
+        (profiling showed normpath+prefix checks in `new()` were ~40%
+        of pure walk time at 60k files; the parent's fields already
+        hold everything the child needs)."""
+        # mat comes from the IDENTITY fields (the same value
+        # materialized_path_for_children computes), never from the
+        # compare=False relative_path cache
+        mat = self.materialized_path_for_children()
+        rel = (f"{self.relative_path}/{base}" if self.relative_path
+               else base)
+        name, ext = _name_ext(base, is_dir)
+        return IsolatedPath(self.location_id, mat, is_dir, name, ext, rel)
 
     @classmethod
     def from_relative(cls, location_id: int, relative: str) -> "IsolatedPath":
@@ -102,10 +121,7 @@ class IsolatedPath:
             mat = f"/{parent}/"
         else:
             mat, base = "/", body
-        if is_dir:
-            name, ext = base, ""
-        else:
-            name, ext = _split_name_ext(base)
+        name, ext = _name_ext(base, is_dir)
         return cls(location_id, mat, is_dir, name, ext, body)
 
     @classmethod

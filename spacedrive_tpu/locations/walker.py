@@ -251,11 +251,9 @@ class Walker:
             if accept_by_children_dir is False:
                 continue
 
-            try:
-                iso = self._iso(current, is_dir)
-            except ValueError as e:
-                errors.append(str(e))
-                continue
+            # derive the child iso from the parent's fields — no
+            # normpath / prefix-check round trip per dirent
+            iso = parent_iso.child(dirent.name, is_dir)
             buffer[iso] = WalkedEntry(
                 uuid4_bytes(), iso,
                 FilePathMetadata.from_stat(current, st),
