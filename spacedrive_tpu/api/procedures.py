@@ -33,6 +33,21 @@ from .serialization import file_path_display, row_to_dict, rows_to_dicts
 BUILD_VERSION = "0.1.0"
 
 
+def _json_safe(v: Any) -> Any:
+    """Make an arbitrary extraction structure JSON-encodable: hex bytes
+    at any depth, recurse containers, stringify anything else non-JSON
+    (e.g. EXIF IFDRational)."""
+    if v is None or isinstance(v, (str, int, float, bool)):
+        return v
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v).hex()
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set)):
+        return [_json_safe(x) for x in v]
+    return str(v)
+
+
 def register_all(router: Router) -> None:
     _core(router)
     _libraries(router)
@@ -558,13 +573,11 @@ def _files(r: Router) -> None:
     @r.query("files.getEphemeralMediaData")
     def files_get_ephemeral_media_data(node, input):
         md = extract_media_data(str(input["path"]))
-        if not isinstance(md, dict):
-            return md
-        # EXIF extraction can carry raw byte blobs (maker notes,
-        # thumbnails) — hex them at the protocol boundary instead of
-        # blowing up JSON encoding.
-        return {k: (v.hex() if isinstance(v, (bytes, bytearray)) else v)
-                for k, v in md.items()}
+        # EXIF extraction carries raw byte blobs (maker notes,
+        # thumbnails) and rationals nested at ANY depth (IFD sub-dicts,
+        # rational arrays) — sanitize recursively at the protocol
+        # boundary instead of blowing up JSON encoding.
+        return _json_safe(md)
 
     @r.mutation("files.setNote", library=True, invalidates=["search.objects"])
     def files_set_note(node, library, input):

@@ -80,18 +80,16 @@ def _sharded_reduce(words, length, n_tops, base_lo, base_hi, *,
     (single-call digest); root=False yields a streaming window's
     subtree-top CV.
     """
-    from jax.experimental.shard_map import shard_map
-
     def inner(words_local):
         top = _shard_fn(words_local, length, shard_chunks,
                         base_lo, base_hi)
         return jax.lax.all_gather(top, "data")  # [D, 8] replicated
 
-    tops = shard_map(
+    tops = jax.shard_map(
         inner, mesh=mesh,
         in_specs=(P("data", None),),
         out_specs=P(None, None),
-        check_rep=False,
+        check_vma=False,
     )(words)
     # Top-of-tree: adjacent pairing over shard tops.
     cvs = [tops[:, i][None, :] for i in range(8)]  # 8 × [1, D]

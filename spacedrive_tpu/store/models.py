@@ -128,6 +128,32 @@ register(Model(
         _id(),
         Field("timestamp", "INTEGER", nullable=False),
         Field("data", "BLOB", nullable=False),  # packed CRDTOperation
+        # Referenced (target model, packed sync id) pairs, denormalized
+        # at park time so a shared delete purges dead parked ops with
+        # one indexed DELETE instead of unpacking the whole table.
+        # Nullable: rows parked by an older schema lack them and fall
+        # back to the drain-time tombstone check.
+        Field("item_model", "TEXT"),
+        Field("item_key", "BLOB"),
+        Field("group_model", "TEXT"),
+        Field("group_key", "BLOB"),
+    ),
+    indexes=(("timestamp",), ("item_model", "item_key"),
+             ("group_model", "group_key")),
+))
+
+# Ops this node's schema cannot apply (unknown model — version skew
+# with a newer peer): quarantined instead of dropped, because the
+# watermark advances past them and get_ops would never re-serve them.
+# SyncManager.drain_quarantined_ops re-ingests after a schema upgrade
+# teaches the registry the model.
+register(Model(
+    "quarantined_op",
+    (
+        _id(),
+        Field("op_id", "BLOB", nullable=False, unique=True),
+        Field("timestamp", "INTEGER", nullable=False),
+        Field("data", "BLOB", nullable=False),  # packed CRDTOperation
     ),
     indexes=(("timestamp",),),
 ))
@@ -346,6 +372,9 @@ register(Model(
     ),
     sync=SyncMode.RELATION,
     relation=("object_id", "tag_id"),  # (item, group) like the reference
+    # object_id is the composite PK's SECOND column — the apply-side
+    # delete cascade's WHERE object_id = ? needs its own index.
+    indexes=(("object_id",),),
 ))
 
 register(Model(
@@ -372,6 +401,7 @@ register(Model(
     ),
     sync=SyncMode.RELATION,
     relation=("object_id", "label_id"),
+    indexes=(("object_id",),),
 ))
 
 # --- Space / Album (schema.prisma:389-411, 448-477): object groupings.
@@ -400,6 +430,7 @@ register(Model(
               references="object(id)"),
     ),
     sync=SyncMode.LOCAL,
+    indexes=(("object_id",),),
 ))
 
 register(Model(
@@ -425,6 +456,7 @@ register(Model(
         Field("date_created", "INTEGER"),
     ),
     sync=SyncMode.LOCAL,
+    indexes=(("object_id",),),
 ))
 
 # --- Jobs (@local, schema.prisma:415-441; self-relation for chains). ------

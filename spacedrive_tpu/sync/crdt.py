@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import enum
 import os
+import threading
 import uuid as uuidlib
 from dataclasses import dataclass
 from typing import Any, Optional, Union
@@ -67,27 +68,44 @@ def uuid4_bytes() -> bytes:
     return uuid4_bytes_batch(1)[0]
 
 
+_uuid_state = [0, 0]  # [last_ms, next_counter] — shared across calls
+_uuid_lock = threading.Lock()  # ids mint from job threads too
+
+
 def uuid4_bytes_batch(n: int) -> list:
     """n time-ordered ids from ONE urandom syscall (see uuid4_bytes).
 
     A 16-bit counter spans b[6] nibble + b[7] + 4 bits of b[8], so
     batches stay STRICTLY ordered up to 65,536 ids — past the largest
-    bulk batch (the identifier's 16,384 device step)."""
+    bulk batch (the identifier's 16,384 device step). The counter is
+    MODULE state continuing across calls within one millisecond
+    (resetting on ms change): two batches minted back-to-back in the
+    same ms (object pub_ids then op ids in one identifier chunk) occupy
+    disjoint, ordered counter slots instead of colliding at 0. Past
+    65,536 ids/ms the counter wraps and uniqueness rests on the 58
+    random bits — still 2^58 per slot."""
     if n <= 0:
         return []
     import time as _time
 
     blob = os.urandom(8 * n)
-    ms = _time.time_ns() // 1_000_000
+    with _uuid_lock:
+        ms = _time.time_ns() // 1_000_000
+        if ms != _uuid_state[0]:
+            _uuid_state[0] = ms
+            _uuid_state[1] = 0
+        base = _uuid_state[1]
+        _uuid_state[1] = (base + n) & 0xFFFF
     ts = ms.to_bytes(6, "big")
     out = []
     for i in range(n):
         k = 8 * i
+        c = (base + i) & 0xFFFF
         b = bytearray(16)
         b[0:6] = ts
-        b[6] = 0x70 | ((i >> 12) & 0x0F)   # version 7 + counter hi
-        b[7] = (i >> 4) & 0xFF             # counter mid
-        b[8] = 0x80 | ((i & 0x0F) << 2) | (blob[k] & 0x03)  # variant+lo
+        b[6] = 0x70 | ((c >> 12) & 0x0F)   # version 7 + counter hi
+        b[7] = (c >> 4) & 0xFF             # counter mid
+        b[8] = 0x80 | ((c & 0x0F) << 2) | (blob[k] & 0x03)  # variant+lo
         b[9:16] = blob[k + 1:k + 8]
         out.append(bytes(b))
     return out

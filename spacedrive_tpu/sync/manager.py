@@ -59,6 +59,9 @@ class SyncManager:
         self.timestamps: Dict[bytes, int] = {}
         self._sync_indexes_ready = False
         self._load_instances()
+        # Re-ingest ops quarantined by an OLDER schema (one cheap
+        # SELECT when the table is empty — the common case).
+        self.drain_quarantined_ops()
 
     def _ensure_sync_indexes(self) -> None:
         """Build the op-log read indexes on first sync use — they are
@@ -386,9 +389,31 @@ class SyncManager:
         applied = 0
         errors: List[str] = []
         ts_max: Dict[bytes, int] = {}
+        failed: set = set()
         with self.db.tx() as conn:
             for op in ops:
                 self.clock.update_with_timestamp(op.timestamp)
+                # Poison-op triage BEFORE the try: an op this schema can
+                # NEVER apply (unknown model — version skew with a newer
+                # peer) must not freeze the watermark, or every future
+                # pull from that instance re-serves the same poison page
+                # and sync silently stops. But the watermark advancing
+                # past it means get_ops will never re-serve it either —
+                # so the op is QUARANTINED, not dropped: after a schema
+                # upgrade, drain_quarantined_ops re-ingests it.
+                reason = self._op_permanently_inapplicable(op)
+                if reason is not None:
+                    conn.execute(
+                        "INSERT OR IGNORE INTO quarantined_op "
+                        "(op_id, timestamp, data) VALUES (?, ?, ?)",
+                        (op.id, op.timestamp, op.pack()))
+                    errors.append(
+                        f"ingest {op.typ!r}: quarantined: {reason}")
+                    if op.instance not in failed:
+                        ts_max[op.instance] = max(
+                            self.timestamps.get(op.instance, op.timestamp),
+                            ts_max.get(op.instance, 0), op.timestamp)
+                    continue
                 try:
                     if not self._compare_message(op):
                         conn.execute("SAVEPOINT ingest_op")
@@ -402,10 +427,18 @@ class SyncManager:
                             conn.execute("RELEASE SAVEPOINT ingest_op")
                         applied += 1
                 except Exception as e:  # noqa: BLE001 — per-op guard
-                    # NO watermark advance for a failed op — advancing
-                    # would make get_ops never re-serve it (silent
-                    # divergence); the next pull retries it.
+                    # FREEZE this instance's watermark at its last
+                    # successfully processed timestamp: a later op from
+                    # the same instance in this page would otherwise
+                    # advance ts_max past the failure, and get_ops would
+                    # never re-serve the failed op (silent divergence).
+                    # The frozen watermark makes the next pull re-request
+                    # from before the failure; already-applied later ops
+                    # are stale on redelivery (_compare_message).
                     errors.append(f"ingest {op.typ!r}: {e}")
+                    failed.add(op.instance)
+                    continue
+                if op.instance in failed:
                     continue
                 # watermark moves only past applied-or-stale ops
                 ts_max[op.instance] = max(
@@ -417,6 +450,48 @@ class SyncManager:
                     (ts, pub))
         self.timestamps.update(ts_max)
         return applied, errors
+
+    def drain_quarantined_ops(self) -> int:
+        """Re-ingest ops a previous (older) schema quarantined as
+        unknown-model. Called at manager init: after an upgrade the
+        registry knows the model and the ops apply; still-unknown ones
+        stay quarantined for the next upgrade. Returns drained count."""
+        rows = self.db.query(
+            "SELECT id, data FROM quarantined_op ORDER BY timestamp")
+        drained = 0
+        for row in rows:
+            op = CRDTOperation.unpack(row["data"])
+            if self._op_permanently_inapplicable(op) is not None:
+                continue
+            _, errs = self.receive_crdt_operations([op])
+            if not errs:
+                self.db.execute(
+                    "DELETE FROM quarantined_op WHERE id = ?", (row["id"],))
+                drained += 1
+        return drained
+
+    def _op_permanently_inapplicable(self, op: CRDTOperation
+                                     ) -> Optional[str]:
+        """Reason string when no retry can EVER apply this op here:
+        the model/relation is absent from this node's registry or has
+        the wrong sync mode (version skew with a newer peer). Unknown
+        FIELDS on a known model are not poison — the apply paths skip
+        them (additive-migration tolerance). Conservative: anything
+        else returns None and failures stay transient (freeze+retry)."""
+        t = op.typ
+        if isinstance(t, SharedOp):
+            model = M.MODELS.get(t.model)
+            if model is None:
+                return f"unknown model {t.model!r}"
+            if model.sync != M.SyncMode.SHARED:
+                return f"model {t.model!r} is not shared-synced"
+        else:
+            model = M.MODELS.get(t.relation)
+            if model is None:
+                return f"unknown relation {t.relation!r}"
+            if model.sync != M.SyncMode.RELATION or not model.relation:
+                return f"model {t.relation!r} is not relation-synced"
+        return None
 
     def _compare_message(self, op: CRDTOperation) -> bool:
         """LWW check: is there an op in the log at or after this one for
@@ -489,11 +564,26 @@ class SyncManager:
         else:
             if self._apply_relation(conn, t, op.timestamp):
                 self._insert_op_row(conn, op, remote_id)
+            elif self._relation_target_tombstoned(conn, t):
+                # The referenced record was DELETED (op-log tombstone)
+                # and pub_ids are unique mints — the row can never
+                # materialize, so parking would sit in
+                # pending_relation_op forever (the arrival order the
+                # delete-time purge cannot cover). Drop: the delete
+                # already won LWW.
+                pass
             else:
+                rmodel = M.MODELS[t.relation]
+                item_f, group_f = rmodel.relation
                 conn.execute(
                     "INSERT INTO pending_relation_op "
-                    "(timestamp, data) VALUES (?, ?)",
-                    (op.timestamp, op.pack()))
+                    "(timestamp, data, item_model, item_key, "
+                    "group_model, group_key) VALUES (?, ?, ?, ?, ?, ?)",
+                    (op.timestamp, op.pack(),
+                     _fk_target(rmodel.field(item_f)),
+                     pack_value(t.item_id),
+                     _fk_target(rmodel.field(group_f)),
+                     pack_value(t.group_id)))
 
     def _drain_pending_relations(self, conn) -> None:
         """Retry parked relation ops; applied ones graduate to the op
@@ -513,6 +603,27 @@ class SyncManager:
                 self._insert_op_row(conn, op, remote_id)
                 conn.execute("DELETE FROM pending_relation_op "
                              "WHERE id = ?", (row["id"],))
+            elif self._relation_target_tombstoned(conn, t):
+                conn.execute("DELETE FROM pending_relation_op "
+                             "WHERE id = ?", (row["id"],))
+
+    def _relation_target_tombstoned(self, conn, t: RelationOp) -> bool:
+        """True when either record a relation op references has a
+        delete ('d') tombstone in the shared op log — it can never be
+        re-created (pub_ids are unique mints), so the op is dead."""
+        model = M.MODELS[t.relation]
+        item_f, group_f = model.relation
+        for rid, tbl in ((t.item_id, _fk_target(model.field(item_f))),
+                         (t.group_id, _fk_target(model.field(group_f)))):
+            if tbl is None:
+                continue
+            row = conn.execute(
+                "SELECT 1 FROM shared_operation WHERE model = ? AND "
+                "record_id = ? AND kind = 'd' LIMIT 1",
+                (tbl, pack_value(rid))).fetchone()
+            if row is not None:
+                return True
+        return False
 
     def _superseding_update_fields(self, conn, t: SharedOp,
                                    ts: Optional[int]) -> set:
@@ -537,12 +648,61 @@ class SyncManager:
         assert model.sync == M.SyncMode.SHARED, t.model
         sync_col = model.sync_id[0]
         if t.delete:
+            # Cascade EVERY local FK referencing the doomed row FIRST:
+            # the emitting peer only minted relation-delete ops for
+            # assignments in ITS db (api tags.delete), so a
+            # concurrently-created, not-yet-synced assignment on THIS
+            # peer — or a purely local reference like file_path.object_id
+            # or object_in_album — would fail the row delete on FK
+            # violation, and the op would never succeed on any retry
+            # (permanent divergence). Policy: nullable FK columns are
+            # SET NULL, non-nullable referencing rows are deleted. The
+            # row delete wins LWW over any concurrent assignment anyway,
+            # so this is the converged state.
+            local = self._resolve_fk(conn, t.model, t.record_id)
+            if local is not None:
+                for rname, rmodel in M.MODELS.items():
+                    for f in rmodel.fields:
+                        if _fk_target(f) != t.model:
+                            continue
+                        if f.on_delete:
+                            # DDL ON DELETE CASCADE / SET NULL fires on
+                            # the row delete below — a manual SET NULL
+                            # here would DETACH rows the DDL cascade is
+                            # about to delete (e.g. file_path.location_id
+                            # is nullable AND CASCADE), diverging from
+                            # the emitting peer's local cascade.
+                            continue
+                        if f.nullable:
+                            conn.execute(
+                                f"UPDATE {rname} SET {f.name} = NULL "
+                                f"WHERE {f.name} = ?", (local,))
+                        else:
+                            conn.execute(
+                                f"DELETE FROM {rname} WHERE {f.name} = ?",
+                                (local,))
+            # Purge parked relation ops referencing the deleted record:
+            # their referenced row can never materialize again (pub_ids
+            # are unique mints), so they would sit in pending_relation_op
+            # forever and tax every future drain scan. One indexed
+            # DELETE via the denormalized ref columns; rows parked by an
+            # older schema (NULL refs) are caught by the drain-time
+            # tombstone check instead.
+            key = pack_value(t.record_id)
+            conn.execute(
+                "DELETE FROM pending_relation_op WHERE "
+                "(item_model = ? AND item_key = ?) OR "
+                "(group_model = ? AND group_key = ?)",
+                (t.model, key, t.model, key))
             conn.execute(
                 f"DELETE FROM {t.model} WHERE {sync_col} = ?", (t.record_id,))
             return
 
         def write_field(name: str, raw_value: Any) -> None:
-            f = model.field(name)  # registry guard before SQL
+            try:
+                f = model.field(name)  # registry guard before SQL
+            except KeyError:
+                return  # newer peer's field this schema lacks — skip
             value = raw_value
             target = _fk_target(f)
             if target is not None and \
@@ -634,7 +794,10 @@ class SyncManager:
         def write_field(name: str, raw_value: Any) -> None:
             # Validate the wire-controlled field name against the registry
             # before it reaches SQL (same guard as _apply_shared).
-            f = model.field(name)
+            try:
+                f = model.field(name)
+            except KeyError:
+                return  # newer peer's field this schema lacks — skip
             conn.execute(
                 f"UPDATE {t.relation} SET {f.name} = ? WHERE {where}",
                 (raw_value, item_local, group_local))

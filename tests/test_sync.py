@@ -418,3 +418,237 @@ def test_tag_delete_with_assignments_syncs_fk_safe(tmp_path):
     assert b_db.query_one("SELECT COUNT(*) AS n FROM tag")["n"] == 0
     assert b_db.query_one(
         "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 0
+
+
+def test_transient_failure_freezes_instance_watermark(pair):
+    """A transiently-failed op must freeze its instance's watermark at
+    the last success: if a LATER op from the same instance in the same
+    page advanced ts_max past the failure, get_ops would never re-serve
+    the failed op (round-5 advisor finding — silent divergence)."""
+    from spacedrive_tpu.sync.crdt import SharedOp
+
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    good1 = a.shared_create("tag", pub, {"name": "t"})[0]
+    # An unbindable SQL value stands in for a transient apply failure
+    # (disk/lock/encoding trouble): known model, apply raises.
+    bad = CRDTOperation(a.instance, a.clock.new_timestamp(),
+                        uuid.uuid4().bytes,
+                        SharedOp("tag", pub, "name", {"not": "bindable"}))
+    good2 = CRDTOperation(a.instance, a.clock.new_timestamp(),
+                          uuid.uuid4().bytes,
+                          SharedOp("tag", pub, "name", "v2"))
+    applied, errors = b.receive_crdt_operations([good1, bad, good2])
+    assert applied == 2 and len(errors) == 1
+    # Watermark froze at good1 — the next pull's clock re-requests from
+    # before the failure, so the failed op gets retried.
+    assert b.timestamps[a.instance] == good1.timestamp
+
+
+def test_poison_op_is_dropped_without_freezing(pair):
+    """An op that can NEVER apply (unknown model — version skew with a
+    newer peer) must NOT freeze the watermark: freezing would re-serve
+    the same poison page on every pull and silently halt sync with that
+    instance. It is recorded as an error and skipped past."""
+    from spacedrive_tpu.sync.crdt import SharedOp
+
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    good1 = a.shared_create("tag", pub, {"name": "t"})[0]
+    poison = CRDTOperation(a.instance, a.clock.new_timestamp(),
+                           uuid.uuid4().bytes,
+                           SharedOp("no_such_model", pub, "x", 1))
+    good2 = CRDTOperation(a.instance, a.clock.new_timestamp(),
+                          uuid.uuid4().bytes,
+                          SharedOp("tag", pub, "name", "v2"))
+    applied, errors = b.receive_crdt_operations([good1, poison, good2])
+    assert applied == 2 and len(errors) == 1
+    assert "quarantined" in errors[0]
+    # Watermark advanced PAST the poison op — sync keeps flowing — but
+    # the op is preserved for post-schema-upgrade recovery, not dropped.
+    assert b.timestamps[a.instance] == good2.timestamp
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM quarantined_op")["n"] == 1
+
+
+def test_quarantined_op_drains_after_schema_upgrade(pair):
+    """An op quarantined by an older schema must re-ingest once the
+    registry knows its model: simulated by quarantining a VALID op
+    directly and constructing a fresh SyncManager (init drains)."""
+    from spacedrive_tpu.sync import SyncManager as SM
+
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    op = a.shared_create("tag", pub, {"name": "from-the-future"})[0]
+    b.db.execute(
+        "INSERT INTO quarantined_op (op_id, timestamp, data) "
+        "VALUES (?, ?, ?)", (op.id, op.timestamp, op.pack()))
+    b2 = SM(b.db, b.instance)  # "restart after upgrade"
+    row = b2.db.query_one("SELECT name FROM tag WHERE pub_id = ?", (pub,))
+    assert row is not None and row["name"] == "from-the-future"
+    assert b2.db.query_one(
+        "SELECT COUNT(*) AS n FROM quarantined_op")["n"] == 0
+
+
+def test_location_delete_cascade_matches_emitter(pair):
+    """Applying a synced location delete must let the DDL ON DELETE
+    CASCADE delete the file_path rows — a manual SET NULL would detach
+    them first, leaving B with orphans A doesn't have (round-5 review
+    finding on the apply-side cascade)."""
+    a, b = pair
+    loc_pub, fp_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    ops = a.shared_create("location", loc_pub, {"name": "l", "path": "/x"})
+    with a.write_ops(ops) as conn:
+        a.db.insert("location", {"pub_id": loc_pub, "name": "l",
+                                 "path": "/x"}, conn=conn)
+    fp_ops = a.shared_create("file_path", fp_pub, {
+        "location_id": loc_pub, "materialized_path": "/", "name": "f",
+        "extension": "", "is_dir": 0})
+    loc_id = a.db.query_one(
+        "SELECT id FROM location WHERE pub_id = ?", (loc_pub,))["id"]
+    with a.write_ops(fp_ops) as conn:
+        a.db.insert("file_path", {
+            "pub_id": fp_pub, "location_id": loc_id,
+            "materialized_path": "/", "name": "f", "extension": "",
+            "is_dir": 0}, conn=conn)
+    for op in ops + fp_ops:
+        assert b.receive_crdt_operation(op)
+    assert b.db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"] == 1
+    assert b.receive_crdt_operation(a.shared_delete("location", loc_pub))
+    # DDL cascade deleted the rows — no NULL-orphaned file_paths.
+    assert b.db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"] == 0
+
+
+def test_relation_op_after_delete_is_dropped_not_parked(pair):
+    """An assignment op arriving AFTER the shared delete of its group
+    (partitioned-peer arrival order) must be discarded via the op-log
+    tombstone, not parked forever in pending_relation_op (round-5
+    review finding)."""
+    a, b = pair
+    tag_pub, obj_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    setup = a.shared_create("tag", tag_pub, {"name": "t"}) + \
+        a.shared_create("object", obj_pub, {"kind": 5})
+    # Assignment minted BEFORE the delete (older HLC stamp) but
+    # delivered after it — the partitioned-peer interleaving.
+    late_assign = a.relation_create("tag_on_object", obj_pub, tag_pub)
+    delete = a.shared_delete("tag", tag_pub)
+    applied, errors = b.receive_crdt_operations(setup + [delete])
+    assert not errors
+    applied2, errors2 = b.receive_crdt_operations(late_assign)
+    assert not errors2
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 0
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 0
+
+
+def test_unknown_fields_are_skipped_not_poison(pair):
+    """A multi-field update carrying a field this schema lacks (newer
+    peer, additive migration) applies its KNOWN fields and drops the
+    unknown one — neither failing the op nor freezing the watermark."""
+    a, b = pair
+    pub = uuid.uuid4().bytes
+    create = a.shared_create("tag", pub, {"name": "t"})
+    with a.write_ops(create):
+        pass
+    fut = a.shared_multi_update("tag", pub, {
+        "name": "renamed", "field_from_the_future": 7})
+    applied, errors = b.receive_crdt_operations(create + [fut])
+    assert applied == len(create) + 1 and not errors
+    row = b.db.query_one("SELECT name FROM tag WHERE pub_id = ?", (pub,))
+    assert row["name"] == "renamed"
+
+
+def test_shared_delete_cascades_unsynced_assignments(pair):
+    """A peer holding a concurrently-created, NOT-yet-synced assignment
+    must still apply a shared tag delete: the emitter only minted
+    relation deletes for assignments in ITS db, so the apply side
+    cascades local relation rows first (round-5 advisor finding — the
+    FK violation would otherwise reject the delete op forever)."""
+    a, b = pair
+    tag_pub = uuid.uuid4().bytes
+    create = a.shared_create("tag", tag_pub, {"name": "doomed"})
+    with a.write_ops(create):
+        pass
+    for op in create:
+        assert b.receive_crdt_operation(op)
+    # B-local assignment A never hears about:
+    oid = b.db.insert("object", {"pub_id": uuid.uuid4().bytes, "kind": 5})
+    tag_row = b.db.query_one(
+        "SELECT id FROM tag WHERE pub_id = ?", (tag_pub,))
+    b.db.insert("tag_on_object",
+                {"tag_id": tag_row["id"], "object_id": oid})
+    assert b.receive_crdt_operation(a.shared_delete("tag", tag_pub))
+    assert b.db.query_one("SELECT COUNT(*) AS n FROM tag")["n"] == 0
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 0
+
+
+def test_shared_object_delete_nulls_file_path_and_clears_albums(pair):
+    """The apply-side cascade covers EVERY local FK, not just synced
+    relations: deleting an object must SET NULL the nullable
+    file_path.object_id link and delete non-nullable object_in_album /
+    object_in_space rows — all of which are local-only state the
+    emitting peer cannot know about (round-5 review finding)."""
+    a, b = pair
+    obj_pub = uuid.uuid4().bytes
+    create = a.shared_create("object", obj_pub, {"kind": 5})
+    with a.write_ops(create):
+        pass
+    for op in create:
+        assert b.receive_crdt_operation(op)
+    oid = b.db.query_one(
+        "SELECT id FROM object WHERE pub_id = ?", (obj_pub,))["id"]
+    loc = b.db.insert("location", {"pub_id": uuid.uuid4().bytes,
+                                   "name": "l", "path": "/x"})
+    fp = b.db.insert("file_path", {
+        "pub_id": uuid.uuid4().bytes, "location_id": loc,
+        "materialized_path": "/", "name": "f", "extension": "",
+        "is_dir": 0, "object_id": oid})
+    album = b.db.insert("album", {"pub_id": uuid.uuid4().bytes,
+                                  "name": "al"})
+    b.db.insert("object_in_album", {"album_id": album, "object_id": oid})
+    assert b.receive_crdt_operation(a.shared_delete("object", obj_pub))
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM object")["n"] == 0
+    assert b.db.query_one(
+        "SELECT object_id FROM file_path WHERE id = ?",
+        (fp,))["object_id"] is None
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM object_in_album")["n"] == 0
+
+
+def test_shared_delete_purges_parked_relation_ops(pair):
+    """A parked assignment op whose group row gets DELETED can never
+    drain (pub_ids are unique mints) — the delete purges it so
+    pending_relation_op doesn't grow without bound (round-5 review
+    finding)."""
+    a, b = pair
+    tag_pub, obj_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    # B knows the object but NOT the tag → assignment op parks.
+    oc = a.shared_create("object", obj_pub, {"kind": 5})
+    with a.write_ops(oc):
+        pass
+    for op in oc:
+        assert b.receive_crdt_operation(op)
+    rel = a.relation_create("tag_on_object", obj_pub, tag_pub)
+    b.receive_crdt_operations(rel)
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 1
+    assert b.receive_crdt_operation(a.shared_delete("tag", tag_pub))
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 0
+
+
+def test_uuid_batches_same_ms_stay_disjoint_and_ordered():
+    """Back-to-back batches (object pub_ids then op ids in one chunk)
+    must occupy disjoint, ordered counter slots — the module-level
+    counter continues within a millisecond instead of restarting at 0
+    (round-5 advisor finding)."""
+    from spacedrive_tpu.sync.crdt import uuid4_bytes_batch
+
+    x = uuid4_bytes_batch(100)
+    y = uuid4_bytes_batch(100)
+    ids = x + y
+    assert len(set(ids)) == 200
+    assert ids == sorted(ids)
