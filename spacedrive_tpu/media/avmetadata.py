@@ -44,31 +44,68 @@ class StreamMetadata:
 
 def probeable_extensions() -> set:
     """Audio/video extensions probe_media can actually read in THIS
-    runtime: everything when ffprobe exists, else just the self-hosted
-    parsers' formats — keeps the media job from re-probing thousands of
-    deterministically-unreadable files on every run."""
+    runtime: everything when ffprobe exists, all video plus the
+    self-hosted audio formats when cv2's bundled libavcodec exists,
+    else just the self-hosted parsers' formats — keeps the media job
+    from re-probing thousands of deterministically-unreadable files on
+    every run."""
     from .audio import AUDIO_EXTENSIONS, _PARSERS
-    from .video import VIDEO_EXTENSIONS
+    from .video import VIDEO_EXTENSIONS, cv2_available
 
     if ffmpeg_available():
         return set(AUDIO_EXTENSIONS) | set(VIDEO_EXTENSIONS)
+    if cv2_available():
+        return set(_PARSERS) | set(VIDEO_EXTENSIONS)
     return set(_PARSERS)
 
 
+def _cv2_stream_metadata(path: str) -> Optional[StreamMetadata]:
+    """Video-stream facts via cv2's bundled libavcodec (duration, fps,
+    dimensions) for containers the self-hosted parsers can't read —
+    the metadata twin of the cv2 thumbnail backend."""
+    from .video import VIDEO_EXTENSIONS, cv2_probe
+
+    import os
+
+    ext = os.path.splitext(path)[1].lstrip(".").lower()
+    if ext not in VIDEO_EXTENSIONS:
+        return None
+    info = cv2_probe(path)
+    if not info:
+        return None
+    md = StreamMetadata()
+    md.duration_seconds = info.get("duration_seconds")
+    md.width = info.get("width")
+    md.height = info.get("height")
+    md.fps = info.get("fps")
+    return md
+
+
 def probe_media(path: str) -> Optional[StreamMetadata]:
-    """ffprobe (when installed) else the self-hosted parsers →
-    StreamMetadata; None when neither can read the container."""
+    """ffprobe (when installed), else the self-hosted parsers with a
+    cv2 fallback for video containers they can't read → StreamMetadata;
+    None when nothing can read the container."""
     if not ffmpeg_available():
         from .audio import parse_stream_info
 
         info = parse_stream_info(path)
         if info is None:
-            return None
+            return _cv2_stream_metadata(path)
         md = StreamMetadata()
         for k, v in info.items():
             # Parser keys are the dataclass fields; a mismatch is a bug,
             # not something to silently drop.
             setattr(md, k, v)
+        if md.width is None and md.duration_seconds is None:
+            # Parser read the container but got no stream facts (e.g. a
+            # codec it can't inspect) — decode-probe with cv2 and MERGE:
+            # the parser's container facts (format_name, brand, codecs)
+            # must survive alongside cv2's dimensions/duration/fps.
+            cv = _cv2_stream_metadata(path)
+            if cv is not None:
+                for name in ("duration_seconds", "width", "height", "fps"):
+                    if getattr(md, name) is None:
+                        setattr(md, name, getattr(cv, name))
         return md
     try:
         out = subprocess.run(

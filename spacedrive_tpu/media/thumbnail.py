@@ -42,11 +42,11 @@ def thumbnailable_extensions() -> set:
     thumbnail without any decoder; files without one degrade to None)."""
     from .rawpreview import RAW_TIFF_EXTENSIONS
     from .video import (_COVER_EXTENSIONS, _H264_TS_EXTENSIONS,
-                        VIDEO_EXTENSIONS, available)
+                        VIDEO_EXTENSIONS, available, cv2_available)
 
     exts = (set(THUMBNAILABLE_EXTENSIONS) | set(_COVER_EXTENSIONS)
             | RAW_TIFF_EXTENSIONS | set(_H264_TS_EXTENSIONS))
-    if available():
+    if available() or cv2_available():
         exts |= VIDEO_EXTENSIONS
     return exts
 

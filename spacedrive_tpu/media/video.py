@@ -1,16 +1,24 @@
-"""Video thumbnailing: ffmpeg CLI when present, self-hosted MJPEG-AVI
-always.
+"""Video thumbnailing: any-codec decode via ffmpeg CLI or OpenCV's
+bundled libavcodec, self-hosted parsers as the library-free floor.
 
 The reference's sd-ffmpeg crate drives raw ffmpeg FFI: seek to 10% of
 the stream, decode one frame, scale, encode webp
 (/root/reference/crates/ffmpeg/src/thumbnailer.rs:11-161,
-movie_decoder.rs:32). This runtime image ships no ffmpeg binary or
-libraries, so the same contract is implemented over the `ffmpeg`/
-`ffprobe` CLIs when present — and for Motion-JPEG `.avi` files the
-container is parsed directly (media/mjpeg.py) so the video-thumbnail
-path actually executes here: seek to the frame at 10%, decode the JPEG
-with PIL, scale, encode webp. Other codecs degrade to None without
-ffmpeg, exactly like the reference degrades on MovieDecoder errors.
+movie_decoder.rs:32). Here the same contract runs through a chain of
+decode backends, best available first:
+
+1. `ffmpeg`/`ffprobe` CLIs when installed;
+2. `cv2.VideoCapture` — OpenCV wheels bundle libavcodec, so this is
+   the moral equivalent of the reference linking ffmpeg: CABAC
+   Main/High H.264, HEVC, VP9, and everything else its ffmpeg build
+   decodes (committed fixtures in tests/fixtures/video exercise it);
+3. the self-hosted from-spec decoders — MJPEG-AVI (media/mjpeg.py)
+   and baseline-CAVLC H.264 in MP4/TS (media/h264.py, mpegts.py) —
+   which keep the path alive with no media library at all;
+4. embedded cover art (MP4 `covr`, Matroska attachments).
+
+A codec nothing in the chain handles degrades to None, exactly like
+the reference degrades on MovieDecoder errors.
 """
 
 from __future__ import annotations
@@ -39,18 +47,57 @@ def available() -> bool:
             and shutil.which("ffprobe") is not None)
 
 
+@lru_cache(maxsize=1)
+def cv2_available() -> bool:
+    """OpenCV with its bundled ffmpeg videoio — the default any-codec
+    decode backend when no ffmpeg CLI is installed."""
+    try:
+        import cv2  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def cv2_probe(path: str) -> Optional[dict]:
+    """Container probe via cv2: duration / fps / dimensions / frames.
+    Returns None when cv2 is absent or cannot open the file."""
+    if not cv2_available():
+        return None
+    import cv2
+
+    cap = cv2.VideoCapture(path)
+    try:
+        if not cap.isOpened():
+            return None
+        fps = cap.get(cv2.CAP_PROP_FPS) or 0.0
+        frames = cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0.0
+        out = {
+            "width": int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)) or None,
+            "height": int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)) or None,
+            "fps": round(fps, 3) or None,
+            "frame_count": int(frames) or None,
+            "duration_seconds": (round(frames / fps, 3)
+                                 if fps > 0 and frames > 0 else None),
+        }
+        return out if any(v for v in out.values()) else None
+    finally:
+        cap.release()
+
+
 def probe_duration(path: str) -> Optional[float]:
     """Container duration in seconds, or None."""
-    if not available():
-        return None
-    try:
-        out = subprocess.run(
-            ["ffprobe", "-v", "quiet", "-print_format", "json",
-             "-show_format", path],
-            capture_output=True, timeout=30, check=True)
-        return float(json.loads(out.stdout)["format"]["duration"])
-    except Exception:
-        return None
+    if available():
+        try:
+            out = subprocess.run(
+                ["ffprobe", "-v", "quiet", "-print_format", "json",
+                 "-show_format", path],
+                capture_output=True, timeout=30, check=True)
+            return float(json.loads(out.stdout)["format"]["duration"])
+        except Exception:
+            return None
+    info = cv2_probe(path)
+    return info.get("duration_seconds") if info else None
 
 
 def _mjpeg_thumbnail(input_path: str, out_path: str,
@@ -114,6 +161,46 @@ def _h264_thumbnail(input_path: str, out_path: str,
         return None
 
 
+def _cv2_thumbnail(input_path: str, out_path: str,
+                   target_px: float) -> Optional[str]:
+    """Decode the frame at 10% with cv2's bundled libavcodec and webp
+    it — the any-codec backend (CABAC H.264, HEVC, VP9, ...) mirroring
+    the reference's ffmpeg link (movie_decoder.rs:32). Seeks by frame
+    index when the container reports a frame count (cheap on the tiny
+    GOPs real files have), else falls back to reading the first frame.
+    Returns None when cv2 is absent or its ffmpeg can't decode the
+    stream — the caller continues down the self-hosted chain."""
+    if not cv2_available():
+        return None
+    import cv2
+    from PIL import Image
+
+    from .thumbnail import encode_webp
+
+    cap = cv2.VideoCapture(input_path)
+    try:
+        if not cap.isOpened():
+            return None
+        frames = cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0.0
+        if frames > 0:
+            cap.set(cv2.CAP_PROP_POS_FRAMES,
+                    int(frames * SEEK_PERCENTAGE))
+        ok, frame = cap.read()
+        if not ok and frames > 0:
+            # Seek landed outside the decodable range (some containers
+            # report wrong counts) — retry from the start.
+            cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
+            ok, frame = cap.read()
+        if not ok or frame is None or frame.size == 0:
+            return None
+        rgb = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+        return encode_webp(Image.fromarray(rgb), out_path, target_px)
+    except Exception:
+        return None
+    finally:
+        cap.release()
+
+
 def _cover_art_thumbnail(input_path: str, out_path: str,
                          target_px: float) -> Optional[str]:
     """Decoder-free fallback for H.264/HEVC containers: embedded cover
@@ -146,20 +233,28 @@ def _cover_art_thumbnail(input_path: str, out_path: str,
         return None
 
 
+def _fallback_chain(input_path: str, out_path: str,
+                    target_px: float) -> Optional[str]:
+    """The ffmpeg-CLI-less backend chain, best decoder first (module
+    docstring): cv2's libavcodec → self-hosted MJPEG-AVI → self-hosted
+    CAVLC H.264 (MP4/TS) → embedded cover art."""
+    return (_cv2_thumbnail(input_path, out_path, target_px)
+            or (_mjpeg_thumbnail(input_path, out_path, target_px)
+                if _is_mjpeg_candidate(input_path) else None)
+            or _h264_thumbnail(input_path, out_path, target_px)
+            or _cover_art_thumbnail(input_path, out_path, target_px))
+
+
 def generate_video_thumbnail(input_path: str, out_path: str,
                              target_px: float = 262144.0
                              ) -> Optional[str]:
     """Seek 10%, grab one frame, scale to ~target_px, encode webp.
 
-    Returns out_path on success, None when no decoder applies or the
-    decode fails (the caller records no thumbnail, as the reference does
-    on MovieDecoder errors). MJPEG `.avi` decodes without ffmpeg — and
-    is also the fallback when an installed ffmpeg fails on one."""
+    Returns out_path on success, None when no decoder in the backend
+    chain (module docstring) applies — the caller records no thumbnail,
+    as the reference does on MovieDecoder errors."""
     if not available():
-        if _is_mjpeg_candidate(input_path):
-            return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return (_h264_thumbnail(input_path, out_path, target_px)
-                or _cover_art_thumbnail(input_path, out_path, target_px))
+        return _fallback_chain(input_path, out_path, target_px)
     duration = probe_duration(input_path) or 0.0
     seek = duration * SEEK_PERCENTAGE
     # ~512×512-equivalent area; ffmpeg keeps aspect via -2.
@@ -183,7 +278,4 @@ def generate_video_thumbnail(input_path: str, out_path: str,
             os.remove(tmp)
         except OSError:
             pass
-        if _is_mjpeg_candidate(input_path):
-            return _mjpeg_thumbnail(input_path, out_path, target_px)
-        return (_h264_thumbnail(input_path, out_path, target_px)
-                or _cover_art_thumbnail(input_path, out_path, target_px))
+        return _fallback_chain(input_path, out_path, target_px)

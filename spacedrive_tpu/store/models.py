@@ -126,6 +126,11 @@ register(Model(
     "pending_relation_op",
     (
         _id(),
+        # Redelivered pages re-park the same op (the watermark freeze
+        # re-serves unapplied ops by design) — op_id UNIQUE + INSERT OR
+        # IGNORE keeps one parked copy, or drain would graduate N
+        # duplicates into the op log.
+        Field("op_id", "BLOB", unique=True),
         Field("timestamp", "INTEGER", nullable=False),
         Field("data", "BLOB", nullable=False),  # packed CRDTOperation
         # Referenced (target model, packed sync id) pairs, denormalized

@@ -575,11 +575,15 @@ class SyncManager:
             else:
                 rmodel = M.MODELS[t.relation]
                 item_f, group_f = rmodel.relation
+                # OR IGNORE on op_id: the frozen watermark re-serves
+                # this op on every retry pull until the page's failing
+                # op clears — without dedup each redelivery would park
+                # another copy and drain would log N duplicates.
                 conn.execute(
-                    "INSERT INTO pending_relation_op "
-                    "(timestamp, data, item_model, item_key, "
-                    "group_model, group_key) VALUES (?, ?, ?, ?, ?, ?)",
-                    (op.timestamp, op.pack(),
+                    "INSERT OR IGNORE INTO pending_relation_op "
+                    "(op_id, timestamp, data, item_model, item_key, "
+                    "group_model, group_key) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                    (op.id, op.timestamp, op.pack(),
                      _fk_target(rmodel.field(item_f)),
                      pack_value(t.item_id),
                      _fk_target(rmodel.field(group_f)),

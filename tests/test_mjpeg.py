@@ -57,14 +57,16 @@ def test_thumbnail_pipeline_executes_video(tmp_path):
 
 
 def test_non_mjpeg_avi_degrades(tmp_path):
-    """A RIFF/AVI whose frames are not JPEG yields None, like the
+    """A RIFF/AVI whose frame payloads are unreadable by EVERY backend
+    (cv2's resilient mjpeg decoder included — wiping just the SOI is no
+    longer enough since the cv2 chain landed) yields None, like the
     reference's MovieDecoder error path."""
     from spacedrive_tpu.media.thumbnail import generate_thumbnail
 
     p = _clip(tmp_path, n=5)
     raw = bytearray(p.read_bytes())
-    for off, _ in index_frames(str(p)):
-        raw[off:off + 2] = b"\x00\x00"  # wipe each frame's JPEG SOI
+    for off, size in index_frames(str(p)):
+        raw[off:off + size] = b"\x00" * size  # zero the whole payload
     p.write_bytes(bytes(raw))
     assert frame_at_fraction(str(p)) is None
     assert generate_thumbnail(str(p), str(tmp_path / "d"),

@@ -652,3 +652,29 @@ def test_uuid_batches_same_ms_stay_disjoint_and_ordered():
     ids = x + y
     assert len(set(ids)) == 200
     assert ids == sorted(ids)
+
+
+def test_redelivered_parked_op_does_not_duplicate(pair):
+    """The frozen watermark re-serves an unapplied (parked) relation op
+    on every retry pull — redelivery must keep ONE parked copy, and the
+    drain must log ONE op-log row, not N (round-5 review finding,
+    reproduced: 3 pulls → 3 pending + 3 log rows before the fix)."""
+    a, b = pair
+    tag_pub, obj_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    assign = a.relation_create("tag_on_object", obj_pub, tag_pub)
+    for _ in range(3):  # three redeliveries of the same page
+        applied, errors = b.receive_crdt_operations(assign)
+        assert not errors
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 1
+    # rows materialize -> drain applies the one copy, once
+    creates = a.shared_create("object", obj_pub, {"kind": 5}) + \
+        a.shared_create("tag", tag_pub, {"name": "t"})
+    applied, errors = b.receive_crdt_operations(creates)
+    assert not errors
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 0
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 1
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM relation_operation")["n"] == 1

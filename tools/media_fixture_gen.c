@@ -1,0 +1,148 @@
+/* Fixture generator: tiny real-codec video files for the media tests.
+ *
+ * Encodes a deterministic animated pattern with the SYSTEM ffmpeg
+ * libraries (libavcodec 59 + libx264/libx265/libvpx/libaom — present in
+ * this image as shared libs + dev headers) into the codecs the runtime
+ * decode path must handle: CABAC Main/High-profile H.264, HEVC, VP9,
+ * AV1. The outputs are committed as tests/fixtures/video/* and decoded
+ * in tests by the cv2-backed runtime path (media/video.py) — mirroring
+ * the reference, whose thumbnailer handles any codec by linking ffmpeg
+ * (/root/reference/crates/ffmpeg/src/movie_decoder.rs:32).
+ *
+ * Build:  gcc -O2 -o media_fixture_gen tools/media_fixture_gen.c \
+ *             -lavformat -lavcodec -lavutil
+ * Run:    ./media_fixture_gen <outdir>
+ *
+ * This tool runs at FIXTURE GENERATION time only — the runtime imports
+ * nothing from here; committed fixtures keep the suite hermetic.
+ */
+#include <libavcodec/avcodec.h>
+#include <libavformat/avformat.h>
+#include <libavutil/imgutils.h>
+#include <libavutil/opt.h>
+#include <stdio.h>
+#include <string.h>
+
+#define W 128
+#define H 96
+#define FPS 10
+#define NFRAMES 25
+
+/* Deterministic pattern: diagonal gradient + a moving bright box so
+ * every frame differs and a mid-stream frame is visually distinct. */
+static void fill_frame(AVFrame *f, int t) {
+  for (int y = 0; y < H; y++)
+    for (int x = 0; x < W; x++)
+      f->data[0][y * f->linesize[0] + x] = (uint8_t)((x * 2 + y + t * 7) & 0xFF);
+  int bx = (t * 9) % (W - 32), by = (t * 5) % (H - 24);
+  for (int y = by; y < by + 24; y++)
+    for (int x = bx; x < bx + 32; x++)
+      f->data[0][y * f->linesize[0] + x] = 235;
+  for (int y = 0; y < H / 2; y++)
+    for (int x = 0; x < W / 2; x++) {
+      f->data[1][y * f->linesize[1] + x] = (uint8_t)((x * 4 + t * 3) & 0xFF);
+      f->data[2][y * f->linesize[2] + x] = (uint8_t)((y * 4 + 255 - t * 3) & 0xFF);
+    }
+}
+
+static int encode_file(const char *path, const char *enc_name,
+                       const char *profile, int crf) {
+  AVFormatContext *oc = NULL;
+  int ret = avformat_alloc_output_context2(&oc, NULL, NULL, path);
+  if (ret < 0 || !oc) { fprintf(stderr, "mux alloc %s\n", path); return -1; }
+
+  const AVCodec *codec = avcodec_find_encoder_by_name(enc_name);
+  if (!codec) { fprintf(stderr, "no encoder %s\n", enc_name); return -1; }
+  AVStream *st = avformat_new_stream(oc, NULL);
+  AVCodecContext *c = avcodec_alloc_context3(codec);
+  c->width = W;
+  c->height = H;
+  c->pix_fmt = AV_PIX_FMT_YUV420P;
+  c->time_base = (AVRational){1, FPS};
+  c->gop_size = 8; /* several keyframes so 10%-seek lands near one */
+  if (oc->oformat->flags & AVFMT_GLOBALHEADER)
+    c->flags |= AV_CODEC_FLAG_GLOBAL_HEADER;
+  if (profile) av_opt_set(c->priv_data, "profile", profile, 0);
+  if (crf >= 0) av_opt_set_int(c->priv_data, "crf", crf, 0);
+  if (!strcmp(enc_name, "libx264")) {
+    /* CABAC is the point of this fixture: Main/High default to it, but
+     * pin it explicitly so a build quirk can't hand back CAVLC. */
+    av_opt_set(c->priv_data, "x264-params", "cabac=1", 0);
+  }
+  if (!strcmp(enc_name, "libaom-av1")) {
+    av_opt_set_int(c->priv_data, "cpu-used", 8, 0); /* keep encode fast */
+    c->bit_rate = 200000;
+  }
+  if (!strcmp(enc_name, "libvpx-vp9")) c->bit_rate = 200000;
+  if (!strcmp(enc_name, "mpeg2video")) c->bit_rate = 400000;
+
+  if ((ret = avcodec_open2(c, codec, NULL)) < 0) {
+    fprintf(stderr, "open %s: %d\n", enc_name, ret); return -1;
+  }
+  avcodec_parameters_from_context(st->codecpar, c);
+  st->time_base = c->time_base;
+  if (!(oc->oformat->flags & AVFMT_NOFILE) &&
+      (ret = avio_open(&oc->pb, path, AVIO_FLAG_WRITE)) < 0) {
+    fprintf(stderr, "avio_open %s\n", path); return -1;
+  }
+  if ((ret = avformat_write_header(oc, NULL)) < 0) {
+    fprintf(stderr, "header %s\n", path); return -1;
+  }
+
+  AVFrame *frame = av_frame_alloc();
+  frame->format = c->pix_fmt;
+  frame->width = W;
+  frame->height = H;
+  av_frame_get_buffer(frame, 0);
+  AVPacket *pkt = av_packet_alloc();
+
+  for (int t = 0; t <= NFRAMES; t++) { /* t == NFRAMES: flush */
+    if (t < NFRAMES) {
+      av_frame_make_writable(frame);
+      fill_frame(frame, t);
+      frame->pts = t;
+      ret = avcodec_send_frame(c, frame);
+    } else {
+      ret = avcodec_send_frame(c, NULL);
+    }
+    if (ret < 0) { fprintf(stderr, "send %d\n", t); return -1; }
+    while ((ret = avcodec_receive_packet(c, pkt)) >= 0) {
+      av_packet_rescale_ts(pkt, c->time_base, st->time_base);
+      pkt->stream_index = st->index;
+      av_interleaved_write_frame(oc, pkt);
+      av_packet_unref(pkt);
+    }
+    if (ret != AVERROR(EAGAIN) && ret != AVERROR_EOF) {
+      fprintf(stderr, "recv %d\n", ret); return -1;
+    }
+  }
+  av_write_trailer(oc);
+  avcodec_free_context(&c);
+  av_frame_free(&frame);
+  av_packet_free(&pkt);
+  if (!(oc->oformat->flags & AVFMT_NOFILE)) avio_closep(&oc->pb);
+  avformat_free_context(oc);
+  printf("wrote %s (%s)\n", path, enc_name);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *dir = argc > 1 ? argv[1] : ".";
+  char path[512];
+  int rc = 0;
+  snprintf(path, sizeof path, "%s/cabac_main.mp4", dir);
+  rc |= encode_file(path, "libx264", "main", 30);
+  snprintf(path, sizeof path, "%s/cabac_high.mp4", dir);
+  rc |= encode_file(path, "libx264", "high", 30);
+  snprintf(path, sizeof path, "%s/hevc.mp4", dir);
+  rc |= encode_file(path, "libx265", NULL, 32);
+  snprintf(path, sizeof path, "%s/vp9.webm", dir);
+  rc |= encode_file(path, "libvpx-vp9", NULL, -1);
+  snprintf(path, sizeof path, "%s/av1.mp4", dir);
+  rc |= encode_file(path, "libaom-av1", NULL, -1);
+  /* .mpg has NO self-hosted parser — exercises the cv2 metadata
+   * fallback in avmetadata.probe_media, not just thumbnails. */
+  snprintf(path, sizeof path, "%s/mpeg2.mpg", dir);
+  rc |= encode_file(path, "mpeg2video", NULL, -1);
+  return rc;
+}
