@@ -920,15 +920,49 @@ def _search_paths_where(input) -> tuple:
         where += (f" AND fp.object_id IN (SELECT object_id FROM "
                   f"tag_on_object WHERE tag_id IN ({ph}))")
         params.extend(int(t) for t in f["tags"])
+    # Server-side favorite/extension-set filters: the virtualized
+    # explorer windows the result by absolute index, so EVERY filter
+    # must narrow the SQL — a client-side filter would leave holes in
+    # the windows and shift indices.
+    if f.get("favorite") is not None:
+        where += (" AND fp.object_id IN "
+                  "(SELECT id FROM object WHERE favorite = ?)")
+        params.append(int(bool(f["favorite"])))
+    if f.get("extensions"):
+        ph = ",".join("?" for _ in f["extensions"])
+        where += f" AND LOWER(fp.extension) IN ({ph})"
+        params.extend(str(e).lower() for e in f["extensions"])
     return where, params
 
 
 def _search(r: Router) -> None:
     @r.query("search.paths", library=True)
     def search_paths(node, library, input):
+        """Two access modes (the reference's Explorer queries through
+        @tanstack/react-virtual windows — interface/app/$libraryId/
+        Explorer): keyset `cursor` pagination for sequential readers,
+        and absolute `skip` windows + server-side `order` for the
+        virtualized explorer, which addresses rows by scroll index."""
         input = input or {}
         where, params = _search_paths_where(input)
         take = min(int(input.get("take", 100)), 500)
+        order = input.get("order") or {}
+        ocol = {"id": "fp.id", "name": "fp.name COLLATE NOCASE",
+                "kind": "fp.extension COLLATE NOCASE",
+                "size": "fp.size_in_bytes",
+                "modified": "fp.date_modified",
+                }.get(str(order.get("field", "id")), "fp.id")
+        odir = "DESC" if order.get("desc") else "ASC"
+        if "skip" in input:
+            skip = max(0, int(input["skip"]))
+            rows = library.db.query(
+                f"SELECT fp.* FROM file_path fp WHERE {where} "
+                f"ORDER BY {ocol} {odir}, fp.id LIMIT ? OFFSET ?",
+                params + [take, skip])
+            items = rows_to_dicts(rows)
+            for it in items:
+                it["thumbnail_key"] = it.get("cas_id")
+            return {"items": items, "skip": skip}
         cursor = int(input.get("cursor", 0))
         rows = library.db.query(
             f"SELECT fp.* FROM file_path fp WHERE {where} AND fp.id > ? "

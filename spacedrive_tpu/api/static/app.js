@@ -309,6 +309,7 @@ async function loadStats() {
 function render() {
   document.getElementById("inspector").style.display = "none";
   hideCtx(); closePreview();
+  if (view !== "explorer") { vg = null; cursorIdx = null; }
   ({overview: renderOverview,
     explorer: browse, browse: renderEphemeral, dups: renderDups,
     neardups: renderNearDups,
@@ -442,8 +443,37 @@ async function renderEphemeral() {
 }
 
 // ---- Explorer --------------------------------------------------------
+// ---- virtualized explorer --------------------------------------------
+// The engine browses 1M-file libraries; the old renderer fetched a hard
+// take:400 and built a DOM node per row. Now the result set is WINDOWED
+// (search.paths skip/take — the server orders and filters, so absolute
+// indices are stable) and only the viewport ± overscan rows exist in
+// the DOM, the same shape as the reference Explorer's
+// @tanstack/react-virtual grids (interface/app/$libraryId/Explorer/).
+const VWIN = 200;        // rows per fetched window (≤ server take cap)
+const MEDIA_EXTS = ["png","jpg","jpeg","gif","webp","bmp","tiff",
+  "tif","heic","heif","avif","svg","svgz","pdf","avi","mp4","mkv",
+  "mov","webm"];
+let vg = null;           // virtual-grid state for the current browse
+let vgResizeObs = null;  // one observer, re-pointed per browse
+let cursorIdx = null;    // keyboard cursor as an ABSOLUTE index
+
+function vgDims() {
+  if (viewMode === "list") return {cellW: 0, cellH: 26, listMode: true};
+  if (viewMode === "media")
+    return {cellW: 188, cellH: 178, listMode: false};
+  return {cellW: 116, cellH: 126, listMode: false};
+}
+function vgCols() {
+  if (!vg) return 1;
+  const {cellW, listMode} = vgDims();
+  if (listMode) return 1;
+  return Math.max(1, Math.floor((vg.wrap.clientWidth - 8) / cellW));
+}
+
 async function browse() {
   const main = document.getElementById("main");
+  vg = null; cursorIdx = null;
   if (!lib || (loc == null && kindFilter == null)) { main.innerHTML =
     "<div class='muted'>create a library and add a location</div>"; return; }
   const searchText = document.getElementById("search").value.trim();
@@ -454,92 +484,155 @@ async function browse() {
   if (searchText) filter.search = searchText;
   else if (kindFilter == null) filter.materialized_path = curPath;
   if (tagFilter != null) filter.tags = [tagFilter];
-  const [rows, count] = await Promise.all([
-    q("search.paths", {library_id: lib, take: 400, filter}),
-    q("search.pathsCount", {library_id: lib, filter}),
-  ]);
+  // Every narrowing is SERVER-side: client-side filtering would leave
+  // holes in the windows and shift absolute indices.
+  if (favOnly) filter.favorite = true;
+  if (viewMode === "media") filter.extensions = MEDIA_EXTS;
+  const order = (viewMode === "list" && sortKey)
+    ? {field: sortKey, desc: sortDir < 0} : null;
+  const count = await q("search.pathsCount", {library_id: lib, filter});
   const kindChip = kindFilter == null ? "" :
     ` · <span class="tagchip on" id="kindchip">kind: ` +
     `${esc(KIND_NAMES[kindFilter] ?? kindFilter)} ✕</span>`;
+  const showUp = !searchText && kindFilter == null && curPath !== "/";
+  const upBtn = showUp
+    ? `<span class="tagchip" id="upbtn">⬆ ..</span> · ` : "";
   main.innerHTML =
-    `<div class="muted" style="margin-bottom:10px">location ${loc} · ` +
+    `<div class="muted" style="margin-bottom:10px">${upBtn}` +
+    `location ${loc} · ` +
     `${searchText ? `search "${esc(searchText)}"` : esc(curPath)} · ` +
-    `${count} paths${kindChip}</div><div id="grid"></div>`;
+    `${count} paths${kindChip}</div>` +
+    (viewMode === "list" ? listHeaderHtml() : "") +
+    `<div id="gridwrap"><div id="grid" class="virt` +
+    `${viewMode === "media" ? " media" : ""}` +
+    `${viewMode === "list" ? " vlist" : ""}"></div></div>`;
   const chip = document.getElementById("kindchip");
   if (chip) chip.onclick = () => { kindFilter = null; browse(); };
-  const grid = document.getElementById("grid");
-  if (!searchText && curPath !== "/") {
-    grid.appendChild(cell({name: "..", is_dir: 1}, () => {
-      curPath = curPath.replace(/[^/]+\/$/, ""); browse();
-    }));
+  const up = document.getElementById("upbtn");
+  if (up) up.onclick = () => {
+    curPath = curPath.replace(/[^/]+\/$/, ""); clearSel(); browse();
+  };
+  if (viewMode === "list") wireListHeader();
+  lastRows = new Array(count);  // sparse: windows fill as they load
+  vg = {count, filter, order,
+        wrap: document.getElementById("gridwrap"),
+        grid: document.getElementById("grid"),
+        fetched: new Set(), inflight: new Map(), pool: new Map()};
+  vg.wrap.onscroll = () => vgUpdate();
+  // Re-layout when the scroller's width changes without a scroll
+  // (inspector open/close, window resize) — vgUpdate detects the new
+  // column count and rebuilds the pool.
+  if (window.ResizeObserver) {
+    if (!vgResizeObs) vgResizeObs = new ResizeObserver(() => vgUpdate());
+    vgResizeObs.disconnect();
+    vgResizeObs.observe(vg.wrap);
   }
-  let items = rows.items || rows;
-  if (favOnly) {
-    const favs = await q("search.objects",
-      {library_id: lib, take: 500, filter: {favorite: true}});
-    const favIds = new Set((favs.items || []).map(o => o.id));
-    items = items.filter(r => favIds.has(r.object_id));
-  }
-  if (viewMode === "media") {
-    const mediaExt = new Set(["png","jpg","jpeg","gif","webp","bmp","tiff",
-      "tif","heic","heif","avif","svg","svgz","pdf","avi","mp4","mkv",
-      "mov","webm"]);
-    items = items.filter(r => !r.is_dir
-      && mediaExt.has((r.extension || "").toLowerCase()));
-    grid.className = "media";
-  } else grid.className = "";
-  lastRows = sortItems(items);
-  if (viewMode === "list") {
-    main.removeChild(grid);
-    main.appendChild(buildListTable(!searchText && curPath !== "/"));
-  } else {
-    items = lastRows;
-    for (const r of items) grid.appendChild(cell(r, null));
-  }
+  vgUpdate();
 }
 
-function sortItems(items) {
-  if (viewMode !== "list" || !sortKey) return items;
-  const keyf = {name: r => (r.name || "").toLowerCase(),
-                kind: r => r.is_dir ? "" : (r.extension || ""),
-                size: r => r.size_in_bytes || 0,
-                modified: r => r.date_modified || 0}[sortKey];
-  return [...items].sort((a, b) => {
-    const ka = keyf(a), kb = keyf(b);
-    return (ka < kb ? -1 : ka > kb ? 1 : 0) * sortDir;
+function listHeaderHtml() {
+  const lbl = (k) => k + (sortKey === k
+    ? (sortDir > 0 ? " ↑" : " ↓") : "");
+  return `<div id="listhdr"><span></span>` +
+    ["name", "kind", "size", "modified"].map(k =>
+      `<span class="lh" data-k="${k}">${lbl(k)}</span>`).join("") +
+    `</div>`;
+}
+function wireListHeader() {
+  document.querySelectorAll("#listhdr .lh").forEach(el => {
+    el.onclick = () => {   // server-side re-sort, windows refetch
+      const k = el.dataset.k;
+      sortDir = sortKey === k ? -sortDir : 1;
+      sortKey = k;
+      browse();
+    };
   });
 }
 
-function buildListTable(showUp) {
-  // Header clicks re-sort lastRows CLIENT-SIDE and swap the table in
-  // place — no refetch (same repaint-in-place rule as selection).
-  const tbl = document.createElement("table");
-  const hdr = document.createElement("tr");
-  hdr.innerHTML = "<th></th>";
-  for (const k of ["name", "kind", "size", "modified"]) {
-    const th = document.createElement("th");
-    th.style.cursor = "pointer";
-    th.textContent = k + (sortKey === k
-      ? (sortDir > 0 ? " ↑" : " ↓") : "");
-    th.onclick = () => {
-      sortDir = sortKey === k ? -sortDir : 1;
-      sortKey = k;
-      lastRows = sortItems(lastRows);
-      tbl.replaceWith(buildListTable(showUp));
-    };
-    hdr.appendChild(th);
+function vgUpdate() {
+  if (!vg || !vg.wrap.isConnected) return;
+  const {cellW, cellH, listMode} = vgDims();
+  const cols = vgCols();
+  if (vg.renderedCols !== undefined && vg.renderedCols !== cols) {
+    // Column count changed (inspector opened, window resized): pooled
+    // cells hold absolute positions computed with the OLD count —
+    // drop them all so this pass re-lays out at the new geometry.
+    for (const el of vg.pool.values()) el.remove();
+    vg.pool.clear();
   }
-  tbl.appendChild(hdr);
-  if (showUp) {
-    const up = document.createElement("tr");
-    up.className = "row";
-    up.innerHTML = "<td>📁</td><td>..</td><td></td><td></td><td></td>";
-    up.onclick = () => { curPath = curPath.replace(/[^/]+\/$/, "");
-                         browse(); };
-    tbl.appendChild(up);
+  vg.renderedCols = cols;
+  const rows = Math.ceil(vg.count / cols);
+  vg.grid.style.height = Math.max(rows * cellH, 1) + "px";
+  const y0 = vg.wrap.scrollTop, y1 = y0 + vg.wrap.clientHeight;
+  const r0 = Math.max(0, Math.floor(y0 / cellH) - 3);
+  const r1 = Math.min(Math.max(rows - 1, 0), Math.ceil(y1 / cellH) + 3);
+  const i0 = r0 * cols;
+  const i1 = Math.min(vg.count - 1, (r1 + 1) * cols - 1);
+  for (let w = Math.floor(i0 / VWIN); w <= Math.floor(i1 / VWIN); w++)
+    vgFetch(w);
+  for (const [idx, el] of [...vg.pool]) {
+    if (idx < i0 || idx > i1) { el.remove(); vg.pool.delete(idx); }
   }
-  for (const r of lastRows) tbl.appendChild(listRow(r));
-  return tbl;
+  for (let i = i0; i <= i1; i++) {
+    if (vg.pool.has(i)) continue;
+    const r = lastRows[i];
+    if (!r) continue;    // window in flight; vgFetch re-renders
+    const el = listMode ? listRow(r) : cell(r, null);
+    el.style.position = "absolute";
+    if (listMode) {
+      el.style.top = (i * cellH) + "px";
+      el.style.left = "0"; el.style.right = "0";
+    } else {
+      el.style.top = (Math.floor(i / cols) * cellH) + "px";
+      el.style.left = ((i % cols) * cellW) + "px";
+    }
+    el.dataset.idx = i;
+    vg.grid.appendChild(el);
+    vg.pool.set(i, el);
+  }
+}
+
+function vgFetch(w) {
+  if (!vg || vg.fetched.has(w)) return Promise.resolve();
+  if (vg.inflight.has(w)) return vg.inflight.get(w);
+  const mine = vg;
+  const p = q("search.paths", {
+    library_id: lib, skip: w * VWIN, take: VWIN, filter: mine.filter,
+    ...(mine.order ? {order: mine.order} : {}),
+  }).then(res => {
+    if (vg !== mine) return;    // navigated away mid-flight
+    (res.items || []).forEach((it, j) => { lastRows[w * VWIN + j] = it; });
+    mine.fetched.add(w);
+    mine.inflight.delete(w);
+    vgUpdate();
+  }).catch(() => {
+    // transient failure (server restart, network blip): clear the
+    // inflight marker and retry shortly — otherwise the very first
+    // viewport stays blank forever with no scroll to re-trigger it
+    mine.inflight.delete(w);
+    setTimeout(() => { if (vg === mine) vgUpdate(); }, 1000);
+  });
+  mine.inflight.set(w, p);
+  return p;
+}
+
+// Scroll an absolute index into view, fetch its window, select it.
+async function selectIndex(i) {
+  if (!vg || !vg.count) return;
+  i = Math.max(0, Math.min(vg.count - 1, i));
+  cursorIdx = i;
+  const {cellH} = vgDims();
+  const cols = vgCols();
+  const top = Math.floor(i / cols) * cellH;
+  if (top < vg.wrap.scrollTop) vg.wrap.scrollTop = top;
+  else if (top + cellH > vg.wrap.scrollTop + vg.wrap.clientHeight)
+    vg.wrap.scrollTop = top + cellH - vg.wrap.clientHeight;
+  await vgFetch(Math.floor(i / VWIN));
+  const r = lastRows[i];
+  if (!r) return;
+  selection.clear(); selection.add(r.id); lastClickId = r.id;
+  vgUpdate(); updateSelClasses();
+  if (previewRow) openPreview(r);
 }
 
 function openEntry(r) {
@@ -557,12 +650,19 @@ function updateSelClasses() {
     el.classList.toggle("sel", selection.has(+el.dataset.fpid)));
 }
 function entryClick(r, e) {
+  // absolute keyboard cursor: the rendered cell carries its index
+  // (dataset.idx, set by vgUpdate) — O(1) vs an O(count) indexOf over
+  // the sparse array at 1M rows
+  const el = e && e.currentTarget;
+  cursorIdx = (el && el.dataset && el.dataset.idx !== undefined)
+    ? +el.dataset.idx : null;
   if (e.shiftKey && lastClickId != null) {
-    const ids = lastRows.map(x => x.id);
-    const a = ids.indexOf(lastClickId), b = ids.indexOf(r.id);
+    // range select across the LOADED windows between the two anchors
+    const a = lastRows.findIndex(x => x && x.id === lastClickId);
+    const b = lastRows.findIndex(x => x && x.id === r.id);
     if (a >= 0 && b >= 0) {
       for (let k = Math.min(a, b); k <= Math.max(a, b); k++)
-        selection.add(ids[k]);
+        if (lastRows[k]) selection.add(lastRows[k].id);
     }
     updateSelClasses();
   } else if (e.ctrlKey || e.metaKey) {
@@ -637,22 +737,19 @@ function previewStep(delta) {
 
 // ---- keyboard model: arrows/enter/del/space in grid and list ---------
 function gridColumns() {
+  if (vg) return vgCols();
   const g = document.getElementById("grid");
   if (!g || viewMode === "list") return 1;
   const cols = getComputedStyle(g).gridTemplateColumns.split(" ").length;
   return Math.max(1, cols);
 }
 function moveCursor(delta) {
-  if (!lastRows.length) return;
-  let i = lastClickId != null
-    ? lastRows.findIndex(r => r.id === lastClickId) : -1;
-  i = Math.max(0, Math.min(lastRows.length - 1, i + delta));
-  const r = lastRows[i];
-  selection.clear(); selection.add(r.id); lastClickId = r.id;
-  updateSelClasses();
-  const el = document.querySelector(`[data-fpid="${r.id}"]`);
-  if (el) el.scrollIntoView({block: "nearest"});
-  if (previewRow) openPreview(r);
+  // Absolute-index navigation over the virtual window: the target row
+  // may not be fetched yet — selectIndex scrolls there, fetches its
+  // window, then selects.
+  if (!vg || !vg.count) return;
+  if (cursorIdx == null) { selectIndex(delta > 0 ? 0 : vg.count - 1); return; }
+  selectIndex(cursorIdx + delta);
 }
 document.addEventListener("keydown", (e) => {
   if (e.key === "Escape") {
@@ -663,7 +760,7 @@ document.addEventListener("keydown", (e) => {
   if (e.key === " ") {
     e.preventDefault();
     if (previewRow) { closePreview(); return; }
-    const r = lastRows.find(x => selection.has(x.id) && !x.is_dir);
+    const r = lastRows.find(x => x && selection.has(x.id) && !x.is_dir);
     if (r) openPreview(r);
   } else if (e.key === "ArrowRight") {
     e.preventDefault();
@@ -676,7 +773,7 @@ document.addEventListener("keydown", (e) => {
   } else if (e.key === "ArrowUp") {
     e.preventDefault(); moveCursor(-gridColumns());
   } else if (e.key === "Enter") {
-    const r = lastRows.find(x => selection.has(x.id));
+    const r = lastRows.find(x => x && selection.has(x.id));
     if (r) openEntry(r);
   } else if (e.key === "Delete") {
     const rows = selRows();
@@ -687,7 +784,7 @@ document.addEventListener("keydown", (e) => {
       });
   } else if ((e.ctrlKey || e.metaKey) && e.key.toLowerCase() === "a") {
     e.preventDefault();
-    for (const r of lastRows) selection.add(r.id);
+    lastRows.forEach(r => selection.add(r.id));  // loaded windows only
     updateSelClasses();
   }
 });
@@ -858,17 +955,20 @@ function wireDnD(el, r) {
 }
 
 function listRow(r) {
-  const tr = document.createElement("tr");
-  tr.className = "row" + (selection.has(r.id) ? " sel" : "");
+  // div-based (not <tr>) so the virtual renderer can absolutely
+  // position each row inside the windowed scroller.
+  const tr = document.createElement("div");
+  tr.className = "lrow" + (selection.has(r.id) ? " sel" : "");
   const kindName = r.is_dir ? "folder" : (r.extension || "file");
   const size = r.is_dir ? "" : fmtBytes(r.size_in_bytes || 0);
   const dm = r.date_modified
     ? new Date(r.date_modified * 1000).toISOString().slice(0, 16)
         .replace("T", " ") : "";
   tr.dataset.fpid = r.id;
-  tr.innerHTML = `<td>${r.is_dir ? "📁" : "🗎"}</td>` +
-    `<td>${esc(r.name)}${r.extension ? "." + esc(r.extension) : ""}</td>` +
-    `<td>${esc(kindName)}</td><td>${size}</td><td>${dm}</td>`;
+  tr.innerHTML = `<span>${r.is_dir ? "📁" : "🗎"}</span>` +
+    `<span>${esc(r.name)}${r.extension ? "." + esc(r.extension) : ""}` +
+    `</span><span>${esc(kindName)}</span><span>${size}</span>` +
+    `<span>${dm}</span>`;
   tr.onclick = (e) => entryClick(r, e);
   tr.ondblclick = () => openEntry(r);
   tr.oncontextmenu = (e) => showCtx(r, e);

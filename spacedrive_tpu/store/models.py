@@ -127,10 +127,14 @@ register(Model(
     (
         _id(),
         # Redelivered pages re-park the same op (the watermark freeze
-        # re-serves unapplied ops by design) — op_id UNIQUE + INSERT OR
-        # IGNORE keeps one parked copy, or drain would graduate N
-        # duplicates into the op log.
-        Field("op_id", "BLOB", unique=True),
+        # re-serves unapplied ops by design) — the ingest INSERT dedups
+        # on op_id via WHERE NOT EXISTS, or drain would graduate N
+        # duplicates into the op log. Deliberately a PLAIN NULLABLE
+        # column (not UNIQUE): this table predates the column, and the
+        # additive migration can only ALTER in plain nullable columns —
+        # a UNIQUE constraint here would brick every pre-existing
+        # library at open (SQLite can't ADD a UNIQUE column).
+        Field("op_id", "BLOB"),
         Field("timestamp", "INTEGER", nullable=False),
         Field("data", "BLOB", nullable=False),  # packed CRDTOperation
         # Referenced (target model, packed sync id) pairs, denormalized
@@ -143,7 +147,7 @@ register(Model(
         Field("group_model", "TEXT"),
         Field("group_key", "BLOB"),
     ),
-    indexes=(("timestamp",), ("item_model", "item_key"),
+    indexes=(("timestamp",), ("op_id",), ("item_model", "item_key"),
              ("group_model", "group_key")),
 ))
 

@@ -575,19 +575,24 @@ class SyncManager:
             else:
                 rmodel = M.MODELS[t.relation]
                 item_f, group_f = rmodel.relation
-                # OR IGNORE on op_id: the frozen watermark re-serves
-                # this op on every retry pull until the page's failing
-                # op clears — without dedup each redelivery would park
+                # Dedup on op_id: the frozen watermark re-serves this
+                # op on every retry pull until the page's failing op
+                # clears — without dedup each redelivery would park
                 # another copy and drain would log N duplicates.
+                # WHERE NOT EXISTS, not a UNIQUE constraint: op_id was
+                # ALTERed into pre-existing tables, where SQLite can't
+                # add uniqueness.
                 conn.execute(
-                    "INSERT OR IGNORE INTO pending_relation_op "
+                    "INSERT INTO pending_relation_op "
                     "(op_id, timestamp, data, item_model, item_key, "
-                    "group_model, group_key) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                    "group_model, group_key) "
+                    "SELECT ?, ?, ?, ?, ?, ?, ? WHERE NOT EXISTS "
+                    "(SELECT 1 FROM pending_relation_op WHERE op_id = ?)",
                     (op.id, op.timestamp, op.pack(),
                      _fk_target(rmodel.field(item_f)),
                      pack_value(t.item_id),
                      _fk_target(rmodel.field(group_f)),
-                     pack_value(t.group_id)))
+                     pack_value(t.group_id), op.id))
 
     def _drain_pending_relations(self, conn) -> None:
         """Retry parked relation ops; applied ones graduate to the op

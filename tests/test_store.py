@@ -93,3 +93,28 @@ def test_sync_metadata_registry():
     # local_only fields never sync (location.instance_id)
     loc = MODELS["location"]
     assert "instance_id" not in [f.name for f in loc.synced_fields]
+
+
+def test_additive_migration_of_pre_round5_library(tmp_path):
+    """A library created BEFORE pending_relation_op grew its dedup/ref
+    columns must still open: the additive migration ALTERs in the new
+    plain-nullable columns (a UNIQUE op_id here bricked old libraries —
+    round-5 review finding; SQLite cannot ADD a UNIQUE column)."""
+    p = tmp_path / "old.db"
+    conn = sqlite3.connect(p)
+    conn.execute(
+        "CREATE TABLE pending_relation_op ("
+        "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "timestamp INTEGER NOT NULL, data BLOB NOT NULL)")
+    conn.execute("INSERT INTO pending_relation_op (timestamp, data) "
+                 "VALUES (1, x'00')")
+    conn.commit()
+    conn.close()
+    db = Database(p)  # raises on a broken migration
+    cols = {r["name"] for r in
+            db.query("PRAGMA table_info(pending_relation_op)")}
+    assert {"op_id", "item_model", "item_key",
+            "group_model", "group_key"} <= cols
+    # the pre-existing row survived
+    assert db.query_one(
+        "SELECT COUNT(*) AS n FROM pending_relation_op")["n"] == 1

@@ -138,7 +138,12 @@ def test_drive_ui_procedures(served):
             # the explorer page itself serves
             async with http.get(f"http://127.0.0.1:{port}/") as resp:
                 assert resp.status == 200
-                assert "Spacedrive" in await resp.text() or True
+                page = await resp.text()
+                # the served shell must actually be the app (round-4's
+                # `or True` here let ANY page pass — it was even hiding
+                # that the title says "spacedrive-tpu", not "Spacedrive")
+                assert "app.js" in page and "spacedrive-tpu" in page, \
+                    page[:200]
             async with http.ws_connect(
                     f"http://127.0.0.1:{port}/rspc") as ws_raw:
                 ws = _Ws(ws_raw)
@@ -581,6 +586,149 @@ def test_drive_ui_procedures(served):
                 assert all((k.get("uuid") or k.get("id")) != ku
                            for k in await q("keys.list"))
 
+                # ---- round 5: decrypt, thumbs, rescans, deletes, ----
+                # ---- dismiss-one, clear-one, live subscriptions ----
+                await m("locations.quickRescan",
+                        {"library_id": lid, "location_id": loc})
+                paths5 = await q("search.paths",
+                                 {"library_id": lid, "take": 500})
+                enc_fp = next(p for p in paths5["items"]
+                              if p["extension"] == "sdtpu")
+                await m("files.decryptFiles",
+                        {"library_id": lid, "location_id": loc,
+                         "file_path_ids": [enc_fp["id"]],
+                         "password": "pw-ui-test"})
+                await node.jobs.wait_idle()
+                dec_path = os.path.join(corpus, "docs", "file3.txt")
+                assert os.path.exists(dec_path), os.listdir(
+                    os.path.join(corpus, "docs"))
+                with open(dec_path, "rb") as f:
+                    assert f.read(9) == b"content 3"
+
+                # thumbs job + its newThumbnail feed
+                thumb_id = 7100
+                await ws_raw.send_json(
+                    {"id": thumb_id, "type": "subscription",
+                     "path": "jobs.newThumbnail", "input": {}})
+                driven.add("jobs.newThumbnail")
+                prog_id = 7101
+                await ws_raw.send_json(
+                    {"id": prog_id, "type": "subscription",
+                     "path": "jobs.progress", "input": {}})
+                driven.add("jobs.progress")
+                await m("jobs.generateThumbsForLocation",
+                        {"library_id": lid, "id": loc})
+                await node.jobs.wait_idle()
+                thumb_dir = os.path.join(str(node.data_dir), "thumbnails")
+                webps = [os.path.join(r, f)
+                         for r, _, fs in os.walk(thumb_dir) for f in fs
+                         if f.endswith(".webp")]
+                assert webps, "thumbs job produced no thumbnails"
+                got_thumb_ev = got_prog_ev = False
+                for _ in range(100):
+                    if got_thumb_ev and got_prog_ev:
+                        break
+                    try:
+                        msg = await asyncio.wait_for(
+                            ws_raw.receive(), timeout=1)
+                    except asyncio.TimeoutError:
+                        break
+                    frame = json.loads(msg.data)
+                    if frame.get("type") != "event":
+                        continue
+                    if frame.get("id") == thumb_id:
+                        got_thumb_ev = True
+                    elif frame.get("id") == prog_id:
+                        got_prog_ev = True
+                assert got_thumb_ev, "no jobs.newThumbnail event"
+                for sid in (thumb_id, prog_id):
+                    await ws_raw.send_json(
+                        {"id": sid, "type": "subscriptionStop"})
+
+                # invalidation feed: an invalidating mutation must push
+                # its key so the UI refetches
+                inv_id = 7102
+                await ws_raw.send_json(
+                    {"id": inv_id, "type": "subscription",
+                     "path": "invalidation.listen", "input": {}})
+                driven.add("invalidation.listen")
+                tag3 = await m("tags.create",
+                               {"library_id": lid, "name": "inv-probe"})
+                got_inv = None
+                for _ in range(40):
+                    msg = await asyncio.wait_for(ws_raw.receive(),
+                                                 timeout=10)
+                    frame = json.loads(msg.data)
+                    if (frame.get("id") == inv_id
+                            and frame.get("type") == "event"
+                            and frame["data"].get("key") == "tags.list"):
+                        got_inv = frame["data"]
+                        break
+                assert got_inv, "no invalidation event for tags.list"
+                await ws_raw.send_json(
+                    {"id": inv_id, "type": "subscriptionStop"})
+                await m("tags.delete", {"library_id": lid,
+                                        "id": tag3["id"]})
+
+                # sync.newMessage fires on local op-log writes
+                sync_id = 7103
+                await ws_raw.send_json(
+                    {"id": sync_id, "type": "subscription",
+                     "path": "sync.newMessage",
+                     "input": {"library_id": lid}})
+                driven.add("sync.newMessage")
+                await m("files.setNote",
+                        {"library_id": lid, "id": oid, "note": "sync ev"})
+                got_sync = False
+                for _ in range(40):
+                    msg = await asyncio.wait_for(ws_raw.receive(),
+                                                 timeout=10)
+                    frame = json.loads(msg.data)
+                    if (frame.get("id") == sync_id
+                            and frame.get("type") == "event"):
+                        got_sync = True
+                        break
+                assert got_sync, "no sync.newMessage event"
+                await ws_raw.send_json(
+                    {"id": sync_id, "type": "subscriptionStop"})
+
+                # notifications: library variant + dismiss ONE
+                await m("notifications.testLibrary", {"library_id": lid})
+                notifs = await q("notifications.get")
+                assert any(n["library_id"] == lid for n in notifs)
+                first = next(n for n in notifs if n["library_id"] == lid)
+                await m("notifications.dismiss",
+                        {"library_id": lid, "id": first["id"]})
+                after = await q("notifications.get")
+                assert next(n for n in after
+                            if n["id"] == first["id"])["read"] == 1
+
+                # clear ONE job report, keep the rest
+                reports5 = await q("jobs.reports", {"library_id": lid})
+                done = next(r for r in reports5 if r["status"] == 2)
+                await m("jobs.clear", {"library_id": lid,
+                                       "id": done["id"]})
+                left5 = await q("jobs.reports", {"library_id": lid})
+                assert all(r["id"] != done["id"] for r in left5)
+
+                # second location lifecycle: create → delete
+                extra_dir = os.path.join(corpus, "..", "extra-loc")
+                os.makedirs(extra_dir, exist_ok=True)
+                with open(os.path.join(extra_dir, "z.txt"), "w") as f:
+                    f.write("z")
+                loc2 = await m("locations.create",
+                               {"library_id": lid, "path": extra_dir,
+                                "dry_run": True})
+                await m("locations.delete",
+                        {"library_id": lid, "location_id": loc2})
+                locs5 = await q("locations.list", {"library_id": lid})
+                assert all(x["id"] != loc2 for x in locs5)
+
+                # library lifecycle: delete the second library
+                await m("library.delete", {"id": lib2["uuid"]})
+                assert all(x["uuid"] != lib2["uuid"]
+                           for x in await q("library.list"))
+
                 # ---- subscription round trip (notifications panel) ----
                 sub_id = 9001
                 await ws_raw.send_json({"id": sub_id, "type": "subscription",
@@ -607,5 +755,97 @@ def test_drive_ui_procedures(served):
         await node.shutdown()
 
     _run(main())
-    assert len(driven) >= 60, (
+    assert len(driven) >= 80, (
         f"only {len(driven)} procedures driven: {sorted(driven)}")
+
+
+def test_virtual_explorer_windows_100k(tmp_path):
+    """The explorer is VIRTUALIZED (VERDICT r4 item 2): the engine
+    handles 1M-file libraries, so its UI must browse past the first
+    window. This drives the exact windowed RPC sequence the virtual
+    grid issues (vgFetch: search.paths skip/take + server-side order)
+    against a generated 100k-file library and asserts scroll-to-end
+    reaches the last row with bounded per-window latency.
+
+    Static guards pin the JS to the windowed renderer: the old
+    `take: 400` full-fetch is gone, the window size respects the
+    server's take cap, and every server-side narrowing the windows
+    rely on (favorite/extensions/order) is sent by the client."""
+    import time
+    import uuid as uuidlib
+
+    js = _ui_js()
+    assert "take: 400" not in js, "explorer regressed to full fetch"
+    assert "vgFetch" in js and "skip:" in js
+    m = re.search(r"const VWIN = (\d+)", js)
+    assert m and int(m.group(1)) <= 500, "window exceeds server take cap"
+    for token in ("filter.favorite", "filter.extensions", "order:"):
+        assert token.replace("order:", "order") in js.replace(
+            "order:", "order"), token
+
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("big")
+    loc_id = lib.db.insert("location", {
+        "pub_id": uuidlib.uuid4().bytes, "name": "synthetic",
+        "path": str(tmp_path / "root")})
+    with lib.db.tx() as conn:
+        exts = ["txt", "jpg", "png", "pdf", "mp4", "py", ""]
+        conn.executemany(
+            "INSERT INTO file_path (pub_id, location_id,"
+            " materialized_path, name, extension, is_dir,"
+            " date_modified) VALUES (?, ?, ?, ?, ?, 0, ?)",
+            [(uuidlib.uuid4().bytes, loc_id, "/", f"file-{i:06d}",
+              exts[i % len(exts)], 1_700_000_000 + i)
+             for i in range(100_000)])
+
+    async def main():
+        server = ApiServer(node)
+        port = await server.start(port=0)
+        async with aiohttp.ClientSession() as http:
+            async with http.ws_connect(
+                    f"http://127.0.0.1:{port}/rspc") as ws_raw:
+                ws = _Ws(ws_raw)
+                lid = (await ws.q("library.list"))[0]["uuid"]
+                filt = {"location_id": loc_id,
+                        "materialized_path": "/"}
+                n = await ws.q("search.pathsCount",
+                               {"library_id": lid, "filter": filt})
+                assert n == 100_000
+                # scroll-to-end: the windows the virtual grid fetches
+                # on a jump to the bottom, plus spot windows on the way
+                worst = 0.0
+                for skip in (0, 37_800, 50_000, 99_800):
+                    t0 = time.monotonic()
+                    r = await ws.q("search.paths",
+                                   {"library_id": lid, "filter": filt,
+                                    "skip": skip, "take": 200})
+                    worst = max(worst, time.monotonic() - t0)
+                    assert len(r["items"]) == 200
+                    assert r["items"][0]["name"] == f"file-{skip:06d}"
+                assert r["items"][-1]["name"] == "file-099999", \
+                    "scroll-to-end did not reach the last row"
+                assert worst < 0.25, f"window latency {worst:.3f}s"
+                # server-side sort: deep window under the sorted order
+                t0 = time.monotonic()
+                r = await ws.q("search.paths",
+                               {"library_id": lid, "filter": filt,
+                                "skip": 99_995, "take": 5,
+                                "order": {"field": "name",
+                                          "desc": True}})
+                assert time.monotonic() - t0 < 1.5
+                assert r["items"][-1]["name"] == "file-000000"
+                # server-side extension filter keeps indices stable
+                n_img = await ws.q(
+                    "search.pathsCount",
+                    {"library_id": lid,
+                     "filter": {**filt, "extensions": ["jpg", "png"]}})
+                r = await ws.q(
+                    "search.paths",
+                    {"library_id": lid,
+                     "filter": {**filt, "extensions": ["jpg", "png"]},
+                     "skip": n_img - 2, "take": 2})
+                assert len(r["items"]) == 2 and all(
+                    x["extension"] in ("jpg", "png") for x in r["items"])
+        await server.stop()
+
+    _run(main())
