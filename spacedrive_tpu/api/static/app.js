@@ -451,12 +451,17 @@ async function renderEphemeral() {
 // the DOM, the same shape as the reference Explorer's
 // @tanstack/react-virtual grids (interface/app/$libraryId/Explorer/).
 const VWIN = 200;        // rows per fetched window (≤ server take cap)
+// Browsers clamp element heights (~17.9M px in Firefox); above this
+// the spacer stays capped and scrollTop maps into virtual row space
+// by ratio, so a 1M-row list (26M px) stays fully reachable.
+const VG_MAX_SPACER = 12_000_000;
 const MEDIA_EXTS = ["png","jpg","jpeg","gif","webp","bmp","tiff",
   "tif","heic","heif","avif","svg","svgz","pdf","avi","mp4","mkv",
   "mov","webm"];
 let vg = null;           // virtual-grid state for the current browse
 let vgResizeObs = null;  // one observer, re-pointed per browse
 let cursorIdx = null;    // keyboard cursor as an ABSOLUTE index
+let lastClickIdx = null; // shift-range anchor as an ABSOLUTE index
 
 function vgDims() {
   if (viewMode === "list") return {cellW: 0, cellH: 26, listMode: true};
@@ -562,8 +567,16 @@ function vgUpdate() {
   }
   vg.renderedCols = cols;
   const rows = Math.ceil(vg.count / cols);
-  vg.grid.style.height = Math.max(rows * cellH, 1) + "px";
-  const y0 = vg.wrap.scrollTop, y1 = y0 + vg.wrap.clientHeight;
+  const fullH = Math.max(rows * cellH, 1);
+  const spacerH = Math.min(fullH, VG_MAX_SPACER);
+  vg.grid.style.height = spacerH + "px";
+  const view = vg.wrap.clientHeight;
+  const scale = (fullH > spacerH && spacerH > view)
+    ? (fullH - view) / (spacerH - view) : 1;
+  const vTop = vg.wrap.scrollTop * scale;  // virtual pixel offset
+  const base = vg.wrap.scrollTop - vTop;   // virtual→spacer shift
+  vg.scale = scale;
+  const y0 = vTop, y1 = vTop + view;
   const r0 = Math.max(0, Math.floor(y0 / cellH) - 3);
   const r1 = Math.min(Math.max(rows - 1, 0), Math.ceil(y1 / cellH) + 3);
   const i0 = r0 * cols;
@@ -572,6 +585,9 @@ function vgUpdate() {
     vgFetch(w);
   for (const [idx, el] of [...vg.pool]) {
     if (idx < i0 || idx > i1) { el.remove(); vg.pool.delete(idx); }
+    else if (scale !== 1) {   // compressed spacer: tops shift per scroll
+      el.style.top = (base + Math.floor(idx / cols) * cellH) + "px";
+    }
   }
   for (let i = i0; i <= i1; i++) {
     if (vg.pool.has(i)) continue;
@@ -579,11 +595,10 @@ function vgUpdate() {
     if (!r) continue;    // window in flight; vgFetch re-renders
     const el = listMode ? listRow(r) : cell(r, null);
     el.style.position = "absolute";
+    el.style.top = (base + Math.floor(i / cols) * cellH) + "px";
     if (listMode) {
-      el.style.top = (i * cellH) + "px";
       el.style.left = "0"; el.style.right = "0";
     } else {
-      el.style.top = (Math.floor(i / cols) * cellH) + "px";
       el.style.left = ((i % cols) * cellW) + "px";
     }
     el.dataset.idx = i;
@@ -623,10 +638,13 @@ async function selectIndex(i) {
   cursorIdx = i;
   const {cellH} = vgDims();
   const cols = vgCols();
-  const top = Math.floor(i / cols) * cellH;
-  if (top < vg.wrap.scrollTop) vg.wrap.scrollTop = top;
-  else if (top + cellH > vg.wrap.scrollTop + vg.wrap.clientHeight)
-    vg.wrap.scrollTop = top + cellH - vg.wrap.clientHeight;
+  const scale = vg.scale || 1;
+  const top = Math.floor(i / cols) * cellH;  // virtual px
+  const vTop = vg.wrap.scrollTop * scale;
+  if (top < vTop) vg.wrap.scrollTop = top / scale;
+  else if (top + cellH > vTop + vg.wrap.clientHeight)
+    vg.wrap.scrollTop =
+      (top + cellH - vg.wrap.clientHeight) / scale;
   await vgFetch(Math.floor(i / VWIN));
   const r = lastRows[i];
   if (!r) return;
@@ -643,7 +661,9 @@ function openEntry(r) {
 }
 
 // ---- multi-select + context menu -------------------------------------
-function clearSel() { selection.clear(); lastClickId = null; }
+function clearSel() {
+  selection.clear(); lastClickId = null; lastClickIdx = null;
+}
 function updateSelClasses() {
   // selection changes repaint in place — no refetch, no DOM rebuild
   document.querySelectorAll("[data-fpid]").forEach(el =>
@@ -654,23 +674,23 @@ function entryClick(r, e) {
   // (dataset.idx, set by vgUpdate) — O(1) vs an O(count) indexOf over
   // the sparse array at 1M rows
   const el = e && e.currentTarget;
-  cursorIdx = (el && el.dataset && el.dataset.idx !== undefined)
+  const idx = (el && el.dataset && el.dataset.idx !== undefined)
     ? +el.dataset.idx : null;
-  if (e.shiftKey && lastClickId != null) {
-    // range select across the LOADED windows between the two anchors
-    const a = lastRows.findIndex(x => x && x.id === lastClickId);
-    const b = lastRows.findIndex(x => x && x.id === r.id);
-    if (a >= 0 && b >= 0) {
-      for (let k = Math.min(a, b); k <= Math.max(a, b); k++)
-        if (lastRows[k]) selection.add(lastRows[k].id);
-    }
+  cursorIdx = idx;
+  if (e.shiftKey && lastClickIdx != null && idx != null) {
+    // range select between the two ANCHOR INDICES — O(range), no
+    // O(count) scan of the sparse array (holes stay unselected)
+    for (let k = Math.min(lastClickIdx, idx);
+         k <= Math.max(lastClickIdx, idx); k++)
+      if (lastRows[k]) selection.add(lastRows[k].id);
     updateSelClasses();
   } else if (e.ctrlKey || e.metaKey) {
     selection.has(r.id) ? selection.delete(r.id) : selection.add(r.id);
-    lastClickId = r.id;
+    lastClickId = r.id; lastClickIdx = idx;
     updateSelClasses();
   } else {
     selection.clear(); selection.add(r.id); lastClickId = r.id;
+    lastClickIdx = idx;
     updateSelClasses();
     openEntry(r);
   }
@@ -1709,7 +1729,7 @@ sub("jobs.newThumbnail", null, (e) => {
   // live-patch just the matching cell's image — a directory of
   // hundreds of thumbnails must not trigger a refetch per event
   if (view !== "explorer" || !e.cas_id) return;
-  const r = lastRows.find(x => x.cas_id === e.cas_id);
+  const r = lastRows.find(x => x && x.cas_id === e.cas_id);
   if (!r) return;
   const el = document.querySelector(`[data-fpid="${r.id}"] .thumb`);
   if (!el || el.querySelector("img")) return;

@@ -95,15 +95,76 @@ class ObjectValidatorJob(StatefulJob):
             "expected": r["integrity_checksum"],
         } for r in rows]
 
+    # Files at or under this size batch-hash MANY per device dispatch
+    # (amortizing the tunnel's ~28 ms per-dispatch latency — VERDICT r4
+    # item 4); larger files stream through sequence-sharded windows.
+    SMALL_FILE_CAP = 4 << 20
+    BATCH_BYTES = 64 << 20   # real payload bytes per batched dispatch
+    BATCH_ROWS = 512
+
     def _checksums_jax(self, jobs, errors):
-        """Sequence-sharded device checksums, one file at a time in
-        mesh-window streams (whole-mesh window ≈ 8 MiB, i.e. ≈ 8 MiB / D
-        per device)."""
+        """Device checksums, two regimes:
+
+        - small files: sorted by size (tight shared chunk grid) and
+          packed into ONE batched dispatch per ~BATCH_BYTES page via
+          checksums_words_batched — the page pays the host↔device RPC
+          latency once instead of once per file;
+        - large files: each streamed through mesh-window sequence
+          sharding (bounded memory at any size, ops/seqhash.py)."""
+        import os as _os
+
         import jax
 
+        from ..ops.blake3_jax import checksums_words_batched
         from ..ops.seqhash import sharded_file_checksum
         from ..parallel.mesh import batch_mesh
 
+        small, big = [], []
+        for r, path in jobs:
+            try:
+                sz = _os.path.getsize(path)
+            except OSError as e:
+                errors.append(f"{path}: {e}")
+                continue
+            (small if sz <= self.SMALL_FILE_CAP else big).append(
+                (r, path, sz))
+
+        small.sort(key=lambda t: t[2])
+
+        def _padded_row(sz: int) -> int:
+            # the dispatch grid pads every row to the batch's pow2 max
+            # chunk count — ascending size order means the CURRENT file
+            # sets that max, so charge its padded cost to the budget
+            chunks = max(1, -(-max(sz, 1) // 1024))
+            return (1 << (chunks - 1).bit_length()) * 1024
+
+        i = 0
+        while i < len(small):
+            batch, blobs = [], []
+            while i < len(small) and len(batch) < self.BATCH_ROWS:
+                r, path, sz = small[i]
+                # Budget the PADDED grid, not the raw payload: one 4 MiB
+                # file after 500 tiny ones would otherwise balloon the
+                # dispatch to rows × pow2(max) ≈ GiBs of zeros.
+                if batch and (len(batch) + 1) * _padded_row(sz) \
+                        > self.BATCH_BYTES:
+                    break
+                i += 1
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError as e:
+                    errors.append(f"{path}: {e}")
+                    continue
+                blobs.append(data)
+                batch.append((r, path))
+            if blobs:
+                hexes = checksums_words_batched(blobs)
+                for (r, path), hx in zip(batch, hexes):
+                    yield r, path, hx
+
+        if not big:
+            return
         # Streaming windows need a power-of-two device count (subtree
         # alignment); on e.g. a 6- or 12-device mesh use the largest
         # power-of-two subset instead of erroring on every file.
@@ -114,7 +175,7 @@ class ObjectValidatorJob(StatefulJob):
         shard_chunks = max(64, (8 << 20) // (D * 1024))
         # power-of-two shard size for subtree alignment
         shard_chunks = 1 << (shard_chunks - 1).bit_length()
-        for r, path in jobs:
+        for r, path, _sz in big:
             try:
                 yield r, path, sharded_file_checksum(
                     mesh, path, shard_chunks=shard_chunks)

@@ -198,6 +198,55 @@ def _bucket_b(B: int) -> int:
     return -(-B // _B_BUCKETS[-1]) * _B_BUCKETS[-1]
 
 
+def checksums_words_batched(blobs) -> list:
+    """Full BLAKE3 digests (64-hex) of B byte strings in ONE device
+    dispatch: rows padded to a shared power-of-two chunk grid, hashed by
+    the batch machinery (sharded over the mesh when >1 device).
+
+    This is the validator's RPC amortizer (VERDICT r4 item 4): the
+    tunneled bench chip costs ~28 ms per dispatch, so hashing one file
+    per call capped the device validator at ~36 files/s regardless of
+    kernel speed — packing a page of small files into one batched grid
+    pays that latency once per page. Callers group similar sizes per
+    call (validator sorts by size) so the shared C pads little.
+    """
+    import os as _os
+
+    B = len(blobs)
+    if B == 0:
+        return []
+    from .blake3_batch import CHUNK_LEN, WORDS_PER_CHUNK, digests_to_hex
+
+    maxlen = max(len(b) for b in blobs)
+    C = max(1, -(-max(maxlen, 1) // CHUNK_LEN))
+    C = 1 << (C - 1).bit_length()   # pow2 → few compiled grids
+    hasher, n_dev = sharded_hasher()
+    if hasher is None:
+        hasher = blake3_words
+    Bp = _bucket_b(B)
+    if n_dev > 1:
+        from ..parallel.mesh import pad_to_multiple
+
+        Bp = pad_to_multiple(Bp, n_dev)
+    buf = np.zeros((Bp, C * CHUNK_LEN), dtype=np.uint8)
+    lengths = np.zeros((Bp,), dtype=np.int32)
+    for i, b in enumerate(blobs):
+        buf[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lengths[i] = len(b)
+    words = buf.view("<u4").reshape(Bp, C, WORDS_PER_CHUNK)
+    if _os.environ.get("SDTPU_DISPATCH_LOG") == "1":
+        DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev, "C": C,
+                             "kind": "checksum"})
+    return digests_to_hex(hasher(words, lengths)[:B])
+
+
+# Dispatch observability: when SDTPU_DISPATCH_LOG=1, every cas_ids_jax
+# call appends {"B", "Bp", "n_dev"} here — per-device shard balance is
+# Bp/n_dev by construction (batch padded to a devices-multiple), and
+# the dryrun/driver artifacts record it from this log.
+DISPATCH_LOG: list = []
+
+
 def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
     """End-to-end device CAS: payload rows + sizes → 16-hex CAS IDs.
 
@@ -205,6 +254,8 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
     the mesh-sharded program (batch padded to a devices-multiple so
     every shard gets equal rows); single-device hosts use the local
     jit/Pallas path."""
+    import os as _os
+
     n_dev = 1
     if hasher is None:
         hasher, n_dev = sharded_hasher()
@@ -222,4 +273,6 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
             [words, np.zeros((Bp - B,) + words.shape[1:], words.dtype)])
         lengths = np.concatenate(
             [lengths, np.zeros((Bp - B,), lengths.dtype)])
+    if _os.environ.get("SDTPU_DISPATCH_LOG") == "1":
+        DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev})
     return digests_to_cas_ids(hasher(words, lengths)[:B])

@@ -505,9 +505,25 @@ class SyncManager:
         carries is covered by same-or-newer update ops on the record —
         so a newer multi-update supersedes a stale single-field op and
         vice versa. The (model, record_id) lazy index narrows the scan
-        to one record's ops."""
+        to one record's ops.
+
+        Deletes are REMOVE-WINS: a 'd' tombstone in the log makes every
+        non-delete op on that record stale regardless of timestamps.
+        Without this the outcome depended on ARRIVAL order — a node
+        that applied delete-then-update resurrected the row (seed_row
+        upsert) while one that applied update-then-delete kept it dead
+        — permanent divergence, found by the 3-node fuzz harness.
+        Remove-wins is safe because pub_ids are unique mints, never
+        reused after a delete."""
         t = op.typ
         if isinstance(t, SharedOp):
+            if not t.delete:
+                row = self.db.query_one(
+                    "SELECT 1 FROM shared_operation WHERE model = ? "
+                    "AND record_id = ? AND kind = 'd' LIMIT 1",
+                    (t.model, pack_value(t.record_id)))
+                if row is not None:
+                    return True  # tombstoned — remove-wins
             kind = t.kind
             if kind.startswith("u:"):
                 fields = set(OpKind.update_fields(kind))
@@ -527,13 +543,30 @@ class SyncManager:
         else:
             # Unlike ingest.rs:209-224 (item-only), group_id participates:
             # ops on different groups of one item are independent records.
-            row = self.db.query_one(
-                "SELECT timestamp FROM relation_operation "
-                "WHERE timestamp >= ? AND relation = ? AND item_id = ? "
-                "AND group_id = ? AND kind = ? "
-                "ORDER BY timestamp DESC LIMIT 1",
-                (op.timestamp, t.relation, pack_value(t.item_id),
-                 pack_value(t.group_id), t.kind))
+            # Existence of a link is LWW between 'c' and 'd' BY
+            # TIMESTAMP, independent of arrival order (the shared-op
+            # remove-wins fix, mirrored — but timestamp-aware, because
+            # unlike pub_ids a relation pair IS legitimately
+            # re-creatable by a later re-assign):
+            #  - any op is stale under a same-or-newer delete;
+            #  - a delete is also stale under a STRICTLY newer create
+            #    (re-assign after delete revives the link);
+            #  - same-kind same-or-newer ops dedup redelivery, as ever.
+            key = (t.relation, pack_value(t.item_id),
+                   pack_value(t.group_id))
+            if t.delete:
+                row = self.db.query_one(
+                    "SELECT 1 FROM relation_operation WHERE relation = ? "
+                    "AND item_id = ? AND group_id = ? AND "
+                    "((kind = 'd' AND timestamp >= ?) OR "
+                    " (kind = 'c' AND timestamp > ?)) LIMIT 1",
+                    key + (op.timestamp, op.timestamp))
+            else:
+                row = self.db.query_one(
+                    "SELECT 1 FROM relation_operation WHERE relation = ? "
+                    "AND item_id = ? AND group_id = ? AND timestamp >= ? "
+                    "AND kind IN (?, 'd') LIMIT 1",
+                    key + (op.timestamp, t.kind))
         return row is not None
 
     # -- generic ModelSyncData apply ---------------------------------------

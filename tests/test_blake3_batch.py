@@ -80,3 +80,72 @@ def test_mixed_batch_includes_single_chunk_and_empty():
     got = blake3_batch_np(msgs)
     for m, d in zip(msgs, got):
         assert d == blake3_digest(m)
+
+
+def test_checksums_words_batched_oracle_and_edges():
+    """One-dispatch batched full-file checksums (the validator's RPC
+    amortizer) must be oracle-exact across the boundary sizes: empty,
+    one byte, exact chunk, chunk+1, multi-chunk tree, and mixed sizes
+    sharing one padded grid."""
+    from spacedrive_tpu.ops.blake3_batch import blake3_batch_np
+    from spacedrive_tpu.ops.blake3_jax import checksums_words_batched
+
+    rng = np.random.default_rng(33)
+    blobs = [
+        b"",
+        b"a",
+        bytes(rng.integers(0, 256, 1024, dtype=np.uint8)),
+        bytes(rng.integers(0, 256, 1025, dtype=np.uint8)),
+        bytes(rng.integers(0, 256, 5_000, dtype=np.uint8)),
+        bytes(rng.integers(0, 256, 64 * 1024, dtype=np.uint8)),
+        bytes(rng.integers(0, 256, 64 * 1024 + 1, dtype=np.uint8)),
+    ]
+    got = checksums_words_batched(blobs)
+    want = [d.hex() for d in blake3_batch_np(blobs)]
+    assert got == want
+    # a second call with ONE max-size blob exercises the B-pad path on
+    # the SAME (B, C) grid — no second ~45 s CPU compile in the suite
+    assert checksums_words_batched(blobs[6:7]) == want[6:7]
+    assert checksums_words_batched([]) == []
+
+
+def test_validator_batch_budget_charges_padded_grid(tmp_path):
+    """500 tiny files + one 4 MiB file must not share a dispatch: the
+    grid pads every row to the batch max, so the budget charges
+    rows × pow2(max), not raw payload (round-5 review finding)."""
+    from spacedrive_tpu.objects.validator import ObjectValidatorJob
+
+    job = ObjectValidatorJob(location_id=1, backend="jax")
+    small = [(None, str(tmp_path / f"s{i}.bin")) for i in range(50)]
+    for _, p in small:
+        with open(p, "wb") as f:
+            f.write(b"x" * 512)
+    bigp = str(tmp_path / "big.bin")
+    with open(bigp, "wb") as f:
+        f.write(os.urandom(4 << 20))
+
+    calls = []
+    import spacedrive_tpu.ops.blake3_jax as bj
+
+    def spy(blobs):
+        # packing-only test: record dispatch shapes, skip real hashing
+        calls.append([len(b) for b in blobs])
+        return ["0" * 64 for _ in blobs]
+
+    errors = []
+    orig = bj.checksums_words_batched
+    bj.checksums_words_batched = spy
+    try:
+        out = list(job._checksums_jax(small + [(None, bigp)], errors))
+    finally:
+        bj.checksums_words_batched = orig
+    assert not errors, errors
+    assert len(out) == 51
+    # the 4 MiB row must be in its own dispatch (or one with few rows):
+    # no dispatch may pad beyond the budget
+    for shape in calls:
+        padded = max(1, max(
+            1 << (max(1, -(-max(sz, 1) // 1024)) - 1).bit_length()
+            for sz in shape)) * 1024 * len(shape)
+        assert padded <= ObjectValidatorJob.BATCH_BYTES, (shape, padded)
+    assert any(len(s) == 1 and s[0] == (4 << 20) for s in calls), calls

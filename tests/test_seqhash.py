@@ -143,9 +143,11 @@ def test_validator_jax_backend_streams_checksums(tmp_path):
     corpus.mkdir()
     rng = np.random.default_rng(21)
     blobs = {}
-    # multi.bin exceeds one shard (1 MiB at the 8-device CPU mesh's
-    # 8 MiB window), so the sequence-sharded device path really runs.
-    for name, size in [("small.bin", 3_000), ("multi.bin", 1_300_000)]:
+    # small.bin + multi.bin are under SMALL_FILE_CAP → the round-5
+    # BATCHED dispatch path; huge.bin exceeds the cap, so the
+    # sequence-sharded streaming path really runs too.
+    for name, size in [("small.bin", 3_000), ("multi.bin", 1_300_000),
+                       ("huge.bin", (4 << 20) + 70_000)]:
         data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
         (corpus / name).write_bytes(data)
         blobs[name] = data

@@ -678,3 +678,88 @@ def test_redelivered_parked_op_does_not_duplicate(pair):
         "SELECT COUNT(*) AS n FROM tag_on_object")["n"] == 1
     assert b.db.query_one(
         "SELECT COUNT(*) AS n FROM relation_operation")["n"] == 1
+
+
+def test_delete_is_remove_wins_under_any_arrival_order(tmp_path):
+    """create(t1) / delete(t2) / update(t3>t2) delivered in BOTH orders
+    must converge to the row being GONE: deletes are remove-wins (a
+    tombstone makes later-arriving non-delete ops stale), or the
+    outcome depends on arrival order — the divergence the 3-node fuzz
+    harness caught (round 5)."""
+    a_id, b_id, c_id = (uuid.uuid4().bytes for _ in range(3))
+    mk = {}
+    for name, my in (("a", a_id), ("b", b_id), ("c", c_id)):
+        db = Database(tmp_path / f"{name}.db")
+        for pid in (a_id, b_id, c_id):
+            _mk_instance(db, pid)
+        mk[name] = SyncManager(db, my)
+    a, b, c = mk["a"], mk["b"], mk["c"]
+
+    pub = uuid.uuid4().bytes
+    create = a.shared_create("tag", pub, {"name": "x", "color": "#111"})[0]
+    delete = a.shared_delete("tag", pub)
+    update = a.shared_update("tag", pub, "name", "resurrected?")
+    assert create.timestamp < delete.timestamp < update.timestamp
+
+    # B: update arrives BEFORE the delete (newer-update-then-delete)
+    for op in (create, update, delete):
+        b.receive_crdt_operation(op)
+    # C: delete arrives BEFORE the newer update (delete-then-update)
+    for op in (create, delete, update):
+        c.receive_crdt_operation(op)
+
+    for m in (b, c):
+        assert m.db.query_one(
+            "SELECT COUNT(*) AS n FROM tag")["n"] == 0, m
+    # and a late-arriving CREATE cannot resurrect either
+    assert not c.receive_crdt_operation(create)
+    assert c.db.query_one("SELECT COUNT(*) AS n FROM tag")["n"] == 0
+
+
+def test_relation_existence_is_lww_by_timestamp_any_order(tmp_path):
+    """Link existence resolves by TIMESTAMP between 'c' and 'd', not
+    arrival order (round-5 review: the shared remove-wins fix mirrored
+    for relations — timestamp-aware, since a link IS legitimately
+    re-creatable by a later re-assign)."""
+    a_id, b_id, c_id = (uuid.uuid4().bytes for _ in range(3))
+    mk = {}
+    for name, my in (("a", a_id), ("b", b_id), ("c", c_id)):
+        db = Database(tmp_path / f"{name}.db")
+        for pid in (a_id, b_id, c_id):
+            _mk_instance(db, pid)
+        mk[name] = SyncManager(db, my)
+    a, b, c = mk["a"], mk["b"], mk["c"]
+
+    tag_pub, obj_pub = uuid.uuid4().bytes, uuid.uuid4().bytes
+    setup = (a.shared_create("tag", tag_pub, {"name": "t"})
+             + a.shared_create("object", obj_pub, {"kind": 5}))
+    c1 = a.relation_create("tag_on_object", obj_pub, tag_pub)[0]   # t1
+    d = a.relation_delete("tag_on_object", obj_pub, tag_pub)       # t2
+    c2 = a.relation_create("tag_on_object", obj_pub, tag_pub)[0]   # t3
+    assert c1.timestamp < d.timestamp < c2.timestamp
+
+    def n_links(m):
+        return m.db.query_one(
+            "SELECT COUNT(*) AS n FROM tag_on_object")["n"]
+
+    # B: in-order (c1, d, c2) → the re-assign revives the link
+    for op in setup + [c1, d, c2]:
+        b.receive_crdt_operations([op])
+    assert n_links(b) == 1
+    # C: delete arrives LAST but is older than the re-assign → link
+    # must still exist (an arrival-order-dependent delete diverged here)
+    for op in setup + [c1, c2, d]:
+        c.receive_crdt_operations([op])
+    assert n_links(c) == 1
+
+    # and without a revive, both orders converge to GONE
+    tag2, obj2 = uuid.uuid4().bytes, uuid.uuid4().bytes
+    setup2 = (a.shared_create("tag", tag2, {"name": "u"})
+              + a.shared_create("object", obj2, {"kind": 5}))
+    c3 = a.relation_create("tag_on_object", obj2, tag2)[0]
+    d2 = a.relation_delete("tag_on_object", obj2, tag2)
+    for op in setup2 + [c3, d2]:
+        b.receive_crdt_operations([op])
+    for op in setup2 + [d2, c3]:   # delete first, create late
+        c.receive_crdt_operations([op])
+    assert n_links(b) == 1 and n_links(c) == 1  # only the revived link

@@ -68,7 +68,8 @@ async def run(n_files: int, file_kb: int) -> None:
         "files": n_done,
         "file_kb": file_kb,
         "seconds": round(dt, 2),
-        "backend": "jax (StreamingShardedChecksum on the local mesh)",
+        "backend": "jax (batched small-file dispatches + StreamingShardedChecksum for large)",
+        "batched_small_files": True,
     }))
     await node.shutdown()
 
