@@ -54,6 +54,8 @@ def register_all(router: Router) -> None:
     _volumes(router)
     _tags(router)
     _labels(router)
+    _spaces(router)
+    _albums(router)
     _categories(router)
     _locations(router)
     _files(router)
@@ -318,6 +320,112 @@ def _labels(r: Router) -> None:
                          (lb["id"],))
             library.db.delete("label", lb["id"], conn=conn)
         return None
+
+
+# -- spaces / albums (net-new API over schema.prisma:389-411/448-477's
+# models — the reference registers the tables but ships NO api/ui for
+# them; both stay LOCAL sync mode, matching its unannotated models) ----
+
+def _grouping(r: Router, kind: str, rel: str, fk: str,
+              extra_fields: tuple,
+              rel_has_date_created: bool = False) -> None:
+    """Shared CRUD for the two object-grouping models (space/album):
+    identical shape, different table names and editable columns."""
+    list_key = f"{kind}s.list"
+    get_key = f"{kind}s.get"
+
+    @r.query(f"{kind}s.list", library=True)
+    def g_list(node, library, _input):
+        return rows_to_dicts(library.db.query(
+            f"SELECT g.*, COUNT(r.{fk}) AS object_count "
+            f"FROM {kind} g LEFT JOIN {rel} r ON r.{fk} = g.id "
+            f"GROUP BY g.id"))
+
+    @r.query(f"{kind}s.get", library=True)
+    def g_get(node, library, input):
+        row = library.db.query_one(
+            f"SELECT * FROM {kind} WHERE id = ?", (int(input["id"]),))
+        if row is None:
+            raise RpcError("NOT_FOUND", f"no such {kind}")
+        out = row_to_dict(row)
+        out["object_ids"] = [x["object_id"] for x in library.db.query(
+            f"SELECT object_id FROM {rel} WHERE {fk} = ?", (row["id"],))]
+        return out
+
+    @r.mutation(f"{kind}s.create", library=True, invalidates=[list_key])
+    def g_create(node, library, input):
+        values = {"name": str(input["name"]),
+                  "date_created": int(time.time()),
+                  "date_modified": int(time.time())}
+        for f in extra_fields:
+            if f in input:
+                values[f] = input[f]
+        gid = library.db.insert(kind, {"pub_id": uuid_bytes(), **values})
+        return {"id": gid, **values}
+
+    @r.mutation(f"{kind}s.update", library=True,
+                invalidates=[list_key, get_key])
+    def g_update(node, library, input):
+        row = library.db.query_one(
+            f"SELECT id FROM {kind} WHERE id = ?", (int(input["id"]),))
+        if row is None:
+            raise RpcError("NOT_FOUND", f"no such {kind}")
+        values = {k: input[k] for k in ("name",) + extra_fields
+                  if k in input}
+        values["date_modified"] = int(time.time())
+        library.db.update(kind, row["id"], values)
+        return None
+
+    @r.mutation(f"{kind}s.delete", library=True,
+                invalidates=[list_key, get_key])
+    def g_delete(node, library, input):
+        with library.db.tx() as conn:
+            conn.execute(f"DELETE FROM {rel} WHERE {fk} = ?",
+                         (int(input["id"]),))
+            conn.execute(f"DELETE FROM {kind} WHERE id = ?",
+                         (int(input["id"]),))
+        return None
+
+    @r.mutation(f"{kind}s.addObjects", library=True,
+                invalidates=[list_key, f"{kind}s.get"])
+    def g_add(node, library, input):
+        gid = int(input["id"])
+        if library.db.query_one(
+                f"SELECT 1 FROM {kind} WHERE id = ?", (gid,)) is None:
+            raise RpcError("NOT_FOUND", f"no such {kind}")
+        now = int(time.time())
+        with library.db.tx() as conn:
+            for oid in input["object_ids"]:
+                if rel_has_date_created:
+                    conn.execute(
+                        f"INSERT OR IGNORE INTO {rel} ({fk}, object_id, "
+                        f"date_created) VALUES (?, ?, ?)",
+                        (gid, int(oid), now))
+                else:
+                    conn.execute(
+                        f"INSERT OR IGNORE INTO {rel} ({fk}, object_id) "
+                        f"VALUES (?, ?)", (gid, int(oid)))
+        return None
+
+    @r.mutation(f"{kind}s.removeObjects", library=True,
+                invalidates=[list_key, f"{kind}s.get"])
+    def g_remove(node, library, input):
+        with library.db.tx() as conn:
+            for oid in input["object_ids"]:
+                conn.execute(
+                    f"DELETE FROM {rel} WHERE {fk} = ? AND object_id = ?",
+                    (int(input["id"]), int(oid)))
+        return None
+
+
+def _spaces(r: Router) -> None:
+    _grouping(r, "space", "object_in_space", "space_id",
+              ("description",))
+
+
+def _albums(r: Router) -> None:
+    _grouping(r, "album", "object_in_album", "album_id",
+              ("is_hidden",), rel_has_date_created=True)
 
 
 # -- categories. (api/categories.rs: object-kind counts) -------------------
@@ -928,6 +1036,14 @@ def _search_paths_where(input) -> tuple:
         where += (" AND fp.object_id IN "
                   "(SELECT id FROM object WHERE favorite = ?)")
         params.append(int(bool(f["favorite"])))
+    if f.get("album_id"):
+        where += (" AND fp.object_id IN (SELECT object_id FROM "
+                  "object_in_album WHERE album_id = ?)")
+        params.append(int(f["album_id"]))
+    if f.get("space_id"):
+        where += (" AND fp.object_id IN (SELECT object_id FROM "
+                  "object_in_space WHERE space_id = ?)")
+        params.append(int(f["space_id"]))
     if f.get("extensions"):
         ph = ",".join("?" for _ in f["extensions"])
         where += f" AND LOWER(fp.extension) IN ({ph})"

@@ -90,6 +90,7 @@ const fmtBytes = (n) => {
 
 let lib = null, loc = null, curPath = "/", view = "explorer";
 let selected = null, tagFilter = null, favOnly = false, allTags = [];
+let albumFilter = null, spaceFilter = null;  // object-grouping filters
 let kindFilter = null;         // ObjectKind filter from the overview
 let viewMode = "grid";         // grid | list | media (explorer modes)
 let sortKey = null, sortDir = 1;  // list-view column sort
@@ -176,7 +177,54 @@ async function loadLibs() {
   if (!lib && libs.length) { lib = libs[0].uuid; loadAll(); }
 }
 function loadAll() {
-  loadLibs(); loadLocs(); loadTags(); loadSaved(); loadStats(); render();
+  loadLibs(); loadLocs(); loadTags(); loadGroupings();
+  loadSaved(); loadStats(); render();
+}
+
+// ---- albums / spaces (object groupings over the reference's
+// schema.prisma:389-411/448-477 models — it ships no UI for them) --
+async function loadGroupings() {
+  if (!lib) return;
+  for (const kind of ["album", "space"]) {
+    const rows = await q(`${kind}s.list`, {library_id: lib});
+    const el = document.getElementById(kind + "s");
+    el.innerHTML = "";
+    for (const g of rows) {
+      const d = document.createElement("span");
+      const active = (kind === "album" ? albumFilter : spaceFilter)
+        === g.id;
+      d.className = "tagchip" + (active ? " on" : "");
+      d.textContent = g.name +
+        (g.object_count ? ` (${g.object_count})` : "");
+      d.title = "click: filter · dblclick: rename · " +
+        "right-click: delete";
+      d.onclick = () => {
+        if (kind === "album") {
+          albumFilter = albumFilter === g.id ? null : g.id;
+        } else {
+          spaceFilter = spaceFilter === g.id ? null : g.id;
+        }
+        loadGroupings(); render();
+      };
+      d.ondblclick = async () => {
+        const name = prompt(`${kind} name`, g.name);
+        if (!name) return;
+        await mut(`${kind}s.update`,
+                  {library_id: lib, id: g.id, name});
+        loadGroupings();
+      };
+      d.oncontextmenu = async (e) => {
+        e.preventDefault();
+        if (confirm(`delete ${kind} \"${g.name}\"?`)) {
+          await mut(`${kind}s.delete`, {library_id: lib, id: g.id});
+          if (albumFilter === g.id) albumFilter = null;
+          if (spaceFilter === g.id) spaceFilter = null;
+          loadGroupings(); render();
+        }
+      };
+      el.appendChild(d);
+    }
+  }
 }
 
 // ---- saved searches (stored in library preferences, the reference's
@@ -479,15 +527,25 @@ function vgCols() {
 async function browse() {
   const main = document.getElementById("main");
   vg = null; cursorIdx = null;
-  if (!lib || (loc == null && kindFilter == null)) { main.innerHTML =
-    "<div class='muted'>create a library and add a location</div>"; return; }
+  if (!lib || (loc == null && kindFilter == null
+               && albumFilter == null && spaceFilter == null)) {
+    main.innerHTML =
+      "<div class='muted'>create a library and add a location</div>";
+    return;
+  }
   const searchText = document.getElementById("search").value.trim();
   // kind drill-down from the overview is LIBRARY-wide (matching the
   // tile's count); normal browsing scopes to the selected location.
+  // album/space/kind drill-downs are LIBRARY-wide; normal browsing
+  // scopes to the selected location + current folder
+  const libraryWide = kindFilter != null || albumFilter != null
+    || spaceFilter != null;
   const filter = kindFilter != null ? {object_kind: [kindFilter]}
-                                    : {location_id: loc};
+    : (libraryWide ? {} : {location_id: loc});
+  if (albumFilter != null) filter.album_id = albumFilter;
+  if (spaceFilter != null) filter.space_id = spaceFilter;
   if (searchText) filter.search = searchText;
-  else if (kindFilter == null) filter.materialized_path = curPath;
+  else if (!libraryWide) filter.materialized_path = curPath;
   if (tagFilter != null) filter.tags = [tagFilter];
   // Every narrowing is SERVER-side: client-side filtering would leave
   // holes in the windows and shift absolute indices.
@@ -499,7 +557,7 @@ async function browse() {
   const kindChip = kindFilter == null ? "" :
     ` · <span class="tagchip on" id="kindchip">kind: ` +
     `${esc(KIND_NAMES[kindFilter] ?? kindFilter)} ✕</span>`;
-  const showUp = !searchText && kindFilter == null && curPath !== "/";
+  const showUp = !searchText && !libraryWide && curPath !== "/";
   const upBtn = showUp
     ? `<span class="tagchip" id="upbtn">⬆ ..</span> · ` : "";
   main.innerHTML =
@@ -860,6 +918,30 @@ function showCtx(r, e) {
          await mut("tags.assign", {library_id: lib, tag_id: t.id,
                                    object_id: x.object_id});
        toast(`tagged ${n}`); loadTags(); }],
+    [`Add to album… (${n})`, async () => {
+       const albums = await q("albums.list", {library_id: lib});
+       const nm = prompt("album name" + (albums.length
+         ? ` (existing: ${albums.map(a => a.name).join(", ")})` : ""));
+       if (!nm) return;
+       let a2 = albums.find(x => x.name === nm);
+       if (!a2) a2 = await mut("albums.create",
+                               {library_id: lib, name: nm});
+       const ids = rows.map(x => x.object_id).filter(v => v != null);
+       await mut("albums.addObjects",
+                 {library_id: lib, id: a2.id, object_ids: ids});
+       toast(`added ${ids.length} to ${nm}`); loadGroupings(); }],
+    [`Add to space… (${n})`, async () => {
+       const sps = await q("spaces.list", {library_id: lib});
+       const nm = prompt("space name" + (sps.length
+         ? ` (existing: ${sps.map(s => s.name).join(", ")})` : ""));
+       if (!nm) return;
+       let sp = sps.find(x => x.name === nm);
+       if (!sp) sp = await mut("spaces.create",
+                               {library_id: lib, name: nm});
+       const ids = rows.map(x => x.object_id).filter(v => v != null);
+       await mut("spaces.addObjects",
+                 {library_id: lib, id: sp.id, object_ids: ids});
+       toast(`added ${ids.length} to ${nm}`); loadGroupings(); }],
     [`Validate (${n})`, async () => {
        await mut("jobs.objectValidator",
                  {library_id: lib, id: loc, mode: "fill"});
@@ -1666,6 +1748,17 @@ document.getElementById("newtag").onclick = async () => {
   await mut("tags.create", {library_id: lib, name, color});
   loadTags();
 };
+document.getElementById("newalbum").onclick = async () => {
+  const name = prompt("album name"); if (!name || !lib) return;
+  await mut("albums.create", {library_id: lib, name});
+  loadGroupings();
+};
+document.getElementById("newspace").onclick = async () => {
+  const name = prompt("space name"); if (!name || !lib) return;
+  const description = prompt("description (optional)") || null;
+  await mut("spaces.create", {library_id: lib, name, description});
+  loadGroupings();
+};
 document.getElementById("search").oninput = (() => {
   let h; return () => { clearTimeout(h); h = setTimeout(() => {
     if (view !== "explorer") { view = "explorer"; renderTabs(); }
@@ -1720,6 +1813,8 @@ sub("invalidation.listen", null, (e) => {
   if (e.key === "search.paths" && view === "explorer") browse();
   if (e.key === "library.list") loadLibs();
   if (e.key === "tags.list") loadTags();
+  if (e.key === "albums.list" || e.key === "spaces.list")
+    loadGroupings();
   if (e.key === "jobs.reports" && view === "jobs") renderJobs();
 });
 sub("notifications.listen", null, (e) => {

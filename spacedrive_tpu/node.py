@@ -130,9 +130,15 @@ class OrphanRemover:
         if not rows:
             return 0
         sync = self.library.sync
+        from .sync.manager import cascade_local_fks
+
         ops = [sync.shared_delete("object", r["pub_id"]) for r in rows]
         with sync.write_ops(ops) as conn:
             for r in rows:
+                # membership rows (tags/labels/albums/spaces) have no
+                # DDL ON DELETE — a raw delete would FK-fail and abort
+                # the whole batch (round-5 review finding)
+                cascade_local_fks(conn, "object", r["id"])
                 conn.execute("DELETE FROM object WHERE id = ?", (r["id"],))
         return len(rows)
 

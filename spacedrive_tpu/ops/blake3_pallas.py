@@ -314,9 +314,16 @@ def chunk_cvs_pallas(words, lengths, counter_base=0, whole=True,
                              lo, hi, whole_mask, interpret=interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def blake3_words_pallas(words, lengths, interpret: bool = False):
     """[B, C, 256] words + [B] lengths → [B, 8] digests (fast-path
-    Pallas chunk stage + jnp tree reduction)."""
+    Pallas chunk stage + jnp tree reduction).
+
+    The WHOLE pipeline is one jitted program: the chunk stage alone was
+    jitted before, which left the ~log2(C) tree-reduce levels running
+    EAGERLY — locally that's a few extra dispatches, but through the
+    tunneled bench chip every eager jnp op is its own RPC round-trip
+    (+compile), turning one batched validator dispatch into ~47 s."""
     from .blake3_batch import tree_reduce
 
     cvs, n_chunks = _chunk_cvs_pallas_fast(words, lengths,

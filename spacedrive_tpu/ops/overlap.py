@@ -43,10 +43,16 @@ class PipelineStats:
     batches: int = 0
     batch_files: int = 0
     # serial reference components, measured on one calibration batch
-    # (t_kernel_1 includes the small digest D2H):
+    # BEFORE the run and once more AFTER it — the tunneled link's
+    # weather drifts minute to minute, and round 4's single pre-run
+    # calibration produced a "bound" BELOW the measured rate when the
+    # link improved mid-run (t_kernel_1 includes the small digest D2H):
     t_stage_1: float = 0.0
     t_h2d_1: float = 0.0
     t_kernel_1: float = 0.0
+    t_stage_2: float = 0.0
+    t_h2d_2: float = 0.0
+    t_kernel_2: float = 0.0
 
     @property
     def files_per_sec(self) -> float:
@@ -54,10 +60,18 @@ class PipelineStats:
 
     @property
     def bound_files_per_sec(self) -> float:
-        """The max(stage, transfer, kernel+fetch) steady-state bound
-        from the calibration components — what a perfect pipeline
-        would sustain."""
-        denom = max(self.t_stage_1, self.t_h2d_1, self.t_kernel_1)
+        """The max(stage, transfer, kernel+fetch) steady-state bound —
+        what a perfect pipeline would sustain under the BEST link
+        conditions observed in the bracketing calibrations (per-
+        component minimum of the pre/post measurements), so
+        bound >= measured holds unless the link beat both brackets
+        mid-run."""
+        def best(a, b):
+            return min(x for x in (a, b) if x > 0) \
+                if (a > 0 or b > 0) else 0.0
+        denom = max(best(self.t_stage_1, self.t_stage_2),
+                    best(self.t_h2d_1, self.t_h2d_2),
+                    best(self.t_kernel_1, self.t_kernel_2))
         return self.batch_files / denom if denom else 0.0
 
 
@@ -147,6 +161,20 @@ def run_overlapped(
     stats.wall_s = time.perf_counter() - t_wall
     stats.files = sum(len(p) for p, _ in batches[1:])
     pool.shutdown()
+
+    # Post-run calibration bracket: same components, same batch-0 data,
+    # measured the moment the pipeline drains — bound_files_per_sec
+    # takes the per-component best of the two brackets.
+    t0 = time.perf_counter()
+    words, lengths = _stage_batch(paths0, sizes0)
+    stats.t_stage_2 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    w = jax.device_put(words); l = jax.device_put(lengths)
+    _sync_marker()
+    stats.t_h2d_2 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(jfn(w, l))
+    stats.t_kernel_2 = time.perf_counter() - t0
     return results, stats
 
 

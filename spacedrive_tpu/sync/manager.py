@@ -39,6 +39,28 @@ class GetOpsArgs:
     count: int = 1000
 
 
+def cascade_local_fks(conn, model: str, local_id: int) -> None:
+    """Clear every FK reference to `model` row `local_id` that the DDL
+    does not already handle (no declared ON DELETE): nullable columns
+    are SET NULL, non-nullable referencing rows are deleted. Shared by
+    the sync apply path (_apply_shared) and LOCAL delete sites like the
+    orphan remover — a raw DELETE FROM object with foreign_keys=ON
+    fails on tag/label/album/space membership rows otherwise (and one
+    failure aborts the whole cleanup batch)."""
+    for rname, rmodel in M.MODELS.items():
+        for f in rmodel.fields:
+            if _fk_target(f) != model or f.on_delete:
+                continue
+            if f.nullable:
+                conn.execute(
+                    f"UPDATE {rname} SET {f.name} = NULL "
+                    f"WHERE {f.name} = ?", (local_id,))
+            else:
+                conn.execute(
+                    f"DELETE FROM {rname} WHERE {f.name} = ?",
+                    (local_id,))
+
+
 def _fk_target(f: M.Field) -> Optional[str]:
     """Referenced table name for FK fields (e.g. 'location')."""
     if not f.references:
@@ -703,26 +725,11 @@ class SyncManager:
             # so this is the converged state.
             local = self._resolve_fk(conn, t.model, t.record_id)
             if local is not None:
-                for rname, rmodel in M.MODELS.items():
-                    for f in rmodel.fields:
-                        if _fk_target(f) != t.model:
-                            continue
-                        if f.on_delete:
-                            # DDL ON DELETE CASCADE / SET NULL fires on
-                            # the row delete below — a manual SET NULL
-                            # here would DETACH rows the DDL cascade is
-                            # about to delete (e.g. file_path.location_id
-                            # is nullable AND CASCADE), diverging from
-                            # the emitting peer's local cascade.
-                            continue
-                        if f.nullable:
-                            conn.execute(
-                                f"UPDATE {rname} SET {f.name} = NULL "
-                                f"WHERE {f.name} = ?", (local,))
-                        else:
-                            conn.execute(
-                                f"DELETE FROM {rname} WHERE {f.name} = ?",
-                                (local,))
+                # (FKs with a declared ON DELETE are skipped inside —
+                # the DDL cascade fires on the row delete below, and a
+                # manual SET NULL would DETACH rows the DDL cascade is
+                # about to delete, e.g. file_path.location_id.)
+                cascade_local_fks(conn, t.model, local)
             # Purge parked relation ops referencing the deleted record:
             # their referenced row can never materialize again (pub_ids
             # are unique mints), so they would sit in pending_relation_op

@@ -357,3 +357,37 @@ def test_auth_device_flow(env):
             == "unsupported_grant_type"
 
     _run(main())
+
+
+def test_orphan_remover_cascades_membership_rows(tmp_path):
+    """An orphan object holding tag/album/space memberships must still
+    be removed — the raw DELETE FROM object FK-failed on any membership
+    row and one failure aborted the WHOLE cleanup batch (round-5 review
+    finding on the new album/space tables; tag_on_object had the same
+    latent bug)."""
+    import uuid as _uuid
+
+    from spacedrive_tpu.node import Node, OrphanRemover
+
+    node = Node(str(tmp_path / "n"))
+    lib = node.create_library("orph")
+    oid = lib.db.insert("object", {"pub_id": _uuid.uuid4().bytes,
+                                   "kind": 5})
+    tag = lib.db.insert("tag", {"pub_id": _uuid.uuid4().bytes,
+                                "name": "t"})
+    lib.db.insert("tag_on_object", {"tag_id": tag, "object_id": oid})
+    alb = lib.db.insert("album", {"pub_id": _uuid.uuid4().bytes,
+                                  "name": "a"})
+    lib.db.insert("object_in_album", {"album_id": alb, "object_id": oid})
+    sp = lib.db.insert("space", {"pub_id": _uuid.uuid4().bytes,
+                                 "name": "s"})
+    lib.db.insert("object_in_space", {"space_id": sp, "object_id": oid})
+
+    removed = OrphanRemover(lib).invoke()
+    assert removed == 1
+    assert lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"] == 0
+    for t in ("tag_on_object", "object_in_album", "object_in_space"):
+        assert lib.db.query_one(
+            f"SELECT COUNT(*) AS n FROM {t}")["n"] == 0, t
+    # the grouping/tag rows themselves survive
+    assert lib.db.query_one("SELECT COUNT(*) AS n FROM album")["n"] == 1

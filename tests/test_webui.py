@@ -524,6 +524,66 @@ def test_drive_ui_procedures(served):
                         {"library_id": lid, "id": lbl["id"]})
                 assert await q("labels.list", {"library_id": lid}) == []
 
+                # ---- albums / spaces (net-new groupings, round 5) ----
+                alb = await m("albums.create",
+                              {"library_id": lid, "name": "trip"})
+                await m("albums.addObjects",
+                        {"library_id": lid, "id": alb["id"],
+                         "object_ids": [oid]})
+                albs = await q("albums.list", {"library_id": lid})
+                assert next(a for a in albs
+                            if a["id"] == alb["id"])["object_count"] == 1
+                got_alb = await q("albums.get",
+                                  {"library_id": lid, "id": alb["id"]})
+                assert got_alb["object_ids"] == [oid]
+                # the explorer filter drives the same windows the UI uses
+                in_alb = await q("search.paths",
+                                 {"library_id": lid, "skip": 0,
+                                  "take": 50,
+                                  "filter": {"album_id": alb["id"]}})
+                assert any(p["object_id"] == oid
+                           for p in in_alb["items"])
+                await m("albums.update", {"library_id": lid,
+                        "id": alb["id"], "name": "trip-2024",
+                        "is_hidden": 1})
+                albs = await q("albums.list", {"library_id": lid})
+                a_row = next(a for a in albs if a["id"] == alb["id"])
+                assert a_row["name"] == "trip-2024" \
+                    and a_row["is_hidden"] == 1
+                await m("albums.removeObjects",
+                        {"library_id": lid, "id": alb["id"],
+                         "object_ids": [oid]})
+                assert (await q("albums.get", {"library_id": lid,
+                        "id": alb["id"]}))["object_ids"] == []
+                await m("albums.delete",
+                        {"library_id": lid, "id": alb["id"]})
+                assert all(a["id"] != alb["id"] for a in
+                           await q("albums.list", {"library_id": lid}))
+
+                sp = await m("spaces.create",
+                             {"library_id": lid, "name": "work",
+                              "description": "projects"})
+                await m("spaces.addObjects",
+                        {"library_id": lid, "id": sp["id"],
+                         "object_ids": [oid]})
+                sps = await q("spaces.list", {"library_id": lid})
+                s_row = next(s for s in sps if s["id"] == sp["id"])
+                assert s_row["object_count"] == 1 \
+                    and s_row["description"] == "projects"
+                in_sp = await q("search.paths",
+                                {"library_id": lid, "skip": 0,
+                                 "take": 50,
+                                 "filter": {"space_id": sp["id"]}})
+                assert any(p["object_id"] == oid
+                           for p in in_sp["items"])
+                await m("spaces.removeObjects",
+                        {"library_id": lid, "id": sp["id"],
+                         "object_ids": [oid]})
+                await m("spaces.delete",
+                        {"library_id": lid, "id": sp["id"]})
+                assert all(s["id"] != sp["id"] for s in
+                           await q("spaces.list", {"library_id": lid}))
+
                 # ---- saved searches (preferences-backed, round 4) ----
                 await m("preferences.update", {"library_id": lid,
                         "values": {"saved_searches":

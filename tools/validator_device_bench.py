@@ -24,6 +24,13 @@ import sys
 import tempfile
 import time
 
+# The tunneled bench link moves ~10-20 MB/s on bad days; keep each
+# batched dispatch's padded grid in the few-second range (the remote
+# worker stalls on minutes-long single transfers). 8 MiB ≈ 32 files at
+# 256 KiB — still a 32× RPC amortization over round 4's 1-file
+# dispatches.
+os.environ.setdefault("SDTPU_VAL_BATCH_BYTES", str(8 << 20))
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -60,6 +67,28 @@ async def run(n_files: int, file_kb: int) -> None:
     n_done = lib.db.query_one(
         "SELECT COUNT(*) AS n FROM file_path "
         "WHERE integrity_checksum IS NOT NULL")["n"]
+    # Same-weather comparator: the round-4 ONE-DISPATCH-PER-FILE path
+    # (streaming sequence-sharded windows) on a subset — the tunneled
+    # link's throughput swings 100x day to day, so the amortization
+    # claim is only honest against the per-file rate measured in the
+    # SAME run.
+    import glob
+
+    import jax
+
+    from spacedrive_tpu.ops.seqhash import sharded_file_checksum
+    from spacedrive_tpu.parallel.mesh import batch_mesh
+
+    mesh = batch_mesh(list(jax.devices())[:1])
+    subset = sorted(glob.glob(os.path.join(corpus, "*.bin")))[
+        :min(20, n_files)]
+    sharded_file_checksum(mesh, subset[0])  # compile outside the timer
+    t0 = time.perf_counter()
+    for p_ in subset:
+        sharded_file_checksum(mesh, p_)
+    per_file_dt = (time.perf_counter() - t0) / len(subset)
+    per_file_fps = 1.0 / per_file_dt
+
     print(json.dumps({
         "metric": "validator_jax_device_files_per_sec",
         "value": round(n_done / dt, 2),
@@ -70,6 +99,8 @@ async def run(n_files: int, file_kb: int) -> None:
         "seconds": round(dt, 2),
         "backend": "jax (batched small-file dispatches + StreamingShardedChecksum for large)",
         "batched_small_files": True,
+        "per_file_dispatch_files_per_sec": round(per_file_fps, 2),
+        "batch_amortization_x": round((n_done / dt) / per_file_fps, 1),
     }))
     await node.shutdown()
 
