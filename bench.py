@@ -143,9 +143,13 @@ def main() -> None:
     from spacedrive_tpu.ops import overlap
 
     link_bps = words.nbytes / t_h2d
-    pb = 2048
+    # Thin-link days (the tunnel swings 1.5 MB/s – 1.2 GB/s): shrink
+    # the per-batch payload so the pipeline + its two calibration
+    # brackets stay inside the bench timeout. The steady-state shape
+    # is unchanged — only fewer files per batch.
+    pb = 2048 if link_bps >= 50e6 else 512
     per_batch_s = pb * MSG_BYTES / max(link_bps, 1e6)
-    n_batches = int(max(4, min(12, 30.0 / max(per_batch_s, 0.25))))
+    n_batches = int(max(3, min(12, 30.0 / max(per_batch_s, 0.25))))
     proot = tempfile.mkdtemp(prefix="sdtpu-overlap-")
     try:
         pipeline_batches = overlap.make_sparse_corpus(

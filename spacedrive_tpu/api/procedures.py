@@ -396,6 +396,13 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
         now = int(time.time())
         with library.db.tx() as conn:
             for oid in input["object_ids"]:
+                # skip stale ids (object deleted between the caller's
+                # list and this add): INSERT OR IGNORE does NOT
+                # suppress FK violations, and one would roll back the
+                # whole batch with a raw IntegrityError
+                if conn.execute("SELECT 1 FROM object WHERE id = ?",
+                                (int(oid),)).fetchone() is None:
+                    continue
                 if rel_has_date_created:
                     conn.execute(
                         f"INSERT OR IGNORE INTO {rel} ({fk}, object_id, "

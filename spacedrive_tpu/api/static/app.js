@@ -217,8 +217,10 @@ async function loadGroupings() {
         e.preventDefault();
         if (confirm(`delete ${kind} \"${g.name}\"?`)) {
           await mut(`${kind}s.delete`, {library_id: lib, id: g.id});
-          if (albumFilter === g.id) albumFilter = null;
-          if (spaceFilter === g.id) spaceFilter = null;
+          if (kind === "album" && albumFilter === g.id)
+            albumFilter = null;
+          if (kind === "space" && spaceFilter === g.id)
+            spaceFilter = null;
           loadGroupings(); render();
         }
       };

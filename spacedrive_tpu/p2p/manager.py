@@ -40,6 +40,7 @@ class P2PManager:
         self.identity = identity or Identity()
         self.enable_discovery = enable_discovery
         self.discovery: Optional[Discovery] = None
+        self.mdns = None  # standards mDNS responder/browser (optional)
         self.server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None
         # Spacedrop decision hook: (peer, request) -> save-path | None.
@@ -69,11 +70,32 @@ class P2PManager:
                 metadata={"name": self.node.config.name,
                           "node_id": self.node.config.id.hex()})
             await self.discovery.start()
+            # Standards-interoperable mDNS/DNS-SD alongside the signed
+            # beacons (the reference's _sd-spacedrive._udp service,
+            # discovery/mdns.rs): visible to any zeroconf browser.
+            # Unauthenticated hints only — pairing still verifies.
+            from .mdns import MdnsService
+
+            self.mdns = MdnsService(
+                instance=self.node.config.id.hex()[:12],
+                service_port=self.port,
+                txt={"name": self.node.config.name,
+                     "id": self.node.config.id.hex(),
+                     "identity":
+                         self.identity.to_remote_identity()
+                         .to_bytes().hex()})
+            try:
+                await self.mdns.start()
+            except OSError:
+                self.mdns = None  # 5353 unavailable: beacons only
         return self.port
 
     async def stop(self) -> None:
         if self.discovery is not None:
             await self.discovery.stop()
+        if self.mdns is not None:
+            await self.mdns.stop()
+            self.mdns = None
         if self.server is not None:
             self.server.close()
             await self.server.wait_closed()
