@@ -1127,18 +1127,47 @@ def _search(r: Router) -> None:
 
     @r.query("search.objects", library=True)
     def search_objects(node, library, input):
+        """Same two access modes as search.paths: keyset `cursor`
+        pagination, or absolute `skip` windows + server-side `order`
+        for virtualized object views."""
         input = input or {}
         where, params = _objects_where(input)
         take = min(int(input.get("take", 100)), 500)
+
+        def _attach_fps(items):
+            # ONE query per page, not per object: the windowed mode is
+            # hit on every scroll of a virtualized view
+            if not items:
+                return items
+            ph = ",".join("?" for _ in items)
+            by_obj: Dict[int, list] = {it["id"]: [] for it in items}
+            for fp in library.db.query(
+                    f"SELECT * FROM file_path WHERE object_id IN ({ph})",
+                    [it["id"] for it in items]):
+                by_obj[fp["object_id"]].append(row_to_dict(fp))
+            for it in items:
+                it["file_paths"] = by_obj[it["id"]]
+            return items
+
+        if "skip" in input:
+            order = input.get("order") or {}
+            ocol = {"id": "o.id", "kind": "o.kind",
+                    "date_created": "o.date_created",
+                    "date_accessed": "o.date_accessed",
+                    }.get(str(order.get("field", "id")), "o.id")
+            odir = "DESC" if order.get("desc") else "ASC"
+            skip = max(0, int(input["skip"]))
+            rows = library.db.query(
+                f"SELECT o.* FROM object o WHERE {where} "
+                f"ORDER BY {ocol} {odir}, o.id LIMIT ? OFFSET ?",
+                params + [take, skip])
+            return {"items": _attach_fps(rows_to_dicts(rows)),
+                    "skip": skip}
         cursor = int(input.get("cursor", 0))
         rows = library.db.query(
             f"SELECT o.* FROM object o WHERE {where} AND o.id > ? "
             f"ORDER BY o.id LIMIT ?", params + [cursor, take])
-        items = rows_to_dicts(rows)
-        for it in items:
-            fps = library.db.query(
-                "SELECT * FROM file_path WHERE object_id = ?", (it["id"],))
-            it["file_paths"] = rows_to_dicts(fps)
+        items = _attach_fps(rows_to_dicts(rows))
         return {
             "items": items,
             "cursor": items[-1]["id"] if len(items) == take else None,

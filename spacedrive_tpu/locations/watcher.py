@@ -84,6 +84,104 @@ class _Inotify:
         os.close(self.fd)
 
 
+class PollingWatcher:
+    """mtime/entry-signature polling fallback with the LocationWatcher
+    contract — the path platforms without inotify take (the reference's
+    notify crate falls back to polling the same way,
+    manager/watcher/mod.rs). Selected by make_watcher when inotify is
+    unavailable, or forced with SDTPU_WATCHER=poll (how Linux CI tests
+    the fallback it would otherwise never execute).
+
+    Each poll walks the tree and compares a per-directory signature
+    (entry names, kinds, sizes, mtimes); changed still-present dirs
+    emit on_dirty(relpath) — vanished ones are covered by their
+    parent's changed signature, mirroring IN_DELETE_SELF handling. O(tree) per tick — the price of portability;
+    the interval keeps it cheap for the location sizes that lack
+    inotify in practice."""
+
+    INTERVAL_S = 1.0
+
+    def __init__(self, location_id: int, root: str,
+                 on_dirty: Callable[[str], None],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self.location_id = location_id
+        self.root = os.path.normpath(root)
+        self.on_dirty = on_dirty
+        self.loop = loop or asyncio.get_event_loop()
+        self._sigs: Dict[str, tuple] = self._snapshot()
+        self._task = self.loop.create_task(self._poll_loop())
+
+    def _dir_sig(self, path: str) -> Optional[tuple]:
+        try:
+            with os.scandir(path) as it:
+                ents = []
+                for e in it:
+                    try:
+                        st = e.stat(follow_symlinks=False)
+                        ents.append((e.name, e.is_dir(
+                            follow_symlinks=False), st.st_size,
+                            st.st_mtime_ns))
+                    except OSError:
+                        continue
+            return tuple(sorted(ents))
+        except OSError:
+            return None
+
+    def _snapshot(self) -> Dict[str, tuple]:
+        sigs: Dict[str, tuple] = {}
+        stack = [self.root]
+        while stack:
+            d = stack.pop()
+            sig = self._dir_sig(d)
+            if sig is None:
+                continue
+            sigs[d] = sig
+            stack.extend(os.path.join(d, name)
+                         for name, is_dir, _, _ in sig if is_dir)
+        return sigs
+
+    async def _poll_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.INTERVAL_S)
+            new = await asyncio.to_thread(self._snapshot)
+            old = self._sigs
+            self._sigs = new
+            # Vanished dirs are NOT emitted (the inotify path's
+            # IN_DELETE_SELF rule: scanning a deleted dir only errors;
+            # the parent's changed signature covers the cleanup).
+            dirty = {d for d in set(old) | set(new)
+                     if old.get(d) != new.get(d) and d in new}
+            for d in sorted(dirty):
+                rel = os.path.relpath(d, self.root)
+                # forward slashes: the materialized-path convention on
+                # every platform (the fallback exists for non-Linux)
+                self.on_dirty("" if rel == "."
+                              else rel.replace(os.sep, "/"))
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+def inotify_available() -> bool:
+    try:
+        _Inotify().close()
+        return True
+    except (OSError, AttributeError):
+        return False
+
+
+def make_watcher(location_id: int, root: str,
+                 on_dirty: Callable[[str], None],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+    """inotify watcher when the platform has it, polling otherwise
+    (or when SDTPU_WATCHER=poll forces the fallback under test)."""
+    if os.environ.get("SDTPU_WATCHER") != "poll" and inotify_available():
+        return LocationWatcher(location_id, root, on_dirty, loop)
+    return PollingWatcher(location_id, root, on_dirty, loop)
+
+
 class LocationWatcher:
     """Recursive watcher for one location; emits debounced dir rescans.
 
@@ -245,7 +343,7 @@ class Locations:
                     self._scanning.discard(_key)
             asyncio.get_event_loop().create_task(scan())
 
-        self.watchers[key] = LocationWatcher(
+        self.watchers[key] = make_watcher(
             location_id, loc["path"], on_dirty)
         return True
 

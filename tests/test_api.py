@@ -391,3 +391,37 @@ def test_orphan_remover_cascades_membership_rows(tmp_path):
             f"SELECT COUNT(*) AS n FROM {t}")["n"] == 0, t
     # the grouping/tag rows themselves survive
     assert lib.db.query_one("SELECT COUNT(*) AS n FROM album")["n"] == 1
+
+
+def test_search_objects_windows(tmp_path):
+    """search.objects serves absolute skip/take windows with
+    server-side order, mirroring search.paths (virtualized views)."""
+    import asyncio
+    import uuid as _uuid
+
+    from spacedrive_tpu.api.router import mount_router
+    from spacedrive_tpu.node import Node
+
+    node = Node(str(tmp_path / "n"))
+    router = mount_router(node)
+    lib = node.create_library("ow")
+    with lib.db.tx() as conn:
+        conn.executemany(
+            "INSERT INTO object (pub_id, kind, date_created) "
+            "VALUES (?, ?, ?)",
+            [(_uuid.uuid4().bytes, i % 7, 1_700_000_000 + i)
+             for i in range(500)])
+
+    async def go():
+        lid = str(lib.id)
+        r = await router.dispatch("search.objects", {
+            "library_id": lid, "skip": 490, "take": 10})
+        assert len(r["items"]) == 10 and r["skip"] == 490
+        r2 = await router.dispatch("search.objects", {
+            "library_id": lid, "skip": 0, "take": 5,
+            "order": {"field": "date_created", "desc": True}})
+        assert r2["items"][0]["date_created"] == 1_700_000_499
+        n = await router.dispatch("search.objectsCount",
+                                  {"library_id": lid, "filter": {}})
+        assert n == 500
+    asyncio.run(go())

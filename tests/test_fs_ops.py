@@ -265,3 +265,40 @@ def test_watcher_detects_rename(env):
         assert new["cas_id"] == old_cas  # same bytes → same identity
         locations.close()
     _run(main())
+
+
+def test_polling_watcher_fallback_detects_changes(env, monkeypatch):
+    """The polling fallback (platforms without inotify) must deliver
+    the same create/delete → light-scan behavior. Forced on Linux via
+    SDTPU_WATCHER=poll — round 4 shipped the fallback claim with no
+    implementation behind it."""
+    node, lib, src, dst, sid, did = env
+    monkeypatch.setenv("SDTPU_WATCHER", "poll")
+
+    async def main():
+        from spacedrive_tpu.locations.watcher import (Locations,
+                                                      PollingWatcher)
+        locations = Locations(node, backend="numpy")
+        assert locations.watch_location(lib, sid)
+        w = locations.watchers[(lib.id, sid)]
+        assert isinstance(w, PollingWatcher), type(w)
+        with open(f"{src}/polled.bin", "wb") as f:
+            f.write(b"poll-me" * 50)
+        for _ in range(80):
+            await asyncio.sleep(0.1)
+            row = lib.db.query_one(
+                "SELECT object_id FROM file_path WHERE name='polled'")
+            if row is not None and row["object_id"] is not None:
+                break
+        else:
+            raise AssertionError("polling watcher never indexed")
+        os.remove(f"{src}/polled.bin")
+        for _ in range(80):
+            await asyncio.sleep(0.1)
+            if lib.db.query_one(
+                    "SELECT * FROM file_path WHERE name='polled'") is None:
+                break
+        else:
+            raise AssertionError("polling watcher never removed")
+        locations.close()
+    _run(main())
