@@ -100,6 +100,7 @@ class PollingWatcher:
     inotify in practice."""
 
     INTERVAL_S = 1.0
+    SYNC_SEED_DIRS = 2000
 
     def __init__(self, location_id: int, root: str,
                  on_dirty: Callable[[str], None],
@@ -108,7 +109,15 @@ class PollingWatcher:
         self.root = os.path.normpath(root)
         self.on_dirty = on_dirty
         self.loop = loop or asyncio.get_event_loop()
-        self._sigs: Dict[str, tuple] = self._snapshot()
+        # Baseline semantics vs loop latency: a synchronous walk here
+        # gives an exact watch-time baseline (nothing created after
+        # watch() can hide in it) but blocks the event loop on large
+        # trees. Hybrid: walk synchronously up to SYNC_SEED_DIRS dirs
+        # (tests and typical locations), else seed on the first tick in
+        # a thread — big locations always pair watch() with a full
+        # scan chain, which covers the seeding window.
+        self._sigs: Optional[Dict[str, tuple]] = self._snapshot(
+            limit=self.SYNC_SEED_DIRS)
         self._task = self.loop.create_task(self._poll_loop())
 
     def _dir_sig(self, path: str) -> Optional[tuple]:
@@ -127,7 +136,11 @@ class PollingWatcher:
         except OSError:
             return None
 
-    def _snapshot(self) -> Dict[str, tuple]:
+    def _snapshot(self, limit: Optional[int] = None
+                  ) -> Optional[Dict[str, tuple]]:
+        """Signature map of the whole tree; with `limit`, None when the
+        tree exceeds that many directories (caller falls back to
+        thread-seeded baseline)."""
         sigs: Dict[str, tuple] = {}
         stack = [self.root]
         while stack:
@@ -136,27 +149,43 @@ class PollingWatcher:
             if sig is None:
                 continue
             sigs[d] = sig
+            if limit is not None and len(sigs) > limit:
+                return None
             stack.extend(os.path.join(d, name)
                          for name, is_dir, _, _ in sig if is_dir)
         return sigs
 
     async def _poll_loop(self) -> None:
+        if self._sigs is None:  # big tree: seed off the event loop
+            self._sigs = await asyncio.to_thread(self._snapshot)
         while True:
             await asyncio.sleep(self.INTERVAL_S)
-            new = await asyncio.to_thread(self._snapshot)
-            old = self._sigs
-            self._sigs = new
-            # Vanished dirs are NOT emitted (the inotify path's
-            # IN_DELETE_SELF rule: scanning a deleted dir only errors;
-            # the parent's changed signature covers the cleanup).
-            dirty = {d for d in set(old) | set(new)
-                     if old.get(d) != new.get(d) and d in new}
-            for d in sorted(dirty):
-                rel = os.path.relpath(d, self.root)
-                # forward slashes: the materialized-path convention on
-                # every platform (the fallback exists for non-Linux)
-                self.on_dirty("" if rel == "."
-                              else rel.replace(os.sep, "/"))
+            try:
+                new = await asyncio.to_thread(self._snapshot)
+                old = self._sigs
+                self._sigs = new
+                # Vanished dirs are NOT emitted (the inotify path's
+                # IN_DELETE_SELF rule: scanning a deleted dir only
+                # errors; the parent's changed signature covers the
+                # cleanup) — EXCEPT the root, which has no watched
+                # parent: a vanished root rescans "" to surface the
+                # missing-path state, like IN_DELETE_SELF on root.
+                dirty = {d for d in set(old) | set(new)
+                         if old.get(d) != new.get(d) and d in new}
+                if self.root in old and self.root not in new:
+                    dirty.add(self.root)
+                for d in sorted(dirty):
+                    rel = os.path.relpath(d, self.root)
+                    # forward slashes: the materialized-path convention
+                    # on every platform (the fallback is for non-Linux)
+                    self.on_dirty("" if rel == "."
+                                  else rel.replace(os.sep, "/"))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a throwing on_dirty must not silently kill the
+                # watcher — the inotify path survives the equivalent
+                continue
 
     def close(self) -> None:
         if self._task is not None:

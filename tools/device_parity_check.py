@@ -1,0 +1,64 @@
+"""On-device digest parity check — run ALONE on the real chip.
+
+The suite's Pallas tests are TPU-gated (skipped on the CPU mesh), so
+this is the reproducible on-chip correctness artifact: the batched
+full-file checksum pipeline (the jitted Pallas chunk stage + tree
+reduction, ops/blake3_pallas.py) and the CAS path, both compared
+byte-for-byte against the numpy oracle on edge-shaped inputs.
+
+Usage: python tools/device_parity_check.py
+Prints one JSON line {"ok": true, ...} on success; non-zero exit on any
+digest mismatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    from spacedrive_tpu.ops.blake3_batch import blake3_batch_np
+    from spacedrive_tpu.ops.blake3_jax import (build_cas_messages,
+                                               blake3_words,
+                                               checksums_words_batched,
+                                               digests_to_cas_ids)
+    from spacedrive_tpu.ops.cas import cas_id_of_payload
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(5)
+
+    # 1. batched full-file checksums across the boundary sizes
+    blobs = [bytes(rng.integers(0, 256, n, dtype=np.uint8))
+             for n in (0, 1, 1024, 1025, 70_000, 262_144)]
+    got = checksums_words_batched(blobs)
+    want = [d.hex() for d in blake3_batch_np(blobs)]
+    checksum_ok = got == want
+
+    # 2. CAS ids on the canonical large-file grid
+    B = 64
+    payloads = rng.integers(0, 256, size=(B, 57344), dtype=np.uint8)
+    sizes = rng.integers(200_000, 5_000_000, size=B).astype(np.uint64)
+    words, lengths = build_cas_messages(payloads, sizes)
+    ids = digests_to_cas_ids(blake3_words(words, lengths))
+    cas_ok = all(
+        ids[i] == cas_id_of_payload(int(sizes[i]), payloads[i].tobytes())
+        for i in (0, B // 2, B - 1))
+
+    ok = checksum_ok and cas_ok
+    print(json.dumps({"ok": ok, "platform": platform,
+                      "checksum_parity": checksum_ok,
+                      "cas_parity": cas_ok,
+                      "checksum_cases": len(blobs), "cas_batch": B}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
