@@ -262,23 +262,29 @@ static void compress8_cv(__m256i cv[8], const __m256i m[16], __m256i ctr_lo,
   cv[7] = _mm256_xor_si256(s7, s15);
 }
 
-// Leaf CVs of 8 consecutive FULL chunks of one stream: lane j hashes
-// data[j*1024 .. j*1024+1024) with chunk counter counter0+j. The caller
-// guarantees none of them is the final chunk.
-static void hash8_leaf_cvs(const uint8_t* data, uint64_t counter0,
-                           uint32_t out_cvs[8][8]) {
+// Leaf CVs of 8 FULL chunks gathered from ARBITRARY lanes: lane j hashes
+// the 1024 bytes at ptrs[j] with chunk counter counters[j]. The caller
+// guarantees every lane is a full non-root leaf (part of a multi-chunk
+// message) — the flag schedule (CHUNK_START on block 0, CHUNK_END on
+// block 15, never ROOT) is then identical across lanes, so chunks from
+// DIFFERENT messages can share one SIMD dispatch. This is what lets
+// ~4 KiB files (4 full chunks each) still fill all 8 lanes: pool the
+// chunks across a group of files instead of within one stream.
+static void hash8_leaf_cvs_gather(const uint8_t* const ptrs[8],
+                                  const uint64_t counters[8],
+                                  uint32_t out_cvs[8][8]) {
   __m256i cv[8];
   for (int i = 0; i < 8; i++) cv[i] = _mm256_set1_epi32((int32_t)IV[i]);
   alignas(32) uint32_t clo[8], chi[8];
   for (int j = 0; j < 8; j++) {
-    clo[j] = (uint32_t)(counter0 + (uint64_t)j);
-    chi[j] = (uint32_t)((counter0 + (uint64_t)j) >> 32);
+    clo[j] = (uint32_t)counters[j];
+    chi[j] = (uint32_t)(counters[j] >> 32);
   }
   __m256i ctr_lo = _mm256_load_si256((const __m256i*)clo);
   __m256i ctr_hi = _mm256_load_si256((const __m256i*)chi);
 
   const uint8_t* p[8];
-  for (int j = 0; j < 8; j++) p[j] = data + (size_t)j * CHUNK_LEN;
+  for (int j = 0; j < 8; j++) p[j] = ptrs[j];
   for (int b = 0; b < 16; b++) {
     __m256i m[16];
     load_block8(p, m);
@@ -290,6 +296,20 @@ static void hash8_leaf_cvs(const uint8_t* data, uint64_t counter0,
   transpose8(cv);  // word-across-lane -> lane rows
   for (int j = 0; j < 8; j++)
     _mm256_storeu_si256((__m256i*)(void*)out_cvs[j], cv[j]);
+}
+
+// Leaf CVs of 8 consecutive FULL chunks of one stream: lane j hashes
+// data[j*1024 .. j*1024+1024) with chunk counter counter0+j. The caller
+// guarantees none of them is the final chunk.
+static void hash8_leaf_cvs(const uint8_t* data, uint64_t counter0,
+                           uint32_t out_cvs[8][8]) {
+  const uint8_t* p[8];
+  uint64_t c[8];
+  for (int j = 0; j < 8; j++) {
+    p[j] = data + (size_t)j * CHUNK_LEN;
+    c[j] = counter0 + (uint64_t)j;
+  }
+  hash8_leaf_cvs_gather(p, c, out_cvs);
 }
 
 // Chaining values of 8 lanes, one word per vector.
@@ -395,6 +415,27 @@ static void blake3_x8(const uint8_t* const rows[8], uint64_t total_len,
 }  // namespace wide
 #endif  // __AVX2__
 
+// One parent-node compression: block = left CV ‖ right CV, IV state.
+// Shared by the streaming hasher and the batched small-file tree fold —
+// any change here changes every digest the plane produces.
+static void merge_parent_cv(const uint32_t left[8], const uint32_t right[8],
+                            uint32_t flags, uint32_t cv_out[8]) {
+  uint32_t block[16], out[16];
+  std::memcpy(block, left, 8 * sizeof(uint32_t));
+  std::memcpy(block + 8, right, 8 * sizeof(uint32_t));
+  compress(IV, block, 0, BLOCK_LEN, flags, out);
+  std::memcpy(cv_out, out, 8 * sizeof(uint32_t));
+}
+
+static void store_digest_le(const uint32_t out16[16], uint8_t out[32]) {
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)out16[i];
+    out[4 * i + 1] = (uint8_t)(out16[i] >> 8);
+    out[4 * i + 2] = (uint8_t)(out16[i] >> 16);
+    out[4 * i + 3] = (uint8_t)(out16[i] >> 24);
+  }
+}
+
 // Streaming hasher — same state machine as the Python oracle: a chunk
 // state plus a binary-counter CV stack of completed subtrees.
 class Blake3 {
@@ -462,19 +503,14 @@ class Blake3 {
       uint32_t cv[8];
       chunk_output(0, cv);
       for (size_t i = stack_.size() - 1; i > 0; i--) {
-        merge_parent(stack_[i].data(), cv, PARENT, cv);
+        merge_parent_cv(stack_[i].data(), cv, PARENT, cv);
       }
       uint32_t parent_block[16];
       std::memcpy(parent_block, stack_[0].data(), 8 * sizeof(uint32_t));
       std::memcpy(parent_block + 8, cv, 8 * sizeof(uint32_t));
       compress(IV, parent_block, 0, BLOCK_LEN, PARENT | ROOT, out16);
     }
-    for (int i = 0; i < 8; i++) {
-      out[4 * i] = (uint8_t)out16[i];
-      out[4 * i + 1] = (uint8_t)(out16[i] >> 8);
-      out[4 * i + 2] = (uint8_t)(out16[i] >> 16);
-      out[4 * i + 3] = (uint8_t)(out16[i] >> 24);
-    }
+    store_digest_le(out16, out);
   }
 
  private:
@@ -485,7 +521,7 @@ class Blake3 {
     std::memcpy(cv, cv_in, sizeof(cv));
     uint64_t total = chunk_counter_ + 1;
     while ((total & 1) == 0) {
-      merge_parent(stack_.back().data(), cv, PARENT, cv);
+      merge_parent_cv(stack_.back().data(), cv, PARENT, cv);
       stack_.pop_back();
       total >>= 1;
     }
@@ -511,15 +547,6 @@ class Blake3 {
              start_flag() | CHUNK_END | extra_flags, out);
     std::memcpy(cv_out, out, 8 * sizeof(uint32_t));
   }
-  static void merge_parent(const uint32_t* left, const uint32_t* right,
-                           uint32_t flags, uint32_t cv_out[8]) {
-    uint32_t block[16], out[16];
-    std::memcpy(block, left, 8 * sizeof(uint32_t));
-    std::memcpy(block + 8, right, 8 * sizeof(uint32_t));
-    compress(IV, block, 0, BLOCK_LEN, flags, out);
-    std::memcpy(cv_out, out, 8 * sizeof(uint32_t));
-  }
-
   uint32_t chunk_cv_[8];
   uint64_t chunk_counter_;
   uint8_t buf_[BLOCK_LEN];
@@ -527,6 +554,78 @@ class Blake3 {
   size_t blocks_compressed_;
   std::vector<std::array<uint32_t, 8>> stack_;
 };
+
+// ---------------------------------------------------------------------------
+// Chunk-level scalar helpers for the cross-file batched small hasher:
+// leaf CVs and tree merges over PRE-COMPUTED chunk CVs, byte-identical
+// to streaming the same message through Blake3 above. The SIMD gather
+// kernel produces full-chunk CVs; these cover tails, single-chunk roots
+// and the per-message parent tree.
+// ---------------------------------------------------------------------------
+
+// CV of one NON-ROOT leaf chunk (1..1024 bytes of a multi-chunk message).
+static void leaf_chunk_cv(const uint8_t* data, size_t len, uint64_t counter,
+                          uint32_t out_cv[8]) {
+  uint32_t cv[8];
+  std::memcpy(cv, IV, sizeof(cv));
+  const size_t n_blocks = (len + BLOCK_LEN - 1) / BLOCK_LEN;
+  uint32_t out16[16];
+  for (size_t b = 0; b < n_blocks; b++) {
+    const size_t blen = std::min(BLOCK_LEN, len - b * BLOCK_LEN);
+    uint32_t w[16];
+    words_of_block(data + b * BLOCK_LEN, blen, w);
+    const uint32_t flags = (b == 0 ? CHUNK_START : 0u) |
+                           (b == n_blocks - 1 ? CHUNK_END : 0u);
+    compress(cv, w, counter, (uint32_t)blen, flags, out16);
+    std::memcpy(cv, out16, 8 * sizeof(uint32_t));
+  }
+  std::memcpy(out_cv, cv, 8 * sizeof(uint32_t));
+}
+
+// Root digest of a message that fits in ONE chunk (0..1024 bytes).
+static void single_chunk_root(const uint8_t* msg, size_t len,
+                              uint8_t out[32]) {
+  uint32_t cv[8];
+  std::memcpy(cv, IV, sizeof(cv));
+  const size_t n_blocks = len == 0 ? 1 : (len + BLOCK_LEN - 1) / BLOCK_LEN;
+  uint32_t out16[16];
+  for (size_t b = 0; b < n_blocks; b++) {
+    const size_t blen = len == 0 ? 0 : std::min(BLOCK_LEN, len - b * BLOCK_LEN);
+    uint32_t w[16];
+    words_of_block(msg + b * BLOCK_LEN, blen, w);
+    uint32_t flags = (b == 0 ? CHUNK_START : 0u);
+    if (b == n_blocks - 1) flags |= CHUNK_END | ROOT;
+    compress(cv, w, 0, (uint32_t)blen, flags, out16);
+    std::memcpy(cv, out16, 8 * sizeof(uint32_t));
+  }
+  store_digest_le(out16, out);
+}
+
+// Root digest from n >= 2 in-order leaf CVs: the same binary-counter
+// stack fold as Blake3::push_chunk_cv/finalize, over precomputed CVs.
+static void merge_cvs_root(const uint32_t (*cvs)[8], uint64_t n,
+                           uint8_t out[32]) {
+  uint32_t stack[64][8];
+  int sp = 0;
+  for (uint64_t c = 0; c + 1 < n; c++) {
+    uint32_t cv[8];
+    std::memcpy(cv, cvs[c], sizeof(cv));
+    uint64_t total = c + 1;
+    while ((total & 1) == 0) {
+      merge_parent_cv(stack[--sp], cv, PARENT, cv);
+      total >>= 1;
+    }
+    std::memcpy(stack[sp++], cv, sizeof(cv));
+  }
+  uint32_t cv[8];
+  std::memcpy(cv, cvs[n - 1], sizeof(cv));
+  for (int i = sp - 1; i > 0; i--) merge_parent_cv(stack[i], cv, PARENT, cv);
+  uint32_t parent_block[16], out16[16];
+  std::memcpy(parent_block, stack[0], 8 * sizeof(uint32_t));
+  std::memcpy(parent_block + 8, cv, 8 * sizeof(uint32_t));
+  compress(IV, parent_block, 0, BLOCK_LEN, PARENT | ROOT, out16);
+  store_digest_le(out16, out);
+}
 
 // ---------------------------------------------------------------------------
 // CAS sampling layout (core/src/object/cas.rs:10-15,23-62 semantics).
@@ -730,6 +829,10 @@ void sd_stage_small(int64_t n, const char** paths, uint64_t cap, uint8_t* out,
 // are staged and hashed in lockstep groups of 8 (wide::blake3_x8).
 void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
                     uint8_t* digests, int32_t* status, int n_threads) {
+  // Lanes fully handled by a batched path below; distinct byte writes
+  // from the group workers are race-free, and the scalar sweep at the
+  // end picks up whatever stayed 0 (group remainders, grown files).
+  std::vector<uint8_t> done((size_t)n, 0);
 #if defined(__AVX2__)
   std::vector<int64_t> large;
   large.reserve((size_t)n);
@@ -743,6 +846,7 @@ void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
     bool all_ok = true;
     for (int j = 0; j < 8; j++) {
       const int64_t i = large[(size_t)(g * 8 + j)];
+      done[(size_t)i] = 1;
       uint8_t* row = buf.data() + (size_t)j * LARGE_PAYLOAD;
       rows[j] = row;
       prefixes[j] = sizes[i];
@@ -775,18 +879,124 @@ void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
       }
     }
   });
-  const auto handled = [&](int64_t i) {
-    if (sizes[i] <= MINIMUM_FILE_SIZE) return false;
-    // Large files beyond the last full group of 8 fall through to the
-    // scalar path below.
-    auto it = std::lower_bound(large.begin(), large.end(), i);
-    return (it - large.begin()) < n_lgroups * 8;
-  };
-#else
-  const auto handled = [](int64_t) { return false; };
+
+  // Small files (whole-file messages, cas.rs:27) batched 8 per group
+  // with their full 1024-byte chunks POOLED ACROSS the group via the
+  // gather kernel: a ~4 KiB file has only 4 full chunks, far short of
+  // the 8 consecutive chunks the within-stream fast path needs, but 8
+  // such files together keep all SIMD lanes busy. Tails, single-chunk
+  // messages and parent merges stay scalar (~6% of the compressions).
+  constexpr uint64_t SMALL_CAP = MINIMUM_FILE_SIZE;  // content cap
+  constexpr uint64_t MSG_CAP = 8 + SMALL_CAP;        // prefix + content
+  constexpr uint32_t MAX_CVS = (uint32_t)(MSG_CAP / CHUNK_LEN) + 1;
+  std::vector<int64_t> small;
+  small.reserve((size_t)n);
+  for (int64_t i = 0; i < n; i++)
+    if (sizes[i] != 0 && sizes[i] <= MINIMUM_FILE_SIZE) small.push_back(i);
+  const int64_t n_sgroups = (int64_t)small.size() / 8;
+  parallel_for(n_sgroups, n_threads, [&](int64_t g) {
+    std::vector<uint8_t> buf((size_t)8 * (MSG_CAP + 1));
+    uint64_t mlen[8];
+    bool live[8];
+    for (int j = 0; j < 8; j++) {
+      const int64_t i = small[(size_t)(g * 8 + j)];
+      uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      live[j] = false;
+      mlen[j] = 0;
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        status[i] = ERR_OPEN;
+        done[(size_t)i] = 1;
+        continue;
+      }
+      le64(sizes[i], msg);  // declared-size prefix (cas.rs:23-26)
+      uint64_t off = 0;
+      bool io_err = false;
+      // Whole ACTUAL file regardless of the declared size (fs::read,
+      // cas.rs:27) — +1 byte of headroom detects a file that grew past
+      // the small cap, which falls through to the unbounded scalar path.
+      for (;;) {
+        ssize_t r = pread(fd, msg + 8 + off, (size_t)(SMALL_CAP + 1 - off),
+                          (off_t)off);
+        if (r < 0) {
+          status[i] = ERR_IO;
+          io_err = true;
+          break;
+        }
+        if (r == 0) break;
+        off += (uint64_t)r;
+        if (off > SMALL_CAP) break;
+      }
+      close(fd);
+      if (io_err) {
+        done[(size_t)i] = 1;
+        continue;
+      }
+      if (off > SMALL_CAP) continue;  // grew: done stays 0 -> scalar sweep
+      mlen[j] = 8 + off;
+      live[j] = true;
+      done[(size_t)i] = 1;
+    }
+
+    // Pool every full leaf chunk of the group's multi-chunk messages.
+    // A full FINAL chunk of a multi-chunk message is flag-identical to
+    // any other full leaf (ROOT lives on the parent), so it pools too.
+    struct Desc {
+      const uint8_t* p;
+      uint64_t ctr;
+      uint8_t lane;
+      uint8_t ci;
+    };
+    Desc ds[8 * (MSG_CAP / CHUNK_LEN)];
+    int nd = 0;
+    static_assert(MAX_CVS <= 256, "ci is uint8_t");
+    uint32_t cvs[8][MAX_CVS][8];
+    uint32_t ncv[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int j = 0; j < 8; j++) {
+      if (!live[j] || mlen[j] <= CHUNK_LEN) continue;
+      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      const uint64_t n_full = mlen[j] / CHUNK_LEN;
+      for (uint64_t c = 0; c < n_full; c++)
+        ds[nd++] = {msg + c * CHUNK_LEN, c, (uint8_t)j, (uint8_t)c};
+      ncv[j] = (uint32_t)(n_full + (mlen[j] % CHUNK_LEN ? 1 : 0));
+    }
+    int k = 0;
+    for (; k + 8 <= nd; k += 8) {
+      const uint8_t* p[8];
+      uint64_t ctr[8];
+      uint32_t out_cvs[8][8];
+      for (int j = 0; j < 8; j++) {
+        p[j] = ds[k + j].p;
+        ctr[j] = ds[k + j].ctr;
+      }
+      wide::hash8_leaf_cvs_gather(p, ctr, out_cvs);
+      for (int j = 0; j < 8; j++)
+        std::memcpy(cvs[ds[k + j].lane][ds[k + j].ci], out_cvs[j], 32);
+    }
+    for (; k < nd; k++)
+      leaf_chunk_cv(ds[k].p, CHUNK_LEN, ds[k].ctr,
+                    cvs[ds[k].lane][ds[k].ci]);
+
+    for (int j = 0; j < 8; j++) {
+      if (!live[j]) continue;
+      const int64_t i = small[(size_t)(g * 8 + j)];
+      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      if (mlen[j] <= CHUNK_LEN) {
+        single_chunk_root(msg, (size_t)mlen[j], digests + i * 32);
+      } else {
+        const uint64_t n_full = mlen[j] / CHUNK_LEN;
+        const uint64_t tail = mlen[j] % CHUNK_LEN;
+        if (tail)
+          leaf_chunk_cv(msg + n_full * CHUNK_LEN, (size_t)tail, n_full,
+                        cvs[j][n_full]);
+        merge_cvs_root(cvs[j], ncv[j], digests + i * 32);
+      }
+      status[i] = OK;
+    }
+  });
 #endif
   parallel_for(n, n_threads, [&](int64_t i) {
-    if (handled(i)) return;
+    if (done[(size_t)i]) return;
     if (sizes[i] == 0) {
       status[i] = ERR_EMPTY;
       return;

@@ -77,6 +77,59 @@ def test_stage_and_cas_digests_parity(tmp_path):
         assert payloads[row, :s].tobytes() == open(p, "rb").read()
 
 
+def test_cas_digests_batched_small_parity(tmp_path):
+    """The cross-file chunk-pooled small path (groups of 8, full chunks
+    gathered across files — sdio.cpp hash8_leaf_cvs_gather) must be
+    byte-identical to the oracle at every boundary: block edges, chunk
+    edges (the 8-byte size prefix shifts content by 8), single-chunk
+    messages, exact-multiple-of-1024 messages (full FINAL leaf), the
+    100 KiB class edge, and group remainders (n % 8 != 0)."""
+    lengths = [1, 7, 55, 63, 64, 65, 1015, 1016, 1017, 1023, 1024,
+               1025, 2040, 2048, 2056, 4096, 8184, 102399, 102400]
+    rng = np.random.default_rng(5)
+    paths = []
+    for i, size in enumerate(lengths):
+        p = tmp_path / f"s{i}.bin"
+        p.write_bytes(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        paths.append(str(p))
+    sizes = np.array(lengths, dtype=np.uint64)
+    for n_threads in (1, 4):
+        digests, status = native.cas_digests(paths, sizes, n_threads)
+        assert (status == native.OK).all()
+        for i, p in enumerate(paths):
+            assert digests[i].tobytes().hex()[:16] == \
+                cas.generate_cas_id(p, lengths[i]), lengths[i]
+
+
+def test_cas_digests_small_group_degrades(tmp_path):
+    """Inside one group of 8: a missing file errors alone, and a file
+    that GREW past the 100 KiB class cap falls back to the unbounded
+    scalar path — declared-size prefix, whole actual content (the
+    fs::read semantics of cas.rs:27)."""
+    rng = np.random.default_rng(6)
+    paths, sizes = [], []
+    for i in range(8):
+        p = tmp_path / f"g{i}.bin"
+        p.write_bytes(rng.integers(0, 256, 3000, dtype=np.uint8).tobytes())
+        paths.append(str(p))
+        sizes.append(3000)
+    paths[2] = str(tmp_path / "missing.bin")
+    grown = tmp_path / "g5.bin"
+    grown.write_bytes(rng.integers(
+        0, 256, native.SMALL_CAP + 500, dtype=np.uint8).tobytes())
+    digests, status = native.cas_digests(
+        paths, np.array(sizes, dtype=np.uint64), 1)
+    assert status[2] == native.ERR_OPEN
+    ok = [i for i in range(8) if i != 2]
+    assert (status[ok] == native.OK).all()
+    import struct
+    from spacedrive_tpu.ops.blake3_ref import blake3_hex
+    for i in ok:
+        want = blake3_hex(struct.pack("<Q", sizes[i])
+                          + open(paths[i], "rb").read())[:16]
+        assert digests[i].tobytes().hex()[:16] == want, i
+
+
 def test_stage_errors(tmp_path):
     missing = str(tmp_path / "nope.bin")
     _, status = native.stage_large([missing], np.array([200000], np.uint64))
