@@ -635,6 +635,10 @@ constexpr uint64_t SAMPLE_COUNT = 4;
 constexpr uint64_t SAMPLE_SIZE = 1024 * 10;
 constexpr uint64_t HEADER_OR_FOOTER_SIZE = 1024 * 8;
 constexpr uint64_t MINIMUM_FILE_SIZE = 1024 * 100;
+// The batched whole-file hasher caps at the CAS small-class edge:
+// sd_cas_digests partitions by MINIMUM_FILE_SIZE and relies on every
+// partitioned lane fitting the group buffer.
+constexpr uint64_t SMALL_WHOLE_CAP = MINIMUM_FILE_SIZE;
 constexpr uint64_t LARGE_PAYLOAD =
     2 * HEADER_OR_FOOTER_SIZE + SAMPLE_COUNT * SAMPLE_SIZE;  // 57344
 constexpr size_t CHECKSUM_BLOCK = 1 << 20;  // validation/hash.rs:8
@@ -726,6 +730,135 @@ static void parallel_for(int64_t n, int n_threads, F&& fn) {
 }
 
 }  // namespace
+
+#if defined(__AVX2__)
+// Whole-file hashing for small files, batched 8 per group with their
+// full 1024-byte chunks POOLED ACROSS the group via the gather kernel:
+// a ~4 KiB file has only 4 full chunks, far short of the 8 consecutive
+// chunks the within-stream fast path needs, but 8 such files together
+// keep all SIMD lanes busy. Tails, single-chunk messages and parent
+// merges stay scalar (~6% of the compressions). Message is [8-byte LE
+// prefix_sizes[i] when non-null] ‖ whole ACTUAL content. Error lanes
+// set status+done alone; lanes past SMALL_WHOLE_CAP leave done=0 for
+// the caller's unbounded fallback. Shared by CAS IDs (declared-size
+// prefix, cas.rs:23-27) and full-file checksums (no prefix).
+static void hash_small_whole_groups(const std::vector<int64_t>& small,
+                                    const char** paths,
+                                    const uint64_t* prefix_sizes,
+                                    uint8_t* digests, int32_t* status,
+                                    std::vector<uint8_t>& done,
+                                    int n_threads) {
+  constexpr uint64_t MSG_CAP = 8 + SMALL_WHOLE_CAP;  // prefix + content
+  constexpr uint32_t MAX_CVS = (uint32_t)(MSG_CAP / CHUNK_LEN) + 1;
+  const uint64_t pre = prefix_sizes ? 8 : 0;
+  const int64_t n_sgroups = (int64_t)small.size() / 8;
+  parallel_for(n_sgroups, n_threads, [&](int64_t g) {
+    // One zero-fill per WORKER THREAD, reused across its groups — a
+    // fresh 819 KB vector per 8 tiny files would cost more in mmap +
+    // memset than the hashing it feeds.
+    thread_local std::vector<uint8_t> buf;
+    if (buf.size() < (size_t)8 * (MSG_CAP + 1))
+      buf.resize((size_t)8 * (MSG_CAP + 1));
+    uint64_t mlen[8];
+    bool live[8];
+    for (int j = 0; j < 8; j++) {
+      const int64_t i = small[(size_t)(g * 8 + j)];
+      uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      live[j] = false;
+      mlen[j] = 0;
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        status[i] = ERR_OPEN;
+        done[(size_t)i] = 1;
+        continue;
+      }
+      if (pre) le64(prefix_sizes[i], msg);
+      uint64_t off = 0;
+      bool io_err = false;
+      // Whole ACTUAL file regardless of any declared size — +1 byte of
+      // headroom detects a file that grew past the cap, which falls
+      // through to the caller's unbounded path.
+      for (;;) {
+        ssize_t r = pread(fd, msg + pre + off,
+                          (size_t)(SMALL_WHOLE_CAP + 1 - off), (off_t)off);
+        if (r < 0) {
+          status[i] = ERR_IO;
+          io_err = true;
+          break;
+        }
+        if (r == 0) break;
+        off += (uint64_t)r;
+        if (off > SMALL_WHOLE_CAP) break;
+      }
+      close(fd);
+      if (io_err) {
+        done[(size_t)i] = 1;
+        continue;
+      }
+      if (off > SMALL_WHOLE_CAP) continue;  // grew: caller's fallback
+      mlen[j] = pre + off;
+      live[j] = true;
+      done[(size_t)i] = 1;
+    }
+
+    // Pool every full leaf chunk of the group's multi-chunk messages.
+    // A full FINAL chunk of a multi-chunk message is flag-identical to
+    // any other full leaf (ROOT lives on the parent), so it pools too.
+    struct Desc {
+      const uint8_t* p;
+      uint64_t ctr;
+      uint8_t lane;
+      uint8_t ci;
+    };
+    Desc ds[8 * (MSG_CAP / CHUNK_LEN)];
+    int nd = 0;
+    static_assert(MAX_CVS <= 256, "ci is uint8_t");
+    uint32_t cvs[8][MAX_CVS][8];
+    uint32_t ncv[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int j = 0; j < 8; j++) {
+      if (!live[j] || mlen[j] <= CHUNK_LEN) continue;
+      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      const uint64_t n_full = mlen[j] / CHUNK_LEN;
+      for (uint64_t c = 0; c < n_full; c++)
+        ds[nd++] = {msg + c * CHUNK_LEN, c, (uint8_t)j, (uint8_t)c};
+      ncv[j] = (uint32_t)(n_full + (mlen[j] % CHUNK_LEN ? 1 : 0));
+    }
+    int k = 0;
+    for (; k + 8 <= nd; k += 8) {
+      const uint8_t* p[8];
+      uint64_t ctr[8];
+      uint32_t out_cvs[8][8];
+      for (int j = 0; j < 8; j++) {
+        p[j] = ds[k + j].p;
+        ctr[j] = ds[k + j].ctr;
+      }
+      wide::hash8_leaf_cvs_gather(p, ctr, out_cvs);
+      for (int j = 0; j < 8; j++)
+        std::memcpy(cvs[ds[k + j].lane][ds[k + j].ci], out_cvs[j], 32);
+    }
+    for (; k < nd; k++)
+      leaf_chunk_cv(ds[k].p, CHUNK_LEN, ds[k].ctr,
+                    cvs[ds[k].lane][ds[k].ci]);
+
+    for (int j = 0; j < 8; j++) {
+      if (!live[j]) continue;
+      const int64_t i = small[(size_t)(g * 8 + j)];
+      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
+      if (mlen[j] <= CHUNK_LEN) {
+        single_chunk_root(msg, (size_t)mlen[j], digests + i * 32);
+      } else {
+        const uint64_t n_full = mlen[j] / CHUNK_LEN;
+        const uint64_t tail = mlen[j] % CHUNK_LEN;
+        if (tail)
+          leaf_chunk_cv(msg + n_full * CHUNK_LEN, (size_t)tail, n_full,
+                        cvs[j][n_full]);
+        merge_cvs_root(cvs[j], ncv[j], digests + i * 32);
+      }
+      status[i] = OK;
+    }
+  });
+}
+#endif  // __AVX2__
 
 extern "C" {
 
@@ -840,7 +973,9 @@ void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
     if (sizes[i] > MINIMUM_FILE_SIZE) large.push_back(i);
   const int64_t n_lgroups = (int64_t)large.size() / 8;
   parallel_for(n_lgroups, n_threads, [&](int64_t g) {
-    std::vector<uint8_t> buf(8 * LARGE_PAYLOAD);
+    thread_local std::vector<uint8_t> buf;  // reused across groups
+    if (buf.size() < (size_t)8 * LARGE_PAYLOAD)
+      buf.resize((size_t)8 * LARGE_PAYLOAD);
     const uint8_t* rows[8];
     uint64_t prefixes[8];
     bool all_ok = true;
@@ -881,119 +1016,13 @@ void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
   });
 
   // Small files (whole-file messages, cas.rs:27) batched 8 per group
-  // with their full 1024-byte chunks POOLED ACROSS the group via the
-  // gather kernel: a ~4 KiB file has only 4 full chunks, far short of
-  // the 8 consecutive chunks the within-stream fast path needs, but 8
-  // such files together keep all SIMD lanes busy. Tails, single-chunk
-  // messages and parent merges stay scalar (~6% of the compressions).
-  constexpr uint64_t SMALL_CAP = MINIMUM_FILE_SIZE;  // content cap
-  constexpr uint64_t MSG_CAP = 8 + SMALL_CAP;        // prefix + content
-  constexpr uint32_t MAX_CVS = (uint32_t)(MSG_CAP / CHUNK_LEN) + 1;
+  // with their full 1024-byte chunks pooled across the group.
   std::vector<int64_t> small;
   small.reserve((size_t)n);
   for (int64_t i = 0; i < n; i++)
     if (sizes[i] != 0 && sizes[i] <= MINIMUM_FILE_SIZE) small.push_back(i);
-  const int64_t n_sgroups = (int64_t)small.size() / 8;
-  parallel_for(n_sgroups, n_threads, [&](int64_t g) {
-    std::vector<uint8_t> buf((size_t)8 * (MSG_CAP + 1));
-    uint64_t mlen[8];
-    bool live[8];
-    for (int j = 0; j < 8; j++) {
-      const int64_t i = small[(size_t)(g * 8 + j)];
-      uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
-      live[j] = false;
-      mlen[j] = 0;
-      int fd = open(paths[i], O_RDONLY);
-      if (fd < 0) {
-        status[i] = ERR_OPEN;
-        done[(size_t)i] = 1;
-        continue;
-      }
-      le64(sizes[i], msg);  // declared-size prefix (cas.rs:23-26)
-      uint64_t off = 0;
-      bool io_err = false;
-      // Whole ACTUAL file regardless of the declared size (fs::read,
-      // cas.rs:27) — +1 byte of headroom detects a file that grew past
-      // the small cap, which falls through to the unbounded scalar path.
-      for (;;) {
-        ssize_t r = pread(fd, msg + 8 + off, (size_t)(SMALL_CAP + 1 - off),
-                          (off_t)off);
-        if (r < 0) {
-          status[i] = ERR_IO;
-          io_err = true;
-          break;
-        }
-        if (r == 0) break;
-        off += (uint64_t)r;
-        if (off > SMALL_CAP) break;
-      }
-      close(fd);
-      if (io_err) {
-        done[(size_t)i] = 1;
-        continue;
-      }
-      if (off > SMALL_CAP) continue;  // grew: done stays 0 -> scalar sweep
-      mlen[j] = 8 + off;
-      live[j] = true;
-      done[(size_t)i] = 1;
-    }
-
-    // Pool every full leaf chunk of the group's multi-chunk messages.
-    // A full FINAL chunk of a multi-chunk message is flag-identical to
-    // any other full leaf (ROOT lives on the parent), so it pools too.
-    struct Desc {
-      const uint8_t* p;
-      uint64_t ctr;
-      uint8_t lane;
-      uint8_t ci;
-    };
-    Desc ds[8 * (MSG_CAP / CHUNK_LEN)];
-    int nd = 0;
-    static_assert(MAX_CVS <= 256, "ci is uint8_t");
-    uint32_t cvs[8][MAX_CVS][8];
-    uint32_t ncv[8] = {0, 0, 0, 0, 0, 0, 0, 0};
-    for (int j = 0; j < 8; j++) {
-      if (!live[j] || mlen[j] <= CHUNK_LEN) continue;
-      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
-      const uint64_t n_full = mlen[j] / CHUNK_LEN;
-      for (uint64_t c = 0; c < n_full; c++)
-        ds[nd++] = {msg + c * CHUNK_LEN, c, (uint8_t)j, (uint8_t)c};
-      ncv[j] = (uint32_t)(n_full + (mlen[j] % CHUNK_LEN ? 1 : 0));
-    }
-    int k = 0;
-    for (; k + 8 <= nd; k += 8) {
-      const uint8_t* p[8];
-      uint64_t ctr[8];
-      uint32_t out_cvs[8][8];
-      for (int j = 0; j < 8; j++) {
-        p[j] = ds[k + j].p;
-        ctr[j] = ds[k + j].ctr;
-      }
-      wide::hash8_leaf_cvs_gather(p, ctr, out_cvs);
-      for (int j = 0; j < 8; j++)
-        std::memcpy(cvs[ds[k + j].lane][ds[k + j].ci], out_cvs[j], 32);
-    }
-    for (; k < nd; k++)
-      leaf_chunk_cv(ds[k].p, CHUNK_LEN, ds[k].ctr,
-                    cvs[ds[k].lane][ds[k].ci]);
-
-    for (int j = 0; j < 8; j++) {
-      if (!live[j]) continue;
-      const int64_t i = small[(size_t)(g * 8 + j)];
-      const uint8_t* msg = buf.data() + (size_t)j * (MSG_CAP + 1);
-      if (mlen[j] <= CHUNK_LEN) {
-        single_chunk_root(msg, (size_t)mlen[j], digests + i * 32);
-      } else {
-        const uint64_t n_full = mlen[j] / CHUNK_LEN;
-        const uint64_t tail = mlen[j] % CHUNK_LEN;
-        if (tail)
-          leaf_chunk_cv(msg + n_full * CHUNK_LEN, (size_t)tail, n_full,
-                        cvs[j][n_full]);
-        merge_cvs_root(cvs[j], ncv[j], digests + i * 32);
-      }
-      status[i] = OK;
-    }
-  });
+  hash_small_whole_groups(small, paths, sizes, digests, status, done,
+                          n_threads);
 #endif
   parallel_for(n, n_threads, [&](int64_t i) {
     if (done[(size_t)i]) return;
@@ -1042,9 +1071,41 @@ void sd_cas_digests(int64_t n, const char** paths, const uint64_t* sizes,
 }
 
 // Full-file checksums, 1 MiB streaming blocks (validation/hash.rs:10-24).
-void sd_checksum_files(int64_t n, const char** paths, uint8_t* digests,
+// `sizes_hint` (nullable) routes files to the batched small path without
+// any stat — callers like the validator already hold sizes from the DB.
+// The hint only PARTITIONS: a hinted-small file that is actually larger
+// than the cap is detected at read time and re-streamed, so a stale hint
+// costs one wasted read, never a wrong digest.
+void sd_checksum_files(int64_t n, const char** paths,
+                       const uint64_t* sizes_hint, uint8_t* digests,
                        int32_t* status, int n_threads) {
+  (void)sizes_hint;  // partition hint is AVX2-path-only
+  std::vector<uint8_t> done((size_t)n, 0);
+#if defined(__AVX2__)
+  // Small regular files go through the cross-file chunk-pooled groups
+  // (no size prefix — validation/hash.rs hashes content only); files a
+  // stat can't see or that grow past the cap stream below as before.
+  // Without a hint, stat in parallel — a serial pre-pass over 1M paths
+  // would gate the whole call on one thread's syscall loop.
+  std::vector<uint64_t> stat_sizes;
+  if (!sizes_hint) {
+    stat_sizes.assign((size_t)n, UINT64_MAX);  // sentinel: stream it
+    parallel_for(n, n_threads, [&](int64_t i) {
+      struct stat st;
+      if (stat(paths[i], &st) == 0 && S_ISREG(st.st_mode))
+        stat_sizes[(size_t)i] = (uint64_t)st.st_size;
+    });
+  }
+  const uint64_t* part = sizes_hint ? sizes_hint : stat_sizes.data();
+  std::vector<int64_t> small;
+  small.reserve((size_t)n);
+  for (int64_t i = 0; i < n; i++)
+    if (part[i] <= SMALL_WHOLE_CAP) small.push_back(i);
+  hash_small_whole_groups(small, paths, nullptr, digests, status, done,
+                          n_threads);
+#endif
   parallel_for(n, n_threads, [&](int64_t i) {
+    if (done[(size_t)i]) return;
     int fd = open(paths[i], O_RDONLY);
     if (fd < 0) {
       status[i] = ERR_OPEN;

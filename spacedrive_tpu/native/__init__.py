@@ -87,7 +87,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, charpp, u64p, u8p, i32p, ctypes.c_int]
     lib.sd_cas_digests.restype = None
     lib.sd_checksum_files.argtypes = [
-        ctypes.c_int64, charpp, u8p, i32p, ctypes.c_int]
+        ctypes.c_int64, charpp, u64p, u8p, i32p, ctypes.c_int]
     lib.sd_checksum_files.restype = None
     lib.sd_secure_erase.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.sd_secure_erase.restype = ctypes.c_int32
@@ -228,16 +228,24 @@ def cas_digests(paths: Sequence[str], sizes: np.ndarray,
 
 
 def checksum_files(paths: Sequence[str],
-                   n_threads: int = 0) -> Tuple[List[Optional[str]],
-                                                np.ndarray]:
-    """Full-file BLAKE3 checksums → ([n] hex-or-None, [n] status)."""
+                   n_threads: int = 0,
+                   sizes_hint: Optional[np.ndarray] = None,
+                   ) -> Tuple[List[Optional[str]], np.ndarray]:
+    """Full-file BLAKE3 checksums → ([n] hex-or-None, [n] status).
+
+    `sizes_hint` (DB-known sizes) routes small files to the batched
+    cross-file SIMD path without a stat sweep; it only partitions —
+    stale hints re-route at read time, digests never depend on it."""
     lib = _load()
     assert lib is not None
     n = len(paths)
     digests = np.zeros((n, 32), dtype=np.uint8)
     status = np.zeros(n, dtype=np.int32)
     if n:
-        lib.sd_checksum_files(n, _paths_array(paths), _u8(digests),
+        hint = None
+        if sizes_hint is not None:
+            hint = _u64(np.ascontiguousarray(sizes_hint, dtype=np.uint64))
+        lib.sd_checksum_files(n, _paths_array(paths), hint, _u8(digests),
                               _i32(status), n_threads)
     hexes: List[Optional[str]] = [
         digests[i].tobytes().hex() if status[i] == OK else None

@@ -73,6 +73,11 @@ def stage_file_list(rows: List[Dict[str, Any]], location_id: int,
     files: List[Tuple[str, int]] = []
     base = os.fspath(location_path)
     sep_fix = os.sep != "/"
+    # rel never starts with a separator (materialized_path[1:] strips
+    # the leading "/"), so join(base, rel) is exactly base+sep+rel —
+    # os.path.join's per-call scan was ~0.9 s of a 200k identify.
+    if not base.endswith(os.sep):
+        base += os.sep
     for r in rows:
         name = r["name"] or ""
         ext = r["extension"] or ""
@@ -81,7 +86,7 @@ def stage_file_list(rows: List[Dict[str, Any]], location_id: int,
         if sep_fix:
             rel = rel.replace("/", os.sep)
         size = int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
-        files.append((os.path.join(base, rel), size))
+        files.append((base + rel, size))
     return files
 
 

@@ -85,13 +85,15 @@ class ObjectValidatorJob(StatefulJob):
     def _fetch_rows(self, db, data) -> List[Dict[str, Any]]:
         rows = db.query(
             f"SELECT id, pub_id, materialized_path, name, extension, "
-            f"integrity_checksum FROM file_path WHERE {data['where']} "
+            f"integrity_checksum, size_in_bytes_bytes "
+            f"FROM file_path WHERE {data['where']} "
             f"AND id >= ? ORDER BY id LIMIT ?",
             list(data["params"]) + [data["cursor"], data["chunk"]])
         return [{
             "id": r["id"], "pub_id": r["pub_id"],
             "materialized_path": r["materialized_path"],
             "name": r["name"] or "", "extension": r["extension"] or "",
+            "size": int.from_bytes(r["size_in_bytes_bytes"] or b"", "big"),
             "expected": r["integrity_checksum"],
         } for r in rows]
 
@@ -225,7 +227,14 @@ class ObjectValidatorJob(StatefulJob):
                 results.append((r, path, checksum))
         elif native.available() and jobs:
             # Batched native plane: one call, pooled pread + C++ BLAKE3.
-            hexes, status = native.checksum_files([p for _, p in jobs])
+            # DB sizes route small files to the cross-file SIMD groups
+            # without a stat sweep (partition hint only — stale sizes
+            # re-route at read time, never change a digest).
+            import numpy as np
+            hexes, status = native.checksum_files(
+                [p for _, p in jobs],
+                sizes_hint=np.array([r["size"] for r, _ in jobs],
+                                    dtype=np.uint64))
             for (r, path), checksum, st in zip(jobs, hexes, status):
                 if checksum is None:
                     errors.append(

@@ -29,6 +29,17 @@ from .crdt import (CRDTOperation, OpKind, RelationOp, SharedOp, op_payload,
                    pack_value, unpack_value, uuid4_bytes, uuid4_bytes_batch)
 from .hlc import HLC
 
+# Pre-encoded msgpack fragments of op_payload's canonical key order for
+# the two field-is-None shapes bulk_shared_ops emits (create: 5-key map;
+# multi-field update: 6-key map with trailing update=True). Any change
+# to op_payload's dict layout MUST change these — the byte-equality
+# test between the bulk and dataclass op paths is the guard.
+_BULK_HDR5 = b"\x85\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
+_BULK_HDR6 = b"\x86\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
+_BULK_OPID = b"\xa5op_id\xc4\x10"
+_BULK_VALUES = b"\xa6values"
+_BULK_UPDATE_T = b"\xa6update\xc3"
+
 
 @dataclass
 class GetOpsArgs:
@@ -261,9 +272,22 @@ class SyncManager:
             return pack_value(rid)
 
         def _data(kind: str, field, value, values, op_id) -> bytes:
+            # field-is-None ops (creates and multi-field updates — the
+            # ONLY shapes bulk writers emit) concatenate pre-encoded
+            # msgpack fragments around one packb of `values`, skipping
+            # the per-op payload-dict build (1.8 -> 0.7 µs/op at 380k
+            # ops per 200k-file identify). Byte-equality with the
+            # dataclass path is asserted by tests — _compare_message
+            # dedup depends on it.
+            if field is None:
+                if kind.startswith("u:"):
+                    return (_BULK_HDR6 + _BULK_OPID + op_id
+                            + _BULK_VALUES + pack_value(values)
+                            + _BULK_UPDATE_T)
+                return (_BULK_HDR5 + _BULK_OPID + op_id
+                        + _BULK_VALUES + pack_value(values))
             return pack_value(op_payload(
-                field, value, False, op_id, values,
-                update=field is None and kind.startswith("u:")))
+                field, value, False, op_id, values))
 
         rows = [
             (ts, model, _rid(rid), kind,

@@ -149,16 +149,23 @@ def test_stage_errors(tmp_path):
 
 
 def test_checksums_parity(tmp_path):
+    """Sizes straddle the batched-group cap (100 KiB): the first 12+
+    small files exercise the cross-file chunk-pooled groups (content
+    only — no size prefix, unlike CAS), the MiB-scale ones the
+    streaming path, all against the oracle."""
     rng = np.random.default_rng(11)
+    sizes = [0, 1, 100, 1023, 1024, 1025, 2048, 3000, 4096, 8192,
+             102399, 102400, 102401, 1 << 20, (1 << 20) + 17]
     paths = []
-    for i, size in enumerate([0, 100, 1 << 20, (1 << 20) + 17]):
+    for i, size in enumerate(sizes):
         p = tmp_path / f"c{i}.bin"
         p.write_bytes(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
         paths.append(str(p))
-    hexes, status = native.checksum_files(paths)
-    assert (status == native.OK).all()
-    for p, h in zip(paths, hexes):
-        assert h == cas.file_checksum(p)
+    for n_threads in (1, 4):
+        hexes, status = native.checksum_files(paths, n_threads)
+        assert (status == native.OK).all()
+        for p, h in zip(paths, hexes):
+            assert h == cas.file_checksum(p)
 
 
 def test_secure_erase(tmp_path):

@@ -66,6 +66,34 @@ def test_shared_create_is_one_value_carrying_op(pair):
     assert got[0].typ.values["path"] == "/home"  # round-trips the log
 
 
+def test_bulk_shared_ops_byte_equal_to_dataclass_path(pair):
+    """The bulk fast path (fragment-concatenated msgpack) must emit
+    rows BYTE-identical to packing the canonical op_payload dict —
+    _compare_message dedup and backup replay compare these blobs."""
+    from spacedrive_tpu.sync.crdt import op_payload, pack_value, unpack_value
+    a, _ = pair
+    pub1, pub2 = uuid.uuid4().bytes, uuid.uuid4().bytes
+    specs = [
+        (pub1, "c", None, None, {"kind": 5, "date_created": "2026-01-01"}),
+        (pub2, "u:cas_id+object_id", None, None,
+         {"cas_id": "0123456789abcdef", "object_id": pub1}),
+        (pub2, "u:note", "note", "hello", None),
+        (7, "u:note", "note", None, None),  # non-16-byte record id
+    ]
+    with a.db.tx() as conn:
+        assert a.bulk_shared_ops(conn, "object", specs) == len(specs)
+    rows = a.db.query("SELECT * FROM shared_operation ORDER BY timestamp")
+    assert len(rows) == len(specs)
+    for row, (rid, kind, field, value, values) in zip(rows, specs):
+        assert bytes(row["record_id"]) == pack_value(rid)
+        assert row["kind"] == kind
+        payload = unpack_value(row["data"])
+        want = pack_value(op_payload(
+            field, value, False, payload["op_id"], values,
+            update=field is None and kind.startswith("u:")))
+        assert bytes(row["data"]) == want
+
+
 def test_wire_roundtrip(pair):
     a, _ = pair
     op = a.shared_update("object", b"\x01" * 16, "note", "hello")
