@@ -773,30 +773,20 @@ static void hash_small_whole_groups(const std::vector<int64_t>& small,
         continue;
       }
       if (pre) le64(prefix_sizes[i], msg);
-      uint64_t off = 0;
-      bool io_err = false;
-      // Whole ACTUAL file regardless of any declared size — +1 byte of
-      // headroom detects a file that grew past the cap, which falls
-      // through to the caller's unbounded path.
-      for (;;) {
-        ssize_t r = pread(fd, msg + pre + off,
-                          (size_t)(SMALL_WHOLE_CAP + 1 - off), (off_t)off);
-        if (r < 0) {
-          status[i] = ERR_IO;
-          io_err = true;
-          break;
-        }
-        if (r == 0) break;
-        off += (uint64_t)r;
-        if (off > SMALL_WHOLE_CAP) break;
-      }
+      // Whole ACTUAL file regardless of any declared size; read_small's
+      // +1-byte headroom flags a file that grew past the cap, which
+      // falls through to the caller's unbounded path (done stays 0).
+      int32_t content_len = 0;
+      const int32_t rs =
+          read_small(fd, SMALL_WHOLE_CAP, msg + pre, &content_len);
       close(fd);
-      if (io_err) {
+      if (rs == ERR_GREW) continue;
+      if (rs != OK) {
+        status[i] = rs;
         done[(size_t)i] = 1;
         continue;
       }
-      if (off > SMALL_WHOLE_CAP) continue;  // grew: caller's fallback
-      mlen[j] = pre + off;
+      mlen[j] = pre + (uint64_t)content_len;
       live[j] = true;
       done[(size_t)i] = 1;
     }

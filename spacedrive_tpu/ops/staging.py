@@ -244,6 +244,11 @@ AUTO_DEVICE_BATCH = 16384  # amortizes ~7-10 ms per-dispatch overhead
 # op building, transaction commit) amortizes over thousands of files
 # instead of the reference's 100 (file_identifier/mod.rs:36). The native
 # C++ plane streams per file, so chunk size costs no extra memory.
+# Sized by interleaved A/B on the 1M corpus (the bench host's IO
+# weather swings 2x between windows, so only same-window pairs count):
+# 4096 beat 16384 in both interleaved pairs (53/58 s vs 69/80 s);
+# sequential runs had earlier suggested the opposite, confounded by
+# weather. Bigger chunks also grow the crash-replay window 4x.
 AUTO_NATIVE_BATCH = 4096
 
 # The CAS pipeline is H2D-bound end-to-end (the pallas kernel sustains
