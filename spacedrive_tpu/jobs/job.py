@@ -182,9 +182,14 @@ class JobContext:
     actors (thumbnailer, staging pool) without jobs importing the node.
     """
 
-    def __init__(self, library: Any, report_progress=None, services: Optional[dict] = None):
+    def __init__(self, library: Any, report_progress=None,
+                 services: Optional[dict] = None,
+                 job_id: Optional[bytes] = None):
         self.library = library
         self.services = services or {}
+        # The running job's report id — keys job_scratch rows (spooled
+        # step payloads) so sweeps can target one job's leftovers.
+        self.job_id = job_id
         self._report_progress = report_progress or (lambda **kw: None)
 
     @property

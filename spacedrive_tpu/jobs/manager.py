@@ -227,6 +227,12 @@ class JobManager:
         entry.report.status = JobStatus.CANCELED
         entry.report.data = None
         entry.report.update(entry.library.db)
+        # A queued/paused job never reaches the worker's cancel path, so
+        # its cleanup hook never runs — sweep spooled step payloads here
+        # or a cancelled paused index leaks its scratch blobs until the
+        # job row itself is cleared (FK cascade).
+        entry.library.db.execute(
+            "DELETE FROM job_scratch WHERE job_id = ?", (job_id,))
 
     def _worker(self, job_id: bytes) -> Worker:
         if job_id not in self.running:

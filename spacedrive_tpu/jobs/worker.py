@@ -130,7 +130,7 @@ class Worker:
     async def _run_inner(self) -> JobStatus:
         r = self.report
         ctx = JobContext(self.library, report_progress=self._progress,
-                         services=self.services)
+                         services=self.services, job_id=r.id)
         self._started_at = time.monotonic()
         r.status = JobStatus.RUNNING
         r.date_started = int(time.time())
@@ -301,7 +301,8 @@ class Worker:
         """Run the job's no-finalize teardown hook; never raises."""
         try:
             await self.job.cleanup(
-                JobContext(self.library, services=self.services), data)
+                JobContext(self.library, services=self.services,
+                           job_id=self.report.id), data)
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
 

@@ -491,6 +491,26 @@ register(Model(
     ),
 ))
 
+# Spooled step payloads for batch jobs (net-new vs the reference, which
+# rmp-serializes every remaining step into job.data, job/mod.rs:896):
+# steps carry a scratch row id instead of inline row lists, so the
+# periodic crash checkpoint serializes kilobytes of descriptors rather
+# than the whole remaining workload (measured ~200 MB / ~23 s per
+# 3-second checkpoint for a 1M-file index before this). Rows delete as
+# steps complete; finalize/cleanup and the job-row FK cascade sweep
+# leftovers.
+
+register(Model(
+    "job_scratch",
+    (
+        _id(),
+        Field("job_id", "BLOB", nullable=False,
+              references="job(id)", on_delete="CASCADE"),
+        Field("data", "BLOB", nullable=False),
+    ),
+    indexes=(("job_id",),),
+))
+
 # --- IndexerRule (@local here; schema.prisma:490). ------------------------
 
 register(Model(
