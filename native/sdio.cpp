@@ -1121,6 +1121,128 @@ void sd_checksum_files(int64_t n, const char** paths,
   });
 }
 
+// ---------------------------------------------------------------------------
+// Batched op-log encoding (the sync plane's msgpack hot path).
+//
+// sd_encode_ops emits one shared_op_blob `data` payload for a whole
+// bulk-writer chunk: a msgpack array of per-op
+//   [timestamp(uint), record_id(bin 18), kind(str), payload(bin)]
+// entries, where payload is the canonical op_payload dict packing for
+// the field-is-None shapes (create / multi-field update) — BYTE-
+// IDENTICAL to the Python fragment encoder in
+// spacedrive_tpu/sync/opblob.py, which is both the fallback and the
+// parity oracle (tests/test_sync_blob.py). The minimal-width msgpack
+// emitters below must match msgpack-python's packb output exactly.
+// ---------------------------------------------------------------------------
+
+static inline uint8_t* mp_be(uint8_t* p, uint64_t v, int nbytes) {
+  for (int i = nbytes - 1; i >= 0; i--) *p++ = (uint8_t)(v >> (8 * i));
+  return p;
+}
+
+static inline uint8_t* mp_uint(uint8_t* p, uint64_t v) {
+  if (v < 0x80) { *p++ = (uint8_t)v; return p; }
+  if (v < 0x100) { *p++ = 0xcc; return mp_be(p, v, 1); }
+  if (v < 0x10000) { *p++ = 0xcd; return mp_be(p, v, 2); }
+  if (v <= 0xFFFFFFFFull) { *p++ = 0xce; return mp_be(p, v, 4); }
+  *p++ = 0xcf;
+  return mp_be(p, v, 8);
+}
+
+static inline uint8_t* mp_bin_hdr(uint8_t* p, uint64_t len) {
+  if (len < 0x100) { *p++ = 0xc4; return mp_be(p, len, 1); }
+  if (len < 0x10000) { *p++ = 0xc5; return mp_be(p, len, 2); }
+  *p++ = 0xc6;
+  return mp_be(p, len, 4);
+}
+
+static inline uint8_t* mp_str(uint8_t* p, const char* s, size_t len) {
+  if (len < 32) {
+    *p++ = (uint8_t)(0xa0 | len);
+  } else if (len < 0x100) {
+    *p++ = 0xd9;
+    *p++ = (uint8_t)len;
+  } else {
+    *p++ = 0xda;
+    p = mp_be(p, len, 2);
+  }
+  std::memcpy(p, s, len);
+  return p + len;
+}
+
+// Mirrors of sync/opblob.py's BULK_* fragments (op_payload key order).
+static const uint8_t OP_HDR5[23] = {
+    0x85, 0xa5, 'f', 'i', 'e', 'l', 'd', 0xc0, 0xa5, 'v', 'a', 'l',
+    'u', 'e', 0xc0, 0xa6, 'd', 'e', 'l', 'e', 't', 'e', 0xc2};
+static const uint8_t OP_HDR6[23] = {
+    0x86, 0xa5, 'f', 'i', 'e', 'l', 'd', 0xc0, 0xa5, 'v', 'a', 'l',
+    'u', 'e', 0xc0, 0xa6, 'd', 'e', 'l', 'e', 't', 'e', 0xc2};
+static const uint8_t OP_OPID[8] = {0xa5, 'o', 'p', '_', 'i', 'd',
+                                   0xc4, 0x10};
+static const uint8_t OP_VALUES[7] = {0xa6, 'v', 'a', 'l', 'u', 'e', 's'};
+static const uint8_t OP_UPDATE_T[8] = {0xa6, 'u', 'p', 'd', 'a', 't',
+                                       'e', 0xc3};
+
+// Encode n ops of one uniform kind into a blob. record_ids/op_ids are
+// dense n×16 arrays (pub ids — the only shape bulk writers emit);
+// values_buf holds the pre-packed msgpack of each op's values dict,
+// sliced by values_offsets[n+1]. Returns bytes written, or -1 when
+// out_cap is too small (callers over-allocate; -1 is a logic error).
+int64_t sd_encode_ops(int64_t n, const uint64_t* timestamps,
+                      const uint8_t* record_ids, const char* kind,
+                      const uint8_t* op_ids, const uint8_t* values_buf,
+                      const int64_t* values_offsets, uint8_t* out,
+                      int64_t out_cap) {
+  const size_t klen = std::strlen(kind);
+  const bool update = klen >= 2 && kind[0] == 'u' && kind[1] == ':';
+  uint8_t* p = out;
+  const uint8_t* end = out + out_cap;
+  if (out_cap < 8) return -1;
+  if (n < 16) {
+    *p++ = (uint8_t)(0x90 | n);
+  } else if (n < 65536) {
+    *p++ = 0xdc;
+    p = mp_be(p, (uint64_t)n, 2);
+  } else {
+    *p++ = 0xdd;
+    p = mp_be(p, (uint64_t)n, 4);
+  }
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t vlen = values_offsets[i + 1] - values_offsets[i];
+    const uint64_t plen =
+        sizeof(OP_HDR5) + sizeof(OP_OPID) + 16 + sizeof(OP_VALUES) +
+        (uint64_t)vlen + (update ? sizeof(OP_UPDATE_T) : 0);
+    // worst-case framing: 1 (fixarray) + 9 (uint64) + 20 (rid bin) +
+    // 3+klen (str) + 5 (payload bin hdr) + plen
+    if (p + 38 + klen + plen > end) return -1;
+    *p++ = 0x94;  // [ts, rid, kind, payload]
+    p = mp_uint(p, timestamps[i]);
+    *p++ = 0xc4;  // bin8(18): msgpack-packed 16-byte pub id
+    *p++ = 18;
+    *p++ = 0xc4;
+    *p++ = 0x10;
+    std::memcpy(p, record_ids + i * 16, 16);
+    p += 16;
+    p = mp_str(p, kind, klen);
+    p = mp_bin_hdr(p, plen);
+    std::memcpy(p, update ? OP_HDR6 : OP_HDR5, sizeof(OP_HDR5));
+    p += sizeof(OP_HDR5);
+    std::memcpy(p, OP_OPID, sizeof(OP_OPID));
+    p += sizeof(OP_OPID);
+    std::memcpy(p, op_ids + i * 16, 16);
+    p += 16;
+    std::memcpy(p, OP_VALUES, sizeof(OP_VALUES));
+    p += sizeof(OP_VALUES);
+    std::memcpy(p, values_buf + values_offsets[i], (size_t)vlen);
+    p += vlen;
+    if (update) {
+      std::memcpy(p, OP_UPDATE_T, sizeof(OP_UPDATE_T));
+      p += sizeof(OP_UPDATE_T);
+    }
+  }
+  return p - out;
+}
+
 // Secure erase: `passes` overwrites with a keystream then zeros, fsync'd
 // (the role of sd-crypto's fs/erase.rs behind the file_eraser job).
 int32_t sd_secure_erase(const char* path, int passes) {

@@ -353,11 +353,19 @@ class IndexerJob(StatefulJob):
         # another dir's to_remove), and only after every save step has
         # had the chance to re-path it by inode can a removal safely
         # judge — path-conditionally — that a row is truly stale.
+        # Deferred payloads SPOOL to job_scratch like save/update rows
+        # (`data` only carries the scratch ids): inline removal dicts
+        # were serialized into every 3-second crash checkpoint, so a
+        # mass-removal rescan (rm -rf of a big subtree) regrew the
+        # checkpoint blob toward the very problem spooling solved.
         if res.to_remove:
-            data["pending_removals"].extend(
+            removals = [
                 {k: r.get(k) for k in (
                     "pub_id", "is_dir", "materialized_path", "name")}
-                for r in res.to_remove)
+                for r in res.to_remove]
+            data.setdefault("removal_scratch", []).extend(self._spool(
+                ctx, [removals[i:i + BATCH_SIZE]
+                      for i in range(0, len(removals), BATCH_SIZE)]))
         save_rows = [_entry_to_row(e, self.location_id) for e in res.walked]
         save_batches = [save_rows[i:i + BATCH_SIZE]
                         for i in range(0, len(save_rows), BATCH_SIZE)]
@@ -397,6 +405,10 @@ class IndexerJob(StatefulJob):
             "location_path": location_path,
             "location_pub_id": loc["pub_id"],
             "dir_sizes": {},
+            # Scratch-row ids of spooled removal batches; the legacy
+            # inline "pending_removals" key is still consumed in
+            # finalize for checkpoints persisted before spooling.
+            "removal_scratch": [],
             "pending_removals": [],
             "total_saved": 0, "total_updated": 0, "total_removed": 0,
         }
@@ -404,7 +416,11 @@ class IndexerJob(StatefulJob):
         res = await asyncio.to_thread(
             walker.walk, to_walk_path, INIT_WALK_LIMIT)
         steps = self._result_to_steps(ctx, res, data)
-        if not steps:
+        # A pure-removal rescan (rm -rf'd subtree, nothing new) emits
+        # zero steps but must still reach finalize, where the spooled
+        # removals are applied — EarlyFinish here would both strand the
+        # stale rows and leak the scratch payloads.
+        if not steps and not data["removal_scratch"]:
             raise EarlyFinish("nothing to index")
         return data, steps
 
@@ -459,11 +475,20 @@ class IndexerJob(StatefulJob):
         """Execute deferred removals (every save has had its chance to
         re-path moved inodes by now), then write accumulated dir sizes
         onto their file_path rows (indexer_job.rs finalize semantics)."""
-        if data.get("pending_removals"):
+        if data.get("pending_removals"):  # pre-spooling checkpoints
             data["total_removed"] += await asyncio.to_thread(
                 remove_file_path_rows, ctx.library, self.location_id,
                 data["pending_removals"])
             data["pending_removals"] = []
+        for sid in data.get("removal_scratch") or []:
+            # Unspool each deferred-removal batch; a consumed/missing
+            # row proves a replayed finalize already removed it.
+            rows = await asyncio.to_thread(
+                self._unspool, ctx, {"scratch": sid})
+            data["total_removed"] += await asyncio.to_thread(
+                remove_file_path_rows, ctx.library, self.location_id,
+                rows, sid)
+        data["removal_scratch"] = []
         db = ctx.db
         loc_path = data["location_path"]
         with db.tx() as conn:

@@ -91,6 +91,11 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sd_checksum_files.restype = None
     lib.sd_secure_erase.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.sd_secure_erase.restype = ctypes.c_int32
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.sd_encode_ops.argtypes = [
+        ctypes.c_int64, u64p, u8p, ctypes.c_char_p, u8p, u8p, i64p,
+        u8p, ctypes.c_int64]
+    lib.sd_encode_ops.restype = ctypes.c_int64
     return lib
 
 
@@ -252,6 +257,49 @@ def checksum_files(paths: Sequence[str],
         for i in range(n)
     ]
     return hexes, status
+
+
+def encode_ops(timestamps, record_ids, kind: str, op_ids,
+               values_packed) -> bytes:
+    """Batched op-log blob encoding (sync/opblob.py format): n ops of
+    one uniform `kind`, 16-byte record/op ids, values pre-packed per
+    op. Returns the msgpack blob bytes — byte-identical to the Python
+    fragment encoder (opblob.encode_uniform_py)."""
+    lib = _load()
+    assert lib is not None
+    n = len(op_ids)
+    if n == 0:
+        return b"\x90"  # empty msgpack array
+    ts = np.fromiter(timestamps, dtype=np.uint64, count=n)
+    rids = np.frombuffer(b"".join(record_ids), dtype=np.uint8)
+    oids = np.frombuffer(b"".join(op_ids), dtype=np.uint8)
+    if rids.size != 16 * n or oids.size != 16 * n:
+        # Same hardening as the cap check below: under `python -O` an
+        # assert would vanish and the C encoder would read shifted
+        # bytes, minting a structurally valid blob with WRONG record
+        # ids — silent op-log corruption.
+        raise ValueError(
+            f"encode_ops: record/op ids must be 16 bytes each "
+            f"(got {rids.size}/{oids.size} bytes for n={n})")
+    vbuf = b"".join(values_packed)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in values_packed], out=offs[1:])
+    vals = (np.frombuffer(vbuf, dtype=np.uint8) if vbuf
+            else np.zeros(1, dtype=np.uint8))
+    kindb = kind.encode("utf-8")
+    cap = 64 + n * (48 + len(kindb) + 70) + len(vbuf)
+    out = np.zeros(cap, dtype=np.uint8)
+    written = lib.sd_encode_ops(
+        n, _u64(ts), _u8(rids), kindb, _u8(oids), _u8(vals),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _u8(out),
+        cap)
+    if written <= 0:
+        # A real exception, not an assert: under `python -O` an assert
+        # would vanish and a truncated garbage blob would land in the
+        # op log — permanent sync corruption, not a crash.
+        raise RuntimeError(
+            f"sd_encode_ops: output buffer undersized (cap={cap}, n={n})")
+    return out[:written].tobytes()
 
 
 def secure_erase(path: str, passes: int = 1) -> None:

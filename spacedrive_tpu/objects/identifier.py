@@ -20,6 +20,8 @@ from __future__ import annotations
 import asyncio
 import os
 import time
+from contextlib import nullcontext
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..sync.crdt import OpKind, uuid4_bytes_batch
@@ -90,34 +92,53 @@ def stage_file_list(rows: List[Dict[str, Any]], location_id: int,
     return files
 
 
+@dataclass
+class TxBatch:
+    """Per-transaction bookkeeping when the job batches several chunks
+    into one commit (identify_chunk's `conn` mode): cas-map keys added
+    inside the open transaction (popped back out if it rolls back) and
+    the op count whose created-broadcast waits for the commit."""
+
+    cas_added: List[str] = field(default_factory=list)
+    n_ops: int = 0
+
+
 def identify_chunk(library, location_id: int, location_path: str,
                    rows: List[Dict[str, Any]], backend: str = "auto",
                    timings: Optional[Dict[str, float]] = None,
                    prehashed: Optional[Tuple] = None,
                    cas_map: Optional[Dict[str, Tuple[int, bytes]]] = None,
+                   conn=None, batch: Optional[TxBatch] = None,
                    ) -> Tuple[int, int, List[str]]:
     """The identifier's per-chunk kernel (identifier_job_step,
     mod.rs:100-331): batched CAS hashing, cas_id writes, object
     linking/creation — all through sync. Returns (linked, created,
     errors). Shared by the job and the shallow/watcher path.
 
-    All writes land in ONE transaction per chunk (the reference batches
-    per pass, mod.rs:144/167/231; one atomic chunk is strictly tighter
-    and 3× fewer commits), with executemany for the row loops so Python
-    stays out of the per-file statement path. `timings` (optional)
+    Without `conn`, all writes land in ONE transaction per chunk (the
+    reference batches per pass, mod.rs:144/167/231; one atomic chunk is
+    strictly tighter and 3× fewer commits), with executemany for the
+    row loops so Python stays out of the per-file statement path. With
+    `conn` + `batch`, the caller owns a transaction spanning SEVERAL
+    chunks (FileIdentifierJob commit batching — WAL commit overhead
+    amortizes across a step): domain+op writes land on that connection,
+    cas-map additions are recorded in `batch.cas_added` so the caller
+    can roll them back, and the created-broadcast is deferred to
+    `batch.n_ops` until the caller commits. `timings` (optional)
     accumulates per-phase seconds: prep / hash / db / ops.
 
     `prehashed` = (files, ids, read_errors) from the job's hash-ahead
     pipeline (chunk i+1 staged+hashed in a worker thread while chunk
     i's transaction commits — CPU overlapping the fsync wait).
 
-    `cas_map` (job-lifetime, maintained post-commit) trades the
-    per-chunk in-tx probes for memory. Concurrency note: an object
-    committed by ANOTHER writer (watcher shallow-identify, sync
-    ingest) mid-run is invisible to the map, so the same content can
-    transiently get a second object row — the dedup job collapses
-    those, and the reference is strictly more duplicative (it creates
-    an object per file_path within a chunk, mod.rs:231-331).
+    `cas_map` (job-lifetime; updated as each chunk's writes land, keyed
+    back out on rollback) trades the per-chunk in-tx probes for memory.
+    Concurrency note: an object committed by ANOTHER writer (watcher
+    shallow-identify, sync ingest) mid-run is invisible to the map, so
+    the same content can transiently get a second object row — the
+    dedup job collapses those, and the reference is strictly more
+    duplicative (it creates an object per file_path within a chunk,
+    mod.rs:231-331).
     """
     t = timings if timings is not None else {}
 
@@ -145,7 +166,8 @@ def identify_chunk(library, location_id: int, location_path: str,
     tp = _mark("prep", tp)
 
     linked = created = n_ops = 0
-    with db.tx() as conn:
+    own_tx = conn is None
+    with (db.tx() if own_tx else nullcontext(conn)) as conn:
         # ---- link targets: existing objects by cas_id (mod.rs:167-225).
         # With a preloaded cas_map (the job's whole-library dict,
         # maintained across chunks) the per-chunk IN() probes vanish —
@@ -234,15 +256,25 @@ def identify_chunk(library, location_id: int, location_path: str,
              {"cas_id": cas_id, "object_id": pub_of[i]})
             for i, cas_id in ids.items()])
         tp = _mark("ops", tp)
-    _mark("db_commit", tp)
+    if own_tx:
+        _mark("db_commit", tp)
     if cas_map is not None:
-        # Job-lifetime map updated only AFTER the commit above: a
-        # rolled-back chunk (step errors are non-fatal) must not leave
-        # uncommitted rowids/pub_ids in the map for later chunks.
+        # Job-lifetime map: with our own tx it updates only AFTER the
+        # commit above (a rolled-back chunk must not leave uncommitted
+        # rowids/pub_ids in the map for later chunks). Inside a caller-
+        # owned multi-chunk tx it updates NOW — the next chunk in the
+        # same transaction must dedup against these objects — and the
+        # added keys ride in batch.cas_added so the caller pops them
+        # back out if the whole transaction rolls back.
         for c, opub in by_cas.items():
             cas_map[c] = (oid_of[opub], opub)
+        if not own_tx and batch is not None:
+            batch.cas_added.extend(by_cas)
     if n_ops:
-        sync._notify_created()
+        if own_tx:
+            sync._notify_created()
+        elif batch is not None:
+            batch.n_ops += n_ops  # broadcast after the caller commits
     return linked, created, list(read_errors.values())
 
 
@@ -328,6 +360,26 @@ class FileIdentifierJob(StatefulJob):
                         "DROP INDEX IF EXISTS idx_file_path_cas_id")
                 conn.execute(
                     "DROP INDEX IF EXISTS idx_file_path_object_id")
+        # Commit batching: one transaction per STEP covering several
+        # hash chunks — WAL commit overhead (page flushes shared across
+        # chunks) amortizes, measured ~4.5 s of the 1M identify as
+        # per-chunk commits. Capped so a commit group stays ≤ ~16k
+        # files: the crash checkpoint's cursor only advances per step,
+        # so a SIGKILL replays at most one commit group (idempotent,
+        # keyed by row id), and pause latency stays bounded.
+        # Two configurations keep one chunk per step: device-engaged
+        # runs (their chunks are already 8-16k files, and a second
+        # device dispatch must never run under the held write lock) and
+        # hash-ahead hosts (≥2 usable cores — there the worker hash of
+        # chunk k+1 overlapping chunk k's whole db+commit phase is
+        # worth more than amortized commits, and batching would
+        # serialize the worker behind the group). Commit batching
+        # targets the remaining case: the single-core host plane, where
+        # nothing overlaps anyway and per-chunk commits were pure
+        # overhead.
+        hash_ahead = not device_engaged and _usable_cpus() > 1
+        commit_every = (1 if device_engaged or hash_ahead
+                        else max(1, min(8, 16384 // chunk)))
         data = {
             "location_path": loc["path"],
             "sub_mat_path": sub_mat,
@@ -336,6 +388,7 @@ class FileIdentifierJob(StatefulJob):
             # The resolved step size rides in `data` so pause/resume
             # replays use the same pagination the steps were counted for.
             "chunk_size": chunk,
+            "commit_every": commit_every,
             # Hash-ahead (stage+hash chunk i+1 in a worker thread while
             # chunk i's transaction commits) runs only on the host
             # planes: the device pipeline double-buffers internally and
@@ -346,11 +399,12 @@ class FileIdentifierJob(StatefulJob):
             # cpu_count): measured on a 1-core host it LOSES ~8%
             # (WAL+synchronous=NORMAL commits don't fsync, so there is
             # no IO wait to hide under — only GIL contention).
-            "hash_ahead": not device_engaged and _usable_cpus() > 1,
+            "hash_ahead": hash_ahead,
             "cursor": 0,
             "linked": 0, "created": 0, "skipped": 0, "total_orphans": count,
         }
-        steps = [{"chunk": i} for i in range(-(-count // chunk))]
+        steps = [{"chunk": i}
+                 for i in range(-(-count // (chunk * commit_every)))]
         ctx.progress(task_count=len(steps),
                      message=f"identifying {count} orphan paths")
         return data, steps
@@ -411,51 +465,154 @@ class FileIdentifierJob(StatefulJob):
         """Worker-thread body of the hash-ahead pipeline: page fetch,
         file staging, batched hashing — everything before the tx. Safe
         to run against the live DB: the page past the previous chunk's
-        last row id is untouched by that chunk's updates."""
+        last row id is untouched by that chunk's updates. Returns
+        (rows, prehashed, per-phase seconds) — the worker times its own
+        phases so overlapped hashing is still attributed to `hash`, not
+        smeared into the consumer's wait (the split perf_smoke
+        reports)."""
+        w: Dict[str, float] = {}
+        t0 = time.perf_counter()
         rows = self._fetch_page(ctx, data, cursor)
+        w["fetch"] = time.perf_counter() - t0
         if not rows:
-            return rows, None
+            return rows, None, w
+        t0 = time.perf_counter()
         files = stage_file_list(
             rows, self.location_id, data["location_path"])
+        w["prep"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
         ids, read_errors = cas_ids_for_files(files, backend=self.backend)
-        return rows, (files, ids, read_errors)
+        w["hash"] = time.perf_counter() - t0
+        return rows, (files, ids, read_errors), w
+
+    def _timed_fetch(self, ctx: JobContext, data: Dict[str, Any],
+                     cursor: int):
+        """Fetch-only prefetch body (non-hash-ahead hosts)."""
+        t0 = time.perf_counter()
+        rows = self._fetch_page(ctx, data, cursor)
+        return rows, None, {"fetch": time.perf_counter() - t0}
+
+    def _take_page(self, ctx: JobContext, data: Dict[str, Any],
+                   cursor: int, timings: Dict[str, float]):
+        """One chunk's orphan page (+ prehashed payload when the
+        prefetch was a hash-ahead one), honoring a matching prefetch.
+
+        Phase accounting: worker-measured fetch/prep/hash seconds merge
+        into `timings` at their TRUE cost; the time this thread spent
+        blocked on the worker lands in `overlap_wait` (the un-hidden
+        remainder of the overlap — with perfect overlap it tends to 0).
+        Overlapped phases can therefore sum past step_total; the split
+        is cost attribution, not a wall-clock partition."""
+        tf = time.perf_counter()
+        pre = getattr(self, "_prefetch", None)
+        rows = prehashed = wtimings = None
+        if pre is not None and pre[0] == cursor:
+            try:
+                rows, prehashed, wtimings = pre[1].result()
+            except Exception:
+                rows = prehashed = wtimings = None  # sync-path fallback
+        self._prefetch = None
+        if wtimings:
+            for k, v in wtimings.items():
+                timings[k] = timings.get(k, 0.0) + v
+            timings["overlap_wait"] = (timings.get("overlap_wait", 0.0)
+                                       + time.perf_counter() - tf)
+        if rows is None:
+            t0 = time.perf_counter()
+            rows = self._fetch_page(ctx, data, cursor)
+            timings["fetch"] = (timings.get("fetch", 0.0)
+                                + time.perf_counter() - t0)
+        return (rows if rows else None), prehashed
+
+    def _stage_and_hash(self, rows, data: Dict[str, Any],
+                        timings: Dict[str, float]):
+        """Inline (main-thread) staging + batched hashing of one page —
+        the path taken when the prefetch was fetch-only. Runs with the
+        successor prefetch already in flight, so the next page's SELECT
+        (or fetch+hash) hides under this work."""
+        tp = time.perf_counter()
+        files = stage_file_list(
+            rows, self.location_id, data["location_path"])
+        t1 = time.perf_counter()
+        timings["prep"] = timings.get("prep", 0.0) + t1 - tp
+        ids, read_errors = cas_ids_for_files(files, backend=self.backend)
+        timings["hash"] = (timings.get("hash", 0.0)
+                           + time.perf_counter() - t1)
+        return files, ids, read_errors
 
     def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
         tf = time.perf_counter()
-        pre = getattr(self, "_prefetch", None)
-        rows = prehashed = None
-        if pre is not None and pre[0] == data["cursor"]:
-            try:
-                rows, prehashed = pre[1].result()
-            except Exception:
-                rows = prehashed = None  # fall back to the sync path
-        self._prefetch = None
-        if rows is None:
-            rows = self._fetch_page(ctx, data, data["cursor"])
         timings = data.setdefault("phase_s", {})
-        # Overlapped work hides under this wait; attribute it to fetch.
-        timings["fetch"] = (timings.get("fetch", 0.0)
-                            + time.perf_counter() - tf)
-        if not rows:
-            return StepOutcome()
         from ..ops.staging import _pool
-        nxt = rows[-1]["id"] + 1
-        if data.get("hash_ahead"):
-            # Stage + hash the NEXT chunk while this one's domain writes
-            # and commit run (CPU overlapping the fsync wait).
-            self._prefetch = (
-                nxt, _pool().submit(self._fetch_and_hash, ctx, data, nxt))
-        else:
-            # Overlap just the next orphan-page SELECT with this chunk's
-            # hash+write work.
-            self._prefetch = (
-                nxt, _pool().submit(
-                    lambda: (self._fetch_page(ctx, data, nxt), None)))
-        linked, created, errors = identify_chunk(
-            ctx.library, self.location_id, data["location_path"], rows,
-            self.backend, timings=timings, prehashed=prehashed,
-            cas_map=self._get_cas_map(ctx, data))
-        data["cursor"] = rows[-1]["id"] + 1
+
+        # Phase 1 — collect the whole commit group OUTSIDE any
+        # transaction: fetch + stage + hash never run (or wait) under
+        # the held write lock. Per chunk: take the page, submit the
+        # successor's prefetch, THEN hash inline — so the next page's
+        # SELECT (or worker fetch+hash) hides under this chunk's
+        # hashing, and the last submitted prefetch (the next step's
+        # first chunk) hides under phase 2's db work. Hash-ahead hosts
+        # run commit_every=1 (set at init), so their worker hash of
+        # chunk k+1 overlaps chunk k's whole phase 2 — the per-chunk
+        # overlap the round-5 pipeline had.
+        commit_every = data.get("commit_every") or 1
+        cursor = data["cursor"]
+        chunks: List[tuple] = []
+        for _ in range(commit_every):
+            rows, prehashed = self._take_page(ctx, data, cursor, timings)
+            if rows is None:
+                break
+            cursor = rows[-1]["id"] + 1
+            if data.get("hash_ahead"):
+                self._prefetch = (cursor, _pool().submit(
+                    self._fetch_and_hash, ctx, data, cursor))
+            else:
+                self._prefetch = (cursor, _pool().submit(
+                    self._timed_fetch, ctx, data, cursor))
+            if prehashed is None:
+                prehashed = self._stage_and_hash(rows, data, timings)
+            chunks.append((rows, prehashed))
+        if not chunks:
+            return StepOutcome()
+
+        # Phase 2 — ONE transaction for the whole commit group
+        # (commit_every chunks): WAL pages dirtied by several chunks
+        # flush once at the group commit instead of per chunk, and the
+        # write lock covers DB WORK ONLY (sub-second for a ~16k-file
+        # group). The cursor in `data` — what the 3 s crash checkpoint
+        # serializes — only advances after the commit, so a SIGKILL
+        # replays at most one commit group, idempotently
+        # (cas_id/object updates keyed by row id).
+        cas_map = self._get_cas_map(ctx, data)
+        batch = TxBatch()
+        linked = created = 0
+        errors: List[str] = []
+        db = ctx.db
+        try:
+            with db.tx() as conn:
+                for rows, prehashed in chunks:
+                    lk, cr, errs = identify_chunk(
+                        ctx.library, self.location_id,
+                        data["location_path"], rows, self.backend,
+                        timings=timings, prehashed=prehashed,
+                        cas_map=cas_map, conn=conn, batch=batch)
+                    linked += lk
+                    created += cr
+                    errors.extend(errs)
+                t_commit = time.perf_counter()
+        except BaseException:
+            # The rolled-back transaction's objects never existed: pop
+            # their cas-map entries or later chunks would link
+            # file_paths to phantom row ids.
+            if cas_map is not None:
+                for c in batch.cas_added:
+                    cas_map.pop(c, None)
+            raise
+        timings["db_commit"] = (timings.get("db_commit", 0.0)
+                                + time.perf_counter() - t_commit)
+        if batch.n_ops:
+            ctx.library.sync._notify_created()
+        data["cursor"] = cursor
         timings["step_total"] = (timings.get("step_total", 0.0)
                                  + time.perf_counter() - tf)
         data["linked"] += linked

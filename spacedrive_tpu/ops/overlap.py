@@ -38,15 +38,22 @@ import numpy as np
 @dataclass
 class PipelineStats:
     files: int = 0
-    wall_s: float = 0.0
+    wall_s: float = 0.0       # measured loop time, calibration EXCLUDED
     stage_s: float = 0.0      # stall time waiting on the stager thread
+    calibration_s: float = 0.0  # time spent in mid-run calibration pauses
     batches: int = 0
     batch_files: int = 0
-    # serial reference components, measured on one calibration batch
-    # BEFORE the run and once more AFTER it — the tunneled link's
-    # weather drifts minute to minute, and round 4's single pre-run
-    # calibration produced a "bound" BELOW the measured rate when the
-    # link improved mid-run (t_kernel_1 includes the small digest D2H):
+    # Serial reference components, measured on calibration batches
+    # INTERLEAVED with the run: one before, one after, and one every
+    # few batches in between (the pipeline drains, the components get
+    # timed, the pipeline resumes). Rounds 4 and 5 calibrated outside
+    # the measurement window and the tunnel's minute-to-minute weather
+    # flipped measured/bound to opposite sides in consecutive
+    # artifacts; same-window samples are what make the bound
+    # comparable to the measurement at all.
+    samples: List[Tuple[float, float, float]] = field(default_factory=list)
+    # first/last sample components, kept as flat fields for artifact
+    # compatibility (bench JSON, tests).
     t_stage_1: float = 0.0
     t_h2d_1: float = 0.0
     t_kernel_1: float = 0.0
@@ -58,21 +65,63 @@ class PipelineStats:
     def files_per_sec(self) -> float:
         return self.files / self.wall_s if self.wall_s else 0.0
 
+    def _component_bests(self) -> Tuple[float, float, float]:
+        def best(idx: int) -> float:
+            vals = [s[idx] for s in self.samples if s[idx] > 0]
+            return min(vals) if vals else 0.0
+        return best(0), best(1), best(2)
+
     @property
     def bound_files_per_sec(self) -> float:
         """The max(stage, transfer, kernel+fetch) steady-state bound —
         what a perfect pipeline would sustain under the BEST link
-        conditions observed in the bracketing calibrations (per-
-        component minimum of the pre/post measurements), so
-        bound >= measured holds unless the link beat both brackets
-        mid-run."""
-        def best(a, b):
-            return min(x for x in (a, b) if x > 0) \
-                if (a > 0 or b > 0) else 0.0
-        denom = max(best(self.t_stage_1, self.t_stage_2),
-                    best(self.t_h2d_1, self.t_h2d_2),
-                    best(self.t_kernel_1, self.t_kernel_2))
+        conditions observed across the same-run interleaved
+        calibrations, so bound >= measured holds unless the link beat
+        every sample between two pauses."""
+        denom = max(self._component_bests())
         return self.batch_files / denom if denom else 0.0
+
+    @property
+    def bound_spread(self) -> float:
+        """max/min ratio of the binding component across calibration
+        samples — the same-run measure of how much the link weather
+        moved underneath the pipeline (1.0 = perfectly stable)."""
+        if not self.samples:
+            return 1.0
+        which = max(range(3), key=lambda i: self._component_bests()[i])
+        vals = [s[which] for s in self.samples if s[which] > 0]
+        return max(vals) / min(vals) if vals else 1.0
+
+    def bound_report(self) -> dict:
+        """Same-run bound accounting for the bench artifact: measured
+        rate, bound, their ratio, and — when measured < 0.9 × bound — a
+        printed reason derived from THIS run's calibration spread (the
+        round-5 demand: the artifact must meet its bound or explain
+        itself from the same run, never from another weather window)."""
+        bound = self.bound_files_per_sec
+        measured = self.files_per_sec
+        ratio = measured / bound if bound else 0.0
+        reason = None
+        if bound and ratio < 0.9:
+            names = ("stage", "h2d", "kernel")
+            which = max(range(3),
+                        key=lambda i: self._component_bests()[i])
+            mid = max(0, len(self.samples) - 2)
+            reason = (
+                f"bound uses the best of {len(self.samples)} same-run "
+                f"calibrations of the binding '{names[which]}' stage, "
+                f"which varied {self.bound_spread:.2f}x within this "
+                f"run; the measured rate averages over the troughs "
+                f"the best sample missed"
+                + (f", and {mid} mid-run pause(s) each leave up to one "
+                   f"un-overlapped batch refill in the measured wall"
+                   if mid else ""))
+        return {"measured_files_per_sec": round(measured, 1),
+                "bound_files_per_sec": round(bound, 1),
+                "ratio": round(ratio, 3),
+                "calibrations": len(self.samples),
+                "binding_component_spread": round(self.bound_spread, 2),
+                "reason": reason}
 
 
 def _stage_batch(paths: Sequence[str], sizes: np.ndarray):
@@ -92,12 +141,20 @@ def _stage_batch(paths: Sequence[str], sizes: np.ndarray):
 def run_overlapped(
     batches: Sequence[Tuple[Sequence[str], np.ndarray]],
     kernel: Optional[Callable] = None,
+    calibrate_every: Optional[int] = None,
 ) -> Tuple[List[np.ndarray], PipelineStats]:
     """Run the staged pipeline over pre-split file batches.
 
     batches: [(paths, sizes_u64)] — all large-class (> 100 KiB) files.
     kernel: (words, lengths) -> [B, 8] digests; defaults to the best
         device implementation (Pallas on TPU).
+    calibrate_every: drain the pipeline and re-time the serial
+        components every this many measured batches (default: ~2 mid-
+        run pauses), so the steady-state bound is computed from the
+        SAME weather window as the measurement — calibrating only
+        outside the run let the tunnel's drift flip measured/bound to
+        opposite sides in consecutive round artifacts. Calibration
+        pauses are excluded from the measured wall time.
     Returns ([per-batch digests], stats). The returned digests are
     row-aligned with each batch's path order.
     """
@@ -109,6 +166,8 @@ def run_overlapped(
     jfn = jax.jit(fn)
     stats = PipelineStats(batches=len(batches),
                           batch_files=len(batches[0][0]))
+    if calibrate_every is None:
+        calibrate_every = max(2, (len(batches) - 1) // 3)
 
     # calibration: one serial batch, component-timed (and the compile).
     # Syncs are FULL fetches of small arrays — a sliced fetch would
@@ -120,19 +179,26 @@ def run_overlapped(
         np.asarray(jax.device_put(np.zeros(16, np.uint8)))
 
     paths0, sizes0 = batches[0]
-    t0 = time.perf_counter()
+
+    def _calibrate() -> Tuple[float, float, float, np.ndarray]:
+        t0 = time.perf_counter()
+        words, lengths = _stage_batch(paths0, sizes0)
+        t_stage = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        w = jax.device_put(words); l = jax.device_put(lengths)
+        _sync_marker()
+        t_h2d = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = np.asarray(jfn(w, l))  # kernel + the (small) digest D2H
+        t_kernel = time.perf_counter() - t0
+        return t_stage, t_h2d, t_kernel, res
+
+    # Warm the compile on batch 0 before the first timed sample.
     words, lengths = _stage_batch(paths0, sizes0)
-    stats.t_stage_1 = time.perf_counter() - t0
-    w = jax.device_put(words); l = jax.device_put(lengths)
-    np.asarray(jfn(w, l))  # compile + warm
-    t0 = time.perf_counter()
-    w = jax.device_put(words); l = jax.device_put(lengths)
-    _sync_marker()
-    stats.t_h2d_1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = jfn(w, l)
-    res0 = np.asarray(out)  # kernel + the (small) digest D2H
-    stats.t_kernel_1 = time.perf_counter() - t0
+    np.asarray(jfn(jax.device_put(words), jax.device_put(lengths)))
+    s0 = _calibrate()
+    stats.samples.append(s0[:3])
+    res0 = s0[3]
 
     pool = ThreadPoolExecutor(1, thread_name_prefix="overlap-stage")
     results: List[Optional[np.ndarray]] = [None] * len(batches)
@@ -147,6 +213,28 @@ def run_overlapped(
         ts = time.perf_counter()
         words, lengths = fut.result()
         stats.stage_s += time.perf_counter() - ts
+        if (i - 1) and (i - 1) % calibrate_every == 0 \
+                and i + 1 < len(batches):
+            # Mid-run calibration: the stager is idle (its result is in
+            # hand, the next submit hasn't happened), so drain the
+            # in-flight dispatches and time the serial components in
+            # the exact weather the pipeline is running through. The
+            # whole pause window — drain INCLUDED, since the forced
+            # early retire is overlap the pipeline loses to the pause —
+            # is excluded from the measured wall. Residual bias: the
+            # post-pause refill (one batch staged/dispatched with
+            # nothing in flight to hide under) stays in the wall, so
+            # each pause costs up to ~one un-overlapped batch; with the
+            # default ~2 pauses that is a small conservative tax on the
+            # measured rate, surfaced via `calibrations` in the report.
+            t_pause = time.perf_counter()
+            for j, prev in inflight:
+                results[j] = np.asarray(prev)
+            inflight.clear()
+            stats.samples.append(_calibrate()[:3])
+            pause = time.perf_counter() - t_pause
+            stats.calibration_s += pause
+            t_wall += pause  # shift the wall clock past the pause
         if i + 1 < len(batches):
             fut = pool.submit(_stage_batch, *batches[i + 1])
         w = jax.device_put(words)
@@ -162,19 +250,12 @@ def run_overlapped(
     stats.files = sum(len(p) for p, _ in batches[1:])
     pool.shutdown()
 
-    # Post-run calibration bracket: same components, same batch-0 data,
-    # measured the moment the pipeline drains — bound_files_per_sec
-    # takes the per-component best of the two brackets.
-    t0 = time.perf_counter()
-    words, lengths = _stage_batch(paths0, sizes0)
-    stats.t_stage_2 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    w = jax.device_put(words); l = jax.device_put(lengths)
-    _sync_marker()
-    stats.t_h2d_2 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    np.asarray(jfn(w, l))
-    stats.t_kernel_2 = time.perf_counter() - t0
+    # Post-run sample: same components, same batch-0 data, measured the
+    # moment the pipeline drains — the closing bracket of the same-run
+    # series.
+    stats.samples.append(_calibrate()[:3])
+    (stats.t_stage_1, stats.t_h2d_1, stats.t_kernel_1) = stats.samples[0]
+    (stats.t_stage_2, stats.t_h2d_2, stats.t_kernel_2) = stats.samples[-1]
     return results, stats
 
 

@@ -51,6 +51,13 @@ class Database:
     def __init__(self, path: str | os.PathLike):
         self.path = str(path)
         self._write_lock = threading.RLock()
+        # Connection REGISTRATION serializes on its own lock, never on
+        # the write lock: a reader thread opening its first connection
+        # while a writer holds a long transaction (the identifier's
+        # multi-chunk commit groups, which WAIT on reader-thread
+        # prefetch results) must not block — with registration under
+        # the write lock that wait was a deadlock.
+        self._conns_lock = threading.Lock()
         self._local = threading.local()
         self._all_conns: list[sqlite3.Connection] = []
         self._closed = False
@@ -134,7 +141,13 @@ class Database:
             # truncate; the WAL may grow to GBs mid-scan, which WAL
             # readers handle fine.
             conn.execute("PRAGMA wal_autocheckpoint=0")
-            with self._write_lock:
+            # Bound the WAL file's on-disk footprint after the explicit
+            # end-of-bulk checkpoints: without a limit SQLite keeps the
+            # multi-GB bulk-scan WAL allocated forever, and the next
+            # scan's commits rewrite cold pages inside it. Matches the
+            # passive-checkpoint budget in tx().
+            conn.execute(f"PRAGMA journal_size_limit={self._WAL_BUDGET_BYTES}")
+            with self._conns_lock:
                 # Re-check under the lock: close() may have won the race
                 # after the unlocked check above (restore swaps the file).
                 if self._closed:
@@ -147,15 +160,16 @@ class Database:
     def close(self) -> None:
         """Close EVERY thread's connection. Any later use of this
         Database object raises — restore swaps in a new instance."""
-        with self._write_lock:
-            self._closed = True
-            for conn in self._all_conns:
-                try:
-                    conn.close()
-                except sqlite3.Error:
-                    pass
-            self._all_conns.clear()
-            self._local = threading.local()
+        with self._write_lock:  # no transaction in flight past here
+            with self._conns_lock:
+                self._closed = True
+                for conn in self._all_conns:
+                    try:
+                        conn.close()
+                    except sqlite3.Error:
+                        pass
+                self._all_conns.clear()
+                self._local = threading.local()
 
     # -- reads ------------------------------------------------------------
 

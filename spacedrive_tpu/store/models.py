@@ -118,6 +118,36 @@ register(Model(
     lazy_indexes=(("timestamp",), ("model", "record_id")),
 ))
 
+# Page-level op-log blobs: a bulk writer's whole chunk of shared ops
+# (identifier/indexer, ~4-10k ops) lands as ONE row here instead of
+# that many shared_operation rows — the op-log append was the measured
+# wall of the 1M identify (README phase_ms: 16.7 s encode+insert vs
+# 15.7 s of hashing). `data` is a msgpack array of per-op
+# [timestamp, record_id(bin), kind, payload(bin)] entries where
+# `payload` is byte-identical to what shared_operation.data would
+# hold (sync/opblob.py; natively encoded by sdio.cpp sd_encode_ops).
+# Blobs are written only while the library is SOLO (single instance);
+# get_ops reads them directly, and the first remote ingest explodes
+# them into indexed rows (SyncManager._ensure_row_oplog) because the
+# per-record LWW compares need the (model, record_id) index.
+register(Model(
+    "shared_op_blob",
+    (
+        _id(),
+        Field("model", "TEXT", nullable=False),
+        Field("min_ts", "INTEGER", nullable=False),
+        Field("max_ts", "INTEGER", nullable=False),
+        Field("n_ops", "INTEGER", nullable=False),
+        Field("data", "BLOB", nullable=False),
+        Field("instance_id", "INTEGER", nullable=False,
+              references="instance(id)"),
+    ),
+    # One cheap index: get_ops pages skip fully-served blobs by
+    # watermark; bulk writers append a handful of rows per chunk, so
+    # unlike the per-op tables this maintenance cost is negligible.
+    indexes=(("max_ts",),),
+))
+
 # Relation ops that arrived before the rows they reference (cross-
 # instance arrival order is not timestamp-ordered): parked here instead
 # of the op log — logging them would make _compare_message reject the

@@ -25,20 +25,29 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 from ..store import models as M
 from ..store.db import Database
+from . import opblob
 from .crdt import (CRDTOperation, OpKind, RelationOp, SharedOp, op_payload,
                    pack_value, unpack_value, uuid4_bytes, uuid4_bytes_batch)
 from .hlc import HLC
 
 # Pre-encoded msgpack fragments of op_payload's canonical key order for
 # the two field-is-None shapes bulk_shared_ops emits (create: 5-key map;
-# multi-field update: 6-key map with trailing update=True). Any change
-# to op_payload's dict layout MUST change these — the byte-equality
-# test between the bulk and dataclass op paths is the guard.
-_BULK_HDR5 = b"\x85\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
-_BULK_HDR6 = b"\x86\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
-_BULK_OPID = b"\xa5op_id\xc4\x10"
-_BULK_VALUES = b"\xa6values"
-_BULK_UPDATE_T = b"\xa6update\xc3"
+# multi-field update: 6-key map with trailing update=True). They live in
+# sync/opblob.py now (the blob codec shares them); any change to
+# op_payload's dict layout MUST change them AND the mirrored constants
+# in native/sdio.cpp — the byte-equality tests between the bulk, blob,
+# and dataclass op paths are the guard.
+_BULK_HDR5 = opblob.BULK_HDR5
+_BULK_HDR6 = opblob.BULK_HDR6
+_BULK_OPID = opblob.BULK_OPID
+_BULK_VALUES = opblob.BULK_VALUES
+_BULK_UPDATE_T = opblob.BULK_UPDATE_T
+
+# A bulk append at or above this many ops on a SOLO library (no other
+# instance registered) lands as ONE shared_op_blob page instead of that
+# many shared_operation rows. Below it, per-blob bookkeeping plus the
+# get_ops decode overhead outweigh the saved row inserts.
+BLOB_MIN_OPS = 256
 
 
 @dataclass
@@ -91,6 +100,11 @@ class SyncManager:
         self._instance_ids: Dict[bytes, int] = {}
         self.timestamps: Dict[bytes, int] = {}
         self._sync_indexes_ready = False
+        # Solo = no other instance registered: bulk writers may append
+        # page-level op blobs (get_ops decodes them; the first remote
+        # ingest explodes them to rows). Flips False forever the moment
+        # a peer instance appears (register_instance).
+        self._solo = True
         self._load_instances()
         # Re-ingest ops quarantined by an OLDER schema (one cheap
         # SELECT when the table is empty — the common case).
@@ -112,6 +126,7 @@ class SyncManager:
             if row["timestamp"]:
                 self.timestamps[row["pub_id"]] = row["timestamp"]
                 self.clock.update_with_timestamp(row["timestamp"])
+        self._solo = all(pub == self.instance for pub in self._instance_ids)
 
     def _instance_row_id(self, pub_id: bytes, conn=None) -> int:
         rid = self._instance_ids.get(pub_id)
@@ -263,6 +278,30 @@ class SyncManager:
         stamps = self.clock.new_timestamps(len(specs))
         op_ids = uuid4_bytes_batch(len(specs))
 
+        # Blob fast path: a big uniform chunk on a SOLO library lands as
+        # ONE shared_op_blob page (sync/opblob.py format, natively
+        # encoded) instead of len(specs) op rows — the dominant host-
+        # side cost of the 1M identify. get_ops decodes blobs; the
+        # first remote ingest explodes them into indexed rows
+        # (_ensure_row_oplog), so the CRDT contract is unchanged.
+        if self._solo and len(specs) >= BLOB_MIN_OPS:
+            kind0 = specs[0][1]
+            uniform = all(
+                field is None and kind == kind0
+                and type(rid) is bytes and len(rid) == 16
+                for rid, kind, field, _v, _vs in specs)
+            if uniform:
+                blob = opblob.encode_uniform(
+                    stamps, [s[0] for s in specs], kind0, op_ids,
+                    [pack_value(s[4]) for s in specs])
+                conn.execute(
+                    "INSERT INTO shared_op_blob "
+                    "(model, min_ts, max_ts, n_ops, data, instance_id) "
+                    "VALUES (?, ?, ?, ?, ?, ?)",
+                    (model, stamps[0], stamps[-1], len(specs), blob,
+                     my_id))
+                return len(specs)
+
         def _rid(rid) -> bytes:
             # record ids are almost always 16-byte pub_ids; msgpack
             # bin8(16) is b"\xc4\x10" + payload — one concat instead of
@@ -328,10 +367,15 @@ class SyncManager:
     def get_ops(self, args: GetOpsArgs) -> List[CRDTOperation]:
         """Ops newer than the given per-instance watermarks, plus all ops
         from instances absent from the watermark list, ordered by
-        (timestamp, instance), limited to args.count."""
+        (timestamp, instance), limited to args.count. Reads BOTH op-log
+        storage formats: per-op rows and page-level blobs (the solo
+        bulk-writer format) — a fresh peer pulling from a library that
+        never synced before sees one merged, identically-ordered
+        stream."""
         self._ensure_sync_indexes()
         clock_ids = [pub for pub, _ in args.clocks]
-        results: List[Tuple[int, bytes, CRDTOperation]] = []
+        results: List[Tuple[int, bytes, CRDTOperation]] = \
+            self._blob_op_tuples(args)
         for table, is_shared in (("shared_operation", True),
                                  ("relation_operation", False)):
             conds, params = [], []
@@ -357,6 +401,113 @@ class SyncManager:
         results.sort(key=lambda t: (t[0], t[1]))
         return [op for _, _, op in results[:args.count]]
 
+    def _blob_op_tuples(self, args: GetOpsArgs
+                        ) -> List[Tuple[int, bytes, CRDTOperation]]:
+        """(timestamp, instance, op) tuples from page-level op blobs,
+        filtered by the same per-instance watermarks as the row tables.
+
+        Blobs decode lazily in min_ts order: fully-served pages are
+        excluded in SQL by their max_ts, and decoding stops once
+        args.count qualifying ops are collected and the next blob's
+        whole range lies past the count-th smallest timestamp — a pull
+        loop paging a million-op backlog touches one or two blobs per
+        page, not the whole log."""
+        conds, params = [], []
+        for pub, ts in args.clocks:
+            conds.append("(i.pub_id = ? AND b.max_ts > ?)")
+            params.extend([pub, ts])
+        if args.clocks:
+            ph = ",".join("?" for _ in args.clocks)
+            conds.append(f"i.pub_id NOT IN ({ph})")
+            params.extend([pub for pub, _ in args.clocks])
+        where = " OR ".join(conds) if conds else "1=1"
+        metas = self.db.query(
+            f"SELECT b.id, b.model, b.min_ts, i.pub_id AS pub "
+            f"FROM shared_op_blob b JOIN instance i "
+            f"ON i.id = b.instance_id WHERE {where} ORDER BY b.min_ts",
+            params)
+        if not metas:
+            return []
+        wm = dict(args.clocks)
+        out: List[Tuple[int, bytes, CRDTOperation]] = []
+        for m in metas:
+            if len(out) >= args.count:
+                kth = sorted(t for t, _, _ in out)[args.count - 1]
+                if m["min_ts"] > kth:
+                    break
+            row = self.db.query_one(
+                "SELECT data FROM shared_op_blob WHERE id = ?",
+                (m["id"],))
+            if row is None:
+                # A concurrent first-ingest exploded this blob between
+                # the metas SELECT and here (each statement reads its
+                # own WAL snapshot): its ops are rows now, served by
+                # the row-table queries that follow.
+                continue
+            floor = wm.get(m["pub"])
+            kth = None  # lazy per-blob cutoff, see below
+            for ts, rid, kind, payload in opblob.decode_entries(
+                    row["data"]):
+                if floor is not None and ts <= floor:
+                    continue
+                if len(out) >= args.count:
+                    # Entries within a blob ascend (HLC batch mint), so
+                    # once an entry exceeds the count-th smallest
+                    # collected timestamp nothing later in this blob
+                    # can make the final page — stop materializing ops
+                    # a multi-page pull will re-request anyway.
+                    if kth is None:
+                        kth = sorted(t for t, _, _ in out)[args.count - 1]
+                    if ts > kth:
+                        break
+                out.append((ts, m["pub"], self._entry_to_op(
+                    m["model"], ts, rid, payload, m["pub"])))
+        return out
+
+    def _entry_to_op(self, model: str, ts: int, rid_packed: bytes,
+                     payload: bytes, pub: bytes) -> CRDTOperation:
+        """One decoded blob entry → CRDTOperation (the blob-format
+        sibling of _row_to_op; payload bytes are identical to what the
+        row format's `data` column would hold)."""
+        data = unpack_value(payload)
+        typ = SharedOp(
+            model, unpack_value(rid_packed), data.get("field"),
+            data.get("value"), bool(data.get("delete")),
+            data.get("values"), bool(data.get("update")))
+        return CRDTOperation(pub, ts, data.get("op_id", b""), typ)
+
+    def _ensure_row_oplog(self) -> None:
+        """Explode page-level op blobs into indexed shared_operation
+        rows. Ingest needs this: _compare_message and the tombstone
+        checks do per-(model, record_id) lookups the blob format cannot
+        index — the price of entering sync after a bulk-optimized solo
+        life, paid once (like the lazy op-log indexes). Batched in
+        small transactions so a huge backlog never holds the write
+        lock for seconds; crash-safe because each blob's rows insert
+        and its blob row deletes atomically."""
+        while True:
+            metas = self.db.query(
+                "SELECT id, model, instance_id, data FROM shared_op_blob "
+                "ORDER BY min_ts LIMIT 16")
+            if not metas:
+                return
+            with self.db.tx() as conn:
+                for m in metas:
+                    self._explode_blob_conn(conn, m)
+
+    @staticmethod
+    def _explode_blob_conn(conn, m) -> None:
+        """One blob page → its op rows + blob-row delete, atomically on
+        the caller's transaction."""
+        conn.executemany(
+            "INSERT INTO shared_operation "
+            "(timestamp, model, record_id, kind, data, instance_id) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            [(ts, m["model"], rid, kind, payload, m["instance_id"])
+             for ts, rid, kind, payload
+             in opblob.decode_entries(m["data"])])
+        conn.execute("DELETE FROM shared_op_blob WHERE id = ?", (m["id"],))
+
     def _row_to_op(self, row, is_shared: bool) -> CRDTOperation:
         data = unpack_value(row["data"])
         if is_shared:
@@ -381,6 +532,8 @@ class SyncManager:
 
     def register_instance(self, pub_id: bytes, **fields: Any) -> int:
         """Insert an instance row if unknown; returns local row id."""
+        if pub_id != self.instance:
+            self._solo = False  # peers exist: bulk ops go row-format now
         row = self.db.query_one(
             "SELECT id FROM instance WHERE pub_id = ?", (pub_id,))
         if row is not None:
@@ -424,6 +577,12 @@ class SyncManager:
         propagation works across any connected mesh."""
         if not ops:
             return 0, []
+        # Row-format first, indexes second: ingest's LWW compares and
+        # tombstone checks are per-(model, record_id) lookups, so any
+        # solo-era blob pages explode to rows before the index build
+        # covers them (explode before indexing also keeps the explode
+        # itself index-maintenance-free on first contact).
+        self._ensure_row_oplog()
         self._ensure_sync_indexes()
         for op in ops:
             if op.instance not in self._instance_ids:
@@ -437,6 +596,15 @@ class SyncManager:
         ts_max: Dict[bytes, int] = {}
         failed: set = set()
         with self.db.tx() as conn:
+            # Straggler sweep under the write lock: a bulk writer that
+            # checked _solo before this pull registered the peer can
+            # land one last blob between the explode above and this
+            # transaction — the LWW compares below must see those ops
+            # as rows. Almost always an empty, one-query no-op.
+            for m in conn.execute(
+                "SELECT id, model, instance_id, data FROM shared_op_blob "
+                    "ORDER BY min_ts").fetchall():
+                self._explode_blob_conn(conn, m)
             for op in ops:
                 self.clock.update_with_timestamp(op.timestamp)
                 # Poison-op triage BEFORE the try: an op this schema can

@@ -1,0 +1,114 @@
+"""Page-level op-log blob codec (the shared_op_blob `data` format).
+
+A bulk writer's whole chunk of shared ops — the identifier's ~4k
+"u:cas_id+object_id" links, the indexer's 1000-row create batches —
+lands in ONE `shared_op_blob` row instead of one `shared_operation`
+row per op. The 1M identify spent 16.7 s encoding + inserting ~1.9M op
+rows against 15.7 s of hashing (README phase_ms); the blob format cuts
+the SQLite side of that to a handful of inserts per chunk and hands
+the msgpack side to the native C++ plane.
+
+Format: `data` is a standard msgpack array of per-op entries
+
+    [timestamp(uint), record_id(bin, msgpack-packed sync id),
+     kind(str), payload(bin)]
+
+where `payload` is BYTE-IDENTICAL to what the same op's
+`shared_operation.data` column would hold (the canonical op_payload
+dict packing, sync/crdt.py). That identity is the whole contract:
+exploding a blob into rows (SyncManager._ensure_row_oplog) or serving
+it through get_ops yields exactly the ops the row format would have
+produced, so LWW compare, dedup, and backup replay never see a second
+encoding. Plain msgpack framing keeps the blob readable by any
+msgpack decoder; entry boundaries are self-delimiting, so per-op
+"offsets" are implicit in the framing.
+
+Two encoders produce the same bytes:
+- `sd_encode_ops` in native/sdio.cpp — one C call for a whole chunk
+  (timestamps/record ids/op ids as dense arrays, values as a packed
+  buffer + offsets);
+- the pure-Python fragment path below — the tested fallback when the
+  native plane is absent (and the oracle the native output is
+  byte-compared against in tests/test_sync_blob.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import msgpack
+
+# Pre-encoded msgpack fragments of op_payload's canonical key order for
+# the two field-is-None shapes bulk writers emit (create: 5-key map;
+# multi-field update: 6-key map with trailing update=True). Any change
+# to op_payload's dict layout MUST change these AND the mirrored C
+# constants in native/sdio.cpp sd_encode_ops — the byte-equality tests
+# between the bulk, blob, and dataclass op paths are the guard.
+BULK_HDR5 = b"\x85\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
+BULK_HDR6 = b"\x86\xa5field\xc0\xa5value\xc0\xa6delete\xc2"
+BULK_OPID = b"\xa5op_id\xc4\x10"
+BULK_VALUES = b"\xa6values"
+BULK_UPDATE_T = b"\xa6update\xc3"
+
+
+def pack_bulk_payload(kind: str, op_id: bytes, values_packed: bytes) -> bytes:
+    """One op's `data` payload from pre-packed values — the fragment
+    fast path for the field-is-None shapes (byte-equal to
+    pack_value(op_payload(...)))."""
+    if kind.startswith("u:"):
+        return (BULK_HDR6 + BULK_OPID + op_id
+                + BULK_VALUES + values_packed + BULK_UPDATE_T)
+    return BULK_HDR5 + BULK_OPID + op_id + BULK_VALUES + values_packed
+
+
+def encode_entries(entries: Sequence[Sequence[Any]]) -> bytes:
+    """Pack [[ts, record_id_packed, kind, payload], ...] into the blob
+    bytes. Plain msgpack — the reference encoder the native path must
+    byte-match."""
+    return msgpack.packb(list(entries), use_bin_type=True)
+
+
+def decode_entries(data: bytes) -> List[list]:
+    """Blob bytes → [[ts, record_id_packed, kind, payload], ...]."""
+    return msgpack.unpackb(data, raw=False, use_list=True)
+
+
+def encode_uniform(timestamps: Sequence[int], record_ids: Sequence[bytes],
+                   kind: str, op_ids: Sequence[bytes],
+                   values_packed: Sequence[bytes]) -> bytes:
+    """Encode a uniform-kind chunk (every record id a 16-byte pub id,
+    every op a field-is-None create or multi-update) — the shape both
+    bulk writers emit. Dispatches to the native C++ encoder when the
+    plane is loaded; the Python fragment path is the fallback and the
+    byte-parity oracle."""
+    blob = _encode_uniform_native(
+        timestamps, record_ids, kind, op_ids, values_packed)
+    if blob is not None:
+        return blob
+    return encode_uniform_py(timestamps, record_ids, kind, op_ids,
+                             values_packed)
+
+
+def encode_uniform_py(timestamps: Sequence[int],
+                      record_ids: Sequence[bytes], kind: str,
+                      op_ids: Sequence[bytes],
+                      values_packed: Sequence[bytes]) -> bytes:
+    """Pure-Python encoder for the uniform chunk shape (see
+    encode_uniform). record_ids are RAW 16-byte pub ids — packed here
+    with the bin8(16) fragment, exactly like the bulk row path."""
+    entries = [
+        [ts, b"\xc4\x10" + rid, kind, pack_bulk_payload(kind, oid, vp)]
+        for ts, rid, oid, vp in zip(timestamps, record_ids, op_ids,
+                                    values_packed)
+    ]
+    return encode_entries(entries)
+
+
+def _encode_uniform_native(timestamps, record_ids, kind, op_ids,
+                           values_packed) -> Optional[bytes]:
+    from .. import native
+
+    if not native.available():
+        return None
+    return native.encode_ops(timestamps, record_ids, kind, op_ids,
+                             values_packed)

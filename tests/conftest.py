@@ -51,3 +51,51 @@ async def pair_two_nodes(a, b, library_name: str = "shared"):
     assert await a.p2p.pair("127.0.0.1", pb, lib_a)
     lib_b = b.libraries.list()[0]
     return lib_a, lib_b
+
+
+def mk_instance(db, pub_id: bytes) -> int:
+    """Insert a bare instance row (the sync suites' fixture shape)."""
+    return db.insert("instance", {
+        "pub_id": pub_id, "identity": b"", "node_id": b"",
+        "node_name": "test", "node_platform": 0,
+        "last_seen": 0, "date_created": 0,
+    })
+
+
+def make_sync_manager(tmp_path, name="solo", others=()):
+    """A SyncManager over a fresh library DB holding its own instance
+    row plus `others` — with no others this is the SOLO configuration
+    the page-blob op-log format targets. Shared by the blob-format and
+    fuzz suites so the two never drift."""
+    import uuid
+
+    from spacedrive_tpu.store.db import Database
+    from spacedrive_tpu.sync.manager import SyncManager
+
+    pub = uuid.uuid4().bytes
+    db = Database(str(tmp_path / f"{name}.db"))
+    mk_instance(db, pub)
+    for other in others:
+        mk_instance(db, other)
+    return SyncManager(db, pub)
+
+
+def drain_sync(src, dst) -> int:
+    """Paged pull-loop drain src → dst through the real
+    get_ops/receive_crdt_operations path (the in-process analog of the
+    TCP pull loop); returns ops applied, asserts no ingest errors."""
+    from spacedrive_tpu.sync.manager import GetOpsArgs
+
+    applied = 0
+    while True:
+        clocks = dict(dst.timestamps)
+        clocks[dst.instance] = max(dst.clock.last,
+                                   clocks.get(dst.instance, 0))
+        page = src.get_ops(GetOpsArgs(clocks=list(clocks.items()),
+                                      count=1000))
+        page = [op for op in page if op.instance != dst.instance]
+        if not page:
+            return applied
+        n, errs = dst.receive_crdt_operations(page)
+        assert not errs, errs[:3]
+        applied += n

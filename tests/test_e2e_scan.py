@@ -339,3 +339,120 @@ def test_cancel_paused_index_sweeps_scratch(tmp_path, monkeypatch):
         await node.shutdown()
 
     _run(main())
+
+
+def test_mass_removals_spool_to_scratch_not_checkpoint(tmp_path, monkeypatch):
+    """Deferred removals ride job_scratch (keyed by job_id, consumed in
+    finalize), NOT data['pending_removals']: a mass-removal rescan must
+    not regrow the crash-checkpoint blob toward the inline-rows problem
+    the spooling fixed for save/update steps (ADVICE r5). The paused
+    checkpoint carries only scratch-row ids; finalize applies the
+    removals and consumes the rows."""
+    import time as _time
+
+    import msgpack
+
+    from spacedrive_tpu.locations import indexer_job as ij
+    monkeypatch.setattr(ij, "BATCH_SIZE", 100)
+    real_save = ij.save_file_path_rows
+
+    def slow_save(*a, **kw):
+        _time.sleep(0.01)
+        return real_save(*a, **kw)
+
+    monkeypatch.setattr(ij, "save_file_path_rows", slow_save)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus / "doomed")
+    os.makedirs(corpus / "kept")
+    for i in range(1200):
+        (corpus / "doomed" / f"f{i}.bin").write_bytes(
+            i.to_bytes(4, "big") * 10)
+    node = Node(str(tmp_path / "data"))
+
+    async def main():
+        await node.start()
+        lib = node.create_library("removals")
+        loc = create_location(lib, str(corpus))
+        jid = await node.jobs.ingest(
+            lib, ij.IndexerJob(location_id=loc))
+        assert await node.jobs.wait(jid) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        assert lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0"
+        )["n"] == 1200
+
+        # rm -rf the subtree, add fresh files (so the rescan has save
+        # steps to pause inside), rescan and pause mid-run.
+        import shutil
+        shutil.rmtree(corpus / "doomed")
+        for i in range(1200):
+            (corpus / "kept" / f"g{i}.bin").write_bytes(
+                i.to_bytes(4, "big") * 10)
+        jid2 = await node.jobs.ingest(
+            lib, ij.IndexerJob(location_id=loc))
+        await asyncio.sleep(0.15)
+        node.jobs.pause(jid2)
+        assert await node.jobs.wait(jid2) == JobStatus.PAUSED
+        state = msgpack.unpackb(
+            lib.db.query_one("SELECT data FROM job WHERE id = ?",
+                             (jid2,))["data"], raw=False)
+        # The checkpoint carries scratch IDS, not removal payloads.
+        assert state["data"]["pending_removals"] == []
+        sids = state["data"]["removal_scratch"]
+        assert sids and all(isinstance(s, int) for s in sids)
+        n_payload = lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM job_scratch WHERE job_id = ?",
+            (jid2,))["n"]
+        assert n_payload >= len(sids)
+
+        await node.jobs.resume(lib, jid2)
+        assert await node.jobs.wait(jid2) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        # finalize applied the removals and consumed the scratch rows
+        assert lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0"
+        )["n"] == 1200
+        assert lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM file_path "
+            "WHERE materialized_path LIKE '/doomed/%'")["n"] == 0
+        assert lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM job_scratch")["n"] == 0
+        await node.shutdown()
+
+    _run(main())
+
+
+def test_pure_removal_rescan_still_removes(tmp_path):
+    """A rescan whose ONLY work is removals (nothing new to index) must
+    not EarlyFinish past finalize — the spooled removals apply and the
+    stale rows go away."""
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus / "doomed")
+    (corpus / "keep.bin").write_bytes(b"k" * 256)
+    for i in range(30):
+        (corpus / "doomed" / f"f{i}.bin").write_bytes(b"x" * 64)
+    node = Node(str(tmp_path / "data"))
+
+    async def main():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+        await node.start()
+        lib = node.create_library("pure-removal")
+        loc = create_location(lib, str(corpus))
+        jid = await node.jobs.ingest(lib, IndexerJob(location_id=loc))
+        assert await node.jobs.wait(jid) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        import shutil
+        shutil.rmtree(corpus / "doomed")
+        jid2 = await node.jobs.ingest(lib, IndexerJob(location_id=loc))
+        assert await node.jobs.wait(jid2) in (
+            JobStatus.COMPLETED, JobStatus.COMPLETED_WITH_ERRORS)
+        rows = lib.db.query(
+            "SELECT materialized_path, name, is_dir FROM file_path "
+            "WHERE is_dir = 0")
+        assert [(r["materialized_path"], r["name"]) for r in rows] == \
+            [("/", "keep")]
+        assert lib.db.query_one(
+            "SELECT COUNT(*) AS n FROM job_scratch")["n"] == 0
+        await node.shutdown()
+
+    _run(main())

@@ -1,0 +1,299 @@
+"""Page-level op-log blobs (sync/opblob.py + shared_op_blob).
+
+The round-6 op-log write path: bulk writers on a SOLO library append
+whole chunks as one blob row; get_ops reads both storage formats into
+one stream; the first remote ingest explodes blobs into indexed rows.
+These tests pin the contracts the ISSUE names: byte-parity between the
+native and Python encoders, get_ops round-trip equality between row-
+and blob-format storage, mixed old-row/new-blob ingest, and the Python
+fallback when the native plane is absent.
+"""
+
+import os
+import uuid
+
+import pytest
+from conftest import drain_sync, make_sync_manager
+
+from spacedrive_tpu import native
+from spacedrive_tpu.sync import opblob
+from spacedrive_tpu.sync.crdt import op_payload, pack_value, unpack_value
+from spacedrive_tpu.sync.manager import BLOB_MIN_OPS, GetOpsArgs
+
+
+def _solo_manager(tmp_path, name="solo"):
+    return make_sync_manager(tmp_path, name)
+
+
+def _object_specs(n):
+    pubs = [os.urandom(16) for _ in range(n)]
+    return pubs, [(p, "c", None, None, {"kind": 5, "date_created": 100 + i})
+                  for i, p in enumerate(pubs)]
+
+
+def _link_specs(pubs):
+    return [(p, "u:cas_id+object_id", None, None,
+             {"cas_id": os.urandom(8).hex(), "object_id": os.urandom(16)})
+            for p in pubs]
+
+
+def _op_key(op):
+    return (op.timestamp, op.instance, op.id, op.typ)
+
+
+# -- codec ----------------------------------------------------------------
+
+
+def test_native_and_python_encoders_byte_identical():
+    if not native.available():
+        pytest.skip("native plane not built")
+    n = 300
+    ts = list(range(2 ** 61, 2 ** 61 + n))
+    rids = [os.urandom(16) for _ in range(n)]
+    oids = [os.urandom(16) for _ in range(n)]
+    for kind, values in (
+        ("c", {"kind": 7, "date_created": 123.5}),
+        ("u:cas_id+object_id",
+         {"cas_id": "0123456789abcdef", "object_id": os.urandom(16)}),
+        ("u:name+note", {"name": "x" * 300, "note": None}),
+    ):
+        vals = [pack_value(values) for _ in range(n)]
+        a = native.encode_ops(ts, rids, kind, oids, vals)
+        b = opblob.encode_uniform_py(ts, rids, kind, oids, vals)
+        assert a == b, kind
+        # and small-n fixarray framing
+        assert native.encode_ops(ts[:3], rids[:3], kind, oids[:3],
+                                 vals[:3]) == \
+            opblob.encode_uniform_py(ts[:3], rids[:3], kind, oids[:3],
+                                     vals[:3])
+
+
+def test_blob_payload_matches_canonical_op_payload():
+    """Each entry's payload must be byte-identical to packing the
+    canonical op_payload dict — the same guarantee the bulk row path
+    gives, extended to the blob format."""
+    ts, rid, oid = [2 ** 61], [os.urandom(16)], [os.urandom(16)]
+    for kind, values, update in (
+        ("c", {"kind": 5, "date_created": 1}, False),
+        ("u:cas_id+object_id", {"cas_id": "ab" * 8,
+                                "object_id": os.urandom(16)}, True),
+    ):
+        blob = opblob.encode_uniform(ts, rid, kind, oid,
+                                     [pack_value(values)])
+        entries = opblob.decode_entries(blob)
+        assert len(entries) == 1
+        e_ts, e_rid, e_kind, payload = entries[0]
+        assert (e_ts, e_kind) == (ts[0], kind)
+        assert e_rid == pack_value(rid[0])
+        assert payload == pack_value(op_payload(
+            None, None, False, oid[0], values, update))
+        assert unpack_value(payload)["op_id"] == oid[0]
+
+
+# -- storage round-trip ---------------------------------------------------
+
+
+def test_get_ops_same_stream_for_rows_and_blob(tmp_path):
+    """THE round-trip contract: the same specs written through the
+    row format and the blob format yield the same logical op stream
+    from get_ops (timestamps/op ids differ per mint; model, record,
+    kind, values, order must not)."""
+    n = BLOB_MIN_OPS + 10
+    pubs, create_specs = _object_specs(n)
+    link_specs = _link_specs(pubs)
+
+    a = _solo_manager(tmp_path, "blobfmt")
+    with a.db.tx() as conn:
+        assert a.bulk_shared_ops(conn, "object", create_specs) == n
+        assert a.bulk_shared_ops(conn, "file_path", link_specs) == n
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 2
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_operation")["n"] == 0
+
+    b = _solo_manager(tmp_path, "rowfmt")
+    b._solo = False  # force the per-op row format
+    with b.db.tx() as conn:
+        assert b.bulk_shared_ops(conn, "object", create_specs) == n
+        assert b.bulk_shared_ops(conn, "file_path", link_specs) == n
+    assert b.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+
+    ops_a = a.get_ops(GetOpsArgs(clocks=[], count=10 * n))
+    ops_b = b.get_ops(GetOpsArgs(clocks=[], count=10 * n))
+    assert len(ops_a) == len(ops_b) == 2 * n
+    for oa, ob in zip(ops_a, ops_b):
+        assert oa.typ == ob.typ
+
+    # paging + watermark filtering agree with the row semantics
+    page = a.get_ops(GetOpsArgs(clocks=[], count=100))
+    assert [_op_key(o) for o in page] == [_op_key(o) for o in ops_a[:100]]
+    wm = ops_a[n - 1].timestamp
+    after = a.get_ops(GetOpsArgs(clocks=[(a.instance, wm)], count=100))
+    assert [_op_key(o) for o in after] == \
+        [_op_key(o) for o in ops_a[n:n + 100]]
+
+
+def test_explode_preserves_stream_and_indexes_rows(tmp_path):
+    n = BLOB_MIN_OPS
+    pubs, create_specs = _object_specs(n)
+    a = _solo_manager(tmp_path)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", create_specs)
+    before = [_op_key(o) for o in a.get_ops(GetOpsArgs(clocks=[],
+                                                       count=10 * n))]
+    a._ensure_row_oplog()
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_operation")["n"] == n
+    after = [_op_key(o) for o in a.get_ops(GetOpsArgs(clocks=[],
+                                                      count=10 * n))]
+    assert before == after
+
+
+def test_small_batches_and_nonuniform_specs_stay_rows(tmp_path):
+    a = _solo_manager(tmp_path)
+    pubs, specs = _object_specs(BLOB_MIN_OPS - 1)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", specs)
+    # mixed kinds / non-16-byte ids in one call: row path
+    mixed = [(os.urandom(16), "c", None, None, {"kind": 1}),
+             (7, "u:note", "note", "x", None)] * (BLOB_MIN_OPS // 2)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", mixed)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+    assert a.db.query_one("SELECT COUNT(*) AS n FROM shared_operation")[
+        "n"] == (BLOB_MIN_OPS - 1) + len(mixed)
+
+
+def test_paired_library_never_writes_blobs(tmp_path):
+    a = make_sync_manager(tmp_path, "paired",
+                          others=(uuid.uuid4().bytes,))
+    assert not a._solo
+    pubs, specs = _object_specs(BLOB_MIN_OPS)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", specs)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+
+
+# -- ingest ---------------------------------------------------------------
+
+_drain = drain_sync  # shared paged pull-loop drain (tests/conftest.py)
+
+
+def test_fresh_peer_converges_from_blob_library(tmp_path):
+    """A fresh peer syncing a library whose whole history is blob-
+    format converges to the same domain state — the acceptance
+    criterion's convergence clause, scaled down."""
+    n = BLOB_MIN_OPS + 50
+    pubs, create_specs = _object_specs(n)
+    a = _solo_manager(tmp_path, "origin")
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", create_specs)
+        conn.executemany(
+            "INSERT INTO object (pub_id, kind, date_created) "
+            "VALUES (?, ?, ?)",
+            [(p, 5, 100 + i) for i, p in enumerate(pubs)])
+    link_specs = _link_specs(pubs)
+
+    b = make_sync_manager(tmp_path, "peer")
+    b.register_instance(a.instance)
+
+    assert _drain(a, b) == n
+    # second blob lands AFTER the first drain; pull again
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "file_path", link_specs)
+    assert _drain(a, b) == n  # the second blob page drains too
+    rows_b = b.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
+    assert rows_b == n
+    for r in b.db.query("SELECT pub_id, kind FROM object LIMIT 5"):
+        assert r["kind"] == 5
+
+
+def test_ingest_explodes_blobs_and_lww_sees_blob_ops(tmp_path):
+    """Remove-wins/LWW correctness across the format boundary: a STALE
+    remote update must lose against a newer local op that lives in a
+    blob — proven by ingesting the stale op and checking the domain
+    row kept the blob op's value."""
+    n = BLOB_MIN_OPS
+    pubs, create_specs = _object_specs(n)
+    a = _solo_manager(tmp_path, "lww")
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", create_specs)
+        conn.executemany(
+            "INSERT INTO object (pub_id, kind, date_created) "
+            "VALUES (?, ?, ?)",
+            [(p, 5, 1) for p in pubs])
+    # a second blob page of multi-field updates — the coverage
+    # _compare_message consults for update-kind LWW
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", [
+            (p, "u:kind+note", None, None, {"kind": 6, "note": "v2"})
+            for p in pubs])
+        conn.executemany(
+            "UPDATE object SET kind = 6, note = 'v2' WHERE pub_id = ?",
+            [(p,) for p in pubs])
+    covering = [o for o in a.get_ops(GetOpsArgs(clocks=[], count=10 * n))
+                if o.typ.update and o.typ.record_id == pubs[0]][0]
+
+    # a remote single-field update OLDER than the blob multi-update:
+    # per update-coverage LWW it must be dropped as stale — which
+    # requires ingest to SEE the blob ops (the explode contract)
+    pub_b = uuid.uuid4().bytes
+    from spacedrive_tpu.sync.crdt import CRDTOperation, SharedOp
+    stale = CRDTOperation(pub_b, covering.timestamp - 1,
+                          os.urandom(16),
+                          SharedOp("object", pubs[0], "kind", 9))
+    a.register_instance(pub_b)
+    applied, errors = a.receive_crdt_operations([stale])
+    assert not errors and applied == 0
+    # ingest exploded every blob into rows
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_operation")["n"] >= 2 * n
+    # the stale update lost: the blob multi-update's value survived
+    row = a.db.query_one("SELECT kind FROM object WHERE pub_id = ?",
+                         (pubs[0],))
+    assert row["kind"] == 6
+
+
+def test_mixed_row_and_blob_history_serves_one_ordered_stream(tmp_path):
+    """Old-row + new-blob libraries (upgrades mid-life) must serve one
+    interleaved, timestamp-ordered stream."""
+    a = _solo_manager(tmp_path)
+    p1 = os.urandom(16)
+    ops = a.shared_create("tag", p1, {"name": "rowed"})
+    with a.write_ops(ops) as conn:
+        a.db.insert("tag", {"pub_id": p1, "name": "rowed"}, conn=conn)
+    pubs, specs = _object_specs(BLOB_MIN_OPS)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", specs)
+    p2 = os.urandom(16)
+    ops = a.shared_create("tag", p2, {"name": "rowed2"})
+    with a.write_ops(ops) as conn:
+        a.db.insert("tag", {"pub_id": p2, "name": "rowed2"}, conn=conn)
+
+    got = a.get_ops(GetOpsArgs(clocks=[], count=10_000))
+    assert len(got) == BLOB_MIN_OPS + 2
+    stamps = [o.timestamp for o in got]
+    assert stamps == sorted(stamps)
+    assert got[0].typ.record_id == p1 and got[-1].typ.record_id == p2
+
+
+def test_python_fallback_when_native_absent(tmp_path, monkeypatch):
+    """The pure-Python encoder carries the blob path when the C++
+    plane is missing, byte-compatibly (same decode, same ingest)."""
+    monkeypatch.setattr(native, "available", lambda: False)
+    n = BLOB_MIN_OPS
+    pubs, specs = _object_specs(n)
+    a = _solo_manager(tmp_path)
+    with a.db.tx() as conn:
+        a.bulk_shared_ops(conn, "object", specs)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 1
+    ops = a.get_ops(GetOpsArgs(clocks=[], count=10 * n))
+    assert len(ops) == n and ops[0].typ.values["kind"] == 5

@@ -166,6 +166,78 @@ _SEEDS = [int(s) for s in os.environ.get(
     "SDTPU_FUZZ_SEEDS", "7,23").split(",")]
 
 
+def test_three_node_blob_relay_convergence(tmp_path):
+    """Scaled-down 3-node convergence for the round-6 blob op-log
+    write path: node A's history is written through the page-blob bulk
+    encoder (native when built), B pulls from A, C pulls ONLY from B
+    (A-authored ops relay through B's log). All three domain tables
+    and logical op streams must converge. In-process managers rather
+    than the TCP plane: this runtime lacks the `cryptography` package
+    the p2p identity layer needs, and the semantics under test are the
+    managers' — the wire is the same paged get_ops/ingest loop."""
+    from conftest import drain_sync as drain
+    from conftest import make_sync_manager
+
+    from spacedrive_tpu.sync.manager import BLOB_MIN_OPS, GetOpsArgs
+
+    def mk(name):
+        return make_sync_manager(tmp_path, name)
+
+    def domain(mgr):
+        return {r["pub_id"].hex(): (r["kind"], r["date_created"],
+                                    r["note"])
+                for r in mgr.db.query(
+                    "SELECT pub_id, kind, date_created, note FROM object")}
+
+    def log(mgr):
+        ops = mgr.get_ops(GetOpsArgs(clocks=[], count=100_000))
+        return sorted((o.timestamp, o.instance, o.typ.kind,
+                       o.typ.record_id) for o in ops)
+
+    a, b, c = mk("a"), mk("b"), mk("c")
+    n = BLOB_MIN_OPS + 17
+    pubs = [os.urandom(16) for _ in range(n)]
+    with a.db.tx() as conn:
+        assert a.bulk_shared_ops(conn, "object", [
+            (p, "c", None, None, {"kind": 5, "date_created": i})
+            for i, p in enumerate(pubs)]) == n
+        conn.executemany(
+            "INSERT INTO object (pub_id, kind, date_created) "
+            "VALUES (?, ?, ?)",
+            [(p, 5, i) for i, p in enumerate(pubs)])
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 1
+
+    b.register_instance(a.instance)
+    assert drain(a, b) == n
+    # C pairs with B only; A's ops relay via B's log (auto-registered
+    # placeholder instance on C).
+    c.register_instance(b.instance)
+    assert drain(b, c) == n
+
+    # Second blob page AFTER the first relay: multi-field updates.
+    with a.db.tx() as conn:
+        assert a.bulk_shared_ops(conn, "object", [
+            (p, "u:kind+note", None, None, {"kind": 6, "note": "v2"})
+            for p in pubs]) == n
+        conn.executemany(
+            "UPDATE object SET kind = 6, note = 'v2' WHERE pub_id = ?",
+            [(p,) for p in pubs])
+    assert drain(a, b) == n
+    assert drain(b, c) == n
+
+    assert domain(a) == domain(b) == domain(c)
+    assert len(domain(a)) == n
+    da = domain(a)
+    assert all(da[p.hex()] == (6, i, "v2") for i, p in enumerate(pubs))
+    # Logical op streams converge byte-for-byte in (ts, instance,
+    # kind, record) across ALL nodes — A still serving from blobs
+    # (never ingested anything), B/C from exploded/ingested rows.
+    assert log(a) == log(b) == log(c)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 2
+
+
 @pytest.mark.parametrize("seed", _SEEDS)
 def test_three_node_adversarial_convergence(tmp_path, seed):
     rng = random.Random(seed)

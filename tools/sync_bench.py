@@ -9,6 +9,15 @@ GetOperations at 1000 ops/request through the ingest state machine
 Prints one JSON line: {"metric": "sync_ingest_ops_per_sec", ...}.
 
 Usage: python tools/sync_bench.py [n_ops]
+       python tools/sync_bench.py --encode [n_ops]
+
+--encode runs the op-log ENCODE+WRITE micro-benchmark instead: the
+same identifier-shaped op specs appended through (a) the per-op row
+format and (b) the page-level blob format (native encoder when the
+C++ plane is built, Python fragment fallback otherwise), plus the
+pure encode cost of both encoders — the before/after artifact for the
+blob op-log work, so the row-vs-blob claim never rests on a README
+anecdote.
 """
 
 from __future__ import annotations
@@ -93,5 +102,83 @@ async def main(n_ops: int) -> None:
     await b.shutdown()
 
 
+def encode_bench(n_ops: int) -> None:
+    """Row-format vs blob-format op-log append, same spec stream."""
+    import uuid
+
+    from spacedrive_tpu import native
+    from spacedrive_tpu.store.db import Database
+    from spacedrive_tpu.sync import opblob
+    from spacedrive_tpu.sync.crdt import pack_value, uuid4_bytes_batch
+    from spacedrive_tpu.sync.manager import SyncManager
+
+    tmp = tempfile.mkdtemp(prefix="sync-encode-bench-")
+
+    def mk(name: str) -> SyncManager:
+        db = Database(os.path.join(tmp, name))
+        pub = uuid.uuid4().bytes
+        db.insert("instance", {
+            "pub_id": pub, "identity": b"", "node_id": b"",
+            "node_name": "bench", "node_platform": 0,
+            "last_seen": 0, "date_created": 0})
+        return SyncManager(db, pub)
+
+    # The identifier's link shape: one multi-field update per file.
+    chunk = 4096
+    pubs = [os.urandom(16) for _ in range(chunk)]
+    specs = [(p, "u:cas_id+object_id", None, None,
+              {"cas_id": os.urandom(8).hex(), "object_id": os.urandom(16)})
+             for p in pubs]
+    n_chunks = max(1, n_ops // chunk)
+
+    def run(mgr: SyncManager, solo: bool) -> float:
+        mgr._solo = solo  # False forces the per-op row format
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            with mgr.db.tx() as conn:
+                mgr.bulk_shared_ops(conn, "file_path", specs)
+        return n_chunks * chunk / (time.perf_counter() - t0)
+
+    rows_ops_s = run(mk("rows.db"), solo=False)
+    blob_ops_s = run(mk("blob.db"), solo=True)
+
+    # Pure encode cost, native vs Python fallback (byte-identical).
+    stamps = list(range(1 << 61, (1 << 61) + chunk))
+    op_ids = uuid4_bytes_batch(chunk)
+    vals = [pack_value(s[4]) for s in specs]
+    encode_only = {}
+    reps = max(1, n_chunks // 2)
+    if native.available():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            native.encode_ops(stamps, pubs, "u:cas_id+object_id",
+                              op_ids, vals)
+        encode_only["native"] = round(
+            reps * chunk / (time.perf_counter() - t0), 1)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        opblob.encode_uniform_py(stamps, pubs, "u:cas_id+object_id",
+                                 op_ids, vals)
+    encode_only["python"] = round(
+        reps * chunk / (time.perf_counter() - t0), 1)
+
+    print(json.dumps({
+        "metric": "oplog_encode_write_ops_per_sec",
+        "unit": "ops/s",
+        "ops": n_chunks * chunk,
+        "chunk": chunk,
+        "rows_format": round(rows_ops_s, 1),
+        "blob_format": round(blob_ops_s, 1),
+        "blob_vs_rows": round(blob_ops_s / rows_ops_s, 2),
+        "native_encoder": native.available(),
+        "encode_only_ops_per_sec": encode_only,
+    }))
+
+
 if __name__ == "__main__":
-    asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 120_000))
+    args = [a for a in sys.argv[1:] if a != "--encode"]
+    n = int(args[0]) if args else 120_000
+    if "--encode" in sys.argv[1:]:
+        encode_bench(n)
+    else:
+        asyncio.run(main(n))
