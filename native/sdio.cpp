@@ -1243,6 +1243,190 @@ int64_t sd_encode_ops(int64_t n, const uint64_t* timestamps,
   return p - out;
 }
 
+// ---------------------------------------------------------------------------
+// Batched op-log decoding (the clone fast path's msgpack hot path).
+//
+// sd_decode_ops is the inverse of sd_encode_ops, but GENERAL: it parses
+// any blob the Python reference encoder (opblob.encode_entries) can
+// emit, not just the uniform bulk shapes. Instead of materializing
+// per-op Python objects it fills dense offset/length arrays pointing
+// INTO the caller's blob buffer — the ctypes wrapper slices lazily and
+// the batched fresh-peer apply consumes record ids / payloads / values
+// as zero-copy views. For payloads matching the uniform bulk shapes
+// (OP_HDR5/6 fragments) it additionally locates the op_id and the
+// packed `values` map so the apply path never decodes the payload's
+// outer dict at all. Byte-parity with the pure-Python decoder
+// (opblob.decode_entries_py) is asserted in tests/test_sync_blob.py.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Cursor over the blob; every reader checks bounds and fails closed.
+struct MpCur {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok;
+  uint8_t peek() const { return *p; }
+  bool need(size_t n) {
+    if ((size_t)(end - p) < n) ok = false;
+    return ok;
+  }
+  uint64_t be(int n) {  // big-endian uint of n bytes, advances
+    uint64_t v = 0;
+    for (int i = 0; i < n; i++) v = (v << 8) | *p++;
+    return v;
+  }
+};
+
+// msgpack uint (the only timestamp shape the encoders emit).
+static bool mp_read_uint(MpCur& c, uint64_t* out) {
+  if (!c.need(1)) return false;
+  uint8_t t = *c.p++;
+  if (t < 0x80) { *out = t; return true; }
+  int n = 0;
+  switch (t) {
+    case 0xcc: n = 1; break;
+    case 0xcd: n = 2; break;
+    case 0xce: n = 4; break;
+    case 0xcf: n = 8; break;
+    default: return false;
+  }
+  if (!c.need(n)) return false;
+  *out = c.be(n);
+  return true;
+}
+
+// msgpack bin: content offset/length (bin8/16/32).
+static bool mp_read_bin(MpCur& c, const uint8_t* base, int64_t* off,
+                        int64_t* len) {
+  if (!c.need(1)) return false;
+  uint8_t t = *c.p++;
+  int n = 0;
+  switch (t) {
+    case 0xc4: n = 1; break;
+    case 0xc5: n = 2; break;
+    case 0xc6: n = 4; break;
+    default: return false;
+  }
+  if (!c.need(n)) return false;
+  uint64_t l = c.be(n);
+  if (!c.need(l)) return false;
+  *off = c.p - base;
+  *len = (int64_t)l;
+  c.p += l;
+  return true;
+}
+
+// msgpack str: content offset/length (fixstr/str8/str16).
+static bool mp_read_str(MpCur& c, const uint8_t* base, int64_t* off,
+                        int32_t* len) {
+  if (!c.need(1)) return false;
+  uint8_t t = *c.p++;
+  uint64_t l;
+  if ((t & 0xe0) == 0xa0) {
+    l = t & 0x1f;
+  } else if (t == 0xd9) {
+    if (!c.need(1)) return false;
+    l = c.be(1);
+  } else if (t == 0xda) {
+    if (!c.need(2)) return false;
+    l = c.be(2);
+  } else {
+    return false;
+  }
+  if (!c.need(l)) return false;
+  *off = c.p - base;
+  *len = (int32_t)l;
+  c.p += l;
+  return true;
+}
+
+}  // namespace
+
+// Decode a shared_op_blob page of up to max_n entries. Per entry i the
+// arrays receive: ts[i]; rid/kind/payload content offset+length into
+// `data`; and — when the payload matches a uniform bulk shape —
+// opid_off[i] (16-byte op id), values_off/len[i] (the packed values
+// map) and flags[i] (bit0 = uniform, bit1 = update), else flags[i]=0
+// and opid_off[i]=-1. Returns the entry count, or a negative Status
+// (ERR_IO) on malformed input — the wrapper falls back to the Python
+// decoder rather than trusting a partial parse.
+int64_t sd_decode_ops(const uint8_t* data, int64_t len, int64_t max_n,
+                      uint64_t* ts, int64_t* rid_off, int32_t* rid_len,
+                      int64_t* kind_off, int32_t* kind_len,
+                      int64_t* payload_off, int64_t* payload_len,
+                      int64_t* opid_off, int64_t* values_off,
+                      int64_t* values_len, uint8_t* flags) {
+  MpCur c{data, data + len, true};
+  if (!c.need(1)) return ERR_IO;
+  uint8_t t = *c.p++;
+  uint64_t n;
+  if ((t & 0xf0) == 0x90) {
+    n = t & 0x0f;
+  } else if (t == 0xdc) {
+    if (!c.need(2)) return ERR_IO;
+    n = c.be(2);
+  } else if (t == 0xdd) {
+    if (!c.need(4)) return ERR_IO;
+    n = c.be(4);
+  } else {
+    return ERR_IO;
+  }
+  if ((int64_t)n > max_n) return ERR_IO;
+  for (uint64_t i = 0; i < n; i++) {
+    if (!c.need(1) || *c.p++ != 0x94) return ERR_IO;  // [ts,rid,kind,pl]
+    if (!mp_read_uint(c, &ts[i])) return ERR_IO;
+    int64_t rl = 0;
+    if (!mp_read_bin(c, data, &rid_off[i], &rl)) return ERR_IO;
+    if (rl > INT32_MAX) return ERR_IO;
+    rid_len[i] = (int32_t)rl;
+    if (!mp_read_str(c, data, &kind_off[i], &kind_len[i])) return ERR_IO;
+    if (!mp_read_bin(c, data, &payload_off[i], &payload_len[i]))
+      return ERR_IO;
+    // Uniform-shape probe: HDR5/6 ‖ OPID ‖ 16 ‖ VALUES ‖ values
+    // [‖ UPDATE_T]. Anything else is still a valid entry — the apply
+    // path just takes its per-op fallback for it.
+    flags[i] = 0;
+    opid_off[i] = -1;
+    values_off[i] = -1;
+    values_len[i] = 0;
+    const uint8_t* pl = data + payload_off[i];
+    const int64_t pn = payload_len[i];
+    const int64_t fixed = (int64_t)(sizeof(OP_HDR5) + sizeof(OP_OPID) +
+                                    16 + sizeof(OP_VALUES));
+    if (pn < fixed + 1) continue;
+    bool update;
+    if (std::memcmp(pl, OP_HDR5, sizeof(OP_HDR5)) == 0) {
+      update = false;
+    } else if (std::memcmp(pl, OP_HDR6, sizeof(OP_HDR6)) == 0) {
+      update = true;
+    } else {
+      continue;
+    }
+    const uint8_t* q = pl + sizeof(OP_HDR5);
+    if (std::memcmp(q, OP_OPID, sizeof(OP_OPID)) != 0) continue;
+    q += sizeof(OP_OPID);
+    const int64_t oid = payload_off[i] + (q - pl);
+    q += 16;
+    if (std::memcmp(q, OP_VALUES, sizeof(OP_VALUES)) != 0) continue;
+    q += sizeof(OP_VALUES);
+    int64_t vlen = pn - (q - pl);
+    if (update) {
+      vlen -= (int64_t)sizeof(OP_UPDATE_T);
+      if (vlen < 1 || std::memcmp(pl + pn - sizeof(OP_UPDATE_T),
+                                  OP_UPDATE_T, sizeof(OP_UPDATE_T)) != 0)
+        continue;
+    }
+    if (vlen < 1) continue;
+    opid_off[i] = oid;
+    values_off[i] = payload_off[i] + (q - pl);
+    values_len[i] = vlen;
+    flags[i] = update ? 3 : 1;
+  }
+  if (c.p != c.end) return ERR_IO;  // trailing garbage
+  return (int64_t)n;
+}
+
 // Secure erase: `passes` overwrites with a keystream then zeros, fsync'd
 // (the role of sd-crypto's fs/erase.rs behind the file_eraser job).
 int32_t sd_secure_erase(const char* path, int passes) {

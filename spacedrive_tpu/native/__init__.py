@@ -96,6 +96,10 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, u64p, u8p, ctypes.c_char_p, u8p, u8p, i64p,
         u8p, ctypes.c_int64]
     lib.sd_encode_ops.restype = ctypes.c_int64
+    lib.sd_decode_ops.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, u64p, i64p, i32p, i64p,
+        i32p, i64p, i64p, i64p, i64p, i64p, u8p]
+    lib.sd_decode_ops.restype = ctypes.c_int64
     return lib
 
 
@@ -300,6 +304,72 @@ def encode_ops(timestamps, record_ids, kind: str, op_ids,
         raise RuntimeError(
             f"sd_encode_ops: output buffer undersized (cap={cap}, n={n})")
     return out[:written].tobytes()
+
+
+def _blob_entry_count(data: bytes) -> int:
+    """Entry count from the blob's msgpack array header (the encoders
+    emit exactly fixarray / array16 / array32)."""
+    if not data:
+        raise ValueError("decode_ops: empty blob")
+    t = data[0]
+    if t & 0xF0 == 0x90:
+        return t & 0x0F
+    if t == 0xDC:
+        return int.from_bytes(data[1:3], "big")
+    if t == 0xDD:
+        return int.from_bytes(data[1:5], "big")
+    raise ValueError(f"decode_ops: not an op blob (leading byte {t:#x})")
+
+
+def decode_ops(data: bytes):
+    """Batched blob decode (sync/opblob.py format): one C call parses a
+    whole shared_op_blob page into dense offset arrays over `data` —
+    no per-op msgpack objects. Returns
+    (n, ts, rid_off, rid_len, kind_off, kind_len, payload_off,
+    payload_len, opid_off, values_off, values_len, flags) where flags
+    bit0 marks a uniform bulk payload (opid/values offsets valid) and
+    bit1 the update shape. Raises ValueError on malformed input —
+    callers (opblob.decode_entries) fall back to the Python decoder."""
+    lib = _load()
+    assert lib is not None
+    n = _blob_entry_count(data)
+    if 7 * n > len(data):
+        # The header's count is WIRE-CONTROLLED (a blob_page frame from
+        # a paired peer): allocating the offset arrays before this
+        # check would let a 5-byte b"\xdd\xff\xff\xff\xff" frame force
+        # tens of GB of np.zeros. Every real entry costs ≥7 bytes
+        # (fixarray4 + ts + empty bin rid + empty fixstr + empty bin).
+        raise ValueError(
+            f"decode_ops: header claims {n} entries in {len(data)} bytes")
+    buf = (np.frombuffer(data, dtype=np.uint8) if data
+           else np.zeros(1, dtype=np.uint8))
+    ts = np.zeros(max(n, 1), dtype=np.uint64)
+    rid_off = np.zeros(max(n, 1), dtype=np.int64)
+    rid_len = np.zeros(max(n, 1), dtype=np.int32)
+    kind_off = np.zeros(max(n, 1), dtype=np.int64)
+    kind_len = np.zeros(max(n, 1), dtype=np.int32)
+    payload_off = np.zeros(max(n, 1), dtype=np.int64)
+    payload_len = np.zeros(max(n, 1), dtype=np.int64)
+    opid_off = np.zeros(max(n, 1), dtype=np.int64)
+    values_off = np.zeros(max(n, 1), dtype=np.int64)
+    values_len = np.zeros(max(n, 1), dtype=np.int64)
+    flags = np.zeros(max(n, 1), dtype=np.uint8)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    got = lib.sd_decode_ops(
+        _u8(buf), len(data), n, _u64(ts),
+        rid_off.ctypes.data_as(i64), _i32(rid_len),
+        kind_off.ctypes.data_as(i64), _i32(kind_len),
+        payload_off.ctypes.data_as(i64),
+        payload_len.ctypes.data_as(i64),
+        opid_off.ctypes.data_as(i64), values_off.ctypes.data_as(i64),
+        values_len.ctypes.data_as(i64), _u8(flags))
+    if got != n:
+        # A real exception (never an assert — see encode_ops): a
+        # malformed page must route to the tolerant Python decoder,
+        # not yield a truncated op stream.
+        raise ValueError(f"sd_decode_ops: malformed blob (rc={got})")
+    return (n, ts, rid_off, rid_len, kind_off, kind_len, payload_off,
+            payload_len, opid_off, values_off, values_len, flags)
 
 
 def secure_erase(path: str, passes: int = 1) -> None:

@@ -88,6 +88,22 @@ class Tunnel:
         self._recv_ctr += 1
         return msgpack.unpackb(plain, raw=False, strict_map_key=False)
 
+    def send_nowait(self, msg: Any) -> None:
+        """Seal and queue a frame WITHOUT awaiting the socket drain —
+        the windowed blob-page sender (sync_net clone stream) pipelines
+        up to its window of pages into the transport buffer and then
+        awaits drain() once, instead of a per-frame drain round-trip.
+        Counter-nonce ordering is unaffected: frames are sealed in call
+        order on the single writer."""
+        plain = msgpack.packb(msg, use_bin_type=True)
+        sealed = self._send.encrypt(self._nonce(self._send_ctr), plain, None)
+        self._send_ctr += 1
+        write_frame(self.writer, sealed)
+
+    async def drain(self) -> None:
+        """Flush frames queued by send_nowait to the socket."""
+        await self.writer.drain()
+
     async def send_raw(self, data: bytes) -> None:
         sealed = self._send.encrypt(self._nonce(self._send_ctr), data, None)
         self._send_ctr += 1

@@ -18,10 +18,12 @@ the reference's in-process transport fake
 from __future__ import annotations
 
 import asyncio
+import os
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from ..sync.ingest import Ingester, MessagesEvent, ReqKind
+from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
+    pump_clone_stream
 from ..sync.manager import GetOpsArgs
 from ..sync.crdt import CRDTOperation
 from ..tracing import logger
@@ -29,13 +31,22 @@ from .identity import RemoteIdentity
 
 OPS_PER_REQUEST = 1000
 
+# Clone fast path flow control: pages in flight on the tunnel before
+# the originator waits for a watermark ack. Window 4 at the bulk
+# writers' 4-16k-op pages keeps a few MB in transport buffers — enough
+# that the receiver's batched apply never starves on the wire, bounded
+# enough that a slow receiver exerts backpressure instead of ballooning
+# originator memory.
+CLONE_WINDOW = 4
+
 # Sync wire-format version, checked in BOTH directions: the originator
 # announces it in the new_ops header (responder refuses a mismatch), and
 # the responder echoes it in every pull-request frame (originator refuses
 # to SERVE a mismatch — the direction that matters: a stale decoder
 # pulling v2 ops would silently read multi-field update ops, "u:a+b"
-# kinds, as creates and corrupt its replica's op log).
-SYNC_PROTO = 2
+# kinds, as creates and corrupt its replica's op log; a v2 peer would
+# likewise not understand v3's blob_stream clone frames).
+SYNC_PROTO = 3
 
 
 class NetworkedLibraries:
@@ -54,6 +65,11 @@ class NetworkedLibraries:
         self._instances: Dict[uuidlib.UUID, Dict[bytes, RemoteIdentity]] = {}
         # identity bytes → (addr, port) route override (tests / static).
         self._routes: Dict[bytes, Tuple[str, int]] = {}
+        # identity bytes → last route that carried a healthy tunnel:
+        # discovery results are cached for the life of the tunnel and
+        # invalidated on send failure, so a steady announce stream does
+        # not re-scan the discovery peer table per round.
+        self._route_cache: Dict[bytes, Tuple[str, int]] = {}
         self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
         self._origin_tasks: set = set()
         self._origin_pending: set = set()
@@ -101,7 +117,13 @@ class NetworkedLibraries:
                  ) -> Optional[Tuple[str, int]]:
         key = identity.to_bytes()
         if key in self._routes:
+            # explicit overrides (set_route / pairing) always win, so a
+            # healed partition takes effect immediately even with a
+            # stale cache entry present
             return self._routes[key]
+        cached = self._route_cache.get(key)
+        if cached is not None:
+            return cached
         disc = self.p2p.discovery
         if disc is not None:
             for peer in disc.peers.values():
@@ -156,9 +178,12 @@ class NetworkedLibraries:
             route = self._resolve(identity)
             if route is None:
                 continue
+            key = identity.to_bytes()
             try:
                 await self._originate_one(library, identity, route)
+                self._route_cache[key] = route  # healthy: keep for next round
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                self._route_cache.pop(key, None)  # stale: re-resolve next time
                 continue  # peer offline; it will pull on reconnect
 
     async def _originate_one(self, library, identity: RemoteIdentity,
@@ -168,7 +193,12 @@ class NetworkedLibraries:
             await tunnel.send({"t": "sync", "kind": "new_ops",
                                "library_id": str(library.id),
                                "proto": SYNC_PROTO})
-            # Serve the responder's pull loop from our op log.
+            # Serve the responder's pull loop from our op log. The
+            # clone fast path runs at most once per tunnel: a receiver
+            # whose watermark stays frozen (persistent per-op failure)
+            # must degrade to the per-op loop, not re-pull the whole
+            # blob stream forever.
+            clone_served = False
             while True:
                 req = await tunnel.recv()
                 if not isinstance(req, dict) or req.get("kind") == "done":
@@ -182,6 +212,18 @@ class NetworkedLibraries:
                     await tunnel.send({"ops": [], "has_more": False})
                     break
                 clocks = [(bytes(i), int(t)) for i, t in req["clocks"]]
+                # Clone fast path: a fresh peer (zero watermark for the
+                # blob-authoring instances) gets the stored blob pages
+                # VERBATIM — no per-op materialization, no re-encode —
+                # under windowed flow control. After the stream the
+                # peer re-requests with advanced clocks and the normal
+                # per-op loop finishes the row tail.
+                if not clone_served and os.environ.get(
+                        "SDTPU_CLONE_PASSTHROUGH", "on") != "off":
+                    clone_served = await self._serve_clone_stream(
+                        library, tunnel, clocks)
+                    if clone_served:
+                        continue
                 ops = library.sync.get_ops(GetOpsArgs(
                     clocks=clocks,
                     count=min(int(req.get("count", OPS_PER_REQUEST)),
@@ -192,6 +234,57 @@ class NetworkedLibraries:
                 })
         finally:
             tunnel.close()
+
+    async def _serve_clone_stream(self, library, tunnel, clocks) -> bool:
+        """Stream eligible blob pages (plus the interleaved row-format
+        ops that must precede each page's watermark advance) to the
+        pulling peer. Window invariant: at most CLONE_WINDOW unacked
+        pages in flight; each ack carries the receiver's durably
+        committed watermark, so a dropped stream resumes exactly where
+        the receiver's instance row says. Returns False (nothing sent)
+        when the peer is not a fresh clone target — the caller falls
+        through to the per-op page."""
+        stream = library.sync.iter_clone_stream(clocks)
+        started = False
+        inflight = 0
+        try:
+            for kind, item in stream:
+                if not started:
+                    await tunnel.send({"kind": "blob_stream",
+                                       "window": CLONE_WINDOW})
+                    started = True
+                if kind == "ops":
+                    await tunnel.send({
+                        "kind": "clone_ops",
+                        "ops": [op.to_wire() for op in item]})
+                    continue
+                tunnel.send_nowait({"kind": "blob_page", **item})
+                inflight += 1
+                if inflight >= CLONE_WINDOW:
+                    # One backpressure point per window instead of per
+                    # frame (the point of send_nowait): the window's
+                    # pages stream into the socket back-to-back, and a
+                    # slow receiver pauses us here, not mid-window.
+                    await tunnel.drain()
+                while inflight >= CLONE_WINDOW:
+                    ack = await tunnel.recv()
+                    if not isinstance(ack, dict) or ack.get("kind") != "ack":
+                        raise ConnectionError(
+                            f"clone stream: bad ack frame {ack!r}")
+                    inflight -= 1
+            await tunnel.drain()  # flush the final partial window
+            while inflight > 0:
+                ack = await tunnel.recv()
+                if not isinstance(ack, dict) or ack.get("kind") != "ack":
+                    raise ConnectionError(
+                        f"clone stream: bad ack frame {ack!r}")
+                inflight -= 1
+        except BaseException:
+            tunnel.close()  # mid-stream failure: no clean blob_done exists
+            raise
+        if started:
+            await tunnel.send({"kind": "blob_done"})
+        return started
 
     # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
 
@@ -245,6 +338,22 @@ class NetworkedLibraries:
                     "proto": SYNC_PROTO,
                 })
                 page = await tunnel.recv()
+                if isinstance(page, dict) and \
+                        page.get("kind") == "blob_stream":
+                    # Clone fast path: the originator answered our pull
+                    # request with a verbatim blob-page stream. Drain it
+                    # here (batched apply + per-page acks), then hand
+                    # the ingester an empty has_more page so its loop
+                    # re-requests with the advanced clocks and the
+                    # normal per-op path serves the row tail.
+                    n, _fast, _fb = await pump_clone_stream(
+                        library.sync, tunnel.recv, tunnel.send,
+                        ingester.errors)
+                    applied += n
+                    ingester.deliver(MessagesEvent(
+                        instance=library.sync.instance, messages=[],
+                        has_more=True))
+                    continue
                 ops = [CRDTOperation.from_wire(raw)
                        for raw in page.get("ops", [])]
                 ingester.deliver(MessagesEvent(

@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import enum
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from .crdt import CRDTOperation
 from .manager import SyncManager
@@ -42,6 +42,91 @@ class MessagesEvent:
     instance: bytes
     messages: List[CRDTOperation]
     has_more: bool
+
+
+async def pump_clone_stream(sync: SyncManager, recv, send,
+                            errors: List[str]) -> Tuple[int, int, int]:
+    """Receiver half of the clone fast path's blob phase: drain
+    `blob_page` / `clone_ops` frames until `blob_done`, acking each
+    applied page with the advanced watermark so the originator's
+    windowed sender (N pages in flight) can release the next page.
+
+    `recv`/`send` are the tunnel's async frame callables — tests drive
+    this with plain asyncio queues, exactly like the Ingester. Pages go
+    through the manager's batched fresh-peer apply
+    (receive_blob_pages, which falls back per-op the moment the page
+    fails the LWW-no-op proof); interleaved `clone_ops` chunks — the
+    row-format ops the originator must deliver BEFORE a page's ack can
+    advance the watermark past them — go through the normal per-op
+    ingest. Returns (ops_applied, fast_pages, fallback_pages)."""
+    applied = 0
+    fast_pages = 0
+    fallback_pages = 0
+    # Frozen-watermark guard: if an op from instance X fails ingest,
+    # receive_crdt_operations freezes X's watermark BELOW it so the
+    # next pull re-serves it (the per-op path's silent-divergence
+    # invariant). This forward-only stream must then stop APPLYING
+    # X's later frames entirely — even the per-op fallback would
+    # advance the watermark past the failed op, orphaning it forever.
+    # `expect` tracks the highest timestamp delivered per instance; a
+    # watermark short of it means something froze → the instance goes
+    # `dirty` and its remaining frames drain unapplied (acked with the
+    # frozen watermark, pure flow control). The next pull re-serves
+    # from the frozen point through the per-op loop. Quarantined
+    # poison ops advance the watermark by design, so version skew
+    # does NOT dirty the stream.
+    dirty: set = set()
+    expect: dict = {}
+
+    def _frozen(pub: bytes) -> bool:
+        return sync.timestamps.get(pub, 0) < expect.get(pub, 0)
+
+    while True:
+        frame = await recv()
+        kind = frame.get("kind") if isinstance(frame, dict) else None
+        if kind == "blob_done":
+            return applied, fast_pages, fallback_pages
+        if kind == "clone_ops":
+            ops = [CRDTOperation.from_wire(raw)
+                   for raw in frame.get("ops", [])]
+            live = [op for op in ops if op.instance not in dirty]
+            if live:
+                n, errs = await asyncio.to_thread(
+                    sync.receive_crdt_operations, live)
+                applied += n
+                errors.extend(errs)
+                for op in live:
+                    expect[op.instance] = max(
+                        expect.get(op.instance, 0), op.timestamp)
+                for pub in {op.instance for op in live}:
+                    if _frozen(pub):
+                        dirty.add(pub)
+        elif kind == "blob_page":
+            pub = bytes(frame["instance"])
+            if pub in dirty or _frozen(pub):
+                dirty.add(pub)
+                await send({"kind": "ack",
+                            "ts": sync.timestamps.get(pub, 0),
+                            "fast": False})
+                fallback_pages += 1
+                continue
+            n, errs, fast = await asyncio.to_thread(
+                sync.receive_blob_pages, [frame])
+            applied += n
+            errors.extend(errs)
+            fast_pages += 1 if fast else 0
+            fallback_pages += 0 if fast else 1
+            expect[pub] = max(expect.get(pub, 0), int(frame["max_ts"]))
+            if _frozen(pub):
+                dirty.add(pub)
+            # Ack AFTER the apply committed: the watermark the ack
+            # carries is durable, so a crash mid-stream re-pulls from
+            # exactly the right place.
+            await send({"kind": "ack",
+                        "ts": sync.timestamps.get(pub, 0),
+                        "fast": bool(fast)})
+        else:
+            raise ValueError(f"unexpected clone-stream frame: {frame!r}")
 
 
 class Ingester:

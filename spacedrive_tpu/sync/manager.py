@@ -105,6 +105,15 @@ class SyncManager:
         # ingest explodes them to rows). Flips False forever the moment
         # a peer instance appears (register_instance).
         self._solo = True
+        # Clone fast-path bookkeeping: the batched blob apply
+        # (receive_blob_pages) may skip per-op LWW compare only while
+        # it can PROVE compare is a no-op — every incoming timestamp
+        # newer than every logged op, and no shared delete tombstones
+        # in the log. Both lazy-init from SQL on first use
+        # (_op_log_state) and are then maintained in memory by every
+        # op-insert site (_note_ops_logged).
+        self._op_log_high: Optional[int] = None
+        self._has_shared_tombstones: Optional[bool] = None
         self._load_instances()
         # Re-ingest ops quarantined by an OLDER schema (one cheap
         # SELECT when the table is empty — the common case).
@@ -139,6 +148,39 @@ class SyncManager:
             rid = row["id"]
             self._instance_ids[pub_id] = rid
         return rid
+
+    def _op_log_state(self) -> Tuple[int, bool]:
+        """(highest logged timestamp across every op-log format, any
+        shared delete tombstones logged?) — the two facts the clone
+        fast path's LWW-compare-is-a-no-op proof rests on. Lazy SQL
+        init, then kept current by _note_ops_logged; blob pages never
+        hold tombstones (bulk writers emit only the field-is-None
+        create/multi-update shapes), so the tombstone probe only needs
+        the row table."""
+        if self._op_log_high is None:
+            hi = 0
+            for table, col in (("shared_operation", "timestamp"),
+                               ("relation_operation", "timestamp"),
+                               ("shared_op_blob", "max_ts")):
+                row = self.db.query_one(
+                    f"SELECT MAX({col}) AS t FROM {table}")
+                if row is not None and row["t"] is not None:
+                    hi = max(hi, row["t"])
+            self._op_log_high = hi
+        if self._has_shared_tombstones is None:
+            self._has_shared_tombstones = self.db.query_one(
+                "SELECT 1 FROM shared_operation WHERE kind = 'd' "
+                "LIMIT 1") is not None
+        return self._op_log_high, self._has_shared_tombstones
+
+    def _note_ops_logged(self, ts_high: int, any_shared_delete: bool
+                         ) -> None:
+        """Keep the lazily-computed _op_log_state facts current after
+        an op-insert batch (no-op while still uninitialized)."""
+        if self._op_log_high is not None and ts_high > self._op_log_high:
+            self._op_log_high = ts_high
+        if any_shared_delete and self._has_shared_tombstones is not None:
+            self._has_shared_tombstones = True
 
     def on_created(self, cb: Callable[[], None]) -> None:
         """Subscribe to SyncMessage::Created broadcasts (manager.rs:89)."""
@@ -256,6 +298,10 @@ class SyncManager:
                 "INSERT INTO relation_operation "
                 "(timestamp, relation, item_id, group_id, kind, data, "
                 "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?)", rel_rows)
+        if shared_rows or rel_rows:
+            self._note_ops_logged(
+                max(r[0] for r in shared_rows + rel_rows),
+                any(r[3] == OpKind.DELETE for r in shared_rows))
 
     def bulk_shared_ops(
         self, conn, model: str,
@@ -286,7 +332,12 @@ class SyncManager:
         # (_ensure_row_oplog), so the CRDT contract is unchanged.
         if self._solo and len(specs) >= BLOB_MIN_OPS:
             kind0 = specs[0][1]
-            uniform = all(
+            # Only the create / multi-update shapes may land as blobs:
+            # pack_bulk_payload would encode a 'd' spec as a
+            # create-shaped payload with delete=False (silently
+            # un-deleting on every replica) — deletes fall through to
+            # the row path, whose tombstone bookkeeping handles them.
+            uniform = (kind0 == "c" or kind0.startswith("u:")) and all(
                 field is None and kind == kind0
                 and type(rid) is bytes and len(rid) == 16
                 for rid, kind, field, _v, _vs in specs)
@@ -300,6 +351,7 @@ class SyncManager:
                     "VALUES (?, ?, ?, ?, ?, ?)",
                     (model, stamps[0], stamps[-1], len(specs), blob,
                      my_id))
+                self._note_ops_logged(stamps[-1], False)
                 return len(specs)
 
         def _rid(rid) -> bytes:
@@ -318,6 +370,12 @@ class SyncManager:
             # ops per 200k-file identify). Byte-equality with the
             # dataclass path is asserted by tests — _compare_message
             # dedup depends on it.
+            if kind == OpKind.DELETE:
+                # 'd' also has field None but must NOT take the
+                # create-shaped fragment path (delete=False would
+                # silently un-delete on every replica)
+                return pack_value(op_payload(None, None, True, op_id,
+                                             values))
             if field is None:
                 if kind.startswith("u:"):
                     return (_BULK_HDR6 + _BULK_OPID + op_id
@@ -338,10 +396,14 @@ class SyncManager:
             "INSERT INTO shared_operation "
             "(timestamp, model, record_id, kind, data, instance_id) "
             "VALUES (?, ?, ?, ?, ?, ?)", rows)
+        self._note_ops_logged(
+            stamps[-1], any(s[1] == OpKind.DELETE for s in specs))
         return len(rows)
 
     def _insert_op_row(self, conn, op: CRDTOperation, instance_row_id: int) -> None:
         t = op.typ
+        self._note_ops_logged(
+            op.timestamp, isinstance(t, SharedOp) and t.delete)
         data = pack_value(op_payload(
             t.field, t.value, t.delete, op.id, t.values,
             getattr(t, "update", False)))
@@ -446,7 +508,7 @@ class SyncManager:
                 continue
             floor = wm.get(m["pub"])
             kth = None  # lazy per-blob cutoff, see below
-            for ts, rid, kind, payload in opblob.decode_entries(
+            for ts, rid, kind, payload in opblob.iter_entries(
                     row["data"]):
                 if floor is not None and ts <= floor:
                     continue
@@ -454,8 +516,10 @@ class SyncManager:
                     # Entries within a blob ascend (HLC batch mint), so
                     # once an entry exceeds the count-th smallest
                     # collected timestamp nothing later in this blob
-                    # can make the final page — stop materializing ops
-                    # a multi-page pull will re-request anyway.
+                    # can make the final page — stop DECODING: the
+                    # iterator is lazy (opblob.iter_entries), so a 2M-op
+                    # backlog never pays msgpack work past the window a
+                    # multi-page pull will re-request anyway.
                     if kth is None:
                         kth = sorted(t for t, _, _ in out)[args.count - 1]
                     if ts > kth:
@@ -527,6 +591,80 @@ class SyncManager:
         return CRDTOperation(
             row["instance_pub_id"], row["timestamp"],
             data.get("op_id", b""), typ)
+
+    # -- clone fast path: serving side --------------------------------------
+
+    def iter_clone_stream(self, clocks: Sequence[Tuple[bytes, int]],
+                          ops_page: int = 1000):
+        """Originator half of the full-library clone fast path: yield
+        ``("page", page_dict)`` items carrying stored `shared_op_blob`
+        pages VERBATIM (no explode, no per-op materialization, no
+        re-encode) interleaved with ``("ops", [CRDTOperation, ...])``
+        row-format chunks, for a peer that has NEVER diverged from the
+        blob-authoring instances (its watermark for them is absent or
+        zero — anything else means it already holds some of their
+        history and the per-op get_ops path must arbitrate).
+
+        Ordering invariant: a page's ack advances the puller's
+        watermark for the authoring instance to the page's max_ts, so
+        every ROW-format op from that instance with a smaller timestamp
+        is yielded AHEAD of the page — otherwise the advanced watermark
+        would skip it forever. Ops from other instances are untouched
+        by the ack and flow through the normal pull loop afterwards.
+        Pages are fetched lazily (one SELECT per yield) so a 2M-op
+        backlog never materializes in memory."""
+        self._ensure_sync_indexes()
+        wm = dict(clocks)
+        metas = self.db.query(
+            "SELECT b.id, b.model, b.min_ts, b.max_ts, b.n_ops, "
+            "b.instance_id, i.pub_id AS pub FROM shared_op_blob b "
+            "JOIN instance i ON i.id = b.instance_id ORDER BY b.min_ts")
+        floors: Dict[bytes, int] = {}
+        for m in metas:
+            pub = m["pub"]
+            if wm.get(pub, 0) != 0:
+                continue
+            floor = floors.get(pub, 0)
+            for ops in self._row_ops_between(
+                    m["instance_id"], pub, floor, m["min_ts"], ops_page):
+                yield ("ops", ops)
+            row = self.db.query_one(
+                "SELECT data FROM shared_op_blob WHERE id = ?",
+                (m["id"],))
+            if row is None:
+                # Concurrently exploded (a first remote ingest ran
+                # between the metas SELECT and here): its ops are rows
+                # now, picked up by the next page's row window or the
+                # normal pull loop after the stream.
+                continue
+            yield ("page", {
+                "model": m["model"], "instance": pub,
+                "min_ts": m["min_ts"], "max_ts": m["max_ts"],
+                "n_ops": m["n_ops"], "data": row["data"]})
+            floors[pub] = m["max_ts"]
+
+    def _row_ops_between(self, instance_row_id: int, pub: bytes,
+                         lo: int, hi: int, ops_page: int):
+        """Row-format ops authored by one instance with lo < ts < hi,
+        in timestamp order, chunked to ops_page (bounded memory)."""
+        while True:
+            merged: List[Tuple[int, bool, Any]] = []
+            for table, is_shared in (("shared_operation", True),
+                                     ("relation_operation", False)):
+                rows = self.db.query(
+                    f"SELECT o.*, ? AS instance_pub_id FROM {table} o "
+                    f"WHERE o.instance_id = ? AND o.timestamp > ? "
+                    f"AND o.timestamp < ? ORDER BY o.timestamp LIMIT ?",
+                    (pub, instance_row_id, lo, hi, ops_page))
+                merged.extend((r["timestamp"], is_shared, r) for r in rows)
+            if not merged:
+                return
+            merged.sort(key=lambda t: t[0])
+            chunk = merged[:ops_page]
+            yield [self._row_to_op(r, s) for _, s, r in chunk]
+            if len(merged) < ops_page:
+                return
+            lo = chunk[-1][0]
 
     # -- ingest (core/crates/sync/src/ingest.rs:110-233) -------------------
 
@@ -664,6 +802,192 @@ class SyncManager:
                     (ts, pub))
         self.timestamps.update(ts_max)
         return applied, errors
+
+    # -- clone fast path: receiving side ------------------------------------
+
+    def receive_blob_pages(self, pages: Sequence[dict]
+                           ) -> Tuple[int, List[str], int]:
+        """Batched ingest of verbatim `shared_op_blob` pages (the clone
+        fast path's receiving half). Each page applies in ONE
+        transaction — executemany op-log inserts, executemany domain
+        writes grouped by value-shape, and a deferred FK-resolution
+        pass (FK pub_ids resolve via subselect AFTER all of the page's
+        rows are seeded) — skipping per-op _compare_message entirely,
+        because eligibility (_clone_fast_eligible) PROVES the LWW
+        compare is a no-op: every incoming timestamp is newer than
+        every logged op and no tombstones exist. The moment a page
+        fails that proof (local writes during the clone, deletes in
+        the log, non-uniform payloads, redelivery) it falls back to
+        the per-op receive_crdt_operations path — identical final
+        state, just slower. Returns (applied, errors, fast_pages)."""
+        applied = 0
+        errors: List[str] = []
+        fast_pages = 0
+        for page in pages:
+            a, errs, fast = self._receive_blob_page(page)
+            applied += a
+            errors.extend(errs)
+            fast_pages += 1 if fast else 0
+        return applied, errors, fast_pages
+
+    def _receive_blob_page(self, page: dict) -> Tuple[int, List[str], bool]:
+        model = page["model"]
+        pub = bytes(page["instance"])
+        rows = opblob.decode_apply_rows(page["data"])
+        if not rows:
+            return 0, [], False
+        if pub not in self._instance_ids:
+            try:
+                self._instance_row_id(pub)
+            except KeyError:
+                self.register_instance(pub, node_name="(relayed)")
+        if self._clone_fast_eligible(model, rows):
+            try:
+                self._apply_page_fast(model, pub, rows)
+                return len(rows), [], True
+            except Exception as e:  # noqa: BLE001 — tx rolled back whole
+                # The per-op path re-decides op by op (savepoints,
+                # quarantine, watermark freeze) — never lose a page to
+                # a fast-path surprise.
+                errors = [f"clone fast apply {model}: {e}; "
+                          f"falling back per-op"]
+                applied, errs = self._receive_page_per_op(model, pub, rows)
+                return applied, errors + errs, False
+        applied, errs = self._receive_page_per_op(model, pub, rows)
+        return applied, errs, False
+
+    def _receive_page_per_op(self, model: str, pub: bytes,
+                             rows: Sequence[tuple]
+                             ) -> Tuple[int, List[str]]:
+        ops = [self._entry_to_op(model, ts, rid, payload, pub)
+               for ts, rid, _kind, payload, _vp, _u in rows]
+        return self.receive_crdt_operations(ops)
+
+    def _clone_fast_eligible(self, model: str,
+                             rows: Sequence[tuple]) -> bool:
+        """True when applying this page without per-op LWW compare is
+        provably identical to the per-op path: known shared model, only
+        uniform create/multi-update entries, strictly ascending
+        timestamps all newer than every logged op, no shared delete
+        tombstones, and no record touched twice (grouped executemany
+        statements preserve order only within one group)."""
+        mdef = M.MODELS.get(model)
+        if mdef is None or mdef.sync != M.SyncMode.SHARED:
+            return False  # per-op path quarantines version skew properly
+        hi, tombstones = self._op_log_state()
+        if tombstones:
+            return False
+        prev = hi
+        seen = set()
+        for ts, rid, kind, _payload, values_packed, _update in rows:
+            if values_packed is None:
+                return False  # not a uniform bulk payload
+            if kind != OpKind.CREATE and not kind.startswith("u:"):
+                return False
+            if ts <= prev:
+                return False
+            prev = ts
+            if rid in seen:
+                return False
+            seen.add(rid)
+        return True
+
+    @staticmethod
+    def _rid_bytes(rid_packed: bytes) -> Any:
+        """Unpack a blob entry's packed record id (bin8(16) fast path —
+        the only shape bulk writers emit)."""
+        if len(rid_packed) == 18 and rid_packed[:2] == b"\xc4\x10":
+            return rid_packed[2:]
+        return unpack_value(rid_packed)
+
+    def _apply_page_fast(self, model: str, pub: bytes,
+                         rows: Sequence[tuple]) -> None:
+        """One page → one transaction of executemany writes. Mirrors
+        _apply_shared's create/multi-update semantics exactly, minus
+        the compare/supersede probes eligibility already proved moot."""
+        mdef = M.MODELS[model]
+        sync_col = mdef.sync_id[0]
+        remote_id = self._instance_row_id(pub)
+        max_ts = rows[-1][0]
+        attributable = any(f.name == "instance_id" for f in mdef.fields)
+        # (is_create, sorted value keys) → [(record_id, values)];
+        # insertion-ordered, and no record repeats across groups
+        # (eligibility), so cross-group execution order is free.
+        groups: Dict[Tuple[bool, Tuple[str, ...]], List[Tuple[Any, dict]]] \
+            = {}
+        oplog_rows = []
+        any_create = False
+        for ts, rid_packed, kind, payload, values_packed, _update in rows:
+            oplog_rows.append(
+                (ts, model, rid_packed, kind, payload, remote_id))
+            is_create = kind == OpKind.CREATE
+            any_create = any_create or is_create
+            values = unpack_value(values_packed) or {}
+            key = (is_create, tuple(sorted(values)))
+            groups.setdefault(key, []).append(
+                (self._rid_bytes(rid_packed), values))
+        with self.db.tx() as conn:
+            conn.executemany(
+                "INSERT INTO shared_operation "
+                "(timestamp, model, record_id, kind, data, instance_id) "
+                "VALUES (?, ?, ?, ?, ?, ?)", oplog_rows)
+            for (is_create, keys), recs in groups.items():
+                self._apply_group_fast(conn, mdef, sync_col, remote_id,
+                                       is_create and attributable,
+                                       keys, recs)
+            if any_create and conn.execute(
+                    "SELECT 1 FROM pending_relation_op LIMIT 1"
+                    ).fetchone() is not None:
+                # parity with _apply_op_conn: creates may materialize
+                # rows parked relation ops were waiting for
+                self._drain_pending_relations(conn)
+            new_wm = max(self.timestamps.get(pub, 0), max_ts)
+            conn.execute(
+                "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
+                (new_wm, pub))
+        self.timestamps[pub] = new_wm
+        self.clock.update_with_timestamp(max_ts)
+        self._note_ops_logged(max_ts, False)
+
+    def _apply_group_fast(self, conn, mdef, sync_col: str, remote_id: int,
+                          attribute: bool, keys: Tuple[str, ...],
+                          recs: List[Tuple[Any, dict]]) -> None:
+        """Domain writes for one (kind-class, value-shape) group:
+        executemany row seeding, then one executemany per field — FK
+        fields resolve pub_id → local id via a scalar subselect (the
+        deferred resolution pass; referenced rows seeded by earlier
+        statements of this page resolve, absent ones write NULL exactly
+        like _resolve_fk)."""
+        table = mdef.name
+        if attribute:
+            conn.executemany(
+                f"INSERT OR IGNORE INTO {table} ({sync_col}, instance_id) "
+                f"VALUES (?, ?)", [(r, remote_id) for r, _ in recs])
+            conn.executemany(
+                f"UPDATE {table} SET instance_id = ? WHERE {sync_col} = ? "
+                f"AND instance_id IS NULL",
+                [(remote_id, r) for r, _ in recs])
+        else:
+            conn.executemany(
+                f"INSERT OR IGNORE INTO {table} ({sync_col}) VALUES (?)",
+                [(r,) for r, _ in recs])
+        for name in keys:
+            try:
+                f = mdef.field(name)  # registry guard before SQL
+            except KeyError:
+                continue  # newer peer's field this schema lacks — skip
+            target = _fk_target(f)
+            if target is not None and \
+                    M.MODELS[target].sync == M.SyncMode.SHARED:
+                conn.executemany(
+                    f"UPDATE {table} SET {name} = "
+                    f"(SELECT id FROM {target} WHERE pub_id = ?) "
+                    f"WHERE {sync_col} = ?",
+                    [(vals[name], r) for r, vals in recs])
+            else:
+                conn.executemany(
+                    f"UPDATE {table} SET {name} = ? WHERE {sync_col} = ?",
+                    [(vals[name], r) for r, vals in recs])
 
     def drain_quarantined_ops(self) -> int:
         """Re-ingest ops a previous (older) schema quarantined as

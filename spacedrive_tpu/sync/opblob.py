@@ -69,8 +69,116 @@ def encode_entries(entries: Sequence[Sequence[Any]]) -> bytes:
 
 
 def decode_entries(data: bytes) -> List[list]:
-    """Blob bytes → [[ts, record_id_packed, kind, payload], ...]."""
+    """Blob bytes → [[ts, record_id_packed, kind, payload], ...].
+
+    Dispatches to the native batched decoder (sd_decode_ops) when the
+    C++ plane is loaded; the pure-Python path below is the fallback and
+    the byte-parity oracle (tests/test_sync_blob.py), and also catches
+    malformed pages the strict native parser refuses."""
+    rows = _decode_native(data)
+    if rows is not None:
+        return rows
+    return decode_entries_py(data)
+
+
+def decode_entries_py(data: bytes) -> List[list]:
+    """Pure-Python blob decode — the reference the native decoder must
+    match entry-for-entry."""
     return msgpack.unpackb(data, raw=False, use_list=True)
+
+
+def iter_entries(data: bytes):
+    """Lazily yield [ts, record_id_packed, kind, payload] entries.
+
+    The count-bounded get_ops read path uses this instead of
+    decode_entries so serving a 1000-op page out of a 2M-op blob
+    backlog never decodes (or materializes) entries past the requested
+    window — the consumer just stops iterating."""
+    u = msgpack.Unpacker(raw=False, use_list=True)
+    u.feed(data)
+    for _ in range(u.read_array_header()):
+        yield u.unpack()
+
+
+def _decode_native(data: bytes, with_values: bool = False
+                   ) -> Optional[list]:
+    """One shared materialization of sd_decode_ops' offset arrays:
+    [ts, rid, kind, payload] entry lists (decode_entries form), or —
+    with_values — the decode_apply_rows tuples carrying the located
+    values slice + uniform-update flag. None when the plane is absent
+    or refuses the bytes (callers fall back to the Python decoder)."""
+    from .. import native
+
+    if not native.available():
+        return None
+    try:
+        (n, ts, rid_off, rid_len, kind_off, kind_len, payload_off,
+         payload_len, _oo, values_off, values_len,
+         flags) = native.decode_ops(data)
+    except ValueError:
+        return None
+    out: list = []
+    kinds: dict = {}  # pages are uniform-kind: decode each kind once
+    for i in range(n):
+        kb = data[int(kind_off[i]):int(kind_off[i]) + int(kind_len[i])]
+        kind = kinds.get(kb)
+        if kind is None:
+            kind = kinds[kb] = kb.decode("utf-8")
+        ro, po = int(rid_off[i]), int(payload_off[i])
+        e_ts = int(ts[i])
+        rid = data[ro:ro + int(rid_len[i])]
+        payload = data[po:po + int(payload_len[i])]
+        if with_values:
+            f = int(flags[i])
+            vo, vl = int(values_off[i]), int(values_len[i])
+            out.append((e_ts, rid, kind, payload,
+                        data[vo:vo + vl] if f & 1 else None,
+                        bool(f & 2)))
+        else:
+            out.append([e_ts, rid, kind, payload])
+    return out
+
+
+def decode_apply_rows(data: bytes) -> List[tuple]:
+    """Blob bytes → (ts, rid_packed, kind, payload, values_packed,
+    update) rows for the batched fresh-peer apply.
+
+    `values_packed` is the payload's packed `values` map located WITHOUT
+    decoding the payload's outer dict — via the native decoder's offset
+    arrays, or the same fragment arithmetic in Python (the payloads were
+    built by concatenating those very fragments). Entries whose payload
+    is not a uniform bulk shape get values_packed=None, which routes the
+    caller to its per-op fallback."""
+    rows = _decode_native(data, with_values=True)
+    if rows is not None:
+        return rows
+    return [_apply_row_py(e) for e in decode_entries_py(data)]
+
+
+_OPID_AT = len(BULK_HDR5)
+_RID_AT = _OPID_AT + len(BULK_OPID)
+_VALUES_AT = _RID_AT + 16
+_VALUES_END = _VALUES_AT + len(BULK_VALUES)
+
+
+def _apply_row_py(entry) -> tuple:
+    """One decoded entry → decode_apply_rows tuple (Python fallback;
+    the same fragment checks as the native uniform-shape probe, and
+    the same outputs: the update flag is set only when the FULL
+    uniform probe succeeds, matching the native flags bit1)."""
+    ts, rid, kind, payload = entry
+    hdr6 = payload.startswith(BULK_HDR6)
+    values: Optional[bytes] = None
+    if (hdr6 or payload.startswith(BULK_HDR5)) and \
+            payload[_OPID_AT:_RID_AT] == BULK_OPID and \
+            payload[_VALUES_AT:_VALUES_END] == BULK_VALUES:
+        if hdr6:
+            if payload.endswith(BULK_UPDATE_T):
+                values = payload[_VALUES_END:-len(BULK_UPDATE_T)] or None
+        else:
+            values = payload[_VALUES_END:] or None
+    return (ts, rid, kind, payload, values,
+            hdr6 and values is not None)
 
 
 def encode_uniform(timestamps: Sequence[int], record_ids: Sequence[bytes],

@@ -31,6 +31,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) — benchmark-scale "
+        "cases like the full-library clone")
+
+
 @pytest.fixture
 def cpu_devices():
     return jax.devices("cpu")

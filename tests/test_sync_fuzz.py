@@ -239,6 +239,127 @@ def test_three_node_blob_relay_convergence(tmp_path):
 
 
 @pytest.mark.parametrize("seed", _SEEDS)
+def test_clone_during_churn_convergence(tmp_path, seed):
+    """A fresh peer joins MID-write-storm: blob pages are still being
+    appended while the clone stream drains, the fresh peer makes local
+    writes mid-clone (flipping the batched apply across the
+    pass-through ↔ per-op fallback boundary), and the origin finally
+    pairs back (register_instance → solo off → its blobs explode on
+    first ingest). Everything must converge byte-identically — domain
+    tables AND logical op streams — against a per-op control replica.
+
+    In-process managers rather than the TCP plane (this runtime lacks
+    `cryptography`); the streams exercised are exactly the ones the
+    wire carries."""
+    from conftest import drain_sync as drain
+    from conftest import make_sync_manager
+
+    from spacedrive_tpu.sync.manager import BLOB_MIN_OPS, GetOpsArgs
+
+    rng = random.Random(seed)
+    a = make_sync_manager(tmp_path, "storm-origin")
+    b = make_sync_manager(tmp_path, "fresh-peer")
+
+    def blob_wave(mgr, n, note):
+        pubs = [os.urandom(16) for _ in range(n)]
+        with mgr.db.tx() as conn:
+            mgr.bulk_shared_ops(conn, "object", [
+                (p, "c", None, None, {"kind": 5, "note": note})
+                for p in pubs])
+            conn.executemany(
+                "INSERT INTO object (pub_id, kind, note) "
+                "VALUES (?, 5, ?)", [(p, note) for p in pubs])
+        return pubs
+
+    def local_tag(mgr, name):
+        pub = os.urandom(16)
+        ops = mgr.shared_create("tag", pub, {"name": name})
+        with mgr.write_ops(ops) as conn:
+            mgr.db.insert("tag", {"pub_id": pub, "name": name},
+                          conn=conn)
+
+    # two blob pages land before the peer exists
+    wave1a = blob_wave(a, BLOB_MIN_OPS + rng.randrange(32), "w1a")
+    wave1b = blob_wave(a, BLOB_MIN_OPS, "w1b")
+    b.register_instance(a.instance)
+
+    fast_pages = fallback_pages = 0
+    stream = a.iter_clone_stream([(b.instance, 0)])
+    consumed = 0
+    for kind, item in stream:
+        if kind == "ops":
+            _n, errs = b.receive_crdt_operations(item)
+            assert not errs, errs[:3]
+        else:
+            _n, errs, fast = b.receive_blob_pages([item])
+            assert not errs, errs[:3]
+            fast_pages += 1 if fast else 0
+            fallback_pages += 0 if fast else 1
+        consumed += 1
+        if consumed == 1:
+            # mid-clone: the storm continues on the origin (still
+            # solo — the peer pulls without being registered there)...
+            blob_wave(a, BLOB_MIN_OPS, "w2-mid-clone")
+            # ...and the fresh peer writes locally. Its op-log
+            # high-water is now NEWER than the second in-flight page
+            # (its clock absorbed page 1's max_ts, so the local op
+            # outstamps everything wave 1 minted) → the batched apply
+            # must cross to the per-op fallback and still converge.
+            local_tag(b, "mid-clone-local")
+
+    assert fast_pages >= 1, "pass-through never engaged"
+    assert fallback_pages >= 1, \
+        "fallback boundary never crossed mid-clone"
+    # wave 2 lands as a NEW stream attempt or the per-op tail — either
+    # way the peer has history now, so pass-through must refuse
+    assert list(a.iter_clone_stream(list(b.timestamps.items()))) == []
+    drain(a, b)
+
+    # the origin pairs back and ingests the peer's local writes: its
+    # remaining blobs explode to rows on first ingest
+    a.register_instance(b.instance)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] >= 1
+    drain(b, a)
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+    # post-pair churn in both directions, row-format now
+    local_tag(a, "post-pair-a")
+    wave3 = blob_wave(a, BLOB_MIN_OPS, "w3-post-pair")  # rows: not solo
+    assert a.db.query_one(
+        "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 0
+    local_tag(b, "post-pair-b")
+    for _ in range(3):  # drain to quiescence both ways
+        drain(a, b)
+        drain(b, a)
+
+    # per-op control replica pulled from the origin's (now exploded)
+    # log must match both storm participants byte-for-byte
+    c = make_sync_manager(tmp_path, "control")
+    c.register_instance(a.instance)
+    drain(a, c)
+
+    def domain(mgr):
+        objs = sorted((r["pub_id"].hex(), r["kind"], r["note"])
+                      for r in mgr.db.query(
+                          "SELECT pub_id, kind, note FROM object"))
+        tags = sorted((r["pub_id"].hex(), r["name"]) for r in
+                      mgr.db.query("SELECT pub_id, name FROM tag"))
+        return objs, tags
+
+    def log(mgr):
+        ops = mgr.get_ops(GetOpsArgs(clocks=[], count=1_000_000))
+        return sorted((o.timestamp, o.instance, o.id, o.typ.kind,
+                       repr(o.typ.record_id)) for o in ops)
+
+    assert domain(a) == domain(b) == domain(c)
+    assert log(a) == log(b) == log(c)
+    n_objects = len(domain(a)[0])
+    assert n_objects == (len(wave1a) + len(wave1b) + BLOB_MIN_OPS
+                         + len(wave3))
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
 def test_three_node_adversarial_convergence(tmp_path, seed):
     rng = random.Random(seed)
     nodes = [Node(str(tmp_path / n)) for n in "abc"]

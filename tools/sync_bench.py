@@ -10,6 +10,7 @@ Prints one JSON line: {"metric": "sync_ingest_ops_per_sec", ...}.
 
 Usage: python tools/sync_bench.py [n_ops]
        python tools/sync_bench.py --encode [n_ops]
+       python tools/sync_bench.py --full-clone [n_files] [--json out.json]
 
 --encode runs the op-log ENCODE+WRITE micro-benchmark instead: the
 same identifier-shaped op specs appended through (a) the per-op row
@@ -18,6 +19,19 @@ C++ plane is built, Python fragment fallback otherwise), plus the
 pure encode cost of both encoders — the before/after artifact for the
 blob op-log work, so the row-vs-blob claim never rests on a README
 anecdote.
+
+--full-clone is the READ/APPLY-side artifact for the clone fast path:
+it generates an identifier-shaped library (~2 ops per "file": an
+object-create page + a file_path-link page per 4096-file chunk, all
+page-level blobs, plus a sprinkle of row-format tag ops so the
+interleave path runs), then syncs it to TWO fresh peers in the SAME
+run — once through the per-op get_ops/receive_crdt_operations pull
+loop, once through the blob pass-through + batched-apply stream — and
+asserts byte-identical domain tables before reporting ops/s for both,
+pages relayed vs rows exploded, and the speedup. Over real TCP (node
+pairing) when the p2p plane's `cryptography` dependency exists;
+otherwise the same paged streams run in-process and the artifact says
+so (`transport`). --json writes the BENCH_r*-style artifact.
 """
 
 from __future__ import annotations
@@ -30,8 +44,6 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from spacedrive_tpu.node import Node  # noqa: E402
 
 
 def build_backlog(lib, n_ops: int) -> int:
@@ -56,6 +68,8 @@ def build_backlog(lib, n_ops: int) -> int:
 
 
 async def main(n_ops: int) -> None:
+    from spacedrive_tpu.node import Node
+
     tmp = tempfile.mkdtemp(prefix="sync-bench-")
     a = Node(os.path.join(tmp, "a"))
     b = Node(os.path.join(tmp, "b"))
@@ -104,24 +118,12 @@ async def main(n_ops: int) -> None:
 
 def encode_bench(n_ops: int) -> None:
     """Row-format vs blob-format op-log append, same spec stream."""
-    import uuid
-
     from spacedrive_tpu import native
-    from spacedrive_tpu.store.db import Database
     from spacedrive_tpu.sync import opblob
     from spacedrive_tpu.sync.crdt import pack_value, uuid4_bytes_batch
-    from spacedrive_tpu.sync.manager import SyncManager
 
     tmp = tempfile.mkdtemp(prefix="sync-encode-bench-")
-
-    def mk(name: str) -> SyncManager:
-        db = Database(os.path.join(tmp, name))
-        pub = uuid.uuid4().bytes
-        db.insert("instance", {
-            "pub_id": pub, "identity": b"", "node_id": b"",
-            "node_name": "bench", "node_platform": 0,
-            "last_seen": 0, "date_created": 0})
-        return SyncManager(db, pub)
+    mk = lambda name: _mk_solo(tmp, name)  # noqa: E731
 
     # The identifier's link shape: one multi-field update per file.
     chunk = 4096
@@ -131,7 +133,7 @@ def encode_bench(n_ops: int) -> None:
              for p in pubs]
     n_chunks = max(1, n_ops // chunk)
 
-    def run(mgr: SyncManager, solo: bool) -> float:
+    def run(mgr, solo: bool) -> float:
         mgr._solo = solo  # False forces the per-op row format
         t0 = time.perf_counter()
         for _ in range(n_chunks):
@@ -139,8 +141,8 @@ def encode_bench(n_ops: int) -> None:
                 mgr.bulk_shared_ops(conn, "file_path", specs)
         return n_chunks * chunk / (time.perf_counter() - t0)
 
-    rows_ops_s = run(mk("rows.db"), solo=False)
-    blob_ops_s = run(mk("blob.db"), solo=True)
+    rows_ops_s = run(mk("rows"), solo=False)
+    blob_ops_s = run(mk("blob"), solo=True)
 
     # Pure encode cost, native vs Python fallback (byte-identical).
     stamps = list(range(1 << 61, (1 << 61) + chunk))
@@ -175,10 +177,269 @@ def encode_bench(n_ops: int) -> None:
     }))
 
 
-if __name__ == "__main__":
-    args = [a for a in sys.argv[1:] if a != "--encode"]
-    n = int(args[0]) if args else 120_000
-    if "--encode" in sys.argv[1:]:
-        encode_bench(n)
+def _mk_solo(tmp: str, name: str):
+    """SyncManager over a fresh library DB with only its own instance
+    row — the solo configuration blob writers target."""
+    import uuid
+
+    from spacedrive_tpu.store.db import Database
+    from spacedrive_tpu.sync.manager import SyncManager
+
+    db = Database(os.path.join(tmp, f"{name}.db"))
+    pub = uuid.uuid4().bytes
+    db.insert("instance", {
+        "pub_id": pub, "identity": b"", "node_id": b"",
+        "node_name": name, "node_platform": 0,
+        "last_seen": 0, "date_created": 0})
+    return SyncManager(db, pub)
+
+
+def build_clone_library(sync, n_files: int, chunk: int = 4096) -> int:
+    """Identifier-shaped solo history: per chunk, one object-create
+    blob page + one file_path-link blob page + domain rows, plus one
+    row-format tag op per chunk (write_ops) so the clone stream's
+    ops/page interleave path runs. Returns total ops written."""
+    total = 0
+    done = 0
+    while done < n_files:
+        b = min(chunk, n_files - done)
+        opubs = [os.urandom(16) for _ in range(b)]
+        fpubs = [os.urandom(16) for _ in range(b)]
+        tag_pub = os.urandom(16)
+        ops = sync.shared_create("tag", tag_pub, {"name": f"t{done}"})
+        with sync.write_ops(ops) as conn:
+            sync.db.insert("tag", {"pub_id": tag_pub,
+                                   "name": f"t{done}"}, conn=conn)
+        total += 1
+        cas_ids = [os.urandom(8).hex() for _ in range(b)]
+        with sync.db.tx() as conn:
+            total += sync.bulk_shared_ops(conn, "object", [
+                (p, "c", None, None, {"kind": 5, "date_created": done + i})
+                for i, p in enumerate(opubs)])
+            conn.executemany(
+                "INSERT INTO object (pub_id, kind, date_created) "
+                "VALUES (?, ?, ?)",
+                [(p, 5, done + i) for i, p in enumerate(opubs)])
+            total += sync.bulk_shared_ops(conn, "file_path", [
+                (fp, "u:cas_id+object_id", None, None,
+                 {"cas_id": c, "object_id": op})
+                for fp, op, c in zip(fpubs, opubs, cas_ids)])
+            conn.executemany(
+                "INSERT INTO file_path (pub_id, name) VALUES (?, ?)",
+                [(fp, f"f{done + i}") for i, fp in enumerate(fpubs)])
+            conn.executemany(
+                "UPDATE file_path SET cas_id = ?, object_id = "
+                "(SELECT id FROM object WHERE pub_id = ?) "
+                "WHERE pub_id = ?", list(zip(cas_ids, opubs, fpubs)))
+        done += b
+    return total
+
+
+def _domain_digest(mgr) -> str:
+    """Order-independent digest of the synced domain tables, FK edges
+    resolved back to pub ids (local row ids legitimately differ)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for row in sorted(
+        (r["pub_id"].hex(), r["kind"], r["date_created"], r["note"])
+        for r in mgr.db.query(
+            "SELECT pub_id, kind, date_created, note FROM object")):
+        h.update(repr(row).encode())
+    for row in sorted(
+        (r["pub_id"].hex(), r["cas_id"],
+         r["opub"].hex() if r["opub"] else None)
+        for r in mgr.db.query(
+            "SELECT fp.pub_id, fp.cas_id, o.pub_id AS opub "
+            "FROM file_path fp LEFT JOIN object o "
+            "ON o.id = fp.object_id")):
+        h.update(repr(row).encode())
+    for row in sorted((r["pub_id"].hex(), r["name"]) for r in
+                      mgr.db.query("SELECT pub_id, name FROM tag")):
+        h.update(repr(row).encode())
+    return h.hexdigest()
+
+
+def _drain_per_op(src, dst) -> int:
+    """The pre-fast-path pull loop: paged get_ops → per-op batched
+    ingest (the same-run comparator)."""
+    from spacedrive_tpu.sync.manager import GetOpsArgs
+
+    applied = 0
+    while True:
+        clocks = dict(dst.timestamps)
+        clocks[dst.instance] = max(dst.clock.last,
+                                   clocks.get(dst.instance, 0))
+        page = src.get_ops(GetOpsArgs(clocks=list(clocks.items()),
+                                      count=1000))
+        page = [op for op in page if op.instance != dst.instance]
+        if not page:
+            return applied
+        n, errs = dst.receive_crdt_operations(page)
+        assert not errs, errs[:3]
+        applied += n
+
+
+def _drain_clone(src, dst) -> dict:
+    """The clone fast path, in-process: blob pass-through stream +
+    batched fresh-peer apply, then the per-op row tail."""
+    applied = pages = fallback = ops_frames = 0
+    clocks = [(dst.instance, max(dst.clock.last, 0))]
+    for kind, item in src.iter_clone_stream(clocks):
+        if kind == "ops":
+            n, errs = dst.receive_crdt_operations(item)
+            assert not errs, errs[:3]
+            applied += n
+            ops_frames += 1
+        else:
+            n, errs, fast = dst.receive_blob_pages([item])
+            assert not errs, errs[:3]
+            applied += n
+            pages += 1 if fast else 0
+            fallback += 0 if fast else 1
+    applied += _drain_per_op(src, dst)
+    return {"applied": applied, "fast_pages": pages,
+            "fallback_pages": fallback, "ops_frames": ops_frames}
+
+
+async def _full_clone_tcp(tmp: str, n_files: int) -> dict:
+    """Real-TCP variant: node A holds the library, two fresh nodes pull
+    it through pairing — B with pass-through on, C with it forced off
+    (the same-run per-op comparator)."""
+    from spacedrive_tpu.node import Node
+
+    a = Node(os.path.join(tmp, "a"))
+    await a.start()
+    lib_a = a.create_library("clone-bench")
+    total = build_clone_library(lib_a.sync, n_files)
+    await a.start_p2p(host="127.0.0.1", enable_discovery=False)
+
+    async def pull_into(name: str, passthrough: bool) -> dict:
+        node = Node(os.path.join(tmp, name))
+        await node.start()
+        port = await node.start_p2p(host="127.0.0.1",
+                                    enable_discovery=False)
+        node.p2p.on_pairing_request = lambda peer, info: True
+        os.environ["SDTPU_CLONE_PASSTHROUGH"] = \
+            "on" if passthrough else "off"
+        t0 = time.perf_counter()
+        assert await a.p2p.pair("127.0.0.1", port, lib_a)
+        lib = node.libraries.list()[0]
+
+        def count() -> int:
+            return lib.db.query_one(
+                "SELECT (SELECT COUNT(*) FROM shared_operation) + "
+                "(SELECT COUNT(*) FROM relation_operation) AS n")["n"]
+
+        last = -1
+        while True:
+            await asyncio.sleep(0.25)
+            n = count()
+            if n >= total:
+                break
+            if n == last:
+                a.p2p.networked.originate_soon(lib_a)
+            last = n
+        dt = time.perf_counter() - t0
+        digest = _domain_digest(lib.sync)
+        await node.shutdown()
+        return {"seconds": dt, "ops_per_sec": total / dt,
+                "digest": digest}
+
+    per_op = await pull_into("c", passthrough=False)
+    fast = await pull_into("b", passthrough=True)
+    os.environ.pop("SDTPU_CLONE_PASSTHROUGH", None)
+    origin_digest = _domain_digest(lib_a.sync)
+    await a.shutdown()
+    assert fast["digest"] == per_op["digest"] == origin_digest, \
+        "replicas diverged from origin"
+    return {"transport": "tcp", "ops": total,
+            "per_op": per_op, "fast": fast}
+
+
+def _full_clone_inproc(tmp: str, n_files: int) -> dict:
+    """In-process variant (no `cryptography` in the runtime): the same
+    paged streams the wire carries, minus the socket."""
+    origin = _mk_solo(tmp, "origin")
+    total = build_clone_library(origin, n_files)
+
+    per_op_mgr = _mk_solo(tmp, "per_op")
+    per_op_mgr.register_instance(origin.instance)
+    t0 = time.perf_counter()
+    applied = _drain_per_op(origin, per_op_mgr)
+    per_op_dt = time.perf_counter() - t0
+    assert applied == total, (applied, total)
+
+    fast_mgr = _mk_solo(tmp, "fast")
+    fast_mgr.register_instance(origin.instance)
+    t0 = time.perf_counter()
+    stats = _drain_clone(origin, fast_mgr)
+    fast_dt = time.perf_counter() - t0
+    assert stats["applied"] == total, (stats, total)
+
+    d_fast, d_slow, d_origin = (_domain_digest(fast_mgr),
+                                _domain_digest(per_op_mgr),
+                                _domain_digest(origin))
+    assert d_fast == d_slow == d_origin, "replicas diverged from origin"
+    return {"transport": "inproc", "ops": total,
+            "per_op": {"seconds": per_op_dt,
+                       "ops_per_sec": total / per_op_dt},
+            "fast": {"seconds": fast_dt, "ops_per_sec": total / fast_dt,
+                     **{k: v for k, v in stats.items()
+                        if k != "applied"}}}
+
+
+def full_clone_bench(n_files: int, json_out: str = "") -> None:
+    from spacedrive_tpu import native
+
+    tmp = tempfile.mkdtemp(prefix="sync-clone-bench-")
+    try:
+        import cryptography  # noqa: F401 — p2p tunnel dependency
+        have_tcp = True
+    except ModuleNotFoundError:
+        have_tcp = False
+    if have_tcp:
+        result = asyncio.run(_full_clone_tcp(tmp, n_files))
     else:
-        asyncio.run(main(n))
+        result = _full_clone_inproc(tmp, n_files)
+    # rows the per-op comparator exploded on the origin's first ingest
+    # are gone by now; count from the blob metadata instead
+    out = {
+        "metric": "sync_full_clone_ops_per_sec",
+        "value": round(result["fast"]["ops_per_sec"], 1),
+        "unit": "ops/s",
+        "n_files": n_files,
+        "ops": result["ops"],
+        "transport": result["transport"],
+        "per_op_ops_per_sec": round(result["per_op"]["ops_per_sec"], 1),
+        "fast_vs_per_op": round(result["fast"]["ops_per_sec"]
+                                / result["per_op"]["ops_per_sec"], 2),
+        "fast_seconds": round(result["fast"]["seconds"], 2),
+        "per_op_seconds": round(result["per_op"]["seconds"], 2),
+        "pages_relayed": result["fast"].get("fast_pages"),
+        "pages_fallback": result["fast"].get("fallback_pages"),
+        "rows_exploded_per_op_path": result["ops"],
+        "native_decoder": native.available(),
+        "domain_tables_identical": True,
+    }
+    print(json.dumps(out))
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    json_out = ""
+    if "--json" in argv:
+        i = argv.index("--json")
+        json_out = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    flags = [a for a in argv if a.startswith("--")]
+    args = [a for a in argv if not a.startswith("--")]
+    if "--full-clone" in flags:
+        full_clone_bench(int(args[0]) if args else 100_000, json_out)
+    elif "--encode" in flags:
+        encode_bench(int(args[0]) if args else 120_000)
+    else:
+        asyncio.run(main(int(args[0]) if args else 120_000))
