@@ -17,6 +17,8 @@ import uuid as uuidlib
 from typing import Any, Dict, List, Optional
 
 from .. import backups as backups_mod
+from .. import telemetry
+from .. import tracing
 from ..jobs.report import JobStatus
 from ..library import Library
 from ..locations import manager as loc_manager
@@ -91,6 +93,35 @@ def _core(r: Router) -> None:
     @r.mutation("toggleFeatureFlag")
     def toggle_feature(node, input):
         return node.config.toggle_feature(str(input["feature"]))
+
+    @r.query("node.metrics")
+    def node_metrics(node, _input):
+        """The node-wide telemetry registry as one JSON-safe snapshot —
+        the rspc face of GET /metrics (same counters, same instant)."""
+        return telemetry.snapshot()
+
+    @r.query("node.spans")
+    def node_spans(node, input):
+        """Recent finished spans from the tracing ring buffer, newest
+        last; optional {limit, trace} filters."""
+        input = input or {}
+        return tracing.recent_spans(
+            limit=int(input.get("limit", 100)),
+            trace_id=input.get("trace"))
+
+    @r.subscription("node.telemetry")
+    def node_telemetry(node, _input, emit):
+        """Relay the TelemetryReporter's periodic TelemetrySnapshot
+        events (plus one immediately, so subscribers paint without
+        waiting an interval)."""
+        def on_event(e):
+            if e.get("type") == "TelemetrySnapshot":
+                emit(e)
+        unsub = node.events.subscribe(on_event)
+        # AFTER subscribing: emit fans out synchronously to the current
+        # subscriber list, so the other order would skip this client.
+        node.telemetry_reporter.emit_snapshot()
+        return unsub
 
 
 # -- library. (api/libraries.rs) -------------------------------------------

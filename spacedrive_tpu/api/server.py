@@ -28,22 +28,35 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
+from .. import telemetry
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
+from ..telemetry import API_REQUESTS
 from .router import Router, RpcError, mount_router
 
 RANGE_CHUNK = 1 << 20
+
+
+@web.middleware
+async def _count_requests(request: web.Request, handler):
+    """Per-route-template request counter (templates, not raw paths, so
+    label cardinality stays bounded; unmatched paths share one label)."""
+    resource = request.match_info.route.resource  # None for true 404s
+    API_REQUESTS.labels(
+        route=getattr(resource, "canonical", None) or "unmatched").inc()
+    return await handler(request)
 
 
 class ApiServer:
     def __init__(self, node, router: Optional[Router] = None):
         self.node = node
         self.router = router or mount_router(node)
-        self.app = web.Application()
+        self.app = web.Application(middlewares=[_count_requests])
         self.app.router.add_get("/", self._index)
         self.app.router.add_get("/static/{name}", self._static)
         self.app.router.add_get("/manifest.webmanifest", self._manifest)
         self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/rspc", self._rspc_ws)
         self.app.router.add_post("/rspc/{path}", self._rspc_http)
         self.app.router.add_get("/rspc/{path}", self._rspc_http)
@@ -74,6 +87,15 @@ class ApiServer:
 
     async def _health(self, _request: web.Request) -> web.Response:
         return web.Response(text="OK")
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        """Prometheus text exposition of the node-wide registry — the
+        operator-facing face of spacedrive_tpu/telemetry.py (scrape
+        this; the webui gets the same data as TelemetrySnapshot
+        events)."""
+        return web.Response(
+            body=telemetry.render_prometheus().encode("utf-8"),
+            headers={"Content-Type": telemetry.PROMETHEUS_CONTENT_TYPE})
 
     async def _index(self, _request: web.Request) -> web.Response:
         """Web explorer entry (apps/web equivalent; assets from

@@ -16,6 +16,13 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ..store import uuid_bytes as new_job_id
+from ..telemetry import (
+    JOBS_DUPLICATE_REJECTED,
+    JOBS_INGESTED,
+    JOBS_QUEUED,
+    JOBS_RESUMED,
+    JOBS_RUNNING,
+)
 from .job import JOB_REGISTRY, JobState, StatefulJob
 from .report import JobReport, JobStatus
 from .worker import Worker, WorkerCommand
@@ -85,7 +92,9 @@ class JobManager:
                      action: Optional[str] = None) -> bytes:
         h = job.hash()
         if h in self._hashes:
+            JOBS_DUPLICATE_REJECTED.inc()
             raise AlreadyRunning(f"{job.NAME} already running/queued")
+        JOBS_INGESTED.inc()
         next_jobs = list(next_jobs or [])
         # Persist a pre-init state blob so a job that dies while QUEUED
         # (or is shut down before starting) cold-resumes instead of
@@ -112,6 +121,7 @@ class JobManager:
             entry.report.status = JobStatus.QUEUED
             entry.report.update(entry.library.db)
             self.queue.append(entry)
+            JOBS_QUEUED.set(len(self.queue))
 
     def _start(self, entry: _Entry) -> None:
         worker = Worker(
@@ -120,6 +130,7 @@ class JobManager:
             resume_state=entry.resume_state,
         )
         self.running[entry.report.id] = worker
+        JOBS_RUNNING.set(len(self.running))
         task = asyncio.ensure_future(worker.run())
         self._tasks[entry.report.id] = task
         task.add_done_callback(
@@ -169,6 +180,8 @@ class JobManager:
         while (self.queue and len(self.running) < self.max_workers
                and not self._shutting_down):
             self._start(self.queue.popleft())
+        JOBS_RUNNING.set(len(self.running))
+        JOBS_QUEUED.set(len(self.queue))
 
     # -- control ----------------------------------------------------------
 
@@ -191,6 +204,7 @@ class JobManager:
         if report.status != JobStatus.PAUSED or not report.data:
             raise JobManagerError("job is not resumable")
         live_job = paused_entry.job if paused_entry is not None else None
+        JOBS_RESUMED.inc()
         self._admit_from_state(library, report, live_job=live_job)
 
     def _admit_from_state(self, library: Any, report: JobReport,
@@ -299,5 +313,6 @@ class JobManager:
             if job.hash() in self._hashes:
                 continue
             self._admit_from_state(library, report)
+            JOBS_RESUMED.inc()
             resumed.append(report.id)
         return resumed

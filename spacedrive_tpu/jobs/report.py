@@ -15,6 +15,12 @@ from typing import Any, Dict, Optional
 import msgpack
 
 from ..store import Database
+from ..telemetry import (
+    JOBS_COMPLETED,
+    JOBS_ITEMS_PER_SEC,
+    JOBS_ITEMS_PROCESSED,
+    JOB_DURATION_SECONDS,
+)
 
 # Record separator for the errors_text TEXT column: tracebacks contain
 # blank lines, so a plain "\n\n" join would split one error into many.
@@ -56,6 +62,24 @@ class JobReport:
     date_started: Optional[int] = None
     date_completed: Optional[int] = None
     date_estimated_completion: Optional[int] = None
+
+    # -- telemetry --------------------------------------------------------
+
+    def record_metrics(self, duration_s: Optional[float] = None) -> None:
+        """Publish this report's terminal facts to the node registry:
+        completion counters by status, run duration, items processed
+        and the derived items/s of the finished run. Called once per
+        worker run from _emit_final (paused runs count too — their
+        status label says so)."""
+        JOBS_COMPLETED.labels(status=self.status.name.lower()).inc()
+        if duration_s is not None and duration_s >= 0:
+            JOB_DURATION_SECONDS.labels(name=self.name).observe(duration_s)
+            if self.completed_task_count and duration_s > 0:
+                JOBS_ITEMS_PER_SEC.labels(name=self.name).set(
+                    self.completed_task_count / duration_s)
+        if self.completed_task_count:
+            JOBS_ITEMS_PROCESSED.labels(name=self.name).inc(
+                self.completed_task_count)
 
     # -- persistence ------------------------------------------------------
 

@@ -20,6 +20,8 @@ import traceback
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..telemetry import JOBS_EARLY_FINISH, JOBS_STEP_ERRORS, JOB_STEP_SECONDS
+from ..tracing import span as trace_span
 from .job import (
     EarlyFinish,
     JobContext,
@@ -101,25 +103,34 @@ class Worker:
     # -- driver -----------------------------------------------------------
 
     async def run(self) -> JobStatus:
-        try:
-            status = await self._run_inner()
-        except asyncio.CancelledError:
-            status = await self._persist_paused_or_fail("worker task cancelled")
-        except Exception as e:  # noqa: BLE001 — job-level catch-all
-            await self._cleanup_quietly(None)
-            self.report.status = JobStatus.FAILED
-            self.report.errors_text.append(
-                "".join(traceback.format_exception(e)).strip()
-            )
-            self.report.date_completed = int(time.time())
-            self.report.data = None
-            self.report.update(self.library.db)
-        else:
-            self.report.status = status
+        # Root span of this run's trace: every job.step span (and any
+        # span opened inside step bodies — contextvars survive
+        # ensure_future and asyncio.to_thread) nests under it.
+        with trace_span(f"job/{self.report.name}",
+                        job_id=self.report.id.hex()):
+            try:
+                status = await self._run_inner()
+            except asyncio.CancelledError:
+                status = await self._persist_paused_or_fail(
+                    "worker task cancelled")
+            except Exception as e:  # noqa: BLE001 — job-level catch-all
+                await self._cleanup_quietly(None)
+                self.report.status = JobStatus.FAILED
+                self.report.errors_text.append(
+                    "".join(traceback.format_exception(e)).strip()
+                )
+                self.report.date_completed = int(time.time())
+                self.report.data = None
+                self.report.update(self.library.db)
+            else:
+                self.report.status = status
         self._emit_final()
         return self.report.status
 
     def _emit_final(self) -> None:
+        self.report.record_metrics(
+            duration_s=(time.monotonic() - self._started_at)
+            if self._started_at else None)
         self.on_event({
             "type": "JobUpdate",
             "id": self.report.id.hex(),
@@ -148,6 +159,7 @@ class Worker:
             try:
                 data, steps = await self.job.init(ctx)
             except EarlyFinish:
+                JOBS_EARLY_FINISH.inc()
                 r.status = JobStatus.COMPLETED
                 r.data = None  # clear the at-ingest state blob
                 r.date_completed = int(time.time())
@@ -175,9 +187,8 @@ class Worker:
             if cmd in (WorkerCommand.PAUSE, WorkerCommand.SHUTDOWN):
                 return await self._persist_paused(state, errors)
 
-            step = state.steps[0]
             step_task = asyncio.ensure_future(
-                self.job.execute_step(ctx, state.data, step, state.step_number)
+                self._spanned_step(ctx, state)
             )
             cmd_task = asyncio.ensure_future(self.commands.get())
             await asyncio.wait(
@@ -212,6 +223,7 @@ class Worker:
             except JobError:
                 raise
             except Exception as e:  # noqa: BLE001 — non-fatal step error
+                JOBS_STEP_ERRORS.inc()
                 errors.append(
                     f"step {state.step_number}: "
                     + "".join(traceback.format_exception(e)).strip()
@@ -220,6 +232,7 @@ class Worker:
             if isinstance(outcome, StepOutcome):
                 state.steps.extend(outcome.more_steps)
                 r.task_count += len(outcome.more_steps)
+                JOBS_STEP_ERRORS.inc(len(outcome.errors))
                 errors.extend(outcome.errors)
                 for k, v in outcome.metadata.items():
                     state.run_metadata[k] = v
@@ -262,6 +275,20 @@ class Worker:
         )
         r.update(self.library.db)
         return r.status
+
+    async def _spanned_step(self, ctx: JobContext, state: JobState):
+        """One step under a child span of the job's root trace (plus the
+        per-step latency histogram). Reads the step from the deque head
+        so the interrupted-step push-back contract is untouched."""
+        t0 = time.perf_counter()
+        try:
+            with trace_span("job.step", job=self.report.name,
+                            step=state.step_number):
+                return await self.job.execute_step(
+                    ctx, state.data, state.steps[0], state.step_number)
+        finally:
+            JOB_STEP_SECONDS.labels(name=self.report.name).observe(
+                time.perf_counter() - t0)
 
     def _drain_commands(self) -> Optional[str]:
         """Pop the latest pending command (latest wins: a RESUME sent after

@@ -16,6 +16,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
+from . import telemetry
 from .jobs.manager import JobManager
 from .library import Libraries, Library
 from .store.db import uuid_bytes
@@ -155,6 +156,49 @@ class OrphanRemover:
             self._task = None
 
 
+class TelemetryReporter:
+    """Periodic TelemetrySnapshot events on the node event bus: the
+    webui's (and any subscriber's) push-based view of the metrics
+    registry — the same snapshot `node.metrics` serves on demand.
+    Interval from SDTPU_TELEMETRY_INTERVAL seconds (default 15); the
+    loop skips emission entirely while telemetry is disabled."""
+
+    DEFAULT_INTERVAL_S = 15.0
+
+    def __init__(self, events: EventBus,
+                 interval_s: Optional[float] = None):
+        self.events = events
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get("SDTPU_TELEMETRY_INTERVAL", ""))
+            except ValueError:
+                interval_s = self.DEFAULT_INTERVAL_S
+        self.interval_s = max(0.05, interval_s)
+        self._task: Optional[asyncio.Task] = None
+
+    def emit_snapshot(self) -> None:
+        self.events.emit({
+            "type": "TelemetrySnapshot",
+            "ts": time.time(),
+            "metrics": telemetry.snapshot(),
+        })
+
+    def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.interval_s)
+                if telemetry.enabled():
+                    self.emit_snapshot()
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
 class Node:
     def __init__(self, data_dir: str):
         self.data_dir = os.path.abspath(data_dir)
@@ -167,6 +211,7 @@ class Node:
             services={"data_dir": self.data_dir, "node": self},
         )
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
+        self.telemetry_reporter = TelemetryReporter(self.events)
         self.p2p = None  # created by start_p2p (P2PManager)
         # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
         # bootstrap (cache version migration runs here), loop starts with
@@ -187,6 +232,10 @@ class Node:
         actors."""
         self._started = True
         self.thumbnailer.start()
+        try:
+            self.telemetry_reporter.start()
+        except RuntimeError:
+            pass  # no running loop (sync tests); node.metrics still works
         self.libraries.init()
         # Dev seed (util/debug_initializer.rs): data-dir init.json.
         # BEFORE cold_resume so reset_on_startup never deletes a library
@@ -239,6 +288,7 @@ class Node:
     async def shutdown(self) -> None:
         """Node::shutdown (lib.rs:205): pause jobs, stop actors."""
         await self.jobs.shutdown()
+        self.telemetry_reporter.stop()
         await self.thumbnailer.stop()
         if self.p2p is not None:
             await self.p2p.stop()

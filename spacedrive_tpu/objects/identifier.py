@@ -32,6 +32,7 @@ from ..locations.file_path_helper import materialized_like, sub_path_children_ma
 from ..locations.paths import IsolatedPath
 from ..ops import staging
 from ..ops.staging import cas_ids_for_files
+from ..telemetry import IDENT_FILES, IDENT_PHASE_SECONDS
 
 CHUNK_SIZE = 100  # file_identifier/mod.rs:36
 
@@ -270,6 +271,15 @@ def identify_chunk(library, location_id: int, location_path: str,
             cas_map[c] = (oid_of[opub], opub)
         if not own_tx and batch is not None:
             batch.cas_added.extend(by_cas)
+    if own_tx:
+        # Standalone callers (watcher shallow-identify) count here; the
+        # job path counts once per commit group in _step instead.
+        if linked:
+            IDENT_FILES.labels(outcome="linked").inc(linked)
+        if created:
+            IDENT_FILES.labels(outcome="created").inc(created)
+        if read_errors:
+            IDENT_FILES.labels(outcome="skipped").inc(len(read_errors))
     if n_ops:
         if own_tx:
             sync._notify_created()
@@ -543,6 +553,11 @@ class FileIdentifierJob(StatefulJob):
     def _step(self, ctx: JobContext, data: Dict[str, Any]) -> StepOutcome:
         tf = time.perf_counter()
         timings = data.setdefault("phase_s", {})
+        # Registry mirror of the phase split: `timings` accumulates for
+        # the job report; the per-step DELTA lands on the node-wide
+        # phase counters so /metrics shows live attribution mid-run
+        # (and perf_smoke --telemetry sources its split from here).
+        phase_before = dict(timings)
         from ..ops.staging import _pool
 
         # Phase 1 — collect the whole commit group OUTSIDE any
@@ -615,6 +630,16 @@ class FileIdentifierJob(StatefulJob):
         data["cursor"] = cursor
         timings["step_total"] = (timings.get("step_total", 0.0)
                                  + time.perf_counter() - tf)
+        for phase, total in timings.items():
+            delta = total - phase_before.get(phase, 0.0)
+            if delta > 0:
+                IDENT_PHASE_SECONDS.labels(phase=phase).inc(delta)
+        if linked:
+            IDENT_FILES.labels(outcome="linked").inc(linked)
+        if created:
+            IDENT_FILES.labels(outcome="created").inc(created)
+        if errors:
+            IDENT_FILES.labels(outcome="skipped").inc(len(errors))
         data["linked"] += linked
         data["created"] += created
         data["skipped"] += len(errors)

@@ -417,6 +417,13 @@ def cas_ids_for_files(
 
     The identifier job's per-chunk kernel: stage + batch hash + format.
     """
+    from ..telemetry import (
+        IDENT_BATCHES,
+        IDENT_BATCH_FILES,
+        IDENT_BYTES_HASHED,
+        IDENT_DEVICE_FALLBACK,
+        IDENT_READ_ERRORS,
+    )
     from ..tracing import device_span
 
     if backend == "auto":
@@ -428,9 +435,20 @@ def cas_ids_for_files(
             # their own call via default_backend directly).
             from .. import native as _native
             backend = "native" if _native.available() else "numpy"
+            IDENT_DEVICE_FALLBACK.inc()
+    IDENT_BATCHES.labels(backend=backend).inc()
+    IDENT_BATCH_FILES.observe(len(files))
+    # Payload-byte accounting (what the hashers actually consume): one
+    # pass over the size list, ~ns/file against a ms/file pipeline.
+    IDENT_BYTES_HASHED.inc(sum(
+        cas.LARGE_PAYLOAD_SIZE if s > cas.MINIMUM_FILE_SIZE else s
+        for _, s in files))
     if backend == "native":
         with device_span("cas_ids/native", batch=len(files)):
-            return _cas_ids_native_fused(files)
+            ids, errors = _cas_ids_native_fused(files)
+        if errors:
+            IDENT_READ_ERRORS.inc(len(errors))
+        return ids, errors
     # Staging (the file reads) belongs INSIDE the span on every backend
     # so cross-backend span timings stay comparable.
     with device_span(f"cas_ids/{backend}", batch=len(files)):
@@ -441,4 +459,6 @@ def cas_ids_for_files(
         ids[idx] = None  # "We can't do shit with empty files" (mod.rs:86)
     for idx in errors:
         ids.pop(idx, None)
+    if errors:
+        IDENT_READ_ERRORS.inc(len(errors))
     return ids, errors

@@ -28,6 +28,11 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
+from ..telemetry import (
+    P2P_TUNNEL_BYTES_RECV,
+    P2P_TUNNEL_BYTES_SENT,
+    P2P_TUNNELS_OPENED,
+)
 from .identity import Identity, RemoteIdentity
 
 MAX_FRAME = 64 * 1024 * 1024  # sanity cap
@@ -70,20 +75,29 @@ class Tunnel:
         self._recv = ChaCha20Poly1305(recv_key)
         self._send_ctr = 0
         self._recv_ctr = 0
+        P2P_TUNNELS_OPENED.inc()
 
     @staticmethod
     def _nonce(counter: int) -> bytes:
         return counter.to_bytes(12, "big")
 
-    async def send(self, msg: Any) -> None:
-        plain = msgpack.packb(msg, use_bin_type=True)
+    def _seal(self, plain: bytes) -> bytes:
+        """Encrypt + frame + count: every outbound path goes through
+        here so the tunnel byte counters see ciphertext (what actually
+        crosses the wire, 4-byte length header excluded)."""
         sealed = self._send.encrypt(self._nonce(self._send_ctr), plain, None)
         self._send_ctr += 1
+        P2P_TUNNEL_BYTES_SENT.inc(len(sealed))
         write_frame(self.writer, sealed)
+        return sealed
+
+    async def send(self, msg: Any) -> None:
+        self._seal(msgpack.packb(msg, use_bin_type=True))
         await self.writer.drain()
 
     async def recv(self) -> Any:
         sealed = await read_frame(self.reader)
+        P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
         return msgpack.unpackb(plain, raw=False, strict_map_key=False)
@@ -95,23 +109,19 @@ class Tunnel:
         awaits drain() once, instead of a per-frame drain round-trip.
         Counter-nonce ordering is unaffected: frames are sealed in call
         order on the single writer."""
-        plain = msgpack.packb(msg, use_bin_type=True)
-        sealed = self._send.encrypt(self._nonce(self._send_ctr), plain, None)
-        self._send_ctr += 1
-        write_frame(self.writer, sealed)
+        self._seal(msgpack.packb(msg, use_bin_type=True))
 
     async def drain(self) -> None:
         """Flush frames queued by send_nowait to the socket."""
         await self.writer.drain()
 
     async def send_raw(self, data: bytes) -> None:
-        sealed = self._send.encrypt(self._nonce(self._send_ctr), data, None)
-        self._send_ctr += 1
-        write_frame(self.writer, sealed)
+        self._seal(data)
         await self.writer.drain()
 
     async def recv_raw(self) -> bytes:
         sealed = await read_frame(self.reader)
+        P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
         return plain

@@ -26,6 +26,13 @@ from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..sync.manager import GetOpsArgs
 from ..sync.crdt import CRDTOperation
+from ..telemetry import (
+    P2P_RECONNECTS,
+    P2P_ROUTE_CACHE_HITS,
+    P2P_ROUTE_CACHE_MISSES,
+    SYNC_CLONE_PAGES_RELAYED,
+    SYNC_CLONE_WINDOW_STALLS,
+)
 from ..tracing import logger
 from .identity import RemoteIdentity
 
@@ -123,7 +130,9 @@ class NetworkedLibraries:
             return self._routes[key]
         cached = self._route_cache.get(key)
         if cached is not None:
+            P2P_ROUTE_CACHE_HITS.inc()
             return cached
+        P2P_ROUTE_CACHE_MISSES.inc()
         disc = self.p2p.discovery
         if disc is not None:
             for peer in disc.peers.values():
@@ -184,6 +193,7 @@ class NetworkedLibraries:
                 self._route_cache[key] = route  # healthy: keep for next round
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
                 self._route_cache.pop(key, None)  # stale: re-resolve next time
+                P2P_RECONNECTS.inc()
                 continue  # peer offline; it will pull on reconnect
 
     async def _originate_one(self, library, identity: RemoteIdentity,
@@ -259,6 +269,7 @@ class NetworkedLibraries:
                         "ops": [op.to_wire() for op in item]})
                     continue
                 tunnel.send_nowait({"kind": "blob_page", **item})
+                SYNC_CLONE_PAGES_RELAYED.inc()
                 inflight += 1
                 if inflight >= CLONE_WINDOW:
                     # One backpressure point per window instead of per
@@ -267,6 +278,7 @@ class NetworkedLibraries:
                     # slow receiver pauses us here, not mid-window.
                     await tunnel.drain()
                 while inflight >= CLONE_WINDOW:
+                    SYNC_CLONE_WINDOW_STALLS.inc()
                     ack = await tunnel.recv()
                     if not isinstance(ack, dict) or ack.get("kind") != "ack":
                         raise ConnectionError(

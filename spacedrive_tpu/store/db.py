@@ -22,6 +22,12 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from . import models
+from .. import telemetry
+from ..telemetry import (
+    STORE_COMMIT_SECONDS,
+    STORE_TX,
+    STORE_WRITE_LOCK_WAIT_SECONDS,
+)
 
 
 def uuid_bytes(u: Optional[uuid.UUID] = None) -> bytes:
@@ -190,13 +196,27 @@ class Database:
 
     @contextmanager
     def tx(self):
-        """Serialized write transaction; the unit of atomic batching."""
+        """Serialized write transaction; the unit of atomic batching.
+
+        Telemetry: write-lock wait and COMMIT latency are observed only
+        while telemetry is enabled — the disabled path adds one module
+        flag check, no clock reads."""
         conn = self._conn()
+        tm = telemetry.enabled()
+        t_wait = time.perf_counter() if tm else 0.0
         with self._write_lock:
+            if tm:
+                STORE_WRITE_LOCK_WAIT_SECONDS.observe(
+                    time.perf_counter() - t_wait)
             try:
                 conn.execute("BEGIN IMMEDIATE")
                 yield conn
+                t_commit = time.perf_counter() if tm else 0.0
                 conn.commit()
+                if tm:
+                    STORE_COMMIT_SECONDS.observe(
+                        time.perf_counter() - t_commit)
+                    STORE_TX.inc()
             except BaseException:
                 conn.rollback()
                 raise

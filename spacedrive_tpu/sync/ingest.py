@@ -20,6 +20,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from ..telemetry import SYNC_INGEST_PAGES
 from .crdt import CRDTOperation
 from .manager import SyncManager
 
@@ -187,6 +188,7 @@ class Ingester:
                 # transaction (a savepoint isolates each op, so one
                 # malformed remote op neither kills the actor nor
                 # poisons its page) — ~6× the per-op drain rate.
+                SYNC_INGEST_PAGES.inc()
                 try:
                     applied, errors = await asyncio.to_thread(
                         self.sync.receive_crdt_operations, event.messages)

@@ -25,6 +25,16 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 from ..store import models as M
 from ..store.db import Database
+from ..telemetry import (
+    SYNC_BLOB_PAGES_APPLIED,
+    SYNC_BLOB_PAGES_WRITTEN,
+    SYNC_BLOBS_EXPLODED,
+    SYNC_INGEST_ERRORS,
+    SYNC_OPS_APPLIED,
+    SYNC_OPS_ENCODED,
+    SYNC_OPS_INGESTED,
+    SYNC_OPS_SERVED,
+)
 from . import opblob
 from .crdt import (CRDTOperation, OpKind, RelationOp, SharedOp, op_payload,
                    pack_value, unpack_value, uuid4_bytes, uuid4_bytes_batch)
@@ -299,6 +309,8 @@ class SyncManager:
                 "(timestamp, relation, item_id, group_id, kind, data, "
                 "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?)", rel_rows)
         if shared_rows or rel_rows:
+            SYNC_OPS_ENCODED.labels(format="row").inc(
+                len(shared_rows) + len(rel_rows))
             self._note_ops_logged(
                 max(r[0] for r in shared_rows + rel_rows),
                 any(r[3] == OpKind.DELETE for r in shared_rows))
@@ -352,6 +364,8 @@ class SyncManager:
                     (model, stamps[0], stamps[-1], len(specs), blob,
                      my_id))
                 self._note_ops_logged(stamps[-1], False)
+                SYNC_OPS_ENCODED.labels(format="blob").inc(len(specs))
+                SYNC_BLOB_PAGES_WRITTEN.inc()
                 return len(specs)
 
         def _rid(rid) -> bytes:
@@ -398,10 +412,12 @@ class SyncManager:
             "VALUES (?, ?, ?, ?, ?, ?)", rows)
         self._note_ops_logged(
             stamps[-1], any(s[1] == OpKind.DELETE for s in specs))
+        SYNC_OPS_ENCODED.labels(format="row").inc(len(rows))
         return len(rows)
 
     def _insert_op_row(self, conn, op: CRDTOperation, instance_row_id: int) -> None:
         t = op.typ
+        SYNC_OPS_ENCODED.labels(format="row").inc()
         self._note_ops_logged(
             op.timestamp, isinstance(t, SharedOp) and t.delete)
         data = pack_value(op_payload(
@@ -461,7 +477,9 @@ class SyncManager:
                     (row["timestamp"], row["instance_pub_id"],
                      self._row_to_op(row, is_shared)))
         results.sort(key=lambda t: (t[0], t[1]))
-        return [op for _, _, op in results[:args.count]]
+        page = [op for _, _, op in results[:args.count]]
+        SYNC_OPS_SERVED.inc(len(page))
+        return page
 
     def _blob_op_tuples(self, args: GetOpsArgs
                         ) -> List[Tuple[int, bytes, CRDTOperation]]:
@@ -571,6 +589,7 @@ class SyncManager:
              for ts, rid, kind, payload
              in opblob.decode_entries(m["data"])])
         conn.execute("DELETE FROM shared_op_blob WHERE id = ?", (m["id"],))
+        SYNC_BLOBS_EXPLODED.inc()
 
     def _row_to_op(self, row, is_shared: bool) -> CRDTOperation:
         data = unpack_value(row["data"])
@@ -801,6 +820,10 @@ class SyncManager:
                     "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
                     (ts, pub))
         self.timestamps.update(ts_max)
+        SYNC_OPS_INGESTED.inc(len(ops))
+        SYNC_OPS_APPLIED.inc(applied)
+        if errors:
+            SYNC_INGEST_ERRORS.inc(len(errors))
         return applied, errors
 
     # -- clone fast path: receiving side ------------------------------------
@@ -828,6 +851,8 @@ class SyncManager:
             applied += a
             errors.extend(errs)
             fast_pages += 1 if fast else 0
+            SYNC_BLOB_PAGES_APPLIED.labels(
+                path="fast" if fast else "fallback").inc()
         return applied, errors, fast_pages
 
     def _receive_blob_page(self, page: dict) -> Tuple[int, List[str], bool]:

@@ -1,0 +1,519 @@
+"""Node-wide telemetry: the process-global metrics registry.
+
+The reference leans on the `tracing`/`tracing-subscriber` ecosystem for
+in-process observability (SURVEY §5); the TPU-native equivalent is this
+registry plus the hierarchical spans in `tracing.py`. Counters, gauges,
+and fixed-bucket histograms live in ONE process-global namespace and are
+served three ways: Prometheus text on `GET /metrics`, the `node.metrics`
+rspc query, and periodic `TelemetrySnapshot` events on the node event
+bus (node.py TelemetryReporter).
+
+Design constraints, in order:
+
+- **Cheap hot path.** Every increment starts with one module-global
+  flag check; when telemetry is disabled (`SDTPU_TELEMETRY=off` or
+  `set_enabled(False)`) that check is the WHOLE cost — the regression
+  budget (tests/test_telemetry.py) holds it under 5 µs/call with
+  typical cost ~0.1 µs. Enabled increments take one per-metric lock
+  (leaf lock, never held around any other lock) so thread-pool workers
+  never lose updates.
+- **Central namespace.** Every metric family is defined at the bottom
+  of THIS module and imported by the instrumented code;
+  `tools/telemetry_lint.py` (run in tier-1) fails the build on
+  families registered anywhere else or on name collisions. Names
+  follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with layers
+  jobs | identifier | sync | p2p | store | api | trace.
+- **No dependencies.** Pure stdlib, imports nothing from the package —
+  importable from every layer (store, p2p, ops) without cycles.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "snapshot", "render_prometheus",
+    "enabled", "set_enabled", "reset",
+]
+
+# Module-global hot-path switch: one LOAD_GLOBAL in every increment.
+# Rebound (not mutated) by set_enabled so readers need no lock.
+_ENABLED = os.environ.get("SDTPU_TELEMETRY", "on").strip().lower() not in (
+    "off", "0", "false")
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> None:
+    """Toggle all hot-path recording (process-wide). Values already
+    recorded stay; disabled increments are dropped, not buffered."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def _fmt_num(v: float) -> str:
+    """Prometheus sample formatting: integral values without the .0."""
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _label_str(names: Sequence[str], values: Sequence[Any]) -> str:
+    return ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values))
+
+
+class _Metric:
+    """Shared shell: name/help/labels plumbing. A metric with
+    `labelnames` is a parent that only vends children via `labels()`;
+    a metric without is itself the single sample."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple, "_Metric"] = {}
+
+    def labels(self, **kv: Any) -> "_Metric":
+        """Child metric for one label-value combination (created on
+        first use, cached forever — label cardinality is expected to be
+        tiny: status names, backend names, phase names)."""
+        if tuple(sorted(kv)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.labelnames)}")
+        key = tuple(kv[n] for n in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = type(self)(self.name, self.help,
+                                       **self._child_kwargs())
+                    self._children[key] = child
+        return child
+
+    def _child_kwargs(self) -> Dict[str, Any]:
+        return {}
+
+    # -- introspection ----------------------------------------------------
+
+    def _sample(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _zero(self) -> None:
+        raise NotImplementedError
+
+    def snapshot_value(self) -> Dict[str, Any]:
+        if self.labelnames:
+            return {
+                "kind": self.kind,
+                "labelnames": list(self.labelnames),
+                "labeled": [
+                    {"labels": dict(zip(self.labelnames, key)),
+                     **child._sample()}
+                    for key, child in sorted(self._children.items())
+                ],
+            }
+        return {"kind": self.kind, **self._sample()}
+
+    def reset(self) -> None:
+        self._zero()
+        for child in list(self._children.values()):
+            child._zero()
+
+
+class Counter(_Metric):
+    """Monotonic float counter (Prometheus `counter`)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _sample(self) -> Dict[str, Any]:
+        return {"value": self._value}
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    def _render(self, out: List[str], labels: str) -> None:
+        suffix = f"{{{labels}}}" if labels else ""
+        out.append(f"{self.name}{suffix} {_fmt_num(self._value)}")
+
+
+class Gauge(Counter):
+    """Set-to-current-value metric (Prometheus `gauge`)."""
+
+    kind = "gauge"
+
+    def set(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._value = float(v)
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: cumulative bucket counts + sum + count.
+
+    Buckets are upper bounds; +Inf is implicit. `observe` is one
+    bisect + three adds under the metric lock."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"{name}: histogram needs >= 1 bucket")
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def _child_kwargs(self) -> Dict[str, Any]:
+        return {"buckets": self.buckets}
+
+    def observe(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _sample(self) -> Dict[str, Any]:
+        cum, cums = 0, []
+        for c in self._counts[:-1]:
+            cum += c
+            cums.append(cum)
+        return {
+            "count": self._count, "sum": round(self._sum, 6),
+            "buckets": [[le, n] for le, n in zip(self.buckets, cums)]
+            + [["+Inf", self._count]],
+        }
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def _render(self, out: List[str], labels: str) -> None:
+        prefix = labels + "," if labels else ""
+        cum = 0
+        for le, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append(
+                f'{self.name}_bucket{{{prefix}le="{_fmt_num(le)}"}} {cum}')
+        out.append(f'{self.name}_bucket{{{prefix}le="+Inf"}} {self._count}')
+        suffix = f"{{{labels}}}" if labels else ""
+        out.append(f"{self.name}_sum{suffix} {_fmt_num(self._sum)}")
+        out.append(f"{self.name}_count{suffix} {self._count}")
+
+
+class MetricsRegistry:
+    """Name → metric map with collision detection. One process-global
+    instance (REGISTRY) is the node-wide namespace; tests construct
+    private ones for golden-format checks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                # Re-registration with an identical spec returns the
+                # existing family (module re-imports); anything else is
+                # a namespace collision and fails loudly.
+                want_buckets = (
+                    tuple(sorted(float(b) for b in kw["buckets"]))
+                    if "buckets" in kw else None)
+                if (type(existing) is cls
+                        and existing.labelnames == tuple(labelnames)
+                        and (want_buckets is None
+                             or want_buckets == existing.buckets)):
+                    return existing
+                raise ValueError(
+                    f"metric name collision: {name} already registered "
+                    f"as {existing.kind}")
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-safe {name: sample} map — the TelemetrySnapshot event
+        payload and the node.metrics query result."""
+        return {name: m.snapshot_value()
+                for name, m in sorted(self._metrics.items())}
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out: List[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                out.append(f"# HELP {name} {m.help}")
+            out.append(f"# TYPE {name} {m.kind}")
+            if m.labelnames:
+                for key, child in sorted(m._children.items()):
+                    child._render(out, _label_str(m.labelnames, key))
+            else:
+                m._render(out, "")
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        """Zero every value (bench/test isolation). Metric objects stay
+        registered — module-level references remain valid. Best-effort
+        vs concurrent increments: a racing inc may land before or after
+        its family is zeroed, never corrupt it."""
+        for m in self._metrics.values():
+            m.reset()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def snapshot() -> Dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Metric families — THE node-wide namespace. Every family used anywhere
+# in the package is defined here and imported by the instrumented code;
+# tools/telemetry_lint.py fails tier-1 on families registered elsewhere.
+# ---------------------------------------------------------------------------
+
+# -- jobs (jobs/manager.py, jobs/worker.py, jobs/report.py) -----------------
+JOBS_INGESTED = counter(
+    "sd_jobs_ingested_total", "Jobs accepted by JobManager.ingest")
+JOBS_DUPLICATE_REJECTED = counter(
+    "sd_jobs_duplicate_rejected_total",
+    "Jobs rejected because an identical job was running/queued")
+JOBS_RESUMED = counter(
+    "sd_jobs_resumed_total", "Paused/interrupted jobs re-admitted "
+    "(resume + cold_resume)")
+JOBS_EARLY_FINISH = counter(
+    "sd_jobs_early_finish_total",
+    "Jobs that completed at init via EarlyFinish (nothing to do)")
+JOBS_STEP_ERRORS = counter(
+    "sd_jobs_step_errors_total",
+    "Non-fatal step errors recorded into job reports")
+JOBS_COMPLETED = counter(
+    "sd_jobs_completed_total", "Jobs reaching a final status",
+    labelnames=("status",))
+JOBS_RUNNING = gauge(
+    "sd_jobs_running", "Jobs currently running under the worker pool")
+JOBS_QUEUED = gauge(
+    "sd_jobs_queued", "Jobs waiting in the manager FIFO queue")
+JOB_DURATION_SECONDS = histogram(
+    "sd_job_duration_seconds", "Wall time of finished job runs",
+    labelnames=("name",),
+    buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300, 1800))
+JOB_STEP_SECONDS = histogram(
+    "sd_job_step_seconds", "Wall time of individual job steps",
+    labelnames=("name",))
+JOBS_ITEMS_PROCESSED = counter(
+    "sd_jobs_items_processed_total",
+    "Completed task-count units (steps/chunks) by finished jobs",
+    labelnames=("name",))
+JOBS_ITEMS_PER_SEC = gauge(
+    "sd_jobs_items_per_sec",
+    "items/s of the most recently finished run of each job",
+    labelnames=("name",))
+
+# -- identifier (objects/identifier.py, ops/staging.py) ---------------------
+IDENT_BATCHES = counter(
+    "sd_identifier_batches_total",
+    "CAS hashing batches dispatched, by resolved backend "
+    "(jax = device pipeline; native/numpy/oracle = host planes)",
+    labelnames=("backend",))
+IDENT_BATCH_FILES = histogram(
+    "sd_identifier_batch_files", "Files per CAS hashing batch",
+    buckets=(1, 16, 64, 256, 1024, 4096, 16384, 65536))
+IDENT_BYTES_HASHED = counter(
+    "sd_identifier_bytes_hashed_total",
+    "Payload bytes fed to the CAS hashers (sampled large-file rows "
+    "count their 57344-byte payload, small files their real size)")
+IDENT_DEVICE_FALLBACK = counter(
+    "sd_identifier_device_fallback_total",
+    "auto-backend batches that downgraded jax->host (link probe said "
+    "the H2D link loses to the native plane)")
+IDENT_READ_ERRORS = counter(
+    "sd_identifier_read_errors_total",
+    "Files dropped from CAS batches by read errors")
+IDENT_FILES = counter(
+    "sd_identifier_files_total",
+    "Identifier outcomes per file", labelnames=("outcome",))
+IDENT_PHASE_SECONDS = counter(
+    "sd_identifier_phase_seconds_total",
+    "Per-phase cost attribution of identifier steps (the phase_ms "
+    "split, as live counters)", labelnames=("phase",))
+
+# -- sync (sync/manager.py, sync/ingest.py, sync/opblob.py) -----------------
+SYNC_OPS_ENCODED = counter(
+    "sd_sync_ops_encoded_total",
+    "CRDT ops appended to the local op log, by storage format",
+    labelnames=("format",))
+SYNC_BLOB_PAGES_WRITTEN = counter(
+    "sd_sync_blob_pages_written_total",
+    "Page-level shared_op_blob rows written by bulk writers")
+SYNC_OPS_SERVED = counter(
+    "sd_sync_ops_served_total",
+    "Ops served to pulling peers via get_ops (both storage formats)")
+SYNC_OPS_INGESTED = counter(
+    "sd_sync_ops_ingested_total",
+    "Remote ops offered to receive_crdt_operations")
+SYNC_OPS_APPLIED = counter(
+    "sd_sync_ops_applied_total",
+    "Remote ops that won LWW and mutated the replica")
+SYNC_INGEST_ERRORS = counter(
+    "sd_sync_ingest_errors_total",
+    "Remote ops that failed ingest (savepoint rolled back)")
+SYNC_INGEST_PAGES = counter(
+    "sd_sync_ingest_pages_total",
+    "Pull-loop pages drained through the ingest actor")
+SYNC_BLOB_PAGES_APPLIED = counter(
+    "sd_sync_blob_pages_applied_total",
+    "Clone-stream blob pages applied, fast (batched, LWW-compare "
+    "proven no-op) vs fallback (per-op)", labelnames=("path",))
+SYNC_BLOBS_EXPLODED = counter(
+    "sd_sync_blobs_exploded_total",
+    "Blob pages exploded into indexed op rows (first remote ingest)")
+SYNC_CLONE_WINDOW_STALLS = counter(
+    "sd_sync_clone_window_stalls_total",
+    "Times the clone-stream originator blocked on a watermark ack "
+    "with CLONE_WINDOW pages in flight (receiver backpressure)")
+SYNC_CLONE_PAGES_RELAYED = counter(
+    "sd_sync_clone_pages_relayed_total",
+    "Blob pages relayed verbatim to pulling peers (serving side)")
+
+# -- p2p (p2p/proto.py, p2p/sync_net.py) ------------------------------------
+P2P_TUNNEL_BYTES_SENT = counter(
+    "sd_p2p_tunnel_bytes_sent_total",
+    "Frame payload bytes written to p2p tunnels (post-encryption)")
+P2P_TUNNEL_BYTES_RECV = counter(
+    "sd_p2p_tunnel_bytes_recv_total",
+    "Frame payload bytes read from p2p tunnels (pre-decryption)")
+P2P_TUNNELS_OPENED = counter(
+    "sd_p2p_tunnels_opened_total", "Authenticated tunnels established")
+P2P_ROUTE_CACHE_HITS = counter(
+    "sd_p2p_route_cache_hits_total",
+    "Peer-route resolutions answered from the healthy-tunnel cache")
+P2P_ROUTE_CACHE_MISSES = counter(
+    "sd_p2p_route_cache_misses_total",
+    "Peer-route resolutions that had to scan discovery")
+P2P_RECONNECTS = counter(
+    "sd_p2p_reconnects_total",
+    "Announce rounds that lost a peer mid-stream (route invalidated; "
+    "next round re-resolves)")
+
+# -- store (store/db.py) ----------------------------------------------------
+STORE_TX = counter(
+    "sd_store_tx_total", "Write transactions committed through tx()")
+STORE_COMMIT_SECONDS = histogram(
+    "sd_store_commit_seconds", "COMMIT latency of write transactions",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5))
+STORE_WRITE_LOCK_WAIT_SECONDS = histogram(
+    "sd_store_write_lock_wait_seconds",
+    "Time spent waiting for the per-database write lock",
+    buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30))
+
+# -- api (api/server.py) ----------------------------------------------------
+API_REQUESTS = counter(
+    "sd_api_requests_total", "HTTP requests served, by route template",
+    labelnames=("route",))
+
+# -- tracing (tracing.py) ---------------------------------------------------
+TRACE_SPANS = counter(
+    "sd_trace_spans_total", "Spans recorded into the ring buffer",
+    labelnames=("ok",))

@@ -6,7 +6,15 @@ structured span log plus optional `jax.profiler` capture around device
 batches:
 
 - `span(name)` times a block and logs one structured line through the
-  standard logging machinery (and the node event bus when attached);
+  standard logging machinery (and the node event bus when attached).
+  Spans are HIERARCHICAL: each carries a 64-bit trace id shared with
+  every span under the same root, its own span id, and its parent's
+  span id — propagated via a contextvar, so nesting survives
+  `asyncio.to_thread` and task boundaries (both copy the context).
+  Every finished span records `ok`/`error` (a body that raised is
+  distinguishable in logs and the ring buffer) and lands in a bounded
+  ring of recent spans queryable at runtime (`recent_spans`, served by
+  the `node.spans` rspc query);
 - when `SDTPU_PROFILE=/path` is set, `device_span(name)` additionally
   wraps the block in a jax profiler trace so device batches show up in
   TensorBoard/xprof with step markers.
@@ -15,44 +23,102 @@ batches:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
 import os
+import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import telemetry
 
 logger = logging.getLogger("spacedrive_tpu")
 
-import threading
+# (trace_id, span_id) of the innermost live span in this context.
+_current_span: contextvars.ContextVar[Optional[Tuple[int, int]]] = \
+    contextvars.ContextVar("sdtpu_current_span", default=None)
 
-_profiler_started = False
-_profiler_failed = False
+# Bounded ring of recently finished span records (newest last). 512
+# records × ~200 B is ~100 KB — queryable at runtime without ever
+# growing with uptime.
+SPAN_RING_CAPACITY = 512
+_span_ring: deque = deque(maxlen=SPAN_RING_CAPACITY)
+_span_ring_lock = threading.Lock()
+_id_counter = iter(range(1, 1 << 62)).__next__
+_id_lock = threading.Lock()
+
+
+def _new_id() -> int:
+    with _id_lock:
+        return _id_counter()
+
+
+def recent_spans(limit: int = 100,
+                 trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Newest-last slice of the span ring buffer, optionally filtered
+    to one trace. Records are JSON-safe dicts."""
+    with _span_ring_lock:
+        records = list(_span_ring)
+    if trace_id is not None:
+        records = [r for r in records if r.get("trace") == trace_id]
+    limit = int(limit)
+    return records[-limit:] if limit > 0 else []
+
+
+def clear_span_ring() -> None:
+    """Test hook: empty the ring buffer."""
+    with _span_ring_lock:
+        _span_ring.clear()
+
+
+# -- profiler (SDTPU_PROFILE) ----------------------------------------------
+
+# Tri-state probe cache: None = not yet probed, False = profiling off
+# (env unset/empty, or a start failure), True = trace running. Cached so
+# the device_span hot path is a single attribute check instead of an
+# os.environ read per call; reset_profiler_cache() is the documented
+# hook for tests/hosts that toggle SDTPU_PROFILE after import.
+_profiler_state: Optional[bool] = None
 _profiler_lock = threading.Lock()
 
 
-def _ensure_profiler() -> bool:
-    """Start the jax trace once if SDTPU_PROFILE is set (read at call
-    time so hosts can toggle it after import). ANY profiling problem —
-    no jax, unwritable path, double-start race — degrades to plain
-    spans; device batches run from thread-pool workers, so the start is
-    lock-guarded."""
-    global _profiler_started, _profiler_failed
-    profile_dir = os.environ.get("SDTPU_PROFILE")
-    if not profile_dir or _profiler_failed:
-        return False
-    if _profiler_started:
-        return True
+def reset_profiler_cache() -> None:
+    """Forget the cached SDTPU_PROFILE probe so the next device_span
+    re-reads the environment (does NOT stop a running trace)."""
+    global _profiler_state
     with _profiler_lock:
-        if _profiler_started:
-            return True
+        if not _profiler_state:
+            _profiler_state = None
+
+
+def _ensure_profiler() -> bool:
+    """Start the jax trace once if SDTPU_PROFILE is set. The result —
+    positive or negative — is cached; hosts that set the env var after
+    import call reset_profiler_cache(). ANY profiling problem — no jax,
+    unwritable path, double-start race — degrades to plain spans;
+    device batches run from thread-pool workers, so the start is
+    lock-guarded."""
+    global _profiler_state
+    state = _profiler_state
+    if state is not None:
+        return state
+    with _profiler_lock:
+        if _profiler_state is not None:
+            return _profiler_state
+        profile_dir = os.environ.get("SDTPU_PROFILE")
+        if not profile_dir:
+            _profiler_state = False
+            return False
         try:
             import jax
 
             jax.profiler.start_trace(profile_dir)
         except Exception as e:
-            _profiler_failed = True
+            _profiler_state = False
             logger.warning("SDTPU_PROFILE disabled: %s", e)
             return False
-        _profiler_started = True
+        _profiler_state = True
         import atexit
 
         # Process-scope flush. Deliberately NOT hooked into per-node
@@ -63,27 +129,59 @@ def _ensure_profiler() -> bool:
 
 
 def stop_profiler() -> None:
-    global _profiler_started
-    if _profiler_started:
+    global _profiler_state
+    if _profiler_state:
         import jax
 
         jax.profiler.stop_trace()
-        _profiler_started = False
+        _profiler_state = None
 
 
 @contextlib.contextmanager
 def span(name: str, events=None, **fields):
     """Time a block; emit one structured record at debug level (the
-    reference's ad-hoc Instant deltas, job/mod.rs:592,638)."""
+    reference's ad-hoc Instant deltas, job/mod.rs:592,638).
+
+    The record carries `trace` (shared by all spans under one root),
+    `id`, `parent` (absent for roots), and `ok`/`error` — a raising
+    body produces ok=False plus the exception type, so failed phases
+    are distinguishable downstream. `events` may be an object with an
+    `.emit(dict)` method (the node EventBus) or a bare callable."""
+    parent = _current_span.get()
+    if parent is None:
+        trace_id, parent_id = _new_id(), None
+    else:
+        trace_id, parent_id = parent[0], parent[1]
+    span_id = _new_id()
+    token = _current_span.set((trace_id, span_id))
     t0 = time.perf_counter()
+    err: Optional[BaseException] = None
     try:
         yield
+    except BaseException as e:
+        err = e
+        raise
     finally:
+        _current_span.reset(token)
         ms = (time.perf_counter() - t0) * 1000
-        record = {"span": name, "ms": round(ms, 2), **fields}
+        record = {
+            "span": name, "ms": round(ms, 2),
+            "trace": f"{trace_id:x}", "id": f"{span_id:x}",
+            "ok": err is None,
+            **fields,
+        }
+        if parent_id is not None:
+            record["parent"] = f"{parent_id:x}"
+        if err is not None:
+            record["error"] = type(err).__name__
+        telemetry.TRACE_SPANS.labels(
+            ok="true" if err is None else "false").inc()
+        with _span_ring_lock:
+            _span_ring.append(record)
         logger.debug("span %s", record)
         if events is not None:
-            events.emit({"type": "TraceSpan", **record})
+            emit = getattr(events, "emit", events)
+            emit({"type": "TraceSpan", **record})
 
 
 @contextlib.contextmanager

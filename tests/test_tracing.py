@@ -1,6 +1,7 @@
 """Structured tracing spans + event-bus emission."""
 
 import logging
+import os
 
 from spacedrive_tpu.tracing import device_span, span
 
@@ -46,6 +47,85 @@ def test_span_survives_exceptions():
     except RuntimeError:
         pass
     assert bus.events and bus.events[0]["span"] == "failing"
+
+
+def test_span_ok_and_error_fields():
+    """A raising body is distinguishable from a clean one (the finally
+    block used to emit identical records for both)."""
+    bus = _Bus()
+    with span("clean", events=bus):
+        pass
+    try:
+        with span("raising", events=bus):
+            raise KeyError("x")
+    except KeyError:
+        pass
+    clean, raising = bus.events
+    assert clean["ok"] is True and "error" not in clean
+    assert raising["ok"] is False and raising["error"] == "KeyError"
+
+
+def test_span_nesting_carries_trace_and_parent():
+    bus = _Bus()
+    with span("outer", events=bus):
+        with span("inner", events=bus):
+            pass
+    inner, outer = bus.events  # inner finishes first
+    assert inner["span"] == "inner" and outer["span"] == "outer"
+    assert inner["trace"] == outer["trace"]
+    assert inner["parent"] == outer["id"]
+    assert "parent" not in outer  # root
+    # sibling roots get fresh traces
+    with span("other", events=bus):
+        pass
+    assert bus.events[-1]["trace"] != outer["trace"]
+
+
+def test_spans_land_in_ring_buffer():
+    from spacedrive_tpu.tracing import clear_span_ring, recent_spans
+
+    clear_span_ring()
+    with span("ringed", tag=1):
+        pass
+    got = recent_spans(limit=10)
+    assert got and got[-1]["span"] == "ringed" and got[-1]["tag"] == 1
+    trace = got[-1]["trace"]
+    assert recent_spans(trace_id=trace)[-1]["id"] == got[-1]["id"]
+    assert recent_spans(trace_id="nope") == []
+
+
+def test_span_accepts_bare_callable_sink():
+    got = []
+    with span("callable.sink", events=got.append):
+        pass
+    assert got and got[0]["span"] == "callable.sink"
+
+
+def test_profiler_probe_caches_negative_result(monkeypatch):
+    """With SDTPU_PROFILE unset the env is read ONCE; later device_span
+    calls are a cached attribute check until reset_profiler_cache()
+    (the documented test hook) re-arms the probe."""
+    from spacedrive_tpu import tracing
+
+    reads = []
+    real_environ = dict(os.environ)
+    real_environ.pop("SDTPU_PROFILE", None)
+
+    class CountingEnv(dict):
+        def get(self, key, default=None):
+            if key == "SDTPU_PROFILE":
+                reads.append(key)
+            return super().get(key, default)
+
+    monkeypatch.setattr(tracing.os, "environ", CountingEnv(real_environ))
+    tracing.reset_profiler_cache()
+    assert tracing._ensure_profiler() is False
+    assert tracing._ensure_profiler() is False
+    assert tracing._ensure_profiler() is False
+    assert len(reads) == 1, "negative probe not cached"
+    tracing.reset_profiler_cache()
+    assert tracing._ensure_profiler() is False
+    assert len(reads) == 2, "reset hook must re-read the environment"
 
 
 def test_staging_emits_device_spans(tmp_path):

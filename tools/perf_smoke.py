@@ -8,6 +8,14 @@ the workload-level complement to bench.py's kernel-level number
 config 5 is this with --files 1000000 across multiple locations).
 
     python tools/perf_smoke.py --files 10000 [--backend auto] [--images 300]
+
+--telemetry resets the node-wide metrics registry before the run and
+sources the identify stage's hash-vs-host phase_split from the SAME
+`sd_identifier_phase_seconds_total` counters production serves on
+GET /metrics (instead of the job report's metadata), then appends a
+final {"stage": "telemetry"} line with the full registry snapshot.
+--json PATH additionally writes every stage line (and the snapshot,
+when --telemetry is on) as one BENCH_r*-style artifact.
 """
 
 from __future__ import annotations
@@ -24,11 +32,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _registry_phase_split():
+    """The identify hash-vs-host split, read from the SAME registry
+    counters GET /metrics serves (sd_identifier_phase_seconds_total) —
+    production-visible numbers, not job-report metadata."""
+    from spacedrive_tpu import telemetry
+
+    fam = telemetry.snapshot().get(
+        "sd_identifier_phase_seconds_total", {})
+    phases = {e["labels"]["phase"]: float(e["value"])
+              for e in fam.get("labeled", [])}
+    hash_ms = phases.get("hash", 0.0) * 1000.0
+    stage_ms = phases.get("prep", 0.0) * 1000.0
+    host_ms = sum(v for k, v in phases.items()
+                  if k not in ("hash", "prep", "step_total",
+                               "overlap_wait")) * 1000.0
+    total = hash_ms + stage_ms + host_ms
+    if not total:
+        return None
+    return {
+        "hash_ms": round(hash_ms, 1),
+        "stage_ms": round(stage_ms, 1),
+        "host_ms": round(host_ms, 1),
+        "host_pct": round(100.0 * host_ms / total, 1),
+        "source": "registry",
+    }
+
+
 async def run(files: int, backend: str, images: int, keep: str | None,
               device_batch: int | None = None, small: bool = False,
-              validate_backend: str | None = None):
+              validate_backend: str | None = None,
+              with_telemetry: bool = False, json_out: str = ""):
     from tools.make_corpus import make_corpus
 
+    from spacedrive_tpu import telemetry
     from spacedrive_tpu.jobs.report import JobStatus
     from spacedrive_tpu.locations.indexer_job import IndexerJob
     from spacedrive_tpu.locations.manager import create_location
@@ -37,14 +74,24 @@ async def run(files: int, backend: str, images: int, keep: str | None,
     from spacedrive_tpu.objects.identifier import FileIdentifierJob
     from spacedrive_tpu.objects.validator import ObjectValidatorJob
 
+    lines: list = []
+
+    def emit(line: dict) -> None:
+        lines.append(line)
+        print(json.dumps(line), flush=True)
+
+    if with_telemetry:
+        # The artifact should cover THIS run only, not whatever the
+        # process did before (the registry is process-global).
+        telemetry.reset()
+
     root = keep or tempfile.mkdtemp(prefix="sdtpu-perf-")
     corpus = os.path.join(root, "corpus")
     t0 = time.perf_counter()
     stats = make_corpus(corpus, files=files, dup_rate=0.1, images=images,
                         small_only=small)
-    print(json.dumps({"stage": "corpus", "seconds":
-                      round(time.perf_counter() - t0, 2), **stats}),
-          flush=True)
+    emit({"stage": "corpus", "seconds":
+          round(time.perf_counter() - t0, 2), **stats})
 
     node = Node(os.path.join(root, "data"))
     await node.start()
@@ -96,7 +143,13 @@ async def run(files: int, backend: str, images: int, keep: str | None,
                     "host_ms": round(host_ms, 1),
                     "host_pct": round(100.0 * host_ms / total, 1),
                 }
-        print(json.dumps(line), flush=True)
+        if with_telemetry and name == "identify":
+            # Same split, sourced from the live registry counters the
+            # /metrics endpoint serves — the production-visible number.
+            reg_split = _registry_phase_split()
+            if reg_split:
+                line["phase_split"] = reg_split
+        emit(line)
         return dt
 
     await stage("index", IndexerJob(location_id=loc))
@@ -117,11 +170,11 @@ async def run(files: int, backend: str, images: int, keep: str | None,
 
     t0 = time.perf_counter()
     groups = exact_duplicate_groups(lib, location_id=loc)
-    print(json.dumps({
+    emit({
         "stage": "exact_dup", "seconds":
         round(time.perf_counter() - t0, 2),
         "duplicate_groups": len(groups),
-    }), flush=True)
+    })
 
     if images:
         from spacedrive_tpu.objects.dedup import NearDupDetectorJob
@@ -134,20 +187,32 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         pairs = lib.db.query_one(
             "SELECT COUNT(*) AS n FROM near_dup_pair "
             "WHERE distance <= 10")["n"]
-        print(json.dumps({"stage": "near_dup_hashed",
-                          "hashed_images": near,
-                          "near_dup_pairs": pairs}), flush=True)
+        emit({"stage": "near_dup_hashed", "hashed_images": near,
+              "near_dup_pairs": pairs})
 
     n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
     n_paths = lib.db.query_one(
         "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0 "
         "AND cas_id IS NOT NULL")["n"]
-    print(json.dumps({
+    emit({
         "stage": "summary", "identified_paths": n_paths,
         "objects": n_objects,
         "dedup_collapsed": n_paths - n_objects,
-    }), flush=True)
+    })
     await node.shutdown()
+    if with_telemetry:
+        # The full registry snapshot — the same counters /metrics and
+        # node.metrics serve — embedded so future perf PRs report phase
+        # splits from production telemetry, not ad-hoc prints.
+        emit({"stage": "telemetry", "metrics": telemetry.snapshot()})
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump({
+                "metric": "perf_smoke",
+                "files": files, "backend": backend,
+                "telemetry_enabled": with_telemetry,
+                "stages": lines,
+            }, f, indent=1)
     if not keep:
         import shutil
 
@@ -170,6 +235,13 @@ if __name__ == "__main__":
     ap.add_argument("--virtual-devices", type=int, default=0,
                     help="force a CPU platform with N virtual devices "
                          "(the multi-chip test mesh) before any jax use")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="reset the metrics registry, source the "
+                         "identify phase split from it, and append the "
+                         "registry snapshot to the output")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="write all stage lines (+ telemetry snapshot) "
+                         "as one BENCH-style JSON artifact")
     args = ap.parse_args()
     if args.virtual_devices:
         os.environ["XLA_FLAGS"] = (
@@ -181,4 +253,5 @@ if __name__ == "__main__":
         # the config update below is the only reliable CPU pin.
         jax.config.update("jax_platforms", "cpu")
     asyncio.run(run(args.files, args.backend, args.images, args.keep,
-                    args.device_batch, args.small, args.validate_backend))
+                    args.device_batch, args.small, args.validate_backend,
+                    with_telemetry=args.telemetry, json_out=args.json))

@@ -20,6 +20,11 @@ pure encode cost of both encoders — the before/after artifact for the
 blob op-log work, so the row-vs-blob claim never rests on a README
 anecdote.
 
+--telemetry (any mode) resets the node-wide metrics registry before
+the measured section and embeds its snapshot into the printed/written
+artifact — the same counters production serves on GET /metrics, so
+BENCH rounds and operators read one source of truth.
+
 --full-clone is the READ/APPLY-side artifact for the clone fast path:
 it generates an identifier-shaped library (~2 ops per "file": an
 object-create page + a file_path-link page per 4096-file chunk, all
@@ -67,7 +72,22 @@ def build_backlog(lib, n_ops: int) -> int:
     return total
 
 
-async def main(n_ops: int) -> None:
+def _maybe_reset_telemetry(on: bool) -> None:
+    if on:
+        from spacedrive_tpu import telemetry
+
+        telemetry.reset()
+
+
+def _maybe_embed_telemetry(out: dict, on: bool) -> dict:
+    if on:
+        from spacedrive_tpu import telemetry
+
+        out["telemetry"] = telemetry.snapshot()
+    return out
+
+
+async def main(n_ops: int, with_telemetry: bool = False) -> None:
     from spacedrive_tpu.node import Node
 
     tmp = tempfile.mkdtemp(prefix="sync-bench-")
@@ -77,6 +97,7 @@ async def main(n_ops: int) -> None:
     await b.start()
     lib_a = a.create_library("bench")
     total = build_backlog(lib_a, n_ops)
+    _maybe_reset_telemetry(with_telemetry)
 
     await a.start_p2p(host="127.0.0.1", enable_discovery=False)
     port_b = await b.start_p2p(host="127.0.0.1", enable_discovery=False)
@@ -103,7 +124,7 @@ async def main(n_ops: int) -> None:
         last = n
     dt = time.perf_counter() - t0
     rows = lib_b.db.query_one("SELECT COUNT(*) AS n FROM tag")["n"]
-    print(json.dumps({
+    print(json.dumps(_maybe_embed_telemetry({
         "metric": "sync_ingest_ops_per_sec",
         "value": round(total / dt, 1),
         "unit": "ops/s",
@@ -111,17 +132,18 @@ async def main(n_ops: int) -> None:
         "seconds": round(dt, 2),
         "pages": -(-total // 1000),
         "replica_tag_rows": rows,
-    }))
+    }, with_telemetry)))
     await a.shutdown()
     await b.shutdown()
 
 
-def encode_bench(n_ops: int) -> None:
+def encode_bench(n_ops: int, with_telemetry: bool = False) -> None:
     """Row-format vs blob-format op-log append, same spec stream."""
     from spacedrive_tpu import native
     from spacedrive_tpu.sync import opblob
     from spacedrive_tpu.sync.crdt import pack_value, uuid4_bytes_batch
 
+    _maybe_reset_telemetry(with_telemetry)
     tmp = tempfile.mkdtemp(prefix="sync-encode-bench-")
     mk = lambda name: _mk_solo(tmp, name)  # noqa: E731
 
@@ -164,7 +186,7 @@ def encode_bench(n_ops: int) -> None:
     encode_only["python"] = round(
         reps * chunk / (time.perf_counter() - t0), 1)
 
-    print(json.dumps({
+    print(json.dumps(_maybe_embed_telemetry({
         "metric": "oplog_encode_write_ops_per_sec",
         "unit": "ops/s",
         "ops": n_chunks * chunk,
@@ -174,7 +196,7 @@ def encode_bench(n_ops: int) -> None:
         "blob_vs_rows": round(blob_ops_s / rows_ops_s, 2),
         "native_encoder": native.available(),
         "encode_only_ops_per_sec": encode_only,
-    }))
+    }, with_telemetry)))
 
 
 def _mk_solo(tmp: str, name: str):
@@ -389,9 +411,11 @@ def _full_clone_inproc(tmp: str, n_files: int) -> dict:
                         if k != "applied"}}}
 
 
-def full_clone_bench(n_files: int, json_out: str = "") -> None:
+def full_clone_bench(n_files: int, json_out: str = "",
+                     with_telemetry: bool = False) -> None:
     from spacedrive_tpu import native
 
+    _maybe_reset_telemetry(with_telemetry)
     tmp = tempfile.mkdtemp(prefix="sync-clone-bench-")
     try:
         import cryptography  # noqa: F401 — p2p tunnel dependency
@@ -422,6 +446,7 @@ def full_clone_bench(n_files: int, json_out: str = "") -> None:
         "native_decoder": native.available(),
         "domain_tables_identical": True,
     }
+    _maybe_embed_telemetry(out, with_telemetry)
     print(json.dumps(out))
     if json_out:
         with open(json_out, "w") as f:
@@ -437,9 +462,12 @@ if __name__ == "__main__":
         argv = argv[:i] + argv[i + 2:]
     flags = [a for a in argv if a.startswith("--")]
     args = [a for a in argv if not a.startswith("--")]
+    with_telemetry = "--telemetry" in flags
     if "--full-clone" in flags:
-        full_clone_bench(int(args[0]) if args else 100_000, json_out)
+        full_clone_bench(int(args[0]) if args else 100_000, json_out,
+                         with_telemetry)
     elif "--encode" in flags:
-        encode_bench(int(args[0]) if args else 120_000)
+        encode_bench(int(args[0]) if args else 120_000, with_telemetry)
     else:
-        asyncio.run(main(int(args[0]) if args else 120_000))
+        asyncio.run(main(int(args[0]) if args else 120_000,
+                         with_telemetry))
