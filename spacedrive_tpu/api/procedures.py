@@ -514,7 +514,8 @@ def _locations(r: Router) -> None:
                 invalidates=["locations.list"])
     async def locations_create(node, library, input):
         try:
-            loc_id = loc_manager.create_location(
+            loc_id = await asyncio.to_thread(
+                loc_manager.create_location,
                 library, str(input["path"]),
                 indexer_rule_ids=input.get("indexer_rules_ids", []),
                 name=input.get("name"))
@@ -747,23 +748,36 @@ def _files(r: Router) -> None:
                               conn=conn)
         return None
 
+    def _set_access_time(library, ids, value):
+        # date_accessed is a SYNCED object field: the write and its
+        # per-object LWW update ops land in one tx (sdlint crdt-parity
+        # — the bare UPDATE this used to do never reached peers).
+        ids = [int(oid) for oid in ids]
+        if not ids:
+            return
+        sync = library.sync
+        rows = library.db.query(
+            "SELECT id, pub_id FROM object WHERE id IN ("
+            + ",".join("?" for _ in ids) + ")", ids)
+        ops = [sync.shared_update("object", r["pub_id"], "date_accessed",
+                                  value) for r in rows]
+        with sync.write_ops(ops) as conn:
+            conn.executemany(
+                "UPDATE object SET date_accessed = ? WHERE id = ?",
+                [(value, r["id"]) for r in rows])
+
     @r.mutation("files.updateAccessTime", library=True)
-    def files_update_access_time(node, library, input):
-        now = int(time.time())
-        with library.db.tx() as conn:
-            for oid in input["ids"]:
-                conn.execute(
-                    "UPDATE object SET date_accessed = ? WHERE id = ?",
-                    (now, int(oid)))
+    async def files_update_access_time(node, library, input):
+        # A multi-select can carry thousands of ids — the SELECT + op
+        # minting + write tx must not run on the event loop.
+        await asyncio.to_thread(
+            _set_access_time, library, input["ids"], int(time.time()))
         return None
 
     @r.mutation("files.removeAccessTime", library=True)
-    def files_remove_access_time(node, library, input):
-        with library.db.tx() as conn:
-            for oid in input["ids"]:
-                conn.execute(
-                    "UPDATE object SET date_accessed = NULL WHERE id = ?",
-                    (int(oid),))
+    async def files_remove_access_time(node, library, input):
+        await asyncio.to_thread(
+            _set_access_time, library, input["ids"], None)
         return None
 
     @r.mutation("files.renameFile", library=True,
@@ -1420,7 +1434,8 @@ def _auth(r: Router) -> None:
                         auth_mod.DEVICE_CODE_URN, dev["device_code"],
                         client_id)
                     if status == 200:
-                        auth_mod.store_token(
+                        await asyncio.to_thread(
+                            auth_mod.store_token,
                             node, auth_mod.OAuthToken.from_raw(body))
                         node.events.invalidate_query(None, "auth.me")
                         emit({"state": "Complete"})

@@ -103,7 +103,9 @@ class ApiServer:
         apps/server/src/main.rs:60-63)."""
         from .webui import index_html
 
-        return web.Response(text=index_html(), content_type="text/html")
+        # index_html reads the asset from disk on first render
+        html = await asyncio.to_thread(index_html)
+        return web.Response(text=html, content_type="text/html")
 
     async def _static(self, request: web.Request) -> web.Response:
         """Serve the explorer's static assets (no path traversal: the
@@ -117,8 +119,12 @@ class ApiServer:
             raise web.HTTPNotFound()
         ctype = (mimetypes.guess_type(path)[0]
                  or "application/octet-stream")
-        with open(path, "rb") as f:
-            return web.Response(body=f.read(), content_type=ctype)
+        def _read() -> bytes:
+            with open(path, "rb") as f:
+                return f.read()
+
+        body = await asyncio.to_thread(_read)
+        return web.Response(body=body, content_type=ctype)
 
     async def _manifest(self, _request: web.Request) -> web.Response:
         """PWA manifest: with the reconnecting websocket client this
@@ -250,7 +256,8 @@ class ApiServer:
         if ("filesOverP2P" not in node.config.features
                 or node.p2p is None or node.p2p.networked is None):
             raise web.HTTPNotFound()
-        inst = lib.db.query_one(
+        inst = await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT * FROM instance WHERE id = ?", (loc["instance_id"],))
         if inst is None or not inst["identity"]:
             raise web.HTTPNotFound()
@@ -298,7 +305,7 @@ class ApiServer:
                 status = 206
             resp = web.StreamResponse(status=status, headers=headers)
             await resp.prepare(request)
-            with open(tmp_path, "rb") as f:
+            with await asyncio.to_thread(open, tmp_path, "rb") as f:
                 while True:
                     chunk = await asyncio.to_thread(f.read, RANGE_CHUNK)
                     if not chunk:
@@ -325,14 +332,16 @@ class ApiServer:
             raise web.HTTPBadRequest()
         if lib is None:
             raise web.HTTPNotFound()
-        row = lib.db.query_one(
+        row = await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT * FROM file_path WHERE id = ? AND location_id = ?",
             (file_path_id, location_id))
-        loc = lib.db.query_one(
+        loc = await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT * FROM location WHERE id = ?", (location_id,))
         if row is None or loc is None:
             raise web.HTTPNotFound()
-        if not self._location_is_local(lib, loc):
+        if not await asyncio.to_thread(self._location_is_local, lib, loc):
             # Remote location: proxy the bytes over p2p when the
             # FilesOverP2P feature is on (custom_uri/mod.rs:149-330
             # files_over_p2p_flag path).
@@ -368,11 +377,12 @@ class ApiServer:
                     "Accept-Ranges": "bytes",
                 })
             await resp.prepare(request)
-            with open(full, "rb") as f:
+            with await asyncio.to_thread(open, full, "rb") as f:
                 f.seek(start)
                 remaining = end - start + 1
                 while remaining > 0:
-                    chunk = f.read(min(RANGE_CHUNK, remaining))
+                    chunk = await asyncio.to_thread(
+                        f.read, min(RANGE_CHUNK, remaining))
                     if not chunk:
                         break
                     await resp.write(chunk)

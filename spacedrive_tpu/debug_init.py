@@ -16,6 +16,7 @@ reused, mirroring the reference's upsert behavior.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 from typing import Optional
@@ -23,13 +24,17 @@ from typing import Optional
 INIT_FILE = "init.json"
 
 
+def _load_config(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
 async def apply_init_file(node, path: Optional[str] = None) -> int:
     """Apply the init config; returns the number of scans queued."""
     path = path or os.path.join(node.data_dir, INIT_FILE)
     if not os.path.exists(path):
         return 0
-    with open(path) as f:
-        config = json.load(f)
+    config = await asyncio.to_thread(_load_config, path)
     scans = 0
     errors = []
     for lib_spec in config.get("libraries", []):
@@ -56,12 +61,13 @@ async def _apply_library(node, lib_spec: dict) -> int:
         loc_path = os.path.abspath(loc_spec["path"])
         if not os.path.isdir(loc_path):
             continue
-        row = lib.db.query_one(
+        row = await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT id FROM location WHERE path = ?", (loc_path,))
         if row is None:
             from .locations.manager import create_location
 
-            loc_id = create_location(lib, loc_path)
+            loc_id = await asyncio.to_thread(create_location, lib, loc_path)
         else:
             loc_id = row["id"]
         if loc_spec.get("scan", True):

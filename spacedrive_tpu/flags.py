@@ -1,0 +1,218 @@
+"""Central SDTPU_* environment-flag registry.
+
+Every environment flag the engine reads is DECLARED here — name,
+parsed default, parser, and a docstring — and READ through `get()` /
+`raw()`. Scattered `os.environ["SDTPU_*"]` reads made the flag surface
+unauditable (round-7 review: ~10 literals across six layers, none
+discoverable without grep); `tools/sdlint`'s flag-registry pass now
+fails the build on any SDTPU_* literal that is not declared here and on
+any direct environ read of one outside this module. Writers (benches
+and tests toggling a flag via `os.environ[...] = ...` or
+`monkeypatch.setenv`) are unaffected — reads go live to the
+environment on every call, so toggles keep working mid-process.
+
+Design constraints (same as telemetry.py, which imports this module):
+pure stdlib, imports nothing from the package — every layer can import
+it without cycles.
+
+README's flag table is generated from this registry
+(`python -m tools.sdlint --flag-table`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "Flag", "FLAGS", "declare", "get", "raw", "flag_table_markdown",
+    "parse_str", "parse_onoff", "parse_flag1", "parse_float",
+    "parse_int", "parse_int_csv",
+]
+
+
+# -- parsers ----------------------------------------------------------------
+# Each takes the RAW environment string (never None) and returns the
+# typed value; a ValueError falls back to the flag's default, matching
+# the defensive parsing every migrated call site already had.
+
+def parse_str(v: str) -> str:
+    return v
+
+
+def parse_onoff(v: str) -> bool:
+    """Kill-switch semantics: anything but off/0/false is ON."""
+    return v.strip().lower() not in ("off", "0", "false")
+
+
+def parse_flag1(v: str) -> bool:
+    """Opt-in semantics: only 1/on/true/yes enable."""
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+def parse_float(v: str) -> float:
+    return float(v)
+
+
+def parse_int(v: str) -> int:
+    return int(v)
+
+
+def parse_int_csv(v: str) -> List[int]:
+    return [int(s) for s in v.split(",") if s.strip()]
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+    # strict=True: a malformed value RAISES instead of falling back to
+    # the default. For flags where a typo silently changing behavior is
+    # worse than a crash (fuzz seeds replaying the wrong corpus, a
+    # batch budget ignoring the operator) — matches the loud parsing
+    # their pre-registry call sites had.
+    strict: bool = False
+
+
+FLAGS: Dict[str, Flag] = {}
+
+
+def declare(name: str, default: Any, parse: Callable[[str], Any] = parse_str,
+            doc: str = "", strict: bool = False) -> Flag:
+    if not name.startswith("SDTPU_"):
+        raise ValueError(f"flag {name!r} must start with SDTPU_")
+    if name in FLAGS:
+        raise ValueError(f"flag {name!r} declared twice")
+    f = Flag(name, default, parse, doc, strict)
+    FLAGS[name] = f
+    return f
+
+
+def raw(name: str) -> Optional[str]:
+    """The raw environment string, or None when unset. The flag must be
+    declared — an unknown name is a programming error, not a lookup
+    miss (that is the whole point of the registry)."""
+    if name not in FLAGS:
+        raise KeyError(f"undeclared flag {name!r} (declare it in "
+                       "spacedrive_tpu/flags.py)")
+    return os.environ.get(name)
+
+
+def get(name: str) -> Any:
+    """Parsed value: parser over the live environment, the declared
+    default when unset, empty, or unparseable. Reads are NOT cached —
+    benches and tests toggle flags mid-process (sync_bench flips
+    SDTPU_CLONE_PASSTHROUGH per phase); call sites that need one-shot
+    semantics cache on their side (tracing's profiler probe)."""
+    flag = FLAGS.get(name)
+    if flag is None:
+        raise KeyError(f"undeclared flag {name!r} (declare it in "
+                       "spacedrive_tpu/flags.py)")
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return flag.default
+    try:
+        return flag.parse(v)
+    except (ValueError, TypeError):
+        if flag.strict:
+            raise ValueError(
+                f"{name}={v!r}: unparseable (see its declaration in "
+                f"spacedrive_tpu/flags.py)")
+        return flag.default
+
+
+def flag_table_markdown() -> str:
+    """README's generated flag table (one row per declared flag)."""
+    out = ["| Flag | Default | Meaning |", "| --- | --- | --- |"]
+    for name in sorted(FLAGS):
+        f = FLAGS[name]
+        default = "unset" if f.default is None else repr(f.default)
+        doc = " ".join(f.doc.split())
+        out.append(f"| `{name}` | {default} | {doc} |")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# THE flag namespace. Keep alphabetical; every entry is enforced by the
+# sdlint flag-registry pass (undeclared literals fail the build).
+# ---------------------------------------------------------------------------
+
+declare(
+    "SDTPU_CLONE_PASSTHROUGH", True, parse_onoff,
+    "Kill switch for the full-library-clone blob pass-through fast "
+    "path (p2p/sync_net.py). `off` forces the per-op pull loop.")
+
+declare(
+    "SDTPU_DEVICE_PIPELINE", "", lambda v: v.strip().lower(),
+    "CAS device-pipeline override (ops/staging.py): `force`/`1` always "
+    "stage through the accelerator, `off`/`0` always use the host "
+    "planes; unset probes the H2D link once per process.")
+
+declare(
+    "SDTPU_DISPATCH_LOG", False, lambda v: v == "1",
+    "When `1`, every device CAS dispatch appends its batch geometry to "
+    "ops/blake3_jax.DISPATCH_LOG (driver/dryrun artifacts read it).")
+
+declare(
+    "SDTPU_FUZZ_SEEDS", [7, 23], parse_int_csv,
+    "Comma-separated RNG seeds the sync fuzz suite replays "
+    "(tests/test_sync_fuzz.py).", strict=True)
+
+declare(
+    "SDTPU_H2D_GBPS", None, parse_float,
+    "Pin the host→device link-rate probe to a fixed GB/s "
+    "(ops/staging.py) instead of measuring — benchmark pinning and "
+    "thin-tunnel hosts.")
+
+declare(
+    "SDTPU_PROFILE", None, parse_str,
+    "Directory for a jax profiler trace; set → device_span() regions "
+    "are captured (tracing.py; probed once per process, "
+    "reset_profiler_cache() re-arms).")
+
+declare(
+    "SDTPU_SANITIZE", False, parse_flag1,
+    "Opt-in runtime sanitizer (sanitize.py): event-loop stall "
+    "detector, lock-order cycle check, write-lock-held-across-await "
+    "assertion. Tier-1 runs with it on.")
+
+declare(
+    "SDTPU_SANITIZE_MODE", "count", lambda v: v.strip().lower(),
+    "`raise` (tests): a detected violation raises at the detection "
+    "point; `count` (production): violations only increment "
+    "sd_sanitize_* telemetry and record into sanitize.violations().")
+
+declare(
+    "SDTPU_SANITIZE_STALL_S", 1.0, parse_float,
+    "Event-loop stall threshold in seconds: one callback/task step "
+    "hogging the loop longer than this is a sanitizer violation.")
+
+declare(
+    "SDTPU_SHARDED_CAS", "auto", lambda v: v.strip().lower(),
+    "`off` pins the single-device CAS program even on multi-device "
+    "hosts (ops/blake3_jax.py; the CPU-mesh test suite sets it to "
+    "dodge a ~50s shard_map compile per batch grid).")
+
+declare(
+    "SDTPU_TELEMETRY", True, parse_onoff,
+    "Kill switch for the node-wide metrics registry (telemetry.py): "
+    "`off` reduces every increment to one flag check.")
+
+declare(
+    "SDTPU_TELEMETRY_INTERVAL", 15.0, parse_float,
+    "Seconds between periodic TelemetrySnapshot events on the node "
+    "event bus (node.py TelemetryReporter).")
+
+declare(
+    "SDTPU_VAL_BATCH_BYTES", None, parse_int,
+    "Device-validator batch budget in bytes (objects/validator.py); "
+    "unset uses the 64 MiB default sized for PCIe/ICI links.",
+    strict=True)
+
+declare(
+    "SDTPU_WATCHER", "", lambda v: v.strip().lower(),
+    "`poll` forces the polling watcher fallback even where inotify is "
+    "available (locations/watcher.py; how Linux CI exercises it).")

@@ -97,7 +97,18 @@ class StatefulJob:
     # -- lifecycle (override) --------------------------------------------
 
     async def init(self, ctx: "JobContext") -> tuple[Dict[str, Any], List[Any]]:
-        """Return (data, steps). Raise EarlyFinish when there is no work."""
+        """Return (data, steps). Raise EarlyFinish when there is no work.
+
+        Jobs whose init is pure sync work (queries + step building —
+        the common batch-job shape) define `_init_sync(ctx)` instead of
+        overriding this: the base runs it off the event loop, so the
+        blocking-in-async discipline (tools/sdlint, sanitize.py) holds
+        by construction for every such job."""
+        sync_init = getattr(self, "_init_sync", None)
+        if sync_init is not None:
+            import asyncio
+
+            return await asyncio.to_thread(sync_init, ctx)
         raise NotImplementedError
 
     async def execute_step(

@@ -83,6 +83,7 @@ class JobManager:
         self._hashes: Dict[str, bytes] = {}  # job.hash() → job id
         self._final_status: Dict[bytes, JobStatus] = {}
         self._paused: Dict[bytes, _Entry] = {}  # paused this session
+        self._resuming: set = set()  # job ids mid-await in resume()
         self._shutting_down = False
 
     # -- ingestion --------------------------------------------------------
@@ -107,10 +108,21 @@ class JobManager:
             id=new_job_id(), name=job.NAME, action=action,
             data=state.serialize(),
         )
-        report.create(library.db)
-        entry = _Entry(job, report, library, next_jobs, resume_state=state)
+        # Reserve the dedup hash BEFORE suspending: with the report
+        # write off-loop, a second identical ingest could otherwise
+        # pass the AlreadyRunning check during our await and run the
+        # same job twice. Released on failure (only if still ours).
         self._hashes[h] = report.id
-        self._admit(entry)
+        try:
+            await asyncio.to_thread(report.create, library.db)
+        except BaseException:
+            if self._hashes.get(h) == report.id:
+                del self._hashes[h]
+            raise
+        entry = _Entry(job, report, library, next_jobs, resume_state=state)
+        # _admit must stay sync (the task done-callback path admits
+        # chained jobs); its QUEUED-status write is one tiny UPDATE.
+        self._admit(entry)  # sdlint: ok[blocking-async]
         return report.id
 
     def _admit(self, entry: _Entry) -> None:
@@ -194,18 +206,26 @@ class JobManager:
             # Cancels a pending not-yet-actioned pause (latest command wins).
             self.running[job_id].command(WorkerCommand.RESUME)
             return
-        if job_id in self._entries:
-            return  # already re-admitted (double resume)
-        paused_entry = self._paused.pop(job_id, None)
-        row = library.db.query_one("SELECT * FROM job WHERE id = ?", (job_id,))
-        if row is None:
-            raise JobManagerError("no such job")
-        report = JobReport.from_row(row)
-        if report.status != JobStatus.PAUSED or not report.data:
-            raise JobManagerError("job is not resumable")
-        live_job = paused_entry.job if paused_entry is not None else None
-        JOBS_RESUMED.inc()
-        self._admit_from_state(library, report, live_job=live_job)
+        if job_id in self._entries or job_id in self._resuming:
+            return  # already re-admitted / mid-resume (double resume)
+        self._resuming.add(job_id)
+        try:
+            paused_entry = self._paused.pop(job_id, None)
+            row = await asyncio.to_thread(
+                library.db.query_one,
+                "SELECT * FROM job WHERE id = ?", (job_id,))
+            if row is None:
+                raise JobManagerError("no such job")
+            report = JobReport.from_row(row)
+            if report.status != JobStatus.PAUSED or not report.data:
+                raise JobManagerError("job is not resumable")
+            live_job = paused_entry.job if paused_entry is not None else None
+            JOBS_RESUMED.inc()
+            # sync by design (done-callback path); tiny status UPDATE
+            self._admit_from_state(  # sdlint: ok[blocking-async]
+                library, report, live_job=live_job)
+        finally:
+            self._resuming.discard(job_id)
 
     def _admit_from_state(self, library: Any, report: JobReport,
                           live_job: Any = None) -> None:
@@ -296,7 +316,8 @@ class JobManager:
         those without are marked Failed.
         """
         resumed = []
-        rows = library.db.query(
+        rows = await asyncio.to_thread(
+            library.db.query,
             "SELECT * FROM job WHERE status IN (?, ?, ?)",
             (int(JobStatus.PAUSED), int(JobStatus.RUNNING),
              int(JobStatus.QUEUED)),
@@ -306,13 +327,15 @@ class JobManager:
             if not report.data or report.name not in JOB_REGISTRY:
                 report.status = JobStatus.FAILED
                 report.errors_text.append("job lost state; cannot resume")
-                report.update(library.db)
+                await asyncio.to_thread(report.update, library.db)
                 continue
             state = JobState.deserialize(report.data)
             job = JOB_REGISTRY[report.name](**state.init_args)
             if job.hash() in self._hashes:
                 continue
-            self._admit_from_state(library, report)
+            # sync by design (done-callback path); tiny status UPDATE
+            self._admit_from_state(library,  # sdlint: ok[blocking-async]
+                                   report)
             JOBS_RESUMED.inc()
             resumed.append(report.id)
         return resumed

@@ -121,7 +121,7 @@ class Worker:
                 )
                 self.report.date_completed = int(time.time())
                 self.report.data = None
-                self.report.update(self.library.db)
+                await asyncio.to_thread(self.report.update, self.library.db)
             else:
                 self.report.status = status
         self._emit_final()
@@ -145,7 +145,7 @@ class Worker:
         self._started_at = time.monotonic()
         r.status = JobStatus.RUNNING
         r.date_started = int(time.time())
-        r.update(self.library.db)
+        await asyncio.to_thread(r.update, self.library.db)
 
         errors: List[str] = []
         if self.resume_state is not None and (
@@ -163,7 +163,7 @@ class Worker:
                 r.status = JobStatus.COMPLETED
                 r.data = None  # clear the at-ingest state blob
                 r.date_completed = int(time.time())
-                r.update(self.library.db)
+                await asyncio.to_thread(r.update, self.library.db)
                 return JobStatus.COMPLETED
             next_chain = (
                 self.resume_state.next_chain if self.resume_state else []
@@ -273,7 +273,7 @@ class Worker:
         r.status = (
             JobStatus.COMPLETED_WITH_ERRORS if errors else JobStatus.COMPLETED
         )
-        r.update(self.library.db)
+        await asyncio.to_thread(r.update, self.library.db)
         return r.status
 
     async def _spanned_step(self, ctx: JobContext, state: JobState):
@@ -309,7 +309,7 @@ class Worker:
     async def _persist_paused(self, state: JobState,
                               errors: List[str]) -> JobStatus:
         self.report.status = JobStatus.PAUSED
-        self._persist_state(state, errors)
+        await asyncio.to_thread(self._persist_state, state, errors)
         return JobStatus.PAUSED
 
     async def _persist_paused_or_fail(self, why: str) -> JobStatus:
@@ -321,7 +321,7 @@ class Worker:
         else:
             self.report.status = JobStatus.FAILED
             self.report.errors_text.append(why)
-        self.report.update(self.library.db)
+        await asyncio.to_thread(self.report.update, self.library.db)
         return self.report.status
 
     async def _cleanup_quietly(self, data) -> None:
@@ -339,5 +339,5 @@ class Worker:
         self.report.data = None
         self.report.completed_task_count = state.step_number
         self.report.date_completed = int(time.time())
-        self.report.update(self.library.db)
+        await asyncio.to_thread(self.report.update, self.library.db)
         return JobStatus.CANCELED

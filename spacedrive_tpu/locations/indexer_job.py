@@ -415,7 +415,11 @@ class IndexerJob(StatefulJob):
         walker = self._walker(ctx, location_path)
         res = await asyncio.to_thread(
             walker.walk, to_walk_path, INIT_WALK_LIMIT)
-        steps = self._result_to_steps(ctx, res, data)
+        # Step building spools row batches into job_scratch (db writes)
+        # and was measured stalling the loop ~1.5s on big removal sets
+        # (the sanitizer's loop_stall detector caught it) — off-loop.
+        steps = await asyncio.to_thread(
+            self._result_to_steps, ctx, res, data)
         # A pure-removal rescan (rm -rf'd subtree, nothing new) emits
         # zero steps but must still reach finalize, where the spooled
         # removals are applied — EarlyFinish here would both strand the
@@ -438,7 +442,8 @@ class IndexerJob(StatefulJob):
             walker.keep_walking,
             ToWalkEntry(step["path"], step.get("accepted"), step.get("parent")),
         )
-        more = self._result_to_steps(ctx, res, data)
+        more = await asyncio.to_thread(
+            self._result_to_steps, ctx, res, data)
         return StepOutcome(more_steps=more, errors=list(res.errors))
 
     def _save(self, ctx: JobContext, data, step) -> StepOutcome:
@@ -471,6 +476,41 @@ class IndexerJob(StatefulJob):
                 ctx.db.execute,
                 "DELETE FROM job_scratch WHERE job_id = ?", (ctx.job_id,))
 
+    def _write_dir_sizes(self, ctx: JobContext, data) -> int:
+        """Deferred dir-size writes + their sync ops in ONE tx.
+
+        size_in_bytes_bytes is a SYNCED field (store/models.py), so the
+        sizes an index run computes must reach peers — the bare UPDATE
+        this used to do diverged replicas silently (sdlint crdt-parity
+        finding). Returns ops emitted; the caller fires the created
+        notification outside the tx."""
+        db = ctx.db
+        sync = ctx.library.sync
+        loc_path = data["location_path"]
+        with db.tx() as conn:
+            specs = []
+            for path, size in data["dir_sizes"].items():
+                try:
+                    iso = IsolatedPath.new(
+                        self.location_id, loc_path, path, True)
+                except ValueError:
+                    continue
+                row = conn.execute(
+                    "SELECT id, pub_id FROM file_path WHERE "
+                    "location_id = ? AND materialized_path = ? AND "
+                    "name = ? AND extension = ?",
+                    (iso.location_id, iso.materialized_path, iso.name,
+                     iso.extension)).fetchone()
+                if row is None:
+                    continue
+                blob = int(size).to_bytes(8, "big")
+                conn.execute(
+                    "UPDATE file_path SET size_in_bytes_bytes = ? "
+                    "WHERE id = ?", (blob, row["id"]))
+                specs.append((row["pub_id"], "u:size_in_bytes_bytes",
+                              "size_in_bytes_bytes", blob, None))
+            return sync.bulk_shared_ops(conn, "file_path", specs)
+
     async def finalize(self, ctx: JobContext, data, metadata):
         """Execute deferred removals (every save has had its chance to
         re-path moved inodes by now), then write accumulated dir sizes
@@ -490,22 +530,11 @@ class IndexerJob(StatefulJob):
                 rows, sid)
         data["removal_scratch"] = []
         db = ctx.db
-        loc_path = data["location_path"]
-        with db.tx() as conn:
-            for path, size in data["dir_sizes"].items():
-                try:
-                    iso = IsolatedPath.new(
-                        self.location_id, loc_path, path, True)
-                except ValueError:
-                    continue
-                conn.execute(
-                    "UPDATE file_path SET size_in_bytes_bytes = ? WHERE "
-                    "location_id = ? AND materialized_path = ? AND "
-                    "name = ? AND extension = ?",
-                    (int(size).to_bytes(8, "big"), iso.location_id,
-                     iso.materialized_path, iso.name, iso.extension))
+        if await asyncio.to_thread(self._write_dir_sizes, ctx, data):
+            ctx.library.sync._notify_created()
         if ctx.job_id:  # sweep any unconsumed scratch (replays, errors)
-            db.execute(
+            await asyncio.to_thread(
+                db.execute,
                 "DELETE FROM job_scratch WHERE job_id = ?", (ctx.job_id,))
         metadata.setdefault("indexed_count", data["total_saved"])
         metadata.setdefault("updated_count", data["total_updated"])

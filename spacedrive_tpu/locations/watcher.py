@@ -20,6 +20,8 @@ import os
 import struct
 from typing import Callable, Dict, Optional, Set
 
+from .. import flags
+
 # inotify event masks (linux/inotify.h)
 IN_CREATE = 0x00000100
 IN_DELETE = 0x00000200
@@ -206,7 +208,7 @@ def make_watcher(location_id: int, root: str,
                  loop: Optional[asyncio.AbstractEventLoop] = None):
     """inotify watcher when the platform has it, polling otherwise
     (or when SDTPU_WATCHER=poll forces the fallback under test)."""
-    if os.environ.get("SDTPU_WATCHER") != "poll" and inotify_available():
+    if flags.get("SDTPU_WATCHER") != "poll" and inotify_available():
         return LocationWatcher(location_id, root, on_dirty, loop)
     return PollingWatcher(location_id, root, on_dirty, loop)
 

@@ -34,7 +34,7 @@ class MediaProcessorJob(StatefulJob):
         self.location_id = location_id
         self.sub_path = sub_path
 
-    async def init(self, ctx: JobContext):
+    def _init_sync(self, ctx: JobContext):
         db = ctx.db
         from ..locations.file_path_helper import job_prologue
         from .avmetadata import probeable_extensions

@@ -16,7 +16,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
-from . import telemetry
+from . import flags, telemetry
 from .jobs.manager import JobManager
 from .library import Libraries, Library
 from .store.db import uuid_bytes
@@ -169,11 +169,7 @@ class TelemetryReporter:
                  interval_s: Optional[float] = None):
         self.events = events
         if interval_s is None:
-            try:
-                interval_s = float(
-                    os.environ.get("SDTPU_TELEMETRY_INTERVAL", ""))
-            except ValueError:
-                interval_s = self.DEFAULT_INTERVAL_S
+            interval_s = flags.get("SDTPU_TELEMETRY_INTERVAL")
         self.interval_s = max(0.05, interval_s)
         self._task: Optional[asyncio.Task] = None
 
@@ -201,6 +197,11 @@ class TelemetryReporter:
 
 class Node:
     def __init__(self, data_dir: str):
+        # Production nodes honor SDTPU_SANITIZE=1 too: violations count
+        # into sd_sanitize_* telemetry (mode `count`) instead of
+        # raising. No-op (and zero overhead) when the flag is unset.
+        from . import sanitize
+        sanitize.install()
         self.data_dir = os.path.abspath(data_dir)
         os.makedirs(self.data_dir, exist_ok=True)
         self.config = NodeConfig(os.path.join(self.data_dir, NODE_CONFIG_NAME))

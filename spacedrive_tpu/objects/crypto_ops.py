@@ -81,10 +81,11 @@ class FileEncryptorJob(_FsJobBase):
         self.erase_original = erase_original
 
     async def init(self, ctx: JobContext):
-        path = self._location_path(ctx)
-        steps = [fd for fd in _file_datas(ctx.db, self.location_id, path,
-                                          self.file_path_ids)
-                 if not fd["is_dir"]]
+        path = await asyncio.to_thread(self._location_path, ctx)
+        fds = await asyncio.to_thread(
+            _file_datas, ctx.db, self.location_id, path,
+            self.file_path_ids)
+        steps = [fd for fd in fds if not fd["is_dir"]]
         if not steps:
             raise EarlyFinish("nothing to encrypt")
         return {"location_path": path}, steps
@@ -156,10 +157,11 @@ class FileDecryptorJob(_FsJobBase):
         self.output_path = output_path
 
     async def init(self, ctx: JobContext):
-        path = self._location_path(ctx)
-        steps = [fd for fd in _file_datas(ctx.db, self.location_id, path,
-                                          self.file_path_ids)
-                 if not fd["is_dir"]]
+        path = await asyncio.to_thread(self._location_path, ctx)
+        fds = await asyncio.to_thread(
+            _file_datas, ctx.db, self.location_id, path,
+            self.file_path_ids)
+        steps = [fd for fd in fds if not fd["is_dir"]]
         if not steps:
             raise EarlyFinish("nothing to decrypt")
         return {"location_path": path}, steps

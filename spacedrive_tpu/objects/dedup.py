@@ -93,7 +93,7 @@ class NearDupDetectorJob(StatefulJob):
         self.sub_path = sub_path
         self.backend = backend
 
-    async def init(self, ctx: JobContext):
+    def _init_sync(self, ctx: JobContext):
         db = ctx.db
         ph = ",".join("?" for _ in PHASHABLE_EXTENSIONS)
         loc, where, params = job_prologue(

@@ -139,9 +139,10 @@ class FileDeleterJob(_FsJobBase):
     NAME = "file_deleter"  # delete.rs:34
 
     async def init(self, ctx: JobContext):
-        path = self._location_path(ctx)
-        steps = _file_datas(ctx.db, self.location_id, path,
-                            self.file_path_ids)
+        path = await asyncio.to_thread(self._location_path, ctx)
+        steps = await asyncio.to_thread(
+            _file_datas, ctx.db, self.location_id, path,
+            self.file_path_ids)
         if not steps:
             raise EarlyFinish("nothing to delete")
         return {"location_path": path}, steps
@@ -170,35 +171,40 @@ class FileEraserJob(_FsJobBase):
         self.passes = passes
 
     async def init(self, ctx: JobContext):
-        path = self._location_path(ctx)
-        steps = _file_datas(ctx.db, self.location_id, path,
-                            self.file_path_ids)
+        path = await asyncio.to_thread(self._location_path, ctx)
+        steps = await asyncio.to_thread(
+            _file_datas, ctx.db, self.location_id, path,
+            self.file_path_ids)
         if not steps:
             raise EarlyFinish("nothing to erase")
         return {"location_path": path, "dirs_to_remove": []}, steps
 
+    def _expand_dir(self, ctx: JobContext, data, step) -> list:
+        # Expand children as further steps; dir removed in finalize
+        # (erase.rs:99-137). Unindexed children MUST still be erased —
+        # skipping them would delete plaintext bytes unscrubbed — so
+        # they get synthetic steps without DB rows.
+        more = []
+        for entry in os.scandir(step["full_path"]):
+            if entry.is_symlink():
+                # NEVER scrub through a symlink — the target may live
+                # outside the erase scope. Remove just the link.
+                os.remove(entry.path)
+                continue
+            is_dir = entry.is_dir(follow_symlinks=False)
+            child = _child_step(
+                ctx.db, self.location_id, data["location_path"],
+                entry.path, is_dir)
+            if child is None:
+                child = {"id": None, "pub_id": None, "is_dir": is_dir,
+                         "name": entry.name, "extension": "",
+                         "full_path": entry.path}
+            more.append(child)
+        return more
+
     async def execute_step(self, ctx, data, step, step_number):
         if step["is_dir"]:
-            # Expand children as further steps; dir removed in finalize
-            # (erase.rs:99-137). Unindexed children MUST still be erased —
-            # skipping them would delete plaintext bytes unscrubbed — so
-            # they get synthetic steps without DB rows.
-            more = []
-            for entry in os.scandir(step["full_path"]):
-                if entry.is_symlink():
-                    # NEVER scrub through a symlink — the target may live
-                    # outside the erase scope. Remove just the link.
-                    os.remove(entry.path)
-                    continue
-                is_dir = entry.is_dir(follow_symlinks=False)
-                child = _child_step(
-                    ctx.db, self.location_id, data["location_path"],
-                    entry.path, is_dir)
-                if child is None:
-                    child = {"id": None, "pub_id": None, "is_dir": is_dir,
-                             "name": entry.name, "extension": "",
-                             "full_path": entry.path}
-                more.append(child)
+            more = await asyncio.to_thread(self._expand_dir, ctx, data, step)
             data["dirs_to_remove"].append(step["full_path"])
             return StepOutcome(more_steps=more)
 
@@ -231,12 +237,14 @@ class FileEraserJob(_FsJobBase):
         return StepOutcome(metadata={"erased": step["full_path"]})
 
     async def finalize(self, ctx, data, metadata):
-        # Deepest-first so nested dirs go before their parents.
-        for d in sorted(data["dirs_to_remove"], key=len, reverse=True):
-            try:
-                os.rmdir(d)
-            except OSError:
-                shutil.rmtree(d, ignore_errors=True)
+        def sweep():
+            # Deepest-first so nested dirs go before their parents.
+            for d in sorted(data["dirs_to_remove"], key=len, reverse=True):
+                try:
+                    os.rmdir(d)
+                except OSError:
+                    shutil.rmtree(d, ignore_errors=True)
+        await asyncio.to_thread(sweep)
         return metadata
 
 
@@ -253,7 +261,7 @@ class _CopyBase(_FsJobBase):
         self.target_location_id = target_location_id
         self.target_relative_directory = target_relative_directory
 
-    async def init(self, ctx: JobContext):
+    def _init_sync(self, ctx: JobContext):
         db = ctx.db
         src_path = self._location_path(ctx)
         tgt_loc = load_location(db, self.target_location_id)

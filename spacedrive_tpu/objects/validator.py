@@ -45,7 +45,7 @@ class ObjectValidatorJob(StatefulJob):
         self.backend = backend
         self.mode = mode
 
-    async def init(self, ctx: JobContext):
+    def _init_sync(self, ctx: JobContext):
         """Cursor-paginated steps (same shape as the identifier): the
         resumable state is (WHERE, cursor, counters) — O(1) regardless
         of scan size. The old design serialized every pending row into
@@ -111,10 +111,10 @@ class ObjectValidatorJob(StatefulJob):
 
     @property
     def batch_bytes(self) -> int:
-        import os as _os
+        from .. import flags
 
-        env = _os.environ.get("SDTPU_VAL_BATCH_BYTES")
-        return int(env) if env else self.BATCH_BYTES
+        env = flags.get("SDTPU_VAL_BATCH_BYTES")
+        return env if env else self.BATCH_BYTES
 
     def _checksums_jax(self, jobs, errors):
         """Device checksums, two regimes:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import cas
+from .. import flags
 from .blake3_batch import (  # noqa: F401 — re-exported for callers
     CHUNK_LEN,
     WORDS_PER_CHUNK,
@@ -166,13 +167,11 @@ def sharded_hasher():
     fresh ~50 s shard_map compile per batch grid for zero coverage
     gain (the sharded dispatch has its own dedicated test and the
     driver's dryrun_multichip stage 6)."""
-    import os as _os
-
     global _SHARDED
     if _SHARDED is None:
         devs = jax.devices()
         if (len(devs) < 2
-                or _os.environ.get("SDTPU_SHARDED_CAS", "auto") == "off"):
+                or flags.get("SDTPU_SHARDED_CAS") == "off"):
             _SHARDED = (None, 1)
         else:
             from ..parallel.mesh import batch_mesh
@@ -210,8 +209,6 @@ def checksums_words_batched(blobs) -> list:
     pays that latency once per page. Callers group similar sizes per
     call (validator sorts by size) so the shared C pads little.
     """
-    import os as _os
-
     B = len(blobs)
     if B == 0:
         return []
@@ -234,7 +231,7 @@ def checksums_words_batched(blobs) -> list:
         buf[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
         lengths[i] = len(b)
     words = buf.view("<u4").reshape(Bp, C, WORDS_PER_CHUNK)
-    if _os.environ.get("SDTPU_DISPATCH_LOG") == "1":
+    if flags.get("SDTPU_DISPATCH_LOG"):
         DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev, "C": C,
                              "kind": "checksum"})
     return digests_to_hex(hasher(words, lengths)[:B])
@@ -254,8 +251,6 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
     the mesh-sharded program (batch padded to a devices-multiple so
     every shard gets equal rows); single-device hosts use the local
     jit/Pallas path."""
-    import os as _os
-
     n_dev = 1
     if hasher is None:
         hasher, n_dev = sharded_hasher()
@@ -273,6 +268,6 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
             [words, np.zeros((Bp - B,) + words.shape[1:], words.dtype)])
         lengths = np.concatenate(
             [lengths, np.zeros((Bp - B,), lengths.dtype)])
-    if _os.environ.get("SDTPU_DISPATCH_LOG") == "1":
+    if flags.get("SDTPU_DISPATCH_LOG"):
         DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev})
     return digests_to_cas_ids(hasher(words, lengths)[:B])

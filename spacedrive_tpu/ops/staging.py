@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import cas
+from .. import flags
 
 _STAGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
@@ -300,13 +301,10 @@ def h2d_gbps() -> float:
     global _H2D_GBPS
     if _H2D_GBPS is not None:
         return _H2D_GBPS
-    env = os.environ.get("SDTPU_H2D_GBPS")
-    if env:
-        try:
-            _H2D_GBPS = float(env)
-            return _H2D_GBPS
-        except ValueError:
-            pass
+    env = flags.get("SDTPU_H2D_GBPS")
+    if env is not None:
+        _H2D_GBPS = env
+        return _H2D_GBPS
     import json
     import time
 
@@ -348,7 +346,7 @@ def h2d_gbps() -> float:
 
 def device_pipeline_worthwhile() -> bool:
     """True when staging→H2D→kernel beats the native CPU plane."""
-    mode = os.environ.get("SDTPU_DEVICE_PIPELINE", "").strip().lower()
+    mode = flags.get("SDTPU_DEVICE_PIPELINE")
     if mode in ("force", "1"):
         return True
     if mode in ("off", "0"):

@@ -155,7 +155,7 @@ class P2PManager:
                 "type": "SpacedropStarted", "id": drop_id,
                 "direction": "send", "name": req.name, "size": size,
                 "peer": f"{addr}:{port}"})
-            with open(file_path, "rb") as f:
+            with await asyncio.to_thread(open, file_path, "rb") as f:
                 ok = await send_file(tunnel, req, f, on_progress)
             return "sent" if ok else "cancelled"
         finally:
@@ -182,7 +182,7 @@ class P2PManager:
             if not isinstance(resp, dict) or resp.get("status") != "ok":
                 return False
             req = SpaceblockRequest.from_wire(resp["req"])
-            with open(out_path, "wb") as out:
+            with await asyncio.to_thread(open, out_path, "wb") as out:
                 return await receive_file(tunnel, req, out)
         finally:
             tunnel.close()
@@ -192,7 +192,8 @@ class P2PManager:
         flow (core/src/p2p/pairing/mod.rs protocol v1, simplified to one
         round-trip of signed instance info)."""
         sync = library.sync
-        me = library.db.query_one(
+        me = await asyncio.to_thread(
+            library.db.query_one,
             "SELECT * FROM instance WHERE pub_id = ?", (sync.instance,))
         tunnel = await self.open_stream(addr, port)
         try:
@@ -214,7 +215,8 @@ class P2PManager:
             if not isinstance(resp, dict) or resp.get("status") != "accepted":
                 return False
             inst = resp["instance"]
-            library.sync.register_instance(
+            await asyncio.to_thread(
+                library.sync.register_instance,
                 inst["pub_id"], identity=inst["identity"],
                 node_id=inst["node_id"], node_name=inst["node_name"])
             if self.networked is not None:
@@ -319,7 +321,7 @@ class P2PManager:
             "direction": "receive", "size": req.size, "path": save_path,
             "peer": tunnel.remote.to_bytes().hex()})
         try:
-            with open(save_path, "wb") as out:
+            with await asyncio.to_thread(open, save_path, "wb") as out:
                 await receive_file(
                     tunnel, req, out,
                     on_progress=self._progress_emitter(
@@ -353,7 +355,8 @@ class P2PManager:
                 header.get("library_name", "paired library"),
                 lib_id=uuidlib.UUID(str(header["library_id"])))
         inst = header["instance"]
-        lib.sync.register_instance(
+        await asyncio.to_thread(
+            lib.sync.register_instance,
             inst["pub_id"], identity=inst["identity"],
             node_id=inst["node_id"], node_name=inst["node_name"])
         if self.networked is not None:
@@ -366,8 +369,10 @@ class P2PManager:
             self.networked.learn_instance(
                 lib.id, inst["pub_id"], RemoteIdentity(inst["identity"]),
                 route=route)
-        me = lib.db.query_one(
-            "SELECT * FROM instance WHERE pub_id = ?", (lib.sync.instance,))
+        me = await asyncio.to_thread(
+            lib.db.query_one,
+            "SELECT * FROM instance WHERE pub_id = ?",
+            (lib.sync.instance,))
         await tunnel.send({"status": "accepted", "instance": {
             "pub_id": me["pub_id"],
             "identity": self.identity.to_remote_identity().to_bytes(),
@@ -386,12 +391,14 @@ class P2PManager:
         if lib is None:
             await tunnel.send({"status": "not_found"})
             return
-        loc = lib.db.query_one(
+        loc = await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT * FROM location WHERE pub_id = ?",
             (bytes(header["location_pub_id"]),))
-        row = lib.db.query_one(
+        row = (await asyncio.to_thread(
+            lib.db.query_one,
             "SELECT * FROM file_path WHERE pub_id = ?",
-            (bytes(header["file_path_pub_id"]),)) if loc else None
+            (bytes(header["file_path_pub_id"]),))) if loc else None
         if (row is None or loc is None or not loc["path"]
                 or row["location_id"] != loc["id"]):
             await tunnel.send({"status": "not_found"})
@@ -408,5 +415,5 @@ class P2PManager:
             os.path.basename(full), os.path.getsize(full),
             header.get("range_start"), header.get("range_end"))
         await tunnel.send({"status": "ok", "req": req.to_wire()})
-        with open(full, "rb") as f:
+        with await asyncio.to_thread(open, full, "rb") as f:
             await send_file(tunnel, req, f)

@@ -18,10 +18,10 @@ the reference's in-process transport fake
 from __future__ import annotations
 
 import asyncio
-import os
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
+from .. import flags
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..sync.manager import GetOpsArgs
@@ -228,16 +228,17 @@ class NetworkedLibraries:
                 # under windowed flow control. After the stream the
                 # peer re-requests with advanced clocks and the normal
                 # per-op loop finishes the row tail.
-                if not clone_served and os.environ.get(
-                        "SDTPU_CLONE_PASSTHROUGH", "on") != "off":
+                if not clone_served and flags.get(
+                        "SDTPU_CLONE_PASSTHROUGH"):
                     clone_served = await self._serve_clone_stream(
                         library, tunnel, clocks)
                     if clone_served:
                         continue
-                ops = library.sync.get_ops(GetOpsArgs(
-                    clocks=clocks,
-                    count=min(int(req.get("count", OPS_PER_REQUEST)),
-                              OPS_PER_REQUEST)))
+                ops = await asyncio.to_thread(
+                    library.sync.get_ops, GetOpsArgs(
+                        clocks=clocks,
+                        count=min(int(req.get("count", OPS_PER_REQUEST)),
+                                  OPS_PER_REQUEST)))
                 await tunnel.send({
                     "ops": [op.to_wire() for op in ops],
                     "has_more": len(ops) >= OPS_PER_REQUEST,
@@ -254,11 +255,17 @@ class NetworkedLibraries:
         the receiver's instance row says. Returns False (nothing sent)
         when the peer is not a fresh clone target — the caller falls
         through to the per-op page."""
-        stream = library.sync.iter_clone_stream(clocks)
+        # Generator construction is lazy — the SQL happens inside each
+        # next(), which runs off-loop below.
+        stream = library.sync.iter_clone_stream(clocks)  # sdlint: ok[blocking-async]
         started = False
         inflight = 0
         try:
-            for kind, item in stream:
+            while True:
+                nxt = await asyncio.to_thread(next, stream, None)
+                if nxt is None:
+                    break
+                kind, item = nxt
                 if not started:
                     await tunnel.send({"kind": "blob_stream",
                                        "window": CLONE_WINDOW})

@@ -22,7 +22,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from . import models
-from .. import telemetry
+from .. import sanitize, telemetry
 from ..telemetry import (
     STORE_COMMIT_SECONDS,
     STORE_TX,
@@ -56,14 +56,17 @@ class Database:
 
     def __init__(self, path: str | os.PathLike):
         self.path = str(path)
-        self._write_lock = threading.RLock()
+        # Both locks come from the sanitizer so SDTPU_SANITIZE=1 runs
+        # record lock order and held-across-await; with the sanitizer
+        # off these ARE plain threading locks.
+        self._write_lock = sanitize.tracked_rlock("db._write_lock")
         # Connection REGISTRATION serializes on its own lock, never on
         # the write lock: a reader thread opening its first connection
         # while a writer holds a long transaction (the identifier's
         # multi-chunk commit groups, which WAIT on reader-thread
         # prefetch results) must not block — with registration under
         # the write lock that wait was a deadlock.
-        self._conns_lock = threading.Lock()
+        self._conns_lock = sanitize.tracked_lock("db._conns_lock")
         self._local = threading.local()
         self._all_conns: list[sqlite3.Connection] = []
         self._closed = False

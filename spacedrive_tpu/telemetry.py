@@ -20,19 +20,23 @@ Design constraints, in order:
 - **Central namespace.** Every metric family is defined at the bottom
   of THIS module and imported by the instrumented code;
   `tools/telemetry_lint.py` (run in tier-1) fails the build on
-  families registered anywhere else or on name collisions. Names
-  follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with layers
-  jobs | identifier | sync | p2p | store | api | trace.
-- **No dependencies.** Pure stdlib, imports nothing from the package —
-  importable from every layer (store, p2p, ops) without cycles.
+  families registered anywhere else or on name collisions (since
+  round 9 the lint is sdlint's telemetry pass; the shim remains).
+  Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
+  layers jobs | identifier | sync | p2p | store | api | trace |
+  sanitize.
+- **No dependencies.** Pure stdlib plus the equally dependency-free
+  flag registry (flags.py) — importable from every layer (store, p2p,
+  ops) without cycles.
 """
 
 from __future__ import annotations
 
-import os
 import threading
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import flags
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -42,8 +46,7 @@ __all__ = [
 
 # Module-global hot-path switch: one LOAD_GLOBAL in every increment.
 # Rebound (not mutated) by set_enabled so readers need no lock.
-_ENABLED = os.environ.get("SDTPU_TELEMETRY", "on").strip().lower() not in (
-    "off", "0", "false")
+_ENABLED = flags.get("SDTPU_TELEMETRY")
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -517,3 +520,14 @@ API_REQUESTS = counter(
 TRACE_SPANS = counter(
     "sd_trace_spans_total", "Spans recorded into the ring buffer",
     labelnames=("ok",))
+
+# -- sanitizer (sanitize.py) ------------------------------------------------
+SANITIZE_VIOLATIONS = counter(
+    "sd_sanitize_violations_total",
+    "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
+    "loop_stall | lock_across_await | lock_order_cycle",
+    labelnames=("kind",))
+SANITIZE_LOOP_MAX_STALL = gauge(
+    "sd_sanitize_loop_max_stall_seconds",
+    "Longest single event-loop callback observed by the sanitizer "
+    "since process start (0 while the sanitizer is off)")

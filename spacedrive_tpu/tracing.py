@@ -31,7 +31,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import telemetry
+from . import flags, telemetry
 
 logger = logging.getLogger("spacedrive_tpu")
 
@@ -106,7 +106,7 @@ def _ensure_profiler() -> bool:
     with _profiler_lock:
         if _profiler_state is not None:
             return _profiler_state
-        profile_dir = os.environ.get("SDTPU_PROFILE")
+        profile_dir = flags.get("SDTPU_PROFILE")
         if not profile_dir:
             _profiler_state = False
             return False

@@ -22,6 +22,24 @@ ensure_host_device_count(8)
 # this back) plus the driver's dryrun_multichip stage 6.
 os.environ.setdefault("SDTPU_SHARDED_CAS", "off")
 
+# Tier-1 runs SANITIZED (spacedrive_tpu/sanitize.py): every asyncio
+# callback is timed (loop-stall detector), the store's locks record
+# acquisition order (cycle check raises), and a lock held across an
+# await is a violation. `raise` mode surfaces lock-order cycles as
+# exceptions at the acquire; asynchronous detections (stalls,
+# held-across-await) are asserted ZERO per test by the autouse fixture
+# below. Install BEFORE any Database is constructed so its locks come
+# from the sanitizer.
+os.environ.setdefault("SDTPU_SANITIZE", "1")
+os.environ.setdefault("SDTPU_SANITIZE_MODE", "raise")
+# CI containers run 2 cores over a 9p filesystem with ±40% IO weather;
+# the production 1.0s stall threshold false-positives there on genuine
+# thread-pool contention. 2.5s still catches real loop hogs.
+os.environ.setdefault("SDTPU_SANITIZE_STALL_S", "2.5")
+from spacedrive_tpu import sanitize  # noqa: E402
+
+sanitize.install()
+
 # The axon TPU plugin registers at interpreter start (sitecustomize) and
 # sets jax_platforms="axon,cpu", so merely calling jax.devices() would
 # initialize the TPU tunnel (slow, single-client). Tests never need the
@@ -41,6 +59,20 @@ def pytest_configure(config):
 @pytest.fixture
 def cpu_devices():
     return jax.devices("cpu")
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_clean():
+    """Every test must finish with zero NEW sanitizer violations —
+    the runtime half of the sdlint acceptance gate. Tests that
+    deliberately trigger violations (test_sanitize.py) reset the list
+    before returning, so this stays green for them too."""
+    before = len(sanitize.violations())
+    yield
+    fresh = sanitize.violations()[before:]
+    assert not fresh, (
+        "sanitizer violations during test: "
+        + "; ".join(f"{v['kind']}: {v['detail']}" for v in fresh[:3]))
 
 
 async def pair_two_nodes(a, b, library_name: str = "shared"):

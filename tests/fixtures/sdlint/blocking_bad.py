@@ -1,0 +1,32 @@
+"""sdlint fixture — blocking-async KNOWN POSITIVES.
+
+Not imported by anything; tests/test_sdlint.py lints this file and
+asserts each shape below is flagged.
+"""
+
+import time
+
+
+async def direct_sqlite(db):
+    # sqlite on the event loop
+    return db.query("SELECT 1")
+
+
+async def direct_sleep():
+    time.sleep(0.1)  # time.sleep on the event loop
+
+
+def helper(store):
+    return store.db.query_one("SELECT 1")
+
+
+async def reaches_through_helper(store):
+    # interprocedural: helper() blocks, and this call is not wrapped
+    # (the argument is not itself a db handle, so only the call-graph
+    # walk can see the violation)
+    return helper(store)
+
+
+async def passes_db_handle(report, library):
+    # passing a live Database into a writer helper
+    report.update(library.db)

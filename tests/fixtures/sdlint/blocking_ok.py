@@ -1,0 +1,22 @@
+"""sdlint fixture — blocking-async KNOWN NEGATIVES (all clean)."""
+
+import asyncio
+
+
+def helper(db):
+    return db.query_one("SELECT 1")
+
+
+async def wrapped_everywhere(db):
+    rows = await asyncio.to_thread(db.query, "SELECT 1")
+    one = await asyncio.to_thread(helper, db)
+    await asyncio.sleep(0.01)  # asyncio.sleep is awaited → fine
+    return rows, one
+
+
+async def sync_callback_not_executed(db):
+    # a nested def is only DEFINED here; its body runs on a worker
+    def work():
+        return db.query("SELECT 1")
+
+    return await asyncio.to_thread(work)

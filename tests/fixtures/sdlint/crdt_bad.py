@@ -1,0 +1,22 @@
+"""sdlint fixture — crdt-parity KNOWN POSITIVES.
+
+Both functions write SHARED model tables (tag, object — real names
+from store/models.py) inside a plain tx with no op emission in scope:
+the silent-divergence bug the pass exists to catch.
+"""
+
+import time
+
+
+def tag_create_silent(db, values):
+    with db.tx() as conn:
+        conn.execute(
+            "INSERT INTO tag (pub_id, name) VALUES (?, ?)",
+            (values["pub_id"], values["name"]))
+
+
+def object_update_silent(db, oid):
+    with db.tx() as conn:
+        conn.execute(
+            "UPDATE object SET date_accessed = ? WHERE id = ?",
+            (int(time.time()), oid))

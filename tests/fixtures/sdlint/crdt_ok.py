@@ -1,0 +1,18 @@
+"""sdlint fixture — crdt-parity KNOWN NEGATIVES (all clean)."""
+
+
+def tag_create_synced(db, sync, values, pub_id):
+    ops = sync.shared_create("tag", pub_id, values)
+    with sync.write_ops(ops) as conn:
+        db.insert("tag", {"pub_id": pub_id, **values}, conn=conn)
+
+
+def bulk_synced(db, sync, conn, specs, rows):
+    db.insert_many("file_path", rows, conn=conn)
+    sync.bulk_shared_ops(conn, "file_path", specs)
+
+
+def local_table_write(db):
+    # volume is a LOCAL model — never synced, no ops required
+    with db.tx() as conn:
+        conn.execute("INSERT INTO volume (name) VALUES (?)", ("v",))

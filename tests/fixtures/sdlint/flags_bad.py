@@ -1,0 +1,17 @@
+"""sdlint fixture — flag-registry KNOWN POSITIVES."""
+
+import os
+
+
+def read_undeclared():
+    # typo'd / never-declared flag: silently returns None at runtime
+    return os.environ.get("SDTPU_NOT_A_REAL_FLAG")
+
+
+def read_outside_registry():
+    # declared flag, but read around the registry
+    return os.environ.get("SDTPU_TELEMETRY", "on")
+
+
+def subscript_read_outside_registry():
+    return os.environ["SDTPU_PROFILE"]

@@ -1,0 +1,15 @@
+"""sdlint fixture — flag-registry KNOWN NEGATIVES (all clean)."""
+
+import os
+
+from spacedrive_tpu import flags
+
+
+def read_via_registry():
+    return flags.get("SDTPU_TELEMETRY")
+
+
+def writes_are_allowed():
+    os.environ["SDTPU_TELEMETRY"] = "off"
+    os.environ.setdefault("SDTPU_SHARDED_CAS", "off")
+    os.environ.pop("SDTPU_TELEMETRY", None)

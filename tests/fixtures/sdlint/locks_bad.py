@@ -1,0 +1,64 @@
+"""sdlint fixture — lock-discipline KNOWN POSITIVES.
+
+`Pr1Database` preserves the shape `store/db.py` had BEFORE PR 1's fix,
+the deadlock that motivated this pass: connection REGISTRATION
+serialized on the WRITE lock (`_conn`), while a writer holds that same
+lock across a cross-thread wait on reader futures (`commit_group`).
+A reader thread opening its first connection blocks on `_write_lock`;
+the writer never releases it because it is waiting on that reader.
+The pass must flag the `fut.result()` under `_write_lock`
+(wait-under-lock) — the encoded regression test for the PR 1 bug.
+"""
+
+import threading
+
+
+class Pr1Database:
+    def __init__(self):
+        self._write_lock = threading.RLock()
+        self._all_conns = []
+
+    def _conn(self):
+        with self._write_lock:  # registration under the WRITE lock
+            conn = object()
+            self._all_conns.append(conn)
+            return conn
+
+    def commit_group(self, prefetch_futures):
+        with self._write_lock:
+            for fut in prefetch_futures:
+                rows = fut.result()  # waits on readers that need _conn()
+                self._write(rows)
+
+    def _write(self, rows):
+        pass
+
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def take_ab():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def take_ba():  # opposite order → AB/BA cycle
+    with b_lock:
+        with a_lock:
+            pass
+
+
+async def suspended_critical_section(db):
+    with db._write_lock:
+        await asyncio_notify()  # coroutine parks while holding the lock
+
+
+async def asyncio_notify():
+    pass
+
+
+def nested_transaction(db, rows):
+    with db.tx() as conn:
+        db.insert("job", {"id": 1})  # opens a SECOND tx inside the first

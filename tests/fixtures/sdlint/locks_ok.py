@@ -1,0 +1,51 @@
+"""sdlint fixture — lock-discipline KNOWN NEGATIVES (all clean).
+
+`FixedDatabase` is the post-PR 1 shape: registration has its own leaf
+lock, and commit groups drain their futures BEFORE taking the write
+lock. Lock order is consistent everywhere (write → conns).
+"""
+
+import threading
+
+
+class FixedDatabase:
+    def __init__(self):
+        self._write_lock = threading.RLock()
+        self._conns_lock = threading.Lock()
+        self._all_conns = []
+
+    def _conn(self):
+        with self._conns_lock:  # leaf lock, never the write lock
+            conn = object()
+            self._all_conns.append(conn)
+            return conn
+
+    def commit_group(self, prefetch_futures):
+        batches = [fut.result() for fut in prefetch_futures]  # lock-free
+        with self._write_lock:
+            for rows in batches:
+                self._write(rows)
+
+    def teardown(self):
+        with self._write_lock:
+            with self._conns_lock:  # same order as everywhere else
+                self._all_conns.clear()
+
+    def _write(self, rows):
+        pass
+
+
+def tx_with_passed_conn(db, sync, rows, ops):
+    with sync.write_ops(ops) as conn:
+        db.insert("job", {"id": 1}, conn=conn)  # reuses the open tx
+
+
+async def lock_released_before_await(db):
+    with db._write_lock:
+        value = 1
+    await asyncio_notify()
+    return value
+
+
+async def asyncio_notify():
+    pass

@@ -1,0 +1,7 @@
+"""sdlint fixture — telemetry-pass KNOWN POSITIVE: a metric family
+registered outside the central registry."""
+
+from spacedrive_tpu.telemetry import counter
+
+ROGUE = counter("sd_rogue_things_total",
+                "registered outside spacedrive_tpu/telemetry.py")

@@ -1,0 +1,8 @@
+"""sdlint fixture — telemetry-pass KNOWN NEGATIVE: importing and using
+a centrally-defined family is the sanctioned idiom."""
+
+from spacedrive_tpu.telemetry import JOBS_INGESTED
+
+
+def record():
+    JOBS_INGESTED.inc()

@@ -1,0 +1,143 @@
+"""Runtime sanitizer (spacedrive_tpu/sanitize.py): the dynamic half of
+sdlint. Tier-1 runs the whole suite under SDTPU_SANITIZE=1 (conftest);
+these tests exercise each detector deliberately and then reset the
+violation list so the autouse zero-violations fixture stays green.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from spacedrive_tpu import sanitize, telemetry
+from spacedrive_tpu.telemetry import SANITIZE_VIOLATIONS
+
+
+@pytest.fixture
+def clean_violations():
+    yield
+    sanitize.reset_violations()
+
+
+def test_installed_by_conftest():
+    assert sanitize.installed()
+
+
+def test_tracked_locks_back_the_store(tmp_path):
+    from spacedrive_tpu.store.db import Database
+
+    db = Database(str(tmp_path / "t.db"))
+    assert getattr(db._write_lock, "name", None) == "db._write_lock"
+    assert getattr(db._conns_lock, "name", None) == "db._conns_lock"
+    with db.tx():
+        assert "db._write_lock" in sanitize.held_tracked_locks()
+    assert "db._write_lock" not in sanitize.held_tracked_locks()
+    db.close()
+
+
+def test_lock_order_cycle_raises(clean_violations):
+    a = sanitize.tracked_rlock("test_cycle_a")
+    b = sanitize.tracked_rlock("test_cycle_b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(sanitize.SanitizerViolation):
+            with a:
+                pass
+
+
+def test_cross_instance_same_name_cycle_detected(clean_violations):
+    """Two locks SHARING a name (every Database names its write lock
+    db._write_lock) are distinct graph nodes: opposite acquisition
+    orders across instances is a real AB/BA deadlock and must raise."""
+    a = sanitize.tracked_rlock("test_same_name")
+    b = sanitize.tracked_rlock("test_same_name")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(sanitize.SanitizerViolation):
+            with a:
+                pass
+
+
+def test_reentrant_rlock_is_not_a_cycle():
+    lk = sanitize.tracked_rlock("test_reentrant")
+    with lk:
+        with lk:
+            assert sanitize.held_tracked_locks().count("test_reentrant") == 2
+
+
+def test_lock_across_await_detected(clean_violations):
+    lk = sanitize.tracked_lock("test_across_await")
+
+    async def bad():
+        lk.acquire()
+        try:
+            # Two suspension points in one held episode: the detector
+            # must report the lock ONCE, not once per loop callback.
+            await asyncio.sleep(0.01)
+            await asyncio.sleep(0.01)
+        finally:
+            lk.release()
+
+    asyncio.run(bad())
+    hits = [v for v in sanitize.violations()
+            if v["kind"] == "lock_across_await"
+            and "test_across_await" in v["detail"]]
+    assert len(hits) == 1, hits
+
+
+def test_loop_stall_detected(clean_violations, monkeypatch):
+    monkeypatch.setattr(sanitize, "_stall_s", 0.05)
+    before = SANITIZE_VIOLATIONS.labels(kind="loop_stall").value
+
+    async def stall():
+        time.sleep(0.12)  # blocks the loop past the tightened threshold
+
+    asyncio.run(stall())
+    assert any(v["kind"] == "loop_stall" for v in sanitize.violations())
+    if telemetry.enabled():
+        assert SANITIZE_VIOLATIONS.labels(
+            kind="loop_stall").value > before
+
+
+def test_no_stall_below_threshold():
+    before = len(sanitize.violations())
+
+    async def fine():
+        await asyncio.sleep(0.01)
+
+    asyncio.run(fine())
+    assert len(sanitize.violations()) == before
+
+
+def test_cross_thread_lock_tracking_is_per_thread():
+    lk = sanitize.tracked_lock("test_thread_local")
+    seen = []
+
+    def worker():
+        seen.append(sanitize.held_tracked_locks())
+
+    with lk:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen == [[]]  # the other thread holds nothing
+
+
+def test_violations_surface_in_metrics_snapshot(clean_violations):
+    """sd_sanitize_* families are part of the node-wide namespace:
+    a recorded violation shows up in telemetry.snapshot() and the
+    Prometheus rendering (the production `count`-mode wiring)."""
+    if not telemetry.enabled():
+        pytest.skip("telemetry disabled in this environment")
+    before = SANITIZE_VIOLATIONS.labels(kind="loop_stall").value
+    sanitize._record("loop_stall", "synthetic (test)", may_raise=False)
+    assert SANITIZE_VIOLATIONS.labels(
+        kind="loop_stall").value == before + 1
+    snap = telemetry.snapshot()
+    assert "sd_sanitize_violations_total" in snap
+    assert "sd_sanitize_violations_total" in telemetry.render_prometheus()

@@ -1,0 +1,185 @@
+"""sdlint framework: per-pass fixtures, the tree gate, baseline policy.
+
+This is the tier-1 hook that replaced the direct telemetry_lint run:
+`test_tree_clean_within_baseline` runs ALL five passes over the repo
+and fails on any finding not in tools/sdlint/baseline.json (which may
+only shrink — budget enforced here too). The per-pass tests pin each
+pass to a known-positive / known-negative fixture pair under
+tests/fixtures/sdlint/, including the encoded PR 1 store/db.py
+reader-registration deadlock shape (locks_bad.Pr1Database).
+"""
+
+import os
+
+from tools.sdlint import Baseline, load_project, run_passes
+from tools.sdlint.baseline import DEFAULT_PATH
+from tools.sdlint.passes import PASSES, get_passes
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "sdlint")
+
+
+def _lint_fixture(filename, pass_name):
+    project = load_project(ROOT, [os.path.join(FIXTURES, filename)])
+    return run_passes(project, get_passes([pass_name]))
+
+
+# -- blocking-async ---------------------------------------------------------
+
+def test_blocking_async_flags_known_positives():
+    found = _lint_fixture("blocking_bad.py", "blocking-async")
+    idents = {f.ident for f in found}
+    quals = {f.qual for f in found}
+    assert "direct:db.query" in idents              # sqlite on the loop
+    assert "direct:time.sleep" in idents
+    assert any(i.startswith("via:helper:") for i in idents), idents
+    assert "passes_db_handle" in quals              # report.update(lib.db)
+
+
+def test_blocking_async_passes_known_negatives():
+    assert _lint_fixture("blocking_ok.py", "blocking-async") == []
+
+
+# -- lock-discipline --------------------------------------------------------
+
+def test_lock_discipline_catches_pr1_deadlock_shape():
+    """The encoded PR 1 regression: fut.result() while holding
+    _write_lock, with registration serialized on the same lock."""
+    found = _lint_fixture("locks_bad.py", "lock-discipline")
+    waits = [f for f in found if f.code == "wait-under-lock"]
+    assert any(f.qual == "Pr1Database.commit_group"
+               and "_write_lock" in f.ident for f in waits), found
+
+
+def test_lock_discipline_other_positives():
+    found = _lint_fixture("locks_bad.py", "lock-discipline")
+    codes = {f.code for f in found}
+    assert "await-under-lock" in codes
+    assert "nested-write-tx" in codes
+    cycles = [f for f in found if f.code == "lock-order-cycle"]
+    assert any("a_lock" in f.ident and "b_lock" in f.ident
+               for f in cycles), found
+
+
+def test_lock_discipline_passes_known_negatives():
+    assert _lint_fixture("locks_ok.py", "lock-discipline") == []
+
+
+# -- crdt-parity ------------------------------------------------------------
+
+def test_crdt_parity_flags_silent_shared_writes():
+    found = _lint_fixture("crdt_bad.py", "crdt-parity")
+    idents = {f.ident for f in found}
+    assert idents == {"tag", "object"}, found
+
+
+def test_crdt_parity_passes_known_negatives():
+    assert _lint_fixture("crdt_ok.py", "crdt-parity") == []
+
+
+# -- flag-registry ----------------------------------------------------------
+
+def test_flag_registry_flags_known_positives():
+    found = _lint_fixture("flags_bad.py", "flag-registry")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "SDTPU_NOT_A_REAL_FLAG" in by_code.get("undeclared-flag", set())
+    assert "SDTPU_TELEMETRY" in by_code.get("environ-read", set())
+    assert "SDTPU_PROFILE" in by_code.get("environ-read", set())
+
+
+def test_flag_registry_passes_known_negatives():
+    assert _lint_fixture("flags_ok.py", "flag-registry") == []
+
+
+# -- telemetry (the folded-in PR 3 lint) ------------------------------------
+
+def test_telemetry_pass_flags_rogue_registration():
+    found = _lint_fixture("telemetry_bad.py", "telemetry")
+    assert any("outside the central" in f.message for f in found), found
+
+
+def test_telemetry_pass_passes_known_negatives():
+    assert _lint_fixture("telemetry_ok.py", "telemetry") == []
+
+
+def test_telemetry_lint_shim_api_intact():
+    """tools/telemetry_lint.py keeps its pre-sdlint public surface."""
+    from tools import telemetry_lint
+
+    assert callable(telemetry_lint.run_lint)
+    assert callable(telemetry_lint.lint_source)
+    assert telemetry_lint.NAME_RE.match("sd_sanitize_violations_total")
+
+
+# -- the tree gate (runs all five passes; tier-1's CI hook) -----------------
+
+def test_tree_clean_within_baseline():
+    project = load_project(ROOT)
+    findings = run_passes(project)
+    baseline = Baseline.load()
+    new, _old, _stale = baseline.split(findings)
+    assert not new, (
+        "new sdlint findings (fix them — the baseline only shrinks):\n"
+        + "\n".join(f.text() for f in new))
+
+
+def test_baseline_within_budget_and_entries_reasoned():
+    baseline = Baseline.load(DEFAULT_PATH)
+    assert len(baseline.entries) <= baseline.budget, (
+        f"baseline grew past its budget ({len(baseline.entries)} > "
+        f"{baseline.budget}): entries were added by hand — fix the "
+        "findings instead (tools/sdlint/baseline.py policy)")
+    for key, reason in baseline.entries.items():
+        assert reason.strip(), f"baseline entry without a reason: {key}"
+
+
+def test_baseline_prune_never_adds():
+    bl = Baseline({"stale::key": "gone", "live::key": "still here"}, 2)
+    from tools.sdlint.core import Finding
+
+    live = Finding("p", "c", "f.py", "q", "i", "msg", 1)
+    bl.entries = {live.key(): "still here", "stale::key": "gone"}
+    dropped = bl.prune([live])
+    assert dropped == ["stale::key"]
+    assert set(bl.entries) == {live.key()}
+    assert bl.budget == 1
+
+
+def test_every_registered_pass_ran_on_tree():
+    assert set(PASSES) == {
+        "blocking-async", "lock-discipline", "crdt-parity",
+        "flag-registry", "telemetry"}
+
+
+# -- flags registry integration --------------------------------------------
+
+def test_flag_table_covers_every_declared_flag():
+    from spacedrive_tpu import flags
+
+    table = flags.flag_table_markdown()
+    for name in flags.FLAGS:
+        assert f"`{name}`" in table
+
+
+def test_flags_get_parses_and_defaults(monkeypatch):
+    from spacedrive_tpu import flags
+
+    monkeypatch.delenv("SDTPU_TELEMETRY_INTERVAL", raising=False)
+    assert flags.get("SDTPU_TELEMETRY_INTERVAL") == 15.0
+    monkeypatch.setenv("SDTPU_TELEMETRY_INTERVAL", "2.5")
+    assert flags.get("SDTPU_TELEMETRY_INTERVAL") == 2.5
+    monkeypatch.setenv("SDTPU_TELEMETRY_INTERVAL", "junk")
+    assert flags.get("SDTPU_TELEMETRY_INTERVAL") == 15.0  # defensive
+    import pytest
+
+    with pytest.raises(KeyError):
+        flags.get("SDTPU_NEVER_DECLARED")
+    # strict flags fail LOUD on malformed values (a fuzz-seed typo must
+    # not silently replay the default corpus)
+    monkeypatch.setenv("SDTPU_FUZZ_SEEDS", "5 9")
+    with pytest.raises(ValueError):
+        flags.get("SDTPU_FUZZ_SEEDS")
+    monkeypatch.setenv("SDTPU_FUZZ_SEEDS", "5,9")
+    assert flags.get("SDTPU_FUZZ_SEEDS") == [5, 9]

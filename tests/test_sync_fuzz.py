@@ -162,8 +162,9 @@ def _state(lib):
     return tags, objs, rels
 
 
-_SEEDS = [int(s) for s in os.environ.get(
-    "SDTPU_FUZZ_SEEDS", "7,23").split(",")]
+from spacedrive_tpu import flags as _flags
+
+_SEEDS = _flags.get("SDTPU_FUZZ_SEEDS")
 
 
 def test_three_node_blob_relay_convergence(tmp_path):

@@ -1,0 +1,33 @@
+"""sdlint — multi-pass concurrency & invariant analyzer for spacedrive_tpu.
+
+An AST + call-graph static-analysis framework over `spacedrive_tpu/`
+and `tools/`, checking the invariant families the engine hand-enforces
+(in the compile-time-checkable spirit of RacerD, Blackshear et al.,
+OOPSLA 2018):
+
+- blocking-async   — blocking calls (sqlite, file IO, time.sleep,
+                     subprocess, native encoders, future waits)
+                     reachable from `async def` without
+                     asyncio.to_thread/executor wrapping, via an
+                     interprocedural reachability walk
+- lock-discipline  — awaits/blocking waits while a threading lock is
+                     held, nested write-transaction entry inside an
+                     open transaction, and lock-order cycles over the
+                     project-wide lock graph (the PR 1 store/db.py
+                     reader-registration deadlock shape)
+- crdt-parity      — transactions writing SHARED/RELATION model tables
+                     without emitting a sync op in the same scope
+- flag-registry    — every SDTPU_* literal declared in
+                     spacedrive_tpu/flags.py; no direct environ reads
+                     of SDTPU flags outside the registry
+- telemetry        — the PR 3 metric-namespace lint, folded in
+                     (tools/telemetry_lint.py remains as a CLI shim)
+
+Run `python -m tools.sdlint --help`. Findings ship as human text or
+JSON; known findings live in `tools/sdlint/baseline.json`, which may
+only shrink (see baseline.py). The runtime twin of this tool is
+`spacedrive_tpu/sanitize.py` (SDTPU_SANITIZE=1).
+"""
+
+from .core import Finding, Project, load_project, run_passes  # noqa: F401
+from .baseline import Baseline  # noqa: F401

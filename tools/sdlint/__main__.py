@@ -1,0 +1,118 @@
+"""sdlint CLI.
+
+    python -m tools.sdlint                     # lint the tree, text out
+    python -m tools.sdlint --json              # machine-readable findings
+    python -m tools.sdlint --passes lock-discipline,crdt-parity
+    python -m tools.sdlint --update-baseline   # prune stale entries only
+    python -m tools.sdlint --write-baseline    # bootstrap (see policy!)
+    python -m tools.sdlint --flag-table        # README flag table stdout
+
+Exit status: 0 when every finding is baselined (or none), 1 otherwise.
+The baseline may only shrink — see tools/sdlint/baseline.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .baseline import DEFAULT_PATH, Baseline
+from .core import load_project, repo_root, run_passes
+from .passes import get_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sdlint",
+        description="spacedrive_tpu concurrency & invariant analyzer")
+    ap.add_argument("--root", default=repo_root(),
+                    help="repo root (default: auto)")
+    ap.add_argument("--passes", default="",
+                    help="comma-separated subset of passes")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=DEFAULT_PATH,
+                    help="baseline file path")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="prune stale baseline entries + lower budget "
+                         "(never adds)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="bootstrap: write ALL current findings as the "
+                         "baseline (policy: one-time, review-visible)")
+    ap.add_argument("--flag-table", action="store_true",
+                    help="print the generated README flag table and exit")
+    args = ap.parse_args(argv)
+
+    if args.no_baseline and (args.update_baseline or args.write_baseline):
+        ap.error("--no-baseline cannot be combined with "
+                 "--update-baseline/--write-baseline (it would rewrite "
+                 "the baseline from an empty view)")
+
+    if args.flag_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import flags
+        print(flags.flag_table_markdown())
+        return 0
+
+    pass_names = [p.strip() for p in args.passes.split(",") if p.strip()]
+    passes = get_passes(pass_names or None)
+    project = load_project(args.root)
+    findings = run_passes(project, passes)
+    # A subset run must not judge (or prune!) other passes' baseline
+    # entries: out-of-scope keys are carved out and merged back on save.
+    out_of_scope = {}
+
+    if args.write_baseline:
+        bl = Baseline({f.key(): f.message for f in findings},
+                      budget=len({f.key() for f in findings}))
+        bl.save(args.baseline)
+        print(f"baseline written: {len(bl.entries)} entr(y/ies), "
+              f"budget {bl.budget}")
+        return 0
+
+    bl = Baseline({}, 0) if args.no_baseline else Baseline.load(args.baseline)
+    if pass_names:
+        ran = set(pass_names) | {"core"}
+        out_of_scope = {k: v for k, v in bl.entries.items()
+                        if k.split("::", 1)[0] not in ran}
+        bl.entries = {k: v for k, v in bl.entries.items()
+                      if k not in out_of_scope}
+    new, baselined, stale = bl.split(findings)
+
+    if args.update_baseline:
+        dropped = bl.prune(findings)
+        bl.entries.update(out_of_scope)
+        bl.budget += len(out_of_scope)
+        bl.save(args.baseline)
+        print(f"baseline: dropped {len(dropped)} stale entr(y/ies), "
+              f"{len(bl.entries)} remain, budget {bl.budget}")
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in new],
+            "baselined": [f.as_json() for f in baselined],
+            "stale_baseline_keys": stale,
+            "budget": bl.budget,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.text())
+        if stale and not args.update_baseline:
+            print(f"note: {len(stale)} stale baseline entr(y/ies) — run "
+                  f"--update-baseline to shrink the file",
+                  file=sys.stderr)
+        print(f"sdlint: {len(new)} new finding(s), "
+              f"{len(baselined)} baselined, {len(stale)} stale")
+    if bl.over_budget():
+        print("sdlint: baseline exceeds its budget — entries were added "
+              "by hand without raising the budget (see baseline.py "
+              "policy)", file=sys.stderr)
+        return 1
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

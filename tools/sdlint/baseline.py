@@ -1,0 +1,75 @@
+"""Baseline (suppression) file — allowed to SHRINK, never to grow.
+
+`tools/sdlint/baseline.json` records the finding keys that were
+present when a pass first landed and were judged acceptable (with a
+one-line reason each). Policy, enforced by tests/test_sdlint.py:
+
+- every current finding must be in the baseline (or the build fails);
+- the checked-in `budget` is an upper bound on baseline size; adding
+  an entry without raising the budget fails the build, and raising the
+  budget is a human, review-visible act;
+- `--update-baseline` only PRUNES entries whose finding no longer
+  exists and lowers the budget to the new size — it cannot add.
+
+Fixing a finding therefore shrinks the file on the next
+`--update-baseline`; introducing one makes CI red until the code is
+fixed (or a reviewer deliberately grows the baseline by hand).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+class Baseline:
+    def __init__(self, entries: Dict[str, str], budget: int):
+        self.entries = dict(entries)     # finding key → reason
+        self.budget = budget
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_PATH) -> "Baseline":
+        if not os.path.exists(path):
+            return cls({}, 0)
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls(raw.get("findings", {}), int(raw.get("budget", 0)))
+
+    def save(self, path: str = DEFAULT_PATH) -> None:
+        raw = {
+            "_policy": (
+                "Shrink-only. New findings must be FIXED, not "
+                "baselined; --update-baseline prunes stale entries and "
+                "lowers the budget, never adds. See baseline.py."),
+            "budget": self.budget,
+            "findings": {k: self.entries[k] for k in sorted(self.entries)},
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(raw, f, indent=2)
+            f.write("\n")
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(new, baselined, stale_keys) for a findings set."""
+        current = {f.key() for f in findings}
+        new = [f for f in findings if f.key() not in self.entries]
+        old = [f for f in findings if f.key() in self.entries]
+        stale = sorted(k for k in self.entries if k not in current)
+        return new, old, stale
+
+    def over_budget(self) -> bool:
+        return len(self.entries) > self.budget
+
+    def prune(self, findings: Sequence[Finding]) -> List[str]:
+        """Drop stale entries, lower the budget. Returns dropped keys."""
+        _new, _old, stale = self.split(findings)
+        for k in stale:
+            del self.entries[k]
+        self.budget = min(self.budget, len(self.entries)) \
+            if self.budget else len(self.entries)
+        return stale

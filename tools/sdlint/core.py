@@ -1,0 +1,331 @@
+"""sdlint core: source index, function table, call graph, findings.
+
+Everything here is pure-stdlib AST work. The call graph is a
+best-effort static over-approximation with three resolution tiers
+(documented at `ProjectIndex.resolve`): same-class methods, same-module
+functions, then project-unique names. Passes receive a `Project` and
+return `Finding`s; unresolvable dynamic dispatch (router handler
+tables, callbacks) is out of scope by design — the runtime sanitizer
+(spacedrive_tpu/sanitize.py) covers that half.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+SUPPRESS_RE = re.compile(r"#\s*sdlint:\s*ok\[([a-z0-9_,-]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One problem. `key()` is the stable baseline identity: it omits
+    line numbers so unrelated edits above a known finding do not churn
+    the baseline file."""
+
+    pass_name: str           # e.g. "blocking-async"
+    code: str                # short rule id within the pass
+    path: str                # repo-relative posix path
+    qual: str                # enclosing function qualname ("" = module)
+    ident: str               # stable detail (root call, lock pair, ...)
+    message: str             # human sentence
+    lineno: int
+
+    def key(self) -> str:
+        return "::".join(
+            (self.pass_name, self.code, self.path, self.qual, self.ident))
+
+    def text(self) -> str:
+        where = f"{self.path}:{self.lineno}"
+        q = f" [{self.qual}]" if self.qual else ""
+        return f"{where}: ({self.pass_name}/{self.code}){q} {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "pass": self.pass_name, "code": self.code, "path": self.path,
+            "qual": self.qual, "ident": self.ident,
+            "message": self.message, "line": self.lineno,
+            "key": self.key(),
+        }
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    name: str                 # dotted callee ("self.foo", "mod.f", "f")
+    wrapped: bool             # appears inside a to_thread/executor arg
+
+
+@dataclass
+class FuncInfo:
+    src: "SourceFile"
+    qual: str                 # "Class.method" | "func" | "outer.inner"
+    cls: Optional[str]        # enclosing class name, if a method
+    node: ast.AST             # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    calls: List[CallSite] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+# Calls whose ARGUMENTS are function references executed off-loop —
+# anything passed into them is not executed on the caller's thread.
+_THREAD_WRAPPERS = {"to_thread", "run_in_executor", "submit",
+                    "call_soon_threadsafe"}
+
+
+class SourceFile:
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=relpath)
+        # line numbers carrying an `# sdlint: ok[...]` suppression,
+        # mapped to the pass names they waive.
+        self.suppressions: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions[i] = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()}
+
+    def suppressed(self, pass_name: str, lineno: int) -> bool:
+        """A finding is waived by a marker on its line or the line
+        above (the comment-above idiom)."""
+        for ln in (lineno, lineno - 1):
+            waived = self.suppressions.get(ln)
+            if waived and (pass_name in waived or "all" in waived):
+                return True
+        return False
+
+
+class _FuncCollector(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, out: List[FuncInfo]):
+        self.src = src
+        self.out = out
+        self._stack: List[str] = []
+        self._cls: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._cls.append(node.name)
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+        self._cls.pop()
+
+    def _func(self, node, is_async: bool):
+        qual = ".".join(self._stack + [node.name])
+        info = FuncInfo(self.src, qual,
+                        self._cls[-1] if self._cls else None,
+                        node, is_async)
+        _collect_calls(node, info)
+        self.out.append(info)
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._func(node, is_async=True)
+
+
+def own_body_walk(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's OWN statements: nested function/lambda bodies
+    are skipped (their code does not run when this function runs), but
+    the nested nodes themselves are yielded so callers can see the
+    boundary if they care."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_calls(fn_node: ast.AST, info: FuncInfo) -> None:
+    wrapped_args: Set[int] = set()
+    for node in own_body_walk(fn_node):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.rsplit(".", 1)[-1] in _THREAD_WRAPPERS:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        wrapped_args.add(id(sub))
+    for node in own_body_walk(fn_node):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None:
+                continue
+            info.calls.append(
+                CallSite(node, d, wrapped=id(node) in wrapped_args))
+
+
+# Attribute names too ubiquitous across the stdlib/ecosystem to resolve
+# by name alone: `task.cancel()` must not resolve to JobManager.cancel,
+# `conn.close()` not to Database.close. Methods on self/cls still
+# resolve; these only gate the name-based fallback tiers.
+_COMMON_ATTRS = {
+    "cancel", "close", "stop", "start", "run", "get", "put", "set",
+    "send", "recv", "read", "write", "update", "create", "delete",
+    "insert", "append", "pop", "clear", "add", "remove", "discard",
+    "join", "result", "done", "wait", "acquire", "release", "open",
+    "items", "keys", "values", "submit", "flush", "commit", "rollback",
+    "execute", "encode", "decode", "emit", "copy", "next", "save",
+    "load", "name",
+}
+
+
+class ProjectIndex:
+    """Function table + the three-tier call resolver."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.funcs: List[FuncInfo] = []
+        for src in files:
+            _FuncCollector(src, self.funcs).visit(src.tree)
+        self.by_key: Dict[str, FuncInfo] = {
+            f"{f.src.relpath}::{f.qual}": f for f in self.funcs}
+        self._by_name: Dict[str, List[FuncInfo]] = {}
+        for f in self.funcs:
+            self._by_name.setdefault(f.name, []).append(f)
+
+    def resolve(self, caller: FuncInfo, name: str) -> Optional[FuncInfo]:
+        """Resolve a dotted call target to a project function.
+
+        Tiers: `self.m`/`cls.m` → method m on the caller's class;
+        bare `f` → function f in the caller's module; otherwise the
+        terminal name, if exactly ONE project function bears it AND the
+        name is project-specific (ubiquitous attribute names like
+        `close`/`cancel` never resolve through the fallback — the
+        receiver is usually a stdlib object). Anything else (stdlib,
+        dynamic dispatch) resolves to None.
+        """
+        parts = name.split(".")
+        last = parts[-1]
+        if parts[0] in ("self", "cls") and len(parts) == 2 and caller.cls:
+            hit = self.by_key.get(
+                f"{caller.src.relpath}::{caller.cls}.{last}")
+            if hit is not None:
+                return hit
+        if len(parts) == 1:
+            hit = self.by_key.get(f"{caller.src.relpath}::{last}")
+            if hit is not None:
+                return hit
+        if len(parts) > 1 and last in _COMMON_ATTRS:
+            return None
+        cands = self._by_name.get(last, [])
+        if len(cands) == 1:
+            return cands[0]
+        same_mod = [c for c in cands if c.src is caller.src]
+        if len(same_mod) == 1:
+            return same_mod[0]
+        return None
+
+
+class Project:
+    def __init__(self, root: str, files: Sequence[SourceFile],
+                 problems: Optional[List[str]] = None):
+        self.root = root
+        self.files = list(files)
+        self.index = ProjectIndex(self.files)
+        # unparseable-file notes (reported as findings by run_passes)
+        self.problems = list(problems or [])
+
+
+DEFAULT_SCOPES = ("spacedrive_tpu", "tools")
+EXCLUDE_DIRS = {"__pycache__"}
+# The linter does not lint itself: its pass sources are full of the
+# very literals (SDTPU_, metric factories, lock names) it hunts.
+EXCLUDE_PREFIXES = ("tools/sdlint/",)
+
+
+def iter_source_paths(root: str,
+                      scopes: Sequence[str] = DEFAULT_SCOPES
+                      ) -> List[str]:
+    out: List[str] = []
+    for scope in scopes:
+        base = os.path.join(root, scope)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(base)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in EXCLUDE_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    rels = []
+    for p in sorted(out):
+        rel = os.path.relpath(p, root).replace(os.sep, "/")
+        if not rel.startswith(EXCLUDE_PREFIXES):
+            rels.append(p)
+    return rels
+
+
+def load_project(root: str,
+                 paths: Optional[Sequence[str]] = None) -> Project:
+    """Project over `paths` (absolute), default: the repo lint scope
+    (spacedrive_tpu/ + tools/, minus sdlint itself)."""
+    if paths is None:
+        paths = iter_source_paths(root)
+    files: List[SourceFile] = []
+    problems: List[str] = []
+    for p in paths:
+        rel = os.path.relpath(p, root)
+        try:
+            files.append(SourceFile(p, rel))
+        except SyntaxError as e:
+            problems.append(f"{rel}: unparseable: {e}")
+    return Project(root, files, problems)
+
+
+def run_passes(project: Project,
+               passes: Optional[Sequence] = None) -> List[Finding]:
+    """Run passes (default: all registered) and return suppression-
+    filtered findings, sorted by (path, line)."""
+    from .passes import all_passes
+
+    if passes is None:
+        passes = all_passes()
+    findings: List[Finding] = []
+    for prob in project.problems:
+        path = prob.split(":", 1)[0]
+        findings.append(Finding(
+            "core", "unparseable", path, "", "syntax", prob, 0))
+    src_by_rel = {f.relpath: f for f in project.files}
+    for p in passes:
+        for f in p.run(project):
+            src = src_by_rel.get(f.path)
+            if src is not None and src.suppressed(f.pass_name, f.lineno):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.lineno, f.key()))
+    return findings
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,34 @@
+"""sdlint pass registry."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .blocking_async import BlockingAsyncPass
+from .lock_discipline import LockDisciplinePass
+from .crdt_parity import CrdtParityPass
+from .flag_registry import FlagRegistryPass
+from .telemetry import TelemetryPass
+
+PASSES = {
+    p.name: p for p in (
+        BlockingAsyncPass(), LockDisciplinePass(), CrdtParityPass(),
+        FlagRegistryPass(), TelemetryPass(),
+    )
+}
+
+
+def all_passes() -> List:
+    return list(PASSES.values())
+
+
+def get_passes(names: Optional[List[str]]) -> List:
+    if not names:
+        return all_passes()
+    out = []
+    for n in names:
+        if n not in PASSES:
+            raise KeyError(
+                f"unknown pass {n!r} (have: {', '.join(sorted(PASSES))})")
+        out.append(PASSES[n])
+    return out

@@ -1,0 +1,185 @@
+"""Pass: blocking-async — blocking work reachable from `async def`.
+
+An event-loop callback that blocks (sqlite, file IO, subprocess,
+time.sleep, native batch encoders, future/thread waits) starves every
+other task on the node: the watcher debounce, p2p acks, job progress
+events. The discipline is `await asyncio.to_thread(...)` (or an
+executor) around anything that touches a syscall or the GIL for long.
+
+Detection, two layers:
+
+1. direct — a blocking root call in an `async def` body that is not
+   awaited (awaited calls are async by construction), not passed into
+   a thread wrapper, and not inside a nested function;
+2. interprocedural — the async function calls a resolvable SYNC
+   project function whose transitive closure contains a blocking root
+   (reported with the discovered call chain).
+
+The resolver is the shared three-tier one (core.ProjectIndex.resolve);
+dynamic dispatch it cannot see is covered at runtime by the
+sanitizer's loop-stall detector — the two tools are designed as a
+pair.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (
+    CallSite,
+    Finding,
+    FuncInfo,
+    Project,
+    dotted,
+    own_body_walk,
+)
+
+PASS = "blocking-async"
+
+# Dotted-name roots (exact or prefix) that always mean a blocking call.
+_EXACT = {
+    "time.sleep", "os.scandir", "os.walk", "os.listdir", "os.replace",
+    "os.makedirs", "os.rename", "os.stat", "os.read", "os.write",
+    "open", "os.fsync",
+}
+_PREFIXES = ("subprocess.", "shutil.")
+
+# Method names that hit SQLite when the receiver looks like a
+# Database / connection / cursor (this codebase's naming idiom).
+_DB_METHODS = {
+    "query", "query_one", "execute", "executemany", "executescript",
+    "commit", "rollback", "insert", "insert_many", "update", "upsert",
+    "delete", "tx", "checkpoint", "checkpoint_passive",
+    "ensure_lazy_indexes",
+}
+_DB_RECEIVERS = {"db", "conn", "connection", "cur", "cursor", "c"}
+
+# SyncManager entry points that run SQL under the hood.
+_SYNC_METHODS = {
+    "get_ops", "receive_crdt_operations", "receive_blob_pages",
+    "iter_clone_stream", "bulk_shared_ops", "drain_quarantined_ops",
+    "write_ops",
+}
+
+# ctypes-backed native batch calls (CPU-bound for the whole page).
+_NATIVE = {"sd_encode_ops", "sd_decode_ops", "compile_library"}
+
+
+def classify_blocking(call: ast.Call) -> Optional[str]:
+    """Stable ident of the blocking root this call is, else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    recv = parts[:-1]
+    if d in _EXACT or d.startswith(_PREFIXES):
+        return d
+    if last in _NATIVE:
+        return d
+    if last in _DB_METHODS and any(
+            p in _DB_RECEIVERS for p in recv):
+        return d
+    if last in _SYNC_METHODS and ("sync" in recv or not recv):
+        return d
+    # Cross-thread waits: a parameterless .result()/.join() is a
+    # future/thread wait (str.join and os.path.join always take args).
+    # Receivers named *task* are asyncio tasks — their .result() after
+    # an `await asyncio.wait(...)` is a non-blocking retrieval.
+    if last in ("result", "join") and not call.args and not call.keywords \
+            and not any("task" in p for p in recv):
+        return d
+    # Passing a live Database handle into a helper
+    # (`report.update(library.db)`) — the helper writes with it.
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        ad = dotted(arg)
+        if ad is not None and (ad == "db" or ad.split(".")[-1] == "db"):
+            return f"{d}(*.db)"
+    return None
+
+
+def _awaited_call_ids(fn_node: ast.AST) -> set:
+    out = set()
+    for node in own_body_walk(fn_node):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+class BlockingAsyncPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        idx = project.index
+        # -- phase 1: does each SYNC function transitively block? ----
+        # memo: key → (root ident, chain of qualnames) or None
+        memo: Dict[str, Optional[Tuple[str, List[str]]]] = {}
+
+        def blocking_of(fn: FuncInfo, stack: frozenset
+                        ) -> Optional[Tuple[str, List[str]]]:
+            key = f"{fn.src.relpath}::{fn.qual}"
+            if key in memo:
+                return memo[key]
+            if key in stack:
+                return None  # recursion guard; cycle adds nothing
+            best: Optional[Tuple[str, List[str]]] = None
+            for site in fn.calls:
+                if site.wrapped:
+                    continue
+                root = classify_blocking(site.node)
+                if root is not None:
+                    best = (root, [fn.qual])
+                    break
+            if best is None:
+                for site in fn.calls:
+                    if site.wrapped:
+                        continue
+                    callee = idx.resolve(fn, site.name)
+                    if callee is None or callee.is_async:
+                        continue
+                    sub = blocking_of(callee, stack | {key})
+                    if sub is not None:
+                        best = (sub[0], [fn.qual] + sub[1])
+                        break
+            memo[key] = best
+            return best
+
+        findings: List[Finding] = []
+        for fn in idx.funcs:
+            if not fn.is_async:
+                continue
+            awaited = _awaited_call_ids(fn.node)
+            seen_idents = set()
+            for site in fn.calls:
+                if site.wrapped or id(site.node) in awaited:
+                    continue
+                root = classify_blocking(site.node)
+                if root is not None:
+                    ident = f"direct:{root}"
+                    if ident in seen_idents:
+                        continue
+                    seen_idents.add(ident)
+                    findings.append(Finding(
+                        PASS, "direct", fn.src.relpath, fn.qual, ident,
+                        f"blocking call `{site.name}` on the event loop "
+                        f"(wrap in asyncio.to_thread)",
+                        site.node.lineno))
+                    continue
+                callee = idx.resolve(fn, site.name)
+                if callee is None or callee.is_async:
+                    continue
+                sub = blocking_of(callee, frozenset())
+                if sub is not None:
+                    chain = " -> ".join(sub[1])
+                    ident = f"via:{site.name}:{sub[0]}"
+                    if ident in seen_idents:
+                        continue
+                    seen_idents.add(ident)
+                    findings.append(Finding(
+                        PASS, "reach", fn.src.relpath, fn.qual, ident,
+                        f"call `{site.name}` reaches blocking "
+                        f"`{sub[0]}` (via {chain}) on the event loop "
+                        f"(wrap in asyncio.to_thread)",
+                        site.node.lineno))
+        return findings

@@ -1,0 +1,152 @@
+"""Pass: crdt-parity — shared-model writes must emit sync ops.
+
+The sync layer's core contract (PR 1/PR 2): every local write to a
+SHARED or RELATION model table logs a CRDT op **in the same
+transaction** — that is what makes a fresh peer's replica converge
+byte-identically. A domain write that skips the op log never syncs,
+silently, forever.
+
+Shared/relation table names come from the model registry
+(`spacedrive_tpu/store/models.py`), parsed as AST — no package import,
+so the linter runs anywhere. A write site is:
+
+- `conn.execute/executemany("INSERT INTO <t> ...")` (or UPDATE /
+  DELETE FROM) with a string-literal SQL mentioning such a table, or
+- a Database helper (`db.insert("t", ...)`, insert_many / update /
+  upsert / delete) whose first argument is such a table literal.
+
+A write complies when its enclosing function also emits ops: a
+`with ...write_ops(...)` context, or a call to `bulk_shared_ops` /
+`_insert_op_rows`. Function-level granularity keeps false positives
+near zero at this codebase's idiom (the op list is always built next
+to the write).
+
+Exempt by design: the sync engine itself (`sync/`), which writes
+shared tables when APPLYING remote ops; `store/` (schema/DDL);
+`backups.py` (byte-level replay of an already-op-logged database).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "crdt-parity"
+
+_HELPERS = {"insert", "insert_many", "update", "upsert", "delete"}
+_EMITTERS = {"bulk_shared_ops", "_insert_op_rows", "write_ops"}
+_EXEMPT_PREFIXES = ("spacedrive_tpu/sync/", "spacedrive_tpu/store/")
+_EXEMPT_FILES = {"spacedrive_tpu/backups.py"}
+
+
+def synced_tables(root: str) -> Set[str]:
+    """SHARED + RELATION table names from store/models.py, by AST:
+    `register(Model("name", ..., sync=SyncMode.SHARED, ...))`."""
+    path = os.path.join(root, "spacedrive_tpu", "store", "models.py")
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) == "register"):
+            continue
+        for arg in node.args:
+            if not (isinstance(arg, ast.Call)
+                    and dotted(arg.func) == "Model"):
+                continue
+            name = None
+            if arg.args and isinstance(arg.args[0], ast.Constant) \
+                    and isinstance(arg.args[0].value, str):
+                name = arg.args[0].value
+            for kw in arg.keywords:
+                if kw.arg == "sync":
+                    mode = dotted(kw.value) or ""
+                    if mode.endswith((".SHARED", ".RELATION")) and name:
+                        out.add(name)
+    return out
+
+
+def _sql_write_tables(sql: str, tables: Set[str]) -> List[str]:
+    hits = []
+    for t in tables:
+        if re.search(
+            rf"\b(INSERT\s+(?:OR\s+\w+\s+)?INTO|UPDATE|DELETE\s+FROM)\s+"
+            rf"{re.escape(t)}\b", sql, re.IGNORECASE,
+        ):
+            hits.append(t)
+    return sorted(hits)
+
+
+def _string_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # implicit concatenation parses to a single Constant; f-strings and
+    # joins stay dynamic → not analyzable, skip
+    return None
+
+
+class CrdtParityPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        tables = synced_tables(project.root)
+        if not tables:
+            return []
+        findings: List[Finding] = []
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            if rel.startswith(_EXEMPT_PREFIXES) or rel in _EXEMPT_FILES:
+                continue
+            emits = self._emits_ops(fn.node)
+            seen: Set[str] = set()
+            for node in own_body_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._write_target(node, tables)
+                if hit is None or emits:
+                    continue
+                if hit in seen:
+                    continue
+                seen.add(hit)
+                findings.append(Finding(
+                    PASS, "silent-write", rel, fn.qual, hit,
+                    f"writes synced table {hit!r} without emitting a "
+                    f"CRDT op in scope (use sync.write_ops / "
+                    f"bulk_shared_ops in the same tx)", node.lineno))
+        return findings
+
+    @staticmethod
+    def _emits_ops(fn_node: ast.AST) -> bool:
+        for node in own_body_walk(fn_node):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None and d.split(".")[-1] in _EMITTERS:
+                    return True
+        return False
+
+    @staticmethod
+    def _write_target(call: ast.Call, tables: Set[str]) -> Optional[str]:
+        d = dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        last = parts[-1]
+        recv = parts[:-1]
+        if last in ("execute", "executemany") and call.args:
+            sql = _string_const(call.args[0])
+            if sql:
+                hits = _sql_write_tables(sql, tables)
+                if hits:
+                    return hits[0]
+        if last in _HELPERS and recv and recv[-1] in ("db", "conn") \
+                and call.args:
+            t = _string_const(call.args[0])
+            if t in tables:
+                return t
+        return None

@@ -1,0 +1,105 @@
+"""Pass: flag-registry — every SDTPU_* flag declared and read centrally.
+
+`spacedrive_tpu/flags.py` is the single source of truth for the
+engine's environment-flag surface: name, default, parser, docstring,
+and the generated README table. This pass enforces the two halves of
+that contract over `spacedrive_tpu/` and `tools/`:
+
+- `undeclared-flag`  — an `SDTPU_*` string literal that no `declare()`
+  in flags.py covers (typo'd flag names silently no-op at runtime;
+  here they fail the build);
+- `environ-read`     — a direct READ of an SDTPU flag from the
+  environment (`os.environ.get`, `os.getenv`, `os.environ[...]` in a
+  load context) anywhere outside flags.py. Writes are fine — benches
+  and tests toggle flags via `os.environ[...] = ...` / `setdefault` /
+  `pop`, and reads go live through `flags.get()` so the toggles still
+  take effect.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set
+
+from ..core import Finding, Project, dotted
+
+PASS = "flag-registry"
+FLAG_RE = re.compile(r"^SDTPU_[A-Z0-9_]+$")
+CENTRAL = "spacedrive_tpu/flags.py"
+
+
+def declared_flags(root: str) -> Set[str]:
+    """Flag names from `declare("SDTPU_X", ...)` calls in flags.py."""
+    path = os.path.join(root, CENTRAL)
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) == "declare" \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.add(arg.value)
+    return out
+
+
+def _flag_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and FLAG_RE.match(node.value):
+        return node.value
+    return None
+
+
+class FlagRegistryPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_flags(project.root)
+        findings: List[Finding] = []
+        for src in project.files:
+            is_central = src.relpath == CENTRAL
+            # literals in write-position subscripts/calls are collected
+            # so the same literal is not double-reported
+            reported: Set[str] = set()
+            for node in ast.walk(src.tree):
+                flag = _flag_literal(node)
+                if flag is not None and flag not in declared \
+                        and not is_central and flag not in reported:
+                    reported.add(flag)
+                    findings.append(Finding(
+                        PASS, "undeclared-flag", src.relpath, "", flag,
+                        f"flag {flag!r} is not declared in "
+                        f"spacedrive_tpu/flags.py (typo, or declare it)",
+                        node.lineno))
+                if is_central:
+                    continue
+                read = self._environ_read(node)
+                if read is not None:
+                    findings.append(Finding(
+                        PASS, "environ-read", src.relpath, "", read,
+                        f"direct environment read of {read!r} — go "
+                        f"through flags.get()/flags.raw() so the "
+                        f"registry stays authoritative", node.lineno))
+        return findings
+
+    @staticmethod
+    def _environ_read(node: ast.AST) -> Optional[str]:
+        # os.environ.get("SDTPU_X", ...) / os.getenv("SDTPU_X")
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in ("os.environ.get", "os.getenv", "environ.get") \
+                    and node.args:
+                return _flag_literal(node.args[0])
+            return None
+        # os.environ["SDTPU_X"] in a LOAD context (a store/del is a
+        # write — allowed)
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            base = dotted(node.value)
+            if base in ("os.environ", "environ"):
+                return _flag_literal(node.slice)
+        return None

@@ -1,0 +1,268 @@
+"""Pass: lock-discipline — what may happen while a threading lock is held.
+
+The store's single-writer discipline (store/db.py) rests on rules the
+type system cannot see:
+
+- `await-under-lock`   — an `await` lexically inside a sync
+  `with <lock>:` block suspends the coroutine with the lock held;
+  every other task needing it deadlocks behind a owner that only
+  resumes via the same loop. (`async with` asyncio locks are exempt —
+  they are designed to be held across awaits.)
+- `wait-under-lock`    — a cross-thread wait (`future.result()`,
+  `thread.join()`, `queue.join()`, `time.sleep`) while holding a lock:
+  if the thread being waited on needs that same lock, the process
+  hangs. This is the PR 1 `store/db.py` deadlock shape: connection
+  registration serialized on the WRITE lock while the writer held it
+  waiting on reader-thread prefetch results. The fix moved
+  registration to its own leaf lock; the fixture
+  (tests/fixtures/sdlint/locks_bad.py) preserves the bad shape and
+  this pass must keep catching it.
+- `nested-write-tx`    — entering a write transaction (`db.tx()`,
+  `sync.write_ops()`, or a Database helper without `conn=`) inside an
+  open `with tx()/write_ops()` block: SQLite raises "cannot start a
+  transaction within a transaction" at runtime; statically it is
+  always a bug.
+- `lock-order-cycle`   — a project-wide lock graph built from nested
+  `with <lock>` statements (plus one interprocedural hop: calls inside
+  a lock body to resolvable functions that acquire locks); a cycle in
+  the graph is a potential AB/BA deadlock. Lock identity is the
+  terminal attribute name (`self._write_lock` and `db._write_lock`
+  are the same lock family by this codebase's naming discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, FuncInfo, Project, dotted, own_body_walk
+
+PASS = "lock-discipline"
+
+_WAIT_LASTS = {"result", "join"}   # parameterless → cross-thread wait
+_TX_LASTS = {"tx", "write_ops"}
+_DB_HELPERS = {"insert", "insert_many", "update", "upsert", "delete",
+               "execute"}
+
+
+def lock_name(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity for `with X:` — the terminal name when
+    it smells like a threading lock (`*_lock` / `*_mutex` / `lock`)."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    last = d.split(".")[-1]
+    if last.endswith(("_lock", "_mutex")) or last in ("lock", "mutex"):
+        return last
+    return None
+
+
+def _tx_ctx(expr: ast.AST) -> Optional[str]:
+    """'tx' / 'write_ops' when `with X` opens a write transaction."""
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+        if d is not None and d.split(".")[-1] in _TX_LASTS:
+            return d.split(".")[-1]
+    return None
+
+
+def _is_wait(call: ast.Call) -> Optional[str]:
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if last in _WAIT_LASTS and not call.args and not call.keywords \
+            and not any("task" in p for p in parts[:-1]):
+        return d
+    if d == "time.sleep":
+        return d
+    return None
+
+
+def _opens_nested_tx(call: ast.Call) -> Optional[str]:
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    recv = parts[:-1]
+    if last in _TX_LASTS and recv and recv[-1] in ("db", "sync"):
+        return d
+    if last in _DB_HELPERS and recv and recv[-1] == "db":
+        # Database helpers open their own tx UNLESS handed the open
+        # connection via conn=...
+        if not any(kw.arg == "conn" for kw in call.keywords):
+            return d
+    return None
+
+
+class _FnScanner:
+    """Walk one function, tracking the stack of held with-contexts."""
+
+    def __init__(self, fn: FuncInfo, project: Project,
+                 edges: Dict[str, Set[str]],
+                 edge_sites: Dict[Tuple[str, str], Tuple[str, int]],
+                 findings: List[Finding]):
+        self.fn = fn
+        self.project = project
+        self.edges = edges
+        self.edge_sites = edge_sites
+        self.findings = findings
+
+    def scan(self) -> None:
+        self._visit_block(self.fn.node.body, locks=[], txs=[])
+
+    # -- helpers -----------------------------------------------------------
+
+    def _note_edge(self, outer: str, inner: str, lineno: int) -> None:
+        if outer == inner:
+            return
+        self.edges.setdefault(outer, set()).add(inner)
+        self.edge_sites.setdefault(
+            (outer, inner), (self.fn.src.relpath, lineno))
+
+    def _emit(self, code: str, ident: str, msg: str, lineno: int) -> None:
+        self.findings.append(Finding(
+            PASS, code, self.fn.src.relpath, self.fn.qual, ident,
+            msg, lineno))
+
+    # -- walk --------------------------------------------------------------
+
+    def _visit_block(self, stmts, locks: List[str], txs: List[str]) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt, locks, txs)
+
+    def _visit_stmt(self, node: ast.AST, locks: List[str],
+                    txs: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested bodies run later, not under these locks
+        if isinstance(node, ast.With):
+            new_locks, new_txs = list(locks), list(txs)
+            for item in node.items:
+                ln = lock_name(item.context_expr)
+                if ln is not None:
+                    for held in new_locks:
+                        self._note_edge(held, ln, node.lineno)
+                    new_locks.append(ln)
+                    continue
+                tx = _tx_ctx(item.context_expr)
+                if tx is not None:
+                    if new_txs:
+                        self._emit(
+                            "nested-write-tx", f"{new_txs[-1]}>{tx}",
+                            f"`with ...{tx}()` entered inside an open "
+                            f"`{new_txs[-1]}()` transaction (SQLite "
+                            f"cannot nest write transactions)",
+                            node.lineno)
+                    new_txs.append(tx)
+                # with-expressions are also expressions: scan them
+                self._visit_expr_tree(item.context_expr, locks, txs)
+            self._visit_block(node.body, new_locks, new_txs)
+            return
+        if isinstance(node, ast.Await):
+            if locks:
+                self._emit(
+                    "await-under-lock", f"await@{locks[-1]}",
+                    f"`await` while holding lock {locks[-1]!r} — the "
+                    f"coroutine suspends mid-critical-section",
+                    node.lineno)
+            self._visit_expr_tree(node.value, locks, txs)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, locks, txs)
+            for child in ast.iter_child_nodes(node):
+                self._visit_stmt(child, locks, txs)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit_stmt(child, locks, txs)
+
+    def _visit_expr_tree(self, node, locks, txs) -> None:
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call):
+                self._visit_call(child, locks, txs)
+
+    def _visit_call(self, call: ast.Call, locks: List[str],
+                    txs: List[str]) -> None:
+        if locks:
+            wait = _is_wait(call)
+            if wait is not None:
+                self._emit(
+                    "wait-under-lock", f"{wait}@{locks[-1]}",
+                    f"cross-thread wait `{wait}` while holding lock "
+                    f"{locks[-1]!r} (the PR 1 deadlock shape: the "
+                    f"waited-on thread may need that lock)",
+                    call.lineno)
+            # Interprocedural lock-graph hop: callee acquires locks
+            # while ours are held.
+            d = dotted(call.func)
+            if d is not None:
+                callee = self.project.index.resolve(self.fn, d)
+                if callee is not None:
+                    for inner in _acquired_locks(callee):
+                        for held in locks:
+                            self._note_edge(held, inner, call.lineno)
+        if txs:
+            nested = _opens_nested_tx(call)
+            if nested is not None:
+                self._emit(
+                    "nested-write-tx", f"{txs[-1]}>{nested}",
+                    f"`{nested}(...)` opens its own write transaction "
+                    f"inside an open `{txs[-1]}()` block — pass "
+                    f"`conn=` instead", call.lineno)
+
+
+def _acquired_locks(fn: FuncInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in own_body_walk(fn.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ln = lock_name(item.context_expr)
+                if ln is not None:
+                    out.add(ln)
+    return out
+
+
+def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS; each reported once, smallest-first
+    rotation for stable idents."""
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, cur: str, path: List[str], seen: Set[str]):
+        for nxt in sorted(edges.get(cur, ())):
+            if nxt == start and len(path) > 1:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in seen:
+                seen.add(nxt)
+                dfs(start, nxt, path + [nxt], seen)
+                seen.discard(nxt)
+
+    for node in sorted(edges):
+        dfs(node, node, [node], {node})
+    return [list(c) for c in sorted(cycles)]
+
+
+class LockDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        edges: Dict[str, Set[str]] = {}
+        edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for fn in project.index.funcs:
+            _FnScanner(fn, project, edges, edge_sites, findings).scan()
+            if fn.is_async:
+                # Await nodes are caught in the walk; nothing extra.
+                pass
+        for cycle in _find_cycles(edges):
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            path, line = edge_sites.get(pairs[0], ("", 0))
+            findings.append(Finding(
+                PASS, "lock-order-cycle", path or "(project)", "",
+                "<->".join(cycle),
+                "lock-order cycle " + " -> ".join(cycle + [cycle[0]])
+                + " — two threads taking these in opposite order "
+                "deadlock", line))
+        return findings

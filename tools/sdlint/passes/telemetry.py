@@ -1,0 +1,201 @@
+"""Pass: telemetry — the PR 3 metric-namespace lint, folded into sdlint.
+
+Semantics unchanged from the original `tools/telemetry_lint.py` (which
+remains as a thin CLI shim over this module): every metric family must
+be registered in `spacedrive_tpu/telemetry.py`, under a string-literal
+name, collision-free, following `sd_<layer>_<what>` (layers now
+include `sanitize`, the runtime sanitizer's counters). See the module
+docstring of the shim for the rule-by-rule rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+from ..core import Finding, Project
+
+PASS = "telemetry"
+
+FACTORY_NAMES = {"counter", "gauge", "histogram"}
+CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
+NAME_RE = re.compile(
+    r"^sd_(jobs?|identifier|sync|p2p|store|api|trace|sanitize)"
+    r"_[a-z0-9_]+$")
+
+CENTRAL_MODULE = "telemetry.py"
+
+
+def _call_target(node: ast.Call) -> Tuple[str, str]:
+    """(base, attr) of the called thing: ("", "counter") for a bare
+    name, ("telemetry", "counter") for an attribute call."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return "", f.id
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else "?"
+        return base, f.attr
+    return "?", "?"
+
+
+def _telemetry_imports(tree: ast.Module) -> set:
+    """Factory/class names this module imported FROM the telemetry
+    module — a bare `counter(...)` call is only a registration if the
+    name actually came from there (crypto code has an unrelated local
+    `counter()` closure, for instance)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.split(".")[-1] == "telemetry":
+            for alias in node.names:
+                if alias.name in FACTORY_NAMES | CLASS_NAMES:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, is_central: bool, from_telemetry: set,
+                 names_seen: dict, problems: List[str]):
+        self.path = path
+        self.is_central = is_central
+        self.from_telemetry = from_telemetry
+        self.names_seen = names_seen
+        self.problems = problems
+        self.depth = 0  # function nesting (0 = module level)
+
+    def visit_FunctionDef(self, node):
+        self.depth += 1
+        self.generic_visit(node)
+        self.depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        base, attr = _call_target(node)
+        qualified = base in ("telemetry", "REGISTRY")
+        is_factory = attr in FACTORY_NAMES and (
+            qualified or (base == "" and (
+                attr in self.from_telemetry or self.is_central)))
+        is_class = attr in CLASS_NAMES and (
+            base == "telemetry"
+            or (base == "" and attr in self.from_telemetry))
+        if not (is_factory or is_class):
+            return
+        where = f"{self.path}:{node.lineno}"
+        if not self.is_central:
+            kind = "instantiated" if is_class else "registered"
+            self.problems.append(
+                f"{where}: metric family {kind} outside the central "
+                f"registry (define it in spacedrive_tpu/telemetry.py "
+                f"and import it)")
+            return
+        if self.depth > 0:
+            return  # telemetry.py plumbing (wrapper/registry bodies)
+        if not node.args:
+            return
+        name_node = node.args[0]
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            self.problems.append(
+                f"{where}: metric name must be a string literal "
+                f"(static namespace)")
+            return
+        name = name_node.value
+        if name in self.names_seen:
+            self.problems.append(
+                f"{where}: metric name collision: {name!r} already "
+                f"registered at {self.names_seen[name]}")
+        else:
+            self.names_seen[name] = where
+        if not NAME_RE.match(name):
+            self.problems.append(
+                f"{where}: {name!r} breaks the naming scheme "
+                f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
+                f"p2p/store/api/trace/sanitize)")
+
+
+def lint_source(path: str, src: str, is_central: bool,
+                names_seen: dict, problems: List[str]) -> None:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        problems.append(f"{path}: unparseable: {e}")
+        return
+    _Visitor(path, is_central, _telemetry_imports(tree),
+             names_seen, problems).visit(tree)
+
+
+def run_lint(package_dir: str) -> List[str]:
+    """Lint every .py under package_dir; returns problem strings.
+    (The telemetry_lint.py shim's public API — kept verbatim.)"""
+    problems: List[str] = []
+    names_seen: dict = {}
+    # Central module first so cross-file collisions blame the outlier.
+    paths: List[str] = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(root, fn))
+    paths.sort(key=lambda p: (os.path.basename(p) != CENTRAL_MODULE, p))
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        lint_source(path, src,
+                    is_central=os.path.basename(path) == CENTRAL_MODULE,
+                    names_seen=names_seen, problems=problems)
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    pkg = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "..", "spacedrive_tpu")
+    pkg = os.path.normpath(pkg)
+    problems = run_lint(pkg)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"telemetry lint: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print("telemetry lint: clean")
+    return 0
+
+
+_LINE_RE = re.compile(r"^(?P<path>.*?):(?P<line>\d+): (?P<msg>.*)$")
+
+
+class TelemetryPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        problems: List[str] = []
+        names_seen: dict = {}
+        files = sorted(
+            project.files,
+            key=lambda f: (os.path.basename(f.relpath) != CENTRAL_MODULE,
+                           f.relpath))
+        for src in files:
+            lint_source(
+                src.relpath, src.src,
+                is_central=os.path.basename(src.relpath) == CENTRAL_MODULE,
+                names_seen=names_seen, problems=problems)
+        findings: List[Finding] = []
+        for prob in problems:
+            m = _LINE_RE.match(prob)
+            if m:
+                findings.append(Finding(
+                    PASS, "namespace", m.group("path"), "",
+                    m.group("msg")[:80], m.group("msg"),
+                    int(m.group("line"))))
+            else:
+                findings.append(Finding(
+                    PASS, "namespace", prob.split(":", 1)[0], "",
+                    prob[:80], prob, 0))
+        return findings

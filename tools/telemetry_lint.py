@@ -1,168 +1,41 @@
-"""Static telemetry-namespace lint, run in tier-1 (tests/test_telemetry).
+"""Static telemetry-namespace lint — COMPATIBILITY SHIM.
 
-The node-wide metric namespace stays coherent only if every family is
-registered through the central registry IN `spacedrive_tpu/telemetry.py`
-— the instrumented code imports the family objects, never mints its own.
-This walks the package AST and fails on:
+The implementation moved into the sdlint framework
+(`tools/sdlint/passes/telemetry.py`) when the PR 3 one-off lint was
+folded in as sdlint's fifth pass; this module keeps the original CLI
+(`python tools/telemetry_lint.py [package_dir]`) and the
+`run_lint(package_dir) -> [problem, ...]` API that
+tests/test_telemetry.py and any local tooling already use.
 
-- metric families registered outside telemetry.py (calls to
-  `counter(`/`gauge(`/`histogram(` — bare, `telemetry.`-qualified, or
-  `REGISTRY.`-qualified — and direct `Counter(`/`Gauge(`/`Histogram(`
-  instantiations);
-- metric-name collisions (two families registered under one name —
-  the runtime registry also raises, but only on the colliding import
-  path actually executing; the lint catches it on every run);
-- non-literal metric names (the namespace must be statically
-  enumerable for dashboards and this lint);
-- names that break the `sd_<layer>_<what>` scheme
-  (docs/architecture.md §Observability).
-
-Usage: python tools/telemetry_lint.py [package_dir]
-Exit 0 clean, 1 with one problem per line on stderr.
+Rules (unchanged): metric families register only in
+spacedrive_tpu/telemetry.py, under string-literal, collision-free
+names following `sd_<layer>_<what>`. Prefer `python -m tools.sdlint`
+(optionally `--passes telemetry`) for new workflows — it adds the
+baseline machinery and the other four invariant passes.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import List, Tuple
 
-FACTORY_NAMES = {"counter", "gauge", "histogram"}
-CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
-NAME_RE = re.compile(
-    r"^sd_(jobs?|identifier|sync|p2p|store|api|trace)_[a-z0-9_]+$")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(_HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(_HERE))
 
-CENTRAL_MODULE = "telemetry.py"
-
-
-def _call_target(node: ast.Call) -> Tuple[str, str]:
-    """(base, attr) of the called thing: ("", "counter") for a bare
-    name, ("telemetry", "counter") for an attribute call."""
-    f = node.func
-    if isinstance(f, ast.Name):
-        return "", f.id
-    if isinstance(f, ast.Attribute):
-        base = f.value.id if isinstance(f.value, ast.Name) else "?"
-        return base, f.attr
-    return "?", "?"
+from tools.sdlint.passes.telemetry import (  # noqa: E402,F401
+    CENTRAL_MODULE,
+    CLASS_NAMES,
+    FACTORY_NAMES,
+    NAME_RE,
+    lint_source,
+    run_lint,
+)
 
 
-def _telemetry_imports(tree: ast.Module) -> set:
-    """Factory/class names this module imported FROM the telemetry
-    module — a bare `counter(...)` call is only a registration if the
-    name actually came from there (crypto code has an unrelated local
-    `counter()` closure, for instance)."""
-    names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module and \
-                node.module.split(".")[-1] == "telemetry":
-            for alias in node.names:
-                if alias.name in FACTORY_NAMES | CLASS_NAMES:
-                    names.add(alias.asname or alias.name)
-    return names
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self, path: str, is_central: bool, from_telemetry: set,
-                 names_seen: dict, problems: List[str]):
-        self.path = path
-        self.is_central = is_central
-        self.from_telemetry = from_telemetry
-        self.names_seen = names_seen
-        self.problems = problems
-        self.depth = 0  # function nesting (0 = module level)
-
-    def visit_FunctionDef(self, node):
-        self.depth += 1
-        self.generic_visit(node)
-        self.depth -= 1
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node: ast.Call):
-        self.generic_visit(node)
-        base, attr = _call_target(node)
-        qualified = base in ("telemetry", "REGISTRY")
-        is_factory = attr in FACTORY_NAMES and (
-            qualified or (base == "" and (
-                attr in self.from_telemetry or self.is_central)))
-        is_class = attr in CLASS_NAMES and (
-            base == "telemetry"
-            or (base == "" and attr in self.from_telemetry))
-        if not (is_factory or is_class):
-            return
-        where = f"{self.path}:{node.lineno}"
-        if not self.is_central:
-            kind = "instantiated" if is_class else "registered"
-            self.problems.append(
-                f"{where}: metric family {kind} outside the central "
-                f"registry (define it in spacedrive_tpu/telemetry.py "
-                f"and import it)")
-            return
-        if self.depth > 0:
-            return  # telemetry.py plumbing (wrapper/registry bodies)
-        if not node.args:
-            return
-        name_node = node.args[0]
-        if not (isinstance(name_node, ast.Constant)
-                and isinstance(name_node.value, str)):
-            self.problems.append(
-                f"{where}: metric name must be a string literal "
-                f"(static namespace)")
-            return
-        name = name_node.value
-        if name in self.names_seen:
-            self.problems.append(
-                f"{where}: metric name collision: {name!r} already "
-                f"registered at {self.names_seen[name]}")
-        else:
-            self.names_seen[name] = where
-        if not NAME_RE.match(name):
-            self.problems.append(
-                f"{where}: {name!r} breaks the naming scheme "
-                f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
-                f"p2p/store/api/trace)")
-
-
-def lint_source(path: str, src: str, is_central: bool,
-                names_seen: dict, problems: List[str]) -> None:
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        problems.append(f"{path}: unparseable: {e}")
-        return
-    _Visitor(path, is_central, _telemetry_imports(tree),
-             names_seen, problems).visit(tree)
-
-
-def run_lint(package_dir: str) -> List[str]:
-    """Lint every .py under package_dir; returns problem strings."""
-    problems: List[str] = []
-    names_seen: dict = {}
-    # Central module first so cross-file collisions blame the outlier.
-    paths: List[str] = []
-    for root, _dirs, files in os.walk(package_dir):
-        if "__pycache__" in root:
-            continue
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                paths.append(os.path.join(root, fn))
-    paths.sort(key=lambda p: (os.path.basename(p) != CENTRAL_MODULE, p))
-    for path in paths:
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        lint_source(path, src,
-                    is_central=os.path.basename(path) == CENTRAL_MODULE,
-                    names_seen=names_seen, problems=problems)
-    return problems
-
-
-def main(argv: List[str]) -> int:
+def main(argv) -> int:
     pkg = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "spacedrive_tpu")
+        os.path.dirname(_HERE), "spacedrive_tpu")
     problems = run_lint(pkg)
     for p in problems:
         print(p, file=sys.stderr)
