@@ -174,6 +174,13 @@ declare(
     "reset_profiler_cache() re-arms).")
 
 declare(
+    "SDTPU_RETRACE_GUARD", "auto", lambda v: v.strip().lower(),
+    "jit retrace counter (ops/jit_registry.py, armed with the "
+    "sanitizer): `off` disables cache-size accounting and the "
+    "per-contract max_traces budget check; `auto` follows "
+    "SDTPU_SANITIZE.")
+
+declare(
     "SDTPU_SANITIZE", False, parse_flag1,
     "Opt-in runtime sanitizer (sanitize.py): event-loop stall "
     "detector, lock-order cycle check, write-lock-held-across-await "
@@ -205,6 +212,13 @@ declare(
     "SDTPU_TELEMETRY_INTERVAL", 15.0, parse_float,
     "Seconds between periodic TelemetrySnapshot events on the node "
     "event bus (node.py TelemetryReporter).")
+
+declare(
+    "SDTPU_TRANSFER_GUARD", "auto", lambda v: v.strip().lower(),
+    "JAX device-to-host transfer guard inside device_scope()/io() "
+    "regions (ops/jit_registry.py, armed with the sanitizer): `auto` "
+    "follows SDTPU_SANITIZE_MODE (raise -> disallow, count -> log), "
+    "`raise`/`log` force a level, `off` disables.")
 
 declare(
     "SDTPU_VAL_BATCH_BYTES", None, parse_int,

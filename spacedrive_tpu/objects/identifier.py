@@ -30,7 +30,7 @@ from ..files import resolve_kind
 from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
 from ..locations.file_path_helper import materialized_like, sub_path_children_mat
 from ..locations.paths import IsolatedPath
-from ..ops import staging
+from ..ops import jit_registry, staging
 from ..ops.staging import cas_ids_for_files
 from ..telemetry import IDENT_FILES, IDENT_PHASE_SECONDS
 
@@ -491,7 +491,13 @@ class FileIdentifierJob(StatefulJob):
             rows, self.location_id, data["location_path"])
         w["prep"] = time.perf_counter() - t0
         t0 = time.perf_counter()
-        ids, read_errors = cas_ids_for_files(files, backend=self.backend)
+        # Round 10: the bucketed identify hash runs inside the
+        # sanitizer's device scope — an undeclared retrace or host
+        # transfer in this exact loop is what the jit registry's
+        # contracts forbid (raise mode in tier-1, counters in prod).
+        with jit_registry.device_scope("identify.hash"):
+            ids, read_errors = cas_ids_for_files(
+                files, backend=self.backend)
         w["hash"] = time.perf_counter() - t0
         return rows, (files, ids, read_errors), w
 
@@ -545,7 +551,9 @@ class FileIdentifierJob(StatefulJob):
             rows, self.location_id, data["location_path"])
         t1 = time.perf_counter()
         timings["prep"] = timings.get("prep", 0.0) + t1 - tp
-        ids, read_errors = cas_ids_for_files(files, backend=self.backend)
+        with jit_registry.device_scope("identify.hash"):
+            ids, read_errors = cas_ids_for_files(
+                files, backend=self.backend)
         timings["hash"] = (timings.get("hash", 0.0)
                            + time.perf_counter() - t1)
         return files, ids, read_errors

@@ -129,6 +129,7 @@ class ObjectValidatorJob(StatefulJob):
 
         import jax
 
+        from ..ops import jit_registry
         from ..ops.blake3_jax import checksums_words_batched
         from ..ops.seqhash import sharded_file_checksum
         from ..parallel.mesh import batch_mesh
@@ -173,7 +174,12 @@ class ObjectValidatorJob(StatefulJob):
                 blobs.append(data)
                 batch.append((r, path))
             if blobs:
-                hexes = checksums_words_batched(blobs)
+                # Guarded dispatch (round 10): the page's only
+                # sanctioned fetch is checksums_words_batched's
+                # declared io("cas.checksums") — a stray D2H here
+                # raises under the tier-1 sanitizer.
+                with jit_registry.device_scope("validator.batched"):
+                    hexes = checksums_words_batched(blobs)
                 for (r, path), hx in zip(batch, hexes):
                     yield r, path, hx
 
@@ -182,6 +188,11 @@ class ObjectValidatorJob(StatefulJob):
         # Streaming windows need a power-of-two device count (subtree
         # alignment); on e.g. a 6- or 12-device mesh use the largest
         # power-of-two subset instead of erroring on every file.
+        # batch_mesh is cached per device tuple, so this per-step call
+        # returns the SAME Mesh object every step — seqhash's
+        # _sharded_reduce keys its trace cache on the mesh static arg,
+        # and a fresh mesh per step would risk a retrace per step
+        # (jit-registry contract seqhash.reduce).
         devices = list(jax.devices())
         pow2 = 1 << (len(devices).bit_length() - 1)
         mesh = batch_mesh(devices[:pow2])

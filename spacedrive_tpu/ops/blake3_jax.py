@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import cas
+from . import cas, jit_registry
 from .. import flags
 from .blake3_batch import (  # noqa: F401 — re-exported for callers
     CHUNK_LEN,
@@ -99,6 +99,7 @@ def _blake3_impl(words, lengths):
     return jnp.stack(tree_reduce(jnp, cvs, n_chunks), axis=1)
 
 
+@jit_registry.tracked("blake3.jnp")
 @jax.jit
 def _blake3_jnp_jit(words, lengths):
     return _blake3_impl(words, lengths)
@@ -143,14 +144,14 @@ def make_sharded_blake3(mesh, axis: str = "data"):
     """
     P = jax.sharding.PartitionSpec
 
-    return jax.jit(
+    return jit_registry.tracked("blake3.sharded")(jax.jit(
         functools.partial(
             jax.shard_map,
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=P(axis),
         )(_blake3_impl_best)
-    )
+    ))
 
 
 def sharded_hasher():
@@ -234,7 +235,10 @@ def checksums_words_batched(blobs) -> list:
     if flags.get("SDTPU_DISPATCH_LOG"):
         DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev, "C": C,
                              "kind": "checksum"})
-    return digests_to_hex(hasher(words, lengths)[:B])
+    with jit_registry.device_scope("cas.checksums"):
+        digests = hasher(words, lengths)[:B]
+        with jit_registry.io("cas.checksums"):
+            return digests_to_hex(digests)
 
 
 # Dispatch observability: when SDTPU_DISPATCH_LOG=1, every cas_ids_jax
@@ -270,4 +274,7 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
             [lengths, np.zeros((Bp - B,), lengths.dtype)])
     if flags.get("SDTPU_DISPATCH_LOG"):
         DISPATCH_LOG.append({"B": B, "Bp": Bp, "n_dev": n_dev})
-    return digests_to_cas_ids(hasher(words, lengths)[:B])
+    with jit_registry.device_scope("cas.ids"):
+        digests = hasher(words, lengths)[:B]
+        with jit_registry.io("cas.ids"):
+            return digests_to_cas_ids(digests)

@@ -62,6 +62,7 @@ from .blake3_ref import (
     ROOT,
 )
 from .blake3_batch import BLOCKS_PER_CHUNK, WORDS_PER_BLOCK, chunk_prelude
+from . import jit_registry
 
 # Lane tile: 8 sublanes × 128 lanes of uint32 (one native VREG of
 # chunks). Each grid step stages one [1024, 256] word block (1 MiB) into
@@ -161,6 +162,7 @@ def _chunk_kernel_meta(words_ref, len_ref, cidx_ref, out_ref):
         out_ref[i, 0] = cv[i]
 
 
+@jit_registry.tracked("blake3.pallas.chunk_fast")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _chunk_cvs_pallas_fast(words, lengths, interpret: bool = False):
     """Whole-message, counter-0 chunk stage (the CAS hot path):
@@ -247,6 +249,7 @@ def _chunk_kernel(words_ref, cb_ref, klast_ref, single_ref, empty0_ref,
         out_ref[i, 0] = cv[i]
 
 
+@jit_registry.tracked("blake3.pallas.chunk")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _chunk_cvs_pallas(words, lengths, clo, chi, whole_mask,
                       interpret: bool = False):
@@ -309,11 +312,12 @@ def chunk_cvs_pallas(words, lengths, counter_base=0, whole=True,
     lo, hi = split_counter_base(counter_base)
     lo = jnp.broadcast_to(jnp.asarray(lo, jnp.uint32), (B,))
     hi = jnp.broadcast_to(jnp.asarray(hi, jnp.uint32), (B,))
-    whole_mask = jnp.broadcast_to(jnp.asarray(whole, bool), (B,))
+    whole_mask = jnp.broadcast_to(jnp.asarray(whole, jnp.bool_), (B,))
     return _chunk_cvs_pallas(words, jnp.asarray(lengths, jnp.int32),
                              lo, hi, whole_mask, interpret=interpret)
 
 
+@jit_registry.tracked("blake3.pallas.words")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def blake3_words_pallas(words, lengths, interpret: bool = False):
     """[B, C, 256] words + [B] lengths → [B, 8] digests (fast-path

@@ -28,7 +28,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from . import jit_registry
 
+
+@jit_registry.tracked("hamming.tile")
 @jax.jit
 def hamming_tile(x, y):
     """[n, W] × [m, W] uint32 → [n, m] int32 Hamming distances."""
@@ -44,6 +47,7 @@ def make_sharded_hamming(mesh):
     its tile of the distance matrix; no device ever sees the full N×N.
     """
 
+    @jit_registry.tracked("hamming.sharded")
     @jax.jit
     @functools.partial(
         jax.shard_map,
@@ -60,6 +64,7 @@ def make_sharded_hamming(mesh):
     return sharded
 
 
+@jit_registry.tracked("hamming.near_mask")
 @functools.partial(jax.jit, static_argnames=("threshold",))
 def _near_mask_tile(x, y, threshold: int):
     return hamming_tile(x, y) <= threshold
@@ -87,8 +92,10 @@ def near_dup_pairs(
     digests = np.ascontiguousarray(digests, dtype=np.uint32)
     N = digests.shape[0]
     if N <= tile:
-        mask = np.triu(np.asarray(
-            _near_mask_tile(digests, digests, threshold)), k=1)
+        with jit_registry.device_scope("hamming.pairs"):
+            dev_mask = _near_mask_tile(digests, digests, threshold)
+            with jit_registry.io("hamming.pairs"):
+                mask = np.triu(np.asarray(dev_mask), k=1)
         ii, jj = np.nonzero(mask)
         return list(zip(ii.tolist(), jj.tolist()))
     return near_dup_pairs_device(digests, threshold, tile=tile, stats=stats)
@@ -248,6 +255,7 @@ def _pair_mask(dots, i, j, T, bits, threshold, n):
     return _origin_pair_mask(dots, i * T, j * T, T, bits, threshold, n)
 
 
+@jit_registry.tracked("hamming.tile_counts")
 @functools.partial(jax.jit, static_argnames=("block",))
 def _tile_counts_block(planes, row0, threshold, n, block: int):
     """Pair counts for `block` consecutive row-tiles starting at row0.
@@ -274,9 +282,9 @@ def _tile_counts_block(planes, row0, threshold, n, block: int):
             return jnp.sum(_pair_mask(dots, i, j, T, BITS, threshold, n),
                            dtype=jnp.int32)
 
-        return jax.lax.map(col, jnp.arange(NT))
+        return jax.lax.map(col, jnp.arange(NT, dtype=jnp.int32))
 
-    return jax.lax.map(row, jnp.arange(block))
+    return jax.lax.map(row, jnp.arange(block, dtype=jnp.int32))
 
 
 def _refine_body(flat, coords, threshold, n, size: int, sub: int):
@@ -300,6 +308,7 @@ def _refine_body(flat, coords, threshold, n, size: int, sub: int):
     return jax.lax.map(one, coords)
 
 
+@jit_registry.tracked("hamming.refine")
 @functools.partial(jax.jit, static_argnames=("size", "sub"))
 def _refine_counts(flat, coords, threshold, n, size: int, sub: int):
     """Subdivide count blocks: for each (row0, col0) block origin pair
@@ -332,6 +341,7 @@ def make_sharded_pyramid(mesh):
           [F, sub, sub] int32   (size/sub fixed at tile → REFINE_SUB)
     """
 
+    @jit_registry.tracked("hamming.pyramid")
     @jax.jit
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -355,11 +365,12 @@ def make_sharded_pyramid(mesh):
                     _pair_mask(dots, base + k, j, T, BITS, threshold, n),
                     dtype=jnp.int32)
 
-            return jax.lax.map(col, jnp.arange(NT))
+            return jax.lax.map(col, jnp.arange(NT, dtype=jnp.int32))
 
-        return jax.lax.map(row, jnp.arange(local_nt))
+        return jax.lax.map(row, jnp.arange(local_nt, dtype=jnp.int32))
 
     def make_refine(size: int, sub: int):
+        @jit_registry.tracked("hamming.pyramid")
         @jax.jit
         @functools.partial(
             jax.shard_map, mesh=mesh,
@@ -374,6 +385,7 @@ def make_sharded_pyramid(mesh):
     return counts_fn, make_refine
 
 
+@jit_registry.tracked("hamming.leaf_masks")
 @functools.partial(jax.jit, static_argnames=("size",))
 def _leaf_masks(flat, coords, threshold, n, size: int):
     """[F, size, size] uint8 pair masks for leaf blocks — tiny enough
@@ -434,6 +446,14 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
         raise ValueError(f"tile must be a power of two, got {tile} "
                          "(the refinement pyramid subdivides by "
                          f"{REFINE_SUB})")
+    with jit_registry.device_scope("hamming.pairs"):
+        return _near_dup_pairs_device_guarded(digests, threshold, tile,
+                                              stats)
+
+
+def _near_dup_pairs_device_guarded(digests, threshold, tile, stats):
+    """Body of near_dup_pairs_device, run inside its device scope."""
+    N, W = digests.shape
     NT = -(-N // tile)
     padded = np.zeros((NT * tile, W), dtype=np.uint32)
     padded[:N] = digests
@@ -444,8 +464,10 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
     nn = jnp.int32(N)
     blocks = []
     for r0 in range(0, NT, COUNT_ROWS_PER_DISPATCH):
-        blk = np.asarray(_tile_counts_block(
-            planes, jnp.int32(r0), thr, nn, COUNT_ROWS_PER_DISPATCH))
+        dev_blk = _tile_counts_block(
+            planes, jnp.int32(r0), thr, nn, COUNT_ROWS_PER_DISPATCH)
+        with jit_registry.io("hamming.pairs"):
+            blk = np.asarray(dev_blk)
         blocks.append(blk[: NT - r0])
     counts = np.concatenate(blocks, axis=0)
 
@@ -480,8 +502,9 @@ def near_dup_pairs_device(digests: np.ndarray, threshold: int,
             fpad = _pow2(len(chunk))
             padded_c = np.zeros((fpad, 2), dtype=np.int32)
             padded_c[: len(chunk)] = chunk
-            res = np.asarray(fn(flat, jnp.asarray(padded_c), thr, nn,
-                                *args))
+            dev_res = fn(flat, jnp.asarray(padded_c), thr, nn, *args)
+            with jit_registry.io("hamming.pairs"):
+                res = np.asarray(dev_res)
             outs.append(res[: len(chunk)])
         return np.concatenate(outs, axis=0)
 

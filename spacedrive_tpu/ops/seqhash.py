@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import jit_registry
 from .blake3_batch import CHUNK_LEN, WORDS_PER_CHUNK, tree_reduce
 from .blake3_jax import _chunk_cvs_scan
 
@@ -67,6 +68,7 @@ def _shard_fn(words_local, length, shard_chunks: int,
     return jnp.stack([w[0] for w in top])  # [8]
 
 
+@jit_registry.tracked("seqhash.reduce")
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "shard_chunks", "root"))
 def _sharded_reduce(words, length, n_tops, base_lo, base_hi, *,
@@ -127,14 +129,16 @@ def make_sharded_checksum(mesh: Mesh,
         buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
         words = buf.view("<u4").reshape(D * shard_chunks, WORDS_PER_CHUNK)
         sharding = NamedSharding(mesh, P("data", None))
-        words_dev = jax.device_put(jnp.asarray(words), sharding)
-        n_tops = np.int32(-(-n_chunks // shard_chunks))
-        zero = jnp.zeros((), jnp.uint32)
-        digest = _sharded_reduce(
-            words_dev, jnp.asarray(len(data), jnp.int32),
-            jnp.asarray(n_tops), zero, zero,
-            mesh=mesh, shard_chunks=shard_chunks, root=True)
-        return np.asarray(digest).astype("<u4").tobytes()
+        with jit_registry.device_scope("seqhash.reduce"):
+            words_dev = jax.device_put(jnp.asarray(words), sharding)
+            n_tops = np.int32(-(-n_chunks // shard_chunks))
+            zero = jnp.zeros((), jnp.uint32)
+            digest = _sharded_reduce(
+                words_dev, jnp.asarray(len(data), jnp.int32),
+                jnp.asarray(n_tops), zero, zero,
+                mesh=mesh, shard_chunks=shard_chunks, root=True)
+            with jit_registry.io("seqhash.window"):
+                return np.asarray(digest).astype("<u4").tobytes()
 
     return fn
 
@@ -207,17 +211,20 @@ class StreamingShardedChecksum:
         buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
         words = buf.view("<u4").reshape(
             self._window_chunks, WORDS_PER_CHUNK)
-        words_dev = jax.device_put(jnp.asarray(words), self._sharding)
-        n_chunks = max(1, -(-len(data) // CHUNK_LEN))
-        n_tops = np.int32(-(-n_chunks // self._shard_chunks))
-        base = self._windows_done * self._window_chunks
-        top = _sharded_reduce(
-            words_dev, jnp.asarray(len(data), jnp.int32),
-            jnp.asarray(n_tops),
-            jnp.asarray(base & 0xFFFFFFFF, jnp.uint32),
-            jnp.asarray(base >> 32, jnp.uint32),
-            mesh=self._mesh, shard_chunks=self._shard_chunks, root=False)
-        return [int(w) for w in np.asarray(top)]
+        with jit_registry.device_scope("seqhash.reduce"):
+            words_dev = jax.device_put(jnp.asarray(words), self._sharding)
+            n_chunks = max(1, -(-len(data) // CHUNK_LEN))
+            n_tops = np.int32(-(-n_chunks // self._shard_chunks))
+            base = self._windows_done * self._window_chunks
+            top = _sharded_reduce(
+                words_dev, jnp.asarray(len(data), jnp.int32),
+                jnp.asarray(n_tops),
+                jnp.asarray(base & 0xFFFFFFFF, jnp.uint32),
+                jnp.asarray(base >> 32, jnp.uint32),
+                mesh=self._mesh, shard_chunks=self._shard_chunks,
+                root=False)
+            with jit_registry.io("seqhash.window"):
+                return [int(w) for w in np.asarray(top)]
 
     def _push_window_cv(self, cv: list) -> None:
         from .blake3_ref import BLOCK_LEN as B3_BLOCK, IV, PARENT, compress

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import cas
+from . import cas, jit_registry
 from .. import flags
 
 _STAGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
@@ -323,10 +323,11 @@ def h2d_gbps() -> float:
         import jax
 
         buf = np.zeros((8 << 20,), dtype=np.uint8)
-        np.asarray(jax.device_put(buf))  # warm
-        t0 = time.perf_counter()
-        np.asarray(jax.device_put(buf))
-        rt = time.perf_counter() - t0
+        with jit_registry.io("staging.h2d_probe"):
+            np.asarray(jax.device_put(buf))  # warm
+            t0 = time.perf_counter()
+            np.asarray(jax.device_put(buf))
+            rt = time.perf_counter() - t0
         # Round trip moves the buffer twice; assuming a roughly
         # symmetric link, one direction runs at 2*nbytes/rt.
         _H2D_GBPS = 2 * buf.nbytes / rt / 1e9
@@ -448,8 +449,15 @@ def cas_ids_for_files(
             IDENT_READ_ERRORS.inc(len(errors))
         return ids, errors
     # Staging (the file reads) belongs INSIDE the span on every backend
-    # so cross-backend span timings stay comparable.
-    with device_span(f"cas_ids/{backend}", batch=len(files)):
+    # so cross-backend span timings stay comparable. The jax backend
+    # additionally runs under the sanitizer's D2H transfer guard: the
+    # only sanctioned fetch in this region is cas_ids_jax's declared
+    # io("cas.ids") scope — anything else raises in tier-1.
+    from contextlib import nullcontext
+
+    guard = (jit_registry.device_scope(f"cas_ids/{backend}")
+             if backend == "jax" else nullcontext())
+    with device_span(f"cas_ids/{backend}", batch=len(files)), guard:
         large, small, empty_idx, errors = stage_files(files)
         ids: Dict[int, Optional[str]] = dict(
             _BACKENDS[backend](files, large, small))

@@ -24,6 +24,15 @@ engine actually depends on:
   `with db.tx(): ... await ...` anti-pattern): every other task on the
   loop can now deadlock behind a lock whose owner only resumes via the
   same loop.
+- **Device-contract guards** (round 10, armed via
+  `ops/jit_registry.arm()` at install) — the runtime twins of sdlint's
+  jit-stability and host-transfer passes: registered jit entry points
+  count retraces against their declared budgets
+  (`sd_jit_retraces_total{fn}` / `sd_jit_cache_size{fn}`, violation
+  kind `jit_retrace_budget`), and `device_scope()` regions arm JAX's
+  device-to-host transfer guard so an undeclared result fetch raises
+  in tier-1 and logs in production (kind `host_transfer`; declared
+  fetches go through `io(name)` scopes).
 
 Activation: `SDTPU_SANITIZE=1` + `install()` (tests/conftest.py calls
 it for tier-1; node bootstrap may too). `SDTPU_SANITIZE_MODE=raise`
@@ -306,6 +315,14 @@ def install() -> bool:
 
     _orig_handle_run = asyncio.events.Handle._run
     asyncio.events.Handle._run = _wrap_handle_run(_orig_handle_run)
+    # Arm the device-layer twin: jit retrace counting against the
+    # declared budgets and the D2H transfer guard inside
+    # device_scope()/io() regions (ops/jit_registry.py). Same
+    # raise/count split; violations flow through _record into the
+    # shared list + sd_sanitize_violations_total.
+    from .ops import jit_registry
+
+    jit_registry.arm(_mode, _record)
     _installed = True
     return True
 
@@ -321,4 +338,7 @@ def uninstall() -> None:
     if _orig_handle_run is not None:
         asyncio.events.Handle._run = _orig_handle_run
         _orig_handle_run = None
+    from .ops import jit_registry
+
+    jit_registry.disarm()
     _installed = False

@@ -24,7 +24,7 @@ Design constraints, in order:
   round 9 the lint is sdlint's telemetry pass; the shim remains).
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
-  sanitize.
+  sanitize | jit.
 - **No dependencies.** Pure stdlib plus the equally dependency-free
   flag registry (flags.py) — importable from every layer (store, p2p,
   ops) without cycles.
@@ -525,9 +525,27 @@ TRACE_SPANS = counter(
 SANITIZE_VIOLATIONS = counter(
     "sd_sanitize_violations_total",
     "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
-    "loop_stall | lock_across_await | lock_order_cycle",
+    "loop_stall | lock_across_await | lock_order_cycle | "
+    "jit_retrace_budget | host_transfer",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",
     "Longest single event-loop callback observed by the sanitizer "
     "since process start (0 while the sanitizer is off)")
+
+# -- jit contracts (ops/jit_registry.py) ------------------------------------
+JIT_RETRACES = counter(
+    "sd_jit_retraces_total",
+    "New jit traces (cache growth) observed by the retrace guard, per "
+    "registered contract name",
+    labelnames=("fn",))
+JIT_CACHE_SIZE = gauge(
+    "sd_jit_cache_size",
+    "Current process-wide trace count per registered jit contract "
+    "(compared against the contract's max_traces budget)",
+    labelnames=("fn",))
+JIT_DECLARED_TRANSFERS = counter(
+    "sd_jit_declared_transfers_total",
+    "Entries into declared io() host-transfer scopes, per contract "
+    "name (the sanctioned D2H points of the device pipelines)",
+    labelnames=("fn",))

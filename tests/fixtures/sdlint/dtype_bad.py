@@ -1,0 +1,30 @@
+"""sdlint fixture — dtype-discipline KNOWN POSITIVES."""
+
+import jax.numpy as jnp
+
+
+def x64_dependent_creations(n):
+    a = jnp.arange(8)            # implicit dtype: int32 or int64 by flag
+    b = jnp.zeros((4,))          # implicit dtype
+    c = jnp.asarray(123)         # dtype chosen by VALUE under x64
+    return a, b, c, n
+
+
+def builtin_casts(x):
+    lanes = jnp.zeros((4,), int)     # Python-builtin dtype
+    return x.astype(int) + lanes     # .astype(int) width follows x64
+
+
+def mixed_direct():
+    idx = jnp.arange(8, dtype=jnp.int32)
+    mask = jnp.uint32(7)
+    return idx & mask            # int32/uint32 in one op
+
+
+def _wrap_mask():
+    return jnp.uint32(0xFFFF)
+
+
+def mixed_via_helper():
+    base = jnp.arange(4, dtype=jnp.int32)
+    return base + _wrap_mask()   # interprocedural int32/uint32 mix

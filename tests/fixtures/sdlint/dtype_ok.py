@@ -1,0 +1,26 @@
+"""sdlint fixture — dtype-discipline KNOWN NEGATIVES (all clean)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def explicit_creations(words):
+    idx = jnp.arange(8, dtype=jnp.int32)
+    pad = jnp.zeros((4,), jnp.uint32)
+    carry = jnp.zeros_like(words)            # dtype-preserving
+    arr = jnp.asarray(words)                 # dtype-preserving
+    return idx, pad, carry, arr
+
+
+def same_sign_arith():
+    lo = jnp.uint32(1)
+    hi = jnp.uint32(2)
+    counter = lo + hi                        # uint32 + uint32
+    steps = jnp.arange(4, dtype=jnp.int32)
+    return counter, steps - jnp.int32(1)     # int32 - int32
+
+
+def explicit_casts(x):
+    as_words = x.astype(jnp.uint32)
+    host = np.asarray([1, 2], dtype=np.uint32)
+    return as_words, host

@@ -1,0 +1,33 @@
+"""sdlint fixture — jit-stability KNOWN NEGATIVES (all clean)."""
+
+import functools
+
+import jax
+import numpy as np
+
+from spacedrive_tpu.ops import jit_registry
+
+
+@jit_registry.tracked("hamming.tile")
+@jax.jit
+def bound_tile(x, y):
+    return x ^ y
+
+
+@jit_registry.tracked("hamming.near_mask")
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def bound_mask(x, y, threshold: int):
+    return (x ^ y) <= threshold
+
+
+def _body(words, lengths):
+    return words[:, 0] + lengths
+
+
+bound_assign = jit_registry.tracked("blake3.jnp")(jax.jit(_body))
+
+
+def caller(d):
+    pre = np.zeros((8, 2), dtype=np.uint32)  # bucketed, not len()-shaped
+    mask = bound_mask(pre, d, threshold=6)   # hashable static arg
+    return bound_tile(mask, mask)

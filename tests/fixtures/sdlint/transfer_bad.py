@@ -1,0 +1,36 @@
+"""sdlint fixture — host-transfer KNOWN POSITIVES."""
+
+import jax
+import numpy as np
+
+from spacedrive_tpu.ops import jit_registry
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def undeclared_fetch(x):
+    out = kernel(x)
+    return np.asarray(out)             # stray D2H, no io(...) scope
+
+
+def implicit_sync(x):
+    r = kernel(x)
+    if r:                              # hidden __bool__ → full D2H sync
+        return float(r)                # hidden __float__ → D2H sync
+    return 0.0
+
+
+def blocking_idioms(x):
+    out = kernel(x)
+    out.block_until_ready()            # undeclared sync
+    first = kernel(x)[0].item()        # undeclared .item() fetch
+    return jax.device_get(out), first  # undeclared explicit fetch
+
+
+def rogue_io_scope(x):
+    out = kernel(x)
+    with jit_registry.io("not.a.contract"):  # name never declared
+        return np.asarray(out)

@@ -1,0 +1,34 @@
+"""sdlint fixture — host-transfer KNOWN NEGATIVES (all clean)."""
+
+import asyncio
+
+import jax
+import numpy as np
+
+from spacedrive_tpu.ops import jit_registry
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def declared_fetch(x):
+    out = kernel(x)
+    with jit_registry.io("cas.ids"):   # declared host_transfer contract
+        return np.asarray(out)
+
+
+def input_prep(rows):
+    # np.asarray feeding the jit boundary is H2D staging, not a fetch
+    return kernel(np.asarray(rows, dtype=np.uint32))
+
+
+async def offloaded(x):
+    out = kernel(x)
+    return await asyncio.to_thread(np.asarray, out)
+
+
+def host_only(rows):
+    # no jit call in sight: numpy conversions here are host work
+    return np.asarray(rows).sum()

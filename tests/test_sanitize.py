@@ -128,6 +128,139 @@ def test_cross_thread_lock_tracking_is_per_thread():
     assert seen == [[]]  # the other thread holds nothing
 
 
+# -- device-contract guards (round 10: ops/jit_registry.py) -----------------
+
+def test_jit_registry_armed_by_conftest():
+    from spacedrive_tpu.ops import jit_registry
+
+    assert jit_registry.armed()
+
+
+def test_retrace_budget_counts_and_raises(clean_violations):
+    """A registered jit exceeding its declared trace budget is a
+    sanitizer violation at the call that crossed it, and every trace
+    lands in sd_jit_retraces_total / sd_jit_cache_size."""
+    import jax
+    import jax.numpy as jnp
+
+    from spacedrive_tpu.ops import jit_registry
+    from spacedrive_tpu.telemetry import JIT_CACHE_SIZE, JIT_RETRACES
+
+    with jit_registry.temporary_contract("test.retrace", max_traces=1):
+
+        @jit_registry.tracked("test.retrace")
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        f(jnp.ones(3))                      # trace 1: within budget
+        f(jnp.ones(3))                      # cache hit: no new trace
+        assert jit_registry.trace_counts()["test.retrace"] == 1
+        with pytest.raises(sanitize.SanitizerViolation):
+            f(jnp.ones(4))                  # trace 2: budget exceeded
+        assert jit_registry.trace_counts()["test.retrace"] == 2
+        if telemetry.enabled():
+            assert JIT_RETRACES.labels(fn="test.retrace").value == 2
+            assert JIT_CACHE_SIZE.labels(fn="test.retrace").value == 2
+        hits = [v for v in sanitize.violations()
+                if v["kind"] == "jit_retrace_budget"]
+        assert hits and "test.retrace" in hits[0]["detail"]
+
+
+def test_tracked_requires_declared_contract():
+    from spacedrive_tpu.ops import jit_registry
+
+    with pytest.raises(KeyError):
+        jit_registry.tracked("never.declared.anywhere")
+
+
+def test_undeclared_io_scope_raises(clean_violations):
+    from spacedrive_tpu.ops import jit_registry
+
+    with pytest.raises(sanitize.SanitizerViolation):
+        with jit_registry.io("never.declared.anywhere"):
+            pass
+
+
+def test_device_scope_arms_d2h_guard_and_io_lifts_it(monkeypatch):
+    """raise mode: device_scope enters JAX's D2H guard at `disallow`;
+    a declared io scope re-enters at `allow` and counts the declared
+    transfer. (The CPU backend's D2H is zero-copy and never trips the
+    real guard, so the wiring is pinned via the cm seam.)"""
+    from contextlib import contextmanager
+
+    import jax
+
+    from spacedrive_tpu.ops import jit_registry
+    from spacedrive_tpu.telemetry import JIT_DECLARED_TRANSFERS
+
+    levels = []
+
+    @contextmanager
+    def fake_guard(level):
+        levels.append(level)
+        yield
+
+    monkeypatch.setattr(jax, "transfer_guard_device_to_host", fake_guard)
+    before = JIT_DECLARED_TRANSFERS.labels(fn="cas.ids").value
+    with jit_registry.device_scope("test"):
+        pass
+    with jit_registry.io("cas.ids"):
+        pass
+    assert levels == ["disallow", "allow"]
+    if telemetry.enabled():
+        assert JIT_DECLARED_TRANSFERS.labels(
+            fn="cas.ids").value == before + 1
+
+
+def test_device_scope_records_transfer_guard_error(clean_violations):
+    """A transfer-guard error escaping a device scope is recorded as a
+    host_transfer violation and re-raised with the original traceback
+    (the offending fetch stays visible)."""
+    from spacedrive_tpu.ops import jit_registry
+
+    with pytest.raises(RuntimeError, match="transfer"):
+        with jit_registry.device_scope("test"):
+            raise RuntimeError(
+                "Disallowed device-to-host transfer: f32[8]")
+    hits = [v for v in sanitize.violations()
+            if v["kind"] == "host_transfer"]
+    assert hits and "device scope test" in hits[0]["detail"]
+
+
+def test_transfer_guard_flag_off_disables_scopes(monkeypatch,
+                                                 clean_violations):
+    from spacedrive_tpu.ops import jit_registry
+
+    monkeypatch.setenv("SDTPU_TRANSFER_GUARD", "off")
+    # no jax cm entered, no violation recorded on the error path either
+    with pytest.raises(ValueError):
+        with jit_registry.device_scope("test"):
+            raise ValueError("unrelated")
+    assert not [v for v in sanitize.violations()
+                if v["kind"] == "host_transfer"]
+
+
+def test_retrace_guard_flag_off_disables_counting(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from spacedrive_tpu.ops import jit_registry
+
+    monkeypatch.setenv("SDTPU_RETRACE_GUARD", "off")
+    with jit_registry.temporary_contract("test.retrace_off",
+                                         max_traces=1):
+
+        @jit_registry.tracked("test.retrace_off")
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        f(jnp.ones(2))
+        f(jnp.ones(5))  # over budget, but the guard is off
+        assert "test.retrace_off" not in jit_registry.trace_counts()
+
+
 def test_violations_surface_in_metrics_snapshot(clean_violations):
     """sd_sanitize_* families are part of the node-wide namespace:
     a recorded violation shows up in telemetry.snapshot() and the

@@ -1,12 +1,15 @@
 """sdlint framework: per-pass fixtures, the tree gate, baseline policy.
 
 This is the tier-1 hook that replaced the direct telemetry_lint run:
-`test_tree_clean_within_baseline` runs ALL five passes over the repo
-and fails on any finding not in tools/sdlint/baseline.json (which may
-only shrink — budget enforced here too). The per-pass tests pin each
-pass to a known-positive / known-negative fixture pair under
+`test_tree_clean_within_baseline` runs ALL eight passes (five
+concurrency/invariant + the round-10 device trio: jit-stability,
+dtype-discipline, host-transfer) over the repo and fails on any
+finding not in tools/sdlint/baseline.json (which may only shrink —
+budget enforced here too). The per-pass tests pin each pass to a
+known-positive / known-negative fixture pair under
 tests/fixtures/sdlint/, including the encoded PR 1 store/db.py
-reader-registration deadlock shape (locks_bad.Pr1Database).
+reader-registration deadlock shape (locks_bad.Pr1Database) and the
+encoded overlap.py:166 call-time-jit shape (jit_bad.call_time).
 """
 
 import os
@@ -113,6 +116,81 @@ def test_telemetry_lint_shim_api_intact():
     assert telemetry_lint.NAME_RE.match("sd_sanitize_violations_total")
 
 
+# -- jit-stability (round 10: the device-contract pass) ---------------------
+
+def test_jit_stability_flags_known_positives():
+    found = _lint_fixture("jit_bad.py", "jit-stability")
+    codes = {f.code for f in found}
+    assert codes == {
+        "unregistered-jit", "unknown-jit-name", "static-args-mismatch",
+        "static-argnums", "call-time-jit", "jit-in-loop",
+        "unhashable-static-arg", "value-dependent-shape"}, codes
+    # the overlap.py:166 shape is the canonical call-time positive
+    assert any(f.code == "call-time-jit" and f.qual == "call_time"
+               for f in found)
+
+
+def test_jit_stability_passes_known_negatives():
+    assert _lint_fixture("jit_ok.py", "jit-stability") == []
+
+
+def test_every_registry_contract_site_exists():
+    """Contracts must point at real code: each declared site's file and
+    qualname resolve in the tree (a renamed function must rename its
+    contract, or the factory/association rules silently stop applying)."""
+    from tools.sdlint.passes.jit_stability import declared_contracts
+
+    project = load_project(ROOT)
+    quals = {f"{f.src.relpath}::{f.qual}"
+             for f in project.index.funcs}
+    classes = set()
+    for src in project.files:
+        import ast as _ast
+        for node in _ast.walk(src.tree):
+            if isinstance(node, _ast.ClassDef):
+                classes.add(f"{src.relpath}::{node.name}")
+    for name, c in declared_contracts(ROOT).items():
+        assert c["site"] in quals | classes, (
+            f"contract {name!r} points at missing site {c['site']!r}")
+
+
+# -- dtype-discipline -------------------------------------------------------
+
+def test_dtype_discipline_flags_known_positives():
+    found = _lint_fixture("dtype_bad.py", "dtype-discipline")
+    codes = {f.code for f in found}
+    assert codes == {"implicit-dtype", "builtin-dtype-cast",
+                     "mixed-sign-arith"}, codes
+    mixed = {f.qual for f in found if f.code == "mixed-sign-arith"}
+    assert "mixed_direct" in mixed
+    # the interprocedural half: the uint32 arrives via a helper's return
+    assert "mixed_via_helper" in mixed
+
+
+def test_dtype_discipline_passes_known_negatives():
+    assert _lint_fixture("dtype_ok.py", "dtype-discipline") == []
+
+
+# -- host-transfer ----------------------------------------------------------
+
+def test_host_transfer_flags_known_positives():
+    found = _lint_fixture("transfer_bad.py", "host-transfer")
+    codes = {f.code for f in found}
+    assert codes == {"undeclared-transfer", "implicit-host-bool",
+                     "implicit-host-cast", "undeclared-io"}, codes
+    idioms = {f.ident for f in found if f.code == "undeclared-transfer"}
+    assert any(i.startswith("np.asarray") for i in idioms)
+    assert any(i.startswith(".item()") for i in idioms)
+    assert any(i.startswith("block_until_ready") for i in idioms)
+    assert any(i.startswith("device_get") for i in idioms)
+
+
+def test_host_transfer_passes_known_negatives():
+    """Declared io scopes, jit-input prep, to_thread offload, and
+    jit-free host code are all sanctioned."""
+    assert _lint_fixture("transfer_ok.py", "host-transfer") == []
+
+
 # -- the tree gate (runs all five passes; tier-1's CI hook) -----------------
 
 def test_tree_clean_within_baseline():
@@ -150,7 +228,55 @@ def test_baseline_prune_never_adds():
 def test_every_registered_pass_ran_on_tree():
     assert set(PASSES) == {
         "blocking-async", "lock-discipline", "crdt-parity",
-        "flag-registry", "telemetry"}
+        "flag-registry", "telemetry", "jit-stability",
+        "dtype-discipline", "host-transfer"}
+
+
+DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
+
+
+def test_device_pass_baseline_entries_individually_reasoned():
+    """Round-10 hygiene: every baselined device-pass finding carries
+    its OWN reason — no blanket waivers copy-pasted across entries
+    (the concurrency passes grandfathered a shared bench-CLI reason;
+    the device family starts stricter)."""
+    baseline = Baseline.load(DEFAULT_PATH)
+    dev = {k: v for k, v in baseline.entries.items()
+           if k.split("::", 1)[0] in DEVICE_PASSES}
+    assert dev, "expected the tools-CLI device findings to be baselined"
+    for key, reason in dev.items():
+        assert len(reason.strip()) >= 20, f"thin reason on {key}"
+    assert len(set(dev.values())) == len(dev), (
+        "duplicate device-pass baseline reasons — write one per entry")
+
+
+def test_subset_run_cannot_wipe_other_pass_baseline(tmp_path):
+    """--passes jit-stability --update-baseline must not judge (or
+    prune) the concurrency passes' entries."""
+    import json
+    import shutil
+
+    from tools.sdlint.__main__ import main
+
+    bl = tmp_path / "baseline.json"
+    shutil.copy(DEFAULT_PATH, bl)
+    before = json.load(open(bl))["findings"]
+    rc = main(["--passes", "jit-stability", "--update-baseline",
+               "--baseline", str(bl)])
+    assert rc == 0
+    after = json.load(open(bl))["findings"]
+    keep = {k: v for k, v in before.items()
+            if k.split("::", 1)[0] != "jit-stability"}
+    assert all(after.get(k) == v for k, v in keep.items()), (
+        "subset run dropped other passes' baseline entries")
+
+
+def test_cli_passes_with_no_value_lists_passes(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--passes"]) == 0
+    out = capsys.readouterr().out.split()
+    assert set(PASSES) <= set(out)
 
 
 # -- flags registry integration --------------------------------------------

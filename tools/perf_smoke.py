@@ -205,6 +205,20 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         # node.metrics serve — embedded so future perf PRs report phase
         # splits from production telemetry, not ad-hoc prints.
         emit({"stage": "telemetry", "metrics": telemetry.snapshot()})
+        # Compile-stability proof for the artifact: per-contract trace
+        # counts vs their declared budgets (ops/jit_registry.py). A
+        # bench run whose jit section shows counts ≤ budget proves the
+        # identify pipeline hit only canonical shapes — no silent
+        # recompiles hiding in the measured wall.
+        from spacedrive_tpu.ops import jit_registry
+
+        traces = jit_registry.trace_counts()
+        emit({"stage": "jit", "traces": traces, "budgets": {
+            name: jit_registry.CONTRACTS[name].max_traces
+            for name in traces
+        }, "over_budget": sorted(
+            name for name, n in traces.items()
+            if n > jit_registry.CONTRACTS[name].max_traces)})
     if json_out:
         with open(json_out, "w") as f:
             json.dump({

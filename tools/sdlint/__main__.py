@@ -3,6 +3,7 @@
     python -m tools.sdlint                     # lint the tree, text out
     python -m tools.sdlint --json              # machine-readable findings
     python -m tools.sdlint --passes lock-discipline,crdt-parity
+    python -m tools.sdlint --passes            # list registered passes
     python -m tools.sdlint --update-baseline   # prune stale entries only
     python -m tools.sdlint --write-baseline    # bootstrap (see policy!)
     python -m tools.sdlint --flag-table        # README flag table stdout
@@ -28,8 +29,9 @@ def main(argv=None) -> int:
         description="spacedrive_tpu concurrency & invariant analyzer")
     ap.add_argument("--root", default=repo_root(),
                     help="repo root (default: auto)")
-    ap.add_argument("--passes", default="",
-                    help="comma-separated subset of passes")
+    ap.add_argument("--passes", nargs="?", const="?list", default="",
+                    help="comma-separated subset of passes; with no "
+                         "value, list the registered passes and exit")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
     ap.add_argument("--baseline", default=DEFAULT_PATH,
@@ -55,6 +57,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import flags
         print(flags.flag_table_markdown())
+        return 0
+
+    if args.passes == "?list":
+        from .passes import PASSES
+        for name in PASSES:
+            print(name)
         return 0
 
     pass_names = [p.strip() for p in args.passes.split(",") if p.strip()]

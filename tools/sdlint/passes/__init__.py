@@ -9,11 +9,15 @@ from .lock_discipline import LockDisciplinePass
 from .crdt_parity import CrdtParityPass
 from .flag_registry import FlagRegistryPass
 from .telemetry import TelemetryPass
+from .jit_stability import JitStabilityPass
+from .dtype_discipline import DtypeDisciplinePass
+from .host_transfer import HostTransferPass
 
 PASSES = {
     p.name: p for p in (
         BlockingAsyncPass(), LockDisciplinePass(), CrdtParityPass(),
-        FlagRegistryPass(), TelemetryPass(),
+        FlagRegistryPass(), TelemetryPass(), JitStabilityPass(),
+        DtypeDisciplinePass(), HostTransferPass(),
     )
 }
 

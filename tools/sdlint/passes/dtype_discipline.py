@@ -1,0 +1,280 @@
+"""Pass: dtype-discipline — exact integer semantics in device kernels.
+
+BLAKE3's compression function is uint32 wrap-around arithmetic; the
+near-dup pyramid's index math is int32 by declaration. Inside device
+code, two dtype hazards silently corrupt either the math or the trace
+cache:
+
+- `mixed-sign-arith`   — int32/uint32 operands in one arithmetic op:
+  JAX promotes to int64 under x64 (different wrap-around!) and raises
+  or weakly promotes elsewhere — either way the kernel's bit-exact
+  contract is gone. Detection is a local dtype inference over
+  assignments (`jnp.uint32(x)`, `.astype(jnp.int32)`, dtype'd creation
+  calls, `jax.lax.axis_index`) extended one level interprocedurally:
+  a call to a resolvable project function contributes that function's
+  inferred return dtype.
+- `implicit-dtype`     — `jnp.arange/zeros/ones/full` without a dtype
+  (or `jnp.array/asarray` over bare numeric literals): the result
+  dtype then depends on the x64 flag, so the same code traces int32
+  programs in production and int64 ones wherever x64 is enabled — a
+  retrace at best, different wrap semantics at worst.
+- `builtin-dtype-cast` — `.astype(int)` / `dtype=float` with Python
+  builtins: width follows the platform/x64 flag, not the kernel spec.
+
+Scope: modules that import `jax.numpy` (device code), wherever they
+live — the uint32 contract travels with the kernel, not the directory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, FuncInfo, Project, dotted, own_body_walk
+
+PASS = "dtype-discipline"
+
+_INT_DTYPES = {"int8", "int16", "int32", "int64",
+               "uint8", "uint16", "uint32", "uint64"}
+_ALL_DTYPES = _INT_DTYPES | {"float32", "float64", "bfloat16", "float16",
+                             "bool_", "bool"}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod,
+              ast.LShift, ast.RShift, ast.BitOr, ast.BitAnd, ast.BitXor)
+_CREATION = {"arange", "zeros", "ones", "full", "array", "asarray"}
+# dtype position for creation calls that accept it positionally
+_DTYPE_POS = {"zeros": 1, "ones": 1, "full": 2, "array": 1, "asarray": 1}
+
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the jax.numpy module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.numpy":
+                    out.add(alias.asname or "jax.numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        out.add(alias.asname or "numpy")
+    return out
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """'uint32' for jnp.uint32 / np.uint32 / "uint32" expressions."""
+    d = dotted(node)
+    if d is not None:
+        last = d.rsplit(".", 1)[-1]
+        if last in _ALL_DTYPES:
+            return "bool" if last == "bool_" else last
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _ALL_DTYPES:
+        return node.value
+    return None
+
+
+def _call_dtype_kw(call: ast.Call, terminal: str) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return _dtype_name(kw.value)
+    pos = _DTYPE_POS.get(terminal)
+    if pos is not None and len(call.args) > pos:
+        return _dtype_name(call.args[pos])
+    return None
+
+
+class _Inference:
+    """Best-effort local dtype inference, with one-level
+    interprocedural return-dtype propagation via the shared resolver."""
+
+    def __init__(self, project: Project):
+        self.idx = project.index
+        self._ret_memo: Dict[str, Optional[str]] = {}
+
+    def func_env(self, fn: FuncInfo) -> Dict[str, Optional[str]]:
+        env: Dict[str, Optional[str]] = {}
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = self.of(node.value, env, fn)
+        return env
+
+    def return_dtype(self, fn: FuncInfo,
+                     stack: frozenset = frozenset()) -> Optional[str]:
+        key = f"{fn.src.relpath}::{fn.qual}"
+        if key in self._ret_memo:
+            return self._ret_memo[key]
+        if key in stack:
+            return None
+        env = {}
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = self.of(
+                    node.value, env, fn, stack | {key})
+        rets = set()
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                rets.add(self.of(node.value, env, fn, stack | {key}))
+        out = rets.pop() if len(rets) == 1 else None
+        self._ret_memo[key] = out
+        return out
+
+    def of(self, node: ast.AST, env: Dict[str, Optional[str]],
+           fn: FuncInfo, stack: frozenset = frozenset()) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Subscript):
+            return self.of(node.value, env, fn, stack)
+        if isinstance(node, ast.UnaryOp):
+            return self.of(node.operand, env, fn, stack)
+        if isinstance(node, ast.BinOp):
+            lt = self.of(node.left, env, fn, stack)
+            rt = self.of(node.right, env, fn, stack)
+            return lt if lt is not None else rt
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None:
+                # x.astype(D) and friends on non-dotted receivers
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args:
+                    return _dtype_name(node.args[0])
+                return None
+            last = d.rsplit(".", 1)[-1]
+            if last == "astype" and node.args:
+                return _dtype_name(node.args[0])
+            if last in _ALL_DTYPES:
+                return "bool" if last == "bool_" else last
+            if d == "jax.lax.axis_index":
+                return "int32"
+            if last in _CREATION:
+                return _call_dtype_kw(node, last)
+            callee = self.idx.resolve(fn, d)
+            if callee is not None and not callee.is_async:
+                return self.return_dtype(callee, stack)
+        return None
+
+
+def _signed_unsigned_pair(a: Optional[str], b: Optional[str]) -> bool:
+    if a is None or b is None or a == b:
+        return False
+    if a not in _INT_DTYPES or b not in _INT_DTYPES:
+        return False
+    return a.startswith("uint") != b.startswith("uint")
+
+
+class DtypeDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        inf = _Inference(project)
+        for src in project.files:
+            aliases = _jnp_aliases(src.tree)
+            if not aliases:
+                continue
+            self._module_checks(src, aliases, findings)
+            for fn in project.index.funcs:
+                if fn.src is not src:
+                    continue
+                env = inf.func_env(fn)
+                for node in own_body_walk(fn.node):
+                    if isinstance(node, ast.BinOp) \
+                            and isinstance(node.op, _ARITH_OPS):
+                        lt = inf.of(node.left, env, fn)
+                        rt = inf.of(node.right, env, fn)
+                        if _signed_unsigned_pair(lt, rt):
+                            expr = ast.unparse(node)[:60]
+                            findings.append(Finding(
+                                PASS, "mixed-sign-arith", src.relpath,
+                                fn.qual, f"{lt}^{rt}:{expr}",
+                                f"mixed {lt}/{rt} arithmetic `{expr}`: "
+                                f"promotes to int64 under x64 (different "
+                                f"wrap-around) — cast one side "
+                                f"explicitly", node.lineno))
+        return findings
+
+    def _module_checks(self, src, aliases: Set[str],
+                       findings: List[Finding]) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                # .astype(int) on computed receivers
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype":
+                    self._builtin_cast(node, src, findings)
+                continue
+            parts = d.split(".")
+            last = parts[-1]
+            if last == "astype":
+                self._builtin_cast(node, src, findings)
+                continue
+            base = ".".join(parts[:-1])
+            if base not in aliases or last not in _CREATION:
+                continue
+            if self._dtype_is_builtin(node, last):
+                findings.append(Finding(
+                    PASS, "builtin-dtype-cast", src.relpath, "",
+                    f"{d}:dtype",
+                    f"`{d}` with a Python-builtin dtype: width follows "
+                    f"the x64 flag, not the kernel spec — use an "
+                    f"explicit jnp dtype", node.lineno))
+                continue
+            if _call_dtype_kw(node, last) is not None:
+                continue
+            if last in ("array", "asarray") \
+                    and not self._bare_numeric(node):
+                continue  # dtype-preserving conversion of an array var
+            if last.endswith("_like"):
+                continue
+            findings.append(Finding(
+                PASS, "implicit-dtype", src.relpath, "", d,
+                f"`{d}` without an explicit dtype: the result is "
+                f"int32 or int64 depending on the x64 flag — a silent "
+                f"retrace (or wrap-semantics change) per flag state",
+                node.lineno))
+
+    @staticmethod
+    def _dtype_is_builtin(call: ast.Call, terminal: str) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in ("int", "float", "bool"):
+                return True
+        pos = _DTYPE_POS.get(terminal)
+        if pos is not None and len(call.args) > pos \
+                and isinstance(call.args[pos], ast.Name) \
+                and call.args[pos].id in ("int", "float", "bool"):
+            return True
+        return False
+
+    @staticmethod
+    def _bare_numeric(call: ast.Call) -> bool:
+        """array/asarray over literals (dtype chosen by VALUE)."""
+        if not call.args:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, (int, float)):
+            return True
+        if isinstance(arg, (ast.List, ast.Tuple)) and arg.elts and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, (int, float)) for e in arg.elts):
+            return True
+        if isinstance(arg, ast.Call) and dotted(arg.func) == "len":
+            return True
+        return False
+
+    def _builtin_cast(self, node: ast.Call, src,
+                      findings: List[Finding]) -> None:
+        if node.args and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in ("int", "float", "bool"):
+            expr = ast.unparse(node)[:60]
+            findings.append(Finding(
+                PASS, "builtin-dtype-cast", src.relpath, "",
+                f"astype:{node.args[0].id}",
+                f"`{expr}`: .astype({node.args[0].id}) width follows "
+                f"the x64 flag — use an explicit jnp dtype",
+                node.lineno))
