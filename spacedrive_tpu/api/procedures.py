@@ -17,6 +17,7 @@ import uuid as uuidlib
 from typing import Any, Dict, List, Optional
 
 from .. import backups as backups_mod
+from .. import tasks
 from .. import telemetry
 from .. import tracing
 from ..jobs.report import JobStatus
@@ -1453,7 +1454,12 @@ def _auth(r: Router) -> None:
                 # breaks Response::Error on every failure arm).
                 emit({"state": "Error"})
 
-        task = asyncio.get_running_loop().create_task(poll())
+        # Supervised: the returned cancel-handle leaked this task
+        # whenever the subscriber disconnected before the first emit —
+        # node.shutdown's reap now sweeps an un-cancelled poll
+        # (tests/test_shutdown_leaks.py asserts none survive close()).
+        task = tasks.spawn("auth-poll", poll(),
+                           owner=f"{node.task_owner}/api")
         return task.cancel
 
 

@@ -28,10 +28,11 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .. import telemetry
+from .. import tasks, telemetry
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
 from ..telemetry import API_REQUESTS
+from ..timeouts import with_timeout
 from .router import Router, RpcError, mount_router
 
 RANGE_CHUNK = 1 << 20
@@ -50,6 +51,7 @@ async def _count_requests(request: web.Request, handler):
 class ApiServer:
     def __init__(self, node, router: Optional[Router] = None):
         self.node = node
+        self._owner = f"{getattr(node, 'task_owner', 'proc')}/api"
         self.router = router or mount_router(node)
         self.app = web.Application(middlewares=[_count_requests])
         self.app.router.add_get("/", self._index)
@@ -146,9 +148,20 @@ class ApiServer:
         path = request.match_info["path"]
         if request.method == "POST":
             try:
-                input = await request.json()
+                # Budgeted body read: a slow-loris client cannot pin
+                # the handler.
+                input = await with_timeout("api.http.read",
+                                           request.json())
             except json.JSONDecodeError:
                 input = None
+            except asyncio.TimeoutError:
+                # A half-sent body must FAIL the request, not dispatch
+                # the procedure with null input.
+                return web.json_response(
+                    {"error": {"code": "TIMEOUT",
+                               "message": "request body read timed "
+                                          "out"}},
+                    status=408)
         else:
             raw = request.query.get("input")
             input = json.loads(raw) if raw else None
@@ -163,32 +176,65 @@ class ApiServer:
 
     async def _rspc_ws(self, request: web.Request) -> web.WebSocketResponse:
         ws = web.WebSocketResponse()
-        await ws.prepare(request)
+        await with_timeout("api.ws.prepare", ws.prepare(request))
         subscriptions: Dict[Any, Any] = {}
         loop = asyncio.get_running_loop()
+
+        async def ws_emit(payload: dict) -> None:
+            # One subscription event to one subscriber, budgeted; a
+            # subscriber that vanished mid-emit (connection gone, send
+            # budget fired) is unsubscribe racing us, not an engine
+            # error. Anything else — an unserializable payload above
+            # all — propagates so the supervisor's done-callback
+            # records the task_exception instead of silence.
+            try:
+                await with_timeout("api.ws.send", ws.send_json(payload))
+            except (asyncio.TimeoutError, ConnectionError,
+                    RuntimeError):
+                # RuntimeError: aiohttp's "websocket connection is
+                # closing" shape on a half-closed socket.
+                pass
 
         async def handle(msg: dict) -> None:
             mid = msg.get("id")
             mtype = msg.get("type")
             try:
                 if mtype in ("query", "mutation"):
-                    result = await self.router.dispatch(
-                        msg["path"], msg.get("input"))
-                    await ws.send_json(
-                        {"id": mid, "type": "response", "result": result})
+                    try:
+                        result = await self.router.dispatch(
+                            msg["path"], msg.get("input"))
+                    except asyncio.TimeoutError as e:
+                        # A budget fired INSIDE the procedure (p2p/sync
+                        # await): the socket is fine — report it, as
+                        # distinct from an api.ws.send wedge below.
+                        raise RpcError(
+                            "TIMEOUT", f"upstream timeout: {e}") from e
+                    await with_timeout("api.ws.send", ws.send_json(
+                        {"id": mid, "type": "response", "result": result}))
                 elif mtype == "subscription":
                     def emit(data, _mid=mid):
                         # Thread-safe: event bus callbacks may fire from
-                        # worker threads.
+                        # worker threads. Supervised spawn: the emit
+                        # task's outcome is observed and node shutdown
+                        # reaps in-flight emits.
                         loop.call_soon_threadsafe(
-                            lambda: loop.create_task(ws.send_json(
-                                {"id": _mid, "type": "event",
-                                 "data": data})))
-                    unsub = await self.router.subscribe(
-                        msg["path"], msg.get("input"), emit)
+                            lambda: tasks.spawn(
+                                "ws-emit",
+                                ws_emit({"id": _mid, "type": "event",
+                                         "data": data}),
+                                owner=self._owner))
+                    try:
+                        unsub = await self.router.subscribe(
+                            msg["path"], msg.get("input"), emit)
+                    except asyncio.TimeoutError as e:
+                        # Same split as the dispatch branch above: a
+                        # budget firing INSIDE the handler is not a
+                        # send wedge — the client must hear about it.
+                        raise RpcError(
+                            "TIMEOUT", f"upstream timeout: {e}") from e
                     subscriptions[mid] = unsub
-                    await ws.send_json(
-                        {"id": mid, "type": "response", "result": None})
+                    await with_timeout("api.ws.send", ws.send_json(
+                        {"id": mid, "type": "response", "result": None}))
                 elif mtype == "subscriptionStop":
                     unsub = subscriptions.pop(mid, None)
                     if unsub:
@@ -196,12 +242,21 @@ class ApiServer:
                 else:
                     raise RpcError("BAD_REQUEST",
                                    f"unknown frame type {mtype}")
+            except asyncio.TimeoutError:
+                # An api.ws.send budget fired: the transport itself is
+                # wedged — answering over the same stalled socket would
+                # just double the wedge (and report a dispatch error
+                # that never happened). Drop the frame; the read side
+                # or reap tears the connection down.
+                pass
             except RpcError as e:
-                await ws.send_json({"id": mid, "type": "error",
-                                    "code": e.code, "message": e.message})
+                await with_timeout("api.ws.send", ws.send_json(
+                    {"id": mid, "type": "error",
+                     "code": e.code, "message": e.message}))
             except Exception as e:  # noqa: BLE001 — protocol boundary
-                await ws.send_json({"id": mid, "type": "error",
-                                    "code": "INTERNAL", "message": str(e)})
+                await with_timeout("api.ws.send", ws.send_json(
+                    {"id": mid, "type": "error",
+                     "code": "INTERNAL", "message": str(e)}))
 
         try:
             async for msg in ws:
@@ -304,14 +359,16 @@ class ApiServer:
                     f"bytes {range_start}-{end_b}/{size_b}"
                 status = 206
             resp = web.StreamResponse(status=status, headers=headers)
-            await resp.prepare(request)
+            await with_timeout("api.http.write", resp.prepare(request))
             with await asyncio.to_thread(open, tmp_path, "rb") as f:
                 while True:
                     chunk = await asyncio.to_thread(f.read, RANGE_CHUNK)
                     if not chunk:
                         break
-                    await resp.write(chunk)
-            await resp.write_eof()
+                    # Per-chunk budget: stalled clients release the
+                    # handler within one window.
+                    await with_timeout("api.http.write", resp.write(chunk))
+            await with_timeout("api.http.write", resp.write_eof())
             return resp
         finally:
             try:
@@ -376,7 +433,7 @@ class ApiServer:
                     "Content-Length": str(end - start + 1),
                     "Accept-Ranges": "bytes",
                 })
-            await resp.prepare(request)
+            await with_timeout("api.http.write", resp.prepare(request))
             with await asyncio.to_thread(open, full, "rb") as f:
                 f.seek(start)
                 remaining = end - start + 1
@@ -385,9 +442,9 @@ class ApiServer:
                         f.read, min(RANGE_CHUNK, remaining))
                     if not chunk:
                         break
-                    await resp.write(chunk)
+                    await with_timeout("api.http.write", resp.write(chunk))
                     remaining -= len(chunk)
-            await resp.write_eof()
+            await with_timeout("api.http.write", resp.write_eof())
             return resp
         return web.FileResponse(full, headers={
             "Content-Type": ctype, "Accept-Ranges": "bytes"})
@@ -409,8 +466,10 @@ async def serve(data_dir: str, host: str = "127.0.0.1",
         while True:
             await asyncio.sleep(3600)
     finally:
-        await server.stop()
-        await node.shutdown()
+        # Shielded: serve() exits via cancellation (ctrl-C), and the
+        # node must still shut down cleanly through the reap.
+        await asyncio.shield(server.stop())
+        await asyncio.shield(node.shutdown())
         from ..tracing import stop_profiler
 
         stop_profiler()  # process exit: flush any SDTPU_PROFILE trace

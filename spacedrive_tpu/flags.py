@@ -204,6 +204,12 @@ declare(
     "dodge a ~50s shard_map compile per batch grid).")
 
 declare(
+    "SDTPU_TASK_REAP_S", 5.0, parse_float,
+    "Grace period the task supervisor's shutdown reap (tasks.py, "
+    "driven by Node.shutdown) waits for cancelled tasks before "
+    "declaring them orphaned (a sanitizer violation).")
+
+declare(
     "SDTPU_TELEMETRY", True, parse_onoff,
     "Kill switch for the node-wide metrics registry (telemetry.py): "
     "`off` reduces every increment to one flag check.")
@@ -212,6 +218,12 @@ declare(
     "SDTPU_TELEMETRY_INTERVAL", 15.0, parse_float,
     "Seconds between periodic TelemetrySnapshot events on the node "
     "event bus (node.py TelemetryReporter).")
+
+declare(
+    "SDTPU_TIMEOUT_SCALE", 1.0, parse_float,
+    "Global multiplier over every declared network-await budget "
+    "(timeouts.py registry; README's generated timeout table lists "
+    "the per-site defaults).")
 
 declare(
     "SDTPU_TRANSFER_GUARD", "auto", lambda v: v.strip().lower(),

@@ -15,6 +15,7 @@ import asyncio
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import tasks
 from ..store import uuid_bytes as new_job_id
 from ..telemetry import (
     JOBS_DUPLICATE_REJECTED,
@@ -72,10 +73,12 @@ class _Entry:
 class JobManager:
     def __init__(self, on_event: Optional[Callable[[dict], None]] = None,
                  services: Optional[dict] = None,
-                 max_workers: int = MAX_WORKERS):
+                 max_workers: int = MAX_WORKERS,
+                 owner: str = "jobs"):
         self.max_workers = max_workers
         self.on_event = on_event or (lambda e: None)
         self.services = services or {}
+        self._owner = owner
         self.running: Dict[bytes, Worker] = {}
         self._tasks: Dict[bytes, asyncio.Task] = {}
         self._entries: Dict[bytes, _Entry] = {}
@@ -143,7 +146,8 @@ class JobManager:
         )
         self.running[entry.report.id] = worker
         JOBS_RUNNING.set(len(self.running))
-        task = asyncio.ensure_future(worker.run())
+        task = tasks.spawn(
+            f"job/{entry.report.name}", worker.run(), owner=self._owner)
         self._tasks[entry.report.id] = task
         task.add_done_callback(
             lambda t, jid=entry.report.id: self._on_done(jid, t)

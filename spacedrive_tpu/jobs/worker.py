@@ -20,6 +20,7 @@ import traceback
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import tasks
 from ..telemetry import JOBS_EARLY_FINISH, JOBS_STEP_ERRORS, JOB_STEP_SECONDS
 from ..tracing import span as trace_span
 from .job import (
@@ -202,11 +203,11 @@ class Worker:
                     # fall through to normal outcome handling below.
                     await asyncio.wait({step_task})
                 else:
-                    step_task.cancel()
-                    try:
-                        await step_task
-                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                        pass
+                    # Interrupted-step reap: swallows the step's own
+                    # cancellation (and captures a racing step error —
+                    # the step replays from its persisted front), but
+                    # OUR cancellation mid-gather still propagates.
+                    await tasks.cancel_and_gather(step_task)
                     if cmd == WorkerCommand.CANCEL:
                         return await self._finish_cancel(state)
                     # interrupted step stays at the front for idempotent replay

@@ -20,7 +20,7 @@ import os
 import struct
 from typing import Callable, Dict, Optional, Set
 
-from .. import flags
+from .. import flags, tasks
 
 # inotify event masks (linux/inotify.h)
 IN_CREATE = 0x00000100
@@ -106,11 +106,10 @@ class PollingWatcher:
 
     def __init__(self, location_id: int, root: str,
                  on_dirty: Callable[[str], None],
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+                 owner: str = "locations"):
         self.location_id = location_id
         self.root = os.path.normpath(root)
         self.on_dirty = on_dirty
-        self.loop = loop or asyncio.get_event_loop()
         # Baseline semantics vs loop latency: a synchronous walk here
         # gives an exact watch-time baseline (nothing created after
         # watch() can hide in it) but blocks the event loop on large
@@ -120,7 +119,8 @@ class PollingWatcher:
         # scan chain, which covers the seeding window.
         self._sigs: Optional[Dict[str, tuple]] = self._snapshot(
             limit=self.SYNC_SEED_DIRS)
-        self._task = self.loop.create_task(self._poll_loop())
+        self._task = tasks.spawn(
+            f"watcher-poll/{location_id}", self._poll_loop(), owner=owner)
 
     def _dir_sig(self, path: str) -> Optional[tuple]:
         try:
@@ -205,12 +205,15 @@ def inotify_available() -> bool:
 
 def make_watcher(location_id: int, root: str,
                  on_dirty: Callable[[str], None],
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+                 owner: str = "locations"):
     """inotify watcher when the platform has it, polling otherwise
-    (or when SDTPU_WATCHER=poll forces the fallback under test)."""
+    (or when SDTPU_WATCHER=poll forces the fallback under test).
+    Must be called on the running event loop the watcher will live on.
+    `owner` is the supervisor ownership path the watcher's background
+    tasks register under (tasks.py)."""
     if flags.get("SDTPU_WATCHER") != "poll" and inotify_available():
-        return LocationWatcher(location_id, root, on_dirty, loop)
-    return PollingWatcher(location_id, root, on_dirty, loop)
+        return LocationWatcher(location_id, root, on_dirty)
+    return PollingWatcher(location_id, root, on_dirty, owner=owner)
 
 
 class LocationWatcher:
@@ -223,12 +226,11 @@ class LocationWatcher:
     """
 
     def __init__(self, location_id: int, root: str,
-                 on_dirty: Callable[[str], None],
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+                 on_dirty: Callable[[str], None]):
         self.location_id = location_id
         self.root = os.path.normpath(root)
         self.on_dirty = on_dirty
-        self.loop = loop or asyncio.get_event_loop()
+        self.loop = asyncio.get_running_loop()
         self._ino = _Inotify()
         self._wd_to_path: Dict[int, str] = {}
         self._path_to_wd: Dict[str, int] = {}
@@ -332,6 +334,10 @@ class Locations:
         self.watchers: Dict[tuple, LocationWatcher] = {}
         self._scanning: Set[tuple] = set()
         self._pending: Dict[tuple, Set[str]] = {}
+        # Supervisor subtree for watcher poll loops + dirty scans:
+        # Node.shutdown's reap sweeps it even though the Locations
+        # actor itself has no stop hook on the node.
+        self._owner = f"{node.task_owner}/locations"
 
     def watch_location(self, library, location_id: int) -> bool:
         loc = library.db.query_one(
@@ -372,10 +378,15 @@ class Locations:
                 finally:
                     self._pending.pop(_key, None)
                     self._scanning.discard(_key)
-            asyncio.get_event_loop().create_task(scan())
+            # Supervised spawn: the registry's strong reference is the
+            # fix for the dropped-task bug this function shipped with —
+            # `asyncio.get_event_loop().create_task(scan())` held NO
+            # reference, so a gc.collect() mid-scan could destroy (and
+            # cancel) the scan task (tests/test_tasks.py pins survival).
+            tasks.spawn(f"watcher-scan/{_loc}", scan(), owner=self._owner)
 
         self.watchers[key] = make_watcher(
-            location_id, loc["path"], on_dirty)
+            location_id, loc["path"], on_dirty, owner=self._owner)
         return True
 
     def unwatch_location(self, library, location_id: int) -> None:

@@ -20,6 +20,7 @@ import shutil
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from .. import tasks
 from .thumbnail import (
     THUMBNAIL_CACHE_VERSION,
     thumbnailable_extensions,
@@ -52,6 +53,7 @@ class Thumbnailer:
         self.node = node
         self.data_dir = node.data_dir
         self.queue: asyncio.Queue = asyncio.Queue()
+        self._owner = f"{getattr(node, 'task_owner', 'proc')}/media"
         self._task: Optional[asyncio.Task] = None
         self._cleanup_task: Optional[asyncio.Task] = None
         self._migrate_version()
@@ -75,20 +77,16 @@ class Thumbnailer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        loop = asyncio.get_running_loop()
         if self._task is None or self._task.done():
-            self._task = loop.create_task(self._run())
+            self._task = tasks.spawn(
+                "thumbnailer", self._run(), owner=self._owner)
         if self._cleanup_task is None or self._cleanup_task.done():
-            self._cleanup_task = loop.create_task(self._cleanup_loop())
+            self._cleanup_task = tasks.spawn(
+                "thumbnailer-cleanup", self._cleanup_loop(),
+                owner=self._owner)
 
     async def stop(self) -> None:
-        for task in (self._task, self._cleanup_task):
-            if task is not None:
-                task.cancel()
-                try:
-                    await task
-                except asyncio.CancelledError:
-                    pass
+        await tasks.cancel_and_gather(self._task, self._cleanup_task)
         self._task = self._cleanup_task = None
 
     # -- queueing API (actor.rs new_batch / new_ephemeral_batch) -----------

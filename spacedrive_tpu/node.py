@@ -16,7 +16,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
-from . import flags, telemetry
+from . import flags, tasks, telemetry
 from .jobs.manager import JobManager
 from .library import Libraries, Library
 from .store.db import uuid_bytes
@@ -118,8 +118,9 @@ class OrphanRemover:
 
     TICK_S = 60
 
-    def __init__(self, library: Library):
+    def __init__(self, library: Library, owner: str = "orphan-remover"):
         self.library = library
+        self._owner = owner
         self._task: Optional[asyncio.Task] = None
 
     def invoke(self) -> int:
@@ -148,7 +149,8 @@ class OrphanRemover:
             while True:
                 await asyncio.sleep(self.TICK_S)
                 await asyncio.to_thread(self.invoke)
-        self._task = asyncio.get_running_loop().create_task(loop())
+        self._task = tasks.spawn(
+            f"orphan/{self.library.id.hex[:8]}", loop(), owner=self._owner)
 
     def stop(self) -> None:
         if self._task is not None:
@@ -166,11 +168,13 @@ class TelemetryReporter:
     DEFAULT_INTERVAL_S = 15.0
 
     def __init__(self, events: EventBus,
-                 interval_s: Optional[float] = None):
+                 interval_s: Optional[float] = None,
+                 owner: str = "telemetry-reporter"):
         self.events = events
         if interval_s is None:
             interval_s = flags.get("SDTPU_TELEMETRY_INTERVAL")
         self.interval_s = max(0.05, interval_s)
+        self._owner = owner
         self._task: Optional[asyncio.Task] = None
 
     def emit_snapshot(self) -> None:
@@ -187,7 +191,8 @@ class TelemetryReporter:
                 if telemetry.enabled():
                     self.emit_snapshot()
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(loop())
+            self._task = tasks.spawn(
+                "telemetry-reporter", loop(), owner=self._owner)
 
     def stop(self) -> None:
         if self._task is not None:
@@ -205,14 +210,20 @@ class Node:
         self.data_dir = os.path.abspath(data_dir)
         os.makedirs(self.data_dir, exist_ok=True)
         self.config = NodeConfig(os.path.join(self.data_dir, NODE_CONFIG_NAME))
+        # Root of this node's supervisor ownership tree (tasks.py):
+        # every long-lived component spawns under it, shutdown() reaps
+        # it. Process-unique so two nodes in one test never cross-reap.
+        self.task_owner = tasks.unique_owner("node")
         self.events = EventBus()
         self.libraries = Libraries(self.data_dir)
         self.jobs = JobManager(
             on_event=self.events.emit,
             services={"data_dir": self.data_dir, "node": self},
+            owner=f"{self.task_owner}/jobs",
         )
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
-        self.telemetry_reporter = TelemetryReporter(self.events)
+        self.telemetry_reporter = TelemetryReporter(
+            self.events, owner=f"{self.task_owner}/reporter")
         self.p2p = None  # created by start_p2p (P2PManager)
         # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
         # bootstrap (cache version migration runs here), loop starts with
@@ -264,7 +275,8 @@ class Node:
 
     def _ensure_actors(self, library: Library) -> None:
         if library.id not in self.orphan_removers:
-            remover = OrphanRemover(library)
+            remover = OrphanRemover(
+                library, owner=f"{self.task_owner}/orphan-remover")
             try:
                 remover.start()
             except RuntimeError:
@@ -287,7 +299,14 @@ class Node:
         return await self.p2p.start(host, port)
 
     async def shutdown(self) -> None:
-        """Node::shutdown (lib.rs:205): pause jobs, stop actors."""
+        """Node::shutdown (lib.rs:205): pause jobs, stop actors, then
+        reap the supervisor subtree as the backstop — anything a
+        component forgot (a mid-flight origin fan-out, a watcher scan,
+        an auth poll whose subscriber vanished) is cancelled-and-
+        gathered by ownership tree BEFORE the library DBs close, so
+        cancellation cleanup can still write. A task that survives the
+        reap grace is an orphan: counted in sd_task_orphaned_total and
+        raised as a sanitizer violation in tier-1."""
         await self.jobs.shutdown()
         self.telemetry_reporter.stop()
         await self.thumbnailer.stop()
@@ -295,8 +314,19 @@ class Node:
             await self.p2p.stop()
         for remover in self.orphan_removers.values():
             remover.stop()
-        for lib in self.libraries.list():
-            lib.db.close()
+        try:
+            await tasks.reap(self.task_owner)
+        finally:
+            # The DBs close even when the reap raises on an orphan
+            # (raise mode): an aborted shutdown must not leak open
+            # library handles on top of the orphaned task.
+            for lib in self.libraries.list():
+                lib.db.close()
+
+    async def close(self) -> None:
+        """Alias for shutdown() — the supervisor docs' name for the
+        reap edge."""
+        await self.shutdown()
 
     # -- convenience -------------------------------------------------------
 

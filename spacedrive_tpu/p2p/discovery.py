@@ -18,6 +18,7 @@ from typing import Callable, Dict, Optional
 
 import msgpack
 
+from .. import tasks
 from .identity import Identity, RemoteIdentity
 
 MCAST_GRP = "239.255.41.42"
@@ -44,7 +45,9 @@ class Discovery:
 
     def __init__(self, identity: Identity, service_port: int,
                  metadata: Optional[dict] = None,
-                 group: str = MCAST_GRP, port: int = MCAST_PORT):
+                 group: str = MCAST_GRP, port: int = MCAST_PORT,
+                 owner: str = "p2p/discovery"):
+        self._owner = owner
         self.identity = identity
         self.service_port = service_port
         self.metadata = metadata or {}
@@ -90,8 +93,10 @@ class Discovery:
 
         self._transport, _ = await loop.create_datagram_endpoint(
             Proto, sock=sock)
-        self._tasks = [loop.create_task(self._beacon_loop()),
-                       loop.create_task(self._expire_loop())]
+        self._tasks = [
+            tasks.spawn("beacon", self._beacon_loop(), owner=self._owner),
+            tasks.spawn("expire", self._expire_loop(), owner=self._owner),
+        ]
 
     def _on_datagram(self, data: bytes, addr) -> None:
         try:
@@ -134,13 +139,7 @@ class Discovery:
                     self.on_expired(key)
 
     async def stop(self) -> None:
-        for t in self._tasks:
-            t.cancel()
-        for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        await tasks.cancel_and_gather(*self._tasks)
         self._tasks = []
         if self._transport is not None:
             self._transport.close()

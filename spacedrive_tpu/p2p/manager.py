@@ -21,6 +21,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, Optional
 
+from ..timeouts import deadline, with_timeout
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity
 from .proto import Tunnel, tunnel_handshake
@@ -29,9 +30,6 @@ from .spaceblock import (
     receive_file,
     send_file,
 )
-
-SPACEDROP_TIMEOUT_S = 60
-
 
 class P2PManager:
     def __init__(self, node, identity: Optional[Identity] = None,
@@ -68,7 +66,8 @@ class P2PManager:
             self.discovery = Discovery(
                 self.identity, self.port,
                 metadata={"name": self.node.config.name,
-                          "node_id": self.node.config.id.hex()})
+                          "node_id": self.node.config.id.hex()},
+                owner=f"{self.node.task_owner}/p2p/discovery")
             await self.discovery.start()
             # Standards-interoperable mDNS/DNS-SD alongside the signed
             # beacons (the reference's _sd-spacedrive._udp service,
@@ -83,7 +82,8 @@ class P2PManager:
                      "id": self.node.config.id.hex(),
                      "identity":
                          self.identity.to_remote_identity()
-                         .to_bytes().hex()})
+                         .to_bytes().hex()},
+                owner=f"{self.node.task_owner}/p2p/mdns")
             try:
                 await self.mdns.start()
             except OSError:
@@ -106,16 +106,29 @@ class P2PManager:
     async def open_stream(self, addr: str, port: int,
                           expected: Optional[RemoteIdentity] = None
                           ) -> Tunnel:
-        reader, writer = await asyncio.open_connection(addr, port)
-        return await tunnel_handshake(
-            reader, writer, self.identity, initiator=True, expected=expected)
+        async with deadline("p2p.connect"):
+            reader, writer = await asyncio.open_connection(addr, port)
+            try:
+                return await tunnel_handshake(
+                    reader, writer, self.identity, initiator=True,
+                    expected=expected)
+            except BaseException:
+                # Handshake death (timeout, bad signature, cancel):
+                # the connected socket must not outlive the attempt —
+                # every announce round against a half-open peer would
+                # otherwise leak one fd.
+                writer.close()
+                raise
 
     async def ping(self, addr: str, port: int) -> float:
         t0 = time.monotonic()
         tunnel = await self.open_stream(addr, port)
-        await tunnel.send({"t": "ping"})
-        assert await tunnel.recv() == {"t": "pong"}
-        tunnel.close()
+        try:
+            async with deadline("p2p.ping"):
+                await tunnel.send({"t": "ping"})
+                assert await tunnel.recv() == {"t": "pong"}
+        finally:
+            tunnel.close()
         return time.monotonic() - t0
 
     def _progress_emitter(self, drop_id: str, total: int, direction: str):
@@ -146,9 +159,13 @@ class P2PManager:
             drop_id, size, "send")
         tunnel = await self.open_stream(addr, port)
         try:
-            await tunnel.send({"t": "spacedrop", "req": req.to_wire()})
-            verdict = await asyncio.wait_for(
-                tunnel.recv(), timeout=SPACEDROP_TIMEOUT_S)
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send({"t": "spacedrop", "req": req.to_wire()}))
+            # The verdict budget brackets the receiver's whole
+            # interactive p2p.spacedrop.decide window (timeouts.py).
+            verdict = await with_timeout(
+                "p2p.spacedrop.verdict", tunnel.recv())
             if verdict != "accept":
                 return "rejected"
             self.node.events.emit({
@@ -173,12 +190,12 @@ class P2PManager:
         ids diverge between nodes and must never cross the wire."""
         tunnel = await self.open_stream(addr, port)
         try:
-            await tunnel.send({
+            await with_timeout("p2p.frame_send", tunnel.send({
                 "t": "file", "library_id": library_id,
                 "location_pub_id": location_pub_id,
                 "file_path_pub_id": file_path_pub_id,
-                "range_start": range_start, "range_end": range_end})
-            resp = await tunnel.recv()
+                "range_start": range_start, "range_end": range_end}))
+            resp = await with_timeout("p2p.file.response", tunnel.recv())
             if not isinstance(resp, dict) or resp.get("status") != "ok":
                 return False
             req = SpaceblockRequest.from_wire(resp["req"])
@@ -192,33 +209,39 @@ class P2PManager:
         flow (core/src/p2p/pairing/mod.rs protocol v1, simplified to one
         round-trip of signed instance info)."""
         sync = library.sync
-        me = await asyncio.to_thread(
-            library.db.query_one,
-            "SELECT * FROM instance WHERE pub_id = ?", (sync.instance,))
         tunnel = await self.open_stream(addr, port)
         try:
-            await tunnel.send({
-                "t": "pair",
-                "library_id": str(library.id),
-                "library_name": library.config.name,
-                # Our LISTENING port (the TCP source port is ephemeral):
-                # the responder derives a route back to us from it.
-                "listen_port": self.port,
-                "instance": {
-                    "pub_id": me["pub_id"], "identity":
-                        self.identity.to_remote_identity().to_bytes(),
-                    "node_id": self.node.config.id,
-                    "node_name": self.node.config.name,
-                },
-            })
-            resp = await tunnel.recv()
-            if not isinstance(resp, dict) or resp.get("status") != "accepted":
-                return False
-            inst = resp["instance"]
-            await asyncio.to_thread(
-                library.sync.register_instance,
-                inst["pub_id"], identity=inst["identity"],
-                node_id=inst["node_id"], node_name=inst["node_name"])
+            # One budget over the whole round-trip: the responder's
+            # decision hook + instance-row DB writes included.
+            async with deadline("p2p.pair"):
+                me = await asyncio.to_thread(
+                    library.db.query_one,
+                    "SELECT * FROM instance WHERE pub_id = ?",
+                    (sync.instance,))
+                await tunnel.send({
+                    "t": "pair",
+                    "library_id": str(library.id),
+                    "library_name": library.config.name,
+                    # Our LISTENING port (the TCP source port is
+                    # ephemeral): the responder derives a route back to
+                    # us from it.
+                    "listen_port": self.port,
+                    "instance": {
+                        "pub_id": me["pub_id"], "identity":
+                            self.identity.to_remote_identity().to_bytes(),
+                        "node_id": self.node.config.id,
+                        "node_name": self.node.config.name,
+                    },
+                })
+                resp = await tunnel.recv()
+                if not isinstance(resp, dict) or \
+                        resp.get("status") != "accepted":
+                    return False
+                inst = resp["instance"]
+                await asyncio.to_thread(
+                    library.sync.register_instance,
+                    inst["pub_id"], identity=inst["identity"],
+                    node_id=inst["node_id"], node_name=inst["node_name"])
             if self.networked is not None:
                 self.networked.learn_instance(
                     library.id, inst["pub_id"],
@@ -243,10 +266,11 @@ class P2PManager:
             writer.close()
             return
         try:
-            header = await tunnel.recv()
+            header = await with_timeout("p2p.header_recv", tunnel.recv())
             t = header.get("t") if isinstance(header, dict) else None
             if t == "ping":
-                await tunnel.send({"t": "pong"})
+                await with_timeout("p2p.frame_send",
+                                   tunnel.send({"t": "pong"}))
             elif t == "spacedrop":
                 await self._handle_spacedrop(tunnel, header)
             elif t == "pair":
@@ -280,7 +304,7 @@ class P2PManager:
             "type": "SpacedropRequest", "id": drop_id, "name": safe_name,
             "size": req.size, "peer": peer.to_bytes().hex()})
         try:
-            return await asyncio.wait_for(fut, SPACEDROP_TIMEOUT_S)
+            return await with_timeout("p2p.spacedrop.decide", fut)
         except asyncio.TimeoutError:
             self.node.events.emit(
                 {"type": "SpacedropTimedout", "id": drop_id})
@@ -310,9 +334,9 @@ class P2PManager:
         drop_id = uuidlib.uuid4().hex
         save_path = await self._decide_spacedrop(tunnel.remote, req, drop_id)
         if save_path is None:
-            await tunnel.send("reject")
+            await with_timeout("p2p.frame_send", tunnel.send("reject"))
             return
-        await tunnel.send("accept")
+        await with_timeout("p2p.frame_send", tunnel.send("accept"))
         self._spacedrop_cancel[drop_id] = False
         # Announce the receive (with its cancellation id) in BOTH modes —
         # p2p.cancelSpacedrop needs an id even when a sync hook accepted.
@@ -340,7 +364,8 @@ class P2PManager:
 
     async def _handle_pair(self, tunnel: Tunnel, header: dict) -> None:
         if not self.on_pairing_request(tunnel.remote, header):
-            await tunnel.send({"status": "rejected"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"status": "rejected"}))
             return
         lib = None
         for candidate in self.node.libraries.list():
@@ -373,12 +398,13 @@ class P2PManager:
             lib.db.query_one,
             "SELECT * FROM instance WHERE pub_id = ?",
             (lib.sync.instance,))
-        await tunnel.send({"status": "accepted", "instance": {
+        await with_timeout("p2p.frame_send", tunnel.send(
+            {"status": "accepted", "instance": {
             "pub_id": me["pub_id"],
             "identity": self.identity.to_remote_identity().to_bytes(),
             "node_id": self.node.config.id,
             "node_name": self.node.config.name,
-        }})
+        }}))
         if self.networked is not None:
             # Symmetric backfill: OUR pre-existing ops (re-pairing case)
             # flow to the initiator without waiting for a local write.
@@ -389,7 +415,8 @@ class P2PManager:
         lib = self.node.libraries.get(
             uuidlib.UUID(str(header["library_id"])))
         if lib is None:
-            await tunnel.send({"status": "not_found"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"status": "not_found"}))
             return
         loc = await asyncio.to_thread(
             lib.db.query_one,
@@ -401,7 +428,8 @@ class P2PManager:
             (bytes(header["file_path_pub_id"]),))) if loc else None
         if (row is None or loc is None or not loc["path"]
                 or row["location_id"] != loc["id"]):
-            await tunnel.send({"status": "not_found"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"status": "not_found"}))
             return
         iso = IsolatedPath.from_db_row(
             loc["id"], bool(row["is_dir"]),
@@ -409,11 +437,14 @@ class P2PManager:
             row["extension"] or "")
         full = iso.join_on(loc["path"])
         if not os.path.isfile(full):
-            await tunnel.send({"status": "not_found"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"status": "not_found"}))
             return
         req = SpaceblockRequest(
             os.path.basename(full), os.path.getsize(full),
             header.get("range_start"), header.get("range_end"))
-        await tunnel.send({"status": "ok", "req": req.to_wire()})
+        await with_timeout("p2p.frame_send",
+                           tunnel.send({"status": "ok",
+                                        "req": req.to_wire()}))
         with await asyncio.to_thread(open, full, "rb") as f:
             await send_file(tunnel, req, f)

@@ -31,6 +31,8 @@ import struct
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import tasks
+
 MDNS_GRP = "224.0.0.251"
 MDNS_PORT = 5353
 SERVICE = "_spacedrive._udp.local"
@@ -161,7 +163,9 @@ class MdnsService:
 
     def __init__(self, instance: str, service_port: int,
                  txt: Optional[Dict[str, str]] = None,
-                 group: str = MDNS_GRP, port: int = MDNS_PORT):
+                 group: str = MDNS_GRP, port: int = MDNS_PORT,
+                 owner: str = "p2p/mdns"):
+        self._owner = owner
         # instance/host labels must be DNS-safe
         safe = "".join(c if c.isalnum() or c == "-" else "-"
                        for c in instance)[:32] or "node"
@@ -242,9 +246,12 @@ class MdnsService:
 
         self._transport, _ = await loop.create_datagram_endpoint(
             Proto, sock=sock)
-        self._tasks = [loop.create_task(self._announce_loop()),
-                       loop.create_task(self._query_loop()),
-                       loop.create_task(self._expire_loop())]
+        self._tasks = [
+            tasks.spawn("announce", self._announce_loop(),
+                        owner=self._owner),
+            tasks.spawn("query", self._query_loop(), owner=self._owner),
+            tasks.spawn("expire", self._expire_loop(), owner=self._owner),
+        ]
 
     async def stop(self) -> None:
         # goodbye packet: TTL 0 clears remote caches (RFC 6762 §10.1)
@@ -254,13 +261,7 @@ class MdnsService:
                                        (self.group, self.port))
             except Exception:
                 pass
-        for t in self._tasks:
-            t.cancel()
-        for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        await tasks.cancel_and_gather(*self._tasks)
         self._tasks = []
         if self._transport is not None:
             self._transport.close()

@@ -33,7 +33,16 @@ from ..telemetry import (
     P2P_TUNNEL_BYTES_SENT,
     P2P_TUNNELS_OPENED,
 )
+from ..timeouts import deadline
 from .identity import Identity, RemoteIdentity
+
+# Timeout discipline (tools/sdlint timeout-discipline pass): this
+# module is the TRANSPORT PRIMITIVE layer — read_frame/send/recv are
+# what every budget wraps, so their internal socket awaits carry
+# suppression markers ("the budget lives at the call site") and the
+# pass enforces that every caller in p2p/api/sync actually provides
+# one (with_timeout / deadline). The handshake is the exception: it is
+# a self-contained exchange, so it owns its own `p2p.handshake` block.
 
 MAX_FRAME = 64 * 1024 * 1024  # sanity cap
 
@@ -43,11 +52,11 @@ class ProtoError(Exception):
 
 
 async def read_frame(reader: asyncio.StreamReader) -> bytes:
-    hdr = await reader.readexactly(4)
+    hdr = await reader.readexactly(4)  # sdlint: ok[timeout-discipline]
     (length,) = struct.unpack(">I", hdr)
     if length > MAX_FRAME:
         raise ProtoError(f"frame too large: {length}")
-    return await reader.readexactly(length)
+    return await reader.readexactly(length)  # sdlint: ok[timeout-discipline]
 
 
 def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
@@ -55,8 +64,9 @@ def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
 
 
 async def read_msg(reader: asyncio.StreamReader) -> Any:
-    return msgpack.unpackb(await read_frame(reader), raw=False,
-                           strict_map_key=False)
+    return msgpack.unpackb(
+        await read_frame(reader),  # sdlint: ok[timeout-discipline]
+        raw=False, strict_map_key=False)
 
 
 def write_msg(writer: asyncio.StreamWriter, msg: Any) -> None:
@@ -93,10 +103,10 @@ class Tunnel:
 
     async def send(self, msg: Any) -> None:
         self._seal(msgpack.packb(msg, use_bin_type=True))
-        await self.writer.drain()
+        await self.writer.drain()  # sdlint: ok[timeout-discipline]
 
     async def recv(self) -> Any:
-        sealed = await read_frame(self.reader)
+        sealed = await read_frame(self.reader)  # sdlint: ok[timeout-discipline]
         P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
@@ -113,14 +123,14 @@ class Tunnel:
 
     async def drain(self) -> None:
         """Flush frames queued by send_nowait to the socket."""
-        await self.writer.drain()
+        await self.writer.drain()  # sdlint: ok[timeout-discipline]
 
     async def send_raw(self, data: bytes) -> None:
         self._seal(data)
-        await self.writer.drain()
+        await self.writer.drain()  # sdlint: ok[timeout-discipline]
 
     async def recv_raw(self) -> bytes:
-        sealed = await read_frame(self.reader)
+        sealed = await read_frame(self.reader)  # sdlint: ok[timeout-discipline]
         P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
@@ -167,8 +177,9 @@ async def tunnel_handshake(
         "nonce": nonce,
         "sig": identity.sign(_x25519_pub_bytes(eph) + nonce),
     })
-    await writer.drain()
-    hello = await read_msg(reader)
+    async with deadline("p2p.handshake"):
+        await writer.drain()
+        hello = await read_msg(reader)
     remote = RemoteIdentity(hello["identity"])
     if expected is not None and remote != expected:
         raise ProtoError("peer identity mismatch")

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 from typing import BinaryIO, Callable, Optional
 
+from ..timeouts import with_timeout
 from .proto import Tunnel
 
 KIB, MIB = 1024, 1024 * 1024
@@ -69,11 +70,14 @@ async def send_file(tunnel: Tunnel, req: SpaceblockRequest, f: BinaryIO,
         chunk = f.read(min(block, total - sent))
         if not chunk:
             break
-        await tunnel.send_raw(chunk)
+        # Per-BLOCK budget: a transfer of any size stays alive as long
+        # as block-level progress continues; a stalled receiver frees
+        # the sender within one p2p.transfer.chunk window.
+        await with_timeout("p2p.transfer.chunk", tunnel.send_raw(chunk))
         sent += len(chunk)
         if on_progress:
             on_progress(sent)
-        ack = await tunnel.recv()
+        ack = await with_timeout("p2p.transfer.chunk", tunnel.recv())
         if ack != "ok":
             return False
     return True
@@ -87,13 +91,15 @@ async def receive_file(tunnel: Tunnel, req: SpaceblockRequest, out: BinaryIO,
     total = end - start
     got = 0
     while got < total:
-        chunk = await tunnel.recv_raw()
+        chunk = await with_timeout("p2p.transfer.chunk",
+                                   tunnel.recv_raw())
         out.write(chunk)
         got += len(chunk)
         if on_progress:
             on_progress(got)
         if should_cancel and should_cancel():
-            await tunnel.send("cancel")
+            await with_timeout("p2p.transfer.chunk",
+                               tunnel.send("cancel"))
             return False
-        await tunnel.send("ok")
+        await with_timeout("p2p.transfer.chunk", tunnel.send("ok"))
     return True

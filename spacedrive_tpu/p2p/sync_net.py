@@ -21,9 +21,10 @@ import asyncio
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from .. import flags
+from .. import flags, tasks
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
+from ..timeouts import with_timeout
 from ..sync.manager import GetOpsArgs
 from ..sync.crdt import CRDTOperation
 from ..telemetry import (
@@ -78,7 +79,9 @@ class NetworkedLibraries:
         # not re-scan the discovery peer table per round.
         self._route_cache: Dict[bytes, Tuple[str, int]] = {}
         self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
-        self._origin_tasks: set = set()
+        # Supervisor subtree for announce fan-outs + per-pull ingest
+        # actors: Node.shutdown reaps any still in flight.
+        self._owner = f"{getattr(node, 'task_owner', 'proc')}/sync"
         self._origin_pending: set = set()
         self._origin_redo: set = set()
         for lib in node.libraries.list():
@@ -175,9 +178,11 @@ class NetworkedLibraries:
                     self._origin_pending.discard(library.id)
                     self._origin_redo.discard(library.id)
 
-            task = loop.create_task(run())
-            self._origin_tasks.add(task)
-            task.add_done_callback(self._origin_tasks.discard)
+            # Supervised: the registry keeps the strong reference
+            # (no GC-cancel), observes a failed fan-out's exception,
+            # and Node.shutdown reaps a round still in flight.
+            tasks.spawn(f"origin/{library.id.hex[:8]}", run(),
+                        owner=self._owner)
 
         loop.call_soon_threadsafe(spawn)
 
@@ -191,7 +196,8 @@ class NetworkedLibraries:
             try:
                 await self._originate_one(library, identity, route)
                 self._route_cache[key] = route  # healthy: keep for next round
-            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            except (ConnectionError, OSError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError):
                 self._route_cache.pop(key, None)  # stale: re-resolve next time
                 P2P_RECONNECTS.inc()
                 continue  # peer offline; it will pull on reconnect
@@ -200,9 +206,11 @@ class NetworkedLibraries:
                              route: Tuple[str, int]) -> None:
         tunnel = await self.p2p.open_stream(*route, expected=identity)
         try:
-            await tunnel.send({"t": "sync", "kind": "new_ops",
-                               "library_id": str(library.id),
-                               "proto": SYNC_PROTO})
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send({"t": "sync", "kind": "new_ops",
+                             "library_id": str(library.id),
+                             "proto": SYNC_PROTO}))
             # Serve the responder's pull loop from our op log. The
             # clone fast path runs at most once per tunnel: a receiver
             # whose watermark stays frozen (persistent per-op failure)
@@ -210,7 +218,10 @@ class NetworkedLibraries:
             # blob stream forever.
             clone_served = False
             while True:
-                req = await tunnel.recv()
+                # The responder ingests the previous page (one tx per
+                # page) before its next pull request lands here.
+                req = await with_timeout("sync.pull.request",
+                                         tunnel.recv())
                 if not isinstance(req, dict) or req.get("kind") == "done":
                     break
                 if int(req.get("proto", 1)) != SYNC_PROTO:
@@ -219,7 +230,9 @@ class NetworkedLibraries:
                     logger.warning(
                         "not serving sync pull: peer wire proto %s != "
                         "ours %d", req.get("proto", 1), SYNC_PROTO)
-                    await tunnel.send({"ops": [], "has_more": False})
+                    await with_timeout(
+                        "p2p.frame_send",
+                        tunnel.send({"ops": [], "has_more": False}))
                     break
                 clocks = [(bytes(i), int(t)) for i, t in req["clocks"]]
                 # Clone fast path: a fresh peer (zero watermark for the
@@ -239,10 +252,10 @@ class NetworkedLibraries:
                         clocks=clocks,
                         count=min(int(req.get("count", OPS_PER_REQUEST)),
                                   OPS_PER_REQUEST)))
-                await tunnel.send({
+                await with_timeout("p2p.frame_send", tunnel.send({
                     "ops": [op.to_wire() for op in ops],
                     "has_more": len(ops) >= OPS_PER_REQUEST,
-                })
+                }))
         finally:
             tunnel.close()
 
@@ -267,13 +280,15 @@ class NetworkedLibraries:
                     break
                 kind, item = nxt
                 if not started:
-                    await tunnel.send({"kind": "blob_stream",
-                                       "window": CLONE_WINDOW})
+                    await with_timeout(
+                        "p2p.frame_send",
+                        tunnel.send({"kind": "blob_stream",
+                                     "window": CLONE_WINDOW}))
                     started = True
                 if kind == "ops":
-                    await tunnel.send({
+                    await with_timeout("p2p.frame_send", tunnel.send({
                         "kind": "clone_ops",
-                        "ops": [op.to_wire() for op in item]})
+                        "ops": [op.to_wire() for op in item]}))
                     continue
                 tunnel.send_nowait({"kind": "blob_page", **item})
                 SYNC_CLONE_PAGES_RELAYED.inc()
@@ -283,17 +298,21 @@ class NetworkedLibraries:
                     # frame (the point of send_nowait): the window's
                     # pages stream into the socket back-to-back, and a
                     # slow receiver pauses us here, not mid-window.
-                    await tunnel.drain()
+                    await with_timeout("sync.clone.drain", tunnel.drain())
                 while inflight >= CLONE_WINDOW:
                     SYNC_CLONE_WINDOW_STALLS.inc()
-                    ack = await tunnel.recv()
+                    # Budgeted per page: the receiver's batched apply
+                    # commits a whole page behind each ack.
+                    ack = await with_timeout("sync.clone.ack",
+                                             tunnel.recv())
                     if not isinstance(ack, dict) or ack.get("kind") != "ack":
                         raise ConnectionError(
                             f"clone stream: bad ack frame {ack!r}")
                     inflight -= 1
-            await tunnel.drain()  # flush the final partial window
+            # flush the final partial window
+            await with_timeout("sync.clone.drain", tunnel.drain())
             while inflight > 0:
-                ack = await tunnel.recv()
+                ack = await with_timeout("sync.clone.ack", tunnel.recv())
                 if not isinstance(ack, dict) or ack.get("kind") != "ack":
                     raise ConnectionError(
                         f"clone stream: bad ack frame {ack!r}")
@@ -302,7 +321,8 @@ class NetworkedLibraries:
             tunnel.close()  # mid-stream failure: no clean blob_done exists
             raise
         if started:
-            await tunnel.send({"kind": "blob_done"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"kind": "blob_done"}))
         return started
 
     # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
@@ -313,12 +333,14 @@ class NetworkedLibraries:
             logger.warning(
                 "refusing sync stream: peer wire proto %d != ours %d",
                 proto, SYNC_PROTO)
-            await tunnel.send({"kind": "done"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"kind": "done"}))
             return
         lib = self.node.libraries.get(
             uuidlib.UUID(str(header["library_id"])))
         if lib is None:
-            await tunnel.send({"kind": "done"})
+            await with_timeout("p2p.frame_send",
+                               tunnel.send({"kind": "done"}))
             return
         lock = self._ingest_locks.setdefault(lib.id, asyncio.Lock())
         async with lock:
@@ -335,7 +357,8 @@ class NetworkedLibraries:
         instance-authored ones), so in an A↔B↔C line B forwards A's
         writes to C. Announcing only on applied>0 terminates — a node
         with nothing new never re-fans."""
-        ingester = Ingester(library.sync)
+        ingester = Ingester(library.sync,
+                            owner=f"{self._owner}/ingest")
         ingester.start()
         applied = 0
         try:
@@ -346,17 +369,20 @@ class NetworkedLibraries:
                     applied += req.count
                     continue
                 if req.kind == ReqKind.FINISHED:
-                    await tunnel.send({"kind": "done"})
+                    await with_timeout("p2p.frame_send",
+                                       tunnel.send({"kind": "done"}))
                     return
                 if req.kind != ReqKind.MESSAGES:
                     continue
-                await tunnel.send({
+                await with_timeout("p2p.frame_send", tunnel.send({
                     "kind": "messages",
                     "clocks": [[i, t] for i, t in req.timestamps],
                     "count": OPS_PER_REQUEST,
                     "proto": SYNC_PROTO,
-                })
-                page = await tunnel.recv()
+                }))
+                # The originator runs get_ops off-loop over bulk op
+                # logs before this page arrives.
+                page = await with_timeout("sync.pull.page", tunnel.recv())
                 if isinstance(page, dict) and \
                         page.get("kind") == "blob_stream":
                     # Clone fast path: the originator answered our pull
@@ -379,7 +405,11 @@ class NetworkedLibraries:
                     instance=library.sync.instance, messages=ops,
                     has_more=bool(page.get("has_more"))))
         finally:
-            await ingester.stop()
+            # Shielded: when _pull itself is being cancelled (node
+            # shutdown dropping a connection mid-pull), the ingester
+            # reap must still run to completion — unshielded it would
+            # die on the first await and orphan the actor task.
+            await asyncio.shield(ingester.stop())
             while not ingester.requests.empty():  # unread tail counts
                 req = ingester.requests.get_nowait()
                 if req.kind == ReqKind.INGESTED:

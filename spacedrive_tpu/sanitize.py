@@ -33,6 +33,12 @@ engine actually depends on:
   device-to-host transfer guard so an undeclared result fetch raises
   in tier-1 and logs in production (kind `host_transfer`; declared
   fetches go through `io(name)` scopes).
+- **Task-supervisor detections** (round 11, reported through
+  `record()` by `tasks.py` — the runtime twin of sdlint's
+  task-lifecycle/cancellation-safety passes): a supervised task dying
+  with an unretrieved exception is a `task_exception`, and a task
+  surviving `Node.shutdown`'s reap grace is a `task_orphaned`
+  (raised at the reap in tier-1).
 
 Activation: `SDTPU_SANITIZE=1` + `install()` (tests/conftest.py calls
 it for tier-1; node bootstrap may too). `SDTPU_SANITIZE_MODE=raise`
@@ -61,7 +67,7 @@ from .telemetry import SANITIZE_LOOP_MAX_STALL, SANITIZE_VIOLATIONS
 __all__ = [
     "SanitizerViolation", "install", "installed", "uninstall",
     "tracked_lock", "tracked_rlock", "violations", "reset_violations",
-    "held_tracked_locks",
+    "held_tracked_locks", "record",
 ]
 
 
@@ -136,6 +142,16 @@ def _record(kind: str, detail: str, may_raise: bool) -> None:
             del _violations[0]
     if may_raise and _mode == "raise":
         raise SanitizerViolation(f"{kind}: {detail}")
+
+
+def record(kind: str, detail: str, may_raise: bool = False) -> None:
+    """Public violation hook for the sanitizer's sibling runtimes
+    (tasks.py's supervisor: `task_exception` / `task_orphaned`).
+    Counts into sd_sanitize_violations_total and violations() whether
+    or not install() ran — metrics must flow in production — and
+    honors the raise/count split when asked (`may_raise`), exactly
+    like the in-module detectors."""
+    _record(kind, detail, may_raise=may_raise)
 
 
 # -- lock-order recorder ----------------------------------------------------

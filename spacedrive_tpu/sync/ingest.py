@@ -20,7 +20,9 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from .. import tasks
 from ..telemetry import SYNC_INGEST_PAGES
+from ..timeouts import with_timeout
 from .crdt import CRDTOperation
 from .manager import SyncManager
 
@@ -83,7 +85,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
         return sync.timestamps.get(pub, 0) < expect.get(pub, 0)
 
     while True:
-        frame = await recv()
+        frame = await with_timeout("sync.clone.frame", recv())
         kind = frame.get("kind") if isinstance(frame, dict) else None
         if kind == "blob_done":
             return applied, fast_pages, fallback_pages
@@ -106,9 +108,11 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             pub = bytes(frame["instance"])
             if pub in dirty or _frozen(pub):
                 dirty.add(pub)
-                await send({"kind": "ack",
-                            "ts": sync.timestamps.get(pub, 0),
-                            "fast": False})
+                await with_timeout(
+                    "sync.clone.ack_send",
+                    send({"kind": "ack",
+                          "ts": sync.timestamps.get(pub, 0),
+                          "fast": False}))
                 fallback_pages += 1
                 continue
             n, errs, fast = await asyncio.to_thread(
@@ -123,9 +127,11 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             # Ack AFTER the apply committed: the watermark the ack
             # carries is durable, so a crash mid-stream re-pulls from
             # exactly the right place.
-            await send({"kind": "ack",
-                        "ts": sync.timestamps.get(pub, 0),
-                        "fast": bool(fast)})
+            await with_timeout(
+                "sync.clone.ack_send",
+                send({"kind": "ack",
+                      "ts": sync.timestamps.get(pub, 0),
+                      "fast": bool(fast)}))
         else:
             raise ValueError(f"unexpected clone-stream frame: {frame!r}")
 
@@ -133,8 +139,9 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
 class Ingester:
     """Owns the notification→retrieve→ingest loop for one library."""
 
-    def __init__(self, sync: SyncManager):
+    def __init__(self, sync: SyncManager, owner: str = "sync-ingest"):
         self.sync = sync
+        self._owner = owner
         self.events: asyncio.Queue = asyncio.Queue()
         self.requests: asyncio.Queue = asyncio.Queue()
         self.errors: List[str] = []
@@ -154,16 +161,12 @@ class Ingester:
 
     def start(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._task = tasks.spawn("ingester", self._run(),
+                                     owner=self._owner)
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        await tasks.cancel_and_gather(self._task)
+        self._task = None
 
     async def _run(self) -> None:
         while True:

@@ -1,0 +1,246 @@
+"""Structured-concurrency task supervisor: the runtime twin of
+tools/sdlint's task-lifecycle pass.
+
+Before this module every background task in the node was a bare
+`loop.create_task(...)` with ad-hoc (or missing) shutdown: ~19 spawn
+points across p2p/jobs/sync/media/locations, at least one of which
+dropped its only task reference (`locations/watcher.py` dirty-scan —
+the garbage collector may cancel a task nobody holds). The reference's
+job system treats pause/cancel/shutdown as a first-class protocol
+(core/src/job/); this module gives the whole async layer the same
+machine-checked contract:
+
+- **`spawn(name, coro, owner=...)`** — the ONE way long-lived
+  components start background work. Every spawned task lands in a
+  process-wide registry keyed by an ownership path (``node#1/p2p/
+  discovery``), gets a ``sdtpu:`` task name (so leak tests can sweep
+  `asyncio.all_tasks()`), and is watched by a done-callback that
+  counts `sd_task_spawned_total{owner}` and records a
+  ``task_exception`` sanitizer violation when a task dies with an
+  exception nobody awaited (the classic "Task exception was never
+  retrieved" black hole, surfaced instead of logged at interpreter
+  exit).
+- **`reap(owner)`** — cancel-and-gather an ownership subtree, deepest
+  owners first (children before parents, so a parent's cleanup still
+  has its children stopped). `Node.shutdown()` calls it as the
+  backstop AFTER stopping every component: anything still registered
+  is cancelled cleanly; anything that survives the grace period
+  (`SDTPU_TASK_REAP_S`) is an ORPHAN — counted in
+  `sd_task_orphaned_total` and raised as a sanitizer violation in
+  tier-1 (`raise` mode), counted in production. Cancel latency per
+  task feeds `sd_task_cancel_latency_seconds`.
+- **`cancel_and_gather(*tasks)`** — the cancellation-safe stop idiom
+  components use instead of the conflated
+  ``except (CancelledError, Exception): pass`` shape sdlint's
+  cancellation-safety pass now rejects: it swallows only the victims'
+  cancellation; our OWN cancellation mid-gather still propagates, and
+  a victim's real exception still reaches the supervisor's
+  done-callback.
+
+Design constraints (same as flags.py / telemetry.py): stdlib +
+telemetry/flags/sanitize only, importable from every layer. The
+registry works whether or not the sanitizer is installed — metrics
+always count; only the raise/count split follows SDTPU_SANITIZE_MODE.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Coroutine, Dict, List, Optional
+
+from . import flags
+from .telemetry import TASK_CANCEL_LATENCY, TASK_ORPHANED, TASK_SPAWNED
+
+__all__ = [
+    "spawn", "reap", "live", "cancel_and_gather", "unique_owner",
+    "owner_label", "TASK_NAME_PREFIX",
+]
+
+# asyncio task-name prefix for every supervised task: leak tests sweep
+# asyncio.all_tasks() for stragglers bearing it.
+TASK_NAME_PREFIX = "sdtpu:"
+
+_OWNER_SEQ_RE = re.compile(r"#\d+")
+
+
+@dataclass
+class TaskRecord:
+    name: str
+    owner: str
+    task: asyncio.Task
+    # Stamped by reap() just before .cancel() so the done-callback can
+    # observe the task's individual cancel→finished latency.
+    cancelled_at: Optional[float] = field(default=None)
+
+
+# task object → record. Tasks unregister themselves on completion via
+# the supervisor's done-callback, so the registry always reflects the
+# LIVE set — `live()` after a clean shutdown is empty by construction.
+_registry: Dict[asyncio.Task, TaskRecord] = {}
+_registry_lock = threading.Lock()
+_owner_seq = [0]
+
+
+def unique_owner(prefix: str) -> str:
+    """A process-unique ownership ROOT (``node#3``): two nodes in one
+    process (every p2p test) must not reap each other's subtrees."""
+    with _registry_lock:
+        _owner_seq[0] += 1
+        return f"{prefix}#{_owner_seq[0]}"
+
+
+def owner_label(owner: str) -> str:
+    """Telemetry label for an owner path: the per-instance ``#seq``
+    uniquifier is stripped so label cardinality stays bounded by the
+    component tree, not by how many nodes the process ever created."""
+    return _OWNER_SEQ_RE.sub("", owner)
+
+
+def _in_subtree(owner: str, root: str) -> bool:
+    return owner == root or owner.startswith(root + "/")
+
+
+def _record_violation(kind: str, detail: str, may_raise: bool) -> None:
+    from . import sanitize
+
+    sanitize.record(kind, detail, may_raise=may_raise)
+
+
+def _on_task_done(task: asyncio.Task) -> None:
+    with _registry_lock:
+        rec = _registry.pop(task, None)
+    if rec is None:
+        return
+    if rec.cancelled_at is not None:
+        TASK_CANCEL_LATENCY.observe(time.perf_counter() - rec.cancelled_at)
+    if task.cancelled():
+        return
+    exc = task.exception()  # retrieves it: no destructor log at exit
+    if exc is not None:
+        _record_violation(
+            "task_exception",
+            f"supervised task {rec.owner}/{rec.name} died with "
+            f"{type(exc).__name__}: {exc}",
+            may_raise=False)  # done-callbacks run inside loop internals
+
+
+def spawn(name: str, coro: Coroutine, owner: str = "proc") -> asyncio.Task:
+    """Create a supervised task. Requires a running loop (callers that
+    may run loop-less keep their ``except RuntimeError`` guards — the
+    coroutine is closed on failure so no 'never awaited' warning
+    leaks). The registry holds a strong reference until the task
+    finishes, so fire-and-forget spawns cannot be GC-cancelled
+    mid-flight (the watcher.py bug this module exists to kill)."""
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        coro.close()
+        raise
+    task = loop.create_task(  # sdlint: ok[task-lifecycle]
+        coro, name=f"{TASK_NAME_PREFIX}{owner}/{name}")
+    with _registry_lock:
+        _registry[task] = TaskRecord(name=name, owner=owner, task=task)
+    TASK_SPAWNED.labels(owner=owner_label(owner)).inc()
+    task.add_done_callback(_on_task_done)
+    return task
+
+
+def live(owner: Optional[str] = None) -> List[TaskRecord]:
+    """Registered (not yet finished) tasks, optionally restricted to
+    an ownership subtree."""
+    with _registry_lock:
+        recs = list(_registry.values())
+    if owner is None:
+        return recs
+    return [r for r in recs if _in_subtree(r.owner, owner)]
+
+
+async def cancel_and_gather(*tasks: Optional[asyncio.Task]) -> None:
+    """Cancel `tasks` and await their completion — the supervised stop
+    idiom. Swallows ONLY the victims' cancellation (gather with
+    return_exceptions captures per-task outcomes); if the CALLER is
+    cancelled mid-gather that cancellation propagates, and a victim's
+    real exception is still recorded by the supervisor's done-callback
+    (for raw tasks, gather's retrieval suppresses the exit-time log,
+    matching the old per-task ``except`` loops)."""
+    victims = [t for t in tasks if t is not None]
+    for t in victims:
+        t.cancel()
+    if victims:
+        await asyncio.gather(*victims, return_exceptions=True)
+
+
+async def reap(owner: str, grace_s: Optional[float] = None) -> List[str]:
+    """Cancel-and-gather every registered task under `owner`, deepest
+    ownership paths first. Returns the reaped task labels. Tasks still
+    pending after `grace_s` (default SDTPU_TASK_REAP_S) are orphans:
+    each counts into sd_task_orphaned_total, and one summarizing
+    ``task_orphaned`` sanitizer violation raises in tier-1 (`raise`
+    mode) AFTER the sweep finishes, so shutdown cleanup still runs."""
+    if grace_s is None:
+        grace_s = flags.get("SDTPU_TASK_REAP_S")
+    reaped: List[str] = []
+    orphans: List[TaskRecord] = []
+    seen: set = set()
+    # Multiple sweeps: a callback queued before shutdown (threadsafe
+    # originate_soon, ws-emit, watcher on_dirty) can spawn under this
+    # owner WHILE the reap awaits — a single snapshot would let that
+    # task outlive the reap uncancelled and unreported.
+    for _round in range(3):
+        victims = [r for r in live(owner)
+                   if not r.task.done() and r.task not in seen]
+        if not victims:
+            break
+        seen.update(r.task for r in victims)
+        reaped.extend(f"{r.owner}/{r.name}" for r in victims)
+        for depth in sorted({r.owner.count("/") for r in victims},
+                            reverse=True):
+            layer = [r for r in victims
+                     if r.owner.count("/") == depth and not r.task.done()]
+            if not layer:
+                continue
+            start = time.perf_counter()
+            # Cancel unconditionally BEFORE the grace-bounded wait:
+            # grace_s=0 must still mean "cancel, just don't wait",
+            # never "leave everything running".
+            for r in layer:
+                r.cancelled_at = start
+                r.task.cancel()
+            pending = {r.task for r in layer}
+            while pending:
+                remaining = grace_s - (time.perf_counter() - start)
+                if remaining <= 0:
+                    break
+                _done, pending = await asyncio.wait(
+                    pending, timeout=min(1.0, remaining))
+                # Re-cancel through the grace window (not once): a
+                # pre-3.11 deadline() block whose timer races the reap
+                # can absorb one cancel into its TimeoutError
+                # conversion — a second round reaches the task at its
+                # next await, so only a task that truly ignores
+                # cancellation is declared an orphan.
+                for t in pending:
+                    t.cancel()
+            orphans.extend(r for r in layer if r.task in pending)
+    # Spawns that landed during the final sweep: cancel so they cannot
+    # run against the DBs shutdown is about to close, and report them —
+    # escaping silently is the one unacceptable outcome.
+    stragglers = [r for r in live(owner)
+                  if not r.task.done() and r.task not in seen]
+    for r in stragglers:
+        r.task.cancel()
+    orphans.extend(stragglers)
+    reaped.extend(f"{r.owner}/{r.name}" for r in stragglers)
+    if orphans:
+        TASK_ORPHANED.inc(len(orphans))
+        _record_violation(
+            "task_orphaned",
+            "task(s) survived the shutdown reap grace period "
+            f"({grace_s}s): "
+            + ", ".join(f"{r.owner}/{r.name}" for r in orphans),
+            may_raise=True)
+    return reaped

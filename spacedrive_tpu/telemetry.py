@@ -526,7 +526,8 @@ SANITIZE_VIOLATIONS = counter(
     "sd_sanitize_violations_total",
     "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
     "loop_stall | lock_across_await | lock_order_cycle | "
-    "jit_retrace_budget | host_transfer",
+    "jit_retrace_budget | host_transfer | task_exception | "
+    "task_orphaned",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",
@@ -549,3 +550,26 @@ JIT_DECLARED_TRANSFERS = counter(
     "Entries into declared io() host-transfer scopes, per contract "
     "name (the sanctioned D2H points of the device pipelines)",
     labelnames=("fn",))
+
+# -- task supervisor (tasks.py) ---------------------------------------------
+TASK_SPAWNED = counter(
+    "sd_task_spawned_total",
+    "Tasks registered with the structured-concurrency supervisor, by "
+    "ownership path (instance #seq stripped)",
+    labelnames=("owner",))
+TASK_ORPHANED = counter(
+    "sd_task_orphaned_total",
+    "Supervised tasks that survived a shutdown reap's grace period "
+    "(SDTPU_TASK_REAP_S) — each is a task_orphaned sanitizer "
+    "violation")
+TASK_CANCEL_LATENCY = histogram(
+    "sd_task_cancel_latency_seconds",
+    "Seconds from a supervisor cancel() to the task actually "
+    "finishing (shutdown responsiveness of the component tree)")
+
+# -- timeout contracts (timeouts.py) ----------------------------------------
+TIMEOUTS_FIRED = counter(
+    "sd_timeout_fired_total",
+    "Declared network-await budgets that fired, per contract name "
+    "(timeouts.py registry) — which peers/paths are hanging",
+    labelnames=("name",))

@@ -1,0 +1,265 @@
+"""Central network-await timeout registry — the lifecycle twin of
+ops/jit_registry.py's contract table.
+
+Every await on a socket/tunnel/ws frame in `p2p/`, `api/`, `sync/`
+runs under a budget DECLARED here — name, default seconds, and a
+docstring — and applied through `with_timeout(name, awaitable)` or a
+`deadline(name)` block. Scattered `asyncio.wait_for(..., 30)` literals
+made the hang surface unauditable (a peer that stops acking a clone
+page parked the originator forever; the spacedrop verdict wait was the
+only network await with ANY budget); tools/sdlint's timeout-discipline
+pass now fails the build on a network-root await that is not covered
+by a declared budget, and on a `with_timeout` name missing from this
+table.
+
+Effective budget = declared default × `SDTPU_TIMEOUT_SCALE`
+(flags.py): thin-pipe or debug hosts scale every budget at once
+instead of chasing literals. A fired budget counts into
+`sd_timeout_fired_total{name}` before the TimeoutError propagates —
+/metrics shows WHICH contract is tripping in production.
+
+README's timeout table is generated from this registry
+(`python -m tools.sdlint --timeout-table`).
+
+Design constraints (same as flags.py): stdlib + flags/telemetry only,
+importable from every layer without cycles.
+
+Budget ordering invariants (asserted nowhere, documented here):
+`p2p.spacedrop.verdict` must EXCEED `p2p.spacedrop.decide` — the
+sender's verdict wait brackets the receiver's interactive decision
+window; equal budgets would race the legitimate decide path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Awaitable, Dict, Optional
+
+from . import flags
+from .telemetry import TIMEOUTS_FIRED
+
+__all__ = [
+    "TimeoutContract", "TIMEOUTS", "declare_timeout", "budget",
+    "with_timeout", "deadline", "timeout_table_markdown",
+]
+
+
+@dataclass(frozen=True)
+class TimeoutContract:
+    name: str        # dotted id: "<layer>.<operation>"
+    default_s: float
+    doc: str
+
+
+TIMEOUTS: Dict[str, TimeoutContract] = {}
+
+
+def declare_timeout(name: str, default_s: float, doc: str
+                    ) -> TimeoutContract:
+    if name in TIMEOUTS:
+        raise ValueError(f"timeout {name!r} declared twice")
+    if default_s <= 0:
+        raise ValueError(f"timeout {name!r}: budget must be positive")
+    c = TimeoutContract(name, float(default_s), doc)
+    TIMEOUTS[name] = c
+    return c
+
+
+def budget(name: str) -> float:
+    """Effective seconds for a declared budget. An unknown name is a
+    programming error, not a lookup miss — exactly flags.raw()."""
+    c = TIMEOUTS.get(name)
+    if c is None:
+        raise KeyError(f"undeclared timeout {name!r} (declare it in "
+                       "spacedrive_tpu/timeouts.py)")
+    return c.default_s * flags.get("SDTPU_TIMEOUT_SCALE")
+
+
+async def with_timeout(name: str, awaitable: Awaitable) -> Any:
+    """`asyncio.wait_for` under a declared budget; a fired budget
+    counts into sd_timeout_fired_total{name} before raising."""
+    try:
+        return await asyncio.wait_for(awaitable, budget(name))
+    except asyncio.TimeoutError:
+        TIMEOUTS_FIRED.labels(name=name).inc()
+        raise
+
+
+class _Deadline:
+    """Block-scoped budget for multi-await sequences (handshakes,
+    pair round-trips): schedules a cancel at the budget and converts
+    the resulting CancelledError back into asyncio.TimeoutError at the
+    block edge. Python 3.10 has no asyncio.timeout(); this is the same
+    cancel-at-deadline shape (and shares its pre-3.11 edge: a timer
+    firing in the instant between the block's last await and __aexit__
+    still raises TimeoutError, but the task-level cancel may surface
+    at the caller's next await — budgets here are tens of seconds over
+    millisecond blocks, so the window is vanishing)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._fired = False
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._task: Optional[asyncio.Task] = None
+
+    def _fire(self) -> None:
+        self._fired = True
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+
+    async def __aenter__(self) -> "_Deadline":
+        self._task = asyncio.current_task()
+        self._handle = asyncio.get_running_loop().call_later(
+            budget(self.name), self._fire)
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        if self._handle is not None:
+            self._handle.cancel()
+        if self._fired and exc_type in (None, asyncio.CancelledError):
+            if exc_type is None and self._task is not None:
+                # The timer fired in the window between the block's
+                # last await completing and __aexit__: our cancel is
+                # PENDING (no suspension point saw it). Neutralize it
+                # (best-effort — CPython parks it in _must_cancel) so
+                # the deterministic TimeoutError below is the only
+                # consequence, not a surprise CancelledError at the
+                # caller's next unrelated await.
+                if getattr(self._task, "_must_cancel", False):
+                    self._task._must_cancel = False
+            TIMEOUTS_FIRED.labels(name=self.name).inc()
+            raise asyncio.TimeoutError(
+                f"deadline {self.name!r} "
+                f"({budget(self.name)}s) exceeded") from exc
+        return False
+
+
+def deadline(name: str) -> _Deadline:
+    """``async with deadline("p2p.handshake"):`` — every await inside
+    the block shares the named budget. sdlint's timeout-discipline
+    pass treats the block as covered."""
+    return _Deadline(name)
+
+
+def timeout_table_markdown() -> str:
+    """README's generated timeout table (one row per declared budget)."""
+    out = ["| Budget | Default | Covers |", "| --- | --- | --- |"]
+    for name in sorted(TIMEOUTS):
+        c = TIMEOUTS[name]
+        doc = " ".join(c.doc.split())
+        out.append(f"| `{name}` | {c.default_s:g}s | {doc} |")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# THE budget namespace. Keep alphabetical within each layer; every
+# entry is enforced by the sdlint timeout-discipline pass (a network
+# await outside a declared budget fails the build).
+# ---------------------------------------------------------------------------
+
+# -- api (rspc HTTP + websocket host) ---------------------------------------
+
+declare_timeout(
+    "api.http.read", 30.0,
+    "Reading a request body (rspc POST input JSON): bounds a "
+    "slow-loris client on the API host.")
+
+declare_timeout(
+    "api.http.write", 60.0,
+    "One streamed response chunk (thumbnail/file/static serving): a "
+    "stalled client releases the handler instead of pinning it.")
+
+declare_timeout(
+    "api.ws.prepare", 30.0,
+    "Websocket upgrade handshake on the rspc ws route.")
+
+declare_timeout(
+    "api.ws.send", 30.0,
+    "One websocket frame to a subscriber (responses, subscription "
+    "events): a dead client cannot wedge the emit path.")
+
+# -- p2p (tunnel control plane) ---------------------------------------------
+
+declare_timeout(
+    "p2p.connect", 20.0,
+    "Outbound TCP dial + authenticated tunnel handshake "
+    "(P2PManager.open_stream).")
+
+declare_timeout(
+    "p2p.file.response", 60.0,
+    "The remote library's file-request decision frame "
+    "(request_file's status/req header).")
+
+declare_timeout(
+    "p2p.frame_send", 60.0,
+    "One control/ops frame into a tunnel including the drain "
+    "backpressure wait — a receiver that stops reading frees the "
+    "sender here.")
+
+declare_timeout(
+    "p2p.handshake", 20.0,
+    "The signed-ephemeral key exchange on a fresh tunnel "
+    "(proto.tunnel_handshake, both roles).")
+
+declare_timeout(
+    "p2p.header_recv", 30.0,
+    "Inbound dispatch header after an accepted handshake: a silent "
+    "dialer cannot hold a server slot open.")
+
+declare_timeout(
+    "p2p.pair", 60.0,
+    "The whole pairing round-trip (instance-row exchange incl. the "
+    "responder's DB writes).")
+
+declare_timeout(
+    "p2p.ping", 20.0,
+    "Ping round-trip over a fresh tunnel.")
+
+declare_timeout(
+    "p2p.spacedrop.decide", 60.0,
+    "Interactive accept/reject window for an inbound spacedrop offer "
+    "(the reference's 60s prompt).")
+
+declare_timeout(
+    "p2p.spacedrop.verdict", 75.0,
+    "Sender's wait for the receiver's accept/reject — brackets the "
+    "receiver's full p2p.spacedrop.decide window, so it MUST stay "
+    "longer than it.")
+
+declare_timeout(
+    "p2p.transfer.chunk", 60.0,
+    "One spaceblock block (send or receive) plus its ack: transfers "
+    "of any size stay live as long as per-block progress continues.")
+
+# -- sync (CRDT pull + clone fast path) -------------------------------------
+
+declare_timeout(
+    "sync.clone.ack", 180.0,
+    "Originator's wait for one blob-page watermark ack — covers the "
+    "receiver's batched one-tx page apply at bulk page sizes.")
+
+declare_timeout(
+    "sync.clone.ack_send", 60.0,
+    "Receiver pushing a page ack back up the tunnel.")
+
+declare_timeout(
+    "sync.clone.drain", 120.0,
+    "Flushing a pipelined clone window into the socket against a "
+    "slow receiver's backpressure.")
+
+declare_timeout(
+    "sync.clone.frame", 180.0,
+    "Receiver's wait for the next clone-stream frame (page, "
+    "interleaved ops, or blob_done) from the originator.")
+
+declare_timeout(
+    "sync.pull.page", 180.0,
+    "Responder's wait for one ops page — the originator runs get_ops "
+    "off-loop over bulk op logs before answering.")
+
+declare_timeout(
+    "sync.pull.request", 180.0,
+    "Originator's wait for the responder's next pull request — the "
+    "responder ingests the previous page (one tx per page) before "
+    "asking again.")

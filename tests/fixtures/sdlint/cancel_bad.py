@@ -1,0 +1,46 @@
+"""cancellation-safety known-POSITIVES."""
+
+import asyncio
+
+
+async def swallow_bare(q):
+    try:
+        await q.get()
+    except:  # noqa: E722 — swallow-cancel (bare)
+        pass
+
+
+async def swallow_base(q):
+    try:
+        await q.get()
+    except BaseException:  # swallow-cancel (no re-raise)
+        return None
+
+
+async def conflated_reap(task):
+    # the pre-PR mdns/discovery stop() shape: CancelledError lumped
+    # with Exception in one silencing handler.
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):  # swallow-cancel
+        pass
+
+
+async def unshielded_cleanup(conn):
+    try:
+        await conn.run()
+    finally:
+        await conn.aclose()  # await-in-finally
+
+
+async def spin(counter):
+    while True:  # no-cancel-point: no await, no break
+        counter += 1
+
+
+def drops_outcome(task, pending):
+    # container-method callback: the exception is never retrieved.
+    task.add_done_callback(pending.discard)
+    # lambda that ignores its task argument: same black hole.
+    task.add_done_callback(lambda t: print("done"))

@@ -1,0 +1,43 @@
+"""cancellation-safety known-NEGATIVES."""
+
+import asyncio
+
+
+async def reap_idiom(task):
+    # lone CancelledError after an explicit cancel: legitimate.
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+
+
+async def reraise_base(conn):
+    # BaseException with a re-raise (store/db.py's rollback shape).
+    try:
+        await conn.run()
+    except BaseException:
+        await_nothing = None  # noqa: F841
+        raise
+    finally:
+        await asyncio.shield(conn.aclose())  # shielded cleanup: fine
+
+
+async def narrow_handler(q):
+    # except Exception does NOT catch CancelledError (py3.8+): fine.
+    try:
+        await q.get()
+    except Exception:
+        return None
+
+
+async def bounded_loop(q):
+    while True:  # has a cancellation point AND an exit
+        item = await q.get()
+        if item is None:
+            break
+
+
+def observing_callback(task, mgr):
+    # the task parameter is used: outcome reaches the handler.
+    task.add_done_callback(lambda t: mgr.on_done(t))

@@ -1,0 +1,32 @@
+"""task-lifecycle known-POSITIVES: every shape below is a finding."""
+
+import asyncio
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+def fire_and_forget(loop):
+    # dropped-task: the loop holds tasks weakly; nothing owns this.
+    loop.create_task(work())
+
+
+def old_loop_spawn():
+    # deprecated-get-event-loop AND dropped-task — the exact
+    # locations/watcher.py:375 shape (dynamic receiver chain).
+    asyncio.get_event_loop().create_task(work())
+
+
+def just_the_loop():
+    # deprecated-get-event-loop alone.
+    loop = asyncio.get_event_loop()
+    return loop
+
+
+async def storm(items, registry):
+    # spawn-in-loop: stored, registered... but never awaited anywhere
+    # in this function — an unbounded task pile-up.
+    for _ in items:
+        t = asyncio.ensure_future(work())
+        registry.append(t)

@@ -1,0 +1,38 @@
+"""task-lifecycle known-NEGATIVES: all sanctioned spawn shapes."""
+
+import asyncio
+
+from spacedrive_tpu import tasks
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+class Actor:
+    def start(self):
+        # stored on an owner: the actor cancels it in stop().
+        self._task = asyncio.get_running_loop().create_task(work())
+
+    def start_supervised(self):
+        # supervised fire-and-forget: the registry holds the reference.
+        tasks.spawn("actor", work(), owner="fixture")
+
+    def stop(self):
+        self._task.cancel()
+
+
+async def awaited_directly():
+    t = asyncio.ensure_future(work())
+    await t
+
+
+async def bounded_in_loop(items):
+    # worker.py's step/command shape: spawned in a loop but awaited
+    # (via asyncio.wait) inside the same function.
+    for _ in items:
+        step = asyncio.ensure_future(work())
+        cmd = asyncio.ensure_future(work())
+        await asyncio.wait({step, cmd},
+                           return_when=asyncio.FIRST_COMPLETED)
+        await tasks.cancel_and_gather(step, cmd)

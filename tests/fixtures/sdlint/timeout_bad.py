@@ -1,0 +1,31 @@
+# sdlint-scope: net
+"""timeout-discipline known-POSITIVES (scope opted in above)."""
+
+import asyncio
+
+from spacedrive_tpu.timeouts import with_timeout
+
+
+async def pull(tunnel):
+    req = await tunnel.recv()            # no-timeout
+    await tunnel.send({"ok": True})      # no-timeout
+    return req
+
+
+async def raw_read(reader):
+    return await reader.readexactly(4)   # no-timeout
+
+
+async def literal_budget(tunnel):
+    # unnamed-timeout: the budget must come from the registry.
+    return await asyncio.wait_for(tunnel.recv(), 5.0)
+
+
+async def unknown_name(tunnel):
+    # undeclared-timeout: not in timeouts.py.
+    return await with_timeout("not.a.real.budget", tunnel.recv())
+
+
+async def computed_name(tunnel, which):
+    # dynamic-timeout-name: the table must stay static.
+    return await with_timeout(which, tunnel.recv())

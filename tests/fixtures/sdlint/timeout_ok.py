@@ -1,0 +1,32 @@
+# sdlint-scope: net
+"""timeout-discipline known-NEGATIVES."""
+
+import asyncio
+
+from spacedrive_tpu.timeouts import deadline, with_timeout
+
+
+async def pull(tunnel):
+    req = await with_timeout("p2p.header_recv", tunnel.recv())
+    await with_timeout("p2p.frame_send", tunnel.send({"ok": True}))
+    return req
+
+
+async def handshake(reader, writer):
+    # block-scoped budget covers every await inside.
+    async with deadline("p2p.handshake"):
+        await writer.drain()
+        return await reader.readexactly(4)
+
+
+async def local_work(db):
+    # not a network root: no budget required.
+    return await asyncio.to_thread(db.query, "SELECT 1")
+
+
+async def server_read_loop(ws):
+    # async-for over a websocket is exempt by design: a client owns
+    # its own idle cadence.
+    async for msg in ws:
+        if msg is None:
+            break

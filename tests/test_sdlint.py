@@ -1,15 +1,17 @@
 """sdlint framework: per-pass fixtures, the tree gate, baseline policy.
 
 This is the tier-1 hook that replaced the direct telemetry_lint run:
-`test_tree_clean_within_baseline` runs ALL eight passes (five
-concurrency/invariant + the round-10 device trio: jit-stability,
-dtype-discipline, host-transfer) over the repo and fails on any
-finding not in tools/sdlint/baseline.json (which may only shrink —
-budget enforced here too). The per-pass tests pin each pass to a
-known-positive / known-negative fixture pair under
-tests/fixtures/sdlint/, including the encoded PR 1 store/db.py
-reader-registration deadlock shape (locks_bad.Pr1Database) and the
-encoded overlap.py:166 call-time-jit shape (jit_bad.call_time).
+`test_tree_clean_within_baseline` runs ALL eleven passes (five
+concurrency/invariant + the round-10 device trio + the round-11
+lifecycle trio: task-lifecycle, cancellation-safety,
+timeout-discipline) over the repo and fails on any finding not in
+tools/sdlint/baseline.json (which may only shrink — budget enforced
+here too). The per-pass tests pin each pass to a known-positive /
+known-negative fixture pair under tests/fixtures/sdlint/, including
+the encoded PR 1 store/db.py reader-registration deadlock shape
+(locks_bad.Pr1Database), the encoded overlap.py:166 call-time-jit
+shape (jit_bad.call_time), and the encoded watcher.py:375
+dropped-task shape (lifecycle_bad.old_loop_spawn).
 """
 
 import os
@@ -191,6 +193,84 @@ def test_host_transfer_passes_known_negatives():
     assert _lint_fixture("transfer_ok.py", "host-transfer") == []
 
 
+# -- task-lifecycle (round 11: the lifecycle trio) --------------------------
+
+def test_task_lifecycle_flags_known_positives():
+    found = _lint_fixture("lifecycle_bad.py", "task-lifecycle")
+    codes = {f.code for f in found}
+    assert codes == {"dropped-task", "deprecated-get-event-loop",
+                     "spawn-in-loop"}, codes
+    # the watcher.py:375 shape: a dynamic-receiver chain whose result
+    # is discarded — both the deprecated loop AND the dropped task
+    quals = {f.qual for f in found if f.code == "dropped-task"}
+    assert {"fire_and_forget", "old_loop_spawn"} <= quals, found
+    assert any(f.qual == "old_loop_spawn"
+               and f.code == "deprecated-get-event-loop" for f in found)
+
+
+def test_task_lifecycle_passes_known_negatives():
+    assert _lint_fixture("lifecycle_ok.py", "task-lifecycle") == []
+
+
+# -- cancellation-safety -----------------------------------------------------
+
+def test_cancellation_safety_flags_known_positives():
+    found = _lint_fixture("cancel_bad.py", "cancellation-safety")
+    codes = {f.code for f in found}
+    assert codes == {"swallow-cancel", "await-in-finally",
+                     "no-cancel-point",
+                     "dropped-exception-callback"}, codes
+    swallow = {f.qual for f in found if f.code == "swallow-cancel"}
+    # the pre-PR mdns/discovery stop() conflation is pinned
+    assert {"swallow_bare", "swallow_base", "conflated_reap"} <= swallow
+    cb = [f for f in found if f.code == "dropped-exception-callback"]
+    assert len(cb) == 2, cb  # container method + task-ignoring lambda
+
+
+def test_cancellation_safety_passes_known_negatives():
+    assert _lint_fixture("cancel_ok.py", "cancellation-safety") == []
+
+
+# -- timeout-discipline ------------------------------------------------------
+
+def test_timeout_discipline_flags_known_positives():
+    found = _lint_fixture("timeout_bad.py", "timeout-discipline")
+    codes = {f.code for f in found}
+    assert codes == {"no-timeout", "unnamed-timeout",
+                     "undeclared-timeout", "dynamic-timeout-name"}, codes
+    roots = {f.ident for f in found if f.code == "no-timeout"}
+    assert "tunnel.recv" in roots and "tunnel.send" in roots
+    assert "reader.readexactly" in roots
+
+
+def test_timeout_discipline_passes_known_negatives():
+    """with_timeout on declared names, deadline blocks, non-net
+    awaits, and the ws async-for exemption are all sanctioned."""
+    assert _lint_fixture("timeout_ok.py", "timeout-discipline") == []
+
+
+def test_timeout_fixture_names_are_really_declared():
+    """The OK fixture leans on real registry names — a renamed budget
+    must rename the fixture (and every call site) with it."""
+    from tools.sdlint.passes.timeout_discipline import declared_timeouts
+
+    declared = declared_timeouts(ROOT)
+    for name in ("p2p.header_recv", "p2p.frame_send", "p2p.handshake"):
+        assert name in declared, name
+
+
+def test_every_with_timeout_site_name_resolves_at_runtime():
+    """The static table and the runtime registry cannot drift: every
+    name the AST parser sees must resolve through timeouts.budget()."""
+    from spacedrive_tpu import timeouts
+    from tools.sdlint.passes.timeout_discipline import declared_timeouts
+
+    static = declared_timeouts(ROOT)
+    assert set(static) == set(timeouts.TIMEOUTS)
+    for name in static:
+        assert timeouts.budget(name) > 0
+
+
 # -- the tree gate (runs all five passes; tier-1's CI hook) -----------------
 
 def test_tree_clean_within_baseline():
@@ -229,7 +309,8 @@ def test_every_registered_pass_ran_on_tree():
     assert set(PASSES) == {
         "blocking-async", "lock-discipline", "crdt-parity",
         "flag-registry", "telemetry", "jit-stability",
-        "dtype-discipline", "host-transfer"}
+        "dtype-discipline", "host-transfer", "task-lifecycle",
+        "cancellation-safety", "timeout-discipline"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -277,6 +358,66 @@ def test_cli_passes_with_no_value_lists_passes(capsys):
     assert main(["--passes"]) == 0
     out = capsys.readouterr().out.split()
     assert set(PASSES) <= set(out)
+
+
+def test_stats_runs_all_passes_under_the_tier1_budget():
+    """`python -m tools.sdlint --stats` is the analyzer's own perf
+    gate: per-pass counts + wall-time, with the whole-tree total
+    pinned under 30s so pass growth can't silently blow up tier-1
+    (the container's 2-core/9p weather included in the margin)."""
+    from tools.sdlint.__main__ import stats
+
+    rows = stats(ROOT)
+    names = [n for n, _c, _s in rows]
+    assert names[0] == "index" and names[-1] == "total"
+    assert set(PASSES) <= set(names)
+    total_s = rows[-1][2]
+    assert total_s < 30.0, (
+        f"sdlint whole-tree run took {total_s:.1f}s — the analyzer "
+        "must stay under 30s or tier-1 eats the overrun")
+
+
+def test_cli_stats_prints_a_row_per_pass(capsys):
+    # Format-only check, so run over the tiny fixture tree: the perf
+    # test above already paid for the one whole-tree sweep tier-1 needs.
+    from tools.sdlint.__main__ import main
+
+    assert main(["--stats", "--root", FIXTURES]) == 0
+    out = capsys.readouterr().out
+    for name in PASSES:
+        assert name in out
+
+
+def test_cli_timeout_table_covers_every_declared_budget(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--timeout-table"]) == 0
+    out = capsys.readouterr().out
+    from spacedrive_tpu import timeouts
+
+    for name in timeouts.TIMEOUTS:
+        assert f"`{name}`" in out
+
+
+def test_baseline_budget_is_minimal_and_reasons_unique():
+    """Round-11 hygiene (the PR 5 uniqueness test, tightened): the
+    budget must be EXACTLY the entry count — a bump that leaves
+    headroom lets findings sneak in silently — and any lifecycle-pass
+    entry must carry its own substantial reason, not a copy-paste."""
+    baseline = Baseline.load(DEFAULT_PATH)
+    assert baseline.budget == len(baseline.entries), (
+        f"budget {baseline.budget} != {len(baseline.entries)} entries: "
+        "the bump must be the minimum required")
+    lifecycle = {k: v for k, v in baseline.entries.items()
+                 if k.split("::", 1)[0] in (
+                     "task-lifecycle", "cancellation-safety",
+                     "timeout-discipline")}
+    # Today the lifecycle passes run CLEAN (zero baselined daemons);
+    # if one is ever added it needs a unique, substantial reason.
+    for key, reason in lifecycle.items():
+        assert len(reason.strip()) >= 20, f"thin reason on {key}"
+    assert len(set(lifecycle.values())) == len(lifecycle), (
+        "duplicate lifecycle baseline reasons — write one per entry")
 
 
 # -- flags registry integration --------------------------------------------

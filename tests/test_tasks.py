@@ -1,0 +1,402 @@
+"""Structured-concurrency supervisor (spacedrive_tpu/tasks.py): the
+runtime twin of sdlint's task-lifecycle pass.
+
+Covers the registry lifecycle (spawn → live → done-unregister), the
+cancellation-safe stop idiom, ownership-tree reaps (deepest first,
+orphan detection), violation wiring into the sanitizer, and the PR's
+headline regression: a watcher dirty-scan surviving a forced
+gc.collect() — the `locations/watcher.py:375` dropped-reference bug
+where `asyncio.get_event_loop().create_task(scan())` held NO strong
+reference and the collector could cancel a scan mid-flight.
+"""
+
+import asyncio
+import gc
+import os
+
+import pytest
+
+from spacedrive_tpu import sanitize, tasks
+from spacedrive_tpu.sanitize import SanitizerViolation
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _labels(owner=None):
+    return sorted(f"{r.owner}/{r.name}" for r in tasks.live(owner))
+
+
+# -- registry lifecycle ------------------------------------------------------
+
+def test_spawn_registers_until_done():
+    async def main():
+        done = asyncio.Event()
+
+        async def waiter():
+            await done.wait()
+
+        t = tasks.spawn("waiter", waiter(), owner="t1")
+        assert "t1/waiter" in _labels("t1")
+        done.set()
+        await t
+        await asyncio.sleep(0)
+        assert _labels("t1") == []
+    _run(main())
+
+
+def test_spawn_without_loop_raises_and_closes_coro(recwarn):
+    async def work():
+        await asyncio.sleep(0)
+
+    with pytest.raises(RuntimeError):
+        tasks.spawn("no-loop", work(), owner="t2")
+    gc.collect()
+    # the coroutine was closed on failure: no "never awaited" warning
+    assert not [w for w in recwarn.list
+                if "never awaited" in str(w.message)]
+
+
+def test_task_names_carry_the_sdtpu_prefix():
+    async def main():
+        async def idle():
+            await asyncio.sleep(30)
+
+        t = tasks.spawn("named", idle(), owner="t3/sub")
+        assert t.get_name() == f"{tasks.TASK_NAME_PREFIX}t3/sub/named"
+        await tasks.cancel_and_gather(t)
+    _run(main())
+
+
+def test_unique_owner_and_label_normalization():
+    a = tasks.unique_owner("node")
+    b = tasks.unique_owner("node")
+    assert a != b and a.startswith("node#")
+    assert tasks.owner_label(f"{a}/p2p/mdns") == "node/p2p/mdns"
+
+
+# -- exception observation ---------------------------------------------------
+
+def test_task_exception_is_recorded_as_violation():
+    async def main():
+        async def boom():
+            raise ValueError("kaput")
+
+        tasks.spawn("boom", boom(), owner="t4")
+        await asyncio.sleep(0.05)
+    _run(main())
+    kinds = [v for v in sanitize.violations()
+             if v["kind"] == "task_exception" and "kaput" in v["detail"]]
+    assert kinds, sanitize.violations()[-3:]
+    sanitize.reset_violations()  # deliberate trigger: keep tier-1 green
+
+
+def test_cancelled_task_is_not_an_exception_violation():
+    before = len(sanitize.violations())
+
+    async def main():
+        async def idle():
+            await asyncio.sleep(30)
+
+        t = tasks.spawn("idle", idle(), owner="t5")
+        await tasks.cancel_and_gather(t)
+    _run(main())
+    assert sanitize.violations()[before:] == []
+
+
+# -- cancel_and_gather -------------------------------------------------------
+
+def test_cancel_and_gather_swallows_victim_cancellation_only():
+    async def main():
+        cleaned = []
+
+        async def victim():
+            try:
+                await asyncio.sleep(30)
+            finally:
+                cleaned.append(True)
+
+        t = tasks.spawn("victim", victim(), owner="t6")
+        await asyncio.sleep(0)
+        await tasks.cancel_and_gather(t, None)  # None entries tolerated
+        assert cleaned == [True]
+        assert t.cancelled()
+    _run(main())
+
+
+def test_cancel_and_gather_propagates_caller_cancellation():
+    async def main():
+        started = asyncio.Event()
+
+        async def stubborn():
+            # refuses the FIRST cancel so the gather stays pending
+            # while the caller itself gets cancelled
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                started.set()
+                await asyncio.sleep(30)
+
+        victim = tasks.spawn("stubborn", stubborn(), owner="t7")
+        await asyncio.sleep(0)
+
+        async def caller():
+            await tasks.cancel_and_gather(victim)
+
+        c = asyncio.ensure_future(caller())
+        await started.wait()
+        c.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await c
+        victim.cancel()  # second cancel lands; clean up
+        await asyncio.gather(victim, return_exceptions=True)
+    _run(main())
+
+
+# -- reap --------------------------------------------------------------------
+
+def test_reap_cancels_subtree_children_first():
+    async def main():
+        order = []
+
+        def ender(tag):
+            async def run():
+                try:
+                    await asyncio.sleep(30)
+                finally:
+                    order.append(tag)
+            return run()
+
+        tasks.spawn("parent", ender("parent"), owner="n1")
+        tasks.spawn("child", ender("child"), owner="n1/p2p")
+        tasks.spawn("grandchild", ender("grand"), owner="n1/p2p/mdns")
+        tasks.spawn("other", ender("other"), owner="n2")
+        await asyncio.sleep(0)
+        reaped = await tasks.reap("n1", grace_s=2.0)
+        assert set(reaped) == {"n1/parent", "n1/p2p/child",
+                               "n1/p2p/mdns/grandchild"}
+        # deepest owners die before their parents
+        assert order.index("grand") < order.index("child") < \
+            order.index("parent")
+        assert _labels("n1") == []
+        assert _labels("n2") == ["n2/other"]  # untouched sibling tree
+        await tasks.reap("n2", grace_s=2.0)
+    _run(main())
+
+
+def test_reap_raises_on_orphaned_task():
+    async def main():
+        release = asyncio.Event()
+
+        async def immortal():
+            while not release.is_set():
+                try:
+                    await asyncio.sleep(30)
+                except asyncio.CancelledError:
+                    pass  # ignores cancellation: the orphan shape
+
+        tasks.spawn("immortal", immortal(), owner="n3")
+        await asyncio.sleep(0)
+        with pytest.raises(SanitizerViolation, match="task_orphaned"):
+            await tasks.reap("n3", grace_s=0.1)
+        release.set()
+        for rec in tasks.live("n3"):
+            rec.task.cancel()
+        await asyncio.sleep(0.05)
+    _run(main())
+    sanitize.reset_violations()  # deliberate trigger
+
+
+def test_reap_zero_grace_cancels_before_declaring_orphans():
+    """grace_s=0 means "cancel, just don't wait" — never "leave
+    everything running": the cancel pass is unconditional, only the
+    wait is grace-bounded."""
+    async def main():
+        async def idle():
+            await asyncio.sleep(30)
+
+        t = tasks.spawn("idle", idle(), owner="n5")
+        await asyncio.sleep(0)
+        with pytest.raises(SanitizerViolation, match="task_orphaned"):
+            await tasks.reap("n5", grace_s=0.0)
+        # the cancel was still delivered: the task dies at its next
+        # suspension instead of running on against closed DBs
+        await asyncio.gather(t, return_exceptions=True)
+        assert t.cancelled()
+    _run(main())
+    sanitize.reset_violations()  # deliberate trigger
+
+
+def test_reap_sweeps_tasks_spawned_during_the_reap():
+    """A callback queued before shutdown can spawn under the owner
+    WHILE the reap awaits (threadsafe originate_soon, ws-emit,
+    watcher on_dirty): a one-shot snapshot would let it escape both
+    cancellation and the orphan report."""
+    async def main():
+        late_done = []
+
+        async def late():
+            try:
+                await asyncio.sleep(30)
+            finally:
+                late_done.append(True)
+
+        async def spawner():
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                tasks.spawn("late", late(), owner="n6")
+                raise
+
+        tasks.spawn("spawner", spawner(), owner="n6")
+        await asyncio.sleep(0)
+        reaped = await tasks.reap("n6", grace_s=2.0)
+        assert "n6/late" in reaped
+        assert late_done == [True]
+        await asyncio.sleep(0)
+        assert _labels("n6") == []
+    _run(main())
+
+
+def test_reap_observes_cancel_latency_metric():
+    from spacedrive_tpu.telemetry import TASK_CANCEL_LATENCY
+
+    before = TASK_CANCEL_LATENCY.count
+
+    async def main():
+        async def idle():
+            await asyncio.sleep(30)
+
+        tasks.spawn("idle", idle(), owner="n4")
+        await asyncio.sleep(0)
+        await tasks.reap("n4", grace_s=2.0)
+    _run(main())
+    assert TASK_CANCEL_LATENCY.count == before + 1
+
+
+# -- the watcher GC regression (satellite #1) --------------------------------
+
+def test_supervised_fire_and_forget_survives_gc():
+    """The supervisor holds the ONLY strong reference: a spawn whose
+    result is discarded must survive aggressive collection (the loop
+    itself keeps tasks weakly — asyncio docs require callers to hold
+    a reference, which the registry now does for everyone)."""
+    async def main():
+        hit = asyncio.Event()
+
+        async def scan():
+            await asyncio.sleep(0.05)
+            hit.set()
+
+        tasks.spawn("gc-scan", scan(), owner="t8")  # reference dropped
+        for _ in range(10):
+            gc.collect()
+            await asyncio.sleep(0.02)
+        assert hit.is_set()
+    _run(main())
+
+
+def _has_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def test_watcher_on_dirty_scan_survives_forced_gc(tmp_path, monkeypatch):
+    """The watcher.py:375 code path itself, crypto-free: drive the
+    on_dirty closure directly (shallow's heavy import chain stubbed)
+    and assert the supervised scan task completes under gc pressure —
+    the old dropped-reference spawn could be collected mid-scan."""
+    import sys
+    import types
+
+    from spacedrive_tpu.locations.watcher import Locations
+    from spacedrive_tpu.node import Node
+
+    scans = []
+    stub = types.ModuleType("spacedrive_tpu.locations.shallow")
+
+    def light_scan_location(lib, loc, sub, backend):
+        scans.append((loc, sub))
+        return {"saved": 0}
+    stub.light_scan_location = light_scan_location
+    monkeypatch.setitem(sys.modules,
+                        "spacedrive_tpu.locations.shallow", stub)
+
+    src = tmp_path / "src"
+    src.mkdir()
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+    lib.db.insert("location", {
+        "pub_id": os.urandom(16), "name": "src", "path": str(src),
+        "date_created": 0})
+
+    async def main():
+        monkeypatch.setenv("SDTPU_WATCHER", "poll")
+        locations = Locations(node, backend="numpy")
+        loc_id = lib.db.query_one("SELECT id FROM location")["id"]
+        assert locations.watch_location(lib, loc_id)
+        (src / "new.bin").write_bytes(b"x" * 64)
+        for _ in range(60):
+            gc.collect()  # the old dropped-reference spawn died here
+            await asyncio.sleep(0.1)
+            if scans:
+                break
+        else:
+            raise AssertionError("dirty-scan never ran under gc "
+                                 "pressure")
+        locations.close()
+        await node.close()
+    _run(main())
+
+
+@pytest.mark.skipif(not os.path.exists("/proc"), reason="linux only")
+@pytest.mark.skipif(not _has_cryptography(),
+                    reason="cryptography missing (environmental)")
+def test_watcher_dirty_scan_survives_forced_gc(tmp_path, monkeypatch):
+    """End-to-end regression for locations/watcher.py:375: the dirty-
+    scan task spawned by a watch event used the deprecated
+    `asyncio.get_event_loop().create_task(scan())` and dropped the
+    reference — GC was free to destroy the scan mid-flight. Routed
+    through the supervisor, the scan must index the new file while
+    gc.collect() hammers every poll tick."""
+    monkeypatch.setenv("SDTPU_WATCHER", "poll")
+    from spacedrive_tpu.locations.manager import create_location
+    from spacedrive_tpu.locations.watcher import Locations, PollingWatcher
+    from spacedrive_tpu.node import Node
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "seed.txt").write_bytes(b"seed")
+    node = Node(str(tmp_path / "data"))
+    lib = node.create_library("t")
+
+    async def main():
+        from spacedrive_tpu.locations.indexer_job import IndexerJob
+
+        sid = create_location(lib, str(src))
+        j = await node.jobs.ingest(lib, IndexerJob(location_id=sid))
+        await node.jobs.wait(j)
+        locations = Locations(node, backend="numpy")
+        assert locations.watch_location(lib, sid)
+        assert isinstance(locations.watchers[(lib.id, sid)],
+                          PollingWatcher)
+        with open(src / "ghost.bin", "wb") as f:
+            f.write(b"gc-bait" * 64)
+        for _ in range(120):
+            gc.collect()  # the old dropped-reference spawn died here
+            await asyncio.sleep(0.1)
+            row = lib.db.query_one(
+                "SELECT object_id FROM file_path WHERE name='ghost'")
+            if row is not None and row["object_id"] is not None:
+                break
+        else:
+            raise AssertionError(
+                "dirty-scan never indexed the new file under gc "
+                "pressure")
+        locations.close()
+        await node.close()
+    _run(main())

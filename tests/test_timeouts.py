@@ -1,0 +1,113 @@
+"""Central timeout registry (spacedrive_tpu/timeouts.py): budgets,
+the SDTPU_TIMEOUT_SCALE multiplier, the fired-budget counter, and the
+3.10 deadline() cancel-scope."""
+
+import asyncio
+
+import pytest
+
+from spacedrive_tpu import timeouts
+from spacedrive_tpu.telemetry import TIMEOUTS_FIRED
+from spacedrive_tpu.timeouts import (
+    TIMEOUTS,
+    budget,
+    deadline,
+    declare_timeout,
+    timeout_table_markdown,
+    with_timeout,
+)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_budget_reads_declared_default():
+    assert budget("p2p.handshake") == TIMEOUTS["p2p.handshake"].default_s
+
+
+def test_budget_scales_with_flag(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "2.5")
+    assert budget("p2p.handshake") == \
+        TIMEOUTS["p2p.handshake"].default_s * 2.5
+
+
+def test_undeclared_budget_is_a_programming_error():
+    with pytest.raises(KeyError):
+        budget("no.such.budget")
+
+
+def test_double_declaration_rejected():
+    with pytest.raises(ValueError):
+        declare_timeout("p2p.handshake", 1.0, "dupe")
+    with pytest.raises(ValueError):
+        declare_timeout("x.nonpositive", 0.0, "bad")
+
+
+def test_with_timeout_passes_results_through():
+    async def main():
+        async def value():
+            return 41 + 1
+
+        return await with_timeout("p2p.ping", value())
+    assert _run(main()) == 42
+
+
+def test_with_timeout_fires_and_counts(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    before = TIMEOUTS_FIRED.labels(name="p2p.ping").value
+
+    async def main():
+        with pytest.raises(asyncio.TimeoutError):
+            await with_timeout("p2p.ping", asyncio.sleep(30))
+    _run(main())
+    assert TIMEOUTS_FIRED.labels(name="p2p.ping").value == before + 1
+
+
+def test_deadline_covers_a_block_and_fires(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    before = TIMEOUTS_FIRED.labels(name="p2p.pair").value
+
+    async def main():
+        with pytest.raises(asyncio.TimeoutError):
+            async with deadline("p2p.pair"):
+                await asyncio.sleep(30)
+    _run(main())
+    assert TIMEOUTS_FIRED.labels(name="p2p.pair").value == before + 1
+
+
+def test_deadline_noop_when_block_is_fast():
+    async def main():
+        async with deadline("p2p.pair"):
+            await asyncio.sleep(0)
+        return True
+    assert _run(main())
+
+
+def test_deadline_does_not_eat_external_cancellation():
+    """A cancel that is NOT the deadline's own must propagate as
+    CancelledError, not mutate into TimeoutError."""
+    async def main():
+        async def victim():
+            async with deadline("p2p.pair"):
+                await asyncio.sleep(30)
+
+        t = asyncio.ensure_future(victim())
+        await asyncio.sleep(0.05)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+    _run(main())
+
+
+def test_spacedrop_verdict_brackets_decide_window():
+    """Documented ordering invariant: the sender's verdict wait must
+    exceed the receiver's interactive decide window, or legitimate
+    accepts race the sender's timeout."""
+    assert budget("p2p.spacedrop.verdict") > budget("p2p.spacedrop.decide")
+
+
+def test_timeout_table_lists_every_budget():
+    table = timeout_table_markdown()
+    for name in timeouts.TIMEOUTS:
+        assert f"`{name}`" in table

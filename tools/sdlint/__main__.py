@@ -7,6 +7,8 @@
     python -m tools.sdlint --update-baseline   # prune stale entries only
     python -m tools.sdlint --write-baseline    # bootstrap (see policy!)
     python -m tools.sdlint --flag-table        # README flag table stdout
+    python -m tools.sdlint --timeout-table     # README timeout table
+    python -m tools.sdlint --stats             # per-pass counts + wall-time
 
 Exit status: 0 when every finding is baselined (or none), 1 otherwise.
 The baseline may only shrink — see tools/sdlint/baseline.py.
@@ -21,6 +23,29 @@ import sys
 from .baseline import DEFAULT_PATH, Baseline
 from .core import load_project, repo_root, run_passes
 from .passes import get_passes
+
+
+def stats(root=None):
+    """[(pass_name, finding_count, seconds)] over the whole tree,
+    with 'index' (project load) and 'total' rows — the `--stats` view,
+    and the hook tests/test_sdlint.py pins the <30s analyzer budget
+    on so pass growth can't silently blow up tier-1."""
+    import time
+
+    from .passes import all_passes
+
+    root = root or repo_root()
+    out = []
+    t0 = time.perf_counter()
+    project = load_project(root)
+    out.append(("index", len(project.files), time.perf_counter() - t0))
+    for p in all_passes():
+        t1 = time.perf_counter()
+        found = run_passes(project, [p])
+        out.append((p.name, len(found), time.perf_counter() - t1))
+    out.append(("total", sum(c for n, c, _ in out if n != "index"),
+                time.perf_counter() - t0))
+    return out
 
 
 def main(argv=None) -> int:
@@ -46,6 +71,12 @@ def main(argv=None) -> int:
                          "baseline (policy: one-time, review-visible)")
     ap.add_argument("--flag-table", action="store_true",
                     help="print the generated README flag table and exit")
+    ap.add_argument("--timeout-table", action="store_true",
+                    help="print the generated README timeout table "
+                         "and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-pass finding counts and wall-time "
+                         "(informational; exit 0)")
     args = ap.parse_args(argv)
 
     if args.no_baseline and (args.update_baseline or args.write_baseline):
@@ -57,6 +88,17 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import flags
         print(flags.flag_table_markdown())
+        return 0
+
+    if args.timeout_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import timeouts
+        print(timeouts.timeout_table_markdown())
+        return 0
+
+    if args.stats:
+        for name, count, secs in stats(args.root):
+            print(f"{name:22s} {count:4d} finding(s) {secs:7.2f}s")
         return 0
 
     if args.passes == "?list":

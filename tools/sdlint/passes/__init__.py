@@ -12,12 +12,17 @@ from .telemetry import TelemetryPass
 from .jit_stability import JitStabilityPass
 from .dtype_discipline import DtypeDisciplinePass
 from .host_transfer import HostTransferPass
+from .task_lifecycle import TaskLifecyclePass
+from .cancellation_safety import CancellationSafetyPass
+from .timeout_discipline import TimeoutDisciplinePass
 
 PASSES = {
     p.name: p for p in (
         BlockingAsyncPass(), LockDisciplinePass(), CrdtParityPass(),
         FlagRegistryPass(), TelemetryPass(), JitStabilityPass(),
         DtypeDisciplinePass(), HostTransferPass(),
+        TaskLifecyclePass(), CancellationSafetyPass(),
+        TimeoutDisciplinePass(),
     )
 }
 

@@ -1,0 +1,193 @@
+"""Pass: cancellation-safety — cancellation must flow, never vanish.
+
+Structured shutdown (tasks.reap, Node.shutdown) works by CANCELLING
+tasks and awaiting them; anything that swallows `CancelledError` turns
+a bounded shutdown into a hang (or an orphaned task the supervisor
+then reports). Four shapes, each observed in this tree before the
+pass landed:
+
+- ``swallow-cancel`` — a handler that catches `CancelledError`
+  *by accident* — bare ``except:``, ``except BaseException``, or the
+  conflated ``except (asyncio.CancelledError, Exception)`` — around
+  an awaiting try-body, without re-raising. A LONE
+  ``except asyncio.CancelledError`` is the legitimate reap idiom and
+  passes (better: `tasks.cancel_and_gather`, which also keeps the
+  caller's own cancellation alive).
+- ``await-in-finally`` — an `await` in a ``finally:`` block that is
+  not wrapped in `asyncio.shield` / `asyncio.wait_for` /
+  `with_timeout`: when the block runs because the task is being
+  cancelled, that await is the task's cleanup budget — unshielded and
+  unbounded, it either dies mid-cleanup on the next cancel or hangs
+  shutdown forever.
+- ``no-cancel-point`` — a ``while True:`` in an `async def` whose body
+  contains no await (and no break/return): `task.cancel()` can never
+  be delivered; the reap declares it an orphan every time.
+- ``dropped-exception-callback`` — `add_done_callback` with a
+  container method (`set.discard` & co.) or a lambda that ignores its
+  task argument: the task's exception is never retrieved, surfacing
+  (if ever) as an interpreter-exit log line. The supervisor's
+  done-callback is the fix (`tasks.spawn` observes every outcome).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "cancellation-safety"
+
+_CONTAINER_CALLBACKS = {"discard", "remove", "append", "add", "pop",
+                        "clear"}
+_FINALLY_WRAPPERS = {"shield", "wait_for", "with_timeout"}
+
+
+def _subtree_skip_defs(node: ast.AST):
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _stmts_walk(stmts):
+    for s in stmts:
+        yield s
+        yield from _subtree_skip_defs(s)
+
+
+def _handler_shape(handler: ast.ExceptHandler) -> Optional[str]:
+    """The flaggable catch shape, or None if the handler cannot
+    swallow a cancellation by accident."""
+    t = handler.type
+    if t is None:
+        return "bare"
+    def last(n):
+        d = dotted(n)
+        return d.rsplit(".", 1)[-1] if d else ""
+    if last(t) == "BaseException":
+        return "BaseException"
+    if isinstance(t, ast.Tuple):
+        lasts = {last(el) for el in t.elts}
+        if "BaseException" in lasts:
+            return "BaseException"
+        if "CancelledError" in lasts and len(lasts) > 1:
+            # the conflated reap idiom: CancelledError lumped with
+            # Exception (or anything else) in one silencing handler
+            return "+".join(sorted(lasts))
+    return None
+
+
+def _has_raise(stmts) -> bool:
+    return any(isinstance(n, ast.Raise) for n in _stmts_walk(stmts))
+
+
+def _has_await(stmts) -> bool:
+    return any(isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+               for n in _stmts_walk(stmts))
+
+
+class CancellationSafetyPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            if fn.is_async:
+                self._check_async(fn, rel, emit)
+            for node in own_body_walk(fn.node):
+                if isinstance(node, ast.Call):
+                    self._check_callback(node, rel, fn.qual, emit)
+        return findings
+
+    def _check_async(self, fn, rel: str, emit) -> None:
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Try):
+                body_awaits = _has_await(node.body)
+                for handler in node.handlers:
+                    shape = _handler_shape(handler)
+                    if shape and body_awaits and \
+                            not _has_raise(handler.body):
+                        emit(Finding(
+                            PASS, "swallow-cancel", rel, fn.qual,
+                            f"except:{shape}",
+                            f"`except {shape}` around an awaiting body "
+                            "swallows CancelledError — catch "
+                            "CancelledError alone (reap idiom / "
+                            "tasks.cancel_and_gather) or re-raise",
+                            handler.lineno))
+                for sub in _stmts_walk(node.finalbody):
+                    if not isinstance(sub, ast.Await):
+                        continue
+                    v = sub.value
+                    wrapped = (isinstance(v, ast.Call) and
+                               (dotted(v.func) or "").rsplit(".", 1)[-1]
+                               in _FINALLY_WRAPPERS)
+                    if not wrapped:
+                        ident = (dotted(v.func) or "await"
+                                 ) if isinstance(v, ast.Call) else "await"
+                        emit(Finding(
+                            PASS, "await-in-finally", rel, fn.qual,
+                            f"finally:{ident}",
+                            "unshielded await in `finally`: during "
+                            "cancellation this is unbounded cleanup — "
+                            "wrap in asyncio.shield (or a timeout)",
+                            sub.lineno))
+            if isinstance(node, ast.While) and \
+                    isinstance(node.test, ast.Constant) and node.test.value:
+                body = list(_stmts_walk(node.body))
+                has_point = any(isinstance(
+                    n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+                    for n in body)
+                has_exit = any(isinstance(n, (ast.Break, ast.Return))
+                               for n in body)
+                if not has_point and not has_exit:
+                    emit(Finding(
+                        PASS, "no-cancel-point", rel, fn.qual,
+                        "while-true",
+                        "`while True` with no await/break in an async "
+                        "function: cancellation can never be "
+                        "delivered — add an await (e.g. sleep(0))",
+                        node.lineno))
+
+    def _check_callback(self, call: ast.Call, rel: str, qual: str,
+                        emit) -> None:
+        d = dotted(call.func)
+        if d is None or d.rsplit(".", 1)[-1] != "add_done_callback":
+            return
+        if not call.args:
+            return
+        cb = call.args[0]
+        if isinstance(cb, ast.Attribute) and \
+                cb.attr in _CONTAINER_CALLBACKS:
+            emit(Finding(
+                PASS, "dropped-exception-callback", rel, qual,
+                dotted(cb) or cb.attr,
+                f"done-callback `{dotted(cb) or cb.attr}` drops the "
+                "task outcome: a failed task's exception is never "
+                "retrieved — use tasks.spawn (supervised) or a "
+                "callback that checks task.exception()",
+                call.lineno))
+        elif isinstance(cb, ast.Lambda) and cb.args.args:
+            param = cb.args.args[0].arg
+            used = any(isinstance(n, ast.Name) and n.id == param
+                       for n in ast.walk(cb.body))
+            if not used:
+                emit(Finding(
+                    PASS, "dropped-exception-callback", rel, qual,
+                    f"lambda:{param}-unused",
+                    "done-callback lambda ignores its task argument: "
+                    "the task outcome (and any exception) is dropped",
+                    call.lineno))

@@ -1,0 +1,190 @@
+"""Pass: task-lifecycle — every background task must have an owner.
+
+`asyncio` gives spawned tasks NO structure: `create_task` returns a
+reference the event loop only holds weakly, so a task nobody stores
+can be garbage-collected (and with it, silently cancelled) mid-flight
+— the `locations/watcher.py` dirty-scan bug this pass encodes. The
+discipline is structured concurrency (`spacedrive_tpu/tasks.py`):
+either the result is stored on an owner (and awaited/cancelled at its
+lifecycle edge) or the spawn goes through the supervisor's
+`tasks.spawn(name, coro, owner=...)`, which keeps a strong reference,
+observes the outcome, and is reaped at `Node.shutdown()`.
+
+Rules:
+
+- ``dropped-task`` — a `create_task` / `ensure_future` whose result is
+  discarded (the call IS an expression statement). A supervisor
+  `spawn(...)` is exempt: the registry holds the reference.
+- ``deprecated-get-event-loop`` — any `asyncio.get_event_loop()` call:
+  inside a running loop it aliases `get_running_loop()` (use that);
+  outside one it silently CREATES a loop the caller never runs —
+  both shapes hid the watcher bug.
+- ``spawn-in-loop`` — a spawn (including supervisor `spawn`) inside a
+  `for`/`while` body whose task is never awaited in the function
+  (directly or via `asyncio.wait`/`gather` on the stored name): an
+  unbounded task storm with no backpressure. The jobs worker's
+  step/command pair passes because both land in `asyncio.wait`.
+
+The supervisor module itself (`spacedrive_tpu/tasks.py`) is exempt —
+it is the one legitimate home of a raw `create_task`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "task-lifecycle"
+
+_SPAWN_LAST = {"create_task", "ensure_future"}
+_SUPERVISOR_LAST = {"spawn"}
+SUPERVISOR_PATH = "spacedrive_tpu/tasks.py"
+
+
+def _spawn_kind(call: ast.Call) -> str:
+    """'raw' | 'supervised' | '' for a call node. Dynamic receivers
+    (`asyncio.get_event_loop().create_task(...)` — a call-chained
+    receiver `dotted()` cannot name) still classify by the terminal
+    attribute: that chain was exactly the watcher.py dropped-task bug."""
+    f = call.func
+    d = dotted(f)
+    last = d.rsplit(".", 1)[-1] if d else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    if last in _SPAWN_LAST:
+        return "raw"
+    if last in _SUPERVISOR_LAST:
+        return "supervised"
+    return ""
+
+
+def _spawn_ident(call: ast.Call) -> str:
+    d = dotted(call.func)
+    if d:
+        return d
+    if isinstance(call.func, ast.Attribute):
+        return f"<dynamic>.{call.func.attr}"
+    return "<spawn>"
+
+
+def _subtree_skip_defs(node: ast.AST):
+    """Walk a subtree, not descending into nested function bodies
+    (their code runs at another time)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class TaskLifecyclePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            if fn.src.relpath == SUPERVISOR_PATH:
+                continue
+            self._check_fn(fn, emit)
+        # Module level: get_event_loop / dropped spawns outside any def.
+        for src in project.files:
+            if src.relpath == SUPERVISOR_PATH:
+                continue
+            for node in _subtree_skip_defs(src.tree):
+                if isinstance(node, ast.Call) and \
+                        dotted(node.func) == "asyncio.get_event_loop":
+                    emit(Finding(
+                        PASS, "deprecated-get-event-loop", src.relpath,
+                        "", "asyncio.get_event_loop",
+                        "asyncio.get_event_loop() is deprecated: use "
+                        "get_running_loop() (or tasks.spawn)",
+                        node.lineno))
+                if isinstance(node, ast.Expr) and \
+                        isinstance(node.value, ast.Call) and \
+                        _spawn_kind(node.value) == "raw":
+                    d = _spawn_ident(node.value)
+                    emit(Finding(
+                        PASS, "dropped-task", src.relpath, "", d,
+                        f"`{d}` result discarded: the loop holds tasks "
+                        "weakly — store it on an owner or use "
+                        "tasks.spawn",
+                        node.lineno))
+        return findings
+
+    def _check_fn(self, fn, emit) -> None:
+        rel = fn.src.relpath
+        # Names awaited anywhere in the function (directly, or inside
+        # an `await asyncio.wait({...})` / gather expression).
+        awaited_names: Set[str] = set()
+        # id(call) → assigned target names, for spawn calls.
+        assigned: Dict[int, Set[str]] = {}
+        dropped_ids: Set[int] = set()
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Await):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        awaited_names.add(sub.id)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _spawn_kind(node.value):
+                names = set()
+                for tgt in node.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            names.add(sub.id)
+                        elif isinstance(sub, ast.Attribute):
+                            names.add(sub.attr)
+                assigned[id(node.value)] = names
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                kind = _spawn_kind(node.value)
+                if kind == "raw":
+                    dropped_ids.add(id(node.value))
+                    d = _spawn_ident(node.value)
+                    emit(Finding(
+                        PASS, "dropped-task", rel, fn.qual, d,
+                        f"`{d}` result discarded: the loop holds tasks "
+                        "weakly (GC may cancel it mid-flight) — store "
+                        "it on an owner or use tasks.spawn",
+                        node.value.lineno))
+            if isinstance(node, ast.Call) and \
+                    dotted(node.func) == "asyncio.get_event_loop":
+                emit(Finding(
+                    PASS, "deprecated-get-event-loop", rel, fn.qual,
+                    "asyncio.get_event_loop",
+                    "asyncio.get_event_loop() is deprecated: use "
+                    "get_running_loop() (or pass the loop / use "
+                    "tasks.spawn)",
+                    node.lineno))
+        # Spawns inside loops: unbounded unless the stored task is
+        # awaited somewhere in this function.
+        for loop_node in own_body_walk(fn.node):
+            if not isinstance(loop_node, (ast.For, ast.While,
+                                          ast.AsyncFor)):
+                continue
+            for node in _subtree_skip_defs(loop_node):
+                if not (isinstance(node, ast.Call) and _spawn_kind(node)):
+                    continue
+                if id(node) in dropped_ids:
+                    continue  # already reported as dropped-task
+                names = assigned.get(id(node), set())
+                if names and names & awaited_names:
+                    continue  # bounded: the task is awaited
+                d = _spawn_ident(node)
+                emit(Finding(
+                    PASS, "spawn-in-loop", rel, fn.qual, f"loop:{d}",
+                    f"`{d}` inside a loop with no await on the spawned "
+                    "task: an unbounded task storm — await it (or a "
+                    "window of them) inside the loop",
+                    node.lineno))

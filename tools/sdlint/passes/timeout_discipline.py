@@ -1,0 +1,207 @@
+"""Pass: timeout-discipline — no unbounded network awaits.
+
+A peer that stops talking must cost a BUDGET, not a hung coroutine:
+before this pass the spacedrop verdict wait was the only network await
+in the tree with any timeout — a stalled clone ack, a silent dialer,
+or a dead websocket subscriber parked its coroutine forever (and, at
+shutdown, became a supervisor orphan). The discipline mirrors the
+PR 5 jit-contract registry: every timeout is DECLARED by name in
+`spacedrive_tpu/timeouts.py` (defaults scaled by SDTPU_TIMEOUT_SCALE,
+README table generated from the registry) and applied with
+`await with_timeout("name", <net await>)` or a block-scoped
+``async with deadline("name"):``.
+
+Scope: modules under `spacedrive_tpu/{p2p,api,sync}/` — the layers
+that talk to sockets/tunnels/websockets — plus any file carrying an
+``# sdlint-scope: net`` marker in its head (how fixtures opt in).
+
+Network roots (the awaits that must be budgeted):
+
+- frame/stream primitives by name: `readexactly`, `readuntil`,
+  `read_frame`, `read_msg`, `open_connection` (`tunnel_handshake`
+  budgets itself — see proto.py);
+- `recv`/`recv_raw`/`send`/`send_raw`/`drain` — bare, or on a
+  receiver that names the wire (`tunnel`, `ws`, `reader`, `writer`,
+  `resp`, `sock`, `stream`);
+- websocket/HTTP streaming methods on `ws`/`resp`/`request`
+  receivers: `send_json`, `send_str`, `prepare`, `receive`, `write`,
+  `write_eof`, `json`, `text`.
+
+`async for` over a websocket is NOT a root by design: a server's
+client-read loop is legitimately idle-forever (the client owns that
+cadence; slow-request bounds live in api.http.read/write). Transport
+primitives (`proto.py` internals) carry explicit suppression markers:
+their budget lives at the call site, which this pass enforces.
+
+Codes: ``no-timeout`` (root await with no budget), ``unnamed-timeout``
+(raw `asyncio.wait_for` around a root — literals drifted once
+already; use the registry), ``undeclared-timeout`` (a `with_timeout`/
+`deadline` name missing from the registry), ``dynamic-timeout-name``
+(non-literal name: the table must be static).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "timeout-discipline"
+
+SCOPE_PREFIXES = ("spacedrive_tpu/p2p/", "spacedrive_tpu/api/",
+                  "spacedrive_tpu/sync/")
+SCOPE_MARKER = "# sdlint-scope: net"
+CENTRAL = "spacedrive_tpu/timeouts.py"
+
+# `tunnel_handshake` is NOT a root: it owns its own `p2p.handshake`
+# deadline internally (proto.py), so callers need no second budget.
+_NAMED_ROOTS = {"readexactly", "readuntil", "read_frame", "read_msg",
+                "open_connection"}
+_WIRE_METHODS = {"recv", "recv_raw", "send", "send_raw", "drain"}
+_WIRE_RECEIVERS = {"tunnel", "ws", "reader", "writer", "resp", "sock",
+                   "stream"}
+_HTTP_METHODS = {"send_json", "send_str", "prepare", "receive",
+                 "write", "write_eof", "json", "text"}
+_HTTP_RECEIVERS = {"ws", "resp", "request"}
+
+
+def declared_timeouts(root: str) -> Dict[str, float]:
+    """Budgets from `declare_timeout(...)` calls in the central
+    registry (AST — the linted tree is never imported)."""
+    out: Dict[str, float] = {}
+    path = os.path.join(root, CENTRAL)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) == "declare_timeout" and node.args):
+            continue
+        name = node.args[0]
+        if isinstance(name, ast.Constant) and isinstance(name.value, str):
+            default = 0.0
+            if len(node.args) > 1 and \
+                    isinstance(node.args[1], ast.Constant):
+                default = float(node.args[1].value)
+            out[name.value] = default
+    return out
+
+
+def classify_root(call: ast.Call) -> str:
+    """Stable ident of the network root this call is, else ''."""
+    d = dotted(call.func)
+    if d is None:
+        return ""
+    parts = d.split(".")
+    last = parts[-1]
+    recv = [p.lower() for p in parts[:-1] if p not in ("self", "cls")]
+    if last in _NAMED_ROOTS:
+        return d
+    if last in _WIRE_METHODS and (
+            not recv or any(r in _WIRE_RECEIVERS for r in recv)):
+        return d
+    if last in _HTTP_METHODS and any(
+            r in _HTTP_RECEIVERS for r in recv):
+        return d
+    return ""
+
+
+def _last(call_or_name) -> str:
+    d = dotted(call_or_name.func) if isinstance(call_or_name, ast.Call) \
+        else dotted(call_or_name)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+class TimeoutDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_timeouts(project.root)
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            if rel == CENTRAL:
+                continue  # the registry's own wait_for IS the wrapper
+            head = "\n".join(fn.src.lines[:5])
+            if not (rel.startswith(SCOPE_PREFIXES)
+                    or SCOPE_MARKER in head):
+                continue
+            self._check_fn(fn, rel, declared, emit)
+        return findings
+
+    def _check_fn(self, fn, rel: str, declared: Dict[str, float],
+                  emit) -> None:
+        # Node ids covered by an `async with deadline("name"):` block.
+        covered: Set[int] = set()
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.AsyncWith):
+                continue
+            for item in node.items:
+                cm = item.context_expr
+                if not (isinstance(cm, ast.Call)
+                        and _last(cm) == "deadline"):
+                    continue
+                self._check_name(cm, rel, fn.qual, declared, emit)
+                for stmt in node.body:
+                    covered.add(id(stmt))
+                    for sub in ast.walk(stmt):
+                        covered.add(id(sub))
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Await):
+                continue
+            v = node.value
+            if not isinstance(v, ast.Call):
+                continue
+            last = _last(v)
+            if last == "with_timeout":
+                self._check_name(v, rel, fn.qual, declared, emit)
+                continue
+            if last == "wait_for":
+                inner = v.args[0] if v.args else None
+                if isinstance(inner, ast.Call) and classify_root(inner):
+                    emit(Finding(
+                        PASS, "unnamed-timeout", rel, fn.qual,
+                        f"wait_for:{classify_root(inner)}",
+                        f"raw asyncio.wait_for around "
+                        f"`{classify_root(inner)}`: budgets live in "
+                        "the timeouts.py registry — use "
+                        "with_timeout(\"<name>\", ...)",
+                        node.lineno))
+                continue
+            root = classify_root(v)
+            if root and id(node) not in covered:
+                emit(Finding(
+                    PASS, "no-timeout", rel, fn.qual, root,
+                    f"unbounded network await `{root}`: wrap in "
+                    "with_timeout(\"<name>\", ...) or a "
+                    "deadline(\"<name>\") block (timeouts.py)",
+                    node.lineno))
+
+    def _check_name(self, call: ast.Call, rel: str, qual: str,
+                    declared: Dict[str, float], emit) -> None:
+        arg = call.args[0] if call.args else None
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            emit(Finding(
+                PASS, "dynamic-timeout-name", rel, qual,
+                "non-literal",
+                "timeout name must be a string literal so the budget "
+                "table stays static",
+                call.lineno))
+            return
+        if arg.value not in declared:
+            emit(Finding(
+                PASS, "undeclared-timeout", rel, qual, arg.value,
+                f"timeout {arg.value!r} is not declared in "
+                "spacedrive_tpu/timeouts.py",
+                call.lineno))
