@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .. import tasks, telemetry
+from .. import channels, tasks, telemetry
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
 from ..telemetry import API_REQUESTS
@@ -36,6 +36,46 @@ from ..timeouts import with_timeout
 from .router import Router, RpcError, mount_router
 
 RANGE_CHUNK = 1 << 20
+
+
+class WsSubscriptionPump:
+    """One subscription's bounded delivery path: events land in a
+    registered `api.ws` channel and ONE supervised drainer task sends
+    them under the api.ws.send budget. This is the EventBus's buffered
+    edge — in-process subscribers stay synchronous callbacks (cheap
+    filters), but delivery to a REMOTE subscriber is where unbounded
+    buffering lived: the old shape spawned one emit task per event, so
+    a stalled consumer accumulated the node's whole event stream (every
+    task parked on its 30s send budget). Now depth is capped by the
+    channel contract: TelemetrySnapshot frames coalesce to the newest
+    snapshot, and overflow sheds NEW events into
+    sd_chan_shed_total{api.ws} — a slow consumer loses events (it was
+    going to time out anyway), never wedges the node or its memory."""
+
+    def __init__(self, send, owner: str):
+        self._send = send
+        self.chan = channels.channel("api.ws")
+        self._task = tasks.spawn("ws-pump", self._drain(), owner=owner)
+
+    def offer(self, payload: dict) -> bool:
+        """Queue one event frame (loop thread). Returns False when the
+        overflow policy shed it."""
+        data = payload.get("data")
+        key = None
+        if isinstance(data, dict) and \
+                data.get("type") == "TelemetrySnapshot":
+            # Snapshot-coalescing: only the newest snapshot matters to
+            # a consumer that fell behind.
+            key = "TelemetrySnapshot"
+        return self.chan.put_nowait(payload, key=key)
+
+    async def _drain(self) -> None:
+        while True:
+            payload = await self.chan.get()
+            await self._send(payload)
+
+    async def stop(self) -> None:
+        await tasks.cancel_and_gather(self._task)
 
 
 @web.middleware
@@ -212,17 +252,26 @@ class ApiServer:
                     await with_timeout("api.ws.send", ws.send_json(
                         {"id": mid, "type": "response", "result": result}))
                 elif mtype == "subscription":
-                    def emit(data, _mid=mid):
-                        # Thread-safe: event bus callbacks may fire from
-                        # worker threads. Supervised spawn: the emit
-                        # task's outcome is observed and node shutdown
-                        # reaps in-flight emits.
+                    if mid in subscriptions:
+                        # Overwriting the map entry would strand the
+                        # prior unsub + pump for the server's lifetime
+                        # (nothing would ever tear them down).
+                        raise RpcError(
+                            "BAD_REQUEST",
+                            f"duplicate subscription id {mid!r}")
+                    # Bounded per-subscription delivery: events go
+                    # through the pump's registered api.ws channel and
+                    # one supervised drainer (reaped at shutdown) —
+                    # not a task per event.
+                    pump = WsSubscriptionPump(ws_emit, owner=self._owner)
+
+                    def emit(data, _mid=mid, _pump=pump):
+                        # Thread-safe: event bus callbacks may fire
+                        # from worker threads; the channel itself is
+                        # loop-thread-only.
                         loop.call_soon_threadsafe(
-                            lambda: tasks.spawn(
-                                "ws-emit",
-                                ws_emit({"id": _mid, "type": "event",
-                                         "data": data}),
-                                owner=self._owner))
+                            _pump.offer,
+                            {"id": _mid, "type": "event", "data": data})
                     try:
                         unsub = await self.router.subscribe(
                             msg["path"], msg.get("input"), emit)
@@ -230,15 +279,31 @@ class ApiServer:
                         # Same split as the dispatch branch above: a
                         # budget firing INSIDE the handler is not a
                         # send wedge — the client must hear about it.
+                        await pump.stop()
                         raise RpcError(
                             "TIMEOUT", f"upstream timeout: {e}") from e
-                    subscriptions[mid] = unsub
+                    except BaseException:
+                        # Failed subscribe (unknown path, bad input):
+                        # the pump never reaches the subscriptions map,
+                        # so reap its drainer here, then re-raise.
+                        await pump.stop()
+                        raise
+                    subscriptions[mid] = (unsub, pump)
                     await with_timeout("api.ws.send", ws.send_json(
                         {"id": mid, "type": "response", "result": None}))
                 elif mtype == "subscriptionStop":
-                    unsub = subscriptions.pop(mid, None)
+                    unsub, pump = subscriptions.pop(mid, (None, None))
                     if unsub:
-                        unsub()
+                        # Same guard as the disconnect teardown: a
+                        # raising unsub must not skip the pump reap
+                        # (the entry is already out of the map, so
+                        # nothing else would ever stop the drainer).
+                        try:
+                            unsub()
+                        except Exception:
+                            pass
+                    if pump:
+                        await pump.stop()
                 else:
                     raise RpcError("BAD_REQUEST",
                                    f"unknown frame type {mtype}")
@@ -269,11 +334,30 @@ class ApiServer:
                 elif msg.type == WSMsgType.ERROR:
                     break
         finally:
-            for unsub in subscriptions.values():
+            # Cancellation-safe teardown: every unsub runs before the
+            # first await (an await inside the loop would die on the
+            # first CancelledError and strand later subscriptions'
+            # EventBus callbacks for the node's lifetime), then ALL
+            # pumps reap under one shield whose inner gather is waited
+            # out to completion even while the handler itself is being
+            # cancelled (same intent as sync_net._pull's ingester reap).
+            for unsub, _pump in subscriptions.values():
                 try:
                     unsub()
                 except Exception:
                     pass
+            if subscriptions:
+                stops = asyncio.gather(
+                    *(pump.stop() for _, pump in subscriptions.values()),
+                    return_exceptions=True)
+                cancelled = False
+                while not stops.done():
+                    try:
+                        await asyncio.shield(stops)
+                    except asyncio.CancelledError:
+                        cancelled = True
+                if cancelled:
+                    raise asyncio.CancelledError
         return ws
 
     async def _thumbnail(self, request: web.Request) -> web.Response:

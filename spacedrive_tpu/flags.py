@@ -141,6 +141,13 @@ def flag_table_markdown() -> str:
 # ---------------------------------------------------------------------------
 
 declare(
+    "SDTPU_CHAN_SCALE", 1.0, parse_float,
+    "Global multiplier over every declared channel capacity "
+    "(channels.py registry; README's generated channel table lists "
+    "the per-channel defaults). Read at channel construction, not "
+    "per put.")
+
+declare(
     "SDTPU_CLONE_PASSTHROUGH", True, parse_onoff,
     "Kill switch for the full-library-clone blob pass-through fast "
     "path (p2p/sync_net.py). `off` forces the per-op pull loop.")

@@ -220,7 +220,7 @@ class JobContext:
 # (the reference does this with a macro over its 8 job types,
 #  core/src/job/manager.rs:362-399)
 
-JOB_REGISTRY: Dict[str, Type[StatefulJob]] = {}
+JOB_REGISTRY: Dict[str, Type[StatefulJob]] = {}  # sdlint: ok[unbounded-growth] import-time job-class registry: one entry per @register_job class, not per event
 
 
 def register_job(cls: Type[StatefulJob]) -> Type[StatefulJob]:

@@ -12,10 +12,9 @@ the DB at startup, failing those without a state blob
 from __future__ import annotations
 
 import asyncio
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from .. import tasks
+from .. import channels, tasks
 from ..store import uuid_bytes as new_job_id
 from ..telemetry import (
     JOBS_DUPLICATE_REJECTED,
@@ -82,7 +81,10 @@ class JobManager:
         self.running: Dict[bytes, Worker] = {}
         self._tasks: Dict[bytes, asyncio.Task] = {}
         self._entries: Dict[bytes, _Entry] = {}
-        self.queue: deque[_Entry] = deque()
+        # Bounded admission run-queue (channels.py registry): shed_new
+        # IS the admission control — a job past capacity is refused
+        # loudly in _admit, the queue never balloons.
+        self.queue = channels.channel("jobs.manager.queue")
         self._hashes: Dict[str, bytes] = {}  # job.hash() → job id
         self._final_status: Dict[bytes, JobStatus] = {}
         self._paused: Dict[bytes, _Entry] = {}  # paused this session
@@ -132,11 +134,46 @@ class JobManager:
         self._entries[entry.report.id] = entry
         if len(self.running) < self.max_workers and not self._shutting_down:
             self._start(entry)
-        else:
-            entry.report.status = JobStatus.QUEUED
-            entry.report.update(entry.library.db)
-            self.queue.append(entry)
-            JOBS_QUEUED.set(len(self.queue))
+            return
+        if not self.queue.put_nowait(entry):
+            # Admission shed (jobs.manager.queue policy shed_new): the
+            # run-queue is at declared capacity — refuse the job loudly
+            # instead of growing without bound. Counted into
+            # sd_chan_shed_total{jobs.manager.queue}.
+            self._finalize_entry(
+                entry, JobStatus.FAILED,
+                "admission refused: jobs.manager.queue at capacity "
+                f"({self.queue.capacity})")
+            self.on_event({
+                "type": "JobError",
+                "id": entry.report.id.hex(),
+                "message": "job queue full: admission refused",
+            })
+            return
+        entry.report.status = JobStatus.QUEUED
+        entry.report.update(entry.library.db)
+        JOBS_QUEUED.set(len(self.queue))
+
+    def _finalize_entry(self, entry: _Entry, status: JobStatus,
+                        message: Optional[str] = None) -> None:
+        """Terminal bookkeeping for a job that never reached a worker
+        (admission refusal, queued/paused cancel): drop it from the
+        indexes, persist the terminal report, and sweep any spooled
+        step payloads — the worker's own cleanup path never runs for
+        these."""
+        job_id = entry.report.id
+        self._entries.pop(job_id, None)
+        h = entry.job.hash()
+        if self._hashes.get(h) == job_id:
+            del self._hashes[h]
+        self._final_status[job_id] = status
+        entry.report.status = status
+        entry.report.data = None
+        if message is not None:
+            entry.report.errors_text.append(message)
+        entry.report.update(entry.library.db)
+        entry.library.db.execute(
+            "DELETE FROM job_scratch WHERE job_id = ?", (job_id,))
 
     def _start(self, entry: _Entry) -> None:
         worker = Worker(
@@ -254,23 +291,12 @@ class JobManager:
         for entry in list(self.queue):
             if entry.report.id == job_id:
                 self.queue.remove(entry)
-                self._entries.pop(job_id, None)
                 break
         else:
             entry = self._paused.pop(job_id, None)
             if entry is None:
                 raise JobManagerError("no such running/queued/paused job")
-        self._hashes.pop(entry.job.hash(), None)
-        self._final_status[job_id] = JobStatus.CANCELED
-        entry.report.status = JobStatus.CANCELED
-        entry.report.data = None
-        entry.report.update(entry.library.db)
-        # A queued/paused job never reaches the worker's cancel path, so
-        # its cleanup hook never runs — sweep spooled step payloads here
-        # or a cancelled paused index leaks its scratch blobs until the
-        # job row itself is cleared (FK cascade).
-        entry.library.db.execute(
-            "DELETE FROM job_scratch WHERE job_id = ?", (job_id,))
+        self._finalize_entry(entry, JobStatus.CANCELED)
 
     def _worker(self, job_id: bytes) -> Worker:
         if job_id not in self.running:

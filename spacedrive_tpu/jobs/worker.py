@@ -20,7 +20,7 @@ import traceback
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from .. import tasks
+from .. import channels, tasks
 from ..telemetry import JOBS_EARLY_FINISH, JOBS_STEP_ERRORS, JOB_STEP_SECONDS
 from ..tracing import span as trace_span
 from .job import (
@@ -66,7 +66,10 @@ class Worker:
         self.on_event = on_event
         self.services = services or {}
         self.resume_state = resume_state
-        self.commands: asyncio.Queue = asyncio.Queue()
+        # Bounded command inbox (channels.py registry): the drain is
+        # latest-wins, so shed_oldest under a command flood preserves
+        # semantics exactly while capping depth.
+        self.commands = channels.channel("jobs.worker.commands")
         self._last_progress_emit = 0.0
         self._last_checkpoint = time.monotonic()
         self._started_at = 0.0

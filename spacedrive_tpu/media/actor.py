@@ -18,9 +18,9 @@ import asyncio
 import os
 import shutil
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from .. import tasks
+from .. import channels, tasks
 from .thumbnail import (
     THUMBNAIL_CACHE_VERSION,
     thumbnailable_extensions,
@@ -44,6 +44,13 @@ class ThumbBatch:
     ephemeral: bool = False
     done: asyncio.Event = field(default_factory=asyncio.Event)
     generated: int = 0
+    # Completion shares: one for this batch's own processing (if it
+    # kept any entries) plus one per delegate batch that absorbed
+    # coalesced entries. `done` fires only when every share lands, so
+    # awaiting a batch always means every requested path was
+    # processed or shed — never silently skipped.
+    _outstanding: int = 0
+    _dependents: List["ThumbBatch"] = field(default_factory=list)
 
 
 class Thumbnailer:
@@ -52,7 +59,18 @@ class Thumbnailer:
     def __init__(self, node):
         self.node = node
         self.data_dir = node.data_dir
-        self.queue: asyncio.Queue = asyncio.Queue()
+        # Bounded batch queue (channels.py registry, policy
+        # shed_oldest): during a full-library scan a slow thumbnailer
+        # used to absorb the whole index into this queue — now the
+        # oldest batch is shed (thumbnails are regenerable; its
+        # awaiters are released via done) and depth stays capped.
+        self.queue = channels.channel("media.thumbs",
+                                      on_evict=self._shed_batch)
+        # (cas_id, path) → the pending/processing batch that will
+        # generate it: duplicate requests coalesce into that batch
+        # instead of queueing the same thumbnail twice (a rescan
+        # mid-generation re-dispatches the same paths).
+        self._queued: Dict[Tuple[str, str], ThumbBatch] = {}
         self._owner = f"{getattr(node, 'task_owner', 'proc')}/media"
         self._task: Optional[asyncio.Task] = None
         self._cleanup_task: Optional[asyncio.Task] = None
@@ -94,13 +112,75 @@ class Thumbnailer:
     async def new_batch(self, entries: List[tuple],
                         library_id=None) -> ThumbBatch:
         batch = ThumbBatch(entries=list(entries), library_id=library_id)
-        await self.queue.put(batch)
-        return batch
+        return await self._enqueue(batch)
 
     async def new_ephemeral_batch(self, entries: List[tuple]) -> ThumbBatch:
         batch = ThumbBatch(entries=list(entries), ephemeral=True)
+        return await self._enqueue(batch)
+
+    async def _enqueue(self, batch: ThumbBatch) -> ThumbBatch:
+        """Per-path coalescing + bounded put. Entries already pending
+        in another batch are dropped from this one — that batch will
+        generate them — but this batch's `done` then also waits for
+        those delegates (processed or shed), so a caller's await
+        never returns while its thumbnails are still someone else's
+        pending work."""
+        fresh: List[tuple] = []
+        delegate_ids: set = set()
+        delegates: List[ThumbBatch] = []
+        for entry in batch.entries:
+            owner = self._queued.get(entry)
+            if owner is None:
+                fresh.append(entry)
+            elif id(owner) not in delegate_ids:
+                delegate_ids.add(id(owner))
+                delegates.append(owner)
+        batch.entries = fresh
+        batch._outstanding = 1 if fresh else 0
+        for d in delegates:
+            if not d.done.is_set():
+                batch._outstanding += 1
+                # waiter registration, not a buffer: one entry per
+                # live caller-owned batch, drained when the delegate
+                # completes — the same shape as a channel's parked
+                # getter futures
+                d._dependents.append(batch)  # sdlint: ok[backpressure]
+        if not fresh:
+            if batch._outstanding == 0:
+                batch.done.set()
+            return batch
+        for key in fresh:
+            self._queued[key] = batch
+        # shed_oldest policy: put never blocks; under overflow the
+        # OLDEST batch is evicted through _shed_batch below.
         await self.queue.put(batch)
         return batch
+
+    def _part_done(self, batch: ThumbBatch) -> None:
+        """One completion share landed (own processing, a shed, or a
+        delegate finishing). The last share fires `done` and cascades
+        to dependents. Dependency edges only point at OLDER batches,
+        so the cascade is acyclic and cannot hang."""
+        batch._outstanding -= 1
+        if batch._outstanding > 0 or batch.done.is_set():
+            return
+        batch.done.set()
+        deps, batch._dependents = batch._dependents, []
+        for dep in deps:
+            self._part_done(dep)
+
+    def _shed_batch(self, batch: ThumbBatch) -> None:
+        # Overflow eviction (counted in sd_chan_shed_total
+        # {media.thumbs}): release the batch's awaiters and forget its
+        # paths so a later rescan can re-request them. Thumbnails are
+        # regenerable state — shedding loses work, never correctness.
+        self._forget(batch)
+        self._part_done(batch)
+
+    def _forget(self, batch: ThumbBatch) -> None:
+        for key in batch.entries:
+            if self._queued.get(key) is batch:
+                del self._queued[key]
 
     def remove_cas_ids(self, cas_ids) -> int:
         return remove_thumbnails_by_cas_ids(self.data_dir, cas_ids)
@@ -123,7 +203,8 @@ class Thumbnailer:
                 self.node.events.emit({
                     "type": "ThumbnailerError", "error": str(e)})
             finally:
-                batch.done.set()
+                self._forget(batch)
+                self._part_done(batch)
 
     async def _process(self, batch: ThumbBatch) -> None:
         sem = asyncio.Semaphore(BATCH_CONCURRENCY)

@@ -26,7 +26,20 @@ NODE_CONFIG_NAME = "node_state.sdconfig"
 
 
 class EventBus:
-    """CoreEvent fan-out: JobProgress / JobUpdate / InvalidateOperation."""
+    """CoreEvent fan-out: JobProgress / JobUpdate / InvalidateOperation.
+
+    Delivery discipline (round 12): in-process subscribers are
+    SYNCHRONOUS callbacks on purpose — every registered callback is a
+    cheap filter (the api procedures' on_event closures), so the emit
+    loop holds no buffer at all and cannot grow one. The moment
+    delivery crosses to a consumer that can stall — every websocket
+    subscription — it goes through a bounded registry channel instead
+    (api/server.py WsSubscriptionPump, channels.py `api.ws`):
+    per-subscriber depth capped, TelemetrySnapshot frames coalesced to
+    the newest, slow consumers shed into sd_chan_shed_total{api.ws}.
+    A callback that does heavy work inline would show up as a
+    loop_stall sanitizer violation, which is the enforcement half of
+    this contract."""
 
     def __init__(self):
         self._subs: List[Callable[[dict], None]] = []

@@ -28,6 +28,7 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
+from .. import channels
 from ..telemetry import (
     P2P_TUNNEL_BYTES_RECV,
     P2P_TUNNEL_BYTES_SENT,
@@ -85,6 +86,13 @@ class Tunnel:
         self._recv = ChaCha20Poly1305(recv_key)
         self._send_ctr = 0
         self._recv_ctr = 0
+        # Declared frame window (channels.py p2p.tunnel.frames): the
+        # send_nowait buffer lives in the transport, so this tracks
+        # its depth — a burst past the declared window without a
+        # drain is a chan_overflow sanitizer violation, which is how
+        # a wedged peer's memory cost stays bounded at the cap
+        # instead of growing with the stream.
+        self._frames = channels.window("p2p.tunnel.frames")
         P2P_TUNNELS_OPENED.inc()
 
     @staticmethod
@@ -104,6 +112,7 @@ class Tunnel:
     async def send(self, msg: Any) -> None:
         self._seal(msgpack.packb(msg, use_bin_type=True))
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
+        self._frames.note_drain()  # drain flushes queued frames too
 
     async def recv(self) -> Any:
         sealed = await read_frame(self.reader)  # sdlint: ok[timeout-discipline]
@@ -118,16 +127,24 @@ class Tunnel:
         up to its window of pages into the transport buffer and then
         awaits drain() once, instead of a per-frame drain round-trip.
         Counter-nonce ordering is unaffected: frames are sealed in call
-        order on the single writer."""
+        order on the single writer. Each queued frame counts into the
+        declared p2p.tunnel.frames window; bursting past its capacity
+        without a drain is a sanitizer violation (the cap that bounds
+        a wedged peer's memory)."""
         self._seal(msgpack.packb(msg, use_bin_type=True))
+        self._frames.note_put()
 
     async def drain(self) -> None:
-        """Flush frames queued by send_nowait to the socket."""
+        """Flush frames queued by send_nowait to the socket. The
+        budget lives at the call site (sync.clone.drain), which is the
+        window's drain deadline."""
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
+        self._frames.note_drain()
 
     async def send_raw(self, data: bytes) -> None:
         self._seal(data)
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
+        self._frames.note_drain()
 
     async def recv_raw(self) -> bytes:
         sealed = await read_frame(self.reader)  # sdlint: ok[timeout-discipline]

@@ -21,7 +21,7 @@ import asyncio
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from .. import flags, tasks
+from .. import channels, flags, tasks
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..timeouts import with_timeout
@@ -40,12 +40,16 @@ from .identity import RemoteIdentity
 OPS_PER_REQUEST = 1000
 
 # Clone fast path flow control: pages in flight on the tunnel before
-# the originator waits for a watermark ack. Window 4 at the bulk
-# writers' 4-16k-op pages keeps a few MB in transport buffers — enough
-# that the receiver's batched apply never starves on the wire, bounded
-# enough that a slow receiver exerts backpressure instead of ballooning
-# originator memory.
-CLONE_WINDOW = 4
+# the originator waits for a watermark ack. The window IS the declared
+# p2p.tunnel.frames channel capacity (channels.py; default 4, scaled
+# by SDTPU_CHAN_SCALE, snapshotted at import): 4 at the bulk writers'
+# 4-16k-op pages keeps a few MB in transport buffers — enough that the
+# receiver's batched apply never starves on the wire, bounded enough
+# that a slow receiver exerts backpressure instead of ballooning
+# originator memory. Tunnel.send_nowait's runtime Window enforces the
+# same cap, so a drift between this constant and the registry is a
+# chan_overflow violation in tier-1, not silent memory growth.
+CLONE_WINDOW = channels.capacity("p2p.tunnel.frames")
 
 # Sync wire-format version, checked in BOTH directions: the originator
 # announces it in the new_ops header (responder refuses a mismatch), and
@@ -69,15 +73,21 @@ class NetworkedLibraries:
             self._loop = asyncio.get_running_loop()
         except RuntimeError:
             self._loop = None
-        # library_id → {instance pub_id → RemoteIdentity}
+        # library_id → {instance pub_id → RemoteIdentity}; evicted on
+        # library delete (bounded by loaded libraries, not history).
         self._instances: Dict[uuidlib.UUID, Dict[bytes, RemoteIdentity]] = {}
-        # identity bytes → (addr, port) route override (tests / static).
-        self._routes: Dict[bytes, Tuple[str, int]] = {}
+        # identity bytes → (addr, port) route override (set_route /
+        # pairing-time learn): authoritative config keyed by PAIRED
+        # instances, not a recomputable cache — evicting an entry
+        # silently strands a non-discoverable peer, so grow-only is
+        # the correctness contract (same shape as SyncManager's
+        # watermark vector).
+        self._routes: Dict[bytes, Tuple[str, int]] = {}  # sdlint: ok[unbounded-growth]
         # identity bytes → last route that carried a healthy tunnel:
         # discovery results are cached for the life of the tunnel and
         # invalidated on send failure, so a steady announce stream does
         # not re-scan the discovery peer table per round.
-        self._route_cache: Dict[bytes, Tuple[str, int]] = {}
+        self._route_cache = channels.bounded_dict("p2p.route_cache")
         self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
         # Supervisor subtree for announce fan-outs + per-pull ingest
         # actors: Node.shutdown reaps any still in flight.
@@ -93,6 +103,12 @@ class NetworkedLibraries:
     def _on_library_event(self, kind: str, library) -> None:
         if kind == "load":
             self.watch_library(library)
+        elif kind == "delete":
+            # Eviction path for the per-library maps: without it a
+            # node cycling through libraries grows them forever
+            # (sdlint unbounded-growth).
+            self._instances.pop(library.id, None)
+            self._ingest_locks.pop(library.id, None)
 
     def watch_library(self, library) -> None:
         self._instances.setdefault(library.id, {})

@@ -39,6 +39,12 @@ engine actually depends on:
   with an unretrieved exception is a `task_exception`, and a task
   surviving `Node.shutdown`'s reap grace is a `task_orphaned`
   (raised at the reap in tier-1).
+- **Channel overflow detection** (round 12, armed via `channels.arm()`
+  at install — the runtime twin of sdlint's queue-discipline and
+  backpressure passes): a send_nowait burst past a declared frame
+  window, or a nowait put on a full block-policy channel, is a
+  `chan_overflow` violation — raised in tier-1, counted in
+  production while the shed/coalesce policies keep depth bounded.
 
 Activation: `SDTPU_SANITIZE=1` + `install()` (tests/conftest.py calls
 it for tier-1; node bootstrap may too). `SDTPU_SANITIZE_MODE=raise`
@@ -92,6 +98,11 @@ _max_stall = 0.0
 # would both miss cross-instance AB/BA deadlocks (libA.write vs
 # libB.write taken in opposite orders reads as a reentrant skip) and
 # merge unrelated instances' edges into false cycles.
+# The lock graph IS the detector's memory: evicting edges would
+# forget recorded orders and miss cycles. Bounded in practice by
+# distinct tracked-lock instances (2 per Database); a pathological
+# library-churn workload trades bytes for detection fidelity.
+# sdlint: ok[unbounded-growth]
 _edges: Dict[str, Set[str]] = {}
 _edges_lock = threading.Lock()
 _lock_seq = [0]
@@ -339,6 +350,11 @@ def install() -> bool:
     from .ops import jit_registry
 
     jit_registry.arm(_mode, _record)
+    # Arm the resource-layer twin: channel depth-watermark breaches
+    # (channels.py) flow through _record as `chan_overflow`.
+    from . import channels
+
+    channels.arm(_mode, _record)
     _installed = True
     return True
 
@@ -357,4 +373,7 @@ def uninstall() -> None:
     from .ops import jit_registry
 
     jit_registry.disarm()
+    from . import channels
+
+    channels.disarm()
     _installed = False

@@ -86,7 +86,7 @@ def _pub_id() -> Field:
     return Field("pub_id", "BLOB", nullable=False, unique=True)
 
 
-MODELS: Dict[str, Model] = {}
+MODELS: Dict[str, Model] = {}  # sdlint: ok[unbounded-growth] import-time schema registry: one entry per declared model
 
 
 def register(model: Model) -> Model:

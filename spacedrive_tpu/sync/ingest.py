@@ -20,7 +20,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from .. import tasks
+from .. import channels, tasks
 from ..telemetry import SYNC_INGEST_PAGES
 from ..timeouts import with_timeout
 from .crdt import CRDTOperation
@@ -45,6 +45,17 @@ class MessagesEvent:
     instance: bytes
     messages: List[CRDTOperation]
     has_more: bool
+
+
+def _extend_capped(errors: List[str], errs: List[str]) -> None:
+    """Append ingest errors, aging out the oldest past ERRORS_CAP.
+    Every writer to an Ingester.errors list — the actor's own
+    _note_errors AND the clone fast path, which is handed the raw
+    list — must funnel through this, or a multi-million-op clone
+    whose pages keep failing grows the failure history unbounded."""
+    errors.extend(errs)
+    if len(errors) > Ingester.ERRORS_CAP:
+        del errors[: len(errors) - Ingester.ERRORS_CAP]
 
 
 async def pump_clone_stream(sync: SyncManager, recv, send,
@@ -97,7 +108,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
                 n, errs = await asyncio.to_thread(
                     sync.receive_crdt_operations, live)
                 applied += n
-                errors.extend(errs)
+                _extend_capped(errors, errs)
                 for op in live:
                     expect[op.instance] = max(
                         expect.get(op.instance, 0), op.timestamp)
@@ -118,7 +129,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             n, errs, fast = await asyncio.to_thread(
                 sync.receive_blob_pages, [frame])
             applied += n
-            errors.extend(errs)
+            _extend_capped(errors, errs)
             fast_pages += 1 if fast else 0
             fallback_pages += 0 if fast else 1
             expect[pub] = max(expect.get(pub, 0), int(frame["max_ts"]))
@@ -139,23 +150,37 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
 class Ingester:
     """Owns the notification→retrieve→ingest loop for one library."""
 
+    # Most recent ingest errors kept for callers (sync_net surfaces
+    # them); older ones age out so a long churn stream cannot grow the
+    # actor's memory with its failure history.
+    ERRORS_CAP = 256
+
     def __init__(self, sync: SyncManager, owner: str = "sync-ingest"):
         self.sync = sync
         self._owner = owner
-        self.events: asyncio.Queue = asyncio.Queue()
-        self.requests: asyncio.Queue = asyncio.Queue()
+        # Bounded channels (channels.py registry): the event inbox
+        # coalesces notification pokes by kind; the request outbox is
+        # block-policy — its put waits under the sync.ingest.backlog
+        # budget when the _pull consumer wedges.
+        self.events = channels.channel("sync.ingest.events")
+        self.requests = channels.channel("sync.ingest.requests")
         self.errors: List[str] = []
         self._task: Optional[asyncio.Task] = None
 
     # -- inputs ------------------------------------------------------------
 
     def notify(self) -> None:
-        """Event::Notification — a peer has new ops."""
-        self.events.put_nowait(("notification", None))
+        """Event::Notification — a peer has new ops. A poke storm
+        coalesces to one pending notification (the reference's wait!
+        drops redundant ones the same way)."""
+        self.events.put_nowait(("notification", None), key="notification")
 
     def deliver(self, event: MessagesEvent) -> None:
         """Event::Messages — a page of ops arrived."""
         self.events.put_nowait(("messages", event))
+
+    def _note_errors(self, errs: List[str]) -> None:
+        _extend_capped(self.errors, errs)
 
     # -- actor loop --------------------------------------------------------
 
@@ -200,9 +225,9 @@ class Ingester:
                     # would repeat forever if we re-requested the same
                     # clocks — ABORT this pull; the next notification
                     # retries from the persisted watermarks.
-                    self.errors.append(f"ingest page: {e}")
+                    self._note_errors([f"ingest page: {e}"])
                     break
-                self.errors.extend(errors)
+                self._note_errors(errors)
                 if applied:
                     await self.requests.put(
                         Request(ReqKind.INGESTED, count=applied))

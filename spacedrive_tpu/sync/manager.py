@@ -105,10 +105,17 @@ class SyncManager:
         self.instance = instance_pub_id
         self.clock = HLC()
         self.emit_messages = emit_messages
-        self._on_created: List[Callable[[], None]] = []
-        # instance pub_id → local row id, and → last-seen NTP64.
-        self._instance_ids: Dict[bytes, int] = {}
-        self.timestamps: Dict[bytes, int] = {}
+        # One subscriber per watching component (sync_net), not per
+        # event; a library lifetime registers O(1) of them.
+        self._on_created: List[Callable[[], None]] = []  # sdlint: ok[unbounded-growth]
+        # instance pub_id → local row id, and → last-seen NTP64. Both
+        # are keyed by PAIRED INSTANCES — the sync topology, mirrored
+        # from the instance table, not traffic — and the timestamps
+        # map is the CRDT watermark vector: evicting an entry would
+        # re-pull that instance's whole history, so "grow-only" is the
+        # correctness contract here.
+        self._instance_ids: Dict[bytes, int] = {}  # sdlint: ok[unbounded-growth]
+        self.timestamps: Dict[bytes, int] = {}  # sdlint: ok[unbounded-growth]
         self._sync_indexes_ready = False
         # Solo = no other instance registered: bulk writers may append
         # page-level op blobs (get_ops decodes them; the first remote

@@ -24,7 +24,7 @@ Design constraints, in order:
   round 9 the lint is sdlint's telemetry pass; the shim remains).
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
-  sanitize | jit.
+  sanitize | jit | task | timeout | chan.
 - **No dependencies.** Pure stdlib plus the equally dependency-free
   flag registry (flags.py) — importable from every layer (store, p2p,
   ops) without cycles.
@@ -527,7 +527,7 @@ SANITIZE_VIOLATIONS = counter(
     "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
     "loop_stall | lock_across_await | lock_order_cycle | "
     "jit_retrace_budget | host_transfer | task_exception | "
-    "task_orphaned",
+    "task_orphaned | chan_overflow",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",
@@ -566,6 +566,31 @@ TASK_CANCEL_LATENCY = histogram(
     "sd_task_cancel_latency_seconds",
     "Seconds from a supervisor cancel() to the task actually "
     "finishing (shutdown responsiveness of the component tree)")
+
+# -- channel contracts (channels.py) ----------------------------------------
+CHAN_DEPTH = gauge(
+    "sd_chan_depth",
+    "Instantaneous item depth per registered channel (channels.py); "
+    "multi-instance channels (per-tunnel windows, per-subscription ws "
+    "buffers) sample the most recently updated instance",
+    labelnames=("name",))
+CHAN_HIGH_WATER = gauge(
+    "sd_chan_high_water",
+    "Deepest observed depth per registered channel since process "
+    "start (monotonic across instance churn; the armed sanitizer "
+    "raises when depth would exceed the declared capacity)",
+    labelnames=("name",))
+CHAN_SHED = counter(
+    "sd_chan_shed_total",
+    "Items dropped or coalesced away by a channel's overflow policy "
+    "(shed_oldest eviction, shed_new rejection, coalesce replacement)",
+    labelnames=("name",))
+CHAN_PUT_BLOCK_SECONDS = histogram(
+    "sd_chan_put_block_seconds",
+    "Producer wait for space on block-policy channels (only waits are "
+    "observed, not instant puts) — the backpressure actually exerted",
+    labelnames=("name",),
+    buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120))
 
 # -- timeout contracts (timeouts.py) ----------------------------------------
 TIMEOUTS_FIRED = counter(

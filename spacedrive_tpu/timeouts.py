@@ -179,6 +179,13 @@ declare_timeout(
     "One websocket frame to a subscriber (responses, subscription "
     "events): a dead client cannot wedge the emit path.")
 
+# -- bench (tools-only put budgets; not wire awaits) ------------------------
+
+declare_timeout(
+    "bench.chan.put", 5.0,
+    "tools/chan_bench.py producer's bounded put on the block-policy "
+    "bench channel — the measured put-block path.")
+
 # -- p2p (tunnel control plane) ---------------------------------------------
 
 declare_timeout(
@@ -252,6 +259,13 @@ declare_timeout(
     "sync.clone.frame", 180.0,
     "Receiver's wait for the next clone-stream frame (page, "
     "interleaved ops, or blob_done) from the originator.")
+
+declare_timeout(
+    "sync.ingest.backlog", 180.0,
+    "Ingester waiting for space in its bounded request channel "
+    "(channels.py sync.ingest.requests): the _pull consumer drains it "
+    "between wire frames, so a wedged consumer frees the actor here "
+    "instead of parking it forever.")
 
 declare_timeout(
     "sync.pull.page", 180.0,

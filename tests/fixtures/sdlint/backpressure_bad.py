@@ -1,0 +1,22 @@
+"""backpressure known-POSITIVES."""
+
+from spacedrive_tpu import channels
+
+
+class Producer:
+    def __init__(self):
+        # sync.ingest.requests is block-policy in the real registry
+        self.requests = channels.channel("sync.ingest.requests")
+
+    def push(self, item):
+        self.requests.put_nowait(item)      # nowait-on-block
+
+
+def fan_out(subs, event):
+    for sub in subs:
+        sub.buffer.append(event)            # unbounded-fanout
+
+
+async def burst(tunnel, pages):
+    for page in pages:
+        tunnel.send_nowait(page)            # burst-without-drain

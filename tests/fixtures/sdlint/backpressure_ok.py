@@ -1,0 +1,40 @@
+"""backpressure known-NEGATIVES: budgeted puts, shed policies, and
+windowed bursts with a drain point."""
+
+from spacedrive_tpu import channels
+from spacedrive_tpu.timeouts import with_timeout
+
+
+class Producer:
+    def __init__(self):
+        self.requests = channels.channel("sync.ingest.requests")
+        self.events = channels.channel("sync.ingest.events")
+
+    async def push(self, item):
+        # block policy: put() waits under the contract's declared
+        # sync.ingest.backlog budget — the sanctioned shape.
+        await self.requests.put(item)
+
+    def poke(self):
+        # coalesce policy: put_nowait never blocks, overflow sheds.
+        self.events.put_nowait(("notification", None),
+                               key="notification")
+
+
+async def windowed_burst(tunnel, pages):
+    inflight = 0
+    for page in pages:
+        tunnel.send_nowait(page)
+        inflight += 1
+        if inflight >= 4:
+            # the drain point that closes the window
+            await with_timeout("sync.clone.drain", tunnel.drain())
+            inflight = 0
+    await with_timeout("sync.clone.drain", tunnel.drain())
+
+
+def fan_out_calls(subs, event):
+    # calling subscribers is fine — the rule is about unbounded
+    # per-subscriber BUFFER writes.
+    for sub in subs:
+        sub(event)

@@ -1,0 +1,21 @@
+# sdlint-scope: growth
+"""unbounded-growth known-POSITIVES."""
+
+SEEN_GLOBAL: dict = {}      # module-level grow-only
+
+
+def remember(key):
+    SEEN_GLOBAL[key] = True
+
+
+class LeakyActor:
+    """Long-lived (actor loop) with grow-only instance collections."""
+
+    def __init__(self):
+        self.seen = {}          # grow-only (subscript writes)
+        self.log = []           # grow-only (append)
+
+    async def run(self):
+        while True:
+            self.seen[object()] = 1
+            self.log.append(1)

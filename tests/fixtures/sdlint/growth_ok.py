@@ -1,0 +1,56 @@
+# sdlint-scope: growth
+"""unbounded-growth known-NEGATIVES: eviction paths, bounded deques,
+registry channels/caches, fixed-slot state, and short-lived classes."""
+
+from collections import deque
+
+from spacedrive_tpu import channels
+
+_STATE = [0, 0]                 # fixed-slot list: writes, not growth
+
+
+def bump(ms):
+    _STATE[0] = ms
+
+
+class BoundedActor:
+    def __init__(self):
+        self.recent = deque(maxlen=16)
+        self.pending = {}
+        self.inbox = channels.channel("sync.ingest.events")
+        self.routes = channels.bounded_dict("p2p.route_cache")
+
+    async def run(self):
+        while True:
+            self.pending[1] = 2
+            self.pending.pop(1, None)
+            self.recent.append(1)
+            self.routes[b"k"] = ("addr", 1)
+
+
+class Unsubscribable:
+    """The eviction path may live in a nested closure (unsubscribe)."""
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def __init__(self):
+        self.subs = []
+
+    def subscribe(self, cb):
+        self.subs.append(cb)
+        return lambda: self.subs.remove(cb)
+
+
+class ShortLived:
+    """No while-True/spawn/start+stop: request-scoped accumulation
+    is bounded by the request's lifetime."""
+
+    def __init__(self):
+        self.items = []
+
+    def add(self, x):
+        self.items.append(x)

@@ -1,0 +1,41 @@
+"""queue-discipline known-POSITIVES."""
+
+import asyncio
+from asyncio import Queue
+from collections import deque
+
+from spacedrive_tpu import channels
+
+
+class Actor:
+    def __init__(self):
+        self.inbox = asyncio.Queue()        # bare-queue
+        self.backlog = deque()              # unbounded-deque-channel
+        self.spare = Queue()                # bare-queue (from-import)
+
+    def produce(self, item):
+        self.inbox.put_nowait(item)         # unregistered-put
+        self.backlog.append(item)
+
+    async def consume(self):
+        self.backlog.popleft()
+        return await self.inbox.get()
+
+
+class Sender:
+    def send_nowait(self, msg):             # unregistered-send-buffer
+        self._buf.append(msg)
+
+
+def local_channel():
+    q = asyncio.Queue()                     # bare-queue
+    q.put_nowait(1)                         # unregistered-put (local)
+    return q
+
+
+def undeclared():
+    return channels.channel("not.a.real.channel")   # undeclared-channel
+
+
+def dynamic(name):
+    return channels.channel(name)           # dynamic-channel-name

@@ -1,0 +1,38 @@
+"""queue-discipline known-NEGATIVES: registry channels, bounded
+deques, and plain work lists are all sanctioned."""
+
+from collections import deque
+
+from spacedrive_tpu import channels
+
+
+class Actor:
+    def __init__(self):
+        self.inbox = channels.channel("sync.ingest.events")
+        self.recent = deque(maxlen=64)      # bounded: not a channel
+
+    def produce(self, item):
+        self.inbox.put_nowait(item)         # registered
+
+    async def consume(self):
+        return await self.inbox.get()
+
+
+class Tunnelish:
+    def __init__(self):
+        self._frames = channels.window("p2p.tunnel.frames")
+
+    def send_nowait(self, msg):
+        self._frames.note_put()
+
+
+class Cache:
+    def __init__(self):
+        self.routes = channels.bounded_dict("p2p.route_cache")
+
+
+def scratch():
+    # function-local deque: a work list, not a cross-task channel
+    work = deque()
+    work.append(1)
+    return work.popleft()

@@ -257,6 +257,76 @@ def test_server_ws_and_custom_uri(env, tmp_path):
     _run(main())
 
 
+def test_ws_teardown_reaps_every_subscription_pump(env):
+    """A client holding SEVERAL subscriptions must get all of them
+    torn down on disconnect — every EventBus callback unsubscribed and
+    every pump drainer reaped — whether the handler exits via a close
+    frame or is cancelled by server shutdown. (Regression: teardown
+    used to await each pump stop inside the unsub loop, so a
+    cancellation mid-loop stranded the remaining subscriptions'
+    callbacks and drainers for the node's lifetime.)"""
+    node, router, corpus = env
+
+    async def main():
+        from spacedrive_tpu import tasks
+        from spacedrive_tpu.api.server import ApiServer
+        server = ApiServer(node, router)
+        port = await server.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        subs_before = len(node.events._subs)
+
+        async def open_three(ws):
+            for mid in (1, 2, 3):
+                await ws.send_json({"id": mid, "type": "subscription",
+                                    "path": "invalidation.listen"})
+                assert (await asyncio.wait_for(
+                    ws.receive_json(), 5))["type"] == "response"
+            assert len(node.events._subs) == subs_before + 3
+
+        async def assert_torn_down():
+            # the client side races ahead of the server handler's
+            # finally, and supervisor records prune in a done-callback
+            # — poll briefly before asserting
+            for _ in range(100):
+                pumps = [r for r in tasks.live(server._owner)
+                         if r.name == "ws-pump"]
+                if not pumps and len(node.events._subs) == subs_before:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(node.events._subs) == subs_before
+            assert not [r for r in tasks.live(server._owner)
+                        if r.name == "ws-pump"]
+
+        async with aiohttp.ClientSession() as http:
+            # clean close frame
+            async with http.ws_connect(f"{base}/rspc") as ws:
+                await open_three(ws)
+                # duplicate mid is rejected, NOT silently overwritten
+                # (an overwrite would strand the first unsub + pump)
+                await ws.send_json({"id": 1, "type": "subscription",
+                                    "path": "invalidation.listen"})
+                frame = await asyncio.wait_for(ws.receive_json(), 5)
+                assert frame["type"] == "error"
+                assert len(node.events._subs) == subs_before + 3
+                # explicit stop tears down that one subscription
+                await ws.send_json({"id": 2, "type": "subscriptionStop"})
+                for _ in range(100):
+                    if len(node.events._subs) == subs_before + 2:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(node.events._subs) == subs_before + 2
+            await assert_torn_down()
+
+            # handler cancelled by server shutdown with the client
+            # still connected and holding three subscriptions
+            ws = await http.ws_connect(f"{base}/rspc")
+            await open_three(ws)
+            await server.stop()
+            await assert_torn_down()
+            await ws.close()
+    _run(main())
+
+
 def test_ts_client_generator_covers_every_procedure():
     """packages/client parity: the generated TS client exposes one
     method per registered procedure with its metadata as JSDoc."""

@@ -1,0 +1,474 @@
+"""channels.py registry: contracts, policies, metrics, the armed
+overflow check, the ws pump's stalled-consumer shed, the thumbnailer's
+per-path coalescing, and the chan_bench artifact.
+
+The stalled-consumer cases are the tier-1 face of the acceptance
+criterion: channel depth never exceeds the declared capacity while
+sd_chan_shed_total advances, with zero loop_stall/task_orphaned
+violations (the autouse sanitizer fixture enforces the latter)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from spacedrive_tpu import channels, sanitize, tasks
+from spacedrive_tpu.channels import (
+    BoundedDict,
+    Channel,
+    ChannelFull,
+    Window,
+    declare_channel,
+)
+from spacedrive_tpu.telemetry import CHAN_SHED
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- contract validation ------------------------------------------------------
+
+def test_declare_rejects_duplicates_and_bad_specs():
+    with pytest.raises(ValueError, match="declared twice"):
+        declare_channel("api.ws", 1, "shed_new", "api", "dup")
+    with pytest.raises(ValueError, match="capacity"):
+        declare_channel("test.zero", 0, "shed_new", "t", "x")
+    with pytest.raises(ValueError, match="policy"):
+        declare_channel("test.pol", 1, "drop_everything", "t", "x")
+    with pytest.raises(ValueError, match="put_budget"):
+        declare_channel("test.block", 1, "block", "t", "x")
+    with pytest.raises(ValueError, match="not declared"):
+        declare_channel("test.block2", 1, "block", "t", "x",
+                        put_budget="no.such.budget")
+    # failed declarations must not leak into the registry (the drift
+    # test asserts runtime == static AST)
+    for name in ("test.zero", "test.pol", "test.block", "test.block2"):
+        assert name not in channels.CHANNELS
+
+
+def test_undeclared_and_kind_mismatch():
+    with pytest.raises(KeyError, match="undeclared channel"):
+        channels.channel("no.such.channel")
+    with pytest.raises(ValueError, match="kind"):
+        channels.channel("p2p.tunnel.frames")   # declared as window
+    with pytest.raises(ValueError, match="window"):
+        channels.window("api.ws")
+    with pytest.raises(ValueError, match="cache"):
+        channels.bounded_dict("api.ws")
+
+
+def test_capacity_scales_with_flag(monkeypatch):
+    base = channels.CHANNELS["p2p.tunnel.frames"].capacity
+    monkeypatch.delenv("SDTPU_CHAN_SCALE", raising=False)
+    assert channels.capacity("p2p.tunnel.frames") == base
+    monkeypatch.setenv("SDTPU_CHAN_SCALE", "2")
+    assert channels.capacity("p2p.tunnel.frames") == base * 2
+    monkeypatch.setenv("SDTPU_CHAN_SCALE", "0.0001")
+    assert channels.capacity("p2p.tunnel.frames") == 1  # floored
+
+
+def test_chan_table_lists_every_contract():
+    table = channels.chan_table_markdown()
+    for name, c in channels.CHANNELS.items():
+        assert f"`{name}`" in table
+        assert c.owner in table
+
+
+# -- policies -----------------------------------------------------------------
+
+def test_shed_oldest_evicts_head_and_counts():
+    evicted = []
+    ch = Channel("jobs.worker.commands", on_evict=evicted.append)
+    before = ch.shed_total
+    for i in range(ch.capacity + 3):
+        assert ch.put_nowait(i) is True
+    assert len(ch) == ch.capacity
+    assert evicted == [0, 1, 2]
+    assert ch.shed_total - before == 3
+    assert ch.get_nowait() == 3   # head advanced past the shed items
+
+
+def test_shed_new_refuses_and_counts():
+    ch = Channel("bench.shed")
+    for i in range(ch.capacity):
+        assert ch.put_nowait(i) is True
+    before = ch.shed_total
+    assert ch.put_nowait("x") is False
+    assert len(ch) == ch.capacity
+    assert ch.shed_total - before == 1
+    assert ch.high_water == ch.capacity
+
+
+def test_coalesce_replaces_pending_by_key():
+    ch = Channel("sync.ingest.events")
+    ch.put_nowait(("notification", 1), key="notification")
+    ch.put_nowait(("messages", "page"))
+    ch.put_nowait(("notification", 2), key="notification")
+    assert len(ch) == 2
+    # the coalesced slot kept its ORIGINAL position with the NEW payload
+    assert ch.get_nowait() == ("notification", 2)
+    assert ch.get_nowait() == ("messages", "page")
+    # once consumed, the key is free again
+    ch.put_nowait(("notification", 3), key="notification")
+    assert len(ch) == 1
+
+
+def test_block_policy_put_waits_then_times_out(monkeypatch):
+    async def main():
+        ch = Channel("bench.chan")
+        for i in range(ch.capacity):
+            await ch.put(i)
+        # a consumer freeing one slot unblocks the waiting put
+        async def free_one():
+            await asyncio.sleep(0.01)
+            ch.get_nowait()
+        t = asyncio.ensure_future(free_one())
+        await ch.put("fits")
+        await t
+        # with no consumer the put budget fires (scaled tiny)
+        monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.004")  # 5s → 20ms
+        with pytest.raises(asyncio.TimeoutError):
+            await ch.put("never")
+    run(main())
+
+
+def test_block_put_with_key_coalesces_like_put_nowait():
+    """A budgeted put honors key coalescing: two puts with one key
+    keep one slot (the newer payload), the keys map stays consistent
+    after a consume, and a third keyed put coalesces instead of
+    duplicating."""
+    async def main():
+        ch = Channel("bench.chan")
+        await ch.put("v1", key="k")
+        await ch.put("v2", key="k")     # replaces in place
+        assert len(ch) == 1
+        await ch.put("other")
+        assert ch.get_nowait() == "v2"
+        ch.put_nowait("v3", key="k")    # key freed by the consume
+        assert len(ch) == 2             # other + v3, no duplicate
+        assert ch.get_nowait() == "other"
+        assert ch.get_nowait() == "v3"
+    run(main())
+
+
+def test_put_nowait_on_full_block_channel_is_a_violation():
+    async def main():
+        ch = Channel("bench.chan")
+        for i in range(ch.capacity):
+            await ch.put(i)
+        # tier-1 runs armed in raise mode: the chan_overflow violation
+        # surfaces before ChannelFull
+        with pytest.raises((sanitize.SanitizerViolation, ChannelFull)):
+            ch.put_nowait("overflow")
+    run(main())
+    assert any(v["kind"] == "chan_overflow"
+               for v in sanitize.violations())
+    sanitize.reset_violations()
+
+
+def test_async_get_waits_for_put():
+    async def main():
+        ch = Channel("sync.ingest.events")
+        getter = asyncio.ensure_future(ch.get())
+        await asyncio.sleep(0)
+        assert not getter.done()
+        ch.put_nowait("item")
+        assert await getter == "item"
+    run(main())
+
+
+def test_cancelled_get_does_not_leak_waiter():
+    """The worker cancels a pending commands.get() every step a
+    command does not arrive; each cancelled waiter must leave the
+    deque (asyncio.Queue semantics), not accumulate forever."""
+    async def main():
+        ch = Channel("jobs.worker.commands")
+        for _ in range(200):
+            getter = asyncio.ensure_future(ch.get())
+            await asyncio.sleep(0)
+            getter.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await getter
+        assert len(ch._getters) == 0
+    run(main())
+
+
+def test_get_cancelled_after_wakeup_hands_item_to_next_getter():
+    """A put can wake a getter whose task is cancelled before it runs:
+    the wakeup must pass to the next parked getter instead of
+    stranding the item with live consumers."""
+    async def main():
+        ch = Channel("sync.ingest.events")
+        first = asyncio.ensure_future(ch.get())
+        second = asyncio.ensure_future(ch.get())
+        await asyncio.sleep(0)          # both parked, in order
+        ch.put_nowait("item")           # wakes `first`'s future
+        first.cancel()                  # ...but first dies before running
+        assert await second == "item"
+        assert len(ch._getters) == 0
+    run(main())
+
+
+def test_cancelled_or_timed_out_block_put_does_not_leak_waiter(monkeypatch):
+    async def main():
+        ch = Channel("bench.chan")
+        for i in range(ch.capacity):
+            await ch.put(i)
+        # producer cancelled while parked on a full channel
+        putter = asyncio.ensure_future(ch.put("parked"))
+        await asyncio.sleep(0)
+        putter.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await putter
+        assert len(ch._space) == 0
+        # budget fires: wait_for cancels the future; the dead waiter
+        # must still be removed from the deque
+        monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.004")
+        with pytest.raises(asyncio.TimeoutError):
+            await ch.put("never")
+        assert len(ch._space) == 0
+    run(main())
+
+
+def test_run_queue_surface_iter_remove_len():
+    ch = Channel("jobs.manager.queue")
+    ch.put_nowait("a")
+    ch.put_nowait("b")
+    ch.put_nowait("c")
+    assert list(ch) == ["a", "b", "c"] and bool(ch) and len(ch) == 3
+    ch.remove("b")
+    assert list(ch) == ["a", "c"]
+    with pytest.raises(ValueError):
+        ch.remove("b")
+    assert ch.popleft() == "a"
+
+
+# -- window (the proto.py send_nowait cap) ------------------------------------
+
+def test_window_breach_is_a_violation():
+    w = Window("p2p.tunnel.frames")
+    for _ in range(w.capacity):
+        w.note_put()
+    assert len(w) == w.capacity
+    with pytest.raises(sanitize.SanitizerViolation):
+        w.note_put()
+    assert any(v["kind"] == "chan_overflow"
+               for v in sanitize.violations())
+    sanitize.reset_violations()
+    w.note_drain()
+    assert len(w) == 0
+    w.note_put()  # a fresh window after the drain is fine
+
+
+def test_tunnel_clone_window_matches_registry():
+    pytest.importorskip("cryptography")  # environmental: p2p needs it
+    from spacedrive_tpu.p2p.sync_net import CLONE_WINDOW
+
+    assert CLONE_WINDOW == channels.capacity("p2p.tunnel.frames")
+
+
+# -- bounded dict (registry-declared caches) ----------------------------------
+
+def test_bounded_dict_lru_eviction():
+    bd = BoundedDict("p2p.route_cache")
+    before = bd.shed_total
+    for i in range(bd.capacity + 5):
+        bd[i] = i
+    assert len(bd) == bd.capacity
+    assert bd.shed_total - before == 5
+    assert 0 not in bd and bd.capacity + 4 in bd
+    # access refreshes recency: key survives the next insert wave
+    first_kept = bd.capacity + 4
+    _ = bd[first_kept - 1]
+    bd["fresh"] = 1
+    assert (first_kept - 1) in bd
+    assert bd.pop("fresh") == 1
+    assert bd.get("gone", "dflt") == "dflt"
+
+
+def test_high_water_gauge_survives_instance_churn():
+    """sd_chan_high_water is documented as the process-lifetime peak
+    per channel NAME: a fresh instance (ws buffers come and go per
+    subscription) reaching a small depth must not regress the gauge
+    below an earlier instance's peak."""
+    from spacedrive_tpu.telemetry import CHAN_HIGH_WATER
+
+    deep = channels.channel("jobs.worker.commands")
+    for i in range(5):
+        deep.put_nowait(i)
+    gauge = CHAN_HIGH_WATER.labels(name="jobs.worker.commands")
+    peak = gauge.value
+    assert peak >= 5
+    fresh = channels.channel("jobs.worker.commands")
+    fresh.put_nowait("x")
+    assert fresh.high_water == 1        # per-instance view unchanged
+    assert gauge.value == peak          # per-name gauge holds the peak
+
+
+def test_bounded_dict_iterates_as_mapping():
+    """`for k in bd` must walk keys like a dict — without __iter__ it
+    would fall into the legacy sequence protocol (bd[0], bd[1], ...)
+    and raise KeyError(0). Iteration is a read: LRU order intact."""
+    bd = BoundedDict("p2p.route_cache")
+    bd["a"] = 1
+    bd["b"] = 2
+    assert list(bd) == ["a", "b"]
+    list(bd)  # a second walk must not refresh recency
+    _ = bd["a"]  # but a lookup does
+    assert list(bd) == ["b", "a"]
+
+
+# -- ws pump: the stalled-consumer tier-1 gate --------------------------------
+
+def test_ws_pump_stalled_consumer_sheds_not_wedges():
+    """A websocket subscriber that stops reading must cost a bounded
+    buffer + shed counter, not the node's memory: depth stays under
+    the declared capacity while sd_chan_shed_total{api.ws} advances,
+    and the pump reaps cleanly (zero task_orphaned — the autouse
+    sanitizer fixture would fail this test otherwise)."""
+    from spacedrive_tpu.api.server import WsSubscriptionPump
+
+    async def main():
+        stall = asyncio.Event()
+        sent = []
+
+        async def stalled_send(payload):
+            sent.append(payload)
+            await stall.wait()   # consumer never drains
+
+        pump = WsSubscriptionPump(stalled_send, owner="test-ws-pump")
+        # snapshot-coalescing before the drainer even runs: two
+        # telemetry frames collapse to the newest
+        pump.offer({"id": 1, "type": "event",
+                    "data": {"type": "TelemetrySnapshot", "seq": 1}})
+        pump.offer({"id": 1, "type": "event",
+                    "data": {"type": "TelemetrySnapshot", "seq": 2}})
+        assert len(pump.chan) == 1
+        before_shed = CHAN_SHED.labels(name="api.ws").value
+        for i in range(4 * pump.chan.capacity):
+            pump.offer({"id": 1, "type": "event",
+                        "data": {"type": "Notification", "n": i}})
+        await asyncio.sleep(0.01)  # let the drainer park on the stall
+        assert len(pump.chan) <= pump.chan.capacity
+        assert pump.chan.high_water <= pump.chan.capacity
+        shed = CHAN_SHED.labels(name="api.ws").value - before_shed
+        assert shed > 0, "stalled consumer must shed, not buffer"
+        # the consumer got at most one frame (it is wedged), the node
+        # kept running — now release and reap cleanly
+        stall.set()
+        await pump.stop()
+        assert not tasks.live("test-ws-pump")
+    run(main())
+
+
+# -- thumbnailer: bounded queue + per-path coalescing (regression) ------------
+
+class _FakeEvents:
+    def emit(self, e):
+        pass
+
+
+class _FakeNode:
+    def __init__(self, data_dir):
+        self.data_dir = data_dir
+        self.task_owner = "test-thumbs"
+        self.events = _FakeEvents()
+
+    class libraries:  # noqa: N801 — minimal stub surface
+        @staticmethod
+        def list():
+            return []
+
+
+def test_thumbnailer_full_scan_is_bounded_and_coalesced(
+        tmp_path, monkeypatch):
+    """Regression for the unbounded media actor queue: with generation
+    wedged (a 'slow thumbnailer'), flooding scan batches must cap the
+    queue at its declared capacity, shed the oldest batches (releasing
+    their awaiters), and coalesce duplicate (cas_id, path) requests
+    instead of queueing them twice."""
+    from spacedrive_tpu.media import actor as actor_mod
+
+    release = threading.Event()
+    monkeypatch.setattr(
+        actor_mod, "generate_thumbnail",
+        lambda path, data_dir, cas_id: release.wait(10) and None)
+
+    async def main():
+        thumb = actor_mod.Thumbnailer(_FakeNode(str(tmp_path / "d")))
+        thumb.start()
+        cap = thumb.queue.capacity
+        batches = []
+        for i in range(cap + 16):
+            b = await thumb.new_batch([(f"cas{i:04d}", f"/pic{i}.png")])
+            batches.append(b)
+        await asyncio.sleep(0.01)  # first batch wedged in generation
+        assert len(thumb.queue) <= cap
+        assert thumb.queue.high_water <= cap
+        assert thumb.queue.shed_total > 0
+        # shed batches released their awaiters instead of hanging them
+        shed_done = [b for b in batches[:16] if b.done.is_set()]
+        assert shed_done, "evicted batches must complete their done event"
+        # a duplicate path coalesces into the pending batch: nothing
+        # re-queues, and done waits for the DELEGATE (a coalesced
+        # caller must not be told done while its thumbnail is still
+        # someone else's pending work)
+        depth = len(thumb.queue)
+        dup = await thumb.new_batch([(f"cas{cap + 10:04d}",
+                                      f"/pic{cap + 10}.png")])
+        assert dup.entries == [] and not dup.done.is_set()
+        assert len(thumb.queue) == depth
+        release.set()
+        await asyncio.wait_for(dup.done.wait(), 10)
+        await thumb.stop()
+        assert not tasks.live("test-thumbs/media")
+    run(main())
+
+
+def test_thumbnailer_coalesced_batch_survives_delegate_shed(
+        tmp_path, monkeypatch):
+    """The coalesce/shed interaction: a batch whose entries rode a
+    delegate must complete when that delegate is SHED (its awaiters
+    are released, never hung) — and a re-request after the shed
+    forgot the paths queues fresh work instead of coalescing into
+    nothing."""
+    from spacedrive_tpu.media import actor as actor_mod
+
+    monkeypatch.setattr(
+        actor_mod, "generate_thumbnail",
+        lambda path, data_dir, cas_id: None)
+
+    async def main():
+        thumb = actor_mod.Thumbnailer(_FakeNode(str(tmp_path / "d")))
+        # actor NOT started: batches stay queued so we control shed
+        a = await thumb.new_batch([("cas0", "/p0.png")])
+        b = await thumb.new_batch([("cas0", "/p0.png")])  # coalesced
+        assert b.entries == [] and not b.done.is_set()
+        # overflow the queue so batch a (oldest) is shed
+        cap = thumb.queue.capacity
+        for i in range(cap + 1):
+            await thumb.new_batch([(f"x{i}", f"/x{i}.png")])
+        assert a.done.is_set(), "shed delegate releases its awaiters"
+        assert b.done.is_set(), "coalesced batch follows its delegate"
+        # the shed forgot (cas0, /p0.png): a re-request is fresh work
+        c = await thumb.new_batch([("cas0", "/p0.png")])
+        assert c.entries == [("cas0", "/p0.png")]
+    run(main())
+
+
+# -- chan_bench artifact -------------------------------------------------------
+
+def test_chan_bench_emits_bounded_artifact():
+    from tools import chan_bench
+
+    artifact = run(chan_bench.run(items=2000, burst=64))
+    assert artifact["bench"] == "chan_burst"
+    block, shed = (artifact["phases"]["block"],
+                   artifact["phases"]["shed"])
+    assert block["depth_high_water"] <= block["capacity"]
+    assert block["puts_per_s"] > 0
+    assert "put_block_p99_us" in block
+    assert shed["depth_high_water"] <= shed["capacity"]
+    assert shed["accepted"] == shed["capacity"]
+    assert shed["shed_total"] >= shed["items"] - shed["capacity"]

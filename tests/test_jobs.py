@@ -316,3 +316,44 @@ def test_errors_survive_pause(library):
         assert status == JobStatus.COMPLETED_WITH_ERRORS
 
     run(main())
+
+
+def test_admission_shed_when_run_queue_full(library, monkeypatch):
+    """Round-12 admission control (jobs.manager.queue, policy
+    shed_new): a job past the run-queue's declared capacity is refused
+    LOUDLY — report FAILED with a reason, a JobError event, a shed
+    count — while everything admitted completes normally. Capacity is
+    scaled tiny via SDTPU_CHAN_SCALE (read at channel construction)."""
+    from spacedrive_tpu.telemetry import CHAN_SHED
+
+    monkeypatch.setenv("SDTPU_CHAN_SCALE", "0.002")  # 1024 → 2
+
+    async def main():
+        events = []
+        m = JobManager(max_workers=1, on_event=events.append)
+        assert m.queue.capacity == 2
+        before_shed = CHAN_SHED.labels(name="jobs.manager.queue").value
+        ids = []
+        for i in range(4):  # 1 running + 2 queued + 1 refused
+            ids.append(await m.ingest(
+                library, CountJob(tag=f"adm{i}", n=2, delay=0.02)))
+        assert await m.wait(ids[3]) == JobStatus.FAILED
+        row = library.db.query_one(
+            "SELECT status, errors_text FROM job WHERE id = ?",
+            (ids[3],))
+        assert row["status"] == int(JobStatus.FAILED)
+        assert "admission refused" in (row["errors_text"] or "")
+        assert any(e.get("type") == "JobError"
+                   and "queue full" in e.get("message", "")
+                   for e in events)
+        assert CHAN_SHED.labels(
+            name="jobs.manager.queue").value > before_shed
+        # the refused hash is released: the same job can re-enter later
+        await m.wait_idle()
+        jid = await m.ingest(library,
+                             CountJob(tag="adm3", n=2, delay=0))
+        assert await m.wait(jid) == JobStatus.COMPLETED
+        for i in range(3):
+            assert SINK[f"adm{i}"] == [0, 1]
+
+    run(main())

@@ -1,10 +1,11 @@
 """sdlint framework: per-pass fixtures, the tree gate, baseline policy.
 
 This is the tier-1 hook that replaced the direct telemetry_lint run:
-`test_tree_clean_within_baseline` runs ALL eleven passes (five
+`test_tree_clean_within_baseline` runs ALL fourteen passes (five
 concurrency/invariant + the round-10 device trio + the round-11
-lifecycle trio: task-lifecycle, cancellation-safety,
-timeout-discipline) over the repo and fails on any finding not in
+lifecycle trio + the round-12 resource trio: queue-discipline,
+backpressure, unbounded-growth) over the repo and fails on any
+finding not in
 tools/sdlint/baseline.json (which may only shrink — budget enforced
 here too). The per-pass tests pin each pass to a known-positive /
 known-negative fixture pair under tests/fixtures/sdlint/, including
@@ -271,6 +272,116 @@ def test_every_with_timeout_site_name_resolves_at_runtime():
         assert timeouts.budget(name) > 0
 
 
+# -- queue-discipline (round 12: the resource trio) -------------------------
+
+def test_queue_discipline_flags_known_positives():
+    found = _lint_fixture("queue_bad.py", "queue-discipline")
+    codes = {f.code for f in found}
+    assert codes == {"bare-queue", "unbounded-deque-channel",
+                     "unregistered-put", "unregistered-send-buffer",
+                     "undeclared-channel", "dynamic-channel-name"}, codes
+    # the pre-registry jobs run-queue shape: an unbounded deque the
+    # class both appends to and pops from
+    assert any(f.code == "unbounded-deque-channel"
+               and f.ident == "self.backlog" for f in found)
+    # put_nowait on a bare self-attr queue AND on a local bare queue
+    puts = {f.ident for f in found if f.code == "unregistered-put"}
+    assert {"self.inbox.put_nowait", "q.put_nowait"} <= puts, puts
+
+
+def test_queue_discipline_passes_known_negatives():
+    assert _lint_fixture("queue_ok.py", "queue-discipline") == []
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_backpressure_flags_known_positives():
+    found = _lint_fixture("backpressure_bad.py", "backpressure")
+    codes = {f.code for f in found}
+    assert codes == {"nowait-on-block", "unbounded-fanout",
+                     "burst-without-drain"}, codes
+    assert any(f.ident == "self.requests.put_nowait" for f in found)
+    assert any(f.ident == "tunnel.send_nowait" for f in found)
+
+
+def test_backpressure_passes_known_negatives():
+    """Budgeted block puts, shed-policy nowait puts, windowed bursts
+    with a drain point, and call-only fan-outs are all sanctioned."""
+    assert _lint_fixture("backpressure_ok.py", "backpressure") == []
+
+
+# -- unbounded-growth --------------------------------------------------------
+
+def test_unbounded_growth_flags_known_positives():
+    found = _lint_fixture("growth_bad.py", "unbounded-growth")
+    assert {f.code for f in found} == {"grow-only"}
+    idents = {(f.qual, f.ident) for f in found}
+    assert ("LeakyActor", "self.seen") in idents      # subscript growth
+    assert ("LeakyActor", "self.log") in idents       # append growth
+    assert ("", "SEEN_GLOBAL") in idents              # module level
+
+
+def test_unbounded_growth_passes_known_negatives():
+    """Eviction paths (including closure unsubscribes), maxlen
+    deques, registry channels/caches, fixed-slot lists, and
+    short-lived classes are all sanctioned."""
+    assert _lint_fixture("growth_ok.py", "unbounded-growth") == []
+
+
+def test_chan_fixture_names_are_really_declared():
+    """The fixtures lean on real registry names — a renamed channel
+    must rename the fixtures (and every call site) with it."""
+    from tools.sdlint.passes.queue_discipline import declared_channels
+
+    declared = declared_channels(ROOT)
+    for name in ("sync.ingest.events", "sync.ingest.requests",
+                 "p2p.tunnel.frames", "p2p.route_cache"):
+        assert name in declared, name
+
+
+def test_channel_registry_static_runtime_drift():
+    """The static table and the runtime registry cannot drift (the
+    PR 6 timeout check, for channels): every AST-visible declaration
+    resolves at runtime, every runtime contract is AST-visible, and
+    every declared channel is actually CONSTRUCTED somewhere in the
+    tree with a literal name the registry knows."""
+    import ast as _ast
+
+    from spacedrive_tpu import channels
+    from tools.sdlint.passes.queue_discipline import declared_channels
+
+    static = declared_channels(ROOT)
+    assert set(static) == set(channels.CHANNELS)
+    for name in static:
+        assert channels.capacity(name) >= 1
+        c = channels.CHANNELS[name]
+        assert c.policy in channels.POLICIES
+        if c.policy == "block" and c.kind == "queue":
+            from spacedrive_tpu import timeouts
+            assert c.put_budget in timeouts.TIMEOUTS
+    # every declared channel constructed somewhere (fixtures excluded)
+    project = load_project(ROOT)
+    constructed = set()
+    for src in project.files:
+        for node in _ast.walk(src.tree):
+            if not isinstance(node, _ast.Call):
+                continue
+            from tools.sdlint.core import dotted
+            d = dotted(node.func)
+            if d is None:
+                continue
+            if d.rsplit(".", 1)[-1] in ("channel", "window",
+                                        "bounded_dict") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, _ast.Constant) and \
+                        isinstance(arg.value, str):
+                    constructed.add(arg.value)
+    missing = set(static) - constructed
+    assert not missing, (
+        f"declared but never constructed in the tree: {missing} — "
+        "prune the contract or adopt it")
+
+
 # -- the tree gate (runs all five passes; tier-1's CI hook) -----------------
 
 def test_tree_clean_within_baseline():
@@ -310,7 +421,8 @@ def test_every_registered_pass_ran_on_tree():
         "blocking-async", "lock-discipline", "crdt-parity",
         "flag-registry", "telemetry", "jit-stability",
         "dtype-discipline", "host-transfer", "task-lifecycle",
-        "cancellation-safety", "timeout-discipline"}
+        "cancellation-safety", "timeout-discipline",
+        "queue-discipline", "backpressure", "unbounded-growth"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -399,6 +511,19 @@ def test_cli_timeout_table_covers_every_declared_budget(capsys):
         assert f"`{name}`" in out
 
 
+def test_cli_chan_table_covers_every_declared_channel(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--chan-table"]) == 0
+    out = capsys.readouterr().out
+    from spacedrive_tpu import channels
+
+    for name in channels.CHANNELS:
+        assert f"`{name}`" in out
+    for c in channels.CHANNELS.values():
+        assert c.policy in out
+
+
 def test_baseline_budget_is_minimal_and_reasons_unique():
     """Round-11 hygiene (the PR 5 uniqueness test, tightened): the
     budget must be EXACTLY the entry count — a bump that leaves
@@ -411,13 +536,18 @@ def test_baseline_budget_is_minimal_and_reasons_unique():
     lifecycle = {k: v for k, v in baseline.entries.items()
                  if k.split("::", 1)[0] in (
                      "task-lifecycle", "cancellation-safety",
-                     "timeout-discipline")}
-    # Today the lifecycle passes run CLEAN (zero baselined daemons);
-    # if one is ever added it needs a unique, substantial reason.
+                     "timeout-discipline",
+                     "queue-discipline", "backpressure",
+                     "unbounded-growth")}
+    # Today the lifecycle AND resource passes run CLEAN (zero
+    # baselined entries — round 12's 22 initial findings were all
+    # fixed or inline-waived with reasons); if one is ever added it
+    # needs a unique, substantial reason.
     for key, reason in lifecycle.items():
         assert len(reason.strip()) >= 20, f"thin reason on {key}"
     assert len(set(lifecycle.values())) == len(lifecycle), (
-        "duplicate lifecycle baseline reasons — write one per entry")
+        "duplicate lifecycle/resource baseline reasons — write one "
+        "per entry")
 
 
 # -- flags registry integration --------------------------------------------

@@ -740,3 +740,43 @@ def test_python_fallback_when_native_absent(tmp_path, monkeypatch):
         "SELECT COUNT(*) AS n FROM shared_op_blob")["n"] == 1
     ops = a.get_ops(GetOpsArgs(clocks=[], count=10 * n))
     assert len(ops) == n and ops[0].typ.values["kind"] == 5
+
+
+def test_pump_clone_stream_caps_error_history():
+    """The clone fast path is handed the Ingester's raw errors list;
+    its per-page extends must age out old entries exactly like
+    _note_errors, or a huge clone whose pages keep failing grows the
+    actor's failure history unbounded."""
+    import asyncio
+
+    from spacedrive_tpu.sync.ingest import Ingester, pump_clone_stream
+
+    class _StubSync:
+        # Every page "applies" but reports a flood of per-op errors;
+        # the watermark always advances so the stream never freezes.
+        timestamps = {b"x" * 16: 10**12}
+
+        def receive_blob_pages(self, pages):
+            return 1, [f"op {i} failed" for i in range(100)], True
+
+    frames = [{"kind": "blob_page", "instance": b"x" * 16,
+               "max_ts": i + 1} for i in range(10)]
+    frames.append({"kind": "blob_done"})
+
+    async def run():
+        inbox: asyncio.Queue = asyncio.Queue()
+        for f in frames:
+            inbox.put_nowait(f)
+
+        async def send(msg):
+            pass
+
+        errors: list = []
+        await pump_clone_stream(_StubSync(), inbox.get, send, errors)
+        return errors
+
+    errors = asyncio.run(run())
+    # 10 pages x 100 errors uncapped would be 1000; only the newest
+    # ERRORS_CAP survive, and they are the most recent ones.
+    assert len(errors) == Ingester.ERRORS_CAP
+    assert errors[-1] == "op 99 failed"

@@ -1,0 +1,137 @@
+"""Producer/consumer burst microbench over the channel registry.
+
+The backpressure analog of perf_smoke/sync_bench: drives two declared
+bench channels through the same Channel machinery production uses and
+emits a BENCH-style JSON artifact, so a regression in the registry's
+hot path (put/get overhead, shed accounting, block-wait plumbing)
+gates like a perf regression instead of surfacing as mystery latency
+in the sync plane.
+
+Two phases:
+
+- **block phase** (`bench.chan`, policy block): a producer bursts
+  items at a consumer draining at a fixed service rate; every put's
+  wall time is recorded — depth high-water shows how far the window
+  fills, put-block p99 shows the backpressure actually exerted.
+- **shed phase** (`bench.shed`, policy shed_new): the consumer stalls
+  entirely; the producer keeps bursting. Depth must pin at capacity
+  and every overflow must land in the shed counter — the bounded-
+  memory contract the stalled-consumer tier-1 test also asserts.
+
+    python -m tools.chan_bench --json
+    python -m tools.chan_bench --items 50000 --burst 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Dict, List
+
+from spacedrive_tpu import channels
+
+
+def _p(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+async def _block_phase(items: int, burst: int) -> Dict:
+    chan = channels.channel("bench.chan")
+    put_times: List[float] = []
+    consumed = 0
+
+    async def consumer() -> None:
+        nonlocal consumed
+        while consumed < items:
+            await chan.get()
+            consumed += 1
+            if consumed % burst == 0:
+                # fixed service cadence: one loop tick per burst, so
+                # the producer periodically runs into the bound
+                await asyncio.sleep(0)
+
+    async def producer() -> None:
+        for i in range(items):
+            t0 = time.perf_counter()
+            await chan.put(i)
+            put_times.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    cons = asyncio.ensure_future(consumer())
+    await producer()
+    await cons
+    wall = time.perf_counter() - t0
+    put_times.sort()
+    return {
+        "channel": "bench.chan",
+        "policy": "block",
+        "items": items,
+        "wall_s": round(wall, 6),
+        "puts_per_s": round(items / wall, 1) if wall else 0.0,
+        "depth_high_water": chan.high_water,
+        "capacity": chan.capacity,
+        "put_block_p50_us": round(_p(put_times, 0.50) * 1e6, 2),
+        "put_block_p99_us": round(_p(put_times, 0.99) * 1e6, 2),
+        "shed_total": chan.shed_total,
+    }
+
+
+async def _shed_phase(items: int) -> Dict:
+    chan = channels.channel("bench.shed")
+    accepted = 0
+    for i in range(items):  # consumer fully stalled: nobody drains
+        if chan.put_nowait(i):
+            accepted += 1
+    assert len(chan) <= chan.capacity, "bounded-depth contract broken"
+    return {
+        "channel": "bench.shed",
+        "policy": "shed_new",
+        "items": items,
+        "accepted": accepted,
+        "depth_high_water": chan.high_water,
+        "capacity": chan.capacity,
+        "shed_total": chan.shed_total,
+    }
+
+
+async def run(items: int = 20000, burst: int = 256) -> Dict:
+    block = await _block_phase(items, burst)
+    shed = await _shed_phase(items)
+    return {
+        "bench": "chan_burst",
+        "items": items,
+        "burst": burst,
+        "phases": {"block": block, "shed": shed},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.chan_bench",
+        description="channel-registry producer/consumer burst bench")
+    ap.add_argument("--items", type=int, default=20000)
+    ap.add_argument("--burst", type=int, default=256)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    artifact = asyncio.run(run(args.items, args.burst))
+    if args.as_json:
+        print(json.dumps(artifact, indent=2))
+    else:
+        b = artifact["phases"]["block"]
+        s = artifact["phases"]["shed"]
+        print(f"block: {b['puts_per_s']:.0f} puts/s, depth hw "
+              f"{b['depth_high_water']}/{b['capacity']}, put-block "
+              f"p99 {b['put_block_p99_us']}us")
+        print(f"shed:  {s['accepted']}/{s['items']} accepted, "
+              f"{s['shed_total']:.0f} shed, depth hw "
+              f"{s['depth_high_water']}/{s['capacity']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

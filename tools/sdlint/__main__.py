@@ -8,6 +8,7 @@
     python -m tools.sdlint --write-baseline    # bootstrap (see policy!)
     python -m tools.sdlint --flag-table        # README flag table stdout
     python -m tools.sdlint --timeout-table     # README timeout table
+    python -m tools.sdlint --chan-table        # README channel table
     python -m tools.sdlint --stats             # per-pass counts + wall-time
 
 Exit status: 0 when every finding is baselined (or none), 1 otherwise.
@@ -74,6 +75,9 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-table", action="store_true",
                     help="print the generated README timeout table "
                          "and exit")
+    ap.add_argument("--chan-table", action="store_true",
+                    help="print the generated README channel table "
+                         "and exit")
     ap.add_argument("--stats", action="store_true",
                     help="per-pass finding counts and wall-time "
                          "(informational; exit 0)")
@@ -94,6 +98,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import timeouts
         print(timeouts.timeout_table_markdown())
+        return 0
+
+    if args.chan_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import channels
+        print(channels.chan_table_markdown())
         return 0
 
     if args.stats:

@@ -15,6 +15,9 @@ from .host_transfer import HostTransferPass
 from .task_lifecycle import TaskLifecyclePass
 from .cancellation_safety import CancellationSafetyPass
 from .timeout_discipline import TimeoutDisciplinePass
+from .queue_discipline import QueueDisciplinePass
+from .backpressure import BackpressurePass
+from .unbounded_growth import UnboundedGrowthPass
 
 PASSES = {
     p.name: p for p in (
@@ -23,6 +26,8 @@ PASSES = {
         DtypeDisciplinePass(), HostTransferPass(),
         TaskLifecyclePass(), CancellationSafetyPass(),
         TimeoutDisciplinePass(),
+        QueueDisciplinePass(), BackpressurePass(),
+        UnboundedGrowthPass(),
     )
 }
 

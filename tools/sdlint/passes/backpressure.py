@@ -1,0 +1,209 @@
+"""Pass: backpressure — every producer handles `full`.
+
+A bounded channel only helps if its producers do something sane at
+the bound: await a BUDGETED put (block policy — the wait is the
+backpressure), or shed/coalesce by declared policy. The failure
+shapes this pass encodes are the ones the registry adoption killed:
+a `put_nowait` straight into a block-policy channel (silently
+reintroducing the unbounded-or-crash choice), a fan-out loop
+appending to per-subscriber buffers no bound ever touches (the
+pre-registry ws emit path), and a `send_nowait` burst with no drain
+point in the loop (a wedged peer then buffers the whole stream in
+the transport).
+
+Codes:
+
+- ``block-without-budget`` — a declared block-policy queue contract
+  whose `put_budget` is missing or not a declared timeouts.py name
+  (checked against both registries' AST; `declare_channel` also
+  raises at import, but the build must fail without importing).
+- ``nowait-on-block`` — `put_nowait` on an attribute constructed from
+  a block-policy registry channel: the producer must use the
+  budgeted `await put()` (ChannelFull at runtime is the sanitizer
+  twin of this finding).
+- ``unbounded-fanout`` — inside a `for`/`async for`, an
+  `append`/`put_nowait` onto a receiver rooted at the LOOP VARIABLE
+  (a per-subscriber/per-peer buffer written once per fan-out round):
+  nothing bounds what one slow subscriber accumulates — route the
+  fan-out through a registered channel per subscriber.
+- ``burst-without-drain`` — a loop body issuing `send_nowait` with no
+  awaited drain/flush or budgeted wait anywhere in the same loop:
+  bursts must close their window (sync_net's CLONE_WINDOW drain is
+  the sanctioned shape, and proto's frame Window enforces the cap at
+  runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, Project, SourceFile, dotted, own_body_walk
+from .queue_discipline import CENTRAL, declared_channels
+from .timeout_discipline import declared_timeouts
+
+PASS = "backpressure"
+
+_DRAIN_LAST = {"drain", "flush", "with_timeout", "wait_for", "put",
+               "get", "recv"}
+
+
+def _registered_block_attrs(cls: ast.ClassDef,
+                            declared: Dict[str, Dict]) -> Set[str]:
+    """Attrs of `cls` assigned from channels.channel("<name>") where
+    <name> is a declared block-policy queue."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        d = dotted(node.value.func)
+        if d is None or d.rsplit(".", 1)[-1] != "channel":
+            continue
+        args = node.value.args
+        if not (args and isinstance(args[0], ast.Constant)
+                and isinstance(args[0].value, str)):
+            continue
+        spec = declared.get(args[0].value)
+        if spec is None or spec.get("policy") != "block":
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+class BackpressurePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_channels(project.root)
+        timeouts = declared_timeouts(project.root)
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        # Contract-level rule: block queues must carry a real budget.
+        for name, spec in sorted(declared.items()):
+            if spec.get("policy") != "block" or \
+                    spec.get("kind") != "queue":
+                continue
+            budget = spec.get("put_budget")
+            if not budget or budget not in timeouts:
+                emit(Finding(
+                    PASS, "block-without-budget", CENTRAL, "", name,
+                    f"block-policy channel {name!r} needs put_budget "
+                    "naming a declared timeouts.py budget (producers "
+                    "must never wait unbounded)",
+                    spec.get("lineno", 0)))
+
+        for src in project.files:
+            if src.relpath == CENTRAL:
+                continue
+            self._check_file(src, declared, emit)
+        return findings
+
+    def _check_file(self, src: SourceFile, declared: Dict, emit) -> None:
+        block_attrs_by_cls: Dict[str, Set[str]] = {}
+        fn_cls: Dict[int, str] = {}  # id(fn node) → class name, one sweep
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                block_attrs_by_cls[node.name] = _registered_block_attrs(
+                    node, declared)
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        fn_cls[id(child)] = node.name
+        for fn in [f for f in ast.walk(src.tree)
+                   if isinstance(f, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            cls = fn_cls.get(id(fn))
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            self._check_fn(src, fn, qual,
+                           block_attrs_by_cls.get(cls or "", set()),
+                           emit)
+
+    def _check_fn(self, src: SourceFile, fn, qual: str,
+                  block_attrs: Set[str], emit) -> None:
+        rel = src.relpath
+        for node in own_body_walk(fn):
+            # nowait-on-block
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None:
+                    parts = d.split(".")
+                    if parts[-1] == "put_nowait" and len(parts) == 3 \
+                            and parts[0] == "self" and \
+                            parts[1] in block_attrs:
+                        emit(Finding(
+                            PASS, "nowait-on-block", rel, qual,
+                            f"self.{parts[1]}.put_nowait",
+                            f"put_nowait on block-policy channel "
+                            f"`self.{parts[1]}`: use the budgeted "
+                            "`await put()` — full must mean "
+                            "backpressure, not ChannelFull",
+                            node.lineno))
+            # loop-scoped rules
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            self._check_loop(src, node, qual, emit)
+
+    def _loop_subtree(self, loop: ast.AST):
+        """The loop's body/orelse, not descending into nested defs."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_loop(self, src: SourceFile, loop: ast.AST, qual: str,
+                    emit) -> None:
+        rel = src.relpath
+        target_names: Set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(loop.target):
+                if isinstance(sub, ast.Name):
+                    target_names.add(sub.id)
+        sends: List[ast.Call] = []
+        has_drain_await = False
+        for n in self._loop_subtree(loop):
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
+                d = dotted(n.value.func)
+                if d is not None and \
+                        d.rsplit(".", 1)[-1] in _DRAIN_LAST:
+                    has_drain_await = True
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            last = parts[-1]
+            if last == "send_nowait":
+                sends.append(n)
+            if last in ("append", "put_nowait") and len(parts) >= 2 \
+                    and parts[0] in target_names:
+                emit(Finding(
+                    PASS, "unbounded-fanout", rel, qual, d,
+                    f"per-subscriber buffer write `{d}` inside a "
+                    "fan-out loop with no bound: a slow subscriber "
+                    "accumulates unbounded memory — deliver through a "
+                    "registered bounded channel",
+                    n.lineno))
+        if sends and not has_drain_await:
+            d = dotted(sends[0].func) or "send_nowait"
+            emit(Finding(
+                PASS, "burst-without-drain", rel, qual, d,
+                f"`{d}` burst inside a loop with no awaited "
+                "drain/budgeted wait: the window never closes and "
+                "a wedged receiver buffers the whole stream",
+                sends[0].lineno))

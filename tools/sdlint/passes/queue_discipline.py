@@ -1,0 +1,344 @@
+"""Pass: queue-discipline — every cross-task channel is a declared,
+bounded registry channel.
+
+A bare `asyncio.Queue()` has no capacity, no overflow policy, no
+metrics, and no owner: the moment its consumer stalls, the producer
+absorbs unbounded memory (the pre-registry media actor queue could
+swallow a whole library index behind one slow thumbnailer). The
+discipline mirrors flags.py / timeouts.py: every channel is DECLARED
+in `spacedrive_tpu/channels.py` (name, capacity, policy, owner;
+README table via `--chan-table`) and constructed through
+`channels.channel(name)` / `channels.window(name)` /
+`channels.bounded_dict(name)`.
+
+Codes:
+
+- ``bare-queue`` — an `asyncio.Queue(...)` construction anywhere
+  outside the central registry. There is no sanctioned bare queue:
+  even flow-controlled ones must declare capacity and policy so the
+  load-harness can audit (and scale) them in one place.
+- ``unbounded-deque-channel`` — a `deque()` with no `maxlen` assigned
+  to an instance/module attribute and used as a producer/consumer
+  channel (the class both appends to it and pops from its head —
+  the pre-registry jobs run-queue shape). Function-local deques are
+  work lists, not channels, and are exempt.
+- ``unregistered-put`` — `put_nowait` on a receiver known to be a
+  bare (unregistered) queue: a self-attribute the class assigned a
+  bare queue/deque, or a local variable assigned one in the same
+  function. Receivers of unknown origin (parameters) are left to the
+  construction-site rules.
+- ``unregistered-send-buffer`` — a class that defines `send_nowait`
+  (the buffered-transport idiom) without constructing a
+  `channels.window(...)` in the same class: send_nowait's whole point
+  is deferring the flush, so its buffer must be depth-tracked.
+- ``undeclared-channel`` / ``dynamic-channel-name`` — a
+  `channels.channel/window/bounded_dict` call whose name literal is
+  missing from the registry, or is not a literal at all (the table
+  must stay static) — exactly the timeout-discipline name rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Project, SourceFile, dotted, own_body_walk
+
+PASS = "queue-discipline"
+
+CENTRAL = "spacedrive_tpu/channels.py"
+_FACTORIES = {"channel", "window", "bounded_dict"}
+_DEQUE_GROW = {"append", "appendleft", "extend"}
+_DEQUE_DRAIN = {"popleft", "pop", "get_nowait"}
+
+
+def declared_channels(root: str) -> Dict[str, Dict]:
+    """Contracts from `declare_channel(...)` calls in the central
+    registry (AST — the linted tree is never imported). Returns
+    name → {capacity, policy, put_budget, kind, lineno}."""
+    out: Dict[str, Dict] = {}
+    path = os.path.join(root, CENTRAL)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) == "declare_channel" and node.args):
+            continue
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            continue
+        spec = {"capacity": 0, "policy": "", "put_budget": None,
+                "kind": "queue", "lineno": node.lineno}
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            spec["capacity"] = int(node.args[1].value)
+        if len(node.args) > 2 and isinstance(node.args[2], ast.Constant):
+            spec["policy"] = str(node.args[2].value)
+        for kw in node.keywords:
+            if kw.arg in ("put_budget", "kind") and \
+                    isinstance(kw.value, ast.Constant):
+                spec[kw.arg] = kw.value.value
+        out[name.value] = spec
+    return out
+
+
+def _is_bare_queue(call: ast.Call, src: SourceFile) -> bool:
+    d = dotted(call.func)
+    if d == "asyncio.Queue":
+        return True
+    if d == "Queue" and "from asyncio import" in src.src and \
+            _imported_from(src.tree, "asyncio", "Queue"):
+        return True
+    return False
+
+
+def _imported_from(tree: ast.Module, module: str, name: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            if any((a.asname or a.name) == name for a in node.names):
+                return True
+    return False
+
+
+def _is_bare_deque(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d not in ("deque", "collections.deque"):
+        return False
+    return not any(kw.arg == "maxlen" for kw in call.keywords)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a `self.x` attribute node."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _factory_call(call: ast.Call) -> Optional[str]:
+    """The factory name for channels.channel/window/bounded_dict
+    calls (bare or module-qualified), else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last not in _FACTORIES:
+        return None
+    if "." in d and not d.startswith(("channels.", "self.")):
+        return None
+    return last
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        # attr → ("queue"|"deque", lineno) for bare constructions
+        self.bare_attrs: Dict[str, tuple] = {}
+        self.registered_attrs: Set[str] = set()
+        self.deque_grow: Set[str] = set()
+        self.deque_drain: Set[str] = set()
+        self.defines_send_nowait = False
+        self.has_window = False
+        self.send_nowait_line = 0
+
+
+class QueueDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_channels(project.root)
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for src in project.files:
+            if src.relpath == CENTRAL:
+                continue
+            self._check_file(src, declared, emit)
+        return findings
+
+    # -- per-file ----------------------------------------------------------
+
+    def _check_file(self, src: SourceFile, declared: Dict, emit) -> None:
+        classes: Dict[str, _ClassInfo] = {}
+        # class collection walk (includes nested defs: channel shape is
+        # a class-wide property)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = self._scan_class(node)
+        # constructions + name checks, everywhere
+        cls_stack: List[str] = []
+        self._walk(src, src.tree, cls_stack, classes, declared, emit,
+                   qual="")
+
+        for info in classes.values():
+            if info.defines_send_nowait and not info.has_window:
+                emit(Finding(
+                    PASS, "unregistered-send-buffer", src.relpath,
+                    f"{info.name}.send_nowait", info.name,
+                    "class defines send_nowait without a "
+                    "channels.window(...) depth tracker: the deferred "
+                    "flush buffer must be declared and capped",
+                    info.send_nowait_line))
+            for attr, (kind, lineno) in info.bare_attrs.items():
+                if kind != "deque":
+                    continue
+                if attr in info.deque_grow and attr in info.deque_drain:
+                    emit(Finding(
+                        PASS, "unbounded-deque-channel", src.relpath,
+                        info.name, f"self.{attr}",
+                        f"unbounded deque `self.{attr}` used as a "
+                        "producer/consumer channel: declare it in "
+                        "spacedrive_tpu/channels.py and construct via "
+                        "channels.channel(name)",
+                        lineno))
+
+    def _scan_class(self, cls: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(cls.name)
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "send_nowait":
+                info.defines_send_nowait = True
+                info.send_nowait_line = node.lineno
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    factory = _factory_call(value)
+                    if factory is not None:
+                        info.registered_attrs.add(attr)
+                        if factory == "window":
+                            info.has_window = True
+                    elif dotted(value.func) == "asyncio.Queue":
+                        info.bare_attrs[attr] = ("queue", value.lineno)
+                    elif _is_bare_deque(value):
+                        info.bare_attrs[attr] = ("deque", value.lineno)
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is None or not d.startswith("self."):
+                    continue
+                parts = d.split(".")
+                if len(parts) != 3:
+                    continue
+                _self, attr, method = parts
+                if method in _DEQUE_GROW:
+                    info.deque_grow.add(attr)
+                elif method in _DEQUE_DRAIN:
+                    info.deque_drain.add(attr)
+        return info
+
+    # -- recursive walk with class context ----------------------------------
+
+    def _walk(self, src: SourceFile, node: ast.AST, cls_stack: List[str],
+              classes: Dict[str, _ClassInfo], declared: Dict, emit,
+              qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cls_stack.append(child.name)
+                self._walk(src, child, cls_stack, classes, declared,
+                           emit, qual=child.name)
+                cls_stack.pop()
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fq = f"{qual}.{child.name}" if qual else child.name
+                self._check_fn(src, child, cls_stack, classes, declared,
+                               emit, fq)
+                self._walk(src, child, cls_stack, classes, declared,
+                           emit, qual=fq)
+                continue
+            # module-level statements
+            if isinstance(child, (ast.Assign, ast.Expr)):
+                self._check_stmt(src, child, cls_stack, classes,
+                                 declared, emit, qual, local_queues=set())
+            self._walk(src, child, cls_stack, classes, declared, emit,
+                       qual=qual)
+
+    def _check_fn(self, src: SourceFile, fn: ast.AST,
+                  cls_stack: List[str], classes: Dict, declared: Dict,
+                  emit, qual: str) -> None:
+        # Two phases: collect local bare-queue names first (the body
+        # walk is unordered), then check call sites against them.
+        local_queues: Set[str] = set()
+        for node in own_body_walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    dotted(node.value.func) == "asyncio.Queue":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_queues.add(tgt.id)
+        for node in own_body_walk(fn):
+            self._check_stmt(src, node, cls_stack, classes, declared,
+                             emit, qual, local_queues)
+
+    def _check_stmt(self, src: SourceFile, node: ast.AST,
+                    cls_stack: List[str], classes: Dict, declared: Dict,
+                    emit, qual: str, local_queues: Set[str]) -> None:
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            if _is_bare_queue(call, src):
+                emit(Finding(
+                    PASS, "bare-queue", src.relpath, qual,
+                    "asyncio.Queue",
+                    "bare asyncio.Queue(): cross-task channels must be "
+                    "declared in spacedrive_tpu/channels.py and "
+                    "constructed via channels.channel(name)",
+                    call.lineno))
+            factory = _factory_call(call)
+            if factory is not None:
+                self._check_name(src, call, declared, emit, qual)
+            d = dotted(call.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if parts[-1] == "put_nowait":
+                recv = parts[:-1]
+                if len(recv) == 2 and recv[0] == "self" and cls_stack:
+                    info = classes.get(cls_stack[-1])
+                    if info is not None and recv[1] in info.bare_attrs:
+                        emit(Finding(
+                            PASS, "unregistered-put", src.relpath, qual,
+                            f"self.{recv[1]}.put_nowait",
+                            f"put_nowait on unregistered channel "
+                            f"`self.{recv[1]}`: declare it in "
+                            "channels.py so capacity and overflow "
+                            "policy are auditable",
+                            call.lineno))
+                elif len(recv) == 1 and recv[0] in local_queues:
+                    emit(Finding(
+                        PASS, "unregistered-put", src.relpath, qual,
+                        f"{recv[0]}.put_nowait",
+                        f"put_nowait on unregistered local queue "
+                        f"`{recv[0]}`: declare it in channels.py",
+                        call.lineno))
+
+    def _check_name(self, src: SourceFile, call: ast.Call,
+                    declared: Dict, emit, qual: str) -> None:
+        arg = call.args[0] if call.args else None
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            emit(Finding(
+                PASS, "dynamic-channel-name", src.relpath, qual,
+                "non-literal",
+                "channel name must be a string literal so the "
+                "registry table stays static",
+                call.lineno))
+            return
+        if arg.value not in declared:
+            emit(Finding(
+                PASS, "undeclared-channel", src.relpath, qual,
+                arg.value,
+                f"channel {arg.value!r} is not declared in "
+                "spacedrive_tpu/channels.py",
+                call.lineno))

@@ -1,0 +1,257 @@
+"""Pass: unbounded-growth — long-lived components must evict.
+
+A bounded channel is pointless next to an instance dict that gains a
+key per event and never loses one: in a component that lives as long
+as the node (an actor loop, a supervised spawner, a start/stop
+service), a grow-only collection IS a memory leak with a workload
+knob. This pass finds instance collections in long-lived classes —
+and module-level collections in the engine package — that only ever
+grow: `append`/`add`/`extend`/`[k] =`/`setdefault`/`update` somewhere,
+with no `pop`/`popleft`/`popitem`/`remove`/`discard`/`clear`/`del`/
+reassignment on ANY path in the same class (nested closures count:
+an unsubscribe lambda is a legitimate eviction path).
+
+Scope:
+
+- **Long-lived classes** only: a class whose body contains a
+  ``while True`` loop, spawns through the task supervisor
+  (`tasks.spawn`), or defines both `start` and `stop` — the actor /
+  service shapes. Request-scoped helpers may accumulate freely; their
+  lifetime bounds them.
+- **Module level** inside `spacedrive_tpu/` (CLIs under tools/ are
+  single-shot; fixtures opt in with a ``# sdlint-scope: growth``
+  head marker). The central declaration registries (flags, timeouts,
+  channels, telemetry, the jit contract table) are exempt by path:
+  their dicts are written once at import by design.
+- **Registry-declared caches are exempt**: an attribute constructed
+  through `channels.channel/window/bounded_dict(...)` carries its own
+  declared bound, as does any `deque(maxlen=...)`.
+
+Code: ``grow-only``, anchored at the collection's construction line so
+an `# sdlint: ok[unbounded-growth]` marker (with its reason) sits next
+to the declaration it waives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, SourceFile, dotted
+
+PASS = "unbounded-growth"
+
+SCOPE_PREFIX = "spacedrive_tpu/"
+SCOPE_MARKER = "# sdlint-scope: growth"
+# Central declaration registries: module dicts written at import time
+# by design (the adoption passes themselves read them).
+EXEMPT_MODULES = {
+    "spacedrive_tpu/flags.py",
+    "spacedrive_tpu/timeouts.py",
+    "spacedrive_tpu/channels.py",
+    "spacedrive_tpu/telemetry.py",
+    "spacedrive_tpu/ops/jit_registry.py",
+}
+
+_GROW = {"append", "appendleft", "add", "extend", "insert",
+         "setdefault", "update"}
+_SHRINK = {"pop", "popleft", "popitem", "remove", "discard", "clear"}
+_COLLECTION_CTORS = {"dict", "set", "list", "deque", "OrderedDict",
+                     "defaultdict"}
+_REGISTRY_CTORS = {"channel", "window", "bounded_dict"}
+
+
+def _collection_ctor(value: ast.AST) -> Optional[str]:
+    """'bounded' | 'registry' | 'plain' | None for an assigned value.
+    A NON-EMPTY list literal is fixed-slot state (`[0, 0]` counters,
+    build-time tables): subscript writes update it, they don't grow
+    it — treated as bounded."""
+    if isinstance(value, (ast.Dict, ast.Set)):
+        return "plain"
+    if isinstance(value, ast.List):
+        return "bounded" if value.elts else "plain"
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted(value.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last in _REGISTRY_CTORS:
+        return "registry"
+    if last not in _COLLECTION_CTORS:
+        return None
+    if last == "deque" and any(kw.arg == "maxlen"
+                               for kw in value.keywords):
+        return "bounded"
+    return "plain"
+
+
+def _root_attr(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """("self", "x") for `self.x`, ("", "x") for a bare name `x`."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name):
+        return node.value.id, node.attr
+    if isinstance(node, ast.Name):
+        return "", node.id
+    return None
+
+
+class _Tracker:
+    """Grow/shrink evidence for one namespace (a class's self-attrs,
+    or a module's globals)."""
+
+    def __init__(self):
+        self.collections: Dict[str, Tuple[int, str]] = {}  # name → (line, kind)
+        self.grown: Set[str] = set()
+        self.shrunk: Set[str] = set()
+
+    def note_assign(self, name: str, value: ast.AST, lineno: int,
+                    is_init: bool) -> None:
+        kind = _collection_ctor(value)
+        if kind is not None:
+            if name not in self.collections:
+                self.collections[name] = (lineno, kind)
+            elif not is_init:
+                # reassignment elsewhere is a reset path
+                self.shrunk.add(name)
+        elif name in self.collections and not is_init:
+            self.shrunk.add(name)
+
+    def findings(self, rel: str, qual: str, emit) -> None:
+        for name, (lineno, kind) in sorted(self.collections.items()):
+            if kind in ("bounded", "registry"):
+                continue
+            if name in self.grown and name not in self.shrunk:
+                where = f"self.{name}" if qual else name
+                emit(Finding(
+                    PASS, "grow-only", rel, qual, where,
+                    f"collection `{where}` only grows (no eviction/"
+                    "discard/maxlen on any path in this long-lived "
+                    "component): bound it, evict it, or declare it a "
+                    "registry cache (channels.bounded_dict)",
+                    lineno))
+
+
+def _scan(body_walker, tracker: _Tracker, attr_root: str) -> None:
+    """Record grow/shrink ops on `attr_root`-rooted receivers
+    (attr_root 'self' for classes, '' for module globals)."""
+    for node in body_walker:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                # growth via subscript write: self.x[k] = v / x[k] = v
+                if isinstance(tgt, ast.Subscript):
+                    root = _root_attr(tgt.value)
+                    if root is not None and root[0] == attr_root:
+                        tracker.grown.add(root[1])
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    root = _root_attr(tgt.value)
+                    if root is not None and root[0] == attr_root:
+                        tracker.shrunk.add(root[1])
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if len(parts) < 2:
+                continue
+            last = parts[-1]
+            recv = parts[:-1]
+            match = (attr_root == "self" and len(recv) == 2
+                     and recv[0] == "self") or \
+                    (attr_root == "" and len(recv) == 1)
+            if not match:
+                continue
+            name = recv[-1]
+            if last in _GROW:
+                tracker.grown.add(name)
+            elif last in _SHRINK:
+                tracker.shrunk.add(name)
+
+
+def _is_long_lived(cls: ast.ClassDef) -> bool:
+    has_start = has_stop = False
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == "start":
+                has_start = True
+            if node.name == "stop":
+                has_stop = True
+        if isinstance(node, ast.While) and \
+                isinstance(node.test, ast.Constant) and \
+                node.test.value is True:
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.rsplit(".", 1)[-1] == "spawn" and \
+                    (d == "spawn" or d.endswith("tasks.spawn")):
+                return True
+    return has_start and has_stop
+
+
+class UnboundedGrowthPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for src in project.files:
+            head = "\n".join(src.lines[:5])
+            in_scope = src.relpath.startswith(SCOPE_PREFIX) or \
+                SCOPE_MARKER in head
+            if not in_scope or src.relpath in EXEMPT_MODULES:
+                continue
+            self._check_module(src, emit)
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef) and \
+                        _is_long_lived(node):
+                    self._check_class(src, node, emit)
+        return findings
+
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef,
+                     emit) -> None:
+        tracker = _Tracker()
+        # collection attrs: self.x = {} / [] / set() / deque() ...
+        for fn in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            is_init = fn.name == "__init__"
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets \
+                        if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in targets:
+                        root = _root_attr(tgt)
+                        if root is not None and root[0] == "self":
+                            tracker.note_assign(
+                                root[1], node.value, node.lineno,
+                                is_init)
+        _scan(ast.walk(cls), tracker, attr_root="self")
+        tracker.findings(src.relpath, cls.name, emit)
+
+    def _check_module(self, src: SourceFile, emit) -> None:
+        tracker = _Tracker()
+        for node in src.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                targets = [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    tracker.note_assign(tgt.id, node.value,
+                                        node.lineno, is_init=True)
+        # mutations anywhere in the module (function bodies included)
+        _scan(ast.walk(src.tree), tracker, attr_root="")
+        tracker.findings(src.relpath, "", emit)
