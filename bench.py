@@ -20,8 +20,8 @@ inside one jitted scan with a loop-carried dependency, timed with a
 single D2H sync — per-call wall timing through the axon tunnel measures
 RPC latency, not the kernel (tools/perf_probe.py documents this). The
 kernel number excludes H2D; `h2d_gbps` and `e2e_overlapped_files_per_sec`
-(steady-state double-buffered pipeline = max(transfer, compute)) are
-reported alongside so the end-to-end story is explicit.
+(steady-state depth-N pipeline = max(transfer, compute) per device
+stream) are reported alongside so the end-to-end story is explicit.
 """
 
 from __future__ import annotations
@@ -131,9 +131,10 @@ def main() -> None:
     t_h2d = (max(per_probe - t_sync, 1e-4)
              * (words.nbytes / probe.nbytes) + t_sync)
 
-    # MEASURED double-buffered pipeline (ops/overlap.py): C++ staging of
-    # batch i+1 overlaps H2D+kernel of batch i, digests retired with a
-    # one-batch lag. Corpus is sparse files sized so the run is ~20-40 s
+    # MEASURED depth-N pipeline (ops/overlap.py): concurrent C++ staging
+    # of batches i+1..i+k overlaps H2D+kernel of batch i across the
+    # device ring, digests retired with a one-batch lag.
+    # Corpus is sparse files sized so the run is ~20-40 s
     # at the probed link speed (the sum of stage+transfer+kernel serial
     # would be strictly larger; the bound field is what a perfect
     # pipeline would sustain from the same run's component times).
@@ -190,6 +191,23 @@ def main() -> None:
         "e2e_overlap_calibrations": breport["calibrations"],
         "e2e_overlap_binding_spread": breport["binding_component_spread"],
         "e2e_overlapped_bound_reason": breport["reason"],
+        # Depth-N pipeline shape of the measured run: how many batches
+        # were in flight, across which device ring, and how much of the
+        # staged footprint the donated kernel recycled.
+        "pipeline_depth": pstats.depth,
+        "pipeline_depth_high_water": pstats.depth_high_water,
+        "pipeline_devices": pstats.n_devices,
+        "pipeline_per_device_batches": pstats.per_device_batches,
+        "pipeline_donated": pstats.donate,
+        "pipeline_donated_reuse": pstats.donated_reuse,
+        "pipeline_h2d_gbps_measured":
+            round(pstats.h2d_bytes / pstats.h2d_s / 1e9, 3)
+            if pstats.h2d_s else 0.0,
+        "pipeline_stall_s": {
+            "stage": round(pstats.stage_s, 3),
+            "retire": round(pstats.retire_stall_s, 3),
+            "calibration": round(pstats.calibration_s, 3),
+        },
         "e2e_overlap_components_s": {
             "stage": round(pstats.t_stage_1, 3),
             "h2d": round(pstats.t_h2d_1, 3),

@@ -224,13 +224,21 @@ class Channel(_Metered):
     marks a shed batch done — its awaiters must not hang)."""
 
     def __init__(self, name: str,
-                 on_evict: Optional[Callable[[Any], None]] = None):
+                 on_evict: Optional[Callable[[Any], None]] = None,
+                 capacity_cap: Optional[int] = None):
         super().__init__(_contract(name))
         if self.contract.kind != "queue":
             raise ValueError(
                 f"channel {name!r} is declared kind="
                 f"{self.contract.kind!r}; use "
                 f"{'window' if self.contract.kind == 'window' else 'bounded_dict'}()")
+        if capacity_cap is not None:
+            # Runtime narrowing BELOW the declared ceiling is allowed —
+            # the contract is the upper bound the registry audits, not
+            # an exact size (the depth-N overlap pipeline sizes its
+            # hand-off channels to the configured depth, which must
+            # never exceed the declared ops.pipeline.* capacity).
+            self.capacity = max(1, min(self.capacity, int(capacity_cap)))
         self._on_evict = on_evict
         # Slots are [key, item] lists so a coalesce replacement mutates
         # in place, keeping the original queue position.
@@ -518,12 +526,14 @@ class BoundedDict(_Metered):
 
 
 def channel(name: str,
-            on_evict: Optional[Callable[[Any], None]] = None) -> Channel:
+            on_evict: Optional[Callable[[Any], None]] = None,
+            capacity_cap: Optional[int] = None) -> Channel:
     """A Channel bound to the declared contract `name`. Multiple
     instances per name are expected (one commands channel per worker,
     one ws buffer per subscription): the shed counter aggregates
-    across them; depth gauges sample per instance."""
-    return Channel(name, on_evict=on_evict)
+    across them; depth gauges sample per instance. `capacity_cap`
+    narrows this instance below the declared ceiling (never above)."""
+    return Channel(name, on_evict=on_evict, capacity_cap=capacity_cap)
 
 
 def window(name: str) -> Window:
@@ -595,6 +605,23 @@ declare_channel(
     "actor.py): a full-library scan against a slow thumbnailer sheds "
     "the oldest batch (thumbnails are regenerable; its awaiters are "
     "released) instead of absorbing the index into RAM.")
+
+declare_channel(
+    "ops.pipeline.inflight", 8, "block", "ops",
+    "Depth-N identify pipeline dispatched-but-unretired window "
+    "(ops/overlap.py): device digests (plus, on the undonated path, "
+    "their pinned input buffers) waiting for the D2H retirer. "
+    "Capacity is the SDTPU_PIPELINE_DEPTH ceiling; each run narrows "
+    "its instance to the configured depth.",
+    put_budget="ops.pipeline.inflight.put")
+
+declare_channel(
+    "ops.pipeline.staged", 8, "block", "ops",
+    "Depth-N identify pipeline staged-batch hand-off (ops/overlap.py): "
+    "host word/length arrays staged by the concurrent stagers, waiting "
+    "for a per-device dispatcher. Capacity is the SDTPU_PIPELINE_DEPTH "
+    "ceiling; each run narrows its instance to the configured depth.",
+    put_budget="ops.pipeline.staged.put")
 
 declare_channel(
     "p2p.route_cache", 512, "shed_oldest", "p2p",

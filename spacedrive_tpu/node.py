@@ -335,6 +335,16 @@ class Node:
             # library handles on top of the orphaned task.
             for lib in self.libraries.list():
                 lib.db.close()
+            # The shared staging executor (ops/staging.py) is module-
+            # global — threads the supervisor reap cannot see. Close it
+            # explicitly (off-loop: the close waits for in-flight
+            # reads; shielded so a cancelled shutdown still completes
+            # the pool close instead of abandoning it half-torn-down);
+            # a later identify in this process just re-creates it, so
+            # multi-node tests stay correct.
+            from .ops import staging as _staging
+            await asyncio.shield(
+                asyncio.to_thread(_staging.shutdown_stage_pool))
 
     async def close(self) -> None:
         """Alias for shutdown() — the supervisor docs' name for the

@@ -387,7 +387,22 @@ class FileIdentifierJob(StatefulJob):
         # targets the remaining case: the single-core host plane, where
         # nothing overlaps anyway and per-chunk commits were pure
         # overhead.
-        hash_ahead = not device_engaged and _usable_cpus() > 1
+        # Hash-ahead now covers device-engaged runs too, gated on the
+        # depth-N pipeline being enabled (SDTPU_PIPELINE_DEPTH > 1)
+        # AND buffer donation being on: donation is the actual safety
+        # condition — the worker's stage+H2D+hash of chunk k+1 then no
+        # longer pins a second batch's device inputs against chunk k's
+        # in-flight dispatch (the old single-client-tunnel hazard that
+        # forced device runs to serialize), so the device stream stays
+        # fed through the whole db+commit phase. Depth 1 or
+        # SDTPU_DONATE_BUFFERS=off restores the serial shape.
+        from .. import flags as _flags
+        from ..ops import overlap as _overlap
+        pipe_depth = _overlap.pipeline_depth()
+        hash_ahead = _usable_cpus() > 1 and (
+            not device_engaged
+            or (pipe_depth > 1
+                and bool(_flags.get("SDTPU_DONATE_BUFFERS"))))
         commit_every = (1 if device_engaged or hash_ahead
                         else max(1, min(8, 16384 // chunk)))
         data = {
@@ -399,16 +414,20 @@ class FileIdentifierJob(StatefulJob):
             # replays use the same pagination the steps were counted for.
             "chunk_size": chunk,
             "commit_every": commit_every,
+            # Recorded for the artifact trail (bench/perf_smoke report
+            # it): which pipeline depth the device stream ran under.
+            "pipeline_depth": pipe_depth if device_engaged else None,
             # Hash-ahead (stage+hash chunk i+1 in a worker thread while
-            # chunk i's transaction commits) runs only on the host
-            # planes: the device pipeline double-buffers internally and
-            # the tunnel is single-client, so overlapping two batched
-            # device calls would serialize or wedge it. Keyed off HOW
-            # the step size was chosen, not its numeric value. It also
-            # needs a second USABLE core (affinity/cgroup-aware, not
-            # cpu_count): measured on a 1-core host it LOSES ~8%
-            # (WAL+synchronous=NORMAL commits don't fsync, so there is
-            # no IO wait to hide under — only GIL contention).
+            # chunk i's transaction commits) runs on the host planes
+            # and, since the depth-N ring landed, on device-engaged
+            # runs whenever SDTPU_PIPELINE_DEPTH > 1 AND
+            # SDTPU_DONATE_BUFFERS is on (donated buffers are what
+            # make a second in-flight device batch safe — see the
+            # commit_every note above). It still needs a second USABLE
+            # core (affinity/cgroup-aware, not cpu_count): measured on
+            # a 1-core host it LOSES ~8% (WAL+synchronous=NORMAL
+            # commits don't fsync, so there is no IO wait to hide
+            # under — only GIL contention).
             "hash_ahead": hash_ahead,
             "cursor": 0,
             "linked": 0, "created": 0, "skipped": 0, "total_orphans": count,
@@ -566,7 +585,9 @@ class FileIdentifierJob(StatefulJob):
         # phase counters so /metrics shows live attribution mid-run
         # (and perf_smoke --telemetry sources its split from here).
         phase_before = dict(timings)
-        from ..ops.staging import _pool
+        # _submit (not a raw _pool().submit): survives another Node's
+        # concurrent shutdown_stage_pool() by landing on a fresh pool.
+        from ..ops.staging import _submit
 
         # Phase 1 — collect the whole commit group OUTSIDE any
         # transaction: fetch + stage + hash never run (or wait) under
@@ -587,13 +608,31 @@ class FileIdentifierJob(StatefulJob):
                 break
             cursor = rows[-1]["id"] + 1
             if data.get("hash_ahead"):
-                self._prefetch = (cursor, _pool().submit(
+                if prehashed is None:
+                    # Cold start (no matching hash-ahead prefetch —
+                    # job start or post-resume): hash THIS chunk
+                    # before submitting the next chunk's worker, so a
+                    # device backend's first-call jit compile happens
+                    # once, serially. Two threads tracing the same
+                    # cold program concurrently buy no overlap (the
+                    # second blocks on the compile anyway) and
+                    # stretch every event-loop callback under the
+                    # GIL — observed as sanitizer loop stalls on
+                    # 2-core hosts. Warm chunks keep the submit-first
+                    # order, so steady-state overlap is unchanged.
+                    prehashed = self._stage_and_hash(rows, data,
+                                                     timings)
+                self._prefetch = (cursor, _submit(
                     self._fetch_and_hash, ctx, data, cursor))
             else:
-                self._prefetch = (cursor, _pool().submit(
+                # Fetch-only prefetch (host planes): submit BEFORE the
+                # inline hash every chunk — the next page fetch hides
+                # under this chunk's hashing.
+                self._prefetch = (cursor, _submit(
                     self._timed_fetch, ctx, data, cursor))
-            if prehashed is None:
-                prehashed = self._stage_and_hash(rows, data, timings)
+                if prehashed is None:
+                    prehashed = self._stage_and_hash(rows, data,
+                                                     timings)
             chunks.append((rows, prehashed))
         if not chunks:
             return StepOutcome()
@@ -696,4 +735,6 @@ class FileIdentifierJob(StatefulJob):
             metadata["phase_ms"] = {
                 k: round(v * 1000.0, 1) for k, v in sorted(phase.items())}
             metadata["chunk_size"] = data.get("chunk_size")
+            if data.get("pipeline_depth") is not None:
+                metadata["pipeline_depth"] = data["pipeline_depth"]
         return metadata

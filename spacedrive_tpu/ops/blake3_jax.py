@@ -115,6 +115,28 @@ def _blake3_impl_best(words, lengths):
     return _blake3_impl(words, lengths)
 
 
+def _donated_best(words, lengths):
+    """Donated twin of the best-backend body: identity pass-through
+    outputs alias the donated inputs (same shape/dtype, so XLA's
+    input-output aliasing engages on every backend, CPU included),
+    meaning the staged device copies are CONSUMED at dispatch and
+    recycled by the allocator at kernel completion — instead of
+    surviving until the digest fetch like the undonated entry's."""
+    return _blake3_impl_best(words, lengths), words, lengths
+
+
+_blake3_best_donated = jit_registry.tracked("blake3.donated")(
+    jax.jit(_donated_best, donate_argnums=(0, 1)))
+
+
+def _donated_local(words, lengths):
+    """Local (single-device) CAS hasher over the donated entry: the
+    ring aliases are dropped on the floor — the identify pipeline only
+    wants the digests, the recycled buffers belong to the allocator."""
+    digests, _ring_w, _ring_l = _blake3_best_donated(words, lengths)
+    return digests
+
+
 def blake3_words(words, lengths):
     """[B, C, 256] uint32 words + [B] int32 lengths → [B, 8] uint32 digests.
 
@@ -259,7 +281,14 @@ def cas_ids_jax(payloads, sizes, payload_lens=None, hasher=None) -> list:
     if hasher is None:
         hasher, n_dev = sharded_hasher()
         if hasher is None:
-            hasher = blake3_words
+            # Single-device dispatch goes through the donated entry by
+            # default (SDTPU_DONATE_BUFFERS): the batch's staged device
+            # copy is recycled at kernel completion, not pinned until
+            # the CAS-ID fetch below. The words/lengths built here are
+            # per-call temporaries, so consuming them is always safe.
+            hasher = (_donated_local
+                      if flags.get("SDTPU_DONATE_BUFFERS")
+                      else blake3_words)
     words, lengths = build_cas_messages(payloads, sizes, payload_lens)
     B = words.shape[0]
     Bp = _bucket_b(B)

@@ -65,6 +65,14 @@ class JitContract:
     out_dtypes: Tuple[str, ...] = ()
     shape_buckets: str = ""        # the canonical-grid policy, prose
     host_transfer: bool = False    # results fetched via io(name)
+    # Positional args this entry point may CONSUME (jax donate_argnums):
+    # a donated buffer is invalid after the call — callers must treat
+    # it as moved, which is why donation is part of the declared
+    # contract surface (the sdlint jit-stability pass fails the build
+    # on a jit site donating argnums its contract does not declare).
+    # Declaring donation does not force it: sites may bind undonated
+    # variants of the same contract (SDTPU_DONATE_BUFFERS=off).
+    donate_argnums: Tuple[int, ...] = ()
 
 
 CONTRACTS: Dict[str, JitContract] = {}
@@ -76,7 +84,8 @@ def declare_jit(name: str, site: str, *, kind: str = "entry",
                 in_dtypes: Tuple[str, ...] = (),
                 out_dtypes: Tuple[str, ...] = (),
                 shape_buckets: str,
-                host_transfer: bool = False) -> JitContract:
+                host_transfer: bool = False,
+                donate_argnums: Tuple[int, ...] = ()) -> JitContract:
     if name in CONTRACTS:
         raise ValueError(f"jit contract {name!r} declared twice")
     if kind not in ("entry", "factory", "wrapper"):
@@ -87,7 +96,8 @@ def declare_jit(name: str, site: str, *, kind: str = "entry",
             f"policy (what keeps the compiled-program count bounded)")
     c = JitContract(name, site, kind, max_traces,
                     tuple(static_argnames), tuple(in_dtypes),
-                    tuple(out_dtypes), shape_buckets, host_transfer)
+                    tuple(out_dtypes), shape_buckets, host_transfer,
+                    tuple(donate_argnums))
     CONTRACTS[name] = c
     return c
 
@@ -335,6 +345,17 @@ declare_jit(
                   "pow2 B buckets as blake3.jnp, shards = devices")
 
 declare_jit(
+    "blake3.donated", "spacedrive_tpu/ops/blake3_jax.py::_donated_best",
+    max_traces=96, in_dtypes=("uint32", "int32"), out_dtypes=("uint32",),
+    donate_argnums=(0, 1),
+    shape_buckets="same canonical CAS grids as blake3.jnp; the donated "
+                  "twin cas_ids_jax dispatches when SDTPU_DONATE_BUFFERS "
+                  "is on — inputs are consumed (identity pass-through "
+                  "outputs alias them), so each CAS batch's staged "
+                  "device copy is recycled at kernel completion instead "
+                  "of surviving until the digest fetch")
+
+declare_jit(
     "cas.ids", "spacedrive_tpu/ops/blake3_jax.py::cas_ids_jax",
     kind="wrapper", host_transfer=True,
     out_dtypes=("str",),
@@ -456,11 +477,16 @@ declare_jit(
 
 declare_jit(
     "overlap.kernel", "spacedrive_tpu/ops/overlap.py::_jitted",
-    kind="factory", max_traces=16,
+    kind="factory", max_traces=64,
     in_dtypes=("uint32", "int32"), out_dtypes=("uint32",),
-    shape_buckets="lru-cached jit per kernel fn (the round-10 fix for "
-                  "the per-call jax.jit(fn) recompile); one large-class "
-                  "batch grid per run")
+    donate_argnums=(0, 1),
+    shape_buckets="lru-cached jit per (kernel fn, donate) pair (the "
+                  "round-10 fix for the per-call jax.jit(fn) "
+                  "recompile); one large-class batch grid per run, "
+                  "times the round-robin device count (committed "
+                  "inputs compile one program per device). The "
+                  "donated variant consumes its (words, lengths) "
+                  "inputs — the depth-N ring's recycled H2D buffers")
 
 declare_jit(
     "overlap.retire", "spacedrive_tpu/ops/overlap.py::run_overlapped",

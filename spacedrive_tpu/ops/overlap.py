@@ -1,23 +1,49 @@
-"""Double-buffered staging → H2D → kernel pipeline, measured for real.
+"""Depth-N staging → H2D → kernel → fetch pipeline, measured for real.
 
 SURVEY.md §7 hard-part 2 ("feeding the beast"): overlap C++ staging,
 host→device copies, and kernel execution so the end-to-end rate is set
-by the slowest stage, not their sum. Round 2 reported the steady-state
-number as a *formula* (`B / max(t_kernel, t_h2d)`); this module is the
-machinery itself, and bench.py now reports its measured rate.
+by the slowest stage, not their sum. Rounds 2-12 ran a TWO-batch
+double buffer (one stager thread, one batch deferred for retirement);
+this is the depth-N generalization that kills the H2D wall head-on:
 
-Shape of the pipeline (two batches in flight):
+    stagers (k):      stage(i+1) .. stage(i+k)          concurrent
+    device streams:   h2d+dispatch(i) per device,       round-robin
+    retire thread:    fetch(i-k+1..)                    one-batch lag
 
-    stager thread:   stage(i+1)          stage(i+2)         ...
-    main thread:     put+dispatch(i) ->  put+dispatch(i+1)  ...
-    retire:          fetch(i-1) while kernel(i) runs
-
-- staging runs on ONE worker thread calling the native C++ plane
-  (pooled pread, GIL released), so it overlaps the device round trip;
-- `jax.device_put` + the jitted kernel dispatch are asynchronous — the
-  only true sync on the axon platform is the D2H fetch, which is
-  deferred one batch so transfer/compute of batch i+1 can proceed
-  while batch i's digests stream back.
+- **depth** (`SDTPU_PIPELINE_DEPTH`, default 3) is the ring-slot
+  count: at most `depth` batches are simultaneously in flight from
+  stage start to digest retirement. Depth 1 is the fully serial
+  reference; depth ≥ 3 hides staging and the kernel under the H2D
+  transfer (or vice versa — whichever stage binds).
+- **staging** runs `depth` concurrent workers on the shared
+  `ops/staging.py` pool (native C++ plane, GIL released), not the old
+  single stager thread.
+- **hand-off** between stages goes through the PR 12 bounded-channel
+  registry: `ops.pipeline.staged` (stagers → dispatchers) and
+  `ops.pipeline.inflight` (dispatchers → retirer), block policy under
+  the `ops.pipeline.*.put` budgets, each instance narrowed to the
+  configured depth — so pipeline backpressure and depth are live
+  `sd_chan_*` metrics, and `sd_pipeline_*` adds the stall/bytes/ring
+  accounting.
+- **donated ring** (`SDTPU_DONATE_BUFFERS`, default on): the kernel
+  binds with `donate_argnums=(0, 1)` through the `overlap.kernel`
+  contract and passes its inputs through as aliased outputs, so each
+  batch's staged device buffers are CONSUMED at dispatch — the
+  allocator recycles them for a later batch's H2D instead of pinning
+  them until retirement. The undonated path keeps each batch's device
+  inputs alive in its in-flight record until its digests retire (the
+  conservative re-dispatchable shape), which is exactly the footprint
+  difference the donation test pins.
+- **devices**: when more than one local device exists (and
+  `SDTPU_PIPELINE_DEVICES` does not cap it), in-flight batches
+  round-robin across per-device dispatch streams — one committed
+  `device_put` + kernel stream per chip, the local half of the
+  multi-chip pipeline (the sharded blake3/mesh machinery provides the
+  device ring; see parallel/mesh.device_ring).
+- **sim-link mode** (`SDTPU_SIM_LINK_GBPS`): every H2D additionally
+  sleeps nbytes/rate per device stream, so CPU-only hosts pin the
+  overlap math deterministically — measured rate vs the
+  max(stage, h2d, kernel) bound at any depth — without TPU hardware.
 
 On a host whose device link is slower than the native plane, the
 pipeline's measured rate approaches the link bound (that is the honest
@@ -27,30 +53,86 @@ same code approaches the kernel bound.
 
 from __future__ import annotations
 
+import asyncio
 import functools
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import jit_registry
+from .. import channels, flags
+from ..telemetry import (
+    PIPELINE_DEPTH_HIGH_WATER,
+    PIPELINE_DEVICE_BATCHES,
+    PIPELINE_DONATED_REUSE,
+    PIPELINE_H2D_BYTES,
+    PIPELINE_H2D_SECONDS,
+    PIPELINE_RETIRE_STALL_SECONDS,
+    PIPELINE_STAGE_STALL_SECONDS,
+)
+
+# Must match the declared capacity of the ops.pipeline.* channels —
+# depth is clamped here so a run can never exceed the registry ceiling.
+MAX_PIPELINE_DEPTH = 8
+
+_DONE = object()  # dispatcher sentinel on the staged channel
+
+# Process-lifetime depth peak backing the sd_pipeline_depth_high_water
+# gauge: a later shallow run must not regress it below an earlier deep
+# run's peak (same contract as channels._NAME_HIGH_WATER).
+_DEPTH_HW = 0
+
+
+def pipeline_depth() -> int:
+    """The configured in-flight batch count, clamped to the declared
+    ops.pipeline.* channel capacity. Both pipeline flags are declared
+    strict — a malformed value raises out of flags.get rather than
+    silently running at a default shape."""
+    return max(1, min(int(flags.get("SDTPU_PIPELINE_DEPTH")),
+                      MAX_PIPELINE_DEPTH))
+
+
+def _pipeline_devices() -> tuple:
+    from ..parallel.mesh import device_ring
+
+    return device_ring(int(flags.get("SDTPU_PIPELINE_DEVICES")))
 
 
 @dataclass
 class PipelineStats:
     files: int = 0
     wall_s: float = 0.0       # measured loop time, calibration EXCLUDED
-    stage_s: float = 0.0      # stall time waiting on the stager thread
+    stage_s: float = 0.0      # dispatcher stall waiting on staged batches
+    retire_stall_s: float = 0.0  # retirer stall waiting on dispatches
     calibration_s: float = 0.0  # time spent in mid-run calibration pauses
     batches: int = 0
     batch_files: int = 0
+    # Pipeline shape of this run (the bound below depends on it).
+    depth: int = 2
+    n_devices: int = 1
+    donate: bool = False
+    sim_link_gbps: float = 0.0
+    # Transfer + ring accounting (mirrors the sd_pipeline_* families).
+    h2d_bytes: int = 0
+    h2d_s: float = 0.0
+    donated_reuse: int = 0
+    depth_high_water: int = 0
+    per_device_batches: Dict[str, int] = field(default_factory=dict)
+    # (live device arrays, words consumed, lengths consumed) sampled
+    # after each dispatch when run_overlapped(track_buffers=True) —
+    # the donation footprint test's probe, off by default.
+    buffer_samples: List[Tuple[int, bool, bool]] = field(
+        default_factory=list)
     # Serial reference components, measured on calibration batches
     # INTERLEAVED with the run: one before, one after, and one every
-    # few batches in between (the pipeline drains, the components get
-    # timed, the pipeline resumes). Rounds 4 and 5 calibrated outside
-    # the measurement window and the tunnel's minute-to-minute weather
+    # few batches in between (the stagers pause at a milestone, the
+    # pipeline drains productively, the components get timed, the
+    # pipeline resumes). Rounds 4 and 5 calibrated outside the
+    # measurement window and the tunnel's minute-to-minute weather
     # flipped measured/bound to opposite sides in consecutive
     # artifacts; same-window samples are what make the bound
     # comparable to the measurement at all.
@@ -63,6 +145,11 @@ class PipelineStats:
     t_stage_2: float = 0.0
     t_h2d_2: float = 0.0
     t_kernel_2: float = 0.0
+    # Guards the fields the per-device executor threads mutate
+    # (h2d_bytes/h2d_s/donated_reuse/buffer_samples): with >1 device
+    # stream a plain += is a lost-update race.
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     @property
     def files_per_sec(self) -> float:
@@ -76,12 +163,20 @@ class PipelineStats:
 
     @property
     def bound_files_per_sec(self) -> float:
-        """The max(stage, transfer, kernel+fetch) steady-state bound —
-        what a perfect pipeline would sustain under the BEST link
+        """The depth/device-aware steady-state bound — what a perfect
+        pipeline of THIS run's shape would sustain under the BEST
         conditions observed across the same-run interleaved
-        calibrations, so bound >= measured holds unless the link beat
-        every sample between two pauses."""
-        denom = max(self._component_bests())
+        calibrations. Staging parallelizes across the `depth`
+        concurrent stagers; H2D and the kernel serialize per device
+        stream; and the total ring depth caps overall concurrency
+        (at depth 1 the bound degenerates to the serial sum — no
+        overlap to promise). bound >= measured holds unless the link
+        beat every sample between two pauses."""
+        t_s, t_h, t_k = self._component_bests()
+        n_dev = max(self.n_devices, 1)
+        depth = max(self.depth, 1)
+        denom = max(t_h / n_dev, t_k / n_dev, t_s / depth,
+                    (t_s + t_h + t_k) / depth)
         return self.batch_files / denom if denom else 0.0
 
     @property
@@ -116,12 +211,14 @@ class PipelineStats:
                 f"which varied {self.bound_spread:.2f}x within this "
                 f"run; the measured rate averages over the troughs "
                 f"the best sample missed"
-                + (f", and {mid} mid-run pause(s) each leave up to one "
-                   f"un-overlapped batch refill in the measured wall"
+                + (f", and {mid} mid-run pause(s) each leave one "
+                   f"pipeline refill un-overlapped in the measured wall"
                    if mid else ""))
         return {"measured_files_per_sec": round(measured, 1),
                 "bound_files_per_sec": round(bound, 1),
                 "ratio": round(ratio, 3),
+                "depth": self.depth,
+                "devices": self.n_devices,
                 "calibrations": len(self.samples),
                 "binding_component_spread": round(self.bound_spread, 2),
                 "reason": reason}
@@ -136,16 +233,25 @@ def _default_kernel(words, lengths):
     return bj._blake3_impl_best(words, lengths)
 
 
-@functools.lru_cache(maxsize=8)
-def _jitted(fn: Callable):
-    """Module-cached jit per kernel fn — the round-10 jit-stability
-    fix: the old call-time `jax.jit(fn)` inside run_overlapped built a
-    fresh jit wrapper (and paid a fresh trace, ~10 s on TPU) on every
-    invocation, so each calibration pause recompiled a program the
-    previous run already owned."""
+@functools.lru_cache(maxsize=16)
+def _jitted(fn: Callable, donate: bool = False):
+    """Module-cached jit per (kernel fn, donate) — the round-10
+    jit-stability fix (the old call-time `jax.jit(fn)` paid a fresh
+    trace per invocation) plus the ring binding: the donated variant
+    consumes its (words, lengths) inputs under the `overlap.kernel`
+    contract's declared donate_argnums and passes them through as
+    aliased outputs, so the staged device buffers are recycled at
+    kernel completion instead of surviving until digest retirement."""
     import jax
 
-    return jit_registry.tracked("overlap.kernel")(jax.jit(fn))
+    if donate:
+        def _donating(words, lengths):
+            return fn(words, lengths), words, lengths
+
+        jf = jax.jit(_donating, donate_argnums=(0, 1))
+    else:
+        jf = jax.jit(fn)
+    return jit_registry.tracked("overlap.kernel")(jf)
 
 
 def _retire(x) -> np.ndarray:
@@ -170,31 +276,129 @@ def _stage_batch(paths: Sequence[str], sizes: np.ndarray):
     return bj.build_cas_messages(large.payloads, large.sizes)
 
 
+def _h2d(words, lengths, dev, stats: Optional[PipelineStats] = None):
+    """One batch's host→device transfer onto `dev`, plus the simulated
+    per-stream link delay when SDTPU_SIM_LINK_GBPS pins a rate. Runs
+    on the per-device dispatch thread (or the calibration thread) —
+    never on the pipeline's event loop."""
+    import jax
+
+    nbytes = int(words.nbytes + lengths.nbytes)
+    t0 = time.perf_counter()
+    w = jax.device_put(words, dev)
+    l = jax.device_put(lengths, dev)
+    gbps = flags.get("SDTPU_SIM_LINK_GBPS")
+    if gbps:
+        time.sleep(nbytes / (gbps * 1e9))
+    dt = time.perf_counter() - t0
+    PIPELINE_H2D_BYTES.inc(nbytes)
+    PIPELINE_H2D_SECONDS.inc(dt)
+    if stats is not None:
+        with stats._lock:
+            stats.h2d_bytes += nbytes
+            stats.h2d_s += dt
+    return w, l
+
+
+def _dispatch_kernel(jfn, w, l, donate: bool,
+                     stats: Optional[PipelineStats] = None):
+    """Dispatch one batch; returns (digests, keepalive).
+
+    Donated path: the kernel CONSUMES w/l (they are invalid after this
+    call) and the pass-through aliases are dropped on the floor, so the
+    buffers return to the allocator the moment the execution finishes —
+    recycled ring slots for a later batch's H2D. Undonated path: w/l
+    ride in the in-flight record until the digests retire (the batch
+    stays re-dispatchable, at the cost of depth × batch-bytes of pinned
+    device memory — the footprint donation removes)."""
+    if donate:
+        out, _ring_w, _ring_l = jfn(w, l)
+        PIPELINE_DONATED_REUSE.inc(2)
+        if stats is not None:
+            with stats._lock:
+                stats.donated_reuse += 2
+        return out, ()
+    return jfn(w, l), (w, l)
+
+
+def _transfer_and_dispatch(jfn, words, lengths, dev, donate: bool,
+                           stats: PipelineStats, track_buffers: bool):
+    """Per-device stream body (executor thread): H2D + kernel dispatch."""
+    w, l = _h2d(words, lengths, dev, stats)
+    out, keep = _dispatch_kernel(jfn, w, l, donate, stats)
+    if track_buffers:
+        import gc
+
+        import jax
+
+        # Debug-only probe: collect first so the count reflects buffers
+        # the PIPELINE holds (ring slots, in-flight records), not
+        # asyncio future/frame cycles awaiting generational GC. Only
+        # staging-CLASS buffers count (nbytes >= this batch's words
+        # array): the [B, 8] digests legitimately accumulate — on CPU
+        # the retired numpy views share their device buffers — while
+        # the staged words/lengths are exactly what donation recycles.
+        gc.collect()
+        threshold = words.nbytes
+        live = sum(1 for a in jax.live_arrays()
+                   if a.nbytes >= threshold)
+        with stats._lock:
+            stats.buffer_samples.append((
+                live, bool(w.is_deleted()), bool(l.is_deleted())))
+    return out, keep
+
+
 def run_overlapped(
     batches: Sequence[Tuple[Sequence[str], np.ndarray]],
     kernel: Optional[Callable] = None,
     calibrate_every: Optional[int] = None,
+    *,
+    depth: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    donate: Optional[bool] = None,
+    track_buffers: bool = False,
 ) -> Tuple[List[np.ndarray], PipelineStats]:
-    """Run the staged pipeline over pre-split file batches.
+    """Run the depth-N pipeline over pre-split file batches.
 
     batches: [(paths, sizes_u64)] — all large-class (> 100 KiB) files.
     kernel: (words, lengths) -> [B, 8] digests; defaults to the best
         device implementation (Pallas on TPU).
-    calibrate_every: drain the pipeline and re-time the serial
-        components every this many measured batches (default: ~2 mid-
-        run pauses), so the steady-state bound is computed from the
-        SAME weather window as the measurement — calibrating only
-        outside the run let the tunnel's drift flip measured/bound to
-        opposite sides in consecutive round artifacts. Calibration
-        pauses are excluded from the measured wall time.
+    calibrate_every: pause staging and re-time the serial components
+        every this many measured batches (default: ~2 mid-run pauses),
+        so the steady-state bound is computed from the SAME weather
+        window as the measurement. The pause is DEPTH-AWARE: the
+        stagers stop at the milestone, the in-flight batches drain
+        productively (their retirement stays in the measured wall —
+        it is real throughput), and only the serial component timing
+        itself is excluded from wall_s, so a pause costs the same at
+        depth 8 as at depth 1.
+    depth / devices / donate: override the SDTPU_PIPELINE_DEPTH /
+        SDTPU_PIPELINE_DEVICES / SDTPU_DONATE_BUFFERS flags for this
+        run (tests, benches).
+    track_buffers: sample (live device arrays, inputs consumed) after
+        every dispatch into stats.buffer_samples — the donation
+        footprint probe.
     Returns ([per-batch digests], stats). The returned digests are
     row-aligned with each batch's path order.
     """
     import jax
 
-    jfn = _jitted(kernel or _default_kernel)
+    if donate is None:
+        donate = bool(flags.get("SDTPU_DONATE_BUFFERS"))
+    if depth is None:
+        depth = pipeline_depth()
+    depth = max(1, min(int(depth), MAX_PIPELINE_DEPTH))
+    devs = tuple(devices) if devices else _pipeline_devices()
+    try:
+        sim_gbps = float(flags.get("SDTPU_SIM_LINK_GBPS") or 0.0)
+    except (TypeError, ValueError):
+        sim_gbps = 0.0
+
+    jfn = _jitted(kernel or _default_kernel, bool(donate))
     stats = PipelineStats(batches=len(batches),
-                          batch_files=len(batches[0][0]))
+                          batch_files=len(batches[0][0]),
+                          depth=depth, n_devices=len(devs),
+                          donate=bool(donate), sim_link_gbps=sim_gbps)
     if calibrate_every is None:
         calibrate_every = max(2, (len(batches) - 1) // 3)
 
@@ -205,7 +409,7 @@ def run_overlapped(
     # transfer rides the same ordered stream, so fetching it back
     # bounds the transfer.
     def _sync_marker() -> None:
-        _retire(jax.device_put(np.zeros(16, np.uint8)))
+        _retire(jax.device_put(np.zeros(16, np.uint8), devs[0]))
 
     paths0, sizes0 = batches[0]
 
@@ -214,70 +418,30 @@ def run_overlapped(
         words, lengths = _stage_batch(paths0, sizes0)
         t_stage = time.perf_counter() - t0
         t0 = time.perf_counter()
-        w = jax.device_put(words); l = jax.device_put(lengths)
+        w, l = _h2d(words, lengths, devs[0])
         _sync_marker()
         t_h2d = time.perf_counter() - t0
         t0 = time.perf_counter()
-        res = _retire(jfn(w, l))  # kernel + the (small) digest D2H
+        out, _keep = _dispatch_kernel(jfn, w, l, donate)
+        res = _retire(out)  # kernel + the (small) digest D2H
         t_kernel = time.perf_counter() - t0
         return t_stage, t_h2d, t_kernel, res
 
     # Warm the compile on batch 0 before the first timed sample.
     words, lengths = _stage_batch(paths0, sizes0)
-    _retire(jfn(jax.device_put(words), jax.device_put(lengths)))
+    out, _keep = _dispatch_kernel(jfn, *_h2d(words, lengths, devs[0]),
+                                  donate)
+    _retire(out)
     s0 = _calibrate()
     stats.samples.append(s0[:3])
-    res0 = s0[3]
-
-    pool = ThreadPoolExecutor(1, thread_name_prefix="overlap-stage")
     results: List[Optional[np.ndarray]] = [None] * len(batches)
-    results[0] = res0
+    results[0] = s0[3]
 
-    t_wall = time.perf_counter()
-    fut = None
     if len(batches) > 1:
-        fut = pool.submit(_stage_batch, *batches[1])
-    inflight: List[Tuple[int, object]] = []
-    for i in range(1, len(batches)):
-        ts = time.perf_counter()
-        words, lengths = fut.result()
-        stats.stage_s += time.perf_counter() - ts
-        if (i - 1) and (i - 1) % calibrate_every == 0 \
-                and i + 1 < len(batches):
-            # Mid-run calibration: the stager is idle (its result is in
-            # hand, the next submit hasn't happened), so drain the
-            # in-flight dispatches and time the serial components in
-            # the exact weather the pipeline is running through. The
-            # whole pause window — drain INCLUDED, since the forced
-            # early retire is overlap the pipeline loses to the pause —
-            # is excluded from the measured wall. Residual bias: the
-            # post-pause refill (one batch staged/dispatched with
-            # nothing in flight to hide under) stays in the wall, so
-            # each pause costs up to ~one un-overlapped batch; with the
-            # default ~2 pauses that is a small conservative tax on the
-            # measured rate, surfaced via `calibrations` in the report.
-            t_pause = time.perf_counter()
-            for j, prev in inflight:
-                results[j] = _retire(prev)
-            inflight.clear()
-            stats.samples.append(_calibrate()[:3])
-            pause = time.perf_counter() - t_pause
-            stats.calibration_s += pause
-            t_wall += pause  # shift the wall clock past the pause
-        if i + 1 < len(batches):
-            fut = pool.submit(_stage_batch, *batches[i + 1])
-        w = jax.device_put(words)
-        l = jax.device_put(lengths)
-        out = jfn(w, l)          # async dispatch
-        inflight.append((i, out))
-        if len(inflight) > 1:    # retire with one-batch lag
-            j, prev = inflight.pop(0)
-            results[j] = _retire(prev)
-    for j, prev in inflight:
-        results[j] = _retire(prev)
-    stats.wall_s = time.perf_counter() - t_wall
+        _run_pipeline(batches, jfn, devs, depth, bool(donate), stats,
+                      results, calibrate_every, _calibrate,
+                      track_buffers)
     stats.files = sum(len(p) for p, _ in batches[1:])
-    pool.shutdown()
 
     # Post-run sample: same components, same batch-0 data, measured the
     # moment the pipeline drains — the closing bracket of the same-run
@@ -286,6 +450,160 @@ def run_overlapped(
     (stats.t_stage_1, stats.t_h2d_1, stats.t_kernel_1) = stats.samples[0]
     (stats.t_stage_2, stats.t_h2d_2, stats.t_kernel_2) = stats.samples[-1]
     return results, stats
+
+
+def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
+                  stats: PipelineStats, results,
+                  calibrate_every: int, calibrate: Callable,
+                  track_buffers: bool) -> None:
+    """The measured depth-N loop over batches[1:]. Runs a private event
+    loop (run_overlapped is a synchronous API called from benches and
+    job worker threads) whose coroutines only coordinate — staging,
+    H2D+dispatch, and the D2H fetch all run on dedicated executor
+    threads, so nothing blocks the loop and the sanitizer's stall
+    detector stays quiet."""
+    from . import staging
+
+    n = len(batches)
+    n_stagers = min(depth, n - 1)
+    # Calibration milestones: after retiring batch m (1-indexed count),
+    # pause staging and re-time the serial components — same cadence as
+    # the old double-buffer ((i-1) % calibrate_every == 0 with room for
+    # at least one post-pause batch).
+    milestones = [m for m in range(calibrate_every + 1, n - 1,
+                                   calibrate_every)]
+    clock = {"start": 0.0}
+
+    async def main() -> None:
+        loop = asyncio.get_running_loop()
+        staged = channels.channel("ops.pipeline.staged",
+                                  capacity_cap=depth)
+        inflight = channels.channel("ops.pipeline.inflight",
+                                    capacity_cap=depth)
+        # depth tickets bound TOTAL in-flight batches (stage start →
+        # digest retired); the two channels bound (and meter) each
+        # hand-off edge within that.
+        tickets = asyncio.Semaphore(depth)
+        state = {"next": 1, "in_flight": 0, "retired": 0,
+                 "limit": milestones[0] if milestones else n,
+                 "pending": list(milestones)}
+        resume = asyncio.Event()
+        resume.set()
+
+        stage_pool = staging.stage_pool()
+        dev_pools = [
+            ThreadPoolExecutor(1, thread_name_prefix=f"sdtpu-pipe-dev{d}")
+            for d in range(len(devs))]
+        retire_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="sdtpu-pipe-retire")
+
+        async def stager() -> None:
+            while True:
+                i = state["next"]
+                if i >= n:
+                    return
+                if i > state["limit"]:
+                    # A calibration is pending at the limit: hold this
+                    # slot until the retirer finishes it. Re-check on
+                    # wake — the limit may still be behind i.
+                    resume.clear()
+                    await resume.wait()
+                    continue
+                state["next"] = i + 1
+                await tickets.acquire()
+                state["in_flight"] += 1
+                if state["in_flight"] > stats.depth_high_water:
+                    stats.depth_high_water = state["in_flight"]
+                    global _DEPTH_HW
+                    if stats.depth_high_water > _DEPTH_HW:
+                        _DEPTH_HW = stats.depth_high_water
+                        PIPELINE_DEPTH_HIGH_WATER.set(_DEPTH_HW)
+                words, lengths = await loop.run_in_executor(
+                    stage_pool, _stage_batch, *batches[i])
+                await staged.put((i, words, lengths))
+
+        async def feed() -> None:
+            await asyncio.gather(*(stager() for _ in range(n_stagers)))
+            for _ in devs:
+                await staged.put((_DONE, None, None))
+
+        async def dispatcher(d: int) -> None:
+            dev = devs[d]
+            label = str(getattr(dev, "id", d))
+            while True:
+                t0 = time.perf_counter()
+                c0 = stats.calibration_s
+                i, words, lengths = await staged.get()
+                # Subtract any calibration pause that completed during
+                # this wait: at a milestone every dispatcher idles in
+                # staged.get() BY DESIGN (stagers hold, pipeline
+                # drains) — that time is already calibration_s, and
+                # counting it here too would misattribute the pause to
+                # a staging bottleneck in the stall breakdown.
+                # calibration_s only mutates in the retirer coroutine
+                # on this same loop thread, so the delta is race-free.
+                wait = max(0.0, time.perf_counter() - t0
+                           - (stats.calibration_s - c0))
+                stats.stage_s += wait
+                PIPELINE_STAGE_STALL_SECONDS.inc(wait)
+                if i is _DONE:
+                    return
+                out, keep = await loop.run_in_executor(
+                    dev_pools[d], _transfer_and_dispatch, jfn, words,
+                    lengths, dev, donate, stats, track_buffers)
+                stats.per_device_batches[label] = (
+                    stats.per_device_batches.get(label, 0) + 1)
+                PIPELINE_DEVICE_BATCHES.labels(device=label).inc()
+                await inflight.put((i, out, keep))
+
+        async def retirer() -> None:
+            while state["retired"] < n - 1:
+                t0 = time.perf_counter()
+                i, out, keep = await inflight.get()
+                wait = time.perf_counter() - t0
+                stats.retire_stall_s += wait
+                PIPELINE_RETIRE_STALL_SECONDS.inc(wait)
+                results[i] = await loop.run_in_executor(
+                    retire_pool, _retire, out)
+                del keep  # undonated: device inputs released at retire
+                state["retired"] += 1
+                state["in_flight"] -= 1
+                tickets.release()
+                if state["pending"] \
+                        and state["retired"] == state["pending"][0]:
+                    # Depth-aware calibration pause: the stagers already
+                    # stopped at the limit, the drain above was ordinary
+                    # (in-wall, productive) retirement — only the serial
+                    # component timing itself is excluded from the
+                    # measured wall, so the pause cost does not scale
+                    # with depth. Residual bias: the post-pause refill
+                    # (one pipeline fill with nothing in flight to hide
+                    # under) stays in the wall — a small conservative
+                    # tax surfaced via `calibrations` in the report.
+                    state["pending"].pop(0)
+                    t_pause = time.perf_counter()
+                    sample = await loop.run_in_executor(
+                        retire_pool, calibrate)
+                    stats.samples.append(sample[:3])
+                    pause = time.perf_counter() - t_pause
+                    stats.calibration_s += pause
+                    clock["start"] += pause  # shift the wall past it
+                    state["limit"] = (state["pending"][0]
+                                      if state["pending"] else n)
+                    resume.set()
+
+        try:
+            await asyncio.gather(
+                feed(), *(dispatcher(d) for d in range(len(devs))),
+                retirer())
+        finally:
+            for pool in dev_pools:
+                pool.shutdown(wait=True)
+            retire_pool.shutdown(wait=True)
+
+    clock["start"] = time.perf_counter()
+    asyncio.run(main())
+    stats.wall_s = time.perf_counter() - clock["start"]
 
 
 def make_sparse_corpus(root: str, n_files: int, file_size: int,

@@ -21,8 +21,10 @@ Backends:
 
 from __future__ import annotations
 
+import atexit
 import concurrent.futures
 import os
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -30,18 +32,80 @@ import numpy as np
 
 from . import cas, jit_registry
 from .. import flags
+from ..telemetry import STAGE_POOL_WORKERS
 
 _STAGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_ATEXIT_REGISTERED = False
+# Serializes pool-identity transitions (create / swap-out) WITH their
+# gauge updates, so sd_stage_pool_workers always describes the current
+# _STAGE_POOL — a shutdown racing a re-create cannot clobber the fresh
+# pool's gauge with a late 0. The long shutdown(wait=True) itself runs
+# outside the lock.
+_POOL_LOCK = threading.Lock()
 
 
 def _pool() -> concurrent.futures.ThreadPoolExecutor:
+    """The shared staging executor, created lazily and visible to the
+    lifecycle machinery: `sd_stage_pool_workers` reports its size (0
+    when down), `shutdown_stage_pool()` is the explicit close hook
+    `Node.shutdown()` drives (with an atexit backstop for bench CLIs
+    that never build a Node), and the next submit after a shutdown
+    simply re-creates the pool — multiple Nodes in one process share
+    it safely."""
+    global _STAGE_POOL, _ATEXIT_REGISTERED
+    with _POOL_LOCK:
+        if _STAGE_POOL is None:
+            workers = min(32, (os.cpu_count() or 4) * 2)
+            _STAGE_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="cas-stage",
+            )
+            STAGE_POOL_WORKERS.set(workers)
+            if not _ATEXIT_REGISTERED:
+                _ATEXIT_REGISTERED = True
+                atexit.register(shutdown_stage_pool)
+        return _STAGE_POOL
+
+
+def stage_pool() -> concurrent.futures.ThreadPoolExecutor:
+    """Public spelling of the shared staging executor (the depth-N
+    overlap pipeline submits its concurrent stage(i+1..i+k) here)."""
+    return _pool()
+
+
+def _submit(fn, *args) -> concurrent.futures.Future:
+    """Submit to the shared pool, surviving a concurrent
+    shutdown_stage_pool(): with two Nodes in one process, node A's
+    shutdown can close the pool between node B's `_pool()` lookup and
+    its `.submit()` — the RuntimeError retry clears the dead executor
+    (only if nobody re-created it yet) and lands on a fresh one."""
     global _STAGE_POOL
-    if _STAGE_POOL is None:
-        _STAGE_POOL = concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(32, (os.cpu_count() or 4) * 2),
-            thread_name_prefix="cas-stage",
-        )
-    return _STAGE_POOL
+    pool = _pool()
+    try:
+        return pool.submit(fn, *args)
+    except RuntimeError:
+        with _POOL_LOCK:
+            if _STAGE_POOL is pool:
+                _STAGE_POOL = None
+                STAGE_POOL_WORKERS.set(0)
+        return _pool().submit(fn, *args)
+
+
+def shutdown_stage_pool(wait: bool = True) -> None:
+    """Tear down the shared staging executor. Idempotent; in-flight
+    reads finish when `wait` (the default — a half-staged batch must
+    not observe freed numpy views). Wired into `Node.shutdown()` so
+    the pool's threads no longer outlive the supervisor reap
+    invisibly, and registered atexit as the backstop. The gauge zeroes
+    AT the swap, under the lock: a pool re-created while this thread
+    still drains the old one keeps its own (non-zero) gauge."""
+    global _STAGE_POOL
+    with _POOL_LOCK:
+        pool, _STAGE_POOL = _STAGE_POOL, None
+        if pool is not None:
+            STAGE_POOL_WORKERS.set(0)
+    if pool is not None:
+        pool.shutdown(wait=wait)
 
 
 @dataclass
@@ -160,10 +224,10 @@ def stage_files(
             errors[idx] = str(e)
 
     futures = [
-        _pool().submit(read_one, "large", row, idx)
+        _submit(read_one, "large", row, idx)
         for row, idx in enumerate(large_idx)
     ] + [
-        _pool().submit(read_one, "small", row, idx)
+        _submit(read_one, "small", row, idx)
         for row, idx in enumerate(small_idx)
     ]
     for fut in futures:

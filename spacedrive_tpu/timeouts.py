@@ -186,6 +186,22 @@ declare_timeout(
     "tools/chan_bench.py producer's bounded put on the block-policy "
     "bench channel — the measured put-block path.")
 
+# -- ops (device-pipeline put budgets; not wire awaits) ---------------------
+
+declare_timeout(
+    "ops.pipeline.inflight.put", 600.0,
+    "Depth-N identify pipeline dispatcher waiting for the retirer to "
+    "drain the in-flight window (channels.py ops.pipeline.inflight): "
+    "a wedged D2H fetch frees the dispatcher here instead of parking "
+    "the device stream forever. Sized for thin-tunnel H2D weather at "
+    "bench batch sizes.")
+
+declare_timeout(
+    "ops.pipeline.staged.put", 600.0,
+    "Depth-N identify pipeline stager waiting for a dispatcher to "
+    "drain the staged-batch channel (channels.py ops.pipeline.staged) "
+    "— the backpressure edge when H2D or the kernel is the bottleneck.")
+
 # -- p2p (tunnel control plane) ---------------------------------------------
 
 declare_timeout(

@@ -56,6 +56,14 @@ def mask(x, y, threshold: int = 2):
     return (x ^ y) <= threshold
 
 
+@jit_registry.tracked("hamming.tile")
+@functools.partial(jax.jit, donate_argnums=(0,))
+def donates_undeclared(x, y):
+    # hamming.tile's contract declares no donate_argnums: consuming the
+    # caller's x is an undeclared semantic change
+    return x ^ y
+
+
 def unhashable_static(x, y):
     return mask(x, y, threshold=[1, 2])
 

@@ -72,6 +72,46 @@ def test_cas_pipeline_small_mode(tmp_path):
     assert got == want
 
 
+def test_cas_dispatch_routes_donated_entry(monkeypatch):
+    """cas_ids_jax dispatch plumbing for SDTPU_DONATE_BUFFERS: with the
+    flag on (the production default; conftest pins it off suite-wide
+    for compile cost) the single-device path hashes through the donated
+    entry — `_donated_local` over the `blake3.donated` contract — and
+    the CAS IDs come out unchanged. The stand-in delegates to the
+    already-compiled undonated program, keeping this a pure plumbing
+    test; the donated program's real consume-at-dispatch semantics are
+    pinned by test_overlap.py's footprint test over a cheap kernel."""
+    from spacedrive_tpu.ops import blake3_jax as bj
+
+    sizes = [0, 77, 4096]
+    B = len(sizes)
+    payloads = np.zeros((B, cas.MINIMUM_FILE_SIZE), dtype=np.uint8)
+    for i, size in enumerate(sizes):
+        payloads[i, :size] = np.frombuffer(os.urandom(size), np.uint8)
+    lens = np.array(sizes, dtype=np.int32)
+    want = cas_ids_jax(payloads, np.array(sizes, np.uint64),
+                       payload_lens=lens)
+
+    calls = []
+
+    def fake_donated(words, lengths):
+        calls.append(tuple(words.shape))
+        return bj.blake3_words(words, lengths)
+
+    monkeypatch.setenv("SDTPU_DONATE_BUFFERS", "on")
+    monkeypatch.setattr(bj, "_donated_local", fake_donated)
+    got = cas_ids_jax(payloads, np.array(sizes, np.uint64),
+                      payload_lens=lens)
+    assert calls, "donated entry was not dispatched with the flag on"
+    assert got == want
+    # the suite-wide off pin really does route the undonated program
+    calls.clear()
+    monkeypatch.setenv("SDTPU_DONATE_BUFFERS", "off")
+    got_off = cas_ids_jax(payloads, np.array(sizes, np.uint64),
+                          payload_lens=lens)
+    assert not calls and got_off == want
+
+
 def test_sharded_blake3_on_cpu_mesh(cpu_devices):
     mesh = batch_mesh(cpu_devices)
     assert len(cpu_devices) == 8, "conftest should provide 8 virtual CPU devices"

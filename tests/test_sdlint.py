@@ -127,10 +127,13 @@ def test_jit_stability_flags_known_positives():
     assert codes == {
         "unregistered-jit", "unknown-jit-name", "static-args-mismatch",
         "static-argnums", "call-time-jit", "jit-in-loop",
-        "unhashable-static-arg", "value-dependent-shape"}, codes
+        "unhashable-static-arg", "value-dependent-shape",
+        "undeclared-donation"}, codes
     # the overlap.py:166 shape is the canonical call-time positive
     assert any(f.code == "call-time-jit" and f.qual == "call_time"
                for f in found)
+    assert any(f.code == "undeclared-donation"
+               and f.qual == "donates_undeclared" for f in found)
 
 
 def test_jit_stability_passes_known_negatives():
@@ -155,6 +158,28 @@ def test_every_registry_contract_site_exists():
     for name, c in declared_contracts(ROOT).items():
         assert c["site"] in quals | classes, (
             f"contract {name!r} points at missing site {c['site']!r}")
+
+
+def test_jit_registry_static_runtime_drift():
+    """The AST-parsed contract table and the runtime registry cannot
+    drift (the channel/timeout drift check, for jit): every statically
+    visible contract resolves at runtime with the SAME static_argnames
+    and donate_argnums — the two fields whose drift silently changes
+    call semantics (a retrace per call, or a consumed caller buffer)."""
+    from spacedrive_tpu.ops import jit_registry
+    from tools.sdlint.passes.jit_stability import declared_contracts
+
+    static = declared_contracts(ROOT)
+    assert set(static) == set(jit_registry.CONTRACTS)
+    donated = set()
+    for name, c in static.items():
+        runtime = jit_registry.CONTRACTS[name]
+        assert tuple(c["static_argnames"]) == runtime.static_argnames, name
+        assert tuple(c["donate_argnums"]) == runtime.donate_argnums, name
+        if runtime.donate_argnums:
+            donated.add(name)
+    # the depth-N ring's donation contracts are declared on both sides
+    assert {"overlap.kernel", "blake3.donated"} <= donated
 
 
 # -- dtype-discipline -------------------------------------------------------

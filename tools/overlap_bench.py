@@ -1,0 +1,209 @@
+"""Depth-N overlap-pipeline microbench: depth sweep × link speeds.
+
+The pipeline analog of tools/chan_bench.py: drives ops/overlap.py's
+depth-N ring through a sweep of pipeline depths and (simulated) H2D
+link rates and emits a BENCH-style JSON artifact — measured files/s vs
+the computed max(stage, h2d, kernel) steady-state bound, the stall
+breakdown (stage/retire/calibration seconds), depth high-water, and
+the per-device batch split — so a pipeline regression gates like a
+perf regression instead of surfacing as a mystery e2e dip.
+
+Simulated links (`--links`, GB/s) use SDTPU_SIM_LINK_GBPS: each H2D
+additionally sleeps nbytes/rate per device stream, deterministically,
+so the sweep runs identically on a CPU container and a TPU host; pass
+``--links real`` to measure the actual link instead.
+
+    python -m tools.overlap_bench --json /tmp/overlap.json
+    python -m tools.overlap_bench --depths 1,2,4 --links 0.05,0.5
+    python -m tools.overlap_bench --gate   # exit 1 when depth>=3 misses
+                                           # its bound by more than 1.3x
+
+The default kernel is the real device BLAKE3 body; `--cheap-kernel`
+swaps in a trivially-compiling checksum so CI sweeps don't pay the
+~45 s BLAKE3 compile per program variant (the overlap math being
+measured is kernel-agnostic).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BOUND_TOLERANCE = 1.3  # acceptance: measured >= bound / 1.3 at depth >= 3
+
+
+def _cheap_kernel(words, lengths):
+    """Trivially-compiling [B, 8] checksum stand-in for the BLAKE3 body
+    (module-level def so _jitted caches one program per donate flag)."""
+    import jax.numpy as jnp
+
+    s = words.sum(axis=(1, 2)).astype(jnp.uint32)
+    return s[:, None] + jnp.arange(8, dtype=jnp.uint32)[None, :]
+
+
+def run_sweep(depths, links, batch=32, batches=8, file_size=120_000,
+              cheap_kernel=False, donate=None, calibrate_every=None):
+    """calibrate_every: None keeps run_overlapped's interleaved mid-run
+    cadence (real links — the bound must come from the same weather
+    window as the measurement); >= batches disables mid-run pauses
+    (simulated links are deterministic, so re-sampling buys nothing
+    and each pause's drain+refill denies short deep-pipeline runs
+    their steady state)."""
+    from spacedrive_tpu.ops import overlap
+
+    kernel = _cheap_kernel if cheap_kernel else None
+    rows = []
+    root = tempfile.mkdtemp(prefix="sdtpu-overlap-bench-")
+    try:
+        corpus = overlap.make_sparse_corpus(
+            root, batch * batches, file_size, batch)
+        from spacedrive_tpu import flags as _flags
+
+        prior = _flags.raw("SDTPU_SIM_LINK_GBPS")
+        for link in links:
+            if link == "real":
+                os.environ.pop("SDTPU_SIM_LINK_GBPS", None)
+            else:
+                os.environ["SDTPU_SIM_LINK_GBPS"] = str(link)
+            try:
+                for depth in depths:
+                    _res, stats = overlap.run_overlapped(
+                        corpus, kernel=kernel, depth=depth,
+                        donate=donate, calibrate_every=calibrate_every)
+                    report = stats.bound_report()
+                    rows.append({
+                        "depth": depth,
+                        "link_gbps": link,
+                        "devices": stats.n_devices,
+                        "donated": stats.donate,
+                        "measured_files_per_sec":
+                            report["measured_files_per_sec"],
+                        "bound_files_per_sec":
+                            report["bound_files_per_sec"],
+                        "ratio": report["ratio"],
+                        "depth_high_water": stats.depth_high_water,
+                        "per_device_batches": stats.per_device_batches,
+                        "donated_reuse": stats.donated_reuse,
+                        "h2d_bytes": stats.h2d_bytes,
+                        "h2d_s": round(stats.h2d_s, 4),
+                        "stall_s": {
+                            "stage": round(stats.stage_s, 4),
+                            "retire": round(stats.retire_stall_s, 4),
+                            "calibration": round(stats.calibration_s, 4),
+                        },
+                        "components_s": {
+                            "stage": round(stats.t_stage_1, 4),
+                            "h2d": round(stats.t_h2d_1, 4),
+                            "kernel_fetch": round(stats.t_kernel_1, 4),
+                        },
+                        "calibrations": report["calibrations"],
+                        "bound_reason": report["reason"],
+                    })
+            finally:
+                # Restore the CALLER's sim-link setting (an operator
+                # running the sweep with the flag exported keeps it),
+                # not just unset it.
+                if prior is None:
+                    os.environ.pop("SDTPU_SIM_LINK_GBPS", None)
+                else:
+                    os.environ["SDTPU_SIM_LINK_GBPS"] = prior
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return rows
+
+
+def gate_failures(rows):
+    """Rows violating the acceptance shape: at depth >= 3 the measured
+    rate must land within BOUND_TOLERANCE of its same-run bound AND
+    strictly beat the same link's depth-1 run."""
+    by_link = {}
+    for r in rows:
+        by_link.setdefault(r["link_gbps"], {})[r["depth"]] = r
+    bad = []
+    for link, by_depth in by_link.items():
+        base = by_depth.get(1)
+        for depth, r in by_depth.items():
+            if depth < 3:
+                continue
+            if r["bound_files_per_sec"] and \
+                    r["measured_files_per_sec"] * BOUND_TOLERANCE \
+                    < r["bound_files_per_sec"]:
+                bad.append((link, depth, "missed bound", r["ratio"]))
+            if base is not None and r["measured_files_per_sec"] \
+                    <= base["measured_files_per_sec"]:
+                bad.append((link, depth, "not better than depth 1",
+                            r["measured_files_per_sec"]))
+    return bad
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depths", default="1,2,4",
+                    help="comma-separated pipeline depths to sweep")
+    ap.add_argument("--links", default="0.05,0.5",
+                    help="comma-separated simulated link GB/s "
+                         "(or 'real' for the actual link)")
+    ap.add_argument("--batch", type=int, default=32,
+                    help="files per batch (32 reuses the tier-1 "
+                         "compile cache)")
+    ap.add_argument("--batches", type=int, default=8)
+    ap.add_argument("--file-size", type=int, default=120_000)
+    ap.add_argument("--cheap-kernel", action="store_true",
+                    help="trivially-compiling checksum kernel (CI)")
+    ap.add_argument("--donate", choices=("on", "off"), default=None,
+                    help="override SDTPU_DONATE_BUFFERS for the sweep")
+    ap.add_argument("--calibrate-every", type=int, default=None,
+                    metavar="N",
+                    help="mid-run calibration cadence in batches "
+                         "(default: run_overlapped's interleaved "
+                         "cadence; pass >= --batches to disable "
+                         "mid-run pauses on deterministic simulated "
+                         "links)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when a depth>=3 row misses its bound "
+                         f"by more than {BOUND_TOLERANCE}x or fails to "
+                         "beat depth 1")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="write the sweep as one BENCH-style artifact")
+    args = ap.parse_args()
+
+    depths = [int(d) for d in args.depths.split(",") if d.strip()]
+    links = [l if l == "real" else float(l)
+             for l in args.links.split(",") if l.strip()]
+    donate = None if args.donate is None else args.donate == "on"
+
+    rows = run_sweep(depths, links, batch=args.batch,
+                     batches=args.batches, file_size=args.file_size,
+                     cheap_kernel=args.cheap_kernel, donate=donate,
+                     calibrate_every=args.calibrate_every)
+    artifact = {
+        "metric": "overlap_bench",
+        "unit": "files/s",
+        "bound_tolerance": BOUND_TOLERANCE,
+        "batch": args.batch, "batches": args.batches,
+        "file_size": args.file_size,
+        "cheap_kernel": bool(args.cheap_kernel),
+        "sweep": rows,
+    }
+    print(json.dumps(artifact))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if args.gate:
+        bad = gate_failures(rows)
+        for link, depth, why, val in bad:
+            print(f"GATE: link={link} depth={depth}: {why} ({val})",
+                  file=sys.stderr)
+        return 1 if bad else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,19 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         }, "over_budget": sorted(
             name for name, n in traces.items()
             if n > jit_registry.CONTRACTS[name].max_traces)})
+        # Pipeline-shape proof next to the jit stage: the depth-N ring's
+        # registry families (depth high-water, stall seconds, H2D
+        # bytes/seconds, donated-buffer reuse, per-device batch split)
+        # plus the configured depth — so a bench artifact shows HOW the
+        # identify stream was fed, not just how fast it went.
+        from spacedrive_tpu.ops import overlap as overlap_mod
+
+        snap = telemetry.snapshot()
+        emit({"stage": "pipeline",
+              "depth_configured": overlap_mod.pipeline_depth(),
+              "metrics": {name: value for name, value in snap.items()
+                          if name.startswith(("sd_pipeline_",
+                                              "sd_stage_pool_"))}})
     if json_out:
         with open(json_out, "w") as f:
             json.dump({

@@ -26,7 +26,14 @@ enforces the binding and the compile-stability idioms around it:
 - `value-dependent-shape` — an argument to a registered jit callable
   is built inline with a `len(...)`-derived shape (`np.zeros(len(x))`
   at the boundary): Python-value-dependent shapes must go through the
-  staging size classes / pow2 buckets, never raw lengths.
+  staging size classes / pow2 buckets, never raw lengths;
+- `undeclared-donation` — a jit site passes `donate_argnums` /
+  `donate_argnames` that its governing contract does not declare.
+  Donation is a caller-visible semantic (the buffer is CONSUMED —
+  reuse after the call raises), so it lives on the declared contract
+  surface: the `donate_argnums` field of `declare_jit`. Declaring
+  donation never forces it — undonated variants of the same contract
+  stay legal (SDTPU_DONATE_BUFFERS=off).
 
 The resolver is lexical by design: transfers and shapes that flow
 through variables across functions are the runtime sanitizer's half
@@ -68,7 +75,7 @@ def declared_contracts(root: str) -> Dict[str, dict]:
         if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
             site = str(node.args[1].value)
         c = {"site": site, "kind": "entry", "static_argnames": (),
-             "host_transfer": False}
+             "host_transfer": False, "donate_argnums": ()}
         for kw in node.keywords:
             if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
                 c["kind"] = kw.value.value
@@ -77,6 +84,8 @@ def declared_contracts(root: str) -> Dict[str, dict]:
             elif kw.arg == "host_transfer" \
                     and isinstance(kw.value, ast.Constant):
                 c["host_transfer"] = bool(kw.value.value)
+            elif kw.arg == "donate_argnums":
+                c["donate_argnums"] = _int_tuple(kw.value)
         out[name.value] = c
     return out
 
@@ -88,6 +97,18 @@ def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
         vals = []
         for el in node.elts:
             if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                vals.append(el.value)
+        return tuple(vals)
+    return ()
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
                 vals.append(el.value)
         return tuple(vals)
     return ()
@@ -136,6 +157,26 @@ def _static_args_of(deco: ast.AST) -> Tuple[Tuple[str, ...], bool]:
     return names, nums
 
 
+def _donation_of(deco: ast.AST) -> Tuple[bool, Tuple[int, ...]]:
+    """(site donates at all, parseable donated argnums) from a jit
+    decorator or call. donate_argnames (string form) counts as
+    donation with no parseable nums — the authorization check still
+    applies, the subset check degrades to 'contract must declare
+    donation'."""
+    call = _partial_jit_call(deco)
+    if call is None and isinstance(deco, ast.Call) \
+            and _is_jit_expr(deco.func):
+        call = deco
+    if call is None:
+        return False, ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return True, _int_tuple(kw.value)
+        if kw.arg == "donate_argnames":
+            return True, ()
+    return False, ()
+
+
 class _SiteVisitor(ast.NodeVisitor):
     """One file: jit defs/calls with qualnames, loop/tracked context."""
 
@@ -149,6 +190,7 @@ class _SiteVisitor(ast.NodeVisitor):
         self._fn_depth = 0
         self._loop_depth = 0
         self._factory_depth = 0         # inside a declared-factory def
+        self._factory_contracts: List[dict] = []
         self._tracked_ctx: List[Optional[str]] = []
 
     # -- helpers ------------------------------------------------------
@@ -187,7 +229,11 @@ class _SiteVisitor(ast.NodeVisitor):
         self._stack.append(node.name)
         self._fn_depth += 1
         self._factory_depth += 1 if is_factory else 0
+        if is_factory:
+            self._factory_contracts.append(contract)
         self.generic_visit(node)
+        if is_factory:
+            self._factory_contracts.pop()
         self._factory_depth -= 1 if is_factory else 0
         self._fn_depth -= 1
         self._stack.pop()
@@ -244,6 +290,7 @@ class _SiteVisitor(ast.NodeVisitor):
                 f"{CENTRAL}", lineno)
             return
         self.bound_names[callable_name] = name
+        self._check_donation(jit_site, contract, name, qual, lineno)
         site_names, nums = _static_args_of(jit_site)
         if nums:
             self._emit(
@@ -286,9 +333,36 @@ class _SiteVisitor(ast.NodeVisitor):
                 self.bound_names[node.targets[0].id] = name
         self.generic_visit(node)
 
+    def _check_donation(self, jit_site: ast.AST, contract: Optional[dict],
+                        cname: str, qual: str, lineno: int) -> None:
+        donates, nums = _donation_of(jit_site)
+        if not donates or contract is None:
+            return
+        declared = tuple(contract.get("donate_argnums") or ())
+        if not declared or not set(nums) <= set(declared):
+            self._emit(
+                "undeclared-donation", qual, cname,
+                f"jit site donates argnums {nums or '(dynamic)'} but "
+                f"contract {cname!r} declares donate_argnums="
+                f"{declared} — donation consumes the caller's buffers "
+                f"and must be part of the declared surface (add "
+                f"donate_argnums to the declare_jit in {CENTRAL})",
+                lineno)
+
     def _check_jit_call(self, node: ast.Call) -> None:
         qual = self._qual()
         tracked = self._tracked_ctx[-1] if self._tracked_ctx else None
+        # Donation authorization applies wherever the jit is built —
+        # module level, factory body, or tracked assignment form.
+        gov_name, gov = None, None
+        if tracked is not None and tracked in self.contracts:
+            gov_name, gov = tracked, self.contracts[tracked]
+        elif self._factory_contracts:
+            gov = self._factory_contracts[-1]
+            gov_name = next((n for n, c in self.contracts.items()
+                             if c is gov), "?")
+        if gov is not None:
+            self._check_donation(node, gov, gov_name, qual, node.lineno)
         if self._loop_depth:
             self._emit(
                 "jit-in-loop", qual, qual or "module",

@@ -24,7 +24,7 @@ FACTORY_NAMES = {"counter", "gauge", "histogram"}
 CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
 NAME_RE = re.compile(
     r"^sd_(jobs?|identifier|sync|p2p|store|api|trace|sanitize|jit"
-    r"|task|timeout|chan)_[a-z0-9_]+$")
+    r"|task|timeout|chan|pipeline|stage)_[a-z0-9_]+$")
 
 CENTRAL_MODULE = "telemetry.py"
 
@@ -115,7 +115,8 @@ class _Visitor(ast.NodeVisitor):
             self.problems.append(
                 f"{where}: {name!r} breaks the naming scheme "
                 f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
-                f"p2p/store/api/trace/sanitize/jit/task/timeout/chan)")
+                f"p2p/store/api/trace/sanitize/jit/task/timeout/chan/"
+                f"pipeline/stage)")
 
 
 def lint_source(path: str, src: str, is_central: bool,
