@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .. import channels, tasks, telemetry
+from .. import channels, tasks, telemetry, threadctx
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
 from ..telemetry import API_REQUESTS
@@ -268,9 +268,11 @@ class ApiServer:
                     def emit(data, _mid=mid, _pump=pump):
                         # Thread-safe: event bus callbacks may fire
                         # from worker threads; the channel itself is
-                        # loop-thread-only.
-                        loop.call_soon_threadsafe(
-                            _pump.offer,
+                        # loop-thread-only. A loop closed mid-shutdown
+                        # drops the frame (counted) instead of
+                        # crashing the emitting thread.
+                        threadctx.call_threadsafe(
+                            loop, _pump.offer,
                             {"id": _mid, "type": "event", "data": data})
                     try:
                         unsub = await self.router.subscribe(

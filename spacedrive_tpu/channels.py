@@ -57,6 +57,7 @@ is how the jobs run-queue serves synchronous construction paths.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
@@ -103,8 +104,15 @@ CHANNELS: Dict[str, ChannelContract] = {}
 
 # Process-lifetime depth peak per channel NAME, backing the
 # sd_chan_high_water gauge across instance churn. Keyed by declared
-# names only, so it is bounded by the registry itself.
+# names only, so it is bounded by the registry itself. The peak
+# compare-and-set runs under _HW_LOCK: channels are loop-affine, but
+# the pure-sync put_nowait surface is also driven from worker threads
+# (jobs run-queue, the threaded stress test), and an unguarded
+# read-compare-write could publish a LOWER peak over a higher one —
+# the gauge is documented monotone. threadctx declares the contract
+# (channels.Metered.high_water guarded_by _hw_lock).
 _NAME_HIGH_WATER: Dict[str, int] = {}
+_HW_LOCK = threading.Lock()
 
 # Armed by sanitize.install(): (mode, record) — identical split to
 # ops/jit_registry.arm. `record(kind, detail, may_raise)` is
@@ -187,6 +195,7 @@ class _Metered:
         self.name = contract.name
         self.capacity = capacity(contract.name)
         self.high_water = 0
+        self._hw_lock = _HW_LOCK  # module-wide: peaks cross instances
         self._m_depth = CHAN_DEPTH.labels(name=self.name)
         self._m_high = CHAN_HIGH_WATER.labels(name=self.name)
         self._m_shed = CHAN_SHED.labels(name=self.name)
@@ -194,14 +203,18 @@ class _Metered:
     def _note_depth(self, depth: int) -> None:
         self._m_depth.set(depth)
         if depth > self.high_water:
-            self.high_water = depth
-            # The gauge is per NAME and documented "since process
-            # start"; instances come and go (one ws buffer per
-            # subscription), so a fresh instance must not regress it
-            # below an earlier instance's peak.
-            if depth > _NAME_HIGH_WATER.get(self.name, 0):
-                _NAME_HIGH_WATER[self.name] = depth
-                self._m_high.set(depth)
+            with self._hw_lock:
+                if depth > self.high_water:
+                    self.high_water = depth
+                # The gauge is per NAME and documented "since process
+                # start"; instances come and go (one ws buffer per
+                # subscription), so a fresh instance must not regress
+                # it below an earlier instance's peak — and two racing
+                # producers must not publish a lower peak over a
+                # higher one (monotone under the stress test).
+                if depth > _NAME_HIGH_WATER.get(self.name, 0):
+                    _NAME_HIGH_WATER[self.name] = depth
+                    self._m_high.set(depth)
 
     def _shed(self, n: int = 1) -> None:
         self._m_shed.inc(n)

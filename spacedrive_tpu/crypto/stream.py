@@ -42,7 +42,12 @@ class Algorithm(enum.Enum):
         return AESGCM(key)
 
 
-class _Stream:
+# Step-local by construction: each Encryptor/Decryptor is created,
+# driven, and dropped inside ONE to_thread job-step body, so the
+# nonce counter never has two live writer threads — the class-level
+# two-context union the pass sees is two DIFFERENT jobs' private
+# instances, not shared state.
+class _Stream:  # sdlint: ok[shared-mutation]
     def __init__(self, key: Protected, nonce: bytes, algorithm: Algorithm):
         if len(key) != 32:
             raise ValueError("stream key must be 32 bytes")

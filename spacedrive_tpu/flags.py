@@ -208,6 +208,14 @@ declare(
     "reset_profiler_cache() re-arms).")
 
 declare(
+    "SDTPU_RACE_GUARD", "auto", lambda v: v.strip().lower(),
+    "Cross-thread race recorder (threadctx.py, armed with the "
+    "sanitizer): declared owner classes record (thread id, held "
+    "lockset) per attribute write and flag data_race violations. "
+    "`off` skips arming (zero overhead); `auto` follows "
+    "SDTPU_SANITIZE. Read once at sanitize.install().")
+
+declare(
     "SDTPU_RETRACE_GUARD", "auto", lambda v: v.strip().lower(),
     "jit retrace counter (ops/jit_registry.py, armed with the "
     "sanitizer): `off` disables cache-size accounting and the "

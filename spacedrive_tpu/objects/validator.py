@@ -80,7 +80,20 @@ class ObjectValidatorJob(StatefulJob):
         return data, steps
 
     async def execute_step(self, ctx, data, step, step_number):
-        return await asyncio.to_thread(self._step, ctx, data, step)
+        outcome = await asyncio.to_thread(self._step, ctx, data, step)
+        # IntegrityViolation events are collected by the worker-thread
+        # step body and emitted HERE, back on the event loop: EventBus
+        # fan-out is loop-affine (sdlint thread-boundary), and the
+        # relay costs nothing — the step has to return before the next
+        # one dispatches anyway.
+        events = (outcome.metadata.pop("_integrity_events", [])
+                  if outcome.metadata else [])
+        if events:
+            node = ctx.services.get("node")
+            if node is not None:
+                for ev in events:
+                    node.events.emit(ev)
+        return outcome
 
     def _fetch_rows(self, db, data) -> List[Dict[str, Any]]:
         rows = db.query(
@@ -270,18 +283,20 @@ class ObjectValidatorJob(StatefulJob):
             # Net-new corruption pass: compare against the stored
             # checksum; mismatches are non-fatal errors + events, never
             # silently "repaired" (the stored value is the evidence).
-            node = ctx.services.get("node")
+            # This body runs in a to_thread worker: EventBus emit is
+            # loop-affine, so violations ride the outcome metadata and
+            # execute_step emits them after the hop back to the loop.
+            integrity_events = []
             for r, path, checksum in results:
                 if checksum != r.get("expected"):
                     data["mismatched"] += 1
                     errors.append(
                         f"CHECKSUM MISMATCH {path}: stored "
                         f"{r.get('expected')}, current {checksum}")
-                    if node is not None:
-                        node.events.emit({
-                            "type": "IntegrityViolation",
-                            "file_path_id": r["id"], "path": path,
-                        })
+                    integrity_events.append({
+                        "type": "IntegrityViolation",
+                        "file_path_id": r["id"], "path": path,
+                    })
             data["validated"] += len(results)
             data["cursor"] = next_cursor
             ctx.progress(message=(
@@ -289,7 +304,8 @@ class ObjectValidatorJob(StatefulJob):
                 f"{data['mismatched']} mismatches"))
             return StepOutcome(errors=errors, metadata={
                 "validated": data["validated"],
-                "mismatched": data["mismatched"]})
+                "mismatched": data["mismatched"],
+                "_integrity_events": integrity_events})
 
         with db.tx() as conn:
             conn.executemany(

@@ -145,9 +145,14 @@ class PipelineStats:
     t_stage_2: float = 0.0
     t_h2d_2: float = 0.0
     t_kernel_2: float = 0.0
-    # Guards the fields the per-device executor threads mutate
-    # (h2d_bytes/h2d_s/donated_reuse/buffer_samples): with >1 device
-    # stream a plain += is a lost-update race.
+    # Guards every multi-writer field (declared guarded_by("_lock") in
+    # the threadctx ownership registry): the per-device executor
+    # threads mutate h2d_bytes/h2d_s/donated_reuse/buffer_samples —
+    # with >1 device stream a plain += is a lost-update race (the PR 8
+    # review bug, now the shared-mutation pass's encoded positive) —
+    # and the pipeline coroutines mutate the stall/calibration/sample
+    # accounting. Critical sections are a few arithmetic ops; no await
+    # ever runs under it.
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -433,7 +438,8 @@ def run_overlapped(
                                   donate)
     _retire(out)
     s0 = _calibrate()
-    stats.samples.append(s0[:3])
+    with stats._lock:
+        stats.samples.append(s0[:3])
     results: List[Optional[np.ndarray]] = [None] * len(batches)
     results[0] = s0[3]
 
@@ -446,7 +452,9 @@ def run_overlapped(
     # Post-run sample: same components, same batch-0 data, measured the
     # moment the pipeline drains — the closing bracket of the same-run
     # series.
-    stats.samples.append(_calibrate()[:3])
+    closing = _calibrate()[:3]
+    with stats._lock:
+        stats.samples.append(closing)
     (stats.t_stage_1, stats.t_h2d_1, stats.t_kernel_1) = stats.samples[0]
     (stats.t_stage_2, stats.t_h2d_2, stats.t_kernel_2) = stats.samples[-1]
     return results, stats
@@ -513,7 +521,9 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                 await tickets.acquire()
                 state["in_flight"] += 1
                 if state["in_flight"] > stats.depth_high_water:
-                    stats.depth_high_water = state["in_flight"]
+                    with stats._lock:
+                        stats.depth_high_water = max(
+                            stats.depth_high_water, state["in_flight"])
                     global _DEPTH_HW
                     if stats.depth_high_water > _DEPTH_HW:
                         _DEPTH_HW = stats.depth_high_water
@@ -544,15 +554,17 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                 # on this same loop thread, so the delta is race-free.
                 wait = max(0.0, time.perf_counter() - t0
                            - (stats.calibration_s - c0))
-                stats.stage_s += wait
+                with stats._lock:
+                    stats.stage_s += wait
                 PIPELINE_STAGE_STALL_SECONDS.inc(wait)
                 if i is _DONE:
                     return
                 out, keep = await loop.run_in_executor(
                     dev_pools[d], _transfer_and_dispatch, jfn, words,
                     lengths, dev, donate, stats, track_buffers)
-                stats.per_device_batches[label] = (
-                    stats.per_device_batches.get(label, 0) + 1)
+                with stats._lock:
+                    stats.per_device_batches[label] = (
+                        stats.per_device_batches.get(label, 0) + 1)
                 PIPELINE_DEVICE_BATCHES.labels(device=label).inc()
                 await inflight.put((i, out, keep))
 
@@ -561,7 +573,8 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                 t0 = time.perf_counter()
                 i, out, keep = await inflight.get()
                 wait = time.perf_counter() - t0
-                stats.retire_stall_s += wait
+                with stats._lock:
+                    stats.retire_stall_s += wait
                 PIPELINE_RETIRE_STALL_SECONDS.inc(wait)
                 results[i] = await loop.run_in_executor(
                     retire_pool, _retire, out)
@@ -584,9 +597,10 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                     t_pause = time.perf_counter()
                     sample = await loop.run_in_executor(
                         retire_pool, calibrate)
-                    stats.samples.append(sample[:3])
                     pause = time.perf_counter() - t_pause
-                    stats.calibration_s += pause
+                    with stats._lock:
+                        stats.samples.append(sample[:3])
+                        stats.calibration_s += pause
                     clock["start"] += pause  # shift the wall past it
                     state["limit"] = (state["pending"][0]
                                       if state["pending"] else n)

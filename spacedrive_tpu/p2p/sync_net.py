@@ -21,7 +21,7 @@ import asyncio
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from .. import channels, flags, tasks
+from .. import channels, flags, tasks, threadctx
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..timeouts import with_timeout
@@ -169,8 +169,6 @@ class NetworkedLibraries:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             loop = self._loop
-        if loop is None or loop.is_closed():
-            return  # no loop (sync unit tests): peers poll on reconnect
 
         def spawn() -> None:
             # Coalesce bursts: while an announcement round is in flight
@@ -200,7 +198,9 @@ class NetworkedLibraries:
             tasks.spawn(f"origin/{library.id.hex[:8]}", run(),
                         owner=self._owner)
 
-        loop.call_soon_threadsafe(spawn)
+        # Absent loop (sync unit tests) or loop closed mid-shutdown:
+        # dropped and counted — peers poll on reconnect either way.
+        threadctx.call_threadsafe(loop, spawn)
 
     async def originate(self, library) -> None:
         peers = list(self._instances.get(library.id, {}).items())

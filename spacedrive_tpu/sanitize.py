@@ -45,6 +45,16 @@ engine actually depends on:
   window, or a nowait put on a full block-policy channel, is a
   `chan_overflow` violation — raised in tier-1, counted in
   production while the shed/coalesce policies keep depth bounded.
+- **Cross-thread race recorder** (round 13, armed via
+  `threadctx.arm()` at install unless `SDTPU_RACE_GUARD=off` — the
+  runtime twin of sdlint's shared-mutation / thread-boundary /
+  guard-consistency passes): every class declared in the
+  threadctx.py ownership registry records (thread id, held
+  tracked-lock set) per attribute/container write; one attribute
+  written from two or more threads with an empty lockset
+  intersection — or a second thread on a `loop_only`/`single_thread`
+  attribute — is a `data_race` violation, raised in tier-1, counted
+  into `sd_race_candidates_total{cls_attr}` in production.
 
 Activation: `SDTPU_SANITIZE=1` + `install()` (tests/conftest.py calls
 it for tier-1; node bootstrap may too). `SDTPU_SANITIZE_MODE=raise`
@@ -73,7 +83,7 @@ from .telemetry import SANITIZE_LOOP_MAX_STALL, SANITIZE_VIOLATIONS
 __all__ = [
     "SanitizerViolation", "install", "installed", "uninstall",
     "tracked_lock", "tracked_rlock", "violations", "reset_violations",
-    "held_tracked_locks", "record",
+    "held_tracked_locks", "held_tracked_lock_ids", "record",
 ]
 
 
@@ -123,6 +133,14 @@ def held_tracked_locks() -> List[str]:
     (outermost first) — the sanitizer's own introspection hook, also
     handy in tests."""
     return [lk.name for lk in _held_stack()]
+
+
+def held_tracked_lock_ids() -> List[str]:
+    """Per-INSTANCE graph ids (`name#seq`) of the calling thread's held
+    tracked locks — the lockset the threadctx race recorder intersects
+    across writer threads (names alone would merge distinct Database
+    instances' locks into phantom protection)."""
+    return [lk.graph_id for lk in _held_stack()]
 
 
 def installed() -> bool:
@@ -355,6 +373,13 @@ def install() -> bool:
     from . import channels
 
     channels.arm(_mode, _record)
+    # Arm the thread-safety twin: declared owner classes record
+    # (thread id, held lockset) per write; contract breaches flow
+    # through _record as `data_race`. SDTPU_RACE_GUARD=off skips the
+    # wrap entirely (threadctx checks it — read once, at install).
+    from . import threadctx
+
+    threadctx.arm(_mode, _record, held_tracked_lock_ids)
     _installed = True
     return True
 
@@ -376,4 +401,7 @@ def uninstall() -> None:
     from . import channels
 
     channels.disarm()
+    from . import threadctx
+
+    threadctx.disarm()
     _installed = False

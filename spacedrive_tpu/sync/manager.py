@@ -19,6 +19,7 @@ Key contracts kept from the reference:
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
@@ -131,6 +132,14 @@ class SyncManager:
         # op-insert site (_note_ops_logged).
         self._op_log_high: Optional[int] = None
         self._has_shared_tombstones: Optional[bool] = None
+        # Leaf lock over the in-memory sync caches above: they are
+        # mutated from to_thread job steps (write_ops),
+        # loop-side ingest, AND pairing — the threadctx ownership
+        # registry declares them guarded_by("_meta_lock"), the
+        # shared-mutation pass checks it, and the armed race recorder
+        # watches it at runtime. Always a leaf (taken after db locks,
+        # never around them), so it can add no ordering cycle.
+        self._meta_lock = threading.Lock()
         self._load_instances()
         # Re-ingest ops quarantined by an OLDER schema (one cheap
         # SELECT when the table is empty — the common case).
@@ -144,15 +153,19 @@ class SyncManager:
             return
         self.db.ensure_lazy_indexes("shared_operation")
         self.db.ensure_lazy_indexes("relation_operation")
-        self._sync_indexes_ready = True
+        with self._meta_lock:
+            self._sync_indexes_ready = True
 
     def _load_instances(self) -> None:
-        for row in self.db.query("SELECT id, pub_id, timestamp FROM instance"):
-            self._instance_ids[row["pub_id"]] = row["id"]
-            if row["timestamp"]:
-                self.timestamps[row["pub_id"]] = row["timestamp"]
-                self.clock.update_with_timestamp(row["timestamp"])
-        self._solo = all(pub == self.instance for pub in self._instance_ids)
+        rows = self.db.query("SELECT id, pub_id, timestamp FROM instance")
+        with self._meta_lock:
+            for row in rows:
+                self._instance_ids[row["pub_id"]] = row["id"]
+                if row["timestamp"]:
+                    self.timestamps[row["pub_id"]] = row["timestamp"]
+                    self.clock.update_with_timestamp(row["timestamp"])
+            self._solo = all(
+                pub == self.instance for pub in self._instance_ids)
 
     def _instance_row_id(self, pub_id: bytes, conn=None) -> int:
         rid = self._instance_ids.get(pub_id)
@@ -163,7 +176,8 @@ class SyncManager:
             if row is None:
                 raise KeyError(f"unknown instance {pub_id.hex()}")
             rid = row["id"]
-            self._instance_ids[pub_id] = rid
+            with self._meta_lock:
+                self._instance_ids[pub_id] = rid
         return rid
 
     def _op_log_state(self) -> Tuple[int, bool]:
@@ -183,21 +197,29 @@ class SyncManager:
                     f"SELECT MAX({col}) AS t FROM {table}")
                 if row is not None and row["t"] is not None:
                     hi = max(hi, row["t"])
-            self._op_log_high = hi
+            with self._meta_lock:
+                if self._op_log_high is None:
+                    self._op_log_high = hi
         if self._has_shared_tombstones is None:
-            self._has_shared_tombstones = self.db.query_one(
+            probed = self.db.query_one(
                 "SELECT 1 FROM shared_operation WHERE kind = 'd' "
                 "LIMIT 1") is not None
+            with self._meta_lock:
+                if self._has_shared_tombstones is None:
+                    self._has_shared_tombstones = probed
         return self._op_log_high, self._has_shared_tombstones
 
     def _note_ops_logged(self, ts_high: int, any_shared_delete: bool
                          ) -> None:
         """Keep the lazily-computed _op_log_state facts current after
         an op-insert batch (no-op while still uninitialized)."""
-        if self._op_log_high is not None and ts_high > self._op_log_high:
-            self._op_log_high = ts_high
-        if any_shared_delete and self._has_shared_tombstones is not None:
-            self._has_shared_tombstones = True
+        with self._meta_lock:
+            if self._op_log_high is not None and \
+                    ts_high > self._op_log_high:
+                self._op_log_high = ts_high
+            if any_shared_delete and \
+                    self._has_shared_tombstones is not None:
+                self._has_shared_tombstones = True
 
     def on_created(self, cb: Callable[[], None]) -> None:
         """Subscribe to SyncMessage::Created broadcasts (manager.rs:89)."""
@@ -697,11 +719,13 @@ class SyncManager:
     def register_instance(self, pub_id: bytes, **fields: Any) -> int:
         """Insert an instance row if unknown; returns local row id."""
         if pub_id != self.instance:
-            self._solo = False  # peers exist: bulk ops go row-format now
+            with self._meta_lock:
+                self._solo = False  # peers exist: row-format bulk ops
         row = self.db.query_one(
             "SELECT id FROM instance WHERE pub_id = ?", (pub_id,))
         if row is not None:
-            self._instance_ids[pub_id] = row["id"]
+            with self._meta_lock:
+                self._instance_ids[pub_id] = row["id"]
             return row["id"]
         import time
         defaults = {
@@ -714,7 +738,8 @@ class SyncManager:
         }
         defaults.update(fields)
         rid = self.db.insert("instance", defaults)
-        self._instance_ids[pub_id] = rid
+        with self._meta_lock:
+            self._instance_ids[pub_id] = rid
         return rid
 
     def receive_crdt_operation(self, op: CRDTOperation) -> bool:
@@ -826,7 +851,8 @@ class SyncManager:
                 conn.execute(
                     "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
                     (ts, pub))
-        self.timestamps.update(ts_max)
+        with self._meta_lock:
+            self.timestamps.update(ts_max)
         SYNC_OPS_INGESTED.inc(len(ops))
         SYNC_OPS_APPLIED.inc(applied)
         if errors:
@@ -977,7 +1003,8 @@ class SyncManager:
             conn.execute(
                 "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
                 (new_wm, pub))
-        self.timestamps[pub] = new_wm
+        with self._meta_lock:
+            self.timestamps[pub] = new_wm
         self.clock.update_with_timestamp(max_ts)
         self._note_ops_logged(max_ts, False)
 

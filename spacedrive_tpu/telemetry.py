@@ -566,12 +566,33 @@ SANITIZE_VIOLATIONS = counter(
     "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
     "loop_stall | lock_across_await | lock_order_cycle | "
     "jit_retrace_budget | host_transfer | task_exception | "
-    "task_orphaned | chan_overflow",
+    "task_orphaned | chan_overflow | data_race",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",
     "Longest single event-loop callback observed by the sanitizer "
     "since process start (0 while the sanitizer is off)")
+
+# -- thread-safety (threadctx.py ownership registry) ------------------------
+RACE_TRACKED_WRITES = counter(
+    "sd_race_tracked_writes_total",
+    "Attribute/container writes recorded by the armed threadctx write "
+    "recorder (declared owner classes only; 0 while the race guard is "
+    "off)")
+RACE_CANDIDATES = counter(
+    "sd_race_candidates_total",
+    "Writes that broke their declared ownership contract — one attr "
+    "written from >=2 threads with an empty lockset intersection, a "
+    "second thread on a loop_only/single_thread attr, or a post-init "
+    "write to an immutable one. Each is a data_race sanitizer "
+    "violation (raised in tier-1, counted in production)",
+    labelnames=("cls_attr",))
+RACE_HANDOFF_CLOSED = counter(
+    "sd_race_handoff_closed_total",
+    "Cross-thread loop hand-offs (threadctx.call_threadsafe) dropped "
+    "because the target event loop was already closed mid-shutdown — "
+    "work that is moot by definition, counted instead of crashing the "
+    "posting executor thread")
 
 # -- jit contracts (ops/jit_registry.py) ------------------------------------
 JIT_RETRACES = counter(

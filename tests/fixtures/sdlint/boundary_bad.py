@@ -1,0 +1,43 @@
+"""Known-POSITIVE fixture for the thread-boundary pass: loop-affine
+calls (task spawn, registry-channel methods, EventBus emit) from
+executor-context code, plus the raw threadsafe hand-off primitive."""
+
+import asyncio
+
+from spacedrive_tpu import channels, tasks
+
+
+async def _noop() -> None:
+    pass
+
+
+class Pump:
+    def __init__(self, events):
+        self.inbox = channels.channel("media.thumbs")
+        self.events = events
+
+    def worker_offer(self, item) -> None:
+        # All four BAD: this method is submitted to the pool below, so
+        # these loop-affine calls run on an executor thread.
+        self.inbox.put_nowait(item)
+        self.events.emit({"type": "x"})
+        tasks.spawn("leak", _noop(), owner="fixture")
+        asyncio.ensure_future(_noop())
+
+    def legacy_post(self, loop, item) -> None:
+        # BAD raw-threadsafe-handoff: the raw primitive crashes the
+        # posting thread when the loop closed mid-shutdown.
+        loop.call_soon_threadsafe(self.inbox.put_nowait, item)
+
+    async def run(self, pool) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(pool, self.worker_offer, 1)
+
+
+def _drain_local() -> None:
+    q = channels.channel("media.thumbs")
+    q.put_nowait(1)   # BAD: local registry channel, worker context
+
+
+async def kick() -> None:
+    await asyncio.to_thread(_drain_local)

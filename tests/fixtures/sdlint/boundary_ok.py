@@ -1,0 +1,41 @@
+"""Known-NEGATIVE fixture for the thread-boundary pass: the sanctioned
+shapes — loop-side channel use, the hardened call_threadsafe hand-off
+from worker code, and channel methods in ambient sync drivers."""
+
+import asyncio
+
+from spacedrive_tpu import channels, tasks, threadctx
+
+
+async def _noop() -> None:
+    pass
+
+
+class Pump:
+    def __init__(self, events):
+        self.inbox = channels.channel("media.thumbs")
+        self.events = events
+
+    def worker_offer(self, loop, item) -> None:
+        # The sanctioned hand-off: post the loop-affine work through
+        # the hardened helper; the callback runs ON the loop, and a
+        # loop closed mid-shutdown is counted, not crashed into.
+        threadctx.call_threadsafe(loop, self.inbox.put_nowait, item)
+
+    async def on_loop(self, item) -> None:
+        # Loop context: channel methods and spawns are home here.
+        self.inbox.put_nowait(item)
+        await self.inbox.put(item)
+        self.events.emit({"type": "x"})
+        tasks.spawn("fanout", _noop(), owner="fixture")
+
+    async def run(self, pool) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(pool, self.worker_offer, loop, 1)
+
+
+def sync_driver() -> None:
+    # Ambient single-threaded construction path (the jobs run-queue
+    # shape): no worker context, so the sync surface is fine.
+    q = channels.channel("media.thumbs")
+    q.put_nowait(1)

@@ -1,0 +1,39 @@
+"""Known-POSITIVE fixture for the guard-consistency pass: attributes
+protected at one site and bare (or under a different lock) at another
+— the RacerD inconsistent-lock-protection smell."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.hits = 0
+
+    def put(self, k, v) -> None:
+        with self._lock:
+            self.entries[k] = v
+            self.hits += 1
+
+    def evict(self, k) -> None:
+        if k in self.entries:
+            del self.entries[k]   # BAD: bare vs the guarded put
+
+    def reset(self) -> None:
+        self.hits = 0             # BAD: bare vs the guarded increment
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.state = []
+
+    def one(self) -> None:
+        with self._a_lock:
+            self.state.append(1)
+
+    def two(self) -> None:
+        with self._b_lock:
+            self.state.append(2)  # BAD: disjoint lock from one()

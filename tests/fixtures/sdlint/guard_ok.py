@@ -1,0 +1,84 @@
+"""Known-NEGATIVE fixture for the guard-consistency pass: consistent
+guards (including supersets and the tx-implies-write-lock model),
+init-time bare writes, never-guarded work lists, and registered
+classes (owned by the shared-mutation contract instead)."""
+
+import threading
+
+from spacedrive_tpu.threadctx import declare_owner, guarded_by
+
+declare_owner(
+    "fixture.OwnedElsewhere",
+    "tests/fixtures/sdlint/guard_ok.py::OwnedElsewhere",
+    {
+        "count": guarded_by("_lock"),
+    })
+
+
+class Consistent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self.entries = {}       # bare here: __init__ is exempt
+        self.hits = 0
+
+    def put(self, k, v) -> None:
+        with self._lock:
+            self.entries[k] = v
+            self.hits += 1
+
+    def evict(self, k) -> None:
+        with self._lock:
+            with self._aux_lock:
+                # Superset of the common guard is still consistent.
+                self.entries.pop(k, None)
+                self.hits -= 1
+
+
+class TxGuarded:
+    """`with db.tx():` holds the database write lock — the model the
+    pass shares with lock-discipline — so mixing it with an explicit
+    `with self._write_lock:` site is consistent."""
+
+    def __init__(self, db):
+        self.db = db
+        self._write_lock = threading.Lock()
+        self.pending = []
+
+    def in_tx(self) -> None:
+        with self.db.tx():
+            self.pending.append(1)
+
+    def direct(self) -> None:
+        with self._write_lock:
+            self.pending.append(2)
+
+
+class NeverGuarded:
+    """No site claims protection: a single-threaded work list, out of
+    scope by design (the shared-mutation context derivation decides
+    whether it NEEDS protection)."""
+
+    def __init__(self):
+        self.items = []
+
+    def push(self, item) -> None:
+        self.items.append(item)
+
+
+class OwnedElsewhere:
+    """Registered in the ownership registry: guard enforcement belongs
+    to the shared-mutation contract, not this heuristic — even though
+    one site here is bare (it would be a shared-mutation finding if
+    its context were multi-threaded)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self) -> None:
+        with self._lock:
+            self.count += 1
+
+    def lazy_reset(self) -> None:
+        self.count = 0

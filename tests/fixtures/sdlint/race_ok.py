@@ -1,0 +1,76 @@
+"""Known-NEGATIVE fixture for the shared-mutation pass: every contract
+kind obeyed, plus the sanctioned unregistered single-context class."""
+
+import asyncio
+import threading
+
+from spacedrive_tpu.threadctx import (
+    atomic_counter,
+    declare_owner,
+    guarded_by,
+    immutable_after_init,
+    loop_only,
+    single_thread,
+)
+
+declare_owner(
+    "fixture.CleanStats",
+    "tests/fixtures/sdlint/race_ok.py::CleanStats",
+    {
+        "h2d_bytes": guarded_by("_lock"),
+        "events": loop_only(),
+        "wall_s": single_thread(),
+        "ticks": atomic_counter(),
+        "shape": immutable_after_init(),
+    })
+
+
+class CleanStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.h2d_bytes = 0
+        self.events = []
+        self.wall_s = 0.0
+        self.ticks = 0
+        self.shape = (8, 57344)   # immutable: bound here, never again
+
+
+def _transfer(stats: CleanStats) -> None:
+    # guarded_by honored: the executor stream takes the declared lock.
+    with stats._lock:
+        stats.h2d_bytes += 57344
+    # atomic_counter: bare augmented update is the declared waiver.
+    stats.ticks += 1
+
+
+async def drive(stats: CleanStats, pool) -> None:
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(pool, _transfer, stats)
+    stats.events.append("done")   # loop_only attr, loop context only
+    stats.wall_s = 1.0            # single_thread: one writer context
+
+
+class LoopLocal:
+    """Unregistered, but every mutation is loop-context: no contract
+    needed and no finding."""
+
+    def __init__(self):
+        self.seen = {}
+
+    def record(self, k) -> None:
+        self.seen[k] = True
+
+
+async def uses(b: LoopLocal) -> None:
+    b.record("x")
+
+
+class WorkList:
+    """Unregistered and mutated only from ambient (unlabeled) sync
+    drivers — single-threaded by construction, no finding."""
+
+    def __init__(self):
+        self.items = []
+
+    def push(self, item) -> None:
+        self.items.append(item)

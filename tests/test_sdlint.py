@@ -1,18 +1,19 @@
 """sdlint framework: per-pass fixtures, the tree gate, baseline policy.
 
 This is the tier-1 hook that replaced the direct telemetry_lint run:
-`test_tree_clean_within_baseline` runs ALL fourteen passes (five
+`test_tree_clean_within_baseline` runs ALL seventeen passes (five
 concurrency/invariant + the round-10 device trio + the round-11
-lifecycle trio + the round-12 resource trio: queue-discipline,
-backpressure, unbounded-growth) over the repo and fails on any
-finding not in
+lifecycle trio + the round-12 resource trio + the round-13
+thread-safety trio: shared-mutation, thread-boundary,
+guard-consistency) over the repo and fails on any finding not in
 tools/sdlint/baseline.json (which may only shrink — budget enforced
 here too). The per-pass tests pin each pass to a known-positive /
 known-negative fixture pair under tests/fixtures/sdlint/, including
 the encoded PR 1 store/db.py reader-registration deadlock shape
 (locks_bad.Pr1Database), the encoded overlap.py:166 call-time-jit
-shape (jit_bad.call_time), and the encoded watcher.py:375
-dropped-task shape (lifecycle_bad.old_loop_spawn).
+shape (jit_bad.call_time), the encoded watcher.py:375 dropped-task
+shape (lifecycle_bad.old_loop_spawn), and the encoded PR 8
+PipelineStats lost-update shape (race_bad._transfer's bare `+=`).
 """
 
 import os
@@ -407,6 +408,149 @@ def test_channel_registry_static_runtime_drift():
         "prune the contract or adopt it")
 
 
+# -- shared-mutation (round 13: the thread-safety trio) ---------------------
+
+def test_shared_mutation_flags_known_positives():
+    found = _lint_fixture("race_bad.py", "shared-mutation")
+    codes = {f.code for f in found}
+    assert codes == {
+        "unguarded-write", "wrong-context-write", "multi-thread-write",
+        "non-atomic-write", "post-init-write", "undeclared-attr",
+        "undeclared-class"}, codes
+    # the encoded PR 8 shape: a guarded counter bumped bare from a
+    # run_in_executor device-stream target
+    assert any(f.code == "unguarded-write"
+               and f.ident == "RaceStats.h2d_bytes"
+               and f.qual == "_transfer" for f in found), found
+    assert any(f.code == "undeclared-class" and f.ident == "BareShared"
+               for f in found), found
+
+
+def test_shared_mutation_passes_known_negatives():
+    """Guarded executor writes, loop-side loop_only use, one-context
+    single_thread, atomic_counter `+=`, init-bound immutables, and
+    single-context unregistered classes are all sanctioned."""
+    assert _lint_fixture("race_ok.py", "shared-mutation") == []
+
+
+# -- thread-boundary ---------------------------------------------------------
+
+def test_thread_boundary_flags_known_positives():
+    found = _lint_fixture("boundary_bad.py", "thread-boundary")
+    codes = {f.code for f in found}
+    assert codes == {"loop-call-from-thread",
+                     "raw-threadsafe-handoff"}, codes
+    idents = {f.ident for f in found
+              if f.code == "loop-call-from-thread"}
+    assert {"self.inbox.put_nowait", "self.events.emit", "tasks.spawn",
+            "asyncio.ensure_future", "q.put_nowait"} <= idents, idents
+    # the old sync_net/api shape: the raw primitive, not the helper
+    assert any(f.code == "raw-threadsafe-handoff"
+               and f.qual == "Pump.legacy_post" for f in found)
+
+
+def test_thread_boundary_passes_known_negatives():
+    """call_threadsafe hand-offs, loop-side channel/spawn/emit use,
+    and ambient sync drivers are all sanctioned."""
+    assert _lint_fixture("boundary_ok.py", "thread-boundary") == []
+
+
+# -- guard-consistency -------------------------------------------------------
+
+def test_guard_consistency_flags_known_positives():
+    found = _lint_fixture("guard_bad.py", "guard-consistency")
+    assert {f.code for f in found} == {"mixed-guard"}
+    idents = {f.ident for f in found}
+    assert idents == {"Cache.entries", "Cache.hits",
+                      "TwoLocks.state"}, idents
+
+
+def test_guard_consistency_passes_known_negatives():
+    """Consistent guards, guard supersets, the tx-implies-write-lock
+    model, init-time writes, never-guarded work lists, and registered
+    classes are all out of scope."""
+    assert _lint_fixture("guard_ok.py", "guard-consistency") == []
+
+
+def test_race_fixture_contract_kinds_cover_the_registry():
+    """The fixture pair exercises every declared contract kind — a new
+    kind added to threadctx.KINDS must grow the fixtures with it."""
+    from spacedrive_tpu import threadctx
+    from tools.sdlint.passes._threads import declared_owners_from_tree
+
+    import ast as _ast
+    for fixture in ("race_bad.py", "race_ok.py"):
+        tree = _ast.parse(
+            open(os.path.join(FIXTURES, fixture), encoding="utf-8")
+            .read())
+        owners = declared_owners_from_tree(tree)
+        kinds = {kind for spec in owners.values()
+                 for kind, _lock in spec["attrs"].values()}
+        assert kinds == set(threadctx.KINDS), (fixture, kinds)
+
+
+# -- --changed incremental mode ---------------------------------------------
+
+def test_reverse_closure_includes_transitive_callers():
+    from tools.sdlint.core import reverse_closure_files
+
+    project = load_project(ROOT)
+    closure = reverse_closure_files(
+        project, ["spacedrive_tpu/channels.py"])
+    assert "spacedrive_tpu/channels.py" in closure
+    # jobs/manager constructs registry channels -> it re-lints
+    assert "spacedrive_tpu/jobs/manager.py" in closure
+    # files with no call path INTO channels stay out of scope
+    assert "spacedrive_tpu/sync/hlc.py" not in closure
+    assert "spacedrive_tpu/locations/paths.py" not in closure
+
+
+def test_changed_mode_scopes_and_exits_clean(monkeypatch, capsys):
+    import tools.sdlint.__main__ as cli
+
+    monkeypatch.setattr(cli, "git_changed_paths",
+                        lambda root, ref: ["spacedrive_tpu/flags.py"])
+    rc = cli.main(["--changed"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.out
+    assert "reverse-closure scope" in captured.err
+
+
+def test_changed_mode_falls_back_on_deleted_files(monkeypatch, capsys):
+    """A deleted/renamed in-scope module cannot seed the closure (its
+    callers are exactly what the change can break) — the run must
+    widen to the whole tree, never silently skip."""
+    import tools.sdlint.__main__ as cli
+
+    monkeypatch.setattr(
+        cli, "git_changed_paths",
+        lambda root, ref: ["spacedrive_tpu/never_existed.py"])
+    rc = cli.main(["--changed"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.out
+    assert "falling back to a full-tree run" in captured.err
+
+
+def test_changed_mode_with_nothing_touched(monkeypatch, capsys):
+    import tools.sdlint.__main__ as cli
+
+    monkeypatch.setattr(cli, "git_changed_paths",
+                        lambda root, ref: [])
+    assert cli.main(["--changed", "HEAD~1"]) == 0
+    assert "no lintable files changed" in capsys.readouterr().out
+
+
+def test_changed_mode_cannot_rewrite_baseline():
+    import pytest
+
+    import tools.sdlint.__main__ as cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["--changed", "--update-baseline"])
+    with pytest.raises(SystemExit):
+        cli.main(["--changed", "--write-baseline"])
+
+
 # -- the tree gate (runs all five passes; tier-1's CI hook) -----------------
 
 def test_tree_clean_within_baseline():
@@ -447,7 +591,8 @@ def test_every_registered_pass_ran_on_tree():
         "flag-registry", "telemetry", "jit-stability",
         "dtype-discipline", "host-transfer", "task-lifecycle",
         "cancellation-safety", "timeout-discipline",
-        "queue-discipline", "backpressure", "unbounded-growth"}
+        "queue-discipline", "backpressure", "unbounded-growth",
+        "shared-mutation", "thread-boundary", "guard-consistency"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -536,6 +681,17 @@ def test_cli_timeout_table_covers_every_declared_budget(capsys):
         assert f"`{name}`" in out
 
 
+def test_cli_owner_table_covers_every_declared_owner(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--owner-table"]) == 0
+    out = capsys.readouterr().out
+    from spacedrive_tpu import threadctx
+
+    for name in threadctx.CONTRACTS:
+        assert f"`{name}`" in out
+
+
 def test_cli_chan_table_covers_every_declared_channel(capsys):
     from tools.sdlint.__main__ import main
 
@@ -563,11 +719,15 @@ def test_baseline_budget_is_minimal_and_reasons_unique():
                      "task-lifecycle", "cancellation-safety",
                      "timeout-discipline",
                      "queue-discipline", "backpressure",
-                     "unbounded-growth")}
-    # Today the lifecycle AND resource passes run CLEAN (zero
-    # baselined entries — round 12's 22 initial findings were all
-    # fixed or inline-waived with reasons); if one is ever added it
-    # needs a unique, substantial reason.
+                     "unbounded-growth",
+                     "shared-mutation", "thread-boundary",
+                     "guard-consistency")}
+    # Today the lifecycle, resource AND thread-safety passes run CLEAN
+    # (zero baselined entries — round 13's initial findings were all
+    # fixed outright: the validator cross-thread emit, the SyncManager
+    # cache lock, the high-water compare-and-set, the two raw
+    # threadsafe hand-off sites); if one is ever added it needs a
+    # unique, substantial reason.
     for key, reason in lifecycle.items():
         assert len(reason.strip()) >= 20, f"thin reason on {key}"
     assert len(set(lifecycle.values())) == len(lifecycle), (

@@ -4,6 +4,9 @@
     python -m tools.sdlint --json              # machine-readable findings
     python -m tools.sdlint --passes lock-discipline,crdt-parity
     python -m tools.sdlint --passes            # list registered passes
+    python -m tools.sdlint --changed           # files touched vs HEAD +
+                                               # reverse-call closure
+    python -m tools.sdlint --changed origin/main
     python -m tools.sdlint --update-baseline   # prune stale entries only
     python -m tools.sdlint --write-baseline    # bootstrap (see policy!)
     python -m tools.sdlint --flag-table        # README flag table stdout
@@ -19,10 +22,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .baseline import DEFAULT_PATH, Baseline
-from .core import load_project, repo_root, run_passes
+from .core import (
+    DEFAULT_SCOPES,
+    EXCLUDE_PREFIXES,
+    Project,
+    git_changed_paths,
+    load_project,
+    repo_root,
+    reverse_closure_files,
+    run_passes,
+)
 from .passes import get_passes
 
 
@@ -78,15 +91,29 @@ def main(argv=None) -> int:
     ap.add_argument("--chan-table", action="store_true",
                     help="print the generated README channel table "
                          "and exit")
+    ap.add_argument("--owner-table", action="store_true",
+                    help="print the generated thread-ownership "
+                         "contract table and exit")
     ap.add_argument("--stats", action="store_true",
                     help="per-pass finding counts and wall-time "
                          "(informational; exit 0)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="incremental pre-commit mode: lint only files "
+                         "touched vs REF (default HEAD; worktree + "
+                         "index + untracked) plus their reverse "
+                         "call-graph closure")
     args = ap.parse_args(argv)
 
     if args.no_baseline and (args.update_baseline or args.write_baseline):
         ap.error("--no-baseline cannot be combined with "
                  "--update-baseline/--write-baseline (it would rewrite "
                  "the baseline from an empty view)")
+    if args.changed is not None and (args.update_baseline
+                                     or args.write_baseline):
+        ap.error("--changed cannot be combined with "
+                 "--update-baseline/--write-baseline (a partial view "
+                 "must never rewrite the whole-tree baseline)")
 
     if args.flag_table:
         sys.path.insert(0, args.root)
@@ -106,6 +133,12 @@ def main(argv=None) -> int:
         print(channels.chan_table_markdown())
         return 0
 
+    if args.owner_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import threadctx
+        print(threadctx.owner_table_markdown())
+        return 0
+
     if args.stats:
         for name, count, secs in stats(args.root):
             print(f"{name:22s} {count:4d} finding(s) {secs:7.2f}s")
@@ -120,6 +153,51 @@ def main(argv=None) -> int:
     pass_names = [p.strip() for p in args.passes.split(",") if p.strip()]
     passes = get_passes(pass_names or None)
     project = load_project(args.root)
+    scope_paths = None
+    if args.changed is not None:
+        try:
+            touched = git_changed_paths(args.root, args.changed)
+        except RuntimeError as e:
+            print(f"sdlint: --changed: {e}", file=sys.stderr)
+            return 2
+        known = {f.relpath for f in project.files}
+        # "Deleted" = in a lint scope, absent from the index, and NOT
+        # merely excluded from linting (tools/sdlint/* edits its own
+        # analyzer — those are never in `known` yet clearly exist).
+        deleted = [p for p in touched
+                   if p.endswith(".py") and p not in known
+                   and p.startswith(tuple(s + "/" for s in
+                                          DEFAULT_SCOPES))
+                   and not p.startswith(EXCLUDE_PREFIXES)
+                   and "__pycache__" not in p
+                   and not os.path.exists(os.path.join(args.root, p))]
+        if deleted:
+            # A deleted/renamed module's CALLERS are exactly what the
+            # change can break, but the file is gone from the current
+            # index so the closure cannot be seeded from it — fall
+            # back to the whole tree rather than silently skipping.
+            print(f"sdlint: --changed: {len(deleted)} in-scope "
+                  f"file(s) deleted/renamed vs {args.changed} "
+                  f"({deleted[0]}…) — falling back to a full-tree "
+                  "run", file=sys.stderr)
+        else:
+            scope_paths = reverse_closure_files(project, touched)
+            if not scope_paths:
+                print(f"sdlint: no lintable files changed vs "
+                      f"{args.changed}")
+                return 0
+            # Re-index over the scoped subset: passes run on (and pay
+            # for) only the changed files plus their reverse callers.
+            # Whole-tree invariants (lock graph, registry drift) are
+            # judged on the subset view — the full gate stays
+            # tier-1's job.
+            project = Project(args.root,
+                              [f for f in project.files
+                               if f.relpath in scope_paths],
+                              project.problems)
+            print(f"sdlint: --changed {args.changed}: {len(touched)} "
+                  f"touched file(s) -> {len(scope_paths)} in "
+                  f"reverse-closure scope", file=sys.stderr)
     findings = run_passes(project, passes)
     # A subset run must not judge (or prune!) other passes' baseline
     # entries: out-of-scope keys are carved out and merged back on save.
@@ -140,7 +218,25 @@ def main(argv=None) -> int:
                         if k.split("::", 1)[0] not in ran}
         bl.entries = {k: v for k, v in bl.entries.items()
                       if k not in out_of_scope}
+    if scope_paths is not None:
+        # Same carve by PATH for incremental runs: baseline entries for
+        # files outside the closure are neither judged nor stale (key
+        # layout: pass::code::path::qual::ident).
+        def _in_scope(key: str) -> bool:
+            parts = key.split("::")
+            return len(parts) > 2 and parts[2] in scope_paths
+        out_of_path = {k: v for k, v in bl.entries.items()
+                       if not _in_scope(k)}
+        bl.entries = {k: v for k, v in bl.entries.items()
+                      if k not in out_of_path}
+        out_of_scope.update(out_of_path)
     new, baselined, stale = bl.split(findings)
+    if scope_paths is not None:
+        # Subset views lose interprocedural findings whose chains
+        # leave the closure — "stale" there is an artifact, not a
+        # fixed finding. Suppress it in BOTH output modes so a
+        # --changed --json consumer can never prune live entries.
+        stale = []
 
     if args.update_baseline:
         dropped = bl.prune(findings)
@@ -160,7 +256,10 @@ def main(argv=None) -> int:
     else:
         for f in new:
             print(f.text())
-        if stale and not args.update_baseline:
+        if stale and not args.update_baseline and scope_paths is None:
+            # Incremental runs skip the nudge: a subset view loses
+            # interprocedural findings whose chains leave the closure,
+            # so "stale" there is an artifact, not a fixed finding.
             print(f"note: {len(stale)} stale baseline entr(y/ies) — run "
                   f"--update-baseline to shrink the file",
                   file=sys.stderr)

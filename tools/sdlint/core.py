@@ -16,7 +16,8 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Set)
 
 SUPPRESS_RE = re.compile(r"#\s*sdlint:\s*ok\[([a-z0-9_,-]+)\]")
 
@@ -88,8 +89,11 @@ class FuncInfo:
 
 # Calls whose ARGUMENTS are function references executed off-loop —
 # anything passed into them is not executed on the caller's thread.
+# call_threadsafe is threadctx.py's hardened call_soon_threadsafe;
+# run_coroutine_threadsafe is its coroutine sibling.
 _THREAD_WRAPPERS = {"to_thread", "run_in_executor", "submit",
-                    "call_soon_threadsafe"}
+                    "call_soon_threadsafe", "call_threadsafe",
+                    "run_coroutine_threadsafe"}
 
 
 class SourceFile:
@@ -235,9 +239,28 @@ class ProjectIndex:
             hit = self.by_key.get(f"{caller.src.relpath}::{last}")
             if hit is not None:
                 return hit
+            # Closures addressable from THIS lexical scope: the
+            # caller's own nested functions (`handler.work` from
+            # handler) and siblings up the enclosing-scope chain
+            # (`_files._spawn_fs_job` from `_files.files_delete`) —
+            # probe every ancestor prefix, innermost first.
+            scope = caller.qual.split(".")
+            for i in range(len(scope), 0, -1):
+                hit = self.by_key.get(
+                    f"{caller.src.relpath}::"
+                    f"{'.'.join(scope[:i])}.{last}")
+                if hit is not None:
+                    return hit
         if len(parts) > 1 and last in _COMMON_ATTRS:
             return None
-        cands = self._by_name.get(last, [])
+        # Other scopes' nested closures are not addressable by name:
+        # a bare `partial(...)` must never resolve to some module's
+        # `_ingest_answers.partial` inner function. Only top-level
+        # functions and direct methods participate in the name-based
+        # fallback tiers.
+        cands = [c for c in self._by_name.get(last, [])
+                 if (c.cls is not None and c.qual == f"{c.cls}.{c.name}")
+                 or (c.cls is None and "." not in c.qual)]
         if len(cands) == 1:
             return cands[0]
         same_mod = [c for c in cands if c.src is caller.src]
@@ -324,6 +347,55 @@ def run_passes(project: Project,
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.lineno, f.key()))
     return findings
+
+
+def reverse_closure_files(project: Project,
+                          changed: Iterable[str]) -> Set[str]:
+    """The incremental-lint scope: `changed` relpaths plus every file
+    whose functions (transitively) CALL into them — the reverse
+    call-graph closure over resolvable edges. A change to a callee can
+    invalidate any caller-side invariant (lock order, context
+    reachability, blocking closure), so callers re-lint; callees of
+    changed files keep their own previously-clean verdict."""
+    idx = project.index
+    rev: Dict[str, Set[str]] = {}
+    for fn in idx.funcs:
+        for site in fn.calls:
+            callee = idx.resolve(fn, site.name)
+            if callee is not None and \
+                    callee.src.relpath != fn.src.relpath:
+                rev.setdefault(callee.src.relpath,
+                               set()).add(fn.src.relpath)
+    known = {f.relpath for f in project.files}
+    closure = {c for c in changed if c in known}
+    frontier = list(closure)
+    while frontier:
+        f = frontier.pop()
+        for caller in rev.get(f, ()):
+            if caller not in closure:
+                closure.add(caller)
+                frontier.append(caller)
+    return closure
+
+
+def git_changed_paths(root: str, ref: str = "HEAD") -> List[str]:
+    """Repo-relative posix paths touched vs `ref` (worktree + index)
+    plus untracked files — the pre-commit view. Raises on git errors
+    (missing ref, not a repo) so the CLI can report them."""
+    import subprocess
+
+    def run(*args: str) -> List[str]:
+        proc = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)}: {proc.stderr.strip()}")
+        return [ln.strip() for ln in proc.stdout.splitlines()
+                if ln.strip()]
+
+    out = set(run("diff", "--name-only", ref, "--"))
+    out.update(run("ls-files", "--others", "--exclude-standard"))
+    return sorted(p.replace(os.sep, "/") for p in out)
 
 
 def repo_root() -> str:

@@ -18,6 +18,9 @@ from .timeout_discipline import TimeoutDisciplinePass
 from .queue_discipline import QueueDisciplinePass
 from .backpressure import BackpressurePass
 from .unbounded_growth import UnboundedGrowthPass
+from .shared_mutation import SharedMutationPass
+from .thread_boundary import ThreadBoundaryPass
+from .guard_consistency import GuardConsistencyPass
 
 PASSES = {
     p.name: p for p in (
@@ -28,6 +31,8 @@ PASSES = {
         TimeoutDisciplinePass(),
         QueueDisciplinePass(), BackpressurePass(),
         UnboundedGrowthPass(),
+        SharedMutationPass(), ThreadBoundaryPass(),
+        GuardConsistencyPass(),
     )
 }
 

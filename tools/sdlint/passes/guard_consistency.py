@@ -1,0 +1,105 @@
+"""Pass: guard-consistency — one attribute, one guard (RacerD-style).
+
+An attribute written under `with self._x_lock:` at one site and bare
+(or under a DIFFERENT lock) at another is the classic inconsistent-
+lock-protection smell: the guarded site documents that concurrent
+access exists, so the bare site is a lost-update/torn-read candidate —
+exactly the evidence-based heuristic Facebook's RacerD made scale
+(O'Hearn, POPL'18): no alias analysis, just "this field is sometimes
+protected, and here it isn't".
+
+Scope: per-class `self.<attr>` mutation sites (rebinds, augmented
+updates, container mutations) outside `__init__`/`__post_init__`.
+Classes registered in the threadctx.py ownership registry are EXEMPT —
+their attrs are held to the stronger declared contract by the
+shared-mutation pass; this pass exists to catch the classes nobody
+declared yet.
+
+Code:
+
+- ``mixed-guard`` — an attr with at least one guarded mutation site
+  and at least one site bare or under a different lock. The ident is
+  `Class.attr`; the message names both locksets and both sites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Project
+from ._threads import (
+    MutationSite,
+    class_hierarchy,
+    collect_mutations,
+    declared_owners,
+    effective_owner,
+    owners_by_class,
+)
+
+PASS = "guard-consistency"
+
+
+class GuardConsistencyPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_owners(project.root, project)
+        by_class = owners_by_class(declared)
+        hierarchy = class_hierarchy(project)
+        registered = {
+            name for name in hierarchy
+            if effective_owner(name, by_class, hierarchy) is not None
+        } | set(by_class)
+        # Same `known` set as shared-mutation so the memoized
+        # whole-tree sweep is genuinely shared (one walk per lint);
+        # the extra annotation-resolved sites it adds are filtered
+        # right below by the self_recv test.
+        sites = collect_mutations(project, set(by_class))
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        grouped: Dict[Tuple[str, str, str], List[MutationSite]] = {}
+        for s in sites:
+            if not s.self_recv or s.in_init:
+                continue
+            if s.cls_name in registered:
+                continue  # shared-mutation enforces the real contract
+            grouped.setdefault(
+                (s.fn.src.relpath, s.cls_name, s.attr), []).append(s)
+
+        for (relpath, cls_name, attr), group in sorted(grouped.items()):
+            guarded = [s for s in group if s.locks]
+            bare = [s for s in group if not s.locks]
+            if not guarded:
+                continue  # never protected: no claimed invariant
+            common = frozenset.intersection(
+                *[frozenset(s.locks) for s in group])
+            if common:
+                continue  # one lock covers every site (extras are fine)
+            g0 = min(guarded, key=lambda s: s.lineno)
+            if bare:
+                other = min(bare, key=lambda s: s.lineno)
+                shape = (f"bare at {other.fn.qual}:{other.lineno}")
+            else:
+                # Two different locks — still inconsistent. The cited
+                # counter-site must be one whose lockset actually
+                # DIFFERS from g0's, or the diagnostic points at
+                # itself.
+                other = min((s for s in guarded
+                             if s.locks != g0.locks),
+                            key=lambda s: s.lineno)
+                shape = (f"under {sorted(other.locks)} at "
+                         f"{other.fn.qual}:{other.lineno}")
+            f = Finding(
+                PASS, "mixed-guard", relpath, g0.fn.qual,
+                f"{cls_name}.{attr}",
+                f"`{cls_name}.{attr}` is mutated under "
+                f"{sorted(g0.locks)} here but {shape} — inconsistent "
+                "guard means the lock protects nothing; hold the same "
+                "lock everywhere or declare the class in "
+                "threadctx.py",
+                g0.lineno)
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+        return findings

@@ -1,0 +1,176 @@
+"""Pass: thread-boundary — loop-affine calls stay on the loop.
+
+asyncio primitives are not thread-safe: `create_task`, waking a
+channel's waiter futures (`Channel.put_nowait` → `fut.set_result`),
+and EventBus fan-out all assume the event-loop thread. Code running on
+an executor thread (a `to_thread` target, a staging-pool worker, a
+per-device dispatch stream) must cross back through
+`loop.call_soon_threadsafe(...)` / `asyncio.run_coroutine_threadsafe`
+— or this tree's hardened spelling, `threadctx.call_threadsafe(loop,
+cb, *args)`, which additionally tolerates a loop closed mid-shutdown
+(the raw idioms at the old p2p/sync_net originate_soon and api/server
+ws-emit sites are the sanctioned shapes this pass points at).
+
+Codes:
+
+- ``loop-call-from-thread`` — a loop-affine call (task spawn, channel
+  method, EventBus emit) in a function reachable from a worker/atexit
+  context, not wrapped in a threadsafe poster. A function reachable
+  from BOTH loop and worker contexts is flagged too: in its worker
+  incarnation the call corrupts loop state.
+- ``raw-threadsafe-handoff`` — a literal `loop.call_soon_threadsafe`
+  / `run_coroutine_threadsafe` call outside threadctx.py: the raw
+  primitive crashes the posting thread with `RuntimeError: Event loop
+  is closed` when shutdown wins the race — use
+  `threadctx.call_threadsafe`, which swallows exactly that shape and
+  counts it into `sd_race_handoff_closed_total`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, FuncInfo, Project, dotted, own_body_walk
+from ._threads import CENTRAL, thread_contexts
+
+PASS = "thread-boundary"
+
+# Channel-typed receivers: methods that touch waiter futures or the
+# slot deque — loop-affine even on the "pure sync" surface once any
+# async consumer is parked.
+_CHANNEL_METHODS = {"put", "put_nowait", "get", "get_nowait", "remove",
+                    "popleft", "note_put", "note_drain"}
+_CHANNEL_FACTORIES = {"channel", "window", "bounded_dict"}
+
+# Task-spawn shapes (the supervisor resolves through the project
+# index; the asyncio spellings are matched by name).
+_SPAWN_DOTTED = {"asyncio.create_task", "asyncio.ensure_future",
+                 "tasks.spawn"}
+
+# EventBus receivers by naming idiom (node.py: `self.events.emit`).
+_BUS_RECEIVERS = {"events", "bus", "event_bus"}
+
+_RAW_POSTERS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+def _channel_attrs(src_tree: ast.Module) -> Dict[str, Set[str]]:
+    """class name → self-attrs assigned from channels.channel/window/
+    bounded_dict (the queue-discipline registration idiom)."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(src_tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            d = dotted(sub.value.func)
+            if d is None or \
+                    d.rsplit(".", 1)[-1] not in _CHANNEL_FACTORIES:
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    attrs.add(tgt.attr)
+        if attrs:
+            out[node.name] = attrs
+    return out
+
+
+def _local_channels(fn: FuncInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in own_body_walk(fn.node):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        d = dotted(node.value.func)
+        if d is None or d.rsplit(".", 1)[-1] not in _CHANNEL_FACTORIES:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+def _loop_affine(call: ast.Call, fn: FuncInfo, project: Project,
+                 chan_attrs: Dict[str, Set[str]],
+                 local_chans: Set[str]) -> Optional[str]:
+    """Stable ident when this call is loop-affine, else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if d in _SPAWN_DOTTED:
+        return d
+    if last == "spawn":
+        callee = project.index.resolve(fn, d)
+        if callee is not None and \
+                callee.src.relpath.endswith("tasks.py"):
+            return d
+    if last in _CHANNEL_METHODS and len(parts) >= 2:
+        recv = parts[:-1]
+        if recv[0] == "self" and len(recv) == 2 and fn.cls and \
+                recv[1] in chan_attrs.get(fn.cls, set()):
+            return d
+        if len(recv) == 1 and recv[0] in local_chans:
+            return d
+    if last in ("emit", "publish") and len(parts) >= 2 and \
+            parts[-2] in _BUS_RECEIVERS:
+        return d
+    return None
+
+
+class ThreadBoundaryPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        contexts = thread_contexts(project)
+        chan_attrs_by_file: Dict[str, Dict[str, Set[str]]] = {}
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            ctx = contexts.get(f"{fn.src.relpath}::{fn.qual}", set())
+            off_loop = {c for c in ctx if c != "loop"}
+            chan_attrs = chan_attrs_by_file.get(fn.src.relpath)
+            if chan_attrs is None:
+                chan_attrs = _channel_attrs(fn.src.tree)
+                chan_attrs_by_file[fn.src.relpath] = chan_attrs
+            local_chans = _local_channels(fn) if off_loop else set()
+            for site in fn.calls:
+                d = site.name
+                last = d.rsplit(".", 1)[-1]
+                if last in _RAW_POSTERS and \
+                        fn.src.relpath != CENTRAL:
+                    emit(Finding(
+                        PASS, "raw-threadsafe-handoff",
+                        fn.src.relpath, fn.qual, d,
+                        f"raw `{d}` hand-off: a loop closed "
+                        "mid-shutdown raises RuntimeError into the "
+                        "posting thread — use "
+                        "threadctx.call_threadsafe(loop, cb, *args)",
+                        site.node.lineno))
+                if not off_loop or site.wrapped:
+                    continue
+                ident = _loop_affine(site.node, fn, project,
+                                     chan_attrs, local_chans)
+                if ident is not None:
+                    emit(Finding(
+                        PASS, "loop-call-from-thread",
+                        fn.src.relpath, fn.qual, ident,
+                        f"loop-affine call `{ident}` in a function "
+                        f"reachable from {sorted(off_loop)} — post it "
+                        "through threadctx.call_threadsafe(loop, ...) "
+                        "(asyncio primitives and registry channels "
+                        "are not thread-safe)",
+                        site.node.lineno))
+        return findings

@@ -50,6 +50,7 @@ EXEMPT_MODULES = {
     "spacedrive_tpu/timeouts.py",
     "spacedrive_tpu/channels.py",
     "spacedrive_tpu/telemetry.py",
+    "spacedrive_tpu/threadctx.py",
     "spacedrive_tpu/ops/jit_registry.py",
 }
 
