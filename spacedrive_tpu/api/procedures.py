@@ -110,6 +110,21 @@ def _core(r: Router) -> None:
             limit=int(input.get("limit", 100)),
             trace_id=input.get("trace"))
 
+    @r.query("node.trace.export")
+    async def node_trace_export(node, input):
+        """The flight-recorder export: span ring + pipeline timeline
+        as one schema-valid Chrome-trace/Perfetto JSON document
+        (spacedrive_tpu/flight.py). Open it in chrome://tracing or
+        ui.perfetto.dev; `python -m tools.trace_export --url ...`
+        pulls and validates it from a live node. Built off-loop: a
+        full ring is thousands of events to copy/sort, and the export
+        is pulled exactly when the node is busy."""
+        from .. import flight
+
+        del input
+        return await asyncio.to_thread(flight.chrome_trace,
+                                       node_name=node.config.name)
+
     @r.subscription("node.telemetry")
     def node_telemetry(node, _input, emit):
         """Relay the TelemetryReporter's periodic TelemetrySnapshot

@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .. import channels, tasks, telemetry, threadctx
+from .. import channels, tasks, telemetry, threadctx, tracing
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
 from ..telemetry import API_REQUESTS
@@ -206,7 +206,14 @@ class ApiServer:
             raw = request.query.get("input")
             input = json.loads(raw) if raw else None
         try:
-            result = await self.router.dispatch(path, input)
+            # Clients (and the trace_export CLI pulling a live trace)
+            # propagate their trace in X-Sdtpu-Trace; the dispatch
+            # span then continues it, so an API-triggered sync/job
+            # shows up under the caller's id.
+            with tracing.continue_trace(
+                    request.headers.get("X-Sdtpu-Trace")), \
+                    tracing.span(f"rpc/{path}"):
+                result = await self.router.dispatch(path, input)
             return web.json_response({"result": result})
         except RpcError as e:
             return web.json_response(
@@ -241,8 +248,12 @@ class ApiServer:
             try:
                 if mtype in ("query", "mutation"):
                     try:
-                        result = await self.router.dispatch(
-                            msg["path"], msg.get("input"))
+                        # The ws envelope's optional "tp" field is the
+                        # websocket spelling of X-Sdtpu-Trace.
+                        with tracing.continue_trace(msg.get("tp")), \
+                                tracing.span(f"rpc/{msg['path']}"):
+                            result = await self.router.dispatch(
+                                msg["path"], msg.get("input"))
                     except asyncio.TimeoutError as e:
                         # A budget fired INSIDE the procedure (p2p/sync
                         # await): the socket is fine — report it, as

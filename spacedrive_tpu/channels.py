@@ -637,6 +637,15 @@ declare_channel(
     put_budget="ops.pipeline.staged.put")
 
 declare_channel(
+    "ops.pipeline.timeline", 4096, "shed_oldest", "ops",
+    "Flight-recorder timeline ring (spacedrive_tpu/flight.py): one "
+    "event per pipeline batch phase (stage/H2D/kernel/retire, plus "
+    "the per-batch bound-attribution window), written by the per-"
+    "device dispatch executor threads under the recorder's lock. "
+    "History ages out oldest-first — the export shows the recent "
+    "window, memory never grows with uptime.")
+
+declare_channel(
     "p2p.route_cache", 512, "shed_oldest", "p2p",
     "Healthy-tunnel route cache (sync_net): LRU over identity → "
     "(addr, port), invalidated on send failure.", kind="cache")

@@ -255,6 +255,14 @@ declare(
     "TPU hardware. Unset = real link only.")
 
 declare(
+    "SDTPU_SPAN_RING", 512, parse_int,
+    "Capacity of the tracing span ring buffer (tracing.py "
+    "recent_spans / node.spans / the flight-recorder export). Read "
+    "once at import — the ring is module-global; "
+    "tracing.configure_span_ring() re-reads it for tests/embedders.",
+    strict=True)
+
+declare(
     "SDTPU_TASK_REAP_S", 5.0, parse_float,
     "Grace period the task supervisor's shutdown reap (tasks.py, "
     "driven by Node.shutdown) waits for cancelled tasks before "

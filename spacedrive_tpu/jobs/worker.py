@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .. import channels, tasks
 from ..telemetry import JOBS_EARLY_FINISH, JOBS_STEP_ERRORS, JOB_STEP_SECONDS
+from ..tracing import current_trace_id
 from ..tracing import span as trace_span
 from .job import (
     EarlyFinish,
@@ -112,6 +113,11 @@ class Worker:
         # ensure_future and asyncio.to_thread) nests under it.
         with trace_span(f"job/{self.report.name}",
                         job_id=self.report.id.hex()):
+            # Stamp the run's trace id into the persisted report so an
+            # operator can jump from a job row to its spans
+            # (node.spans {trace: ...}) and its flight-recorder
+            # timeline (node.trace.export) after the fact.
+            self.report.metadata["trace"] = current_trace_id()
             try:
                 status = await self._run_inner()
             except asyncio.CancelledError:

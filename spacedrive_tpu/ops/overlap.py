@@ -64,7 +64,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import jit_registry
-from .. import channels, flags
+from .. import channels, flags, tracing
+from ..flight import RECORDER, new_run_token
 from ..telemetry import (
     PIPELINE_DEPTH_HIGH_WATER,
     PIPELINE_DEVICE_BATCHES,
@@ -327,10 +328,31 @@ def _dispatch_kernel(jfn, w, l, donate: bool,
 
 
 def _transfer_and_dispatch(jfn, words, lengths, dev, donate: bool,
-                           stats: PipelineStats, track_buffers: bool):
-    """Per-device stream body (executor thread): H2D + kernel dispatch."""
+                           stats: PipelineStats, track_buffers: bool,
+                           batch_idx: Optional[int] = None,
+                           stream: int = 0, label: str = "",
+                           trace: Optional[str] = None,
+                           run: Optional[int] = None):
+    """Per-device stream body (executor thread): H2D + kernel dispatch.
+
+    With a batch_idx (the measured pipeline loop; calibration passes
+    None) the flight recorder gets one `h2d` and one `kernel` timeline
+    event per batch. The kernel lane times the DISPATCH wall — on an
+    async backend completion lands in the batch's `retire` lane; on
+    the CPU/sim-link paths tier-1 pins, dispatch is effectively the
+    execution."""
+    t0 = time.perf_counter()
     w, l = _h2d(words, lengths, dev, stats)
+    t1 = time.perf_counter()
     out, keep = _dispatch_kernel(jfn, w, l, donate, stats)
+    if batch_idx is not None:
+        t2 = time.perf_counter()
+        RECORDER.record("h2d", batch=batch_idx, t0=t0, t1=t1,
+                        device=label, stream=stream, trace=trace,
+                        run=run)
+        RECORDER.record("kernel", batch=batch_idx, t0=t1, t1=t2,
+                        device=label, stream=stream, trace=trace,
+                        run=run)
     if track_buffers:
         import gc
 
@@ -385,7 +407,29 @@ def run_overlapped(
         footprint probe.
     Returns ([per-batch digests], stats). The returned digests are
     row-aligned with each batch's path order.
+
+    The whole run executes inside a `pipeline.run` span, and every
+    measured batch's stage/H2D/kernel/retire phases land in the flight
+    recorder (spacedrive_tpu/flight.py) stamped with that span's trace
+    id — a caller already inside a trace (the identifier job) gets the
+    pipeline timeline attached to its own trace.
     """
+    with tracing.span("pipeline.run", batches=len(batches)):
+        return _run_overlapped_impl(
+            batches, kernel, calibrate_every, depth=depth,
+            devices=devices, donate=donate, track_buffers=track_buffers)
+
+
+def _run_overlapped_impl(
+    batches: Sequence[Tuple[Sequence[str], np.ndarray]],
+    kernel: Optional[Callable] = None,
+    calibrate_every: Optional[int] = None,
+    *,
+    depth: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    donate: Optional[bool] = None,
+    track_buffers: bool = False,
+) -> Tuple[List[np.ndarray], PipelineStats]:
     import jax
 
     if donate is None:
@@ -446,7 +490,7 @@ def run_overlapped(
     if len(batches) > 1:
         _run_pipeline(batches, jfn, devs, depth, bool(donate), stats,
                       results, calibrate_every, _calibrate,
-                      track_buffers)
+                      track_buffers, tracing.current_trace_id())
     stats.files = sum(len(p) for p, _ in batches[1:])
 
     # Post-run sample: same components, same batch-0 data, measured the
@@ -463,7 +507,8 @@ def run_overlapped(
 def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                   stats: PipelineStats, results,
                   calibrate_every: int, calibrate: Callable,
-                  track_buffers: bool) -> None:
+                  track_buffers: bool,
+                  trace: Optional[str] = None) -> None:
     """The measured depth-N loop over batches[1:]. Runs a private event
     loop (run_overlapped is a synchronous API called from benches and
     job worker threads) whose coroutines only coordinate — staging,
@@ -474,6 +519,11 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
 
     n = len(batches)
     n_stagers = min(depth, n - 1)
+    # Disambiguates THIS run's batch windows in the process recorder:
+    # two runs (concurrent jobs, or back-to-back in one trace) both
+    # dispatch a "batch 3", and the bound attribution must never mix
+    # their phases.
+    run_token = new_run_token()
     # Calibration milestones: after retiring batch m (1-indexed count),
     # pause staging and re-time the serial components — same cadence as
     # the old double-buffer ((i-1) % calibrate_every == 0 with room for
@@ -505,7 +555,7 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
         retire_pool = ThreadPoolExecutor(
             1, thread_name_prefix="sdtpu-pipe-retire")
 
-        async def stager() -> None:
+        async def stager(w: int) -> None:
             while True:
                 i = state["next"]
                 if i >= n:
@@ -528,12 +578,19 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                     if stats.depth_high_water > _DEPTH_HW:
                         _DEPTH_HW = stats.depth_high_water
                         PIPELINE_DEPTH_HIGH_WATER.set(_DEPTH_HW)
+                t0 = time.perf_counter()
                 words, lengths = await loop.run_in_executor(
                     stage_pool, _stage_batch, *batches[i])
+                # Stage lane: this batch's staging wall as the
+                # pipeline saw it (executor queue wait included — that
+                # wait IS stage-side contention).
+                RECORDER.record("stage", batch=i, t0=t0,
+                                t1=time.perf_counter(), stream=w,
+                                trace=trace, run=run_token)
                 await staged.put((i, words, lengths))
 
         async def feed() -> None:
-            await asyncio.gather(*(stager() for _ in range(n_stagers)))
+            await asyncio.gather(*(stager(w) for w in range(n_stagers)))
             for _ in devs:
                 await staged.put((_DONE, None, None))
 
@@ -561,7 +618,8 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                     return
                 out, keep = await loop.run_in_executor(
                     dev_pools[d], _transfer_and_dispatch, jfn, words,
-                    lengths, dev, donate, stats, track_buffers)
+                    lengths, dev, donate, stats, track_buffers,
+                    i, d, label, trace, run_token)
                 with stats._lock:
                     stats.per_device_batches[label] = (
                         stats.per_device_batches.get(label, 0) + 1)
@@ -576,8 +634,14 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                 with stats._lock:
                     stats.retire_stall_s += wait
                 PIPELINE_RETIRE_STALL_SECONDS.inc(wait)
+                t0r = time.perf_counter()
                 results[i] = await loop.run_in_executor(
                     retire_pool, _retire, out)
+                # Retire lane; the recorder closes batch i's window
+                # here and emits its bound-attribution event.
+                RECORDER.record("retire", batch=i, t0=t0r,
+                                t1=time.perf_counter(), trace=trace,
+                                run=run_token)
                 del keep  # undonated: device inputs released at retire
                 state["retired"] += 1
                 state["in_flight"] -= 1

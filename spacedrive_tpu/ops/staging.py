@@ -23,16 +23,24 @@ from __future__ import annotations
 
 import atexit
 import concurrent.futures
+import itertools
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import cas, jit_registry
-from .. import flags
+from .. import flags, tracing
+from ..flight import RECORDER
 from ..telemetry import STAGE_POOL_WORKERS
+
+# Monotone hashing-chunk ordinal for the flight recorder's "identify"
+# scope: host-plane chunks get timeline lanes too, so the export shows
+# the hash-ahead cadence next to the device pipeline's per-batch ring.
+_CHUNK_SEQ = itertools.count(1).__next__
 
 _STAGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _ATEXIT_REGISTERED = False
@@ -506,9 +514,17 @@ def cas_ids_for_files(
     IDENT_BYTES_HASHED.inc(sum(
         cas.LARGE_PAYLOAD_SIZE if s > cas.MINIMUM_FILE_SIZE else s
         for _, s in files))
+    chunk = _CHUNK_SEQ()
     if backend == "native":
         with device_span("cas_ids/native", batch=len(files)):
+            t0 = time.perf_counter()
             ids, errors = _cas_ids_native_fused(files)
+            # Fused stage+hash is one C call: one timeline event, on
+            # the kernel lane (there is no separable stage phase).
+            RECORDER.record(
+                "kernel", batch=chunk, t0=t0, t1=time.perf_counter(),
+                device="native", scope="identify",
+                trace=tracing.current_trace_id(), files=len(files))
         if errors:
             IDENT_READ_ERRORS.inc(len(errors))
         return ids, errors
@@ -522,9 +538,22 @@ def cas_ids_for_files(
     guard = (jit_registry.device_scope(f"cas_ids/{backend}")
              if backend == "jax" else nullcontext())
     with device_span(f"cas_ids/{backend}", batch=len(files)), guard:
+        trace = tracing.current_trace_id()
+        t0 = time.perf_counter()
         large, small, empty_idx, errors = stage_files(files)
+        t1 = time.perf_counter()
         ids: Dict[int, Optional[str]] = dict(
             _BACKENDS[backend](files, large, small))
+        # Host-plane chunks get the same stage/kernel lanes as the
+        # depth-N pipeline (scope "identify"): the exporter shows
+        # hash-ahead chunk cadence next to the device ring's lanes.
+        RECORDER.record("stage", batch=chunk, t0=t0, t1=t1,
+                        device=backend, scope="identify", trace=trace,
+                        files=len(files))
+        RECORDER.record("kernel", batch=chunk, t0=t1,
+                        t1=time.perf_counter(), device=backend,
+                        scope="identify", trace=trace,
+                        files=len(files))
     for idx in empty_idx:
         ids[idx] = None  # "We can't do shit with empty files" (mod.rs:86)
     for idx in errors:

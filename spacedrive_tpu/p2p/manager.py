@@ -21,6 +21,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, Optional
 
+from .. import tracing
 from ..timeouts import deadline, with_timeout
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity
@@ -122,13 +123,15 @@ class P2PManager:
 
     async def ping(self, addr: str, port: int) -> float:
         t0 = time.monotonic()
-        tunnel = await self.open_stream(addr, port)
-        try:
-            async with deadline("p2p.ping"):
-                await tunnel.send({"t": "ping"})
-                assert await tunnel.recv() == {"t": "pong"}
-        finally:
-            tunnel.close()
+        with tracing.span("p2p/ping", peer=f"{addr}:{port}"):
+            tunnel = await self.open_stream(addr, port)
+            try:
+                async with deadline("p2p.ping"):
+                    await tunnel.send({"t": "ping",
+                                       "tp": tracing.traceparent()})
+                    assert await tunnel.recv() == {"t": "pong"}
+            finally:
+                tunnel.close()
         return time.monotonic() - t0
 
     def _progress_emitter(self, drop_id: str, total: int, direction: str):
@@ -157,11 +160,19 @@ class P2PManager:
         drop_id = uuidlib.uuid4().hex
         on_progress = on_progress or self._progress_emitter(
             drop_id, size, "send")
+        with tracing.span("p2p/spacedrop", peer=f"{addr}:{port}",
+                          bytes=size):
+            return await self._spacedrop_send(
+                addr, port, file_path, req, drop_id, on_progress, size)
+
+    async def _spacedrop_send(self, addr, port, file_path, req, drop_id,
+                              on_progress, size) -> str:
         tunnel = await self.open_stream(addr, port)
         try:
             await with_timeout(
                 "p2p.frame_send",
-                tunnel.send({"t": "spacedrop", "req": req.to_wire()}))
+                tunnel.send({"t": "spacedrop", "req": req.to_wire(),
+                             "tp": tracing.traceparent()}))
             # The verdict budget brackets the receiver's whole
             # interactive p2p.spacedrop.decide window (timeouts.py).
             verdict = await with_timeout(
@@ -188,26 +199,35 @@ class P2PManager:
 
         Rows are addressed by their synced pub_ids — local autoincrement
         ids diverge between nodes and must never cross the wire."""
-        tunnel = await self.open_stream(addr, port)
-        try:
-            await with_timeout("p2p.frame_send", tunnel.send({
-                "t": "file", "library_id": library_id,
-                "location_pub_id": location_pub_id,
-                "file_path_pub_id": file_path_pub_id,
-                "range_start": range_start, "range_end": range_end}))
-            resp = await with_timeout("p2p.file.response", tunnel.recv())
-            if not isinstance(resp, dict) or resp.get("status") != "ok":
-                return False
-            req = SpaceblockRequest.from_wire(resp["req"])
-            with await asyncio.to_thread(open, out_path, "wb") as out:
-                return await receive_file(tunnel, req, out)
-        finally:
-            tunnel.close()
+        with tracing.span("p2p/file", peer=f"{addr}:{port}"):
+            tunnel = await self.open_stream(addr, port)
+            try:
+                await with_timeout("p2p.frame_send", tunnel.send({
+                    "t": "file", "library_id": library_id,
+                    "location_pub_id": location_pub_id,
+                    "file_path_pub_id": file_path_pub_id,
+                    "range_start": range_start, "range_end": range_end,
+                    "tp": tracing.traceparent()}))
+                resp = await with_timeout("p2p.file.response",
+                                          tunnel.recv())
+                if not isinstance(resp, dict) or \
+                        resp.get("status") != "ok":
+                    return False
+                req = SpaceblockRequest.from_wire(resp["req"])
+                with await asyncio.to_thread(open, out_path, "wb") as out:
+                    return await receive_file(tunnel, req, out)
+            finally:
+                tunnel.close()
 
     async def pair(self, addr: str, port: int, library) -> bool:
         """Pair a library with a peer: exchange instance rows so sync can
         flow (core/src/p2p/pairing/mod.rs protocol v1, simplified to one
         round-trip of signed instance info)."""
+        with tracing.span("p2p/pair", peer=f"{addr}:{port}",
+                          library=str(library.id)):
+            return await self._pair(addr, port, library)
+
+    async def _pair(self, addr: str, port: int, library) -> bool:
         sync = library.sync
         tunnel = await self.open_stream(addr, port)
         try:
@@ -220,6 +240,7 @@ class P2PManager:
                     (sync.instance,))
                 await tunnel.send({
                     "t": "pair",
+                    "tp": tracing.traceparent(),
                     "library_id": str(library.id),
                     "library_name": library.config.name,
                     # Our LISTENING port (the TCP source port is
@@ -268,18 +289,32 @@ class P2PManager:
         try:
             header = await with_timeout("p2p.header_recv", tunnel.recv())
             t = header.get("t") if isinstance(header, dict) else None
-            if t == "ping":
-                await with_timeout("p2p.frame_send",
-                                   tunnel.send({"t": "pong"}))
-            elif t == "spacedrop":
-                await self._handle_spacedrop(tunnel, header)
-            elif t == "pair":
-                await self._handle_pair(tunnel, header)
-            elif t == "file":
-                await self._handle_file(tunnel, header)
-            elif t == "sync":
-                if self.networked is not None:
-                    await self.networked.handle_sync_stream(tunnel, header)
+            tp = header.get("tp") if isinstance(header, dict) else None
+            # Continue the dialer's trace across the wire: every
+            # handler span below (and sync.pull, which re-anchors to
+            # the same header) lands in the caller's trace — a
+            # request is one trace id end-to-end over the mesh.
+            with tracing.continue_trace(tp):
+                if t == "ping":
+                    with tracing.span("p2p/ping"):
+                        await with_timeout("p2p.frame_send",
+                                           tunnel.send({"t": "pong"}))
+                elif t == "spacedrop":
+                    with tracing.span("p2p/spacedrop"):
+                        await self._handle_spacedrop(tunnel, header)
+                elif t == "pair":
+                    with tracing.span("p2p/pair"):
+                        await self._handle_pair(tunnel, header)
+                elif t == "file":
+                    with tracing.span("p2p/file"):
+                        await self._handle_file(tunnel, header)
+                elif t == "sync":
+                    # handle_sync_stream opens its own continued
+                    # sync.pull span parented directly on the
+                    # originator's sync.serve span.
+                    if self.networked is not None:
+                        await self.networked.handle_sync_stream(
+                            tunnel, header)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception as e:

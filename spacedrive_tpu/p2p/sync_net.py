@@ -21,7 +21,7 @@ import asyncio
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from .. import channels, flags, tasks, threadctx
+from .. import channels, flags, tasks, threadctx, tracing
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..timeouts import with_timeout
@@ -220,13 +220,25 @@ class NetworkedLibraries:
 
     async def _originate_one(self, library, identity: RemoteIdentity,
                              route: Tuple[str, int]) -> None:
+        # The serving half of the cross-node sync trace: this span
+        # roots it (or continues the caller's — a backfill triggered
+        # inside an rpc/* span rides that trace), and its traceparent
+        # travels in the new_ops header so the responder's sync.pull
+        # span lands in the SAME trace — one id covers the request
+        # end-to-end across both nodes.
+        with tracing.span("sync.serve", library=str(library.id)):
+            await self._serve_pull_loop(library, identity, route)
+
+    async def _serve_pull_loop(self, library, identity: RemoteIdentity,
+                               route: Tuple[str, int]) -> None:
         tunnel = await self.p2p.open_stream(*route, expected=identity)
         try:
             await with_timeout(
                 "p2p.frame_send",
                 tunnel.send({"t": "sync", "kind": "new_ops",
                              "library_id": str(library.id),
-                             "proto": SYNC_PROTO}))
+                             "proto": SYNC_PROTO,
+                             "tp": tracing.traceparent()}))
             # Serve the responder's pull loop from our op log. The
             # clone fast path runs at most once per tunnel: a receiver
             # whose watermark stays frozen (persistent per-op failure)
@@ -358,9 +370,15 @@ class NetworkedLibraries:
             await with_timeout("p2p.frame_send",
                                tunnel.send({"kind": "done"}))
             return
-        lock = self._ingest_locks.setdefault(lib.id, asyncio.Lock())
-        async with lock:
-            await self._pull(lib, tunnel)
+        # Continue the originator's trace (the header's tp field):
+        # this node's pull spans — and the ingester task spawned under
+        # them, which inherits the context through tasks.spawn — join
+        # the serving node's trace instead of rooting a fresh one.
+        with tracing.continue_trace(header.get("tp")), \
+                tracing.span("sync.pull", library=str(lib.id)):
+            lock = self._ingest_locks.setdefault(lib.id, asyncio.Lock())
+            async with lock:
+                await self._pull(lib, tunnel)
         self.node.events.invalidate_query(lib.id, "search.paths")
 
     async def _pull(self, library, tunnel) -> None:
@@ -395,6 +413,11 @@ class NetworkedLibraries:
                     "clocks": [[i, t] for i, t in req.timestamps],
                     "count": OPS_PER_REQUEST,
                     "proto": SYNC_PROTO,
+                    # Trace continuity in the reverse direction too:
+                    # the pull-request frame carries this node's span
+                    # (a child of the originator's, once continued
+                    # above) so wire captures show one id everywhere.
+                    "tp": tracing.traceparent(),
                 }))
                 # The originator runs get_ops off-loop over bulk op
                 # logs before this page arrives.

@@ -555,10 +555,15 @@ API_REQUESTS = counter(
     "sd_api_requests_total", "HTTP requests served, by route template",
     labelnames=("route",))
 
-# -- tracing (tracing.py) ---------------------------------------------------
+# -- tracing (tracing.py, flight.py) ----------------------------------------
 TRACE_SPANS = counter(
     "sd_trace_spans_total", "Spans recorded into the ring buffer",
     labelnames=("ok",))
+TRACE_TIMELINE_EVENTS = counter(
+    "sd_trace_timeline_events_total",
+    "Pipeline timeline events recorded by the flight recorder "
+    "(flight.py): per-batch stage/H2D/kernel/retire phases plus the "
+    "per-batch bound-attribution windows")
 
 # -- sanitizer (sanitize.py) ------------------------------------------------
 SANITIZE_VIOLATIONS = counter(

@@ -625,6 +625,18 @@ declare_owner(
     "note_put/note_drain run on the owning tunnel's loop.")
 
 declare_owner(
+    "flight.FlightRecorder", "spacedrive_tpu/flight.py::FlightRecorder",
+    {
+        "ring": immutable_after_init(),
+        "_open": guarded_by("_lock"),
+    },
+    "Flight-recorder timeline ring: the per-device dispatch executor "
+    "threads, the retire thread, and the pipeline coroutines all "
+    "record phases — every ring put and open-window mutation runs "
+    "under the recorder's _lock; the ring channel itself is bound at "
+    "construction and never rebound.")
+
+declare_owner(
     "overlap.PipelineStats",
     "spacedrive_tpu/ops/overlap.py::PipelineStats",
     {

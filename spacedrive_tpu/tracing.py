@@ -17,7 +17,19 @@ batches:
   the `node.spans` rspc query);
 - when `SDTPU_PROFILE=/path` is set, `device_span(name)` additionally
   wraps the block in a jax profiler trace so device batches show up in
-  TensorBoard/xprof with step markers.
+  TensorBoard/xprof with step markers;
+- spans PROPAGATE across nodes: `traceparent()` renders the current
+  (trace, span) as a compact wire field, `continue_trace(tp)` adopts a
+  remote caller's ids so the first span opened inside becomes a child
+  of the remote span — one trace id then covers a request end-to-end
+  over the p2p/sync/rspc planes (the flight recorder's export path,
+  spacedrive_tpu/flight.py, renders the merged timeline).
+
+Span NAMES come from the central family registry at the bottom of this
+module (`declare_span`): a span name is `<family>` or
+`<family>/<variant>`, and the family must be declared — the sdlint
+telemetry pass fails the build on an undeclared or fully-dynamic name,
+the same scheme discipline metric families get.
 """
 
 from __future__ import annotations
@@ -26,6 +38,7 @@ import contextlib
 import contextvars
 import logging
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -39,19 +52,111 @@ logger = logging.getLogger("spacedrive_tpu")
 _current_span: contextvars.ContextVar[Optional[Tuple[int, int]]] = \
     contextvars.ContextVar("sdtpu_current_span", default=None)
 
-# Bounded ring of recently finished span records (newest last). 512
-# records × ~200 B is ~100 KB — queryable at runtime without ever
-# growing with uptime.
-SPAN_RING_CAPACITY = 512
+# Bounded ring of recently finished span records (newest last). The
+# default 512 records × ~200 B is ~100 KB — queryable at runtime
+# without ever growing with uptime; SDTPU_SPAN_RING resizes it (read
+# once at import; configure_span_ring() is the re-read hook). Floored
+# at 1 like the re-read path: 0/negative would disable the ring (or
+# crash deque construction) instead of erroring usefully.
+SPAN_RING_CAPACITY = max(1, int(flags.get("SDTPU_SPAN_RING")))
 _span_ring: deque = deque(maxlen=SPAN_RING_CAPACITY)
 _span_ring_lock = threading.Lock()
-_id_counter = iter(range(1, 1 << 62)).__next__
+# Ids are sequential above a random 48-bit per-process base: cheap to
+# mint under the lock, and two NODES (separate processes) joined by
+# trace propagation cannot collide on trace ids.
+_ID_BASE = (int.from_bytes(os.urandom(6), "big") << 14) + 1
+_id_counter = iter(range(_ID_BASE, 1 << 63)).__next__
 _id_lock = threading.Lock()
+
+# Wall-clock anchor for span/timeline timestamps: perf_counter gives
+# the monotone durations, this epoch aligns them to wall microseconds
+# so two nodes' exported traces land on one comparable axis.
+_EPOCH = time.time() - time.perf_counter()
+
+
+def perf_to_us(t_perf: float) -> int:
+    """A time.perf_counter() reading as wall-clock microseconds (the
+    Chrome-trace `ts` unit)."""
+    return int((_EPOCH + t_perf) * 1e6)
 
 
 def _new_id() -> int:
     with _id_lock:
         return _id_counter()
+
+
+def span_ring_capacity() -> int:
+    return SPAN_RING_CAPACITY
+
+
+def configure_span_ring() -> int:
+    """Re-read SDTPU_SPAN_RING and rebuild the ring, keeping the newest
+    records that fit. The flag is otherwise read once at import (the
+    ring is module-global); tests and long-lived embedders that change
+    the environment call this to apply it."""
+    global SPAN_RING_CAPACITY, _span_ring
+    cap = max(1, int(flags.get("SDTPU_SPAN_RING")))
+    with _span_ring_lock:
+        if cap != SPAN_RING_CAPACITY:
+            SPAN_RING_CAPACITY = cap
+            _span_ring = deque(_span_ring, maxlen=cap)
+    return SPAN_RING_CAPACITY
+
+
+# -- cross-node propagation -------------------------------------------------
+
+def current_trace() -> Optional[Tuple[int, int]]:
+    """(trace_id, span_id) of the innermost live span, or None."""
+    return _current_span.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """Hex trace id of the innermost live span, or None — what the
+    flight recorder stamps on pipeline timeline events."""
+    cur = _current_span.get()
+    return f"{cur[0]:x}" if cur else None
+
+
+def traceparent() -> Optional[str]:
+    """The current span as a compact `<trace>-<span>` hex wire field —
+    carried in p2p headers, sync pull frames, and rspc envelopes so the
+    remote side's spans continue this trace instead of rooting a new
+    one. None outside any span (the remote side then roots normally)."""
+    cur = _current_span.get()
+    return f"{cur[0]:x}-{cur[1]:x}" if cur else None
+
+
+def parse_traceparent(tp: Any) -> Optional[Tuple[int, int]]:
+    """Parse a wire traceparent; None for anything malformed — a
+    hostile or stale peer field must degrade to a fresh root, never
+    raise into the transport handler."""
+    if not isinstance(tp, str) or "-" not in tp:
+        return None
+    trace_s, _, span_s = tp.partition("-")
+    try:
+        trace_id, span_id = int(trace_s, 16), int(span_s, 16)
+    except ValueError:
+        return None
+    if not (0 < trace_id < 1 << 64 and 0 < span_id < 1 << 64):
+        return None
+    return trace_id, span_id
+
+
+@contextlib.contextmanager
+def continue_trace(tp: Any):
+    """Adopt a remote caller's (trace, span) for the block: spans
+    opened inside become children of the remote span, sharing its
+    trace id across the wire. A missing/malformed `tp` is a no-op —
+    the block's spans root locally as before."""
+    parsed = parse_traceparent(tp)
+    if parsed is None:
+        yield
+        return
+    token = _current_span.set(parsed)
+    try:
+        yield
+    finally:
+        _current_span.reset(token)
 
 
 def recent_spans(limit: int = 100,
@@ -166,6 +271,10 @@ def span(name: str, events=None, **fields):
         ms = (time.perf_counter() - t0) * 1000
         record = {
             "span": name, "ms": round(ms, 2),
+            # Start timestamp in wall microseconds: what the Chrome-
+            # trace exporter uses as `ts` (dur comes from `ms`), and
+            # what makes two nodes' rings mergeable on one axis.
+            "ts_us": perf_to_us(t0),
             "trace": f"{trace_id:x}", "id": f"{span_id:x}",
             "ok": err is None,
             **fields,
@@ -196,3 +305,81 @@ def device_span(name: str, events=None, **fields):
     else:
         with span(name, events, **fields):
             yield
+
+
+# ---------------------------------------------------------------------------
+# THE span-name namespace. A span name is `<family>` or
+# `<family>/<variant>` (variants carry per-call detail: backend names,
+# job names, rspc paths); the family before the first `/` must be
+# declared here. Enforced by the sdlint telemetry pass: an undeclared
+# family, a fully-dynamic name, or a declare_span() outside this module
+# fails the build — span names stay a greppable, documented surface
+# exactly like metric families.
+# ---------------------------------------------------------------------------
+
+# Import-time declaration registry (bounded by the source text, same
+# contract as jobs.JOB_REGISTRY / store.MODELS).
+SPAN_FAMILIES: Dict[str, str] = {}  # sdlint: ok[unbounded-growth]
+
+_FAMILY_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+def declare_span(family: str, doc: str = "") -> str:
+    """Register a span family (tracing.py module bottom only — the
+    telemetry pass flags declarations anywhere else)."""
+    if not _FAMILY_RE.match(family):
+        raise ValueError(
+            f"span family {family!r} breaks the scheme "
+            "(lowercase dotted, no slash — variants are per-call)")
+    if family in SPAN_FAMILIES:
+        raise ValueError(f"span family {family!r} declared twice")
+    SPAN_FAMILIES[family] = doc
+    return family
+
+
+declare_span(
+    "cas_ids",
+    "One CAS hashing batch through ops/staging.cas_ids_for_files; the "
+    "variant is the resolved backend (native/numpy/jax/oracle).")
+
+declare_span(
+    "job",
+    "A job worker's whole run (jobs/worker.py); the variant is the "
+    "job name. Root of the per-job trace; job.step spans nest under "
+    "it.")
+
+declare_span(
+    "job.step",
+    "One executed job step inside a job/<name> root span.")
+
+declare_span(
+    "p2p",
+    "One inbound or outbound p2p exchange (p2p/manager.py); the "
+    "variant is the header discriminator (ping/pair/spacedrop/file). "
+    "Inbound spans continue the dialer's trace via the header's tp "
+    "field.")
+
+declare_span(
+    "pipeline.run",
+    "One depth-N identify pipeline run (ops/overlap.run_overlapped); "
+    "the flight recorder's timeline events carry this span's trace "
+    "id.")
+
+declare_span(
+    "rpc",
+    "One rspc query/mutation dispatch on the API host (api/"
+    "server.py); the variant is the procedure path. Continues the "
+    "client's trace via the X-Sdtpu-Trace header / ws frame tp "
+    "field.")
+
+declare_span(
+    "sync.pull",
+    "The responder half of one sync stream (sync_net."
+    "handle_sync_stream): the ingest pull loop, continuing the "
+    "originator's trace from the new_ops header.")
+
+declare_span(
+    "sync.serve",
+    "The originator half of one sync stream (sync_net._originate_one): "
+    "announce + serve the peer's pull loop; root of the cross-node "
+    "sync trace.")

@@ -168,10 +168,11 @@ def test_sync_stream_refuses_mismatched_proto(two_nodes):
 
         a.p2p.open_stream = fake_open_stream
         from spacedrive_tpu.p2p.identity import RemoteIdentity
+        from spacedrive_tpu.p2p.sync_net import SYNC_PROTO
         await a.p2p.networked._originate_one(
             lib_a, RemoteIdentity(b"\x01" * 32), ("127.0.0.1", 1))
         # Header announced, then an empty terminal page — no ops served.
-        assert puller.sent[0]["proto"] == 2
+        assert puller.sent[0]["proto"] == SYNC_PROTO
         assert puller.sent[1] == {"ops": [], "has_more": False}
 
     _run(main())

@@ -120,6 +120,49 @@ def test_telemetry_lint_shim_api_intact():
     assert telemetry_lint.NAME_RE.match("sd_sanitize_violations_total")
 
 
+def test_span_name_discipline_flags_known_positives():
+    """The round-14 span-name half of the telemetry pass: undeclared
+    families (literal and f-string variant), fully-dynamic names, and
+    a declare_span outside tracing.py."""
+    found = _lint_fixture("spans_bad.py", "telemetry")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "totally.rogue.family" in by_code.get("span-undeclared", set())
+    assert "rogue_family/<dynamic>" in by_code.get("span-undeclared",
+                                                   set())
+    # the aliased-module, fully-dotted, and relative-import-aliased
+    # spellings must not bypass the family check
+    assert "rogue.via.alias" in by_code.get("span-undeclared", set())
+    assert "rogue.via.dotted" in by_code.get("span-undeclared", set())
+    assert "rogue.via.relative" in by_code.get("span-undeclared", set())
+    assert {"span", "device_span"} <= by_code.get("span-dynamic", set())
+    assert "declare_span" in by_code.get("span-central", set())
+
+
+def test_span_name_discipline_passes_known_negatives():
+    """Declared families through every import spelling — including a
+    dynamic VARIANT under a declared family, and a local function that
+    happens to be named span — are clean."""
+    assert _lint_fixture("spans_ok.py", "telemetry") == []
+
+
+def test_span_families_declared_for_every_tree_literal():
+    """Static↔runtime parity for the span registry: the AST-parsed
+    declaration set matches tracing.SPAN_FAMILIES, and the whole-tree
+    telemetry pass reports zero span-* findings (every span literal in
+    the tree resolves to a declared family)."""
+    from spacedrive_tpu import tracing
+    from tools.sdlint.passes.telemetry import declared_span_families
+
+    static = declared_span_families(ROOT)
+    assert static == set(tracing.SPAN_FAMILIES)
+    project = load_project(ROOT)
+    found = run_passes(project, get_passes(["telemetry"]))
+    span_findings = [f for f in found if f.code.startswith("span-")]
+    assert span_findings == [], [f.text() for f in span_findings]
+
+
 # -- jit-stability (round 10: the device-contract pass) ---------------------
 
 def test_jit_stability_flags_known_positives():

@@ -3,6 +3,8 @@
 import logging
 import os
 
+import pytest
+
 from spacedrive_tpu.tracing import device_span, span
 
 
@@ -126,6 +128,59 @@ def test_profiler_probe_caches_negative_result(monkeypatch):
     tracing.reset_profiler_cache()
     assert tracing._ensure_profiler() is False
     assert len(reads) == 2, "reset hook must re-read the environment"
+
+
+def test_span_records_start_timestamp():
+    """Every record carries ts_us (wall µs at span start) — what the
+    Chrome-trace exporter sorts and renders on one axis."""
+    import time as _time
+
+    from spacedrive_tpu.tracing import recent_spans
+
+    before = _time.time() * 1e6
+    with span("unit.work"):
+        pass
+    rec = recent_spans(limit=1)[-1]
+    after = _time.time() * 1e6
+    assert before - 2e6 <= rec["ts_us"] <= after + 2e6
+
+
+def test_span_ring_capacity_flag(monkeypatch):
+    """SDTPU_SPAN_RING sizes the ring; configure_span_ring() is the
+    documented re-read hook (the flag itself is read once at import),
+    keeping the newest records on shrink."""
+    from spacedrive_tpu import tracing
+
+    default_cap = tracing.span_ring_capacity()
+    try:
+        monkeypatch.setenv("SDTPU_SPAN_RING", "8")
+        assert tracing.configure_span_ring() == 8
+        for i in range(20):
+            with span("unit.work", i=i):
+                pass
+        got = tracing.recent_spans(limit=100)
+        assert len(got) == 8
+        assert got[-1]["i"] == 19  # newest kept
+    finally:
+        monkeypatch.delenv("SDTPU_SPAN_RING", raising=False)
+        tracing.configure_span_ring()
+    assert tracing.span_ring_capacity() == default_cap
+    from spacedrive_tpu import flags
+
+    assert flags.FLAGS["SDTPU_SPAN_RING"].default == 512
+
+
+def test_span_family_registry_shape():
+    """declare_span enforces the family scheme and uniqueness; every
+    family the engine uses is present."""
+    from spacedrive_tpu import tracing
+
+    assert {"cas_ids", "job", "job.step", "p2p", "pipeline.run", "rpc",
+            "sync.pull", "sync.serve"} <= set(tracing.SPAN_FAMILIES)
+    with pytest.raises(ValueError):
+        tracing.declare_span("Bad/Family")
+    with pytest.raises(ValueError):
+        tracing.declare_span("job")  # duplicate
 
 
 def test_staging_emits_device_spans(tmp_path):

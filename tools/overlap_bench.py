@@ -172,12 +172,23 @@ def main() -> int:
                          "beat depth 1")
     ap.add_argument("--json", default="", metavar="PATH",
                     help="write the sweep as one BENCH-style artifact")
+    ap.add_argument("--trace", default="", metavar="PATH",
+                    help="export the sweep's flight-recorder timeline "
+                         "+ span ring as a schema-validated Chrome-"
+                         "trace JSON next to the artifact (exit 1 on "
+                         "schema violation)")
     args = ap.parse_args()
 
     depths = [int(d) for d in args.depths.split(",") if d.strip()]
     links = [l if l == "real" else float(l)
              for l in args.links.split(",") if l.strip()]
     donate = None if args.donate is None else args.donate == "on"
+
+    if args.trace:
+        # The trace artifact should cover THIS sweep only.
+        from spacedrive_tpu import flight
+
+        flight.RECORDER.clear()
 
     rows = run_sweep(depths, links, batch=args.batch,
                      batches=args.batches, file_size=args.file_size,
@@ -196,6 +207,16 @@ def main() -> int:
     if args.json:
         with open(args.json, "w") as f:
             json.dump(artifact, f, indent=1)
+    if args.trace:
+        from spacedrive_tpu import flight
+
+        problems = flight.write_trace_artifact(args.trace,
+                                               "overlap_bench")
+        for p in problems:
+            print(f"TRACE SCHEMA: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"trace artifact: {args.trace}", file=sys.stderr)
     if args.gate:
         bad = gate_failures(rows)
         for link, depth, why, val in bad:

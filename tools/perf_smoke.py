@@ -62,7 +62,8 @@ def _registry_phase_split():
 async def run(files: int, backend: str, images: int, keep: str | None,
               device_batch: int | None = None, small: bool = False,
               validate_backend: str | None = None,
-              with_telemetry: bool = False, json_out: str = ""):
+              with_telemetry: bool = False, json_out: str = "",
+              trace_out: str = ""):
     from tools.make_corpus import make_corpus
 
     from spacedrive_tpu import telemetry
@@ -84,6 +85,13 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         # The artifact should cover THIS run only, not whatever the
         # process did before (the registry is process-global).
         telemetry.reset()
+    if trace_out:
+        # Same per-run hygiene for the flight recorder: the exported
+        # timeline + span ring should cover this run only.
+        from spacedrive_tpu import flight, tracing
+
+        flight.RECORDER.clear()
+        tracing.clear_span_ring()
 
     root = keep or tempfile.mkdtemp(prefix="sdtpu-perf-")
     corpus = os.path.join(root, "corpus")
@@ -240,10 +248,30 @@ async def run(files: int, backend: str, images: int, keep: str | None,
                 "telemetry_enabled": with_telemetry,
                 "stages": lines,
             }, f, indent=1)
+    trace_problems: list = []
+    if trace_out:
+        # The run's flight-recorder export: job/rpc spans + identify
+        # timeline lanes as one Chrome-trace artifact next to the
+        # BENCH JSON. Schema-gated (shared write_trace_artifact
+        # helper) so a malformed trace fails the bench run, not the
+        # person opening it later.
+        from spacedrive_tpu import flight
+
+        trace_problems = await asyncio.to_thread(
+            flight.write_trace_artifact, trace_out, "perf_smoke")
+        for p in trace_problems:
+            print(f"TRACE SCHEMA: {p}", file=sys.stderr)
+        if not trace_problems:
+            print(f"trace artifact: {trace_out}", file=sys.stderr)
     if not keep:
         import shutil
 
         shutil.rmtree(root, ignore_errors=True)
+    if trace_problems:
+        # Exit non-zero AFTER the corpus cleanup above: a schema
+        # regression must fail the run, not also leak a multi-GB
+        # sdtpu-perf-* tempdir per attempt.
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
@@ -269,6 +297,10 @@ if __name__ == "__main__":
     ap.add_argument("--json", default="", metavar="PATH",
                     help="write all stage lines (+ telemetry snapshot) "
                          "as one BENCH-style JSON artifact")
+    ap.add_argument("--trace", default="", metavar="PATH",
+                    help="export the run's flight-recorder timeline + "
+                         "span ring as a schema-validated Chrome-trace "
+                         "JSON artifact")
     args = ap.parse_args()
     if args.virtual_devices:
         os.environ["XLA_FLAGS"] = (
@@ -281,4 +313,5 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     asyncio.run(run(args.files, args.backend, args.images, args.keep,
                     args.device_batch, args.small, args.validate_backend,
-                    with_telemetry=args.telemetry, json_out=args.json))
+                    with_telemetry=args.telemetry, json_out=args.json,
+                    trace_out=args.trace))

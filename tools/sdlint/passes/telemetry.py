@@ -6,6 +6,20 @@ be registered in `spacedrive_tpu/telemetry.py`, under a string-literal
 name, collision-free, following `sd_<layer>_<what>` (layers now
 include `sanitize`, the runtime sanitizer's counters). See the module
 docstring of the shim for the rule-by-rule rationale.
+
+Round 14 extends the same discipline to SPAN NAMES: a name passed to
+`span()`/`device_span()` (tracing.py) is `<family>` or
+`<family>/<variant>`, and the family must be declared via
+`declare_span()` at the bottom of spacedrive_tpu/tracing.py — the
+observable-name contract metric families already have, applied to the
+trace surface the flight recorder exports. Codes:
+
+- ``span-undeclared`` — a literal (or constant f-string prefix) whose
+  family is not declared centrally;
+- ``span-dynamic``    — a name with no resolvable constant family
+  (bare variable, f-string with no `family/` prefix): an unauditable
+  span namespace;
+- ``span-central``    — a `declare_span()` call outside tracing.py.
 """
 
 from __future__ import annotations
@@ -14,9 +28,9 @@ import ast
 import os
 import re
 import sys
-from typing import List, Tuple
+from typing import List, Set, Tuple
 
-from ..core import Finding, Project
+from ..core import Finding, Project, dotted
 
 PASS = "telemetry"
 
@@ -27,6 +41,58 @@ NAME_RE = re.compile(
     r"|task|timeout|chan|pipeline|stage|race)_[a-z0-9_]+$")
 
 CENTRAL_MODULE = "telemetry.py"
+
+SPAN_FUNCS = {"span", "device_span"}
+SPAN_CENTRAL = "spacedrive_tpu/tracing.py"
+
+
+def declared_span_families(root: str) -> Set[str]:
+    """Family names from `declare_span("...")` calls in tracing.py."""
+    path = os.path.join(root, SPAN_CENTRAL)
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args:
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name == "declare_span":
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    out.add(arg.value)
+    return out
+
+
+def _span_imports(tree: ast.Module) -> Tuple[dict, Set[str]]:
+    """(function aliases, module aliases) for the tracing span
+    surface: `from ..tracing import span as trace_span` binds a
+    FUNCTION alias; `import spacedrive_tpu.tracing as tr` (or
+    `from spacedrive_tpu import tracing`) binds a MODULE alias whose
+    `.span(...)` calls must be checked too — the aliased-module
+    spelling was the review-round bypass."""
+    funcs: dict = {}
+    modules: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[-1] == "tracing":
+                for alias in node.names:
+                    if alias.name in SPAN_FUNCS | {"declare_span"}:
+                        funcs[alias.asname or alias.name] = alias.name
+            # `from spacedrive_tpu import tracing [as tr]` AND the
+            # pure-relative `from .. import tracing [as tr]` (where
+            # node.module is None) both bind a module alias.
+            for alias in node.names:
+                if alias.name == "tracing":
+                    modules.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[-1] == "tracing":
+                    modules.add(alias.asname or alias.name)
+    return funcs, modules
 
 
 def _call_target(node: ast.Call) -> Tuple[str, str]:
@@ -58,12 +124,23 @@ def _telemetry_imports(tree: ast.Module) -> set:
 
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, is_central: bool, from_telemetry: set,
-                 names_seen: dict, problems: List[str]):
+                 names_seen: dict, problems: List[str],
+                 span_aliases: dict = None, span_families: Set[str] = None,
+                 is_span_central: bool = False,
+                 span_problems: List[Tuple[int, str, str, str]] = None,
+                 span_modules: Set[str] = None):
         self.path = path
         self.is_central = is_central
         self.from_telemetry = from_telemetry
         self.names_seen = names_seen
         self.problems = problems
+        self.span_aliases = span_aliases or {}
+        self.span_modules = span_modules or set()
+        self.span_families = span_families if span_families is not None \
+            else set()
+        self.is_span_central = is_span_central
+        self.span_problems = span_problems if span_problems is not None \
+            else []
         self.depth = 0  # function nesting (0 = module level)
 
     def visit_FunctionDef(self, node):
@@ -73,8 +150,78 @@ class _Visitor(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    # -- span-name discipline ----------------------------------------------
+
+    def _span_call_target(self, node: ast.Call):
+        """The original tracing function name this call binds to, or
+        None when it is not a span-surface call. Covers bare/renamed
+        function imports AND every module spelling — `tracing.span`,
+        `tr.span` (aliased import), `spacedrive_tpu.tracing.span`
+        (fully dotted)."""
+        base, attr = _call_target(node)
+        if base == "" and attr in self.span_aliases:
+            return self.span_aliases[attr]
+        if attr in SPAN_FUNCS | {"declare_span"}:
+            d = dotted(node.func)
+            if d is not None and "." in d:
+                mod = d.rsplit(".", 1)[0]
+                if mod == "tracing" or mod.endswith(".tracing") \
+                        or mod in self.span_modules:
+                    return attr
+        return None
+
+    def _check_span_call(self, node: ast.Call) -> None:
+        target = self._span_call_target(node)
+        if target is None or self.is_span_central:
+            return
+        if target == "declare_span":
+            self.span_problems.append((
+                node.lineno, "span-central",
+                "declare_span",
+                "span family declared outside the central registry "
+                "(declare it in spacedrive_tpu/tracing.py)"))
+            return
+        if not node.args:
+            self.span_problems.append((
+                node.lineno, "span-dynamic", target,
+                f"{target}() without a positional name literal — span "
+                "names must start with a declared family"))
+            return
+        name_node = node.args[0]
+        if isinstance(name_node, ast.Constant) and \
+                isinstance(name_node.value, str):
+            family = name_node.value.split("/", 1)[0]
+            if family not in self.span_families:
+                self.span_problems.append((
+                    node.lineno, "span-undeclared", name_node.value,
+                    f"span family {family!r} is not declared via "
+                    "declare_span() in spacedrive_tpu/tracing.py"))
+            return
+        if isinstance(name_node, ast.JoinedStr):
+            first = name_node.values[0] if name_node.values else None
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and "/" in first.value:
+                family = first.value.split("/", 1)[0]
+                if family not in self.span_families:
+                    self.span_problems.append((
+                        node.lineno, "span-undeclared",
+                        f"{family}/<dynamic>",
+                        f"span family {family!r} is not declared via "
+                        "declare_span() in spacedrive_tpu/tracing.py"))
+                return
+            self.span_problems.append((
+                node.lineno, "span-dynamic", target,
+                "f-string span name with no constant `family/` prefix "
+                "— the variant may be dynamic, the family may not"))
+            return
+        self.span_problems.append((
+            node.lineno, "span-dynamic", target,
+            "non-literal span name — span names must be `family` or "
+            "`family/<variant>` with a declared, greppable family"))
+
     def visit_Call(self, node: ast.Call):
         self.generic_visit(node)
+        self._check_span_call(node)
         base, attr = _call_target(node)
         qualified = base in ("telemetry", "REGISTRY")
         is_factory = attr in FACTORY_NAMES and (
@@ -120,21 +267,34 @@ class _Visitor(ast.NodeVisitor):
 
 
 def lint_source(path: str, src: str, is_central: bool,
-                names_seen: dict, problems: List[str]) -> None:
+                names_seen: dict, problems: List[str],
+                span_families: Set[str] = None,
+                is_span_central: bool = False,
+                span_problems: List[Tuple[int, str, str, str]] = None
+                ) -> None:
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         problems.append(f"{path}: unparseable: {e}")
         return
+    span_aliases, span_modules = _span_imports(tree)
     _Visitor(path, is_central, _telemetry_imports(tree),
-             names_seen, problems).visit(tree)
+             names_seen, problems,
+             span_aliases=span_aliases,
+             span_modules=span_modules,
+             span_families=span_families,
+             is_span_central=is_span_central,
+             span_problems=span_problems).visit(tree)
 
 
 def run_lint(package_dir: str) -> List[str]:
     """Lint every .py under package_dir; returns problem strings.
-    (The telemetry_lint.py shim's public API — kept verbatim.)"""
+    (The telemetry_lint.py shim's public API — kept verbatim; span
+    problems land in the same string list.)"""
     problems: List[str] = []
     names_seen: dict = {}
+    span_families = declared_span_families(os.path.dirname(
+        os.path.abspath(package_dir)))
     # Central module first so cross-file collisions blame the outlier.
     paths: List[str] = []
     for root, _dirs, files in os.walk(package_dir):
@@ -147,9 +307,16 @@ def run_lint(package_dir: str) -> List[str]:
     for path in paths:
         with open(path, encoding="utf-8") as f:
             src = f.read()
+        span_problems: List[Tuple[int, str, str, str]] = []
         lint_source(path, src,
                     is_central=os.path.basename(path) == CENTRAL_MODULE,
-                    names_seen=names_seen, problems=problems)
+                    names_seen=names_seen, problems=problems,
+                    span_families=span_families,
+                    is_span_central=path.replace(os.sep, "/").endswith(
+                        SPAN_CENTRAL),
+                    span_problems=span_problems)
+        for lineno, _code, _ident, msg in span_problems:
+            problems.append(f"{path}:{lineno}: {msg}")
     return problems
 
 
@@ -178,16 +345,24 @@ class TelemetryPass:
     def run(self, project: Project) -> List[Finding]:
         problems: List[str] = []
         names_seen: dict = {}
+        span_families = declared_span_families(project.root)
         files = sorted(
             project.files,
             key=lambda f: (os.path.basename(f.relpath) != CENTRAL_MODULE,
                            f.relpath))
+        findings: List[Finding] = []
         for src in files:
+            span_problems: List[Tuple[int, str, str, str]] = []
             lint_source(
                 src.relpath, src.src,
                 is_central=os.path.basename(src.relpath) == CENTRAL_MODULE,
-                names_seen=names_seen, problems=problems)
-        findings: List[Finding] = []
+                names_seen=names_seen, problems=problems,
+                span_families=span_families,
+                is_span_central=src.relpath == SPAN_CENTRAL,
+                span_problems=span_problems)
+            for lineno, code, ident, msg in span_problems:
+                findings.append(Finding(
+                    PASS, code, src.relpath, "", ident, msg, lineno))
         for prob in problems:
             m = _LINE_RE.match(prob)
             if m:

@@ -1,0 +1,143 @@
+"""Chrome-trace exporter CLI: the flight recorder's operator face.
+
+Turns the span ring (spacedrive_tpu/tracing.py) plus the pipeline
+timeline (spacedrive_tpu/flight.py) into a schema-valid Chrome-trace/
+Perfetto JSON artifact, and VALIDATES every document it touches — the
+schema gate (`flight.validate_chrome_trace`) is the same one the
+golden-file test pins, so a malformed trace fails here (exit 1), not
+on the bench host.
+
+    python -m tools.trace_export --json                # self-check
+    python -m tools.trace_export --json --out t.json   # + write it
+    python -m tools.trace_export --url http://host:port --out t.json
+    python -m tools.trace_export --input exported.json # validate only
+
+- `--json` runs the built-in SELF-CHECK: a synthetic two-batch
+  pipeline timeline plus a nested span tree goes through the real
+  recorder + exporter, the result is validated and printed as JSON.
+  Non-zero exit on any schema violation — tier-1 runs this so the
+  exporter cannot rot silently.
+- `--url` pulls a LIVE node's trace over the rspc HTTP route
+  (`GET /rspc/node.trace.export`), validates, and writes it — the
+  operator path for "what was that node just doing".
+- `--input` validates an existing artifact (CI gating a stored trace).
+
+Open the artifact in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_self_check_trace() -> dict:
+    """A deterministic exporter input exercising every lane kind: a
+    nested + a cross-"node" continued span tree through the real
+    tracing machinery, and a two-batch two-device pipeline timeline
+    through a private FlightRecorder (the process one is left alone)."""
+    from spacedrive_tpu import flight, tracing
+
+    with tracing.span("rpc/trace.selfCheck"):
+        tp = tracing.traceparent()
+        with tracing.span("job/self-check"):
+            with tracing.span("job.step", step=1):
+                pass
+    # The continued half: what a remote node's spans look like.
+    with tracing.continue_trace(tp):
+        with tracing.span("sync.pull", library="self-check"):
+            pass
+
+    rec = flight.FlightRecorder()
+    run = flight.new_run_token()
+    t0 = time.perf_counter()
+    for batch, dev in ((1, "0"), (2, "1")):
+        b = t0 + batch * 0.010
+        rec.record("stage", batch=batch, t0=b, t1=b + 0.004,
+                   stream=batch % 2, trace="selfcheck", run=run)
+        rec.record("h2d", batch=batch, t0=b + 0.004, t1=b + 0.007,
+                   device=dev, trace="selfcheck", run=run)
+        rec.record("kernel", batch=batch, t0=b + 0.007, t1=b + 0.008,
+                   device=dev, trace="selfcheck", run=run)
+        rec.record("retire", batch=batch, t0=b + 0.008, t1=b + 0.009,
+                   trace="selfcheck", run=run)
+    spans = [r for r in tracing.recent_spans(
+        limit=tracing.span_ring_capacity()) if "ts_us" in r]
+    return flight.chrome_trace(spans=spans, timeline=rec.snapshot(),
+                               node_name="self-check")
+
+
+def fetch_live_trace(url: str) -> dict:
+    """GET /rspc/node.trace.export from a live node's API host."""
+    endpoint = url.rstrip("/") + "/rspc/node.trace.export"
+    with urllib.request.urlopen(endpoint, timeout=30) as resp:
+        payload = json.load(resp)
+    doc = payload.get("result") if isinstance(payload, dict) else None
+    if doc is None:
+        raise SystemExit(f"no result in response from {endpoint}")
+    return doc
+
+
+def main(argv=None) -> int:
+    from spacedrive_tpu import flight
+
+    ap = argparse.ArgumentParser(
+        description="Export/validate flight-recorder Chrome traces")
+    ap.add_argument("--json", action="store_true",
+                    help="build the self-check trace, validate it, and "
+                         "print it as JSON (exit 1 on schema violation)")
+    ap.add_argument("--url", default="", metavar="http://host:port",
+                    help="pull a live node's node.trace.export, "
+                         "validate, and write/print it")
+    ap.add_argument("--input", default="", metavar="PATH",
+                    help="validate an existing Chrome-trace JSON file")
+    ap.add_argument("--out", default="", metavar="PATH",
+                    help="write the (validated) trace document here")
+    args = ap.parse_args(argv)
+
+    if sum(map(bool, (args.json, args.url, args.input))) != 1:
+        ap.error("exactly one of --json / --url / --input is required")
+
+    if args.json:
+        doc = build_self_check_trace()
+    elif args.url:
+        doc = fetch_live_trace(args.url)
+    else:
+        try:
+            with open(args.input, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"trace_export: unreadable {args.input}: {e}",
+                  file=sys.stderr)
+            return 1
+
+    problems = flight.validate_chrome_trace(doc)
+    for p in problems:
+        print(f"trace_export: SCHEMA: {p}", file=sys.stderr)
+    if problems:
+        print(f"trace_export: {len(problems)} schema violation(s)",
+              file=sys.stderr)
+        return 1
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        print(f"trace_export: wrote {args.out} "
+              f"({len(doc['traceEvents'])} events)", file=sys.stderr)
+    if args.json:
+        print(json.dumps(doc))
+    elif not args.out:
+        print(f"trace_export: valid "
+              f"({len(doc['traceEvents'])} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
