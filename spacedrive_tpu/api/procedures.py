@@ -125,6 +125,31 @@ def _core(r: Router) -> None:
         return await asyncio.to_thread(flight.chrome_trace,
                                        node_name=node.config.name)
 
+    @r.query("node.health")
+    def node_health(node, _input):
+        """The health observatory's latest snapshot (spacedrive_tpu/
+        health.py): per-subsystem ok|degraded|saturated states with
+        bottleneck attribution — the top-k declared resources driving
+        each non-ok state, evidence series inline. Served from the
+        periodic sampler's cache; computes a fresh sample when the
+        sampler hasn't run within ~2 intervals (loop-less embedders,
+        sync tests)."""
+        return node.health.snapshot()
+
+    @r.subscription("node.health")
+    def node_health_sub(node, _input, emit):
+        """Push every HealthSnapshot the sampler emits (plus one
+        immediately, so subscribers paint without waiting an
+        interval). The ws pump coalesces these newest-wins — a
+        stalled operator top only ever misses stale states."""
+        def on_event(e):
+            if e.get("type") == "HealthSnapshot":
+                emit(e)
+        unsub = node.events.subscribe(on_event)
+        # AFTER subscribing, same ordering contract as node.telemetry.
+        node.health.emit_snapshot()
+        return unsub
+
     @r.subscription("node.telemetry")
     def node_telemetry(node, _input, emit):
         """Relay the TelemetryReporter's periodic TelemetrySnapshot

@@ -44,14 +44,22 @@ class Router:
     def __init__(self, node):
         self.node = node
         self.procedures: Dict[str, Procedure] = {}
+        # Subscriptions live in their OWN namespace (round 15): a path
+        # may be both a query (pull the current value) and a
+        # subscription (push every change) — `node.health` is both,
+        # mirroring rspc where the kinds are separate maps. dispatch()
+        # only ever sees `procedures`, subscribe() only this.
+        self.subscriptions: Dict[str, Procedure] = {}
 
     # -- registration ------------------------------------------------------
 
     def _register(self, name: str, kind: str, library: bool,
                   invalidates: Optional[List[str]] = None):
         def deco(fn):
-            assert name not in self.procedures, name
-            self.procedures[name] = Procedure(
+            registry = self.subscriptions \
+                if kind == "subscription" else self.procedures
+            assert name not in registry, name
+            registry[name] = Procedure(
                 name, kind, fn, library, list(invalidates or []))
             return fn
         return deco
@@ -84,10 +92,11 @@ class Router:
         """Run a query or mutation; returns its JSON-safe result."""
         proc = self.procedures.get(path)
         if proc is None:
+            if path in self.subscriptions:
+                raise RpcError("BAD_REQUEST",
+                               f"{path} is a subscription; use "
+                               "subscribe()")
             raise RpcError("NOT_FOUND", f"no such procedure: {path}")
-        if proc.kind == "subscription":
-            raise RpcError("BAD_REQUEST",
-                           f"{path} is a subscription; use subscribe()")
         args = [self.node]
         if proc.library_scoped:
             args.append(self._resolve_library(input))
@@ -109,8 +118,8 @@ class Router:
     async def subscribe(self, path: str, input: Any,
                         emit: Callable[[Any], None]) -> Callable[[], None]:
         """Start a subscription; returns an unsubscribe callable."""
-        proc = self.procedures.get(path)
-        if proc is None or proc.kind != "subscription":
+        proc = self.subscriptions.get(path)
+        if proc is None:
             raise RpcError("NOT_FOUND", f"no such subscription: {path}")
         args = [self.node]
         if proc.library_scoped:
@@ -129,7 +138,8 @@ def mount_router(node) -> Router:
     # Every `invalidates=` key must name a real query — a typo'd key
     # would silently never refetch (the reference validates invalidation
     # keys against the router at startup, api/utils/invalidate.rs:82).
-    for proc in router.procedures.values():
+    for proc in list(router.procedures.values()) \
+            + list(router.subscriptions.values()):
         for key in proc.invalidates:
             target = router.procedures.get(key)
             if target is None or target.kind != "query":

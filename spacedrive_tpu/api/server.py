@@ -62,11 +62,12 @@ class WsSubscriptionPump:
         overflow policy shed it."""
         data = payload.get("data")
         key = None
-        if isinstance(data, dict) and \
-                data.get("type") == "TelemetrySnapshot":
-            # Snapshot-coalescing: only the newest snapshot matters to
-            # a consumer that fell behind.
-            key = "TelemetrySnapshot"
+        if isinstance(data, dict) and data.get("type") in (
+                "TelemetrySnapshot", "HealthSnapshot"):
+            # Snapshot-coalescing (newest wins): only the latest
+            # telemetry/health state matters to a consumer that fell
+            # behind — intermediate snapshots are stale by definition.
+            key = data["type"]
         return self.chan.put_nowait(payload, key=key)
 
     async def _drain(self) -> None:

@@ -98,6 +98,11 @@ class ChannelContract:
     doc: str
     put_budget: Optional[str] = None  # timeouts.py name (block queues)
     kind: str = "queue"     # queue | window (external buffer) | cache
+    # True for HISTORY rings whose overflow policy is how they age by
+    # design (flight-recorder timeline, health sample rings, the
+    # latest-wins worker command inbox): the health engine reads their
+    # shed rate as normal aging, not as saturation evidence.
+    sheds_expected: bool = False
 
 
 CHANNELS: Dict[str, ChannelContract] = {}
@@ -141,7 +146,8 @@ def _violation(detail: str) -> None:
 
 def declare_channel(name: str, capacity: int, policy: str, owner: str,
                     doc: str, put_budget: Optional[str] = None,
-                    kind: str = "queue") -> ChannelContract:
+                    kind: str = "queue",
+                    sheds_expected: bool = False) -> ChannelContract:
     if name in CHANNELS:
         raise ValueError(f"channel {name!r} declared twice")
     if capacity <= 0:
@@ -161,7 +167,7 @@ def declare_channel(name: str, capacity: int, policy: str, owner: str,
                 f"channel {name!r}: put_budget {put_budget!r} is not "
                 "declared in spacedrive_tpu/timeouts.py")
     c = ChannelContract(name, int(capacity), policy, owner, doc,
-                        put_budget, kind)
+                        put_budget, kind, bool(sheds_expected))
     CHANNELS[name] = c
     return c
 
@@ -607,10 +613,25 @@ declare_channel(
     "never balloons.")
 
 declare_channel(
+    "health.series", 120, "shed_oldest", "health",
+    "Per-series sample ring of the health observatory (spacedrive_"
+    "tpu/health.py): one instance per metric series, each entry a "
+    "(ts, windowed value) point from the sampler. Oldest samples age "
+    "out — ~10 min of history at the default 5 s interval — so the "
+    "observer itself is depth-disciplined like everything it "
+    "observes.", sheds_expected=True)
+
+declare_channel(
+    "health.snapshots", 64, "shed_oldest", "health",
+    "Recent computed HealthSnapshot ring (spacedrive_tpu/health.py): "
+    "node.health serves the newest entry; history ages out "
+    "oldest-first.", sheds_expected=True)
+
+declare_channel(
     "jobs.worker.commands", 16, "shed_oldest", "jobs",
     "Per-worker command inbox (pause/resume/cancel/shutdown). The "
     "drain is latest-wins, so shedding the OLDEST command under a "
-    "flood preserves semantics exactly.")
+    "flood preserves semantics exactly.", sheds_expected=True)
 
 declare_channel(
     "media.thumbs", 64, "shed_oldest", "media",
@@ -643,7 +664,7 @@ declare_channel(
     "the per-batch bound-attribution window), written by the per-"
     "device dispatch executor threads under the recorder's lock. "
     "History ages out oldest-first — the export shows the recent "
-    "window, memory never grows with uptime.")
+    "window, memory never grows with uptime.", sheds_expected=True)
 
 declare_channel(
     "p2p.route_cache", 512, "shed_oldest", "p2p",

@@ -202,6 +202,29 @@ declare(
     strict=True)
 
 declare(
+    "SDTPU_HEALTH_INTERVAL_S", 5.0, parse_float,
+    "Seconds between health-observatory sampler ticks (health.py, "
+    "supervised under node/health): each tick spools delta-snapshots "
+    "of every metric family into the health.series rings, re-"
+    "evaluates per-subsystem saturation, and emits a HealthSnapshot "
+    "event.")
+
+declare(
+    "SDTPU_HEALTH_TOPK", 3, parse_int,
+    "Bottleneck-attribution depth of the health observatory "
+    "(health.py): the top-k resources driving each non-ok subsystem "
+    "state, ranked by severity then evidence score, served by "
+    "node.health and rendered by tools/sd_top.py.", strict=True)
+
+declare(
+    "SDTPU_LOG_JSON", False, parse_flag1,
+    "When on, a JSON-line formatter is installed on the "
+    "`spacedrive_tpu` logger (tracing.install_json_logging): every "
+    "record carries ts/level/logger/msg plus the CURRENT trace/span "
+    "id (the tracing contextvar survives to_thread), so log lines "
+    "correlate with node.spans and exported traces.")
+
+declare(
     "SDTPU_PROFILE", None, parse_str,
     "Directory for a jax profiler trace; set → device_span() regions "
     "are captured (tracing.py; probed once per process, "

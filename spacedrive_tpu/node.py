@@ -16,7 +16,8 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
-from . import flags, tasks, telemetry
+from . import flags, tasks, telemetry, tracing
+from .health import HealthMonitor
 from .jobs.manager import JobManager
 from .library import Libraries, Library
 from .store.db import uuid_bytes
@@ -220,6 +221,9 @@ class Node:
         # raising. No-op (and zero overhead) when the flag is unset.
         from . import sanitize
         sanitize.install()
+        # SDTPU_LOG_JSON: trace-correlated structured logging — a
+        # no-op when the flag is off, one handler per process when on.
+        tracing.install_json_logging()
         self.data_dir = os.path.abspath(data_dir)
         os.makedirs(self.data_dir, exist_ok=True)
         self.config = NodeConfig(os.path.join(self.data_dir, NODE_CONFIG_NAME))
@@ -237,6 +241,11 @@ class Node:
         self.orphan_removers: Dict[uuidlib.UUID, OrphanRemover] = {}
         self.telemetry_reporter = TelemetryReporter(
             self.events, owner=f"{self.task_owner}/reporter")
+        # Health observatory (health.py): delta-samples every metric
+        # family into bounded rings and attributes saturation; serves
+        # node.health and the sd_health_state{subsystem} gauges.
+        self.health = HealthMonitor(
+            self.events, owner=f"{self.task_owner}/health")
         self.p2p = None  # created by start_p2p (P2PManager)
         # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
         # bootstrap (cache version migration runs here), loop starts with
@@ -259,8 +268,10 @@ class Node:
         self.thumbnailer.start()
         try:
             self.telemetry_reporter.start()
+            self.health.start()
         except RuntimeError:
-            pass  # no running loop (sync tests); node.metrics still works
+            pass  # no running loop (sync tests); node.metrics and the
+            # on-demand node.health sample still work
         self.libraries.init()
         # Dev seed (util/debug_initializer.rs): data-dir init.json.
         # BEFORE cold_resume so reset_on_startup never deletes a library
@@ -322,6 +333,7 @@ class Node:
         raised as a sanitizer violation in tier-1."""
         await self.jobs.shutdown()
         self.telemetry_reporter.stop()
+        self.health.stop()
         await self.thumbnailer.stop()
         if self.p2p is not None:
             await self.p2p.stop()

@@ -24,7 +24,14 @@ Design constraints, in order:
   round 9 the lint is sdlint's telemetry pass; the shim remains).
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
-  sanitize | jit | task | timeout | chan.
+  sanitize | jit | task | timeout | chan | health.
+- **Windowed reads without resets.** Counters and histograms expose
+  `snapshot_delta(cursor)` — an exact delta view since a previous
+  cursor — so the health observatory (health.py) can compute windowed
+  rates and percentiles while the cumulative families `/metrics`
+  serves keep their meaning forever (a delta reader NEVER resets the
+  registry; consecutive deltas telescope exactly, even under
+  concurrent increments).
 - **No dependencies.** Pure stdlib plus the equally dependency-free
   flag registry (flags.py) — importable from every layer (store, p2p,
   ops) without cycles.
@@ -119,6 +126,17 @@ class _Metric:
     def _child_kwargs(self) -> Dict[str, Any]:
         return {}
 
+    def samples(self) -> List[Tuple[Optional[Dict[str, Any]], "_Metric"]]:
+        """The flat sample list: (labels, child) per label combination
+        for a labeled parent, [(None, self)] for a bare metric — what
+        the health sampler iterates to spool every series."""
+        if self.labelnames:
+            with self._lock:
+                items = sorted(self._children.items())
+            return [(dict(zip(self.labelnames, key)), child)
+                    for key, child in items]
+        return [(None, self)]
+
     # -- introspection ----------------------------------------------------
 
     def _sample(self) -> Dict[str, Any]:
@@ -165,6 +183,20 @@ class Counter(_Metric):
     @property
     def value(self) -> float:
         return self._value
+
+    def snapshot_delta(self, cursor: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """Windowed counter view: the value delta since `cursor` (a
+        previous call's ``"cursor"``), plus the new cursor. Exact
+        under concurrency — increments commit under the metric lock,
+        so consecutive deltas telescope to the true total with
+        nothing lost or double-counted, and the cumulative value is
+        never touched (no reset). A value BELOW the cursor means the
+        registry was reset mid-window (bench isolation); the delta
+        then restarts from zero instead of going negative."""
+        v = self._value
+        prev = 0.0 if cursor is None else float(cursor)
+        return {"value": v - prev if v >= prev else v, "cursor": v}
 
     def _sample(self) -> Dict[str, Any]:
         return {"value": self._value}
@@ -231,6 +263,37 @@ class Histogram(_Metric):
     @property
     def sum(self) -> float:
         return self._sum
+
+    def state(self) -> Tuple[Tuple[int, ...], float, int]:
+        """Atomic (counts, sum, count) copy under the metric lock —
+        the cursor `snapshot_delta` consumes. counts are per-bucket
+        (non-cumulative), +Inf last."""
+        with self._lock:
+            return (tuple(self._counts), self._sum, self._count)
+
+    def snapshot_delta(self, cursor: Optional[Tuple] = None
+                       ) -> Dict[str, Any]:
+        """Windowed histogram view since `cursor` (a previous call's
+        ``"cursor"``): per-bucket NON-cumulative delta counts aligned
+        with `self.buckets` (+Inf last), delta sum/count, and the new
+        cursor. The read is one locked state copy, so a window's
+        totals are exact even while worker threads observe
+        concurrently — and the cumulative registry is never reset
+        (windowed percentiles come from bucket-delta interpolation in
+        health.py, not from zeroing). A shrunken state means the
+        registry was reset mid-window; the delta restarts from the
+        fresh values instead of going negative."""
+        counts, s, n = self.state()
+        if cursor is None:
+            d_counts, d_sum, d_count = list(counts), s, n
+        else:
+            pc, ps, pn = cursor
+            d_counts = [c - p for c, p in zip(counts, pc)]
+            d_sum, d_count = s - ps, n - pn
+            if d_count < 0 or any(c < 0 for c in d_counts):
+                d_counts, d_sum, d_count = list(counts), s, n
+        return {"counts": d_counts, "sum": d_sum, "count": d_count,
+                "cursor": (counts, s, n)}
 
     def _sample(self) -> Dict[str, Any]:
         cum, cums = 0, []
@@ -310,6 +373,13 @@ class MetricsRegistry:
 
     def get(self, name: str) -> Optional[_Metric]:
         return self._metrics.get(name)
+
+    def families(self) -> Dict[str, _Metric]:
+        """Shallow copy of the name → family map (the health sampler's
+        iteration surface; a copy so registration during the walk —
+        module imports from another thread — cannot break it)."""
+        with self._lock:
+            return dict(self._metrics)
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-safe {name: sample} map — the TelemetrySnapshot event
@@ -656,6 +726,20 @@ CHAN_PUT_BLOCK_SECONDS = histogram(
     "observed, not instant puts) — the backpressure actually exerted",
     labelnames=("name",),
     buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120))
+
+# -- health observatory (health.py) -----------------------------------------
+HEALTH_STATE = gauge(
+    "sd_health_state",
+    "Per-subsystem saturation state computed by the health "
+    "observatory's engine (health.py): 0 = ok, 1 = degraded, "
+    "2 = saturated. The attribution behind each non-ok state is "
+    "served by the node.health rspc query / subscription",
+    labelnames=("subsystem",))
+HEALTH_SAMPLES = counter(
+    "sd_health_samples_total",
+    "Sampler observations taken by the health observatory (each "
+    "spools delta-snapshots of every registered family into the "
+    "health.series rings and re-evaluates saturation)")
 
 # -- timeout contracts (timeouts.py) ----------------------------------------
 TIMEOUTS_FIRED = counter(

@@ -637,6 +637,20 @@ declare_owner(
     "construction and never rebound.")
 
 declare_owner(
+    "health.HealthMonitor", "spacedrive_tpu/health.py::HealthMonitor",
+    {
+        "_cursors": guarded_by("_lock"),
+        "_series": guarded_by("_lock"),
+        "_prev_t": guarded_by("_lock"),
+        "_last": guarded_by("_lock"),
+        "_task": guarded_by("_lock"),
+    },
+    "Health observatory sampler: ticked by its supervised loop task, "
+    "sampled on demand by rspc handlers and bench CLIs — per-series "
+    "cursors, rings, and the cached snapshot all mutate under the "
+    "monitor's _lock leaf.")
+
+declare_owner(
     "overlap.PipelineStats",
     "spacedrive_tpu/ops/overlap.py::PipelineStats",
     {

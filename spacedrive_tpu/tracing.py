@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
 import logging
 import os
 import re
@@ -175,6 +176,63 @@ def clear_span_ring() -> None:
     """Test hook: empty the ring buffer."""
     with _span_ring_lock:
         _span_ring.clear()
+
+
+# -- trace-correlated structured logging (SDTPU_LOG_JSON) --------------------
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per log record, stamped with the CURRENT
+    trace/span id at emit time. Emission is synchronous with the
+    logging call and the span contextvar survives `asyncio.to_thread`
+    and task boundaries, so a worker-side log line inside a span
+    carries that span's trace id — log lines join node.spans and the
+    flight-recorder export on one correlation key."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        cur = _current_span.get()
+        if cur is not None:
+            out["trace"] = f"{cur[0]:x}"
+            out["span"] = f"{cur[1]:x}"
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+_json_handler: Optional[logging.Handler] = None
+_json_handler_lock = threading.Lock()
+
+
+def install_json_logging(force: bool = False, stream=None) -> bool:
+    """Attach the JSON-line handler to the `spacedrive_tpu` logger
+    when the SDTPU_LOG_JSON flag is on (or `force` is set). Idempotent
+    — one handler per process no matter how many nodes boot. Returns
+    whether the handler is installed afterwards."""
+    global _json_handler
+    with _json_handler_lock:
+        if _json_handler is not None:
+            return True
+        if not force and not flags.get("SDTPU_LOG_JSON"):
+            return False
+        h = logging.StreamHandler(stream)
+        h.setFormatter(JsonLogFormatter())
+        logger.addHandler(h)
+        _json_handler = h
+    return True
+
+
+def uninstall_json_logging() -> None:
+    """Test/embedder hook: detach the JSON handler installed above."""
+    global _json_handler
+    with _json_handler_lock:
+        if _json_handler is not None:
+            logger.removeHandler(_json_handler)
+            _json_handler = None
 
 
 # -- profiler (SDTPU_PROFILE) ----------------------------------------------

@@ -338,10 +338,16 @@ def test_ts_client_generator_covers_every_procedure():
     register_all(router)
     code = generate()
     n_scoped = 0
-    for name, proc in router.procedures.items():
+    for name, proc in list(router.procedures.items()) \
+            + list(router.subscriptions.items()):
         assert f"'{name}'" in code, name
         if proc.library_scoped:
             n_scoped += 1
+    # a path registered as both query and subscription (node.health)
+    # vends two methods, the subscription one suffixed
+    assert "node.health" in router.procedures
+    assert "node.health" in router.subscriptions
+    assert "healthSubscribe" in code
     # every library-scoped procedure carries the JSDoc contract marker
     assert code.count("library-scoped (input.library_id required)") \
         == n_scoped

@@ -808,3 +808,49 @@ def test_flags_get_parses_and_defaults(monkeypatch):
         flags.get("SDTPU_FUZZ_SEEDS")
     monkeypatch.setenv("SDTPU_FUZZ_SEEDS", "5,9")
     assert flags.get("SDTPU_FUZZ_SEEDS") == [5, 9]
+
+
+# -- health-engine read surface (round 15) ----------------------------------
+
+def test_health_reads_static_runtime_parity():
+    """The AST-parsed READS table and the runtime one cannot drift,
+    and every family the health engine reads — plus every sd_health_*
+    family it emits — must resolve in the central registry (the
+    span-family/channel drift check, for the observatory)."""
+    from spacedrive_tpu import health, telemetry
+    from tools.sdlint.passes.telemetry import health_reads
+
+    static = health_reads(ROOT)
+    assert static, "READS table not found in spacedrive_tpu/health.py"
+    assert set(static) == set(health.READS)
+    for fam in health.READS:
+        assert telemetry.REGISTRY.get(fam) is not None, fam
+    for fam in ("sd_health_state", "sd_health_samples_total"):
+        assert telemetry.REGISTRY.get(fam) is not None, fam
+
+
+def test_health_read_lint_catches_violations(tmp_path):
+    """Positive fixtures for the two new telemetry-pass codes: a
+    READS key missing from the central registry, and a sd_* literal
+    outside the table. The engine's own sd_health_* families are
+    exempt (they are centrally declared by the existing rule)."""
+    from tools.telemetry_lint import run_lint
+
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "telemetry.py").write_text(
+        "def counter(name, help=''):\n    return None\n\n\n"
+        "A = counter('sd_jobs_a_total')\n")
+    (pkg / "health.py").write_text(
+        "READS = {\n"
+        "    'sd_jobs_a_total': 'fine, centrally registered',\n"
+        "    'sd_jobs_missing_total': 'NOT registered',\n"
+        "}\n"
+        "X = 'sd_jobs_unlisted_total'\n"
+        "Y = 'sd_health_own_total'\n")
+    problems = run_lint(str(pkg))
+    text = "\n".join(problems)
+    assert "'sd_jobs_missing_total' is not registered" in text
+    assert "'sd_jobs_unlisted_total' outside the READS table" in text
+    assert "sd_health_own_total" not in text
+    assert "'sd_jobs_a_total'" not in text

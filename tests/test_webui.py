@@ -79,7 +79,7 @@ def test_ui_procedure_names_resolve():
     with tempfile.TemporaryDirectory() as d:
         node = Node(os.path.join(d, "data"))
         router = mount_router(node)
-        known = set(router.procedures)
+        known = set(router.procedures) | set(router.subscriptions)
         missing = sorted(n for n in names if n not in known)
         assert not missing, f"UI references unknown procedures: {missing}"
         referenced = (names | (literals & known)) & known

@@ -190,10 +190,24 @@ def main() -> int:
 
         flight.RECORDER.clear()
 
+    # Whole-sweep health window (spacedrive_tpu/health.py): cursors
+    # established before the sweep, sampled once after — the artifact
+    # carries WHAT saturated (pipeline stall split, channel behavior)
+    # next to the measured/bound rows it explains.
+    from spacedrive_tpu.health import (
+        HealthMonitor,
+        validate_health_snapshot,
+    )
+
+    monitor = HealthMonitor()
     rows = run_sweep(depths, links, batch=args.batch,
                      batches=args.batches, file_size=args.file_size,
                      cheap_kernel=args.cheap_kernel, donate=donate,
                      calibrate_every=args.calibrate_every)
+    hsnap = monitor.sample()
+    health_problems = validate_health_snapshot(hsnap)
+    for p in health_problems:
+        print(f"HEALTH SCHEMA: {p}", file=sys.stderr)
     artifact = {
         "metric": "overlap_bench",
         "unit": "files/s",
@@ -202,6 +216,11 @@ def main() -> int:
         "file_size": args.file_size,
         "cheap_kernel": bool(args.cheap_kernel),
         "sweep": rows,
+        "health": {
+            "window_s": hsnap["window_s"],
+            "states": hsnap["states"],
+            "attribution": hsnap["attribution"],
+        },
     }
     print(json.dumps(artifact))
     if args.json:
@@ -217,6 +236,8 @@ def main() -> int:
         if problems:
             return 1
         print(f"trace artifact: {args.trace}", file=sys.stderr)
+    if health_problems:
+        return 1
     if args.gate:
         bad = gate_failures(rows)
         for link, depth, why, val in bad:

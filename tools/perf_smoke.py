@@ -76,15 +76,23 @@ async def run(files: int, backend: str, images: int, keep: str | None,
     from spacedrive_tpu.objects.validator import ObjectValidatorJob
 
     lines: list = []
+    health_problems: list = []
 
     def emit(line: dict) -> None:
         lines.append(line)
         print(json.dumps(line), flush=True)
 
+    monitor = None
     if with_telemetry:
         # The artifact should cover THIS run only, not whatever the
         # process did before (the registry is process-global).
         telemetry.reset()
+        # Whole-run health window: cursors established here, sampled
+        # once at the end — the artifact's `health` stage shows what
+        # saturated DURING the run, next to the numbers it explains.
+        from spacedrive_tpu.health import HealthMonitor
+
+        monitor = HealthMonitor()
     if trace_out:
         # Same per-run hygiene for the flight recorder: the exported
         # timeline + span ring should cover this run only.
@@ -240,6 +248,21 @@ async def run(files: int, backend: str, images: int, keep: str | None,
               "metrics": {name: value for name, value in snap.items()
                           if name.startswith(("sd_pipeline_",
                                               "sd_stage_pool_"))}})
+        # Saturation evidence next to the numbers: subsystem states +
+        # top attribution over the WHOLE run's window (the monitor's
+        # cursors were established before the corpus stage), schema-
+        # gated like the trace artifact.
+        from spacedrive_tpu import health as health_mod
+
+        hsnap = monitor.sample()
+        health_problems.extend(
+            health_mod.validate_health_snapshot(hsnap))
+        for p in health_problems:
+            print(f"HEALTH SCHEMA: {p}", file=sys.stderr)
+        emit({"stage": "health",
+              "window_s": hsnap["window_s"],
+              "states": hsnap["states"],
+              "attribution": hsnap["attribution"]})
     if json_out:
         with open(json_out, "w") as f:
             json.dump({
@@ -267,7 +290,7 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         import shutil
 
         shutil.rmtree(root, ignore_errors=True)
-    if trace_problems:
+    if trace_problems or health_problems:
         # Exit non-zero AFTER the corpus cleanup above: a schema
         # regression must fail the run, not also leak a multi-GB
         # sdtpu-perf-* tempdir per attempt.

@@ -1,0 +1,290 @@
+"""sd_top — the health observatory's live operator top.
+
+Polls a live node's `node.health` (and renders what its sampler
+already computed: per-subsystem saturation states, bottleneck
+attribution with the declared resource names, channel depths vs
+declared capacities, windowed p99s and rates) — the "what is
+saturated and what is it blocked on" view `/metrics` alone cannot
+give.
+
+    python -m tools.sd_top --url http://host:port           # live top
+    python -m tools.sd_top --url http://host:port --once    # one frame
+    python -m tools.sd_top --url http://host:port --json    # one-shot artifact
+    python -m tools.sd_top --json [--out PATH]              # self-check
+    python -m tools.sd_top --input artifact.json            # validate only
+
+- `--json` without `--url` runs the built-in SELF-CHECK: three
+  synthetic saturations (a shedding channel, a slow store write lock,
+  a fired timeout budget) are driven through the real registry and a
+  real HealthMonitor, the resulting artifact is schema-validated
+  (`health.validate_health_snapshot`) AND semantically checked (each
+  induced saturation must be attributed to the right declared
+  resource). Non-zero exit on any violation — tier-1 runs this so the
+  observatory cannot rot silently, same pattern as
+  `trace_export.py --json`.
+- `--url` attaches to a live node over rspc HTTP; every fetched
+  snapshot is validated before rendering (a malformed one exits 1).
+- `--input` validates a stored artifact (CI gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STATE_MARK = {"ok": " ", "degraded": "!", "saturated": "#"}
+
+
+def _fetch_rspc(url: str, path: str) -> dict:
+    endpoint = url.rstrip("/") + "/rspc/" + path
+    with urllib.request.urlopen(endpoint, timeout=30) as resp:
+        payload = json.load(resp)
+    result = payload.get("result") if isinstance(payload, dict) else None
+    if result is None:
+        raise SystemExit(f"no result in response from {endpoint}")
+    return result
+
+
+def fetch_health(url: str) -> dict:
+    """GET /rspc/node.health from a live node's API host."""
+    return _fetch_rspc(url, "node.health")
+
+
+def fetch_metrics(url: str) -> dict:
+    """GET /rspc/node.metrics — the cumulative registry next to the
+    windowed health view (same counters `/metrics` scrapes)."""
+    return _fetch_rspc(url, "node.metrics")
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def render_top(snap: dict, source: str = "", width: int = 100,
+               metrics: dict = None) -> str:
+    """One text frame over a HealthSnapshot (plus, when the caller
+    polled node.metrics too, cumulative context in the header):
+    states + attribution, channel depths, windowed p99s, hottest
+    rates."""
+    out = []
+    ts = time.strftime("%H:%M:%S", time.localtime(snap.get("ts", 0)))
+    header = (
+        f"sd_top — {source or 'node'}  ts={ts}  "
+        f"window={_fmt(snap.get('window_s'))}s  "
+        f"tasks={snap.get('tasks', {}).get('live', '-')}")
+    if metrics:
+        tx = metrics.get("sd_store_tx_total", {}).get("value")
+        header += (f"  families={len(metrics)}"
+                   + (f"  tx_total={_fmt(tx)}" if tx is not None else ""))
+    out.append(header)
+    out.append("")
+    out.append(f"{'SUBSYSTEM':<10} {'STATE':<10} BOTTLENECK")
+    attribution = snap.get("attribution", {})
+    for sub in sorted(snap.get("states", {})):
+        st = snap["states"][sub]
+        entries = attribution.get(sub, [])
+        top = ""
+        if entries:
+            e = entries[0]
+            ev = ", ".join(
+                f"{k.split('{')[0]}={_fmt(v)}"
+                for k, v in list(e.get("evidence", {}).items())[:3])
+            top = f"{e['resource']} — {e['reason']}"
+            if ev:
+                top += f"  [{ev}]"
+        line = f"{STATE_MARK.get(st, '?')}{sub:<9} {st:<10} {top}"
+        out.append(line[:width])
+        for e in entries[1:]:
+            out.append(f"  {'':<19} {e['resource']} — "
+                       f"{e['reason']}"[:width])
+    window = snap.get("window", {})
+    chans = [(rec["labels"].get("name", "?"), rec.get("value", 0))
+             for rec in window.values()
+             if rec.get("family") == "sd_chan_depth"]
+    if chans:
+        out.append("")
+        out.append("CHANNELS (depth / shed rate):")
+        for name, depth in sorted(chans):
+            shed = window.get(
+                f"sd_chan_shed_total{{name={name}}}", {})
+            out.append(f"  {name:<28} depth={_fmt(depth):<8} "
+                       f"shed/s={_fmt(shed.get('rate', 0))}")
+    hists = [(k, rec) for k, rec in window.items()
+             if rec.get("kind") == "histogram"
+             and (rec.get("count") or 0) > 0]
+    if hists:
+        out.append("")
+        out.append("WINDOWED LATENCIES (p50 / p95 / p99, this window):")
+        hists.sort(key=lambda kv: -(kv[1].get("p99") or 0))
+        for k, rec in hists[:12]:
+            out.append(
+                f"  {k[:44]:<44} {_fmt(rec.get('p50'))} / "
+                f"{_fmt(rec.get('p95'))} / {_fmt(rec.get('p99'))}  "
+                f"(n={rec.get('count')})")
+    rates = [(k, rec.get("rate") or 0) for k, rec in window.items()
+             if rec.get("kind") == "counter" and (rec.get("rate") or 0) > 0]
+    if rates:
+        out.append("")
+        out.append("HOTTEST RATES (/s, this window):")
+        rates.sort(key=lambda kv: -kv[1])
+        for k, r in rates[:12]:
+            out.append(f"  {k[:60]:<60} {_fmt(r)}")
+    return "\n".join(out)
+
+
+def build_self_check() -> dict:
+    """Drive three KNOWN saturations through the real registry and a
+    real HealthMonitor, so the artifact exercises every schema shape:
+    channel shed, store write-lock wait, and a fired timeout budget."""
+    from spacedrive_tpu import channels, health, telemetry
+    from spacedrive_tpu.telemetry import (
+        STORE_WRITE_LOCK_WAIT_SECONDS,
+        TIMEOUTS_FIRED,
+    )
+
+    monitor = health.HealthMonitor(interval_s=0.05)
+    # 1. a shedding channel (tools-owned bench contract, shed_new)
+    ch = channels.channel("bench.shed")
+    for i in range(2 * ch.capacity):
+        ch.put_nowait(i)
+    # 2. a held store write lock's wait histogram
+    STORE_WRITE_LOCK_WAIT_SECONDS.observe(0.8)
+    # 3. a declared network budget firing
+    TIMEOUTS_FIRED.labels(name="p2p.ping").inc()
+    time.sleep(0.06)  # a real (if tiny) window for the rates
+    snap = monitor.sample()
+    del telemetry
+    return {
+        "metric": "sd_top",
+        "source": "self-check",
+        "health": snap,
+    }
+
+
+def self_check_problems(artifact: dict) -> list:
+    """Schema + semantic gate over the self-check artifact: the three
+    induced saturations must be attributed to the right declared
+    resources by name."""
+    from spacedrive_tpu import health
+
+    snap = artifact.get("health", {})
+    problems = health.validate_health_snapshot(snap)
+    attribution = snap.get("attribution", {})
+
+    def attributed(sub: str, resource: str) -> bool:
+        return any(e.get("resource") == resource
+                   for e in attribution.get(sub, []))
+
+    if not attributed("bench", "bench.shed"):
+        problems.append(
+            "self-check: shedding bench.shed channel not attributed")
+    if not attributed("store", "store.db.write_lock"):
+        problems.append(
+            "self-check: write-lock wait not attributed to "
+            "store.db.write_lock")
+    if not attributed("p2p", "p2p.ping"):
+        problems.append(
+            "self-check: fired p2p.ping budget not attributed")
+    if snap.get("states", {}).get("store") != "saturated":
+        problems.append("self-check: store state not saturated")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live operator top / health-artifact gate")
+    ap.add_argument("--url", default="", metavar="http://host:port",
+                    help="attach to a live node's rspc host")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one schema-validated JSON artifact "
+                         "(without --url: run the built-in self-check; "
+                         "exit 1 on any violation)")
+    ap.add_argument("--input", default="", metavar="PATH",
+                    help="validate an existing sd_top JSON artifact")
+    ap.add_argument("--out", default="", metavar="PATH",
+                    help="write the (validated) artifact here")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame instead of polling")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll seconds in live mode (default 2)")
+    args = ap.parse_args(argv)
+
+    from spacedrive_tpu import health
+
+    if args.input:
+        try:
+            with open(args.input, encoding="utf-8") as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"sd_top: unreadable {args.input}: {e}",
+                  file=sys.stderr)
+            return 1
+        problems = health.validate_health_snapshot(
+            artifact.get("health", artifact))
+        for p in problems:
+            print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"sd_top: valid ({args.input})")
+        return 0
+
+    if args.json and not args.url:
+        artifact = build_self_check()
+        problems = self_check_problems(artifact)
+        for p in problems:
+            print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
+        if problems:
+            print(f"sd_top: {len(problems)} violation(s)",
+                  file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(artifact, f, indent=1)
+            print(f"sd_top: wrote {args.out}", file=sys.stderr)
+        print(json.dumps(artifact))
+        return 0
+
+    if not args.url:
+        ap.error("--url is required outside --json/--input modes")
+
+    while True:
+        snap = fetch_health(args.url)
+        problems = health.validate_health_snapshot(snap)
+        for p in problems:
+            print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        if args.json:
+            artifact = {"metric": "sd_top", "source": args.url,
+                        "health": snap}
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(artifact, f, indent=1)
+            print(json.dumps(artifact))
+            return 0
+        try:
+            metrics = fetch_metrics(args.url)
+        except Exception:
+            metrics = None  # health alone still renders
+        frame = render_top(snap, source=args.url, metrics=metrics)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(max(0.2, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

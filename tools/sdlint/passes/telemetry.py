@@ -20,6 +20,18 @@ trace surface the flight recorder exports. Codes:
   (bare variable, f-string with no `family/` prefix): an unauditable
   span namespace;
 - ``span-central``    — a `declare_span()` call outside tracing.py.
+
+Round 15 extends it to the HEALTH ENGINE's read surface: the
+saturation engine (spacedrive_tpu/health.py) may only read metric
+families listed in its module-bottom `READS` table, and every listed
+family must be centrally registered — so the observatory can never
+silently depend on a family that was renamed or removed. Codes:
+
+- ``health-read-undeclared`` — a READS key that is not registered in
+  spacedrive_tpu/telemetry.py;
+- ``health-read-unlisted``   — a `sd_*` string literal in health.py
+  outside the READS table (and not one of its own emitted
+  `sd_health_*` families).
 """
 
 from __future__ import annotations
@@ -38,12 +50,76 @@ FACTORY_NAMES = {"counter", "gauge", "histogram"}
 CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
 NAME_RE = re.compile(
     r"^sd_(jobs?|identifier|sync|p2p|store|api|trace|sanitize|jit"
-    r"|task|timeout|chan|pipeline|stage|race)_[a-z0-9_]+$")
+    r"|task|timeout|chan|pipeline|stage|race|health)_[a-z0-9_]+$")
 
 CENTRAL_MODULE = "telemetry.py"
 
 SPAN_FUNCS = {"span", "device_span"}
 SPAN_CENTRAL = "spacedrive_tpu/tracing.py"
+
+HEALTH_MODULE = "health.py"
+
+
+def health_reads_from_tree(tree: ast.Module) -> dict:
+    """READS keys (family → key lineno) plus the key-node id set, from
+    a parsed health.py: the module-level ``READS`` dict literal
+    (plain or annotated assignment)."""
+    reads: dict = {}
+    key_ids: Set[int] = set()
+    for node in tree.body:
+        tgt = val = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt, val = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            tgt, val = node.target.id, node.value
+        if tgt == "READS" and isinstance(val, ast.Dict):
+            for k in val.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    reads[k.value] = k.lineno
+                    key_ids.add(id(k))
+    return {"reads": reads, "key_ids": key_ids}
+
+
+def health_reads(root: str) -> dict:
+    """The READS table parsed from spacedrive_tpu/health.py (family →
+    lineno) — the static half of the runtime parity test."""
+    path = os.path.join(root, "spacedrive_tpu", HEALTH_MODULE)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return {}
+    return health_reads_from_tree(tree)["reads"]
+
+
+def health_problems(path: str, tree: ast.Module,
+                    declared_families: Set[str]
+                    ) -> List[Tuple[int, str, str, str]]:
+    """The health-engine read-surface checks over a parsed health.py:
+    (lineno, code, ident, msg) tuples."""
+    parsed = health_reads_from_tree(tree)
+    reads, key_ids = parsed["reads"], parsed["key_ids"]
+    out: List[Tuple[int, str, str, str]] = []
+    for fam, lineno in sorted(reads.items()):
+        if fam not in declared_families:
+            out.append((
+                lineno, "health-read-undeclared", fam,
+                f"health engine READS entry {fam!r} is not registered "
+                "in spacedrive_tpu/telemetry.py"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("sd_") and id(node) not in key_ids:
+            if node.value in reads or node.value.startswith("sd_health_"):
+                continue
+            out.append((
+                node.lineno, "health-read-unlisted", node.value,
+                f"sd_* literal {node.value!r} outside the READS table "
+                "— every family the health engine reads must be "
+                "listed there (spacedrive_tpu/health.py bottom)"))
+    return out
 
 
 def declared_span_families(root: str) -> Set[str]:
@@ -263,7 +339,7 @@ class _Visitor(ast.NodeVisitor):
                 f"{where}: {name!r} breaks the naming scheme "
                 f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
                 f"p2p/store/api/trace/sanitize/jit/task/timeout/chan/"
-                f"pipeline/stage/race)")
+                f"pipeline/stage/race/health)")
 
 
 def lint_source(path: str, src: str, is_central: bool,
@@ -317,6 +393,18 @@ def run_lint(package_dir: str) -> List[str]:
                     span_problems=span_problems)
         for lineno, _code, _ident, msg in span_problems:
             problems.append(f"{path}:{lineno}: {msg}")
+    # Health-engine read surface (needs the full declared-name set,
+    # so it runs after the walk).
+    for path in paths:
+        if os.path.basename(path) != HEALTH_MODULE:
+            continue
+        try:
+            tree = ast.parse(open(path, encoding="utf-8").read())
+        except (OSError, SyntaxError):
+            continue
+        for lineno, _code, _ident, msg in health_problems(
+                path, tree, set(names_seen)):
+            problems.append(f"{path}:{lineno}: {msg}")
     return problems
 
 
@@ -361,6 +449,13 @@ class TelemetryPass:
                 is_span_central=src.relpath == SPAN_CENTRAL,
                 span_problems=span_problems)
             for lineno, code, ident, msg in span_problems:
+                findings.append(Finding(
+                    PASS, code, src.relpath, "", ident, msg, lineno))
+        for src in files:
+            if os.path.basename(src.relpath) != HEALTH_MODULE:
+                continue
+            for lineno, code, ident, msg in health_problems(
+                    src.relpath, src.tree, set(names_seen)):
                 findings.append(Finding(
                     PASS, code, src.relpath, "", ident, msg, lineno))
         for prob in problems:
