@@ -210,29 +210,25 @@ def _volumes(r: Router) -> None:
 def _tags(r: Router) -> None:
     @r.query("tags.list", library=True)
     def tags_list(node, library, _input):
-        return rows_to_dicts(library.db.query("SELECT * FROM tag"))
+        return rows_to_dicts(library.db.run("api.tag.all"))
 
     @r.query("tags.get", library=True)
     def tags_get(node, library, input):
-        row = library.db.query_one(
-            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        row = library.db.run("api.tag.by_id", (int(input["id"]),))
         return row_to_dict(row) if row else None
 
     @r.query("tags.getForObject", library=True)
     def tags_for_object(node, library, input):
-        return rows_to_dicts(library.db.query(
-            "SELECT t.* FROM tag t JOIN tag_on_object to2 "
-            "ON to2.tag_id = t.id WHERE to2.object_id = ?",
-            (int(input["object_id"]),)))
+        return rows_to_dicts(library.db.run(
+            "api.tag.for_object", (int(input["object_id"]),)))
 
     @r.query("tags.getWithObjects", library=True)
     def tags_with_objects(node, library, input):
-        tags = rows_to_dicts(library.db.query("SELECT * FROM tag"))
+        tags = rows_to_dicts(library.db.run("api.tag.all"))
         for t in tags:
             t["object_ids"] = [
-                row["object_id"] for row in library.db.query(
-                    "SELECT object_id FROM tag_on_object WHERE tag_id = ?",
-                    (t["id"],))
+                row["object_id"] for row in library.db.run(
+                    "api.tag.object_ids", (t["id"],))
             ]
         return tags
 
@@ -251,8 +247,7 @@ def _tags(r: Router) -> None:
 
     @r.mutation("tags.update", library=True, invalidates=["tags.list"])
     def tags_update(node, library, input):
-        tag = library.db.query_one(
-            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        tag = library.db.run("api.tag.by_id", (int(input["id"]),))
         if tag is None:
             raise RpcError("NOT_FOUND", "no such tag")
         sync = library.sync
@@ -265,34 +260,30 @@ def _tags(r: Router) -> None:
 
     @r.mutation("tags.delete", library=True, invalidates=["tags.list"])
     def tags_delete(node, library, input):
-        tag = library.db.query_one(
-            "SELECT * FROM tag WHERE id = ?", (int(input["id"]),))
+        tag = library.db.run("api.tag.by_id", (int(input["id"]),))
         if tag is None:
             return None
         sync = library.sync
         # relation deletes FIRST (earlier HLC stamps): a peer holding
         # assignments must clear them before the row delete or its
         # FK constraint rejects the op forever (sync divergence).
-        assigned = library.db.query(
-            "SELECT o.pub_id AS opub FROM tag_on_object tob "
-            "JOIN object o ON o.id = tob.object_id WHERE tob.tag_id = ?",
-            (tag["id"],))
+        assigned = library.db.run("api.tag.assigned_objects",
+                                  (tag["id"],))
         ops = [sync.relation_delete("tag_on_object", r["opub"],
                                     tag["pub_id"]) for r in assigned]
         ops.append(sync.shared_delete("tag", tag["pub_id"]))
         with sync.write_ops(ops) as conn:
-            conn.execute("DELETE FROM tag_on_object WHERE tag_id = ?",
-                         (tag["id"],))
+            library.db.run("api.tag.clear_assignments", (tag["id"],),
+                           conn=conn)
             library.db.delete("tag", tag["id"], conn=conn)
         return None
 
     @r.mutation("tags.assign", library=True,
                 invalidates=["tags.getForObject"])
     def tags_assign(node, library, input):
-        tag = library.db.query_one(
-            "SELECT * FROM tag WHERE id = ?", (int(input["tag_id"]),))
-        obj = library.db.query_one(
-            "SELECT * FROM object WHERE id = ?", (int(input["object_id"]),))
+        tag = library.db.run("api.tag.by_id", (int(input["tag_id"]),))
+        obj = library.db.run("api.object.by_id",
+                             (int(input["object_id"]),))
         if tag is None or obj is None:
             raise RpcError("NOT_FOUND", "tag or object missing")
         sync = library.sync
@@ -300,17 +291,14 @@ def _tags(r: Router) -> None:
             ops = [sync.relation_delete(
                 "tag_on_object", obj["pub_id"], tag["pub_id"])]
             with sync.write_ops(ops) as conn:
-                conn.execute(
-                    "DELETE FROM tag_on_object WHERE tag_id = ? AND "
-                    "object_id = ?", (tag["id"], obj["id"]))
+                library.db.run("api.tag.unassign",
+                               (tag["id"], obj["id"]), conn=conn)
         else:
             ops = sync.relation_create(
                 "tag_on_object", obj["pub_id"], tag["pub_id"])
             with sync.write_ops(ops) as conn:
-                conn.execute(
-                    "INSERT OR IGNORE INTO tag_on_object "
-                    "(tag_id, object_id) VALUES (?, ?)",
-                    (tag["id"], obj["id"]))
+                library.db.run("api.tag.assign",
+                               (tag["id"], obj["id"]), conn=conn)
         return None
 
 
@@ -320,17 +308,13 @@ def _tags(r: Router) -> None:
 def _labels(r: Router) -> None:
     @r.query("labels.list", library=True)
     def labels_list(node, library, _input):
-        return rows_to_dicts(library.db.query(
-            "SELECT l.*, COUNT(lo.label_id) AS object_count "
-            "FROM label l LEFT JOIN label_on_object lo "
-            "ON lo.label_id = l.id GROUP BY l.id"))
+        return rows_to_dicts(library.db.run(
+            "api.label.list_with_counts"))
 
     @r.query("labels.getForObject", library=True)
     def labels_for_object(node, library, input):
-        return rows_to_dicts(library.db.query(
-            "SELECT l.* FROM label l JOIN label_on_object lo "
-            "ON lo.label_id = l.id WHERE lo.object_id = ?",
-            (int(input["object_id"]),)))
+        return rows_to_dicts(library.db.run(
+            "api.label.for_object", (int(input["object_id"]),)))
 
     @r.mutation("labels.create", library=True, invalidates=["labels.list"])
     def labels_create(node, library, input):
@@ -347,10 +331,10 @@ def _labels(r: Router) -> None:
     @r.mutation("labels.assign", library=True,
                 invalidates=["labels.list", "labels.getForObject"])
     def labels_assign(node, library, input):
-        lb = library.db.query_one(
-            "SELECT * FROM label WHERE id = ?", (int(input["label_id"]),))
-        obj = library.db.query_one(
-            "SELECT * FROM object WHERE id = ?", (int(input["object_id"]),))
+        lb = library.db.run("api.label.by_id",
+                            (int(input["label_id"]),))
+        obj = library.db.run("api.object.by_id",
+                             (int(input["object_id"]),))
         if lb is None or obj is None:
             raise RpcError("NOT_FOUND", "label or object missing")
         sync = library.sync
@@ -358,38 +342,33 @@ def _labels(r: Router) -> None:
             ops = [sync.relation_delete(
                 "label_on_object", obj["pub_id"], lb["pub_id"])]
             with sync.write_ops(ops) as conn:
-                conn.execute(
-                    "DELETE FROM label_on_object WHERE label_id = ? "
-                    "AND object_id = ?", (lb["id"], obj["id"]))
+                library.db.run("api.label.unassign",
+                               (lb["id"], obj["id"]), conn=conn)
         else:
             ops = sync.relation_create(
                 "label_on_object", obj["pub_id"], lb["pub_id"],
                 {"date_created": int(time.time())})
             with sync.write_ops(ops) as conn:
-                conn.execute(
-                    "INSERT OR IGNORE INTO label_on_object "
-                    "(label_id, object_id, date_created) VALUES (?, ?, ?)",
-                    (lb["id"], obj["id"], int(time.time())))
+                library.db.run(
+                    "api.label.assign",
+                    (lb["id"], obj["id"], int(time.time())), conn=conn)
         return None
 
     @r.mutation("labels.delete", library=True, invalidates=["labels.list"])
     def labels_delete(node, library, input):
-        lb = library.db.query_one(
-            "SELECT * FROM label WHERE id = ?", (int(input["id"]),))
+        lb = library.db.run("api.label.by_id", (int(input["id"]),))
         if lb is None:
             return None
         sync = library.sync
         # relation deletes first — see tags_delete (FK-safe op order)
-        assigned = library.db.query(
-            "SELECT o.pub_id AS opub FROM label_on_object lo "
-            "JOIN object o ON o.id = lo.object_id WHERE lo.label_id = ?",
-            (lb["id"],))
+        assigned = library.db.run("api.label.assigned_objects",
+                                  (lb["id"],))
         ops = [sync.relation_delete("label_on_object", r["opub"],
                                     lb["pub_id"]) for r in assigned]
         ops.append(sync.shared_delete("label", lb["pub_id"]))
         with sync.write_ops(ops) as conn:
-            conn.execute("DELETE FROM label_on_object WHERE label_id = ?",
-                         (lb["id"],))
+            library.db.run("api.label.clear_assignments", (lb["id"],),
+                           conn=conn)
             library.db.delete("label", lb["id"], conn=conn)
         return None
 
@@ -415,6 +394,7 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
 
     @r.query(f"{kind}s.get", library=True)
     def g_get(node, library, input):
+        # f-strings bind the declared api.grouping.* shapes
         row = library.db.query_one(
             f"SELECT * FROM {kind} WHERE id = ?", (int(input["id"]),))
         if row is None:
@@ -438,14 +418,14 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
     @r.mutation(f"{kind}s.update", library=True,
                 invalidates=[list_key, get_key])
     def g_update(node, library, input):
-        row = library.db.query_one(
-            f"SELECT id FROM {kind} WHERE id = ?", (int(input["id"]),))
-        if row is None:
+        gid = int(input["id"])
+        if library.db.query_one(
+                f"SELECT 1 FROM {kind} WHERE id = ?", (gid,)) is None:
             raise RpcError("NOT_FOUND", f"no such {kind}")
         values = {k: input[k] for k in ("name",) + extra_fields
                   if k in input}
         values["date_modified"] = int(time.time())
-        library.db.update(kind, row["id"], values)
+        library.db.update(kind, gid, values)
         return None
 
     @r.mutation(f"{kind}s.delete", library=True,
@@ -472,8 +452,8 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
                 # list and this add): INSERT OR IGNORE does NOT
                 # suppress FK violations, and one would roll back the
                 # whole batch with a raw IntegrityError
-                if conn.execute("SELECT 1 FROM object WHERE id = ?",
-                                (int(oid),)).fetchone() is None:
+                if library.db.run("api.object.exists", (int(oid),),
+                                  conn=conn) is None:
                     continue
                 if rel_has_date_created:
                     conn.execute(
@@ -514,8 +494,7 @@ def _categories(r: Router) -> None:
     def categories_list(node, library, _input):
         from ..files import ObjectKind
         counts = {int(k): 0 for k in ObjectKind}
-        for row in library.db.query(
-                "SELECT kind, COUNT(*) AS n FROM object GROUP BY kind"):
+        for row in library.db.run("api.object.kind_counts"):
             if row["kind"] is not None:
                 counts[int(row["kind"])] = row["n"]
         return {ObjectKind(k).name.title().replace("_", ""): n
@@ -527,28 +506,23 @@ def _categories(r: Router) -> None:
 def _locations(r: Router) -> None:
     @r.query("locations.list", library=True)
     def locations_list(node, library, _input):
-        return rows_to_dicts(library.db.query("SELECT * FROM location"))
+        return rows_to_dicts(library.db.run("location.all"))
 
     @r.query("locations.get", library=True)
     def locations_get(node, library, input):
-        row = library.db.query_one(
-            "SELECT * FROM location WHERE id = ?",
-            (int(input["location_id"]),))
+        row = library.db.run("location.by_id",
+                             (int(input["location_id"]),))
         return row_to_dict(row) if row else None
 
     @r.query("locations.getWithRules", library=True)
     def locations_get_with_rules(node, library, input):
-        row = library.db.query_one(
-            "SELECT * FROM location WHERE id = ?",
-            (int(input["location_id"]),))
+        row = library.db.run("location.by_id",
+                             (int(input["location_id"]),))
         if row is None:
             return None
         out = row_to_dict(row)
-        out["indexer_rules"] = rows_to_dicts(library.db.query(
-            "SELECT ir.* FROM indexer_rule ir "
-            "JOIN indexer_rule_in_location irl "
-            "ON irl.indexer_rule_id = ir.id WHERE irl.location_id = ?",
-            (row["id"],)))
+        out["indexer_rules"] = rows_to_dicts(library.db.run(
+            "location.rules_for", (row["id"],)))
         return out
 
     @r.mutation("locations.create", library=True,
@@ -570,8 +544,7 @@ def _locations(r: Router) -> None:
     @r.mutation("locations.update", library=True,
                 invalidates=["locations.list"])
     def locations_update(node, library, input):
-        loc = library.db.query_one(
-            "SELECT * FROM location WHERE id = ?", (int(input["id"]),))
+        loc = library.db.run("location.by_id", (int(input["id"]),))
         if loc is None:
             raise RpcError("NOT_FOUND", "no such location")
         sync = library.sync
@@ -583,14 +556,12 @@ def _locations(r: Router) -> None:
         # rule re-attachment
         if "indexer_rules_ids" in input:
             with library.db.tx() as conn:
-                conn.execute(
-                    "DELETE FROM indexer_rule_in_location WHERE "
-                    "location_id = ?", (loc["id"],))
-                for rid in input["indexer_rules_ids"]:
-                    conn.execute(
-                        "INSERT OR IGNORE INTO indexer_rule_in_location "
-                        "(location_id, indexer_rule_id) VALUES (?, ?)",
-                        (loc["id"], int(rid)))
+                library.db.run("location.detach_rules", (loc["id"],),
+                               conn=conn)
+                library.db.run_many(
+                    "location.attach_rule",
+                    [(loc["id"], int(rid))
+                     for rid in input["indexer_rules_ids"]], conn=conn)
         return None
 
     @r.mutation("locations.delete", library=True,
@@ -635,16 +606,15 @@ def _locations(r: Router) -> None:
     @r.query("locations.online", library=True)
     def locations_online(node, library, _input):
         out = []
-        for row in library.db.query("SELECT id, path FROM location"):
+        for row in library.db.run("location.id_paths"):
             if row["path"] and os.path.isdir(row["path"]):
                 out.append(row["id"])
         return out
 
     @r.mutation("locations.createDirectory", library=True)
     def locations_create_directory(node, library, input):
-        loc = library.db.query_one(
-            "SELECT path FROM location WHERE id = ?",
-            (int(input["location_id"]),))
+        loc = library.db.run("location.path_by_id",
+                             (int(input["location_id"]),))
         if loc is None:
             raise RpcError("NOT_FOUND", "no such location")
         target = os.path.join(
@@ -656,22 +626,18 @@ def _locations(r: Router) -> None:
     # locations.indexer_rules.*)
     @r.query("locations.indexer_rules.list", library=True)
     def rules_list(node, library, _input):
-        return rows_to_dicts(
-            library.db.query("SELECT * FROM indexer_rule"))
+        return rows_to_dicts(library.db.run("location.rule.all"))
 
     @r.query("locations.indexer_rules.get", library=True)
     def rules_get(node, library, input):
-        row = library.db.query_one(
-            "SELECT * FROM indexer_rule WHERE id = ?", (int(input["id"]),))
+        row = library.db.run("location.rule.by_id",
+                             (int(input["id"]),))
         return row_to_dict(row) if row else None
 
     @r.query("locations.indexer_rules.listForLocation", library=True)
     def rules_for_location(node, library, input):
-        return rows_to_dicts(library.db.query(
-            "SELECT ir.* FROM indexer_rule ir "
-            "JOIN indexer_rule_in_location irl "
-            "ON irl.indexer_rule_id = ir.id WHERE irl.location_id = ?",
-            (int(input["location_id"]),)))
+        return rows_to_dicts(library.db.run(
+            "location.rules_for", (int(input["location_id"]),)))
 
     @r.mutation("locations.indexer_rules.create", library=True,
                 invalidates=["locations.indexer_rules.list"])
@@ -694,9 +660,8 @@ def _locations(r: Router) -> None:
     @r.mutation("locations.indexer_rules.delete", library=True,
                 invalidates=["locations.indexer_rules.list"])
     def rules_delete(node, library, input):
-        row = library.db.query_one(
-            "SELECT default_rule FROM indexer_rule WHERE id = ?",
-            (int(input["id"]),))
+        row = library.db.run("location.rule.default_flag",
+                             (int(input["id"]),))
         if row is None:
             return None
         if row["default_rule"]:
@@ -708,16 +673,14 @@ def _locations(r: Router) -> None:
 # -- files. (api/files.rs) -------------------------------------------------
 
 def _file_path_row(library, file_path_id: int):
-    row = library.db.query_one(
-        "SELECT * FROM file_path WHERE id = ?", (file_path_id,))
+    row = library.db.run("api.file_path.by_id", (file_path_id,))
     if row is None:
         raise RpcError("NOT_FOUND", f"file_path {file_path_id} not found")
     return row
 
 
 def _object_row(library, object_id: int):
-    row = library.db.query_one(
-        "SELECT * FROM object WHERE id = ?", (object_id,))
+    row = library.db.run("api.object.by_id", (object_id,))
     if row is None:
         raise RpcError("NOT_FOUND", f"object {object_id} not found")
     return row
@@ -726,23 +689,21 @@ def _object_row(library, object_id: int):
 def _files(r: Router) -> None:
     @r.query("files.get", library=True)
     def files_get(node, library, input):
-        obj = library.db.query_one(
-            "SELECT * FROM object WHERE id = ?", (int(input["id"]),))
+        obj = library.db.run("api.object.by_id", (int(input["id"]),))
         if obj is None:
             return None
         out = row_to_dict(obj)
-        out["file_paths"] = rows_to_dicts(library.db.query(
-            "SELECT * FROM file_path WHERE object_id = ?", (obj["id"],)))
-        md = library.db.query_one(
-            "SELECT * FROM media_data WHERE object_id = ?", (obj["id"],))
+        out["file_paths"] = rows_to_dicts(library.db.run(
+            "api.file_path.for_object", (obj["id"],)))
+        md = library.db.run("api.media_data.for_object", (obj["id"],))
         out["media_data"] = row_to_dict(md) if md else None
         return out
 
     @r.query("files.getPath", library=True)
     def files_get_path(node, library, input):
         row = _file_path_row(library, int(input["id"]))
-        loc = library.db.query_one(
-            "SELECT path FROM location WHERE id = ?", (row["location_id"],))
+        loc = library.db.run("location.path_by_id",
+                             (row["location_id"],))
         if loc is None or not loc["path"]:
             return None
         iso = IsolatedPath.from_db_row(
@@ -753,9 +714,8 @@ def _files(r: Router) -> None:
 
     @r.query("files.getMediaData", library=True)
     def files_get_media_data(node, library, input):
-        md = library.db.query_one(
-            "SELECT * FROM media_data WHERE object_id = ?",
-            (int(input["id"]),))
+        md = library.db.run("api.media_data.for_object",
+                            (int(input["id"]),))
         return row_to_dict(md) if md else None
 
     @r.query("files.getEphemeralMediaData")
@@ -797,15 +757,16 @@ def _files(r: Router) -> None:
         if not ids:
             return
         sync = library.sync
+        ph = ",".join("?" for _ in ids)
+        # binds the declared api.object.pubs_by_ids shape
         rows = library.db.query(
-            "SELECT id, pub_id FROM object WHERE id IN ("
-            + ",".join("?" for _ in ids) + ")", ids)
+            f"SELECT id, pub_id FROM object WHERE id IN ({ph})", ids)
         ops = [sync.shared_update("object", r["pub_id"], "date_accessed",
                                   value) for r in rows]
         with sync.write_ops(ops) as conn:
-            conn.executemany(
-                "UPDATE object SET date_accessed = ? WHERE id = ?",
-                [(value, r["id"]) for r in rows])
+            library.db.run_many(
+                "api.object.set_access_time",
+                [(value, r["id"]) for r in rows], conn=conn)
 
     @r.mutation("files.updateAccessTime", library=True)
     async def files_update_access_time(node, library, input):
@@ -825,8 +786,7 @@ def _files(r: Router) -> None:
                 invalidates=["search.paths"])
     def files_rename(node, library, input):
         row = _file_path_row(library, int(input["file_path_id"]))
-        loc = library.db.query_one(
-            "SELECT * FROM location WHERE id = ?", (row["location_id"],))
+        loc = library.db.run("location.by_id", (row["location_id"],))
         iso = IsolatedPath.from_db_row(
             row["location_id"], bool(row["is_dir"]),
             row["materialized_path"], row["name"] or "",
@@ -856,21 +816,18 @@ def _files(r: Router) -> None:
                 # descendants' materialized_path prefix changes too
                 old_mat = f"{row['materialized_path']}{row['name']}/"
                 new_mat = f"{row['materialized_path']}{name}/"
-                conn.execute(
-                    "UPDATE file_path SET materialized_path = "
-                    "REPLACE(materialized_path, ?, ?) WHERE location_id = ? "
-                    "AND materialized_path LIKE ? ESCAPE '\\'",
+                library.db.run(
+                    "api.file_path.rename_descendants",
                     (old_mat, new_mat, row["location_id"],
                      old_mat.replace("\\", "\\\\").replace("%", r"\%")
-                     .replace("_", r"\_") + "%"))
+                     .replace("_", r"\_") + "%"), conn=conn)
         return None
 
     @r.mutation("files.createFolder", library=True,
                 invalidates=["search.paths"])
     def files_create_folder(node, library, input):
-        loc = library.db.query_one(
-            "SELECT * FROM location WHERE id = ?",
-            (int(input["location_id"]),))
+        loc = library.db.run("location.by_id",
+                             (int(input["location_id"]),))
         if loc is None:
             raise RpcError("NOT_FOUND", "no such location")
         target = os.path.join(loc["path"],
@@ -973,8 +930,8 @@ def _files(r: Router) -> None:
     @r.mutation("files.convertImage", library=True)
     def files_convert_image(node, library, input):
         row = _file_path_row(library, int(input["file_path_id"]))
-        loc = library.db.query_one(
-            "SELECT path FROM location WHERE id = ?", (row["location_id"],))
+        loc = library.db.run("location.path_by_id",
+                             (row["location_id"],))
         iso = IsolatedPath.from_db_row(
             row["location_id"], bool(row["is_dir"]),
             row["materialized_path"], row["name"] or "",
@@ -1000,12 +957,7 @@ def _files(r: Router) -> None:
 def _jobs(r: Router) -> None:
     @r.query("jobs.reports", library=True)
     def jobs_reports(node, library, _input):
-        rows = library.db.query(
-            "SELECT id, name, action, status, task_count, "
-            "completed_task_count, errors_text, metadata, parent_id, "
-            "date_created, date_started, date_completed, "
-            "date_estimated_completion FROM job "
-            "ORDER BY date_created DESC LIMIT 100")
+        rows = library.db.run("api.job.reports")
         return rows_to_dicts(rows)
 
     @r.query("jobs.isActive", library=True)
@@ -1043,16 +995,16 @@ def _jobs(r: Router) -> None:
 
     @r.mutation("jobs.clear", library=True, invalidates=["jobs.reports"])
     def jobs_clear(node, library, input):
-        library.db.execute(
-            "DELETE FROM job WHERE id = ? AND status NOT IN (?, ?, ?)",
+        library.db.run_tx(
+            "api.job.clear",
             (bytes.fromhex(str(input["id"])), int(JobStatus.RUNNING),
              int(JobStatus.PAUSED), int(JobStatus.QUEUED)))
         return None
 
     @r.mutation("jobs.clearAll", library=True, invalidates=["jobs.reports"])
     def jobs_clear_all(node, library, _input):
-        library.db.execute(
-            "DELETE FROM job WHERE status NOT IN (?, ?, ?)",
+        library.db.run_tx(
+            "api.job.clear_all",
             (int(JobStatus.RUNNING), int(JobStatus.PAUSED),
              int(JobStatus.QUEUED)))
         return None
@@ -1227,6 +1179,7 @@ def _search(r: Router) -> None:
                 return items
             ph = ",".join("?" for _ in items)
             by_obj: Dict[int, list] = {it["id"]: [] for it in items}
+            # binds the declared api.search.paths_for_objects shape
             for fp in library.db.query(
                     f"SELECT * FROM file_path WHERE object_id IN ({ph})",
                     [it["id"] for it in items]):
@@ -1331,7 +1284,7 @@ def _preferences(r: Router) -> None:
     @r.query("preferences.get", library=True)
     def preferences_get(node, library, _input):
         out = {}
-        for row in library.db.query("SELECT * FROM preference"):
+        for row in library.db.run("api.preference.all"):
             out[row["key"]] = msgpack.unpackb(row["value"], raw=False) \
                 if row["value"] else None
         return out
@@ -1342,8 +1295,8 @@ def _preferences(r: Router) -> None:
         with library.db.tx() as conn:
             for k, v in (input.get("values") or {}).items():
                 if v is None:
-                    conn.execute(
-                        "DELETE FROM preference WHERE key = ?", (str(k),))
+                    library.db.run("api.preference.delete", (str(k),),
+                                   conn=conn)
                 else:
                     library.db.upsert(
                         "preference", {"key": str(k)},
@@ -1359,8 +1312,7 @@ def _notifications(r: Router) -> None:
     def notifications_get(node, _input):
         out = []
         for lib in node.libraries.list():
-            for row in lib.db.query(
-                    "SELECT * FROM notification ORDER BY id DESC LIMIT 50"):
+            for row in lib.db.run("api.notification.recent"):
                 d = row_to_dict(row)
                 d["library_id"] = str(lib.id)
                 out.append(d)
@@ -1369,16 +1321,16 @@ def _notifications(r: Router) -> None:
     @r.mutation("notifications.dismiss", library=True,
                 invalidates=["notifications.get"])
     def notifications_dismiss(node, library, input):
-        library.db.execute(
-            "UPDATE notification SET read = 1 WHERE id = ?",
-            (int(input["id"]),))
+        library.db.run_tx("api.notification.dismiss",
+                          (int(input["id"]),))
         return None
 
     @r.mutation("notifications.dismissAll",
                 invalidates=["notifications.get"])
     def notifications_dismiss_all(node, _input):
         for lib in node.libraries.list():
-            lib.db.execute("UPDATE notification SET read = 1")
+            # one tx per LIBRARY — each library is its own database
+            lib.db.run_tx("api.notification.dismiss_all")  # sdlint: ok[tx-shape]
         return None
 
     @r.subscription("notifications.listen")
@@ -1418,7 +1370,7 @@ def _nodes(r: Router) -> None:
 
     @r.query("nodes.listLocations", library=True)
     def nodes_list_locations(node, library, input):
-        return rows_to_dicts(library.db.query("SELECT * FROM location"))
+        return rows_to_dicts(library.db.run("location.all"))
 
 
 # -- auth. (api/auth.rs — the RFC 8628 device flow state machine) ----------

@@ -388,9 +388,8 @@ class ApiServer:
         (or predates instance attribution)."""
         if loc["instance_id"] is None:
             return True
-        row = lib.db.query_one(
-            "SELECT pub_id FROM instance WHERE id = ?",
-            (loc["instance_id"],))
+        row = lib.db.run("node.instance_pub_by_row",
+                         (loc["instance_id"],))
         return row is None or row["pub_id"] == lib.sync.instance
 
     async def _file_over_p2p(self, request, lib, loc, row

@@ -246,6 +246,24 @@ declare(
     "SDTPU_SANITIZE.")
 
 declare(
+    "SDTPU_SQL_AUDIT", "auto", lambda v: v.strip().lower(),
+    "Runtime SQL auditor (store/sqlaudit.py, armed with the "
+    "sanitizer): every executed statement is matched against the "
+    "contract registry (store/statements.py) — undeclared statements "
+    "and autocommit writes are sanitizer violations (raised in "
+    "tier-1, counted in production). `off` skips arming (plain "
+    "sqlite3 connections, zero overhead); `auto` follows "
+    "SDTPU_SANITIZE. Read once at sanitize.install().")
+
+declare(
+    "SDTPU_SQL_EXPLAIN", 0, parse_int,
+    "EXPLAIN-sampling period of the runtime SQL auditor: every Nth "
+    "execution of a declared read over a registered large table runs "
+    "EXPLAIN QUERY PLAN, and full-table scans count into "
+    "sd_sql_scan_total{name}. 0 (default) disables sampling.",
+    strict=True)
+
+declare(
     "SDTPU_SANITIZE", False, parse_flag1,
     "Opt-in runtime sanitizer (sanitize.py): event-loop stall "
     "detector, lock-order cycle check, write-lock-held-across-await "

@@ -421,6 +421,26 @@ def _timeout_findings(window, dt) -> List[Dict[str, Any]]:
     return finds
 
 
+def _hot_statements(window, top: int = 3) -> List[Dict[str, Any]]:
+    """Per-statement attribution over the declared inventory (round
+    16): the window's hottest statements by execution and row rate —
+    so a saturated write lock names WHICH statement is hammering it,
+    not just that the store hurts."""
+    from .store import statements as _stmts
+
+    hot = []
+    for name in list(_stmts.STATEMENTS) + list(_stmts.SHAPES):
+        rec = _win(window, "sd_sql_statements_total", name=name)
+        rate = (rec or {}).get("rate") or 0.0
+        if rate <= 0:
+            continue
+        rows = _win(window, "sd_sql_rows_total", name=name)
+        hot.append({"statement": name, "rate": rate,
+                    "rows_rate": (rows or {}).get("rate") or 0.0})
+    hot.sort(key=lambda h: (-h["rows_rate"], -h["rate"]))
+    return hot[:top]
+
+
 def _store_findings(window) -> List[Dict[str, Any]]:
     finds = []
     lock_rec = _win(window, "sd_store_write_lock_wait_seconds")
@@ -439,6 +459,7 @@ def _store_findings(window) -> List[Dict[str, Any]]:
                     "sd_store_write_lock_wait_seconds": p99,
                     "tx_rate": (_win(window, "sd_store_tx_total")
                                 or {}).get("rate"),
+                    "hottest_statements": _hot_statements(window),
                 }))
     commit_rec = _win(window, "sd_store_commit_seconds")
     cp99 = (commit_rec or {}).get("p99")
@@ -447,7 +468,8 @@ def _store_findings(window) -> List[Dict[str, Any]]:
             "store.db.commit", "store", 1, cp99,
             f"COMMIT latency p99 {cp99:.3g}s in window",
             owner="store", doc=_family_doc("sd_store_commit_seconds"),
-            evidence={"sd_store_commit_seconds": cp99}))
+            evidence={"sd_store_commit_seconds": cp99,
+                      "hottest_statements": _hot_statements(window)}))
     return finds
 
 
@@ -670,6 +692,11 @@ READS: Dict[str, str] = {
         "writer serialization behind the per-database write lock",
     "sd_store_commit_seconds": "COMMIT latency of write transactions",
     "sd_store_tx_total": "write-transaction rate (lock-wait context)",
+    "sd_sql_statements_total":
+        "per-statement execution rate (hottest-statement attribution "
+        "for store findings)",
+    "sd_sql_rows_total":
+        "per-statement row throughput (hottest-statement attribution)",
     "sd_task_spawned_total": "supervisor spawn rate (census context)",
     "sd_task_orphaned_total": "tasks surviving the shutdown reap",
     "sd_pipeline_stage_stall_seconds_total":

@@ -172,8 +172,8 @@ class JobManager:
         if message is not None:
             entry.report.errors_text.append(message)
         entry.report.update(entry.library.db)
-        entry.library.db.execute(
-            "DELETE FROM job_scratch WHERE job_id = ?", (job_id,))
+        entry.library.db.run_tx("jobs.scratch.delete_for_job",
+                                (job_id,))
 
     def _start(self, entry: _Entry) -> None:
         worker = Worker(
@@ -232,7 +232,8 @@ class JobManager:
                     self._admit(nxt)
         while (self.queue and len(self.running) < self.max_workers
                and not self._shutting_down):
-            self._start(self.queue.popleft())
+            # one report tx per STARTED job — the admission unit
+            self._start(self.queue.popleft())  # sdlint: ok[tx-shape]
         JOBS_RUNNING.set(len(self.running))
         JOBS_QUEUED.set(len(self.queue))
 
@@ -364,7 +365,7 @@ class JobManager:
             if job.hash() in self._hashes:
                 continue
             # sync by design (done-callback path); tiny status UPDATE
-            self._admit_from_state(library,  # sdlint: ok[blocking-async]
+            self._admit_from_state(library,  # sdlint: ok[blocking-async,tx-shape]
                                    report)
             JOBS_RESUMED.inc()
             resumed.append(report.id)

@@ -69,25 +69,22 @@ class Library:
     def statistics(self) -> dict:
         """library.statistics procedure data (api/libraries.rs:47)."""
         db = self.db
-        objs = db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
-        paths = db.query_one("SELECT COUNT(*) AS n FROM file_path")["n"]
-        size_rows = db.query(
-            "SELECT size_in_bytes_bytes FROM file_path WHERE is_dir = 0")
+        objs = db.run("store.object_count")["n"]
+        paths = db.run("library.stats.path_count")["n"]
+        size_rows = db.run("library.stats.file_sizes")
         total = sum(int.from_bytes(r["size_in_bytes_bytes"] or b"", "big")
                     for r in size_rows)
-        unique_rows = db.query(
-            "SELECT MIN(size_in_bytes_bytes) AS s FROM file_path "
-            "WHERE is_dir = 0 AND object_id IS NOT NULL GROUP BY object_id")
+        unique_rows = db.run("library.stats.unique_sizes")
         unique = sum(int.from_bytes(r["s"] or b"", "big")
                      for r in unique_rows)
         db_size = os.path.getsize(db.path) if os.path.exists(db.path) else 0
         # Persist the LATEST statistics snapshot (single row, replaced in
         # place — a polled query must not grow the table unboundedly).
-        db.execute("DELETE FROM statistics")
-        db.execute(
-            "INSERT INTO statistics (total_object_count, library_db_size,"
-            " total_unique_bytes, total_bytes_used) VALUES (?, ?, ?, ?)",
-            (objs, str(db_size), str(unique), str(total)))
+        with db.tx() as conn:
+            db.run("library.stats.clear", conn=conn)
+            db.run("library.stats.insert",
+                   (objs, str(db_size), str(unique), str(total)),
+                   conn=conn)
         return {
             "total_object_count": objs,
             "total_path_count": paths,

@@ -18,8 +18,7 @@ from .paths import IsolatedPath
 def load_location(db, location_id: int):
     """Location row, or EarlyFinish when it vanished mid-chain (the
     reference jobs treat a missing location as clean completion)."""
-    loc = db.query_one(
-        "SELECT * FROM location WHERE id = ?", (location_id,))
+    loc = db.run("location.by_id", (location_id,))
     if loc is None or not loc["path"]:
         raise EarlyFinish(f"location {location_id} gone")
     return loc

@@ -19,7 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
-from ..store import uuid_bytes
+from ..store import statements, uuid_bytes
 from .paths import IsolatedPath
 from .rules import load_rules_for_location
 from .walker import ToWalkEntry, WalkedEntry, Walker, WalkResult
@@ -63,10 +63,7 @@ def make_db_fetchers(db, location_id: int):
     def existing(paths):
         out = []
         for p in paths:
-            row = db.query_one(
-                "SELECT * FROM file_path WHERE location_id = ? AND "
-                "materialized_path = ? AND name = ? AND extension = ?",
-                p.db_key())
+            row = db.run("indexer.path_by_key", p.db_key())
             if row is not None:
                 out.append(dict(row))
         return out
@@ -76,11 +73,7 @@ def make_db_fetchers(db, location_id: int):
         children_mat = parent_iso.materialized_path_for_children()
         if children_mat is None:
             return []
-        rows = db.query(
-            "SELECT pub_id, cas_id, is_dir, materialized_path, name, "
-            "extension FROM file_path "
-            "WHERE location_id = ? AND materialized_path = ?",
-            (location_id, children_mat))
+        rows = db.run("indexer.children", (location_id, children_mat))
         seen = {(p.materialized_path, p.name, p.extension)
                 for p in iso_paths}
         return [dict(r) for r in rows
@@ -101,7 +94,8 @@ def _consume_scratch(conn, scratch_id: Optional[int]) -> None:
     domain transaction — commit and consume are atomic, so a crash can
     never land between them (no reliance on idempotent replay)."""
     if scratch_id is not None:
-        conn.execute("DELETE FROM job_scratch WHERE id = ?", (scratch_id,))
+        conn.execute(statements.get("jobs.scratch.delete").sql,
+                     (scratch_id,))
 
 
 def save_file_path_rows(library, location_pub_id: bytes,
@@ -132,6 +126,7 @@ def save_file_path_rows(library, location_pub_id: bytes,
     existing_by_inode: Dict[bytes, Any] = {}
     for chunk in _in_chunks(inodes):
         ph = ",".join("?" for _ in chunk)
+        # binds the declared indexer.paths_by_inodes shape
         for e in db.query(
             f"SELECT inode, pub_id, materialized_path, name, extension "
             f"FROM file_path WHERE location_id = ? AND inode IN ({ph})",
@@ -249,9 +244,8 @@ def remove_file_path_rows(library, location_id: int,
     with db.tx() as conn:
         for r in removed:
             if r.get("materialized_path") is not None:
-                cur_row = conn.execute(
-                    "SELECT materialized_path, name FROM file_path "
-                    "WHERE pub_id = ?", (r["pub_id"],)).fetchone()
+                cur_row = db.run("indexer.path_current",
+                                 (r["pub_id"],), conn=conn)
                 if cur_row is None:
                     continue  # already gone (replayed step)
                 if (cur_row["materialized_path"] != r["materialized_path"]
@@ -261,17 +255,19 @@ def remove_file_path_rows(library, location_id: int,
                 children_mat = (f"{r['materialized_path']}{r['name']}/")
                 where, params = "location_id = ?", [location_id]
                 where = materialized_like(where, params, children_mat)
+                # binds the declared indexer.desc_pubs shape
                 desc = conn.execute(
                     f"SELECT pub_id FROM file_path WHERE {where}",
                     params).fetchall()
                 for d in desc:
                     ops.append(sync.shared_delete("file_path", d["pub_id"]))
+                # binds the declared indexer.desc_delete shape
                 cur = conn.execute(
                     f"DELETE FROM file_path WHERE {where}", params)
                 n += cur.rowcount
             ops.append(sync.shared_delete("file_path", r["pub_id"]))
-            conn.execute("DELETE FROM file_path WHERE pub_id = ?",
-                         (r["pub_id"],))
+            db.run("indexer.path_delete_by_pub", (r["pub_id"],),
+                   conn=conn)
             n += 1
         sync._insert_op_rows(conn, ops)
         _consume_scratch(conn, consume_scratch)
@@ -324,9 +320,12 @@ class IndexerJob(StatefulJob):
         sids: List[int] = []
         with ctx.db.tx() as conn:
             for b in batches:
-                cur = conn.execute(
-                    "INSERT INTO job_scratch (job_id, data) VALUES (?, ?)",
-                    (ctx.job_id, msgpack.packb(b, use_bin_type=True)))
+                # per-row lastrowid feeds the step descriptors —
+                # executemany has no rowid surface; one tx regardless
+                cur = ctx.db.run(  # sdlint: ok[tx-shape]
+                    "jobs.scratch.insert",
+                    (ctx.job_id, msgpack.packb(b, use_bin_type=True)),
+                    conn=conn)
                 sids.append(cur.lastrowid)
         return sids
 
@@ -338,8 +337,7 @@ class IndexerJob(StatefulJob):
         landed). Inline "rows" kept for states persisted pre-spooling."""
         if "rows" in step:
             return step["rows"]
-        row = ctx.db.query_one(
-            "SELECT data FROM job_scratch WHERE id = ?", (step["scratch"],))
+        row = ctx.db.run("jobs.scratch.data", (step["scratch"],))
         if row is None:
             return []
         import msgpack
@@ -389,8 +387,7 @@ class IndexerJob(StatefulJob):
 
     async def init(self, ctx: JobContext):
         db = ctx.db
-        loc = db.query_one(
-            "SELECT * FROM location WHERE id = ?", (self.location_id,))
+        loc = db.run("location.by_id", (self.location_id,))
         if loc is None or not loc["path"]:
             raise EarlyFinish(f"location {self.location_id} gone")
         location_path = loc["path"]
@@ -473,8 +470,8 @@ class IndexerJob(StatefulJob):
         persisted step list that references them."""
         if ctx.job_id:
             await asyncio.to_thread(
-                ctx.db.execute,
-                "DELETE FROM job_scratch WHERE job_id = ?", (ctx.job_id,))
+                ctx.db.run_tx, "jobs.scratch.delete_for_job",
+                (ctx.job_id,))
 
     def _write_dir_sizes(self, ctx: JobContext, data) -> int:
         """Deferred dir-size writes + their sync ops in ONE tx.
@@ -495,18 +492,18 @@ class IndexerJob(StatefulJob):
                         self.location_id, loc_path, path, True)
                 except ValueError:
                     continue
-                row = conn.execute(
-                    "SELECT id, pub_id FROM file_path WHERE "
-                    "location_id = ? AND materialized_path = ? AND "
-                    "name = ? AND extension = ?",
+                row = ctx.db.run(
+                    "indexer.id_pub_by_key",
                     (iso.location_id, iso.materialized_path, iso.name,
-                     iso.extension)).fetchone()
+                     iso.extension), conn=conn)
                 if row is None:
                     continue
                 blob = int(size).to_bytes(8, "big")
-                conn.execute(
-                    "UPDATE file_path SET size_in_bytes_bytes = ? "
-                    "WHERE id = ?", (blob, row["id"]))
+                # interleaved with the per-row key resolution above;
+                # the whole rollup is already ONE tx
+                ctx.db.run(  # sdlint: ok[tx-shape]
+                    "indexer.set_dir_size", (blob, row["id"]),
+                    conn=conn)
                 specs.append((row["pub_id"], "u:size_in_bytes_bytes",
                               "size_in_bytes_bytes", blob, None))
             return sync.bulk_shared_ops(conn, "file_path", specs)
@@ -534,8 +531,7 @@ class IndexerJob(StatefulJob):
             ctx.library.sync._notify_created()
         if ctx.job_id:  # sweep any unconsumed scratch (replays, errors)
             await asyncio.to_thread(
-                db.execute,
-                "DELETE FROM job_scratch WHERE job_id = ?", (ctx.job_id,))
+                db.run_tx, "jobs.scratch.delete_for_job", (ctx.job_id,))
         metadata.setdefault("indexed_count", data["total_saved"])
         metadata.setdefault("updated_count", data["total_updated"])
         metadata.setdefault("removed_count", data["total_removed"])

@@ -33,7 +33,7 @@ def create_location(library, path: str,
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise LocationError(f"{path} is not a directory")
-    for row in library.db.query("SELECT path FROM location"):
+    for row in library.db.run("location.paths"):
         other = row["path"] or ""
         if other and (path == other
                       or path.startswith(other.rstrip("/") + "/")
@@ -60,8 +60,7 @@ def create_location(library, path: str,
 
 
 def delete_location(library, location_id: int) -> None:
-    row = library.db.query_one(
-        "SELECT pub_id FROM location WHERE id = ?", (location_id,))
+    row = library.db.run("location.pub_by_id", (location_id,))
     if row is None:
         raise LocationError("no such location")
     with library.sync.write_ops(
@@ -98,8 +97,7 @@ def relink_location(library, location_id: int, new_path: str) -> None:
     new_path = os.path.abspath(new_path)
     if not os.path.isdir(new_path):
         raise LocationError(f"{new_path} is not a directory")
-    row = library.db.query_one(
-        "SELECT pub_id FROM location WHERE id = ?", (location_id,))
+    row = library.db.run("location.pub_by_id", (location_id,))
     if row is None:
         raise LocationError("no such location")
     with library.sync.write_ops([

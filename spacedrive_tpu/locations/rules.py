@@ -172,27 +172,24 @@ def seed_system_rules(db) -> None:
     index (seed.rs:38-69: uuid_from_u128(i)). DO NOT REORDER."""
     import time
     now = int(time.time())
-    for i, factory in enumerate(SYSTEM_RULES):
-        rule = factory()
-        pub_id = i.to_bytes(16, "big")
-        db.upsert(
-            "indexer_rule",
-            {"pub_id": pub_id},
-            {
-                "name": rule.name,
-                "default_rule": int(rule.default),
-                "rules_per_kind": rule.serialize_rules(),
-                "date_created": now,
-                "date_modified": now,
-            },
-        )
+    with db.tx() as conn:  # one tx for the whole seed set
+        for i, factory in enumerate(SYSTEM_RULES):
+            rule = factory()
+            pub_id = i.to_bytes(16, "big")
+            db.upsert(
+                "indexer_rule",
+                {"pub_id": pub_id},
+                {
+                    "name": rule.name,
+                    "default_rule": int(rule.default),
+                    "rules_per_kind": rule.serialize_rules(),
+                    "date_created": now,
+                    "date_modified": now,
+                },
+                conn=conn,
+            )
 
 
 def load_rules_for_location(db, location_id: int) -> List[IndexerRule]:
-    rows = db.query(
-        "SELECT ir.* FROM indexer_rule ir "
-        "JOIN indexer_rule_in_location irl ON irl.indexer_rule_id = ir.id "
-        "WHERE irl.location_id = ?",
-        (location_id,),
-    )
+    rows = db.run("location.rules_for", (location_id,))
     return [IndexerRule.from_row(r) for r in rows]

@@ -70,6 +70,7 @@ def light_scan_location(library, location_id: int,
         where, params = orphan_filters(location_id, cursor, None)
         where += " AND materialized_path = ?"
         params.append(sub_mat)
+        # binds the declared location.shallow.page shape
         chunk = [dict(r) for r in db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id LIMIT ?",
             params + [CHUNK_SIZE])]

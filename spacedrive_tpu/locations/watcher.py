@@ -340,8 +340,7 @@ class Locations:
         self._owner = f"{node.task_owner}/locations"
 
     def watch_location(self, library, location_id: int) -> bool:
-        loc = library.db.query_one(
-            "SELECT path FROM location WHERE id = ?", (location_id,))
+        loc = library.db.run("location.path_by_id", (location_id,))
         if loc is None or not loc["path"] or not os.path.isdir(loc["path"]):
             return False
         key = (library.id, location_id)

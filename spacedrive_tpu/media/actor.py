@@ -240,9 +240,7 @@ class Thumbnailer:
         loaded library. Returns the number removed."""
         known = set()
         for lib in self.node.libraries.list():
-            for row in lib.db.query(
-                    "SELECT DISTINCT cas_id FROM file_path "
-                    "WHERE cas_id IS NOT NULL"):
+            for row in lib.db.run("media.known_cas"):
                 known.add(row["cas_id"])
         removed = 0
         root = os.path.join(self.data_dir, "thumbnails")

@@ -47,6 +47,7 @@ class MediaProcessorJob(StatefulJob):
             f"location_id = ? AND is_dir = 0 AND object_id IS NOT NULL "
             f"AND LOWER(extension) IN ({ph})",
             [self.location_id, *exts])
+        # binds the declared media.file_rows shape
         rows = db.query(
             f"SELECT id, pub_id, object_id, cas_id, materialized_path, "
             f"name, extension FROM file_path WHERE {where} ORDER BY id",
@@ -79,15 +80,19 @@ class MediaProcessorJob(StatefulJob):
         av_exts = probeable_extensions()
         db = ctx.db
         errors: List[str] = []
+        # Extraction runs outside any tx (file IO per row); the batch
+        # lands as ONE insert_many transaction — the tx-shape pass
+        # flagged the old per-row db.insert as commit-per-item.
+        # OR IGNORE keeps the old unique-race semantics (another path
+        # of the same object winning the object_id slot is benign).
+        mds: List[dict] = []
         for r in step["rows"]:
             ext = (r["extension"] or "").lower()
             is_av = ext in av_exts
             if ext not in MEDIA_DATA_EXTENSIONS and not is_av:
                 continue
             full = self._full_path(data, r)
-            existing = db.query_one(
-                "SELECT id FROM media_data WHERE object_id = ?",
-                (r["object_id"],))
+            existing = db.run("media.data_exists", (r["object_id"],))
             if existing is not None:
                 continue
             try:
@@ -102,10 +107,28 @@ class MediaProcessorJob(StatefulJob):
                     if md is None:
                         continue
                     md["object_id"] = r["object_id"]
-                db.insert("media_data", md)
-                data["extracted"] += 1
-            except Exception as e:  # unique race: another path
+                mds.append(md)
+            except Exception as e:
                 errors.append(f"media_data {full}: {e}")
+        if mds:
+            try:
+                data["extracted"] += db.insert_many(
+                    "media_data", mds, ignore_conflicts=True)
+            except Exception as e:
+                # OR IGNORE does not cover FK violations (an object
+                # deleted between scan and insert): fall back to
+                # per-row inserts so one dead reference costs one
+                # error string, not the whole batch
+                del e
+                with db.tx() as conn:
+                    for md in mds:
+                        try:
+                            db.insert("media_data", md, conn=conn)
+                            data["extracted"] += 1
+                        except Exception as row_e:  # noqa: BLE001
+                            errors.append(
+                                f"media_data object "
+                                f"{md.get('object_id')}: {row_e}")
         return StepOutcome(errors=errors)
 
     async def _thumbs_step(self, ctx: JobContext, data, step) -> None:

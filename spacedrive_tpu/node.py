@@ -139,10 +139,7 @@ class OrphanRemover:
 
     def invoke(self) -> int:
         db = self.library.db
-        rows = db.query(
-            "SELECT o.id, o.pub_id FROM object o "
-            "LEFT JOIN file_path fp ON fp.object_id = o.id "
-            "WHERE fp.id IS NULL LIMIT 512")
+        rows = db.run("node.orphan_objects")
         if not rows:
             return 0
         sync = self.library.sync
@@ -155,7 +152,7 @@ class OrphanRemover:
                 # DDL ON DELETE — a raw delete would FK-fail and abort
                 # the whole batch (round-5 review finding)
                 cascade_local_fks(conn, "object", r["id"])
-                conn.execute("DELETE FROM object WHERE id = ?", (r["id"],))
+                db.run("node.object_delete", (r["id"],), conn=conn)
         return len(rows)
 
     def start(self) -> None:
@@ -284,7 +281,8 @@ class Node:
         except Exception as e:
             self.events.emit({"type": "DebugInitError", "error": str(e)})
         for lib in self.libraries.list():
-            await self.jobs.cold_resume(lib)
+            # one resume sweep per LIBRARY — each is its own database
+            await self.jobs.cold_resume(lib)  # sdlint: ok[tx-shape]
             self._ensure_actors(lib)
 
     def _on_library_event(self, kind: str, library: Library) -> None:

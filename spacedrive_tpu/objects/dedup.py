@@ -43,6 +43,7 @@ def exact_duplicate_groups(library, location_id: Optional[int] = None,
     if location_id is not None:
         where += " AND fp.location_id = ?"
         params.append(location_id)
+    # binds the declared dedup.exact_groups shape
     rows = library.db.query(
         f"SELECT fp.cas_id AS cas_id, COUNT(*) AS n, "
         f"o.pub_id AS object_pub_id "
@@ -51,10 +52,7 @@ def exact_duplicate_groups(library, location_id: Optional[int] = None,
         f"ORDER BY n DESC LIMIT ?", params + [limit])
     out = []
     for r in rows:
-        paths = library.db.query(
-            "SELECT materialized_path, name, extension, location_id, "
-            "size_in_bytes_bytes FROM file_path WHERE cas_id = ?",
-            (r["cas_id"],))
+        paths = library.db.run("dedup.paths_by_cas", (r["cas_id"],))
         sizes = [int.from_bytes(p["size_in_bytes_bytes"] or b"", "big")
                  for p in paths]
         pub = r["object_pub_id"]
@@ -103,6 +101,7 @@ class NearDupDetectorJob(StatefulJob):
             [self.location_id, *PHASHABLE_EXTENSIONS])
         where = where.replace("materialized_path LIKE",
                               "fp.materialized_path LIKE")
+        # binds the declared dedup.image_rows shape
         rows = db.query(
             f"SELECT fp.id, fp.object_id, fp.materialized_path, fp.name, "
             f"fp.extension, md.phash AS phash "
@@ -145,14 +144,15 @@ class NearDupDetectorJob(StatefulJob):
         with db.tx() as conn:
             for i, words in hashes.items():
                 blob = phash_to_bytes(words)
-                cur = conn.execute(
-                    "UPDATE media_data SET phash = ? WHERE object_id = ?",
-                    (blob, rows[i]["object_id"]))
+                # UPDATE-then-INSERT fallback decides per ROW on
+                # rowcount — not batchable; one tx for the chunk
+                cur = ctx.db.run(  # sdlint: ok[tx-shape]
+                    "dedup.set_phash",
+                    (blob, rows[i]["object_id"]), conn=conn)
                 if cur.rowcount == 0:
-                    conn.execute(
-                        "INSERT OR IGNORE INTO media_data "
-                        "(object_id, phash) VALUES (?, ?)",
-                        (rows[i]["object_id"], blob))
+                    ctx.db.run(  # sdlint: ok[tx-shape]
+                        "dedup.insert_phash_row",
+                        (rows[i]["object_id"], blob), conn=conn)
         data["hashed"] += len(hashes)
         ctx.progress(message=f"hashed {data['hashed']} images")
         return StepOutcome(errors=errors,
@@ -162,12 +162,8 @@ class NearDupDetectorJob(StatefulJob):
         import numpy as np
         from ..ops.hamming import near_dup_pairs, near_dup_pairs_lsh
         db = ctx.db
-        rows = db.query(
-            "SELECT DISTINCT md.object_id AS object_id, md.phash AS phash "
-            "FROM media_data md "
-            "JOIN file_path fp ON fp.object_id = md.object_id "
-            "WHERE md.phash IS NOT NULL AND fp.location_id = ?",
-            (self.location_id,))
+        rows = db.run("dedup.phashes_for_location",
+                      (self.location_id,))
         if len(rows) < 2:
             return StepOutcome(metadata={"pairs": 0})
         object_ids = [r["object_id"] for r in rows]
@@ -194,20 +190,16 @@ class NearDupDetectorJob(StatefulJob):
             pairs = near_dup_pairs_lsh(digests, self.threshold)
 
         now = int(time.time())
+        pair_rows = []
+        for i, j in pairs:
+            a, b = sorted((object_ids[i], object_ids[j]))
+            if a == b:
+                continue  # two file_paths of one object: exact dup
+            d = int(np.sum(np.unpackbits(
+                (digests[i] ^ digests[j]).astype(">u4").view(np.uint8))))
+            pair_rows.append((a, b, d, now))
         with db.tx() as conn:
-            for i, j in pairs:
-                a, b = sorted((object_ids[i], object_ids[j]))
-                if a == b:
-                    continue  # two file_paths of one object: exact dup
-                d = int(np.sum(np.unpackbits(
-                    (digests[i] ^ digests[j]).astype(">u4").view(np.uint8))))
-                conn.execute(
-                    "INSERT INTO near_dup_pair "
-                    "(object_a_id, object_b_id, distance, date_detected) "
-                    "VALUES (?, ?, ?, ?) "
-                    "ON CONFLICT (object_a_id, object_b_id) "
-                    "DO UPDATE SET distance = excluded.distance",
-                    (a, b, d, now))
+            db.run_many("dedup.upsert_pair", pair_rows, conn=conn)
         data["pairs_found"] = len(pairs)
         return StepOutcome(errors=errors, metadata={"pairs": len(pairs)})
 
@@ -222,18 +214,15 @@ def near_duplicates(library, location_id: Optional[int] = None,
                     max_distance: int = DEFAULT_THRESHOLD,
                     limit: int = 1000) -> List[Dict[str, Any]]:
     """Query stored near-dup pairs with object/file detail."""
-    rows = library.db.query(
-        "SELECT * FROM near_dup_pair WHERE distance <= ? "
-        "ORDER BY distance ASC LIMIT ?", (max_distance, limit))
+    rows = library.db.run("dedup.pairs_within", (max_distance, limit))
     out = []
     for r in rows:
         def paths_of(oid):
             return [
                 f"{p['materialized_path']}{p['name']}"
                 + (f".{p['extension']}" if p["extension"] else "")
-                for p in library.db.query(
-                    "SELECT materialized_path, name, extension "
-                    "FROM file_path WHERE object_id = ?", (oid,))
+                for p in library.db.run("dedup.paths_for_object",
+                                        (oid,))
             ]
         out.append({
             "distance": r["distance"],

@@ -81,8 +81,7 @@ def _file_datas(db, location_id: int, location_path: str,
     """get_many_files_datas (fs/mod.rs:53-87): resolve ids → full paths."""
     out = []
     for fid in file_path_ids:
-        row = db.query_one(
-            "SELECT * FROM file_path WHERE id = ?", (fid,))
+        row = db.run("api.file_path.by_id", (fid,))
         if row is None:
             raise FsJobError(f"file_path {fid} not found")
         iso = IsolatedPath.from_db_row(
@@ -106,10 +105,7 @@ def _child_step(db, location_id: int, location_path: str, child_path: str,
         iso = IsolatedPath.new(location_id, location_path, child_path, is_dir)
     except ValueError:
         return None
-    row = db.query_one(
-        "SELECT * FROM file_path WHERE location_id = ? AND "
-        "materialized_path = ? AND name = ? AND extension = ?",
-        iso.db_key())
+    row = db.run("indexer.path_by_key", iso.db_key())
     if row is None:
         return None
     return {

@@ -180,6 +180,7 @@ def identify_chunk(library, location_id: int, location_path: str,
             existing = {}
             for chunk in _in_chunks(cas_list):
                 ph = ",".join("?" for _ in chunk)
+                # binds the declared identifier.cas_links shape
                 for r in conn.execute(
                     f"SELECT fp.cas_id AS cas_id, o.id AS oid, "
                     f"o.pub_id AS opub "
@@ -215,34 +216,33 @@ def identify_chunk(library, location_id: int, location_path: str,
         tp = _mark("ops", tp)
 
         # ---- domain writes: objects + ONE file_path update pass --------
-        conn.executemany(
-            "INSERT INTO object (pub_id, kind, date_created) "
-            "VALUES (?, ?, ?)", new_objects)
+        library.db.run_many("identifier.object_insert", new_objects,
+                            conn=conn)
         created = len(new_objects)
         if new_objects:
             # Consecutive rowids: inside one tx each rowid-table insert
             # gets max(rowid)+1 and we hold the write lock, so the batch
             # occupies [last-n+1, last] in insertion order — no SELECT-
             # back of n rows. One probe guards the assumption.
-            last = conn.execute("SELECT last_insert_rowid()").fetchone()[0]
+            last = library.db.run("store.last_rowid", conn=conn)
             first = last - len(new_objects) + 1
-            probe = conn.execute(
-                "SELECT id FROM object WHERE pub_id = ?",
-                (new_objects[0][0],)).fetchone()
+            probe = library.db.run("identifier.object_by_pub",
+                                   (new_objects[0][0],), conn=conn)
             if probe is not None and probe["id"] == first:
                 for k, (opub, _, _) in enumerate(new_objects):
                     oid_of[opub] = first + k
             else:  # fall back to the slow exact lookup
                 for chunk in _in_chunks([p for p, _, _ in new_objects]):
                     ph = ",".join("?" for _ in chunk)
+                    # binds the declared identifier.objects_by_pubs shape
                     for r in conn.execute(
                         f"SELECT id, pub_id FROM object "
                             f"WHERE pub_id IN ({ph})", chunk):
                         oid_of[r["pub_id"]] = r["id"]
-        conn.executemany(
-            "UPDATE file_path SET cas_id = ?, object_id = ? WHERE id = ?",
+        library.db.run_many(
+            "identifier.link_paths",
             [(cas_id, oid_of[pub_of[i]], rows[i]["id"])
-             for i, cas_id in ids.items()])
+             for i, cas_id in ids.items()], conn=conn)
         tp = _mark("db_write", tp)
 
         # ---- op log: object creates, then ONE multi-field update per
@@ -321,6 +321,7 @@ class FileIdentifierJob(StatefulJob):
         loc = load_location(db, self.location_id)
         sub_mat = sub_path_children_mat(self.location_id, self.sub_path)
         where, params = orphan_filters(self.location_id, 0, sub_mat)
+        # binds the declared identifier.orphan_count shape
         count = db.query_one(
             f"SELECT COUNT(*) AS n FROM file_path WHERE {where}", params)["n"]
         if count == 0:
@@ -361,8 +362,8 @@ class FileIdentifierJob(StatefulJob):
         # will replace its probes — otherwise the per-chunk IN()
         # fallbacks would become full table scans.
         rebuild = count >= self.BULK_DROP_MIN_ORPHANS
-        cas_preload = db.query_one(
-            "SELECT COUNT(*) AS n FROM object")["n"] <= self.CAS_PRELOAD_MAX
+        cas_preload = (await asyncio.to_thread(
+            db.run, "store.object_count"))["n"] <= self.CAS_PRELOAD_MAX
         if rebuild:
             with db.tx() as conn:
                 if cas_preload:
@@ -446,6 +447,7 @@ class FileIdentifierJob(StatefulJob):
         where, params = orphan_filters(
             self.location_id, cursor, data["sub_mat_path"])
         # sqlite3.Row supports ["name"] access directly — no dict() copy.
+        # binds the declared identifier.orphan_page shape
         return ctx.db.query(
             f"SELECT * FROM file_path WHERE {where} ORDER BY id ASC LIMIT ?",
             params + [data.get("chunk_size") or self.chunk_size])
@@ -474,17 +476,13 @@ class FileIdentifierJob(StatefulJob):
             return None if m is False else m  # {} stays engaged
         enabled = data.get("cas_preload")
         if enabled is None:
-            enabled = ctx.db.query_one(
-                "SELECT COUNT(*) AS n FROM object")["n"] \
+            enabled = ctx.db.run("store.object_count")["n"] \
                 <= self.CAS_PRELOAD_MAX
         if not enabled:
             self._cas_map = False
             return None
         m = {}
-        for r in ctx.db.query(
-            "SELECT fp.cas_id AS c, o.id AS oid, o.pub_id AS opub "
-            "FROM file_path fp JOIN object o ON o.id = fp.object_id "
-                "WHERE fp.cas_id IS NOT NULL"):
+        for r in ctx.db.run("identifier.cas_map"):
             m.setdefault(r["c"], (r["oid"], r["opub"]))
         self._cas_map = m
         return m

@@ -60,6 +60,7 @@ class ObjectValidatorJob(StatefulJob):
             db, self.location_id, self.sub_path,
             f"location_id = ? AND is_dir = 0 AND {checksum_filter}",
             [self.location_id])
+        # binds the declared identifier.orphan_count shape
         count = db.query_one(
             f"SELECT COUNT(*) AS n FROM file_path WHERE {where}",
             params)["n"]
@@ -96,6 +97,7 @@ class ObjectValidatorJob(StatefulJob):
         return outcome
 
     def _fetch_rows(self, db, data) -> List[Dict[str, Any]]:
+        # binds the declared validator.page shape
         rows = db.query(
             f"SELECT id, pub_id, materialized_path, name, extension, "
             f"integrity_checksum, size_in_bytes_bytes "
@@ -308,10 +310,10 @@ class ObjectValidatorJob(StatefulJob):
                 "_integrity_events": integrity_events})
 
         with db.tx() as conn:
-            conn.executemany(
-                "UPDATE file_path SET integrity_checksum = ? "
-                "WHERE id = ? AND integrity_checksum IS NULL",
-                [(checksum, r["id"]) for r, _p, checksum in results])
+            db.run_many(
+                "validator.fill_checksum",
+                [(checksum, r["id"]) for r, _p, checksum in results],
+                conn=conn)
             n_ops = sync.bulk_shared_ops(conn, "file_path", [
                 (r["pub_id"], "u:integrity_checksum", "integrity_checksum",
                  checksum, None) for r, _p, checksum in results])

@@ -120,7 +120,7 @@ class NetworkedLibraries:
         """Paired instances persist in the instance table; identities
         recorded at pairing time re-arm routes after restart."""
         me = library.sync.instance
-        for row in library.db.query("SELECT * FROM instance"):
+        for row in library.db.run("sync.instances.rows"):
             if row["pub_id"] == me:
                 continue
             identity = row["identity"]

@@ -45,6 +45,15 @@ engine actually depends on:
   window, or a nowait put on a full block-policy channel, is a
   `chan_overflow` violation — raised in tier-1, counted in
   production while the shed/coalesce policies keep depth bounded.
+- **SQL statement auditor** (round 16, armed via `store/sqlaudit.arm()`
+  at install unless `SDTPU_SQL_AUDIT=off` — the runtime twin of
+  sdlint's sql-discipline / tx-shape / schema-parity passes): every
+  Database connection matches executed statements against the contract
+  registry (store/statements.py). An undeclared statement outside the
+  ad-hoc read allowance is `sql_undeclared`; a write-verb statement
+  outside an open tx() is `sql_autocommit_write` — raised in tier-1,
+  counted into `sd_sql_undeclared_total`/`sd_sanitize_violations_total`
+  in production.
 - **Cross-thread race recorder** (round 13, armed via
   `threadctx.arm()` at install unless `SDTPU_RACE_GUARD=off` — the
   runtime twin of sdlint's shared-mutation / thread-boundary /
@@ -380,6 +389,14 @@ def install() -> bool:
     from . import threadctx
 
     threadctx.arm(_mode, _record, held_tracked_lock_ids)
+    # Arm the store twin: every Database connection created from here
+    # on is contract-audited against store/statements.py — undeclared
+    # statements and autocommit writes flow through _record as
+    # `sql_undeclared` / `sql_autocommit_write`. SDTPU_SQL_AUDIT=off
+    # skips the wrap (sqlaudit checks it — read once, at install).
+    from .store import sqlaudit
+
+    sqlaudit.arm(_mode, _record)
     _installed = True
     return True
 
@@ -404,4 +421,7 @@ def uninstall() -> None:
     from . import threadctx
 
     threadctx.disarm()
+    from .store import sqlaudit
+
+    sqlaudit.disarm()
     _installed = False

@@ -13,6 +13,7 @@ model registry (store/models.py), mirroring core/prisma/schema.prisma.
 
 from __future__ import annotations
 
+import logging
 import os
 import sqlite3
 import threading
@@ -21,13 +22,16 @@ import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from . import models
+from . import models, sqlaudit, statements
 from .. import sanitize, telemetry
 from ..telemetry import (
     STORE_COMMIT_SECONDS,
+    STORE_INIT_WARNINGS,
     STORE_TX,
     STORE_WRITE_LOCK_WAIT_SECONDS,
 )
+
+log = logging.getLogger("spacedrive_tpu.store")
 
 
 def uuid_bytes(u: Optional[uuid.UUID] = None) -> bytes:
@@ -111,15 +115,21 @@ class Database:
             # would cost more than the maintenance saves).
             try:
                 n_inst = conn.execute(
-                    "SELECT COUNT(*) FROM instance").fetchone()[0]
+                    statements.get("store.init.instance_count").sql
+                ).fetchone()[0]
                 if n_inst <= 1:
                     for table, model in models.MODELS.items():
                         for idx in model.lazy_indexes:
                             conn.execute(
                                 f"DROP INDEX IF EXISTS "
                                 f"idx_{table}_{'_'.join(idx)}")
-            except sqlite3.Error:
-                pass
+            except sqlite3.Error as e:
+                # Non-fatal by design (the indexes only cost bulk-write
+                # maintenance) but never silent: a corrupt library
+                # failing this probe must be visible in health.
+                log.debug("lazy-index drop skipped for %s: %s",
+                          self.path, e)
+                STORE_INIT_WARNINGS.inc()
             conn.commit()
 
     def _conn(self) -> sqlite3.Connection:
@@ -130,8 +140,11 @@ class Database:
             # check_same_thread=False so close() can tear down every
             # thread's connection (backup restore swaps the file under
             # us); normal use keeps one conn per thread regardless.
+            # The factory is the SQL auditor's seam: armed processes
+            # get contract-checked connections (store/sqlaudit.py).
             conn = sqlite3.connect(self.path, timeout=30.0,
-                                   check_same_thread=False)
+                                   check_same_thread=False,
+                                   factory=sqlaudit.connection_class())
             conn.row_factory = sqlite3.Row
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA foreign_keys=ON")
@@ -181,12 +194,83 @@ class Database:
                 self._local = threading.local()
 
     # -- reads ------------------------------------------------------------
+    # query/query_one are the AD-HOC diagnostic read surface: raw SQL,
+    # no contract, no write lock. Tests and debugging use them freely
+    # (the runtime auditor's adhoc() allowance); PRODUCT code does not —
+    # sdlint's sql-discipline pass fails the build on raw SQL literals
+    # outside the registry, so engine reads go through run().
 
     def query(self, sql: str, params: Sequence = ()) -> List[sqlite3.Row]:
-        return self._conn().execute(sql, params).fetchall()
+        with sqlaudit.adhoc():
+            return self._conn().execute(sql, params).fetchall()
 
     def query_one(self, sql: str, params: Sequence = ()) -> Optional[sqlite3.Row]:
-        return self._conn().execute(sql, params).fetchone()
+        with sqlaudit.adhoc():
+            return self._conn().execute(sql, params).fetchone()
+
+    # -- declared-statement dispatch ---------------------------------------
+
+    def run(self, name: str, params: Sequence = (), conn=None):
+        """Execute the declared statement `name` (store/statements.py).
+
+        Reads run on the calling thread's connection with NO write
+        lock (WAL readers never block) and return what the declared
+        cardinality promises: `one` → Row|None, `many` → list[Row],
+        `scalar` → first column of the first row (or None). Pass
+        `conn=` (from an open tx()) when the read must see the
+        transaction's own uncommitted writes.
+
+        Writes REQUIRE the open tx() connection (`conn=`) — every
+        write-verb contract is tx_required, run_tx() is the
+        single-statement sugar — and return the cursor (lastrowid /
+        rowcount). ddl/pragma statements serialize on the write lock.
+        """
+        st = statements.get(name)
+        if st.verb == "read":
+            c = conn if conn is not None else self._conn()
+            cur = c.execute(st.sql, params)
+            if st.cardinality == "one":
+                row = cur.fetchone()
+                sqlaudit.note_rows(name, 1 if row is not None else 0)
+                return row
+            if st.cardinality == "scalar":
+                row = cur.fetchone()
+                sqlaudit.note_rows(name, 1 if row is not None else 0)
+                return row[0] if row is not None else None
+            rows = cur.fetchall()
+            sqlaudit.note_rows(name, len(rows))
+            return rows
+        if st.verb == "write":
+            if conn is None:
+                raise statements.SqlContractError(
+                    f"{name}: write statements execute on the open "
+                    "tx() connection — pass conn= (or use run_tx)")
+            return conn.execute(st.sql, params)
+        # ddl / pragma: serialized like the other schema operations
+        with self._write_lock:
+            return self._conn().execute(st.sql, params)
+
+    def run_many(self, name: str, seq: Iterable[Sequence],
+                 conn=None) -> sqlite3.Cursor:
+        """executemany over a declared write statement, on the open
+        tx() connection."""
+        st = statements.get(name)
+        if st.verb != "write":
+            raise statements.SqlContractError(
+                f"{name}: run_many is for write statements")
+        if conn is None:
+            raise statements.SqlContractError(
+                f"{name}: write statements execute on the open tx() "
+                "connection — pass conn= (or use run_tx)")
+        return conn.executemany(st.sql, seq)
+
+    def run_tx(self, name: str, params: Sequence = ()) -> sqlite3.Cursor:
+        """One declared write statement as its own transaction — the
+        single-statement sugar over `with tx() as c: run(..., conn=c)`.
+        Per-item loops should batch under ONE tx() instead (the
+        tx-shape pass flags run_tx inside loops)."""
+        with self.tx() as c:
+            return self.run(name, params, conn=c)
 
     # -- writes -----------------------------------------------------------
 
@@ -213,15 +297,18 @@ class Database:
                     time.perf_counter() - t_wait)
             try:
                 conn.execute("BEGIN IMMEDIATE")
+                sqlaudit.tx_begin(conn)
                 yield conn
                 t_commit = time.perf_counter() if tm else 0.0
                 conn.commit()
+                sqlaudit.tx_end(conn, committed=True)
                 if tm:
                     STORE_COMMIT_SECONDS.observe(
                         time.perf_counter() - t_commit)
                     STORE_TX.inc()
             except BaseException:
                 conn.rollback()
+                sqlaudit.tx_end(conn, committed=False)
                 raise
             self._commits = getattr(self, "_commits", 0) + 1
             if self._commits % self._WAL_CHECK_EVERY == 0:
@@ -232,9 +319,12 @@ class Database:
                 except (OSError, sqlite3.Error):
                     pass
 
-    def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
-        with self.tx() as conn:
-            return conn.execute(sql, params)
+    # NOTE: the old `execute(sql, params)` wrapper is gone. It wrapped
+    # EVERY statement — reads included — in a write transaction (write
+    # lock + BEGIN IMMEDIATE, committing nothing for a SELECT), so
+    # read-verb callers serialized behind bulk writers for no reason.
+    # Reads go through run()/query() (no lock); writes go through
+    # run(conn=)/run_tx()/the typed helpers (all tx-scoped).
 
     def checkpoint(self) -> None:
         """Flush the WAL into the main DB file (for backups). Must NOT run

@@ -210,7 +210,11 @@ register(Model(
         Field("instance_id", "INTEGER", nullable=False,
               references="instance(id)"),
     ),
-    lazy_indexes=(("timestamp",),),  # sync-side reads only, as above
+    # Sync-side reads only, as above. (relation, item_id) narrows the
+    # per-record LWW compares exactly like shared_operation's
+    # (model, record_id) — surfaced by schema-parity's
+    # unindexed-filter over the relation compare statements.
+    lazy_indexes=(("timestamp",), ("relation", "item_id")),
 ))
 
 # --- Instances (schema.prisma:70-97): one row per (device, library). ------
@@ -381,7 +385,9 @@ register(Model(
         Field("date_detected", "INTEGER"),
     ),
     uniques=(("object_a_id", "object_b_id"),),
-    indexes=(("object_a_id",), ("object_b_id",)),
+    # distance serves the search.nearDuplicates threshold filter —
+    # surfaced by sdlint's schema-parity unindexed-filter check.
+    indexes=(("object_a_id",), ("object_b_id",), ("distance",)),
 ))
 
 # --- Tags (@shared; TagOnObject @relation — schema.prisma:331,349). -------

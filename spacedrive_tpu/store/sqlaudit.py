@@ -1,0 +1,292 @@
+"""Runtime SQL auditor — the dynamic twin of sdlint's store passes.
+
+Armed by `sanitize.install()` (flag `SDTPU_SQL_AUDIT`, default follows
+SDTPU_SANITIZE): every Database connection is constructed from
+`connection_class()`, a sqlite3.Connection subclass whose execute/
+executemany match each statement's text against the contract registry
+(store/statements.py) before it runs:
+
+- **Declared** statements count into `sd_sql_statements_total{name}` /
+  `sd_sql_rows_total{name}`; a write-verb statement executing outside
+  an open `tx()` is a `sql_autocommit_write` violation (raised in
+  tier-1, counted in production) — the single-writer discipline has no
+  autocommit write path.
+- **Undeclared** statements count `sd_sql_undeclared_total` and are a
+  `sql_undeclared` violation. Exception: a READ on a thread inside the
+  `adhoc()` allowance counts under the `_adhoc` label instead (never
+  into the undeclared gate metric) — `Database.query`/`query_one`
+  apply that allowance as the sanctioned ad-hoc DIAGNOSTIC read
+  surface (tests, debugging) that the static sql-discipline pass
+  keeps product code off.
+- **DDL / PRAGMA / transaction-control / EXPLAIN** text passes through:
+  schema bootstrap and the WAL machinery are store/db.py's whitelisted
+  engine room (the static pass scopes them the same way).
+
+Per-transaction statement counts land in the `sd_sql_tx_statements`
+histogram at COMMIT (tx() brackets via tx_begin/tx_end) — the N+1 /
+commit-per-item shapes the tx-shape pass hunts statically show up here
+as a left-shifted histogram.
+
+Opt-in EXPLAIN sampling (`SDTPU_SQL_EXPLAIN=N`, 0=off): every Nth
+execution of a declared read over a registered large table runs
+`EXPLAIN QUERY PLAN`; a full-table SCAN of a large table counts into
+`sd_sql_scan_total{name}` — index regressions surface without tracing.
+
+Disabled cost: `connection_class()` returns the plain
+sqlite3.Connection and every hook is one `if not _armed` check.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .. import flags
+from ..telemetry import (
+    SQL_ROWS,
+    SQL_SCAN,
+    SQL_STATEMENTS,
+    SQL_TX_STATEMENTS,
+    SQL_UNDECLARED,
+)
+from . import statements
+
+__all__ = [
+    "arm", "disarm", "armed", "connection_class", "adhoc",
+    "tx_begin", "tx_end", "note_rows", "executed_names",
+]
+
+_armed = False
+_record: Optional[Callable[[str, str, bool], None]] = None
+_explain_every = 0
+_tls = threading.local()
+
+# Names observed executing since process start — the static↔runtime
+# drift surfaces read it. Bounded by the declared-statement namespace
+# (only registry names are ever inserted).
+_executed: Dict[str, int] = {}  # sdlint: ok[unbounded-growth]
+_executed_lock = threading.Lock()
+
+# Leading keywords that bypass contract matching entirely: transaction
+# control (tx() itself), schema/DDL bootstrap, PRAGMAs, and the
+# auditor's own EXPLAIN probes.
+_PASS_HEADS = frozenset({
+    "BEGIN", "COMMIT", "ROLLBACK", "SAVEPOINT", "RELEASE",
+    "CREATE", "DROP", "ALTER", "ANALYZE", "VACUUM", "REINDEX",
+    "ATTACH", "DETACH", "PRAGMA", "EXPLAIN",
+})
+
+_WRITE_HEADS = frozenset({"INSERT", "UPDATE", "DELETE", "REPLACE"})
+
+
+def armed() -> bool:
+    return _armed
+
+
+def arm(mode: str, record: Callable[[str, str, bool], None]) -> None:
+    """Called by sanitize.install(). `record(kind, detail, may_raise)`
+    is the sanitizer's violation hook — the raise/count split lives
+    there. SDTPU_SQL_AUDIT=off skips arming (zero overhead); `auto`
+    follows the sanitizer. Read once, at install."""
+    global _armed, _record, _explain_every
+    del mode  # raise/count is the record callback's concern
+    level = flags.get("SDTPU_SQL_AUDIT")
+    if level == "off":
+        return
+    _record = record
+    _explain_every = max(0, int(flags.get("SDTPU_SQL_EXPLAIN")))
+    _armed = True
+
+
+def disarm() -> None:
+    global _armed, _record
+    _armed = False
+    _record = None
+
+
+def executed_names() -> Dict[str, int]:
+    """Declared-statement execution counts since process start."""
+    with _executed_lock:
+        return dict(_executed)
+
+
+class adhoc:
+    """Thread-local allowance for ad-hoc diagnostic READS (Database.
+    query/query_one, tests poking at a library). Writes are never
+    excused — there is no ad-hoc write path."""
+
+    def __enter__(self):
+        _tls.adhoc = getattr(_tls, "adhoc", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.adhoc -= 1
+        return False
+
+
+def _in_adhoc() -> bool:
+    return getattr(_tls, "adhoc", 0) > 0
+
+
+def tx_begin(conn: sqlite3.Connection) -> None:
+    """Bracket from Database.tx() right after BEGIN IMMEDIATE."""
+    if not _armed:
+        return
+    try:
+        conn._sd_in_tx = True
+        conn._sd_tx_stmts = 0
+    except AttributeError:  # plain sqlite3.Connection (pre-arm conn)
+        pass
+
+
+def tx_end(conn: sqlite3.Connection, committed: bool) -> None:
+    if not _armed:
+        return
+    n = getattr(conn, "_sd_tx_stmts", None)
+    try:
+        conn._sd_in_tx = False
+        conn._sd_tx_stmts = 0
+    except AttributeError:
+        return
+    if committed and n:
+        SQL_TX_STATEMENTS.observe(n)
+
+
+def note_rows(name: str, n: int) -> None:
+    """Fetched-row accounting for the read path (cursor rowcount is -1
+    for SELECTs; Database.run counts what it actually fetched)."""
+    if _armed and n:
+        SQL_ROWS.labels(name=name).inc(n)
+
+
+def _note_executed(name: str) -> None:
+    with _executed_lock:
+        _executed[name] = _executed.get(name, 0) + 1
+
+
+def _violation(kind: str, detail: str) -> None:
+    rec = _record
+    if rec is not None:
+        rec(kind, detail, True)
+
+
+def _maybe_explain(conn: "AuditedConnection", st, sql: str,
+                   params) -> None:
+    count = _executed.get(st.name, 0)
+    if count % _explain_every != 1 and _explain_every != 1:
+        return
+    try:
+        plan = sqlite3.Connection.execute(
+            conn, "EXPLAIN QUERY PLAN " + sql, params).fetchall()
+    except sqlite3.Error:
+        return
+    for row in plan:
+        detail = row["detail"] if "detail" in row.keys() else str(row)
+        if not detail.startswith("SCAN"):
+            continue
+        if "USING" in detail:  # covering/index scan — fine
+            continue
+        # "SCAN file_path" (3.36+) / "SCAN TABLE file_path" (older)
+        parts = [p for p in detail.split() if p != "TABLE"]
+        table = parts[1] if len(parts) > 1 else ""
+        if table in statements.LARGE_TABLES:
+            SQL_SCAN.labels(name=st.name).inc()
+
+
+def _observe(conn: "AuditedConnection", sql: str, params: Any,
+             many: bool) -> Optional[Any]:
+    """Pre-execute contract check; returns the matched Stmt (or None
+    for pass-through text) so the caller can post rowcounts."""
+    head = sql.lstrip().split(" ", 1)[0].split("\n", 1)[0].upper()
+    if head in _PASS_HEADS:
+        return None
+    st = statements.lookup_sql(sql)
+    in_tx = getattr(conn, "_sd_in_tx", False)
+    if in_tx:
+        conn._sd_tx_stmts = getattr(conn, "_sd_tx_stmts", 0) + 1
+    if st is None:
+        if _in_adhoc() and head not in _WRITE_HEADS:
+            # sanctioned diagnostic read — counted under _adhoc, never
+            # into the undeclared gate metric
+            SQL_STATEMENTS.labels(name="_adhoc").inc()
+            return None
+        SQL_UNDECLARED.inc()
+        _violation(
+            "sql_undeclared",
+            f"undeclared SQL reached the store: "
+            f"{statements.normalize_sql(sql)[:200]!r} — declare it in "
+            "spacedrive_tpu/store/statements.py (or use the typed "
+            "helpers; ad-hoc diagnostic reads go through db.query)")
+        return None
+    SQL_STATEMENTS.labels(name=st.name).inc()
+    _note_executed(st.name)
+    if st.verb == "write" and not in_tx:
+        _violation(
+            "sql_autocommit_write",
+            f"write statement {st.name!r} executed outside an open "
+            "tx() — every write must ride a write transaction "
+            "(db.run(..., conn=) from tx(), or db.run_tx)")
+    if (_explain_every and not many and st.verb == "read" and st.large
+            and isinstance(params, (tuple, list))):
+        _maybe_explain(conn, st, sql, params)
+    return st
+
+
+class AuditedConnection(sqlite3.Connection):
+    """sqlite3.Connection with the contract check on every execute.
+    cursor()/fetch behavior is untouched; executescript is DDL-only in
+    this codebase and passes through head-classification anyway."""
+
+    def execute(self, sql: str, params=()):  # type: ignore[override]
+        st = None
+        if _armed:
+            st = _observe(self, sql, params, many=False)
+        cur = super().execute(sql, params)
+        if st is not None and st.verb == "write" and cur.rowcount > 0:
+            SQL_ROWS.labels(name=st.name).inc(cur.rowcount)
+        return cur
+
+    def executemany(self, sql: str, seq):  # type: ignore[override]
+        st = None
+        if _armed:
+            st = _observe(self, sql, seq, many=True)
+        cur = super().executemany(sql, seq)
+        if st is not None and st.verb == "write" and cur.rowcount > 0:
+            SQL_ROWS.labels(name=st.name).inc(cur.rowcount)
+        return cur
+
+
+def connection_class() -> type:
+    """The sqlite3 factory Database._conn uses: audited when armed,
+    the plain connection otherwise (zero overhead)."""
+    return AuditedConnection if _armed else sqlite3.Connection
+
+
+def stage_summary(top: int = 10) -> Dict[str, Any]:
+    """The benches' `sql` artifact stage: top statements by count and
+    by rows plus the per-tx statement histogram — an N+1 regression
+    reads as a new hot single-row statement and a left-shifted
+    histogram, gated in BENCH artifacts instead of found in prod."""
+    from .. import telemetry
+
+    snap = telemetry.snapshot()
+
+    def _children(family: str) -> Dict[str, float]:
+        fam = snap.get(family) or {}
+        return {c["labels"]["name"]: c["value"]
+                for c in fam.get("labeled", [])}
+
+    counts = _children("sd_sql_statements_total")
+    rows = _children("sd_sql_rows_total")
+    hist = snap.get("sd_sql_tx_statements") or {}
+    return {
+        "top_by_count": sorted(counts.items(),
+                               key=lambda kv: -kv[1])[:top],
+        "top_by_rows": sorted(rows.items(),
+                              key=lambda kv: -kv[1])[:top],
+        "undeclared_total": (snap.get("sd_sql_undeclared_total")
+                             or {}).get("value", 0),
+        "tx_statements": {k: hist.get(k) for k in
+                          ("count", "sum", "buckets") if k in hist},
+    }

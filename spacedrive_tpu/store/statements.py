@@ -1,0 +1,1488 @@
+"""Central SQL statement contract registry — the store's machine-checked seam.
+
+Every SQL statement the engine executes is DECLARED here — name, exact
+SQL text, verb (read|write|ddl|pragma), touched tables, transaction
+requirement, and result cardinality — and executed through
+`Database.run(name, params)` / `run_many` / `run_tx` (store/db.py) or
+the typed helpers. The reference gets this discipline from its
+generated Prisma client (every query is a typed method); scattered
+`execute("...")` literals gave us none of it: no inventory of reads vs
+writes, no machine check that a write is tx-scoped, no seam to split
+when ROADMAP item 4 moves writes onto a single-writer actor and reads
+onto a connection pool. This registry IS that seam: `--sql-table`
+renders it, sdlint's sql-discipline/tx-shape/schema-parity passes check
+it statically, and store/sqlaudit.py enforces it at runtime.
+
+Two declaration forms:
+
+- `declare_stmt(name, sql, ...)` — an exact statement. The SQL is the
+  single source of truth; call sites hold only the name.
+- `declare_shape(name, skeleton, ...)` — a TEMPLATE for the small set
+  of legitimately dynamic sites: the typed helpers (column lists vary
+  per row dict), the sync engine's registry-generic apply code
+  (table/column names come from store/models.py, guarded by
+  `model.field()` before reaching SQL), and composable search filters.
+  `{i}` slots match one SQL identifier which must exist in the model
+  registry (tables ∪ columns — validated at runtime by the auditor);
+  `{w}` slots match an arbitrary clause (dynamic WHERE/placeholder
+  lists). sdlint matches f-string call sites against skeletons
+  statically, the auditor matches the rendered SQL against the
+  compiled pattern at runtime.
+
+Write discipline: every write-verb declaration is tx_required — there
+is no autocommit write path. `Database.run` demands the open `tx()`
+connection for them; `run_tx` is the single-statement-transaction
+sugar. The tier-1 registry test asserts this invariant holds for the
+whole inventory (the acceptance gate for the item-4 actor split).
+
+Design constraints (same as flags.py/models.py): stdlib + models only,
+importable from every layer without cycles.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import models
+
+__all__ = [
+    "Stmt", "STATEMENTS", "SHAPES", "declare_stmt", "declare_shape",
+    "get", "lookup_sql", "normalize_sql", "skeleton_of",
+    "sql_table_markdown", "SqlContractError", "LARGE_TABLES",
+    "VERBS", "CARDINALITIES",
+]
+
+VERBS = ("read", "write", "ddl", "pragma")
+# read → what run() fetches; write/ddl/pragma carry "none" (cursor out).
+CARDINALITIES = ("one", "many", "scalar", "none")
+
+# Same dotted-name discipline as the timeout/channel registries.
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+# Tables whose scans hurt at production scale: the EXPLAIN-sampling
+# auditor mode (SDTPU_SQL_EXPLAIN) flags full-table scans on these into
+# sd_sql_scan_total, and schema-parity warns on filters over their
+# unindexed columns.
+LARGE_TABLES = frozenset({
+    "file_path", "object", "shared_operation", "shared_op_blob",
+    "relation_operation", "media_data", "near_dup_pair", "job_scratch",
+})
+
+# Tables that exist without a model registration (SQLite internals).
+_EXTERNAL_TABLES = frozenset({"sqlite_master"})
+
+_WS_RE = re.compile(r"\s+")
+
+
+def normalize_sql(sql: str) -> str:
+    """Whitespace-collapsed statement text — the audit-match identity.
+    SQL literals wrap across source lines freely; semantics don't."""
+    return _WS_RE.sub(" ", sql).strip().rstrip(";").strip()
+
+
+_VERB_KEYWORDS = {
+    "SELECT": "read", "WITH": "read",
+    "INSERT": "write", "UPDATE": "write", "DELETE": "write",
+    "REPLACE": "write",
+    "CREATE": "ddl", "DROP": "ddl", "ALTER": "ddl",
+    "PRAGMA": "pragma",
+}
+
+
+def sql_verb_keyword(sql: str) -> Optional[str]:
+    """The verb a statement's leading keyword implies, or None."""
+    head = normalize_sql(sql).split(" ", 1)[0].upper()
+    return _VERB_KEYWORDS.get(head)
+
+
+class SqlContractError(RuntimeError):
+    """A statement-contract violation at declare or dispatch time."""
+
+
+@dataclass(frozen=True)
+class Stmt:
+    name: str
+    sql: str                   # exact SQL, or the skeleton for shapes
+    verb: str                  # read | write | ddl | pragma
+    tables: Tuple[str, ...]
+    tx_required: bool
+    cardinality: str           # one | many | scalar | none
+    coverage: str              # "tier1" | "tools"
+    doc: str = ""
+    shape: bool = False        # declared via declare_shape
+
+    @property
+    def large(self) -> bool:
+        return bool(set(self.tables) & LARGE_TABLES)
+
+
+STATEMENTS: Dict[str, Stmt] = {}  # sdlint: ok[unbounded-growth] import-time contract registry
+SHAPES: Dict[str, Stmt] = {}  # sdlint: ok[unbounded-growth] import-time contract registry
+_BY_SQL: Dict[str, str] = {}  # sdlint: ok[unbounded-growth] one entry per declared statement
+# skeleton (normalized, slots erased to {}) → shape name, for the
+# static pass's f-string matching; compiled regexes for the auditor.
+_SHAPE_SKELETONS: Dict[str, str] = {}  # sdlint: ok[unbounded-growth] import-time contract registry
+_SHAPE_PATTERNS: List[Tuple[re.Pattern, str]] = []  # sdlint: ok[unbounded-growth] import-time contract registry
+
+_IDENT_RE = r"[A-Za-z_][A-Za-z0-9_]*"
+
+
+def _registry_identifiers() -> frozenset:
+    """Every table and column name the model registry knows — the set
+    dynamic `{i}` slots are allowed to interpolate."""
+    out = set(models.MODELS) | set(_EXTERNAL_TABLES)
+    for m in models.MODELS.values():
+        out.update(f.name for f in m.fields)
+    return frozenset(out)
+
+
+_REGISTRY_IDENTS = _registry_identifiers()
+
+
+def _validate_common(name: str, verb: str, tables, tx_required: bool,
+                     cardinality: Optional[str], coverage: str) -> str:
+    if not NAME_RE.match(name):
+        raise SqlContractError(
+            f"statement name {name!r} must be dotted lower_snake "
+            "(layer.what), like the timeout/channel registries")
+    if name in STATEMENTS or name in SHAPES:
+        raise SqlContractError(f"statement {name!r} declared twice")
+    if verb not in VERBS:
+        raise SqlContractError(f"{name}: verb {verb!r} not in {VERBS}")
+    if coverage not in ("tier1", "tools"):
+        raise SqlContractError(
+            f"{name}: coverage {coverage!r} must be tier1|tools")
+    for t in tables:
+        if t not in models.MODELS and t not in _EXTERNAL_TABLES:
+            raise SqlContractError(
+                f"{name}: table {t!r} is not in the model registry")
+    if verb == "read":
+        if cardinality not in ("one", "many", "scalar"):
+            raise SqlContractError(
+                f"{name}: read statements need cardinality one|many|"
+                f"scalar, got {cardinality!r}")
+    else:
+        if cardinality not in (None, "none"):
+            raise SqlContractError(
+                f"{name}: {verb} statements carry no cardinality")
+        cardinality = "none"
+    if verb == "write" and not tx_required:
+        # THE invariant: no autocommit write path exists. Item 4's
+        # group-commit actor splits along exactly this property.
+        raise SqlContractError(
+            f"{name}: write statements must declare tx_required=True")
+    return cardinality
+
+
+def declare_stmt(name: str, sql: str, *, verb: str,
+                 tables: Tuple[str, ...] = (),
+                 tx_required: bool = False,
+                 cardinality: Optional[str] = None,
+                 coverage: str = "tier1",
+                 doc: str = "") -> str:
+    """Declare one exact statement; returns the name (import-friendly).
+
+    Validated here, once, at import: name discipline, verb/leading-
+    keyword agreement, registry-known tables, write⇒tx_required,
+    read⇒cardinality. The sdlint schema-parity pass re-checks
+    tables/columns against store/models.py from the AST side."""
+    cardinality = _validate_common(
+        name, verb, tables, tx_required, cardinality, coverage)
+    norm = normalize_sql(sql)
+    kw_verb = sql_verb_keyword(norm)
+    if kw_verb is not None and kw_verb != verb:
+        raise SqlContractError(
+            f"{name}: SQL leads with a {kw_verb} keyword but declares "
+            f"verb={verb}")
+    if norm in _BY_SQL:
+        raise SqlContractError(
+            f"{name}: SQL text already declared as {_BY_SQL[norm]!r} — "
+            "reuse that name (audit matching must be unambiguous)")
+    st = Stmt(name, norm, verb, tuple(tables), tx_required,
+              cardinality, coverage, doc)
+    STATEMENTS[name] = st
+    _BY_SQL[norm] = name
+    return name
+
+
+def skeleton_of(skeleton: str) -> str:
+    """Normalized skeleton with `{i}`/`{w}` slots erased to bare `{}` —
+    what an f-string call site reduces to in the static pass."""
+    return normalize_sql(skeleton).replace("{i}", "{}").replace(
+        "{w}", "{}")
+
+
+def declare_shape(name: str, skeleton: str, *, verb: str,
+                  tables: Tuple[str, ...] = (),
+                  tx_required: bool = False,
+                  cardinality: Optional[str] = None,
+                  coverage: str = "tier1",
+                  doc: str = "") -> str:
+    """Declare a statement TEMPLATE for a legitimately dynamic site.
+
+    `{i}` = one identifier that must be a registry table/column name
+    (checked per match at runtime); `{w}` = an arbitrary clause. The
+    constant parts are exact. A shape is deliberately coarser than an
+    exact statement — keep them few, and keep tables declared where
+    they are fixed."""
+    cardinality = _validate_common(
+        name, verb, tables, tx_required, cardinality, coverage)
+    norm = normalize_sql(skeleton)
+    skel = skeleton_of(skeleton)
+    if skel in _SHAPE_SKELETONS:
+        raise SqlContractError(
+            f"{name}: skeleton already declared as "
+            f"{_SHAPE_SKELETONS[skel]!r}")
+    parts: List[str] = []
+    for tok in re.split(r"(\{i\}|\{w\})", norm):
+        if tok == "{i}":
+            parts.append(f"({_IDENT_RE})")
+        elif tok == "{w}":
+            parts.append(r"(?:.*?)")
+        else:
+            parts.append(re.escape(tok))
+    pattern = re.compile("^" + "".join(parts) + "$", re.DOTALL)
+    st = Stmt(name, norm, verb, tuple(tables), tx_required,
+              cardinality, coverage, doc, shape=True)
+    SHAPES[name] = st
+    _SHAPE_SKELETONS[skel] = name
+    _SHAPE_PATTERNS.append((pattern, name))
+    return name
+
+
+def get(name: str) -> Stmt:
+    st = STATEMENTS.get(name)
+    if st is None:
+        raise SqlContractError(
+            f"undeclared statement {name!r} (declare it in "
+            "spacedrive_tpu/store/statements.py)")
+    return st
+
+
+# Shape matching memo: rendered dynamic SQL repeats heavily (one shape
+# per table/column combination), so match once per distinct text.
+# Capped — pathological param-churn trades match work for memory.
+_MATCH_CAP = 4096
+# capped by the len() guard in lookup_sql — never grows past _MATCH_CAP
+_match_memo: Dict[str, Optional[str]] = {}  # sdlint: ok[unbounded-growth]
+
+
+def lookup_sql(sql: str) -> Optional[Stmt]:
+    """Contract for an executed statement's text: exact declarations
+    first, then shape templates (with `{i}` captures validated against
+    the model registry). None = undeclared."""
+    norm = normalize_sql(sql)
+    name = _BY_SQL.get(norm)
+    if name is not None:
+        return STATEMENTS[name]
+    if norm in _match_memo:
+        hit = _match_memo[norm]
+        return SHAPES[hit] if hit is not None else None
+    hit = None
+    for pattern, shape_name in _SHAPE_PATTERNS:
+        m = pattern.match(norm)
+        if m is None:
+            continue
+        if all(g in _REGISTRY_IDENTS for g in m.groups()):
+            hit = shape_name
+            break
+    if len(_match_memo) < _MATCH_CAP:
+        _match_memo[norm] = hit
+    return SHAPES[hit] if hit is not None else None
+
+
+def shape_for_skeleton(skel: str) -> Optional[str]:
+    """Shape name whose skeleton equals `skel` (already slot-erased,
+    normalized) — the static pass's f-string lookup."""
+    return _SHAPE_SKELETONS.get(skel)
+
+
+def all_statements() -> List[Stmt]:
+    """Exact statements then shapes, name-ordered — the inventory."""
+    return ([STATEMENTS[n] for n in sorted(STATEMENTS)]
+            + [SHAPES[n] for n in sorted(SHAPES)])
+
+
+def sql_table_markdown() -> str:
+    """README's generated statement table (`--sql-table`): the
+    complete read/write seam, one row per declared statement/shape."""
+    out = ["| Statement | Verb | Tables | Tx | Cardinality | Coverage |",
+           "| --- | --- | --- | --- | --- | --- |"]
+    for st in all_statements():
+        name = f"`{st.name}`" + (" (shape)" if st.shape else "")
+        tables = ", ".join(st.tables) if st.tables else "—"
+        tx = "tx" if st.tx_required else "—"
+        out.append(
+            f"| {name} | {st.verb} | {tables} | {tx} | "
+            f"{st.cardinality} | {st.coverage} |")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# THE statement namespace. Grouped by layer; every entry is enforced by
+# the sdlint sql-discipline pass (undeclared literals fail the build)
+# and by the runtime auditor (store/sqlaudit.py) in tier-1.
+# ---------------------------------------------------------------------------
+
+# -- store: Database internals (store/db.py) --------------------------------
+
+declare_stmt(
+    "store.init.instance_count",
+    "SELECT COUNT(*) FROM instance",
+    verb="read", tables=("instance",), cardinality="scalar",
+    doc="Library-open probe: ≤1 instance row = never synced, so the "
+        "lazy op-log indexes may drop (db.py __init__).")
+
+# -- store: typed-helper shapes (store/db.py insert/update/...) -------------
+# The helpers build SQL from the caller's row dict; the SHAPE is fixed,
+# the column list varies. All writes, all tx-scoped (the helpers open
+# tx() themselves or ride the caller's conn).
+
+declare_shape(
+    "store.helper.insert",
+    "INSERT INTO {i} ({w}) VALUES ({w})",
+    verb="write", tx_required=True,
+    doc="Database.insert / insert_many (no-conflict form).")
+
+declare_shape(
+    "store.helper.insert_ignore",
+    "INSERT OR IGNORE INTO {i} ({w}) VALUES ({w})",
+    verb="write", tx_required=True,
+    doc="Database.insert_many(ignore_conflicts=True) and the sync "
+        "apply engine's seed-row inserts.")
+
+declare_shape(
+    "store.helper.update",
+    "UPDATE {i} SET {w} WHERE {i} = ?",
+    verb="write", tx_required=True,
+    doc="Database.update (SET list from the values dict) and the "
+        "sync apply engine's registry-derived single-column writes "
+        "(field apply, FK-subselect resolution, cascade detach).")
+
+declare_shape(
+    "store.helper.upsert",
+    "INSERT INTO {i} ({w}) VALUES ({w}) ON CONFLICT ({w}) "
+    "DO UPDATE SET {w}",
+    verb="write", tx_required=True,
+    doc="Database.upsert.")
+
+declare_shape(
+    "store.helper.delete",
+    "DELETE FROM {i} WHERE {i} = ?",
+    verb="write", tx_required=True,
+    doc="Database.delete and registry-derived single-key deletes "
+        "(sync cascade, blob explode, quarantine drain).")
+
+# -- sync: op factory / write path (sync/manager.py) ------------------------
+
+declare_stmt(
+    "sync.instances.all",
+    "SELECT id, pub_id, timestamp FROM instance",
+    verb="read", tables=("instance",), cardinality="many",
+    doc="Instance-cache load at SyncManager init (ids, watermarks).")
+
+declare_stmt(
+    "sync.instances.id_by_pub",
+    "SELECT id FROM instance WHERE pub_id = ?",
+    verb="read", tables=("instance",), cardinality="one",
+    doc="pub_id → local row id (cached in _instance_ids after one "
+        "miss).")
+
+declare_stmt(
+    "sync.instances.set_watermark",
+    "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
+    verb="write", tables=("instance",), tx_required=True,
+    doc="Advance one instance's CRDT watermark, in the ingest tx.")
+
+declare_stmt(
+    "sync.oplog.insert_shared",
+    "INSERT INTO shared_operation "
+    "(timestamp, model, record_id, kind, data, instance_id) "
+    "VALUES (?, ?, ?, ?, ?, ?)",
+    verb="write", tables=("shared_operation",), tx_required=True,
+    doc="Append shared-model op rows (single + executemany bulk; "
+        "also the blob-explode target).")
+
+declare_stmt(
+    "sync.oplog.insert_relation",
+    "INSERT INTO relation_operation "
+    "(timestamp, relation, item_id, group_id, kind, data, instance_id) "
+    "VALUES (?, ?, ?, ?, ?, ?, ?)",
+    verb="write", tables=("relation_operation",), tx_required=True,
+    doc="Append relation op rows.")
+
+declare_stmt(
+    "sync.blob.insert",
+    "INSERT INTO shared_op_blob "
+    "(model, min_ts, max_ts, n_ops, data, instance_id) "
+    "VALUES (?, ?, ?, ?, ?, ?)",
+    verb="write", tables=("shared_op_blob",), tx_required=True,
+    doc="One page-level op blob per solo bulk chunk "
+        "(bulk_shared_ops fast path).")
+
+declare_stmt(
+    "sync.oplog.max_ts_shared",
+    "SELECT MAX(timestamp) AS t FROM shared_operation",
+    verb="read", tables=("shared_operation",), cardinality="one",
+    doc="Lazy _op_log_state init: highest logged shared-op stamp.")
+
+declare_stmt(
+    "sync.oplog.max_ts_relation",
+    "SELECT MAX(timestamp) AS t FROM relation_operation",
+    verb="read", tables=("relation_operation",), cardinality="one",
+    doc="Lazy _op_log_state init: highest logged relation-op stamp.")
+
+declare_stmt(
+    "sync.oplog.max_ts_blob",
+    "SELECT MAX(max_ts) AS t FROM shared_op_blob",
+    verb="read", tables=("shared_op_blob",), cardinality="one",
+    doc="Lazy _op_log_state init: highest blob-page stamp.")
+
+declare_stmt(  # sdlint: ok[schema-parity] one-shot lazy probe, LIMIT 1, cached in _op_log_state
+    "sync.oplog.has_tombstones",
+    "SELECT 1 FROM shared_operation WHERE kind = 'd' LIMIT 1",
+    verb="read", tables=("shared_operation",), cardinality="one",
+    doc="Clone fast-path eligibility probe: any shared delete logged?")
+
+# -- sync: read path / clone serving ----------------------------------------
+
+declare_shape(
+    "sync.oplog.page",
+    "SELECT o.*, i.pub_id AS instance_pub_id FROM {i} o "
+    "JOIN instance i ON i.id = o.instance_id WHERE {w} "
+    "ORDER BY o.timestamp ASC LIMIT ?",
+    verb="read", tables=("instance",), cardinality="many",
+    doc="get_ops page over shared_operation/relation_operation with "
+        "the per-instance watermark disjunction.")
+
+declare_shape(
+    "sync.oplog.window",
+    "SELECT o.*, ? AS instance_pub_id FROM {i} o "
+    "WHERE o.instance_id = ? AND o.timestamp > ? AND o.timestamp < ? "
+    "ORDER BY o.timestamp LIMIT ?",
+    verb="read", cardinality="many",
+    doc="Clone-stream row-op window for one authoring instance "
+        "(ops interleaved ahead of each verbatim blob page).")
+
+declare_shape(
+    "sync.blob.metas_watermarked",
+    "SELECT b.id, b.model, b.min_ts, i.pub_id AS pub "
+    "FROM shared_op_blob b JOIN instance i ON i.id = b.instance_id "
+    "WHERE {w} ORDER BY b.min_ts",
+    verb="read", tables=("shared_op_blob", "instance"),
+    cardinality="many",
+    doc="get_ops blob metadata filtered by the watermark disjunction.")
+
+declare_stmt(
+    "sync.blob.data_by_id",
+    "SELECT data FROM shared_op_blob WHERE id = ?",
+    verb="read", tables=("shared_op_blob",), cardinality="one",
+    doc="Lazy per-page blob fetch (get_ops decode, clone stream).")
+
+declare_stmt(
+    "sync.clone.blob_metas",
+    "SELECT b.id, b.model, b.min_ts, b.max_ts, b.n_ops, b.instance_id, "
+    "i.pub_id AS pub FROM shared_op_blob b "
+    "JOIN instance i ON i.id = b.instance_id ORDER BY b.min_ts",
+    verb="read", tables=("shared_op_blob", "instance"),
+    cardinality="many",
+    doc="Clone-stream originator: every stored page in min_ts order.")
+
+declare_stmt(
+    "sync.blob.metas_batch",
+    "SELECT id, model, instance_id, data FROM shared_op_blob "
+    "ORDER BY min_ts LIMIT 16",
+    verb="read", tables=("shared_op_blob",), cardinality="many",
+    doc="_ensure_row_oplog explode batches (small txs, bounded lock "
+        "hold).")
+
+declare_stmt(
+    "sync.blob.metas_sweep",
+    "SELECT id, model, instance_id, data FROM shared_op_blob "
+    "ORDER BY min_ts",
+    verb="read", tables=("shared_op_blob",), cardinality="many",
+    doc="Ingest straggler sweep under the write lock (late solo-era "
+        "blob landing between explode and the ingest tx).")
+
+declare_stmt(
+    "sync.blob.delete",
+    "DELETE FROM shared_op_blob WHERE id = ?",
+    verb="write", tables=("shared_op_blob",), tx_required=True,
+    doc="Blob-row delete after its ops explode to rows (atomic with "
+        "the inserts).")
+
+# -- sync: ingest / LWW compare ---------------------------------------------
+
+declare_stmt(
+    "sync.quarantine.insert",
+    "INSERT OR IGNORE INTO quarantined_op (op_id, timestamp, data) "
+    "VALUES (?, ?, ?)",
+    verb="write", tables=("quarantined_op",), tx_required=True,
+    doc="Park a permanently-inapplicable op (version skew) instead of "
+        "freezing the watermark.")
+
+declare_stmt(
+    "sync.quarantine.all",
+    "SELECT id, data FROM quarantined_op ORDER BY timestamp",
+    verb="read", tables=("quarantined_op",), cardinality="many",
+    doc="drain_quarantined_ops re-ingest scan at manager init.")
+
+declare_stmt(
+    "sync.quarantine.delete",
+    "DELETE FROM quarantined_op WHERE id = ?",
+    verb="write", tables=("quarantined_op",), tx_required=True,
+    doc="Drop a quarantined op once it finally applied.")
+
+declare_stmt(
+    "sync.lww.shared_tombstone",
+    "SELECT 1 FROM shared_operation WHERE model = ? "
+    "AND record_id = ? AND kind = 'd' LIMIT 1",
+    verb="read", tables=("shared_operation",), cardinality="one",
+    doc="Remove-wins probe: is this record tombstoned?")
+
+declare_stmt(
+    "sync.lww.shared_update_coverage",
+    "SELECT DISTINCT kind FROM shared_operation "
+    "WHERE model = ? AND record_id = ? AND timestamp >= ? "
+    "AND kind LIKE 'u:%'",
+    verb="read", tables=("shared_operation",), cardinality="many",
+    doc="Field-coverage LWW for update kinds (same-or-newer).")
+
+declare_stmt(
+    "sync.lww.superseding_updates",
+    "SELECT DISTINCT kind FROM shared_operation WHERE model = ? "
+    "AND record_id = ? AND timestamp > ? AND kind LIKE 'u:%'",
+    verb="read", tables=("shared_operation",), cardinality="many",
+    doc="Create-op apply: strictly-newer per-field updates the "
+        "batched values must not clobber.")
+
+declare_stmt(
+    "sync.lww.shared_same_kind",
+    "SELECT timestamp FROM shared_operation WHERE timestamp >= ? "
+    "AND model = ? AND record_id = ? AND kind = ? "
+    "ORDER BY timestamp DESC LIMIT 1",
+    verb="read", tables=("shared_operation",), cardinality="one",
+    doc="Exact-kind LWW compare (creates/deletes).")
+
+declare_stmt(
+    "sync.lww.relation_delete_check",
+    "SELECT 1 FROM relation_operation WHERE relation = ? "
+    "AND item_id = ? AND group_id = ? AND "
+    "((kind = 'd' AND timestamp >= ?) OR "
+    " (kind = 'c' AND timestamp > ?)) LIMIT 1",
+    verb="read", tables=("relation_operation",), cardinality="one",
+    doc="Relation delete staleness (newer delete, or reviving "
+        "create).")
+
+declare_stmt(
+    "sync.lww.relation_nondelete_check",
+    "SELECT 1 FROM relation_operation WHERE relation = ? "
+    "AND item_id = ? AND group_id = ? AND timestamp >= ? "
+    "AND kind IN (?, 'd') LIMIT 1",
+    verb="read", tables=("relation_operation",), cardinality="one",
+    doc="Relation create/update staleness (same-kind or delete).")
+
+declare_stmt(
+    "sync.lww.relation_superseding",
+    "SELECT 1 FROM relation_operation WHERE relation = ? AND "
+    "item_id = ? AND group_id = ? AND kind = ? AND timestamp > ? "
+    "LIMIT 1",
+    verb="read", tables=("relation_operation",), cardinality="one",
+    doc="Relation-create field supersession probe.")
+
+declare_stmt(
+    "sync.pending.park",
+    "INSERT INTO pending_relation_op "
+    "(op_id, timestamp, data, item_model, item_key, "
+    "group_model, group_key) "
+    "SELECT ?, ?, ?, ?, ?, ?, ? WHERE NOT EXISTS "
+    "(SELECT 1 FROM pending_relation_op WHERE op_id = ?)",
+    verb="write", tables=("pending_relation_op",), tx_required=True,
+    doc="Park an early relation op, op_id-deduped against "
+        "redelivery.")
+
+declare_stmt(
+    "sync.pending.any",
+    "SELECT 1 FROM pending_relation_op LIMIT 1",
+    verb="read", tables=("pending_relation_op",), cardinality="one",
+    doc="Fast-apply parity probe: any parked ops to drain after "
+        "creates?")
+
+declare_stmt(
+    "sync.pending.all",
+    "SELECT id, data FROM pending_relation_op ORDER BY timestamp",
+    verb="read", tables=("pending_relation_op",), cardinality="many",
+    doc="Drain scan of parked relation ops.")
+
+declare_stmt(
+    "sync.pending.delete",
+    "DELETE FROM pending_relation_op WHERE id = ?",
+    verb="write", tables=("pending_relation_op",), tx_required=True,
+    doc="Unpark one relation op (applied, dead, or malformed).")
+
+declare_stmt(
+    "sync.pending.purge_refs",
+    "DELETE FROM pending_relation_op WHERE "
+    "(item_model = ? AND item_key = ?) OR "
+    "(group_model = ? AND group_key = ?)",
+    verb="write", tables=("pending_relation_op",), tx_required=True,
+    doc="Shared delete purges parked ops referencing the dead record "
+        "(indexed via the denormalized ref columns).")
+
+# -- sync: registry-generic apply shapes ------------------------------------
+# The apply engine is generic over store/models.py: table and column
+# names come from the registry (model.field() guards every wire-
+# controlled name before it reaches SQL), so these are shapes, not
+# exact statements. `{i}` slots are runtime-checked against the
+# registry's identifier set.
+
+declare_shape(
+    "sync.fk.resolve",
+    "SELECT id FROM {i} WHERE pub_id = ?",
+    verb="read", cardinality="one",
+    doc="Sync-id (pub_id) → local row id, any shared table.")
+
+declare_shape(
+    "sync.apply.backfill_owner",
+    "UPDATE {i} SET instance_id = ? WHERE {i} = ? "
+    "AND instance_id IS NULL",
+    verb="write", tx_required=True,
+    doc="Create-op owner attribution backfill (apply + clone fast "
+        "path).")
+
+declare_shape(
+    "sync.apply.relation_delete",
+    "DELETE FROM {i} WHERE {i} = ? AND {i} = ?",
+    verb="write", tx_required=True,
+    doc="Relation-op link delete.")
+
+declare_shape(
+    "sync.apply.relation_set_field",
+    "UPDATE {i} SET {i} = ? WHERE {i} = ? AND {i} = ?",
+    verb="write", tx_required=True,
+    doc="Relation-op extra-column write (e.g. date_created).")
+
+
+# -- locations (locations/*.py + api location routes) -----------------------
+
+declare_stmt(
+    "location.all",
+    "SELECT * FROM location",
+    verb="read", tables=("location",), cardinality="many",
+    doc="Location listing (api locations.list / nodes.listLocations).")
+
+declare_stmt(
+    "location.by_id",
+    "SELECT * FROM location WHERE id = ?",
+    verb="read", tables=("location",), cardinality="one",
+    doc="Full location row (api routes, fs jobs, file serving).")
+
+declare_stmt(
+    "location.path_by_id",
+    "SELECT path FROM location WHERE id = ?",
+    verb="read", tables=("location",), cardinality="one",
+    doc="Root path only (watcher, thumbnails, directory ops).")
+
+declare_stmt(
+    "location.pub_by_id",
+    "SELECT pub_id FROM location WHERE id = ?",
+    verb="read", tables=("location",), cardinality="one",
+    doc="Sync id lookup for location delete/relink op emission.")
+
+declare_stmt(
+    "location.id_paths",
+    "SELECT id, path FROM location",
+    verb="read", tables=("location",), cardinality="many",
+    doc="Online-check and watcher enumeration.")
+
+declare_stmt(
+    "location.paths",
+    "SELECT path FROM location",
+    verb="read", tables=("location",), cardinality="many",
+    doc="Overlap check at location create.")
+
+declare_stmt(
+    "location.rules_for",
+    "SELECT ir.* FROM indexer_rule ir "
+    "JOIN indexer_rule_in_location irl "
+    "ON irl.indexer_rule_id = ir.id WHERE irl.location_id = ?",
+    verb="read", tables=("indexer_rule", "indexer_rule_in_location"),
+    cardinality="many",
+    doc="Rules attached to one location (indexer + api).")
+
+declare_stmt(
+    "location.rule.all",
+    "SELECT * FROM indexer_rule",
+    verb="read", tables=("indexer_rule",), cardinality="many",
+    doc="Indexer-rule listing.")
+
+declare_stmt(
+    "location.rule.by_id",
+    "SELECT * FROM indexer_rule WHERE id = ?",
+    verb="read", tables=("indexer_rule",), cardinality="one",
+    doc="One indexer rule.")
+
+declare_stmt(
+    "location.rule.default_flag",
+    "SELECT default_rule FROM indexer_rule WHERE id = ?",
+    verb="read", tables=("indexer_rule",), cardinality="one",
+    doc="System-rule guard before delete.")
+
+declare_stmt(
+    "location.detach_rules",
+    "DELETE FROM indexer_rule_in_location WHERE location_id = ?",
+    verb="write", tables=("indexer_rule_in_location",),
+    tx_required=True,
+    doc="Rule re-attachment: clear before re-adding.")
+
+declare_stmt(
+    "location.attach_rule",
+    "INSERT OR IGNORE INTO indexer_rule_in_location "
+    "(location_id, indexer_rule_id) VALUES (?, ?)",
+    verb="write", tables=("indexer_rule_in_location",),
+    tx_required=True,
+    doc="Attach one rule to a location.")
+
+declare_shape(
+    "location.shallow.page",
+    "SELECT * FROM file_path WHERE {w} ORDER BY id LIMIT ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Shallow-rescan identify page (location + optional sub-path "
+        "filter).")
+
+# -- identifier (objects/identifier.py) -------------------------------------
+
+declare_stmt(
+    "store.object_count",
+    "SELECT COUNT(*) AS n FROM object",
+    verb="read", tables=("object",), cardinality="one",
+    doc="Object census (identifier cas-preload gate, library stats).")
+
+declare_stmt(
+    "store.last_rowid",
+    "SELECT last_insert_rowid()",
+    verb="read", cardinality="scalar",
+    doc="Consecutive-rowid probe after a batched insert (identifier).")
+
+declare_shape(
+    "identifier.cas_links",
+    "SELECT fp.cas_id AS cas_id, o.id AS oid, o.pub_id AS opub "
+    "FROM file_path fp JOIN object o ON o.id = fp.object_id "
+    "WHERE fp.cas_id IN ({w})",
+    verb="read", tables=("file_path", "object"), cardinality="many",
+    doc="Per-chunk existing-object probe by cas_id IN-list.")
+
+declare_stmt(
+    "identifier.cas_map",
+    "SELECT fp.cas_id AS c, o.id AS oid, o.pub_id AS opub "
+    "FROM file_path fp JOIN object o ON o.id = fp.object_id "
+    "WHERE fp.cas_id IS NOT NULL",
+    verb="read", tables=("file_path", "object"), cardinality="many",
+    doc="Whole-library cas_id → object preload (bulk identify).")
+
+declare_stmt(
+    "identifier.object_insert",
+    "INSERT INTO object (pub_id, kind, date_created) VALUES (?, ?, ?)",
+    verb="write", tables=("object",), tx_required=True,
+    doc="Object creates for unmatched cas_ids (executemany).")
+
+declare_stmt(
+    "identifier.object_by_pub",
+    "SELECT id FROM object WHERE pub_id = ?",
+    verb="read", tables=("object",), cardinality="one",
+    doc="Consecutive-rowid assumption probe.")
+
+declare_shape(
+    "identifier.objects_by_pubs",
+    "SELECT id, pub_id FROM object WHERE pub_id IN ({w})",
+    verb="read", tables=("object",), cardinality="many",
+    doc="Slow-path id lookup when the rowid probe fails.")
+
+declare_stmt(
+    "identifier.link_paths",
+    "UPDATE file_path SET cas_id = ?, object_id = ? WHERE id = ?",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="ONE file_path update pass per chunk (executemany).")
+
+declare_shape(
+    "identifier.orphan_count",
+    "SELECT COUNT(*) AS n FROM file_path WHERE {w}",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="Orphan census under the job's location/sub-path filters "
+        "(identifier + validator reuse the filter builder).")
+
+declare_shape(
+    "identifier.orphan_page",
+    "SELECT * FROM file_path WHERE {w} ORDER BY id ASC LIMIT ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Keyset-paged orphan fetch per hash chunk.")
+
+# -- indexer (locations/indexer_job.py, shallow.py) -------------------------
+
+declare_stmt(
+    "indexer.path_by_key",
+    "SELECT * FROM file_path WHERE location_id = ? AND "
+    "materialized_path = ? AND name = ? AND extension = ?",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="Existing row by the (location, path, name, ext) unique key "
+        "(also fs_ops target probe).")
+
+declare_stmt(
+    "indexer.children",
+    "SELECT pub_id, cas_id, is_dir, materialized_path, name, "
+    "extension FROM file_path "
+    "WHERE location_id = ? AND materialized_path = ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Direct children of one directory (shallow diff).")
+
+declare_shape(
+    "indexer.paths_by_inodes",
+    "SELECT inode, pub_id, materialized_path, name, extension "
+    "FROM file_path WHERE location_id = ? AND inode IN ({w})",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Move detection: existing rows by inode IN-list.")
+
+declare_stmt(
+    "indexer.path_current",
+    "SELECT materialized_path, name FROM file_path WHERE pub_id = ?",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="Removal guard: row still at the recorded path?")
+
+declare_shape(
+    "indexer.desc_pubs",
+    "SELECT pub_id FROM file_path WHERE {w}",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Descendant pub_ids of a removed directory (op emission "
+        "before the prefix delete).")
+
+declare_shape(
+    "indexer.desc_delete",
+    "DELETE FROM file_path WHERE {w}",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="Prefix delete of a removed directory's descendants "
+        "(materialized_like filter).")
+
+declare_stmt(
+    "indexer.path_delete_by_pub",
+    "DELETE FROM file_path WHERE pub_id = ?",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="Single removed row delete (op emitted in the same tx).")
+
+declare_stmt(
+    "indexer.set_dir_size",
+    "UPDATE file_path SET size_in_bytes_bytes = ? WHERE id = ?",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="Finalize dir-size rollup (ops via bulk_shared_ops in-tx).")
+
+declare_stmt(
+    "jobs.scratch.insert",
+    "INSERT INTO job_scratch (job_id, data) VALUES (?, ?)",
+    verb="write", tables=("job_scratch",), tx_required=True,
+    doc="Spool one batch-job step payload.")
+
+declare_stmt(
+    "jobs.scratch.delete",
+    "DELETE FROM job_scratch WHERE id = ?",
+    verb="write", tables=("job_scratch",), tx_required=True,
+    doc="Consume a spooled step atomically with its domain tx.")
+
+declare_stmt(
+    "jobs.scratch.delete_for_job",
+    "DELETE FROM job_scratch WHERE job_id = ?",
+    verb="write", tables=("job_scratch",), tx_required=True,
+    doc="Sweep a finished/shed job's leftover scratch rows.")
+
+# -- validator / dedup (objects/validator.py, objects/dedup.py) -------------
+
+declare_shape(
+    "validator.page",
+    "SELECT id, pub_id, materialized_path, name, extension, "
+    "integrity_checksum, size_in_bytes_bytes "
+    "FROM file_path WHERE {w} AND id >= ? ORDER BY id LIMIT ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Keyset-paged checksum fetch under the job filters.")
+
+declare_stmt(
+    "validator.fill_checksum",
+    "UPDATE file_path SET integrity_checksum = ? "
+    "WHERE id = ? AND integrity_checksum IS NULL",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="Fill-mode checksum write (never clobbers, executemany).")
+
+declare_shape(
+    "dedup.exact_groups",
+    "SELECT fp.cas_id AS cas_id, COUNT(*) AS n, "
+    "o.pub_id AS object_pub_id "
+    "FROM file_path fp JOIN object o ON o.id = fp.object_id "
+    "WHERE {w} GROUP BY fp.cas_id HAVING n > 1 "
+    "ORDER BY n DESC LIMIT ?",
+    verb="read", tables=("file_path", "object"), cardinality="many",
+    doc="Exact-duplicate groups by cas_id (optional location "
+        "filter).")
+
+declare_stmt(
+    "dedup.paths_by_cas",
+    "SELECT materialized_path, name, extension, location_id, "
+    "size_in_bytes_bytes FROM file_path WHERE cas_id = ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Paths of one duplicate group.")
+
+declare_shape(
+    "dedup.image_rows",
+    "SELECT fp.id, fp.object_id, fp.materialized_path, fp.name, "
+    "fp.extension, md.phash AS phash "
+    "FROM file_path fp "
+    "LEFT JOIN media_data md ON md.object_id = fp.object_id "
+    "WHERE {w} ORDER BY fp.id", verb="read",
+    tables=("file_path", "media_data"), cardinality="many",
+    doc="Images to perceptual-hash (extension + location filters).")
+
+declare_stmt(
+    "dedup.set_phash",
+    "UPDATE media_data SET phash = ? WHERE object_id = ?",
+    verb="write", tables=("media_data",), tx_required=True,
+    doc="Store a computed phash on existing media_data.")
+
+declare_stmt(
+    "dedup.insert_phash_row",
+    "INSERT OR IGNORE INTO media_data (object_id, phash) "
+    "VALUES (?, ?)",
+    verb="write", tables=("media_data",), tx_required=True,
+    doc="Seed media_data when the EXIF pass never ran for this "
+        "object.")
+
+declare_stmt(
+    "dedup.phashes_for_location",
+    "SELECT DISTINCT md.object_id AS object_id, md.phash AS phash "
+    "FROM media_data md "
+    "JOIN file_path fp ON fp.object_id = md.object_id "
+    "WHERE md.phash IS NOT NULL AND fp.location_id = ?",
+    verb="read", tables=("media_data", "file_path"),
+    cardinality="many",
+    doc="Device near-dup sweep input codes.")
+
+declare_stmt(
+    "dedup.upsert_pair",
+    "INSERT INTO near_dup_pair "
+    "(object_a_id, object_b_id, distance, date_detected) "
+    "VALUES (?, ?, ?, ?) "
+    "ON CONFLICT (object_a_id, object_b_id) "
+    "DO UPDATE SET distance = excluded.distance",
+    verb="write", tables=("near_dup_pair",), tx_required=True,
+    doc="Record one near-dup pair (re-detect refreshes distance).")
+
+declare_stmt(
+    "dedup.pairs_within",
+    "SELECT * FROM near_dup_pair WHERE distance <= ? "
+    "ORDER BY distance ASC LIMIT ?",
+    verb="read", tables=("near_dup_pair",), cardinality="many",
+    doc="Stored near-dup pairs for the search surface.")
+
+declare_stmt(
+    "dedup.paths_for_object",
+    "SELECT materialized_path, name, extension "
+    "FROM file_path WHERE object_id = ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Display paths for one near-dup object.")
+
+# -- media (media/processor.py, media/actor.py) -----------------------------
+
+declare_shape(
+    "media.file_rows",
+    "SELECT id, pub_id, object_id, cas_id, materialized_path, "
+    "name, extension FROM file_path WHERE {w} ORDER BY id",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Media-processor scan rows (extension-set filter).")
+
+declare_stmt(
+    "media.data_exists",
+    "SELECT id FROM media_data WHERE object_id = ?",
+    verb="read", tables=("media_data",), cardinality="one",
+    doc="Skip objects that already carry media_data.")
+
+declare_stmt(
+    "media.known_cas",
+    "SELECT DISTINCT cas_id FROM file_path WHERE cas_id IS NOT NULL",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Thumbnail cleanup: cas_ids still referenced by any library.")
+
+# -- library / node (library.py statistics, node.py orphan remover) ---------
+
+declare_stmt(
+    "library.stats.path_count",
+    "SELECT COUNT(*) AS n FROM file_path",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="Statistics: total file_path rows.")
+
+declare_stmt(  # sdlint: ok[schema-parity] statistics IS a whole-table aggregate (u64 BE blobs defeat SQL SUM)
+    "library.stats.file_sizes",
+    "SELECT size_in_bytes_bytes FROM file_path WHERE is_dir = 0",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Statistics: per-file sizes (summed host-side — the u64 BE "
+        "blob encoding defeats SQL SUM).")
+
+declare_stmt(
+    "library.stats.unique_sizes",
+    "SELECT MIN(size_in_bytes_bytes) AS s FROM file_path "
+    "WHERE is_dir = 0 AND object_id IS NOT NULL GROUP BY object_id",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="Statistics: one size per object (dedup-aware bytes).")
+
+declare_stmt(
+    "library.stats.clear",
+    "DELETE FROM statistics",
+    verb="write", tables=("statistics",), tx_required=True,
+    doc="Statistics snapshot is a single row, replaced in place.")
+
+declare_stmt(
+    "library.stats.insert",
+    "INSERT INTO statistics (total_object_count, library_db_size, "
+    "total_unique_bytes, total_bytes_used) VALUES (?, ?, ?, ?)",
+    verb="write", tables=("statistics",), tx_required=True,
+    doc="Persist the latest statistics snapshot.")
+
+declare_stmt(
+    "node.orphan_objects",
+    "SELECT o.id, o.pub_id FROM object o "
+    "LEFT JOIN file_path fp ON fp.object_id = o.id "
+    "WHERE fp.id IS NULL LIMIT 512",
+    verb="read", tables=("object", "file_path"), cardinality="many",
+    doc="Orphan-object remover batch (no file_path references "
+        "left).")
+
+declare_stmt(
+    "node.object_delete",
+    "DELETE FROM object WHERE id = ?",
+    verb="write", tables=("object",), tx_required=True,
+    doc="Orphan-object delete (FK cascade handled in-tx).")
+
+declare_stmt(
+    "node.instance_pub_by_row",
+    "SELECT pub_id FROM instance WHERE id = ?",
+    verb="read", tables=("instance",), cardinality="one",
+    doc="Locality check: which instance owns a location row "
+        "(api file serving).")
+
+declare_stmt(
+    "sync.instances.rows",
+    "SELECT * FROM instance",
+    verb="read", tables=("instance",), cardinality="many",
+    doc="Paired-peer identity re-arm at sync_net attach.")
+
+# -- api: tags / labels (api/procedures.py) ---------------------------------
+
+declare_stmt(
+    "api.tag.all", "SELECT * FROM tag",
+    verb="read", tables=("tag",), cardinality="many",
+    doc="tags.list / tags.getWithObjects.")
+
+declare_stmt(
+    "api.tag.by_id", "SELECT * FROM tag WHERE id = ?",
+    verb="read", tables=("tag",), cardinality="one",
+    doc="Tag CRUD lookups.")
+
+declare_stmt(
+    "api.tag.for_object",
+    "SELECT t.* FROM tag t JOIN tag_on_object to2 "
+    "ON to2.tag_id = t.id WHERE to2.object_id = ?",
+    verb="read", tables=("tag", "tag_on_object"), cardinality="many",
+    doc="tags.getForObject.")
+
+declare_stmt(
+    "api.tag.object_ids",
+    "SELECT object_id FROM tag_on_object WHERE tag_id = ?",
+    verb="read", tables=("tag_on_object",), cardinality="many",
+    doc="tags.getWithObjects member ids.")
+
+declare_stmt(
+    "api.tag.assigned_objects",
+    "SELECT o.pub_id AS opub FROM tag_on_object tob "
+    "JOIN object o ON o.id = tob.object_id WHERE tob.tag_id = ?",
+    verb="read", tables=("tag_on_object", "object"),
+    cardinality="many",
+    doc="tags.delete: assignment pub_ids for FK-safe op order.")
+
+declare_stmt(
+    "api.tag.clear_assignments",
+    "DELETE FROM tag_on_object WHERE tag_id = ?",
+    verb="write", tables=("tag_on_object",), tx_required=True,
+    doc="tags.delete: local assignment sweep (ops emitted in-tx).")
+
+declare_stmt(
+    "api.tag.unassign",
+    "DELETE FROM tag_on_object WHERE tag_id = ? AND object_id = ?",
+    verb="write", tables=("tag_on_object",), tx_required=True,
+    doc="tags.assign(unassign=True).")
+
+declare_stmt(
+    "api.tag.assign",
+    "INSERT OR IGNORE INTO tag_on_object (tag_id, object_id) "
+    "VALUES (?, ?)",
+    verb="write", tables=("tag_on_object",), tx_required=True,
+    doc="tags.assign.")
+
+declare_stmt(
+    "api.label.list_with_counts",
+    "SELECT l.*, COUNT(lo.label_id) AS object_count "
+    "FROM label l LEFT JOIN label_on_object lo "
+    "ON lo.label_id = l.id GROUP BY l.id",
+    verb="read", tables=("label", "label_on_object"),
+    cardinality="many",
+    doc="labels.list.")
+
+declare_stmt(
+    "api.label.by_id", "SELECT * FROM label WHERE id = ?",
+    verb="read", tables=("label",), cardinality="one",
+    doc="Label CRUD lookups.")
+
+declare_stmt(
+    "api.label.for_object",
+    "SELECT l.* FROM label l JOIN label_on_object lo "
+    "ON lo.label_id = l.id WHERE lo.object_id = ?",
+    verb="read", tables=("label", "label_on_object"),
+    cardinality="many",
+    doc="labels.getForObject.")
+
+declare_stmt(
+    "api.label.assigned_objects",
+    "SELECT o.pub_id AS opub FROM label_on_object lo "
+    "JOIN object o ON o.id = lo.object_id WHERE lo.label_id = ?",
+    verb="read", tables=("label_on_object", "object"),
+    cardinality="many",
+    doc="labels.delete: assignment pub_ids for FK-safe op order.")
+
+declare_stmt(
+    "api.label.clear_assignments",
+    "DELETE FROM label_on_object WHERE label_id = ?",
+    verb="write", tables=("label_on_object",), tx_required=True,
+    doc="labels.delete: local assignment sweep.")
+
+declare_stmt(
+    "api.label.unassign",
+    "DELETE FROM label_on_object WHERE label_id = ? "
+    "AND object_id = ?",
+    verb="write", tables=("label_on_object",), tx_required=True,
+    doc="labels.assign(unassign=True).")
+
+declare_stmt(
+    "api.label.assign",
+    "INSERT OR IGNORE INTO label_on_object "
+    "(label_id, object_id, date_created) VALUES (?, ?, ?)",
+    verb="write", tables=("label_on_object",), tx_required=True,
+    doc="labels.assign.")
+
+# -- api: objects / files ---------------------------------------------------
+
+declare_stmt(
+    "api.object.by_id", "SELECT * FROM object WHERE id = ?",
+    verb="read", tables=("object",), cardinality="one",
+    doc="Object lookups across files.* and tag/label assignment.")
+
+declare_stmt(
+    "api.object.exists", "SELECT 1 FROM object WHERE id = ?",
+    verb="read", tables=("object",), cardinality="one",
+    doc="Stale-id guard before grouping membership inserts.")
+
+declare_shape(
+    "api.object.pubs_by_ids",
+    "SELECT id, pub_id FROM object WHERE id IN ({w})",
+    verb="read", tables=("object",), cardinality="many",
+    doc="Multi-select access-time update: op targets by id list.")
+
+declare_stmt(
+    "api.object.set_access_time",
+    "UPDATE object SET date_accessed = ? WHERE id = ?",
+    verb="write", tables=("object",), tx_required=True,
+    doc="files.updateAccessTime batch (ops in the same tx).")
+
+declare_stmt(
+    "api.object.kind_counts",
+    "SELECT kind, COUNT(*) AS n FROM object GROUP BY kind",
+    verb="read", tables=("object",), cardinality="many",
+    doc="categories.list.")
+
+declare_stmt(
+    "api.file_path.by_id", "SELECT * FROM file_path WHERE id = ?",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="file_path row for files.* routes and fs jobs.")
+
+declare_stmt(
+    "api.file_path.for_object",
+    "SELECT * FROM file_path WHERE object_id = ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="files.get attachments.")
+
+declare_stmt(
+    "api.media_data.for_object",
+    "SELECT * FROM media_data WHERE object_id = ?",
+    verb="read", tables=("media_data",), cardinality="one",
+    doc="files.get / files.getMediaData.")
+
+declare_stmt(
+    "api.file_path.rename_descendants",
+    "UPDATE file_path SET materialized_path = "
+    "REPLACE(materialized_path, ?, ?) WHERE location_id = ? "
+    "AND materialized_path LIKE ? ESCAPE '\\'",
+    verb="write", tables=("file_path",), tx_required=True,
+    doc="Directory rename: re-prefix every descendant's "
+        "materialized_path.")
+
+# -- api: grouping shapes (spaces/albums share one factory) -----------------
+
+declare_shape(
+    "api.grouping.list",
+    "SELECT g.*, COUNT(r.{i}) AS object_count "
+    "FROM {i} g LEFT JOIN {i} r ON r.{i} = g.id GROUP BY g.id",
+    verb="read", cardinality="many",
+    doc="spaces.list / albums.list with member counts.")
+
+declare_shape(
+    "api.grouping.get",
+    "SELECT * FROM {i} WHERE id = ?",
+    verb="read", cardinality="one",
+    doc="Generic by-id fetch for the grouping factory.")
+
+declare_shape(
+    "api.grouping.exists",
+    "SELECT 1 FROM {i} WHERE id = ?",
+    verb="read", cardinality="one",
+    doc="Existence probe for the grouping factory.")
+
+declare_shape(
+    "api.grouping.object_ids",
+    "SELECT object_id FROM {i} WHERE {i} = ?",
+    verb="read", cardinality="many",
+    doc="Membership ids of one space/album.")
+
+# -- api: jobs / search / preferences / notifications -----------------------
+
+declare_stmt(
+    "api.job.reports",
+    "SELECT id, name, action, status, task_count, "
+    "completed_task_count, errors_text, metadata, parent_id, "
+    "date_created, date_started, date_completed, "
+    "date_estimated_completion FROM job "
+    "ORDER BY date_created DESC LIMIT 100",
+    verb="read", tables=("job",), cardinality="many",
+    doc="jobs.reports listing.")
+
+declare_stmt(
+    "api.job.clear",
+    "DELETE FROM job WHERE id = ? AND status NOT IN (?, ?, ?)",
+    verb="write", tables=("job",), tx_required=True,
+    doc="jobs.clear (never a live job).")
+
+declare_stmt(
+    "api.job.clear_all",
+    "DELETE FROM job WHERE status NOT IN (?, ?, ?)",
+    verb="write", tables=("job",), tx_required=True,
+    doc="jobs.clearAll (never live jobs).")
+
+declare_shape(
+    "api.search.paths_window",
+    "SELECT fp.* FROM file_path fp WHERE {w} "
+    "ORDER BY {w} {w}, fp.id LIMIT ? OFFSET ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="search.paths absolute-skip window (virtualized explorer).")
+
+declare_shape(
+    "api.search.paths_cursor",
+    "SELECT fp.* FROM file_path fp WHERE {w} AND fp.id > ? "
+    "ORDER BY fp.id LIMIT ?",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="search.paths keyset page.")
+
+declare_shape(
+    "api.search.paths_count",
+    "SELECT COUNT(*) AS n FROM file_path fp WHERE {w}",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="search.pathsCount.")
+
+declare_shape(
+    "api.search.objects_window",
+    "SELECT o.* FROM object o WHERE {w} "
+    "ORDER BY {w} {w}, o.id LIMIT ? OFFSET ?",
+    verb="read", tables=("object",), cardinality="many",
+    doc="search.objects absolute-skip window.")
+
+declare_shape(
+    "api.search.objects_cursor",
+    "SELECT o.* FROM object o WHERE {w} AND o.id > ? "
+    "ORDER BY o.id LIMIT ?",
+    verb="read", tables=("object",), cardinality="many",
+    doc="search.objects keyset page.")
+
+declare_shape(
+    "api.search.objects_count",
+    "SELECT COUNT(*) AS n FROM object o WHERE {w}",
+    verb="read", tables=("object",), cardinality="one",
+    doc="search.objectsCount.")
+
+declare_shape(
+    "api.search.paths_for_objects",
+    "SELECT * FROM file_path WHERE object_id IN ({w})",
+    verb="read", tables=("file_path",), cardinality="many",
+    doc="One attachment query per search.objects page.")
+
+declare_stmt(
+    "api.preference.all", "SELECT * FROM preference",
+    verb="read", tables=("preference",), cardinality="many",
+    doc="preferences.get KV dump.")
+
+declare_stmt(
+    "api.preference.delete",
+    "DELETE FROM preference WHERE key = ?",
+    verb="write", tables=("preference",), tx_required=True,
+    doc="preferences.update(None) key removal.")
+
+declare_stmt(
+    "api.notification.recent",
+    "SELECT * FROM notification ORDER BY id DESC LIMIT 50",
+    verb="read", tables=("notification",), cardinality="many",
+    doc="notifications.get per library.")
+
+declare_stmt(
+    "api.notification.dismiss",
+    "UPDATE notification SET read = 1 WHERE id = ?",
+    verb="write", tables=("notification",), tx_required=True,
+    doc="notifications.dismiss.")
+
+declare_stmt(
+    "api.notification.dismiss_all",
+    "UPDATE notification SET read = 1",
+    verb="write", tables=("notification",), tx_required=True,
+    doc="notifications.dismissAll per library.")
+
+# -- bench corpus writers (tools/; not on any tier-1 product path) ----------
+
+declare_stmt(
+    "bench.tag_insert",
+    "INSERT INTO tag (pub_id, name) VALUES (?, ?)",
+    verb="write", tables=("tag",), tx_required=True,
+    coverage="tools",
+    doc="sync_bench tag corpus (write_ops tx).")
+
+# (sync_bench's corpus objects reuse identifier.object_insert — the
+# bench deliberately mimics the identify write shape byte-for-byte.)
+
+declare_stmt(
+    "bench.file_path_insert",
+    "INSERT INTO file_path (pub_id, name) VALUES (?, ?)",
+    verb="write", tables=("file_path",), tx_required=True,
+    coverage="tools",
+    doc="sync_bench identify-shaped corpus paths.")
+
+declare_stmt(
+    "bench.file_path_link",
+    "UPDATE file_path SET cas_id = ?, object_id = "
+    "(SELECT id FROM object WHERE pub_id = ?) WHERE pub_id = ?",
+    verb="write", tables=("file_path", "object"), tx_required=True,
+    coverage="tools",
+    doc="sync_bench identify-shaped corpus linking.")
+
+# -- bench diagnostic reads (tools/) ----------------------------------------
+
+declare_stmt(
+    "jobs.report.by_id",
+    "SELECT * FROM job WHERE id = ?",
+    verb="read", tables=("job",), cardinality="one",
+    coverage="tools",
+    doc="perf_smoke per-stage report fetch.")
+
+declare_stmt(  # sdlint: ok[schema-parity] bench diagnostic census, off the serving path
+    "bench.file_count",
+    "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0",
+    verb="read", tables=("file_path",), cardinality="one",
+    coverage="tools",
+    doc="perf_smoke per-stage file census.")
+
+declare_stmt(
+    "bench.identified_count",
+    "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0 "
+    "AND cas_id IS NOT NULL",
+    verb="read", tables=("file_path",), cardinality="one",
+    coverage="tools",
+    doc="perf_smoke summary: identified paths.")
+
+declare_stmt(  # sdlint: ok[schema-parity] bench diagnostic census, off the serving path
+    "bench.phash_count",
+    "SELECT COUNT(*) AS n FROM media_data WHERE phash IS NOT NULL",
+    verb="read", tables=("media_data",), cardinality="one",
+    coverage="tools",
+    doc="perf_smoke near-dup stage.")
+
+declare_stmt(
+    "bench.pair_count",
+    "SELECT COUNT(*) AS n FROM near_dup_pair WHERE distance <= 10",
+    verb="read", tables=("near_dup_pair",), cardinality="one",
+    coverage="tools",
+    doc="perf_smoke near-dup stage.")
+
+declare_stmt(  # sdlint: ok[schema-parity] bench diagnostic census, off the serving path
+    "bench.checksum_count",
+    "SELECT COUNT(*) AS n FROM file_path "
+    "WHERE integrity_checksum IS NOT NULL",
+    verb="read", tables=("file_path",), cardinality="one",
+    coverage="tools",
+    doc="validator_device_bench progress census.")
+
+declare_stmt(
+    "bench.oplog_row_count",
+    "SELECT COUNT(*) AS n FROM shared_operation",
+    verb="read", tables=("shared_operation",), cardinality="one",
+    coverage="tools",
+    doc="sync_bench ingest-drain convergence poll.")
+
+declare_stmt(
+    "bench.oplog_total",
+    "SELECT (SELECT COUNT(*) FROM shared_operation) + "
+    "(SELECT COUNT(*) FROM relation_operation) AS n",
+    verb="read", tables=("shared_operation", "relation_operation"),
+    cardinality="one", coverage="tools",
+    doc="sync_bench full-clone convergence poll.")
+
+declare_stmt(
+    "bench.tag_count",
+    "SELECT COUNT(*) AS n FROM tag",
+    verb="read", tables=("tag",), cardinality="one",
+    coverage="tools",
+    doc="sync_bench applied-tag census.")
+
+declare_stmt(
+    "bench.objects_digest",
+    "SELECT pub_id, kind, date_created, note FROM object",
+    verb="read", tables=("object",), cardinality="many",
+    coverage="tools",
+    doc="sync_bench byte-identity domain digest.")
+
+declare_stmt(
+    "bench.paths_digest",
+    "SELECT fp.pub_id, fp.cas_id, o.pub_id AS opub "
+    "FROM file_path fp LEFT JOIN object o ON o.id = fp.object_id",
+    verb="read", tables=("file_path", "object"), cardinality="many",
+    coverage="tools",
+    doc="sync_bench byte-identity domain digest.")
+
+declare_stmt(
+    "bench.tags_digest",
+    "SELECT pub_id, name FROM tag",
+    verb="read", tables=("tag",), cardinality="many",
+    coverage="tools",
+    doc="sync_bench byte-identity domain digest.")
+
+declare_stmt(
+    "indexer.id_pub_by_key",
+    "SELECT id, pub_id FROM file_path WHERE location_id = ? AND "
+    "materialized_path = ? AND name = ? AND extension = ?",
+    verb="read", tables=("file_path",), cardinality="one",
+    doc="Finalize dir-size rollup: resolve each directory row by its "
+        "unique key inside the rollup tx.")
+
+declare_stmt(
+    "jobs.scratch.data",
+    "SELECT data FROM job_scratch WHERE id = ?",
+    verb="read", tables=("job_scratch",), cardinality="one",
+    doc="Unspool one batch-job step payload (missing row = the step "
+        "already committed).")

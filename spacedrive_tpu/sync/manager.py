@@ -77,7 +77,9 @@ def cascade_local_fks(conn, model: str, local_id: int) -> None:
     the sync apply path (_apply_shared) and LOCAL delete sites like the
     orphan remover — a raw DELETE FROM object with foreign_keys=ON
     fails on tag/label/album/space membership rows otherwise (and one
-    failure aborts the whole cleanup batch)."""
+    failure aborts the whole cleanup batch). Table/column names come
+    from the model registry; the f-strings bind the declared
+    store.helper.update / store.helper.delete shapes."""
     for rname, rmodel in M.MODELS.items():
         for f in rmodel.fields:
             if _fk_target(f) != model or f.on_delete:
@@ -157,7 +159,7 @@ class SyncManager:
             self._sync_indexes_ready = True
 
     def _load_instances(self) -> None:
-        rows = self.db.query("SELECT id, pub_id, timestamp FROM instance")
+        rows = self.db.run("sync.instances.all")
         with self._meta_lock:
             for row in rows:
                 self._instance_ids[row["pub_id"]] = row["id"]
@@ -170,9 +172,8 @@ class SyncManager:
     def _instance_row_id(self, pub_id: bytes, conn=None) -> int:
         rid = self._instance_ids.get(pub_id)
         if rid is None:
-            q = "SELECT id FROM instance WHERE pub_id = ?"
-            row = (conn.execute(q, (pub_id,)).fetchone() if conn is not None
-                   else self.db.query_one(q, (pub_id,)))
+            row = self.db.run("sync.instances.id_by_pub", (pub_id,),
+                              conn=conn)
             if row is None:
                 raise KeyError(f"unknown instance {pub_id.hex()}")
             rid = row["id"]
@@ -190,20 +191,17 @@ class SyncManager:
         the row table."""
         if self._op_log_high is None:
             hi = 0
-            for table, col in (("shared_operation", "timestamp"),
-                               ("relation_operation", "timestamp"),
-                               ("shared_op_blob", "max_ts")):
-                row = self.db.query_one(
-                    f"SELECT MAX({col}) AS t FROM {table}")
+            for row in (self.db.run("sync.oplog.max_ts_shared"),
+                        self.db.run("sync.oplog.max_ts_relation"),
+                        self.db.run("sync.oplog.max_ts_blob")):
                 if row is not None and row["t"] is not None:
                     hi = max(hi, row["t"])
             with self._meta_lock:
                 if self._op_log_high is None:
                     self._op_log_high = hi
         if self._has_shared_tombstones is None:
-            probed = self.db.query_one(
-                "SELECT 1 FROM shared_operation WHERE kind = 'd' "
-                "LIMIT 1") is not None
+            probed = self.db.run(
+                "sync.oplog.has_tombstones") is not None
             with self._meta_lock:
                 if self._has_shared_tombstones is None:
                     self._has_shared_tombstones = probed
@@ -328,15 +326,11 @@ class SyncManager:
                     (op.timestamp, t.relation, pack_value(t.item_id),
                      pack_value(t.group_id), t.kind, data, my_id))
         if shared_rows:
-            conn.executemany(
-                "INSERT INTO shared_operation "
-                "(timestamp, model, record_id, kind, data, instance_id) "
-                "VALUES (?, ?, ?, ?, ?, ?)", shared_rows)
+            self.db.run_many("sync.oplog.insert_shared", shared_rows,
+                             conn=conn)
         if rel_rows:
-            conn.executemany(
-                "INSERT INTO relation_operation "
-                "(timestamp, relation, item_id, group_id, kind, data, "
-                "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?)", rel_rows)
+            self.db.run_many("sync.oplog.insert_relation", rel_rows,
+                             conn=conn)
         if shared_rows or rel_rows:
             SYNC_OPS_ENCODED.labels(format="row").inc(
                 len(shared_rows) + len(rel_rows))
@@ -386,12 +380,10 @@ class SyncManager:
                 blob = opblob.encode_uniform(
                     stamps, [s[0] for s in specs], kind0, op_ids,
                     [pack_value(s[4]) for s in specs])
-                conn.execute(
-                    "INSERT INTO shared_op_blob "
-                    "(model, min_ts, max_ts, n_ops, data, instance_id) "
-                    "VALUES (?, ?, ?, ?, ?, ?)",
+                self.db.run(
+                    "sync.blob.insert",
                     (model, stamps[0], stamps[-1], len(specs), blob,
-                     my_id))
+                     my_id), conn=conn)
                 self._note_ops_logged(stamps[-1], False)
                 SYNC_OPS_ENCODED.labels(format="blob").inc(len(specs))
                 SYNC_BLOB_PAGES_WRITTEN.inc()
@@ -435,10 +427,7 @@ class SyncManager:
             for (rid, kind, field, value, values), ts, op_id
             in zip(specs, stamps, op_ids)
         ]
-        conn.executemany(
-            "INSERT INTO shared_operation "
-            "(timestamp, model, record_id, kind, data, instance_id) "
-            "VALUES (?, ?, ?, ?, ?, ?)", rows)
+        self.db.run_many("sync.oplog.insert_shared", rows, conn=conn)
         self._note_ops_logged(
             stamps[-1], any(s[1] == OpKind.DELETE for s in specs))
         SYNC_OPS_ENCODED.labels(format="row").inc(len(rows))
@@ -453,21 +442,16 @@ class SyncManager:
             t.field, t.value, t.delete, op.id, t.values,
             getattr(t, "update", False)))
         if isinstance(t, SharedOp):
-            conn.execute(
-                "INSERT INTO shared_operation "
-                "(timestamp, model, record_id, kind, data, instance_id) "
-                "VALUES (?, ?, ?, ?, ?, ?)",
+            self.db.run(
+                "sync.oplog.insert_shared",
                 (op.timestamp, t.model, pack_value(t.record_id), t.kind,
-                 data, instance_row_id),
-            )
+                 data, instance_row_id), conn=conn)
         else:
-            conn.execute(
-                "INSERT INTO relation_operation "
-                "(timestamp, relation, item_id, group_id, kind, data, "
-                "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?)",
+            self.db.run(
+                "sync.oplog.insert_relation",
                 (op.timestamp, t.relation, pack_value(t.item_id),
                  pack_value(t.group_id), t.kind, data, instance_row_id),
-            )
+                conn=conn)
 
     # -- read path (manager.rs:130-199) ------------------------------------
 
@@ -495,6 +479,8 @@ class SyncManager:
                 conds.append(f"i.pub_id NOT IN ({ph})")
                 params.extend(clock_ids)
             where = " OR ".join(conds) if conds else "1=1"
+            # binds the declared sync.oplog.page shape (table from the
+            # two-element literal tuple above, watermark disjunction)
             rows = self.db.query(
                 f"SELECT o.*, i.pub_id AS instance_pub_id FROM {table} o "
                 f"JOIN instance i ON i.id = o.instance_id "
@@ -530,6 +516,7 @@ class SyncManager:
             conds.append(f"i.pub_id NOT IN ({ph})")
             params.extend([pub for pub, _ in args.clocks])
         where = " OR ".join(conds) if conds else "1=1"
+        # binds the declared sync.blob.metas_watermarked shape
         metas = self.db.query(
             f"SELECT b.id, b.model, b.min_ts, i.pub_id AS pub "
             f"FROM shared_op_blob b JOIN instance i "
@@ -544,9 +531,7 @@ class SyncManager:
                 kth = sorted(t for t, _, _ in out)[args.count - 1]
                 if m["min_ts"] > kth:
                     break
-            row = self.db.query_one(
-                "SELECT data FROM shared_op_blob WHERE id = ?",
-                (m["id"],))
+            row = self.db.run("sync.blob.data_by_id", (m["id"],))
             if row is None:
                 # A concurrent first-ingest exploded this blob between
                 # the metas SELECT and here (each statement reads its
@@ -597,27 +582,24 @@ class SyncManager:
         lock for seconds; crash-safe because each blob's rows insert
         and its blob row deletes atomically."""
         while True:
-            metas = self.db.query(
-                "SELECT id, model, instance_id, data FROM shared_op_blob "
-                "ORDER BY min_ts LIMIT 16")
+            metas = self.db.run("sync.blob.metas_batch")
             if not metas:
                 return
-            with self.db.tx() as conn:
+            # one SMALL tx per 16-blob batch BY DESIGN: a multi-GB
+            # backlog must never hold the write lock for seconds
+            with self.db.tx() as conn:  # sdlint: ok[tx-shape]
                 for m in metas:
                     self._explode_blob_conn(conn, m)
 
-    @staticmethod
-    def _explode_blob_conn(conn, m) -> None:
+    def _explode_blob_conn(self, conn, m) -> None:
         """One blob page → its op rows + blob-row delete, atomically on
         the caller's transaction."""
-        conn.executemany(
-            "INSERT INTO shared_operation "
-            "(timestamp, model, record_id, kind, data, instance_id) "
-            "VALUES (?, ?, ?, ?, ?, ?)",
+        self.db.run_many(
+            "sync.oplog.insert_shared",
             [(ts, m["model"], rid, kind, payload, m["instance_id"])
              for ts, rid, kind, payload
-             in opblob.decode_entries(m["data"])])
-        conn.execute("DELETE FROM shared_op_blob WHERE id = ?", (m["id"],))
+             in opblob.decode_entries(m["data"])], conn=conn)
+        self.db.run("sync.blob.delete", (m["id"],), conn=conn)
         SYNC_BLOBS_EXPLODED.inc()
 
     def _row_to_op(self, row, is_shared: bool) -> CRDTOperation:
@@ -663,10 +645,7 @@ class SyncManager:
         backlog never materializes in memory."""
         self._ensure_sync_indexes()
         wm = dict(clocks)
-        metas = self.db.query(
-            "SELECT b.id, b.model, b.min_ts, b.max_ts, b.n_ops, "
-            "b.instance_id, i.pub_id AS pub FROM shared_op_blob b "
-            "JOIN instance i ON i.id = b.instance_id ORDER BY b.min_ts")
+        metas = self.db.run("sync.clone.blob_metas")
         floors: Dict[bytes, int] = {}
         for m in metas:
             pub = m["pub"]
@@ -676,9 +655,7 @@ class SyncManager:
             for ops in self._row_ops_between(
                     m["instance_id"], pub, floor, m["min_ts"], ops_page):
                 yield ("ops", ops)
-            row = self.db.query_one(
-                "SELECT data FROM shared_op_blob WHERE id = ?",
-                (m["id"],))
+            row = self.db.run("sync.blob.data_by_id", (m["id"],))
             if row is None:
                 # Concurrently exploded (a first remote ingest ran
                 # between the metas SELECT and here): its ops are rows
@@ -699,6 +676,7 @@ class SyncManager:
             merged: List[Tuple[int, bool, Any]] = []
             for table, is_shared in (("shared_operation", True),
                                      ("relation_operation", False)):
+                # binds the declared sync.oplog.window shape
                 rows = self.db.query(
                     f"SELECT o.*, ? AS instance_pub_id FROM {table} o "
                     f"WHERE o.instance_id = ? AND o.timestamp > ? "
@@ -721,8 +699,7 @@ class SyncManager:
         if pub_id != self.instance:
             with self._meta_lock:
                 self._solo = False  # peers exist: row-format bulk ops
-        row = self.db.query_one(
-            "SELECT id FROM instance WHERE pub_id = ?", (pub_id,))
+        row = self.db.run("sync.instances.id_by_pub", (pub_id,))
         if row is not None:
             with self._meta_lock:
                 self._instance_ids[pub_id] = row["id"]
@@ -778,8 +755,10 @@ class SyncManager:
                 try:
                     self._instance_row_id(op.instance)
                 except KeyError:
-                    self.register_instance(op.instance,
-                                           node_name="(relayed)")
+                    # bounded by distinct unknown relayed instances
+                    # (≈0 per page) — not a per-item tx
+                    self.register_instance(  # sdlint: ok[tx-shape]
+                        op.instance, node_name="(relayed)")
         applied = 0
         errors: List[str] = []
         ts_max: Dict[bytes, int] = {}
@@ -790,9 +769,7 @@ class SyncManager:
             # land one last blob between the explode above and this
             # transaction — the LWW compares below must see those ops
             # as rows. Almost always an empty, one-query no-op.
-            for m in conn.execute(
-                "SELECT id, model, instance_id, data FROM shared_op_blob "
-                    "ORDER BY min_ts").fetchall():
+            for m in self.db.run("sync.blob.metas_sweep", conn=conn):
                 self._explode_blob_conn(conn, m)
             for op in ops:
                 self.clock.update_with_timestamp(op.timestamp)
@@ -806,10 +783,11 @@ class SyncManager:
                 # upgrade, drain_quarantined_ops re-ingests it.
                 reason = self._op_permanently_inapplicable(op)
                 if reason is not None:
-                    conn.execute(
-                        "INSERT OR IGNORE INTO quarantined_op "
-                        "(op_id, timestamp, data) VALUES (?, ?, ?)",
-                        (op.id, op.timestamp, op.pack()))
+                    # poison ops are rare (version skew); executemany
+                    # would buy nothing and lose the per-op triage
+                    self.db.run(  # sdlint: ok[tx-shape]
+                        "sync.quarantine.insert",
+                        (op.id, op.timestamp, op.pack()), conn=conn)
                     errors.append(
                         f"ingest {op.typ!r}: quarantined: {reason}")
                     if op.instance not in failed:
@@ -848,9 +826,10 @@ class SyncManager:
                     self.timestamps.get(op.instance, op.timestamp),
                     ts_max.get(op.instance, 0), op.timestamp)
             for pub, ts in ts_max.items():
-                conn.execute(
-                    "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
-                    (ts, pub))
+                # one row per PAIRED INSTANCE (2-3), not per item
+                self.db.run(  # sdlint: ok[tx-shape]
+                    "sync.instances.set_watermark", (ts, pub),
+                    conn=conn)
         with self._meta_lock:
             self.timestamps.update(ts_max)
         SYNC_OPS_INGESTED.inc(len(ops))
@@ -880,7 +859,8 @@ class SyncManager:
         errors: List[str] = []
         fast_pages = 0
         for page in pages:
-            a, errs, fast = self._receive_blob_page(page)
+            # one tx per PAGE is the protocol's ack/watermark unit
+            a, errs, fast = self._receive_blob_page(page)  # sdlint: ok[tx-shape]
             applied += a
             errors.extend(errs)
             fast_pages += 1 if fast else 0
@@ -985,24 +965,20 @@ class SyncManager:
             groups.setdefault(key, []).append(
                 (self._rid_bytes(rid_packed), values))
         with self.db.tx() as conn:
-            conn.executemany(
-                "INSERT INTO shared_operation "
-                "(timestamp, model, record_id, kind, data, instance_id) "
-                "VALUES (?, ?, ?, ?, ?, ?)", oplog_rows)
+            self.db.run_many("sync.oplog.insert_shared", oplog_rows,
+                             conn=conn)
             for (is_create, keys), recs in groups.items():
                 self._apply_group_fast(conn, mdef, sync_col, remote_id,
                                        is_create and attributable,
                                        keys, recs)
-            if any_create and conn.execute(
-                    "SELECT 1 FROM pending_relation_op LIMIT 1"
-                    ).fetchone() is not None:
+            if any_create and self.db.run(
+                    "sync.pending.any", conn=conn) is not None:
                 # parity with _apply_op_conn: creates may materialize
                 # rows parked relation ops were waiting for
                 self._drain_pending_relations(conn)
             new_wm = max(self.timestamps.get(pub, 0), max_ts)
-            conn.execute(
-                "UPDATE instance SET timestamp = ? WHERE pub_id = ?",
-                (new_wm, pub))
+            self.db.run("sync.instances.set_watermark", (new_wm, pub),
+                        conn=conn)
         with self._meta_lock:
             self.timestamps[pub] = new_wm
         self.clock.update_with_timestamp(max_ts)
@@ -1016,7 +992,9 @@ class SyncManager:
         fields resolve pub_id → local id via a scalar subselect (the
         deferred resolution pass; referenced rows seeded by earlier
         statements of this page resolve, absent ones write NULL exactly
-        like _resolve_fk)."""
+        like _resolve_fk). The f-strings interpolate registry-derived
+        identifiers only and bind the declared store.helper.* /
+        sync.apply.* shapes."""
         table = mdef.name
         if attribute:
             conn.executemany(
@@ -1053,17 +1031,18 @@ class SyncManager:
         unknown-model. Called at manager init: after an upgrade the
         registry knows the model and the ops apply; still-unknown ones
         stay quarantined for the next upgrade. Returns drained count."""
-        rows = self.db.query(
-            "SELECT id, data FROM quarantined_op ORDER BY timestamp")
+        rows = self.db.run("sync.quarantine.all")
         drained = 0
         for row in rows:
             op = CRDTOperation.unpack(row["data"])
             if self._op_permanently_inapplicable(op) is not None:
                 continue
-            _, errs = self.receive_crdt_operations([op])
+            # init-time drain of an almost-always-empty table: each
+            # op re-decides through the full ingest machinery
+            _, errs = self.receive_crdt_operations([op])  # sdlint: ok[tx-shape]
             if not errs:
-                self.db.execute(
-                    "DELETE FROM quarantined_op WHERE id = ?", (row["id"],))
+                self.db.run_tx(  # sdlint: ok[tx-shape]
+                    "sync.quarantine.delete", (row["id"],))
                 drained += 1
         return drained
 
@@ -1115,9 +1094,8 @@ class SyncManager:
         t = op.typ
         if isinstance(t, SharedOp):
             if not t.delete:
-                row = self.db.query_one(
-                    "SELECT 1 FROM shared_operation WHERE model = ? "
-                    "AND record_id = ? AND kind = 'd' LIMIT 1",
+                row = self.db.run(
+                    "sync.lww.shared_tombstone",
                     (t.model, pack_value(t.record_id)))
                 if row is not None:
                     return True  # tombstoned — remove-wins
@@ -1125,17 +1103,13 @@ class SyncManager:
             if kind.startswith("u:"):
                 fields = set(OpKind.update_fields(kind))
                 covered: set = set()
-                for row in self.db.query(
-                    "SELECT DISTINCT kind FROM shared_operation "
-                    "WHERE model = ? AND record_id = ? AND timestamp >= ? "
-                    "AND kind LIKE 'u:%'",
+                for row in self.db.run(
+                        "sync.lww.shared_update_coverage",
                         (t.model, pack_value(t.record_id), op.timestamp)):
                     covered.update(OpKind.update_fields(row["kind"]))
                 return fields <= covered
-            row = self.db.query_one(
-                "SELECT timestamp FROM shared_operation WHERE timestamp >= ? "
-                "AND model = ? AND record_id = ? AND kind = ? "
-                "ORDER BY timestamp DESC LIMIT 1",
+            row = self.db.run(
+                "sync.lww.shared_same_kind",
                 (op.timestamp, t.model, pack_value(t.record_id), t.kind))
         else:
             # Unlike ingest.rs:209-224 (item-only), group_id participates:
@@ -1152,17 +1126,12 @@ class SyncManager:
             key = (t.relation, pack_value(t.item_id),
                    pack_value(t.group_id))
             if t.delete:
-                row = self.db.query_one(
-                    "SELECT 1 FROM relation_operation WHERE relation = ? "
-                    "AND item_id = ? AND group_id = ? AND "
-                    "((kind = 'd' AND timestamp >= ?) OR "
-                    " (kind = 'c' AND timestamp > ?)) LIMIT 1",
+                row = self.db.run(
+                    "sync.lww.relation_delete_check",
                     key + (op.timestamp, op.timestamp))
             else:
-                row = self.db.query_one(
-                    "SELECT 1 FROM relation_operation WHERE relation = ? "
-                    "AND item_id = ? AND group_id = ? AND timestamp >= ? "
-                    "AND kind IN (?, 'd') LIMIT 1",
+                row = self.db.run(
+                    "sync.lww.relation_nondelete_check",
                     key + (op.timestamp, t.kind))
         return row is not None
 
@@ -1171,6 +1140,7 @@ class SyncManager:
     def _resolve_fk(self, conn, table: str, pub_id: Any) -> Optional[int]:
         if pub_id is None:
             return None
+        # binds the declared sync.fk.resolve shape (registry table)
         row = conn.execute(
             f"SELECT id FROM {table} WHERE pub_id = ?", (pub_id,)).fetchone()
         return row["id"] if row else None
@@ -1212,39 +1182,33 @@ class SyncManager:
                 # WHERE NOT EXISTS, not a UNIQUE constraint: op_id was
                 # ALTERed into pre-existing tables, where SQLite can't
                 # add uniqueness.
-                conn.execute(
-                    "INSERT INTO pending_relation_op "
-                    "(op_id, timestamp, data, item_model, item_key, "
-                    "group_model, group_key) "
-                    "SELECT ?, ?, ?, ?, ?, ?, ? WHERE NOT EXISTS "
-                    "(SELECT 1 FROM pending_relation_op WHERE op_id = ?)",
+                self.db.run(
+                    "sync.pending.park",
                     (op.id, op.timestamp, op.pack(),
                      _fk_target(rmodel.field(item_f)),
                      pack_value(t.item_id),
                      _fk_target(rmodel.field(group_f)),
-                     pack_value(t.group_id), op.id))
+                     pack_value(t.group_id), op.id), conn=conn)
 
     def _drain_pending_relations(self, conn) -> None:
         """Retry parked relation ops; applied ones graduate to the op
         log (keeping LWW bookkeeping consistent)."""
-        rows = conn.execute(
-            "SELECT id, data FROM pending_relation_op "
-            "ORDER BY timestamp").fetchall()
+        rows = self.db.run("sync.pending.all", conn=conn)
         for row in rows:
             op = CRDTOperation.unpack(row["data"])
             t = op.typ
             if not isinstance(t, RelationOp):
-                conn.execute("DELETE FROM pending_relation_op "
-                             "WHERE id = ?", (row["id"],))
+                self.db.run("sync.pending.delete", (row["id"],),
+                            conn=conn)
                 continue
             if self._apply_relation(conn, t, op.timestamp):
                 remote_id = self._instance_row_id(op.instance, conn)
                 self._insert_op_row(conn, op, remote_id)
-                conn.execute("DELETE FROM pending_relation_op "
-                             "WHERE id = ?", (row["id"],))
+                self.db.run("sync.pending.delete", (row["id"],),
+                            conn=conn)
             elif self._relation_target_tombstoned(conn, t):
-                conn.execute("DELETE FROM pending_relation_op "
-                             "WHERE id = ?", (row["id"],))
+                self.db.run("sync.pending.delete", (row["id"],),
+                            conn=conn)
 
     def _relation_target_tombstoned(self, conn, t: RelationOp) -> bool:
         """True when either record a relation op references has a
@@ -1256,10 +1220,8 @@ class SyncManager:
                          (t.group_id, _fk_target(model.field(group_f)))):
             if tbl is None:
                 continue
-            row = conn.execute(
-                "SELECT 1 FROM shared_operation WHERE model = ? AND "
-                "record_id = ? AND kind = 'd' LIMIT 1",
-                (tbl, pack_value(rid))).fetchone()
+            row = self.db.run("sync.lww.shared_tombstone",
+                              (tbl, pack_value(rid)), conn=conn)
             if row is not None:
                 return True
         return False
@@ -1271,10 +1233,9 @@ class SyncManager:
         per create (the in-order common case returns the empty set)."""
         if ts is None:
             return set()
-        rows = conn.execute(
-            "SELECT DISTINCT kind FROM shared_operation WHERE model = ? "
-            "AND record_id = ? AND timestamp > ? AND kind LIKE 'u:%'",
-            (t.model, pack_value(t.record_id), ts)).fetchall()
+        rows = self.db.run(
+            "sync.lww.superseding_updates",
+            (t.model, pack_value(t.record_id), ts), conn=conn)
         out: set = set()
         for row in rows:
             out.update(OpKind.update_fields(row["kind"]))
@@ -1313,15 +1274,15 @@ class SyncManager:
             # older schema (NULL refs) are caught by the drain-time
             # tombstone check instead.
             key = pack_value(t.record_id)
-            conn.execute(
-                "DELETE FROM pending_relation_op WHERE "
-                "(item_model = ? AND item_key = ?) OR "
-                "(group_model = ? AND group_key = ?)",
-                (t.model, key, t.model, key))
+            self.db.run("sync.pending.purge_refs",
+                        (t.model, key, t.model, key), conn=conn)
             conn.execute(
                 f"DELETE FROM {t.model} WHERE {sync_col} = ?", (t.record_id,))
             return
 
+        # The f-strings below interpolate registry-guarded identifiers
+        # only and bind the declared store.helper.* / sync.apply.*
+        # shapes (runtime-matched by the SQL auditor).
         def write_field(name: str, raw_value: Any) -> None:
             try:
                 f = model.field(name)  # registry guard before SQL
@@ -1383,12 +1344,10 @@ class SyncManager:
         """Mirror of _create_field_superseded for relation creates."""
         if ts is None:
             return False
-        row = conn.execute(
-            "SELECT 1 FROM relation_operation WHERE relation = ? AND "
-            "item_id = ? AND group_id = ? AND kind = ? AND timestamp > ? "
-            "LIMIT 1",
+        row = self.db.run(
+            "sync.lww.relation_superseding",
             (t.relation, pack_value(t.item_id), pack_value(t.group_id),
-             OpKind.update(field), ts)).fetchone()
+             OpKind.update(field), ts), conn=conn)
         return row is not None
 
     def _apply_relation(self, conn, t: RelationOp,
@@ -1404,10 +1363,12 @@ class SyncManager:
         group_local = self._resolve_fk(conn, group_table, t.group_id)
         if item_local is None or group_local is None:
             return False
-        where = f"{item_field} = ? AND {group_field} = ?"
+        # Identifiers inline (not via a shared `where` variable) so each
+        # f-string binds its declared sync.apply.relation_* shape.
         if t.delete:
             conn.execute(
-                f"DELETE FROM {t.relation} WHERE {where}",
+                f"DELETE FROM {t.relation} WHERE {item_field} = ? "
+                f"AND {group_field} = ?",
                 (item_local, group_local))
             return True
         conn.execute(
@@ -1423,7 +1384,8 @@ class SyncManager:
             except KeyError:
                 return  # newer peer's field this schema lacks — skip
             conn.execute(
-                f"UPDATE {t.relation} SET {f.name} = ? WHERE {where}",
+                f"UPDATE {t.relation} SET {f.name} = ? "
+                f"WHERE {item_field} = ? AND {group_field} = ?",
                 (raw_value, item_local, group_local))
 
         if t.field is not None:

@@ -24,7 +24,7 @@ Design constraints, in order:
   round 9 the lint is sdlint's telemetry pass; the shim remains).
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
-  sanitize | jit | task | timeout | chan | health.
+  sanitize | jit | task | timeout | chan | health | sql.
 - **Windowed reads without resets.** Counters and histograms expose
   `snapshot_delta(cursor)` — an exact delta view since a previous
   cursor — so the health observatory (health.py) can compute windowed
@@ -619,6 +619,38 @@ STORE_WRITE_LOCK_WAIT_SECONDS = histogram(
     "sd_store_write_lock_wait_seconds",
     "Time spent waiting for the per-database write lock",
     buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30))
+STORE_INIT_WARNINGS = counter(
+    "sd_store_init_warnings_total",
+    "Non-fatal problems swallowed while opening a library database "
+    "(e.g. the lazy-index drop failing on a corrupt library) — "
+    "logged at debug, surfaced here so health can see a bad open")
+
+# -- sql statement contracts (store/statements.py + store/sqlaudit.py) ------
+SQL_STATEMENTS = counter(
+    "sd_sql_statements_total",
+    "Executions per declared statement/shape name (runtime SQL "
+    "auditor; `_adhoc` = diagnostic reads through db.query)",
+    labelnames=("name",))
+SQL_ROWS = counter(
+    "sd_sql_rows_total",
+    "Rows flowing through each declared statement: fetched for reads "
+    "(counted by Database.run), affected for writes (cursor rowcount)",
+    labelnames=("name",))
+SQL_UNDECLARED = counter(
+    "sd_sql_undeclared_total",
+    "Statements that matched no declared contract or shape — a "
+    "sql_undeclared sanitizer violation outside the ad-hoc read "
+    "allowance (raised in tier-1, counted in production)")
+SQL_TX_STATEMENTS = histogram(
+    "sd_sql_tx_statements",
+    "Statements executed per committed write transaction — the "
+    "commit-per-item anti-pattern reads as a spike at 1-2",
+    buckets=(1, 2, 5, 10, 25, 100, 500, 1000, 5000, 20000))
+SQL_SCAN = counter(
+    "sd_sql_scan_total",
+    "EXPLAIN-sampled executions whose query plan full-scans a "
+    "registered large table (SDTPU_SQL_EXPLAIN sampling mode)",
+    labelnames=("name",))
 
 # -- api (api/server.py) ----------------------------------------------------
 API_REQUESTS = counter(
@@ -641,7 +673,8 @@ SANITIZE_VIOLATIONS = counter(
     "Runtime-sanitizer detections (SDTPU_SANITIZE=1), by kind: "
     "loop_stall | lock_across_await | lock_order_cycle | "
     "jit_retrace_budget | host_transfer | task_exception | "
-    "task_orphaned | chan_overflow | data_race",
+    "task_orphaned | chan_overflow | data_race | sql_undeclared | "
+    "sql_autocommit_write",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",

@@ -57,16 +57,18 @@ def get_volumes() -> List[Dict]:
 
 
 def save_volumes(db) -> int:
-    """Upsert detected volumes into the @local volume table."""
+    """Upsert detected volumes into the @local volume table — one tx
+    for the whole detection sweep (tx-shape: no tx per volume)."""
     vols = get_volumes()
-    for v in vols:
-        db.upsert(
-            "volume",
-            {"mount_point": v["mount_point"], "name": v["name"]},
-            {
-                "filesystem": v["filesystem"],
-                "total_bytes_capacity": v["total_bytes_capacity"],
-                "total_bytes_available": v["total_bytes_available"],
-                "is_system": int(v["is_system"]),
-            })
+    with db.tx() as conn:
+        for v in vols:
+            db.upsert(
+                "volume",
+                {"mount_point": v["mount_point"], "name": v["name"]},
+                {
+                    "filesystem": v["filesystem"],
+                    "total_bytes_capacity": v["total_bytes_capacity"],
+                    "total_bytes_available": v["total_bytes_available"],
+                    "is_system": int(v["is_system"]),
+                }, conn=conn)
     return len(vols)

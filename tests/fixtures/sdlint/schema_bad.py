@@ -1,0 +1,38 @@
+"""sdlint fixture — schema-parity KNOWN POSITIVES.
+
+Declarations whose SQL has drifted from store/models.py: an unknown
+table, an unknown column on a real table (bare and alias-qualified),
+a tables= set disagreeing with the SQL, and an unindexed filter over
+a registered large table. The declare calls carry sql-discipline
+waivers — central-registry placement is that pass's concern, not this
+fixture's.
+"""
+
+from spacedrive_tpu.store.statements import declare_stmt
+
+
+def declare_bad():
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ghost_table",
+        "SELECT * FROM warp_core WHERE dilithium = ?",
+        verb="read", tables=(), cardinality="one")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ghost_column",
+        "SELECT flux_capacitance FROM tag WHERE id = ?",
+        verb="read", tables=("tag",), cardinality="one")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ghost_qualified",
+        "SELECT t.wormhole FROM tag t WHERE t.id = ?",
+        verb="read", tables=("tag",), cardinality="one")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.drifted_tables",
+        "SELECT id FROM object WHERE id = ?",
+        verb="read", tables=("location",), cardinality="one")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.sequential_scan",
+        "SELECT id FROM file_path WHERE date_modified = ?",
+        verb="read", tables=("file_path",), cardinality="many")

@@ -1,0 +1,39 @@
+"""sdlint fixture — schema-parity KNOWN NEGATIVES.
+
+Registry-faithful declarations: real tables and columns, aliases and
+result aliases, an indexed filter on a large table, a shape with open
+identifier slots, and SQLite internals (rowid, sqlite_master,
+functions).
+"""
+
+from spacedrive_tpu.store.statements import declare_shape, declare_stmt
+
+
+def declare_ok():
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ok_read",
+        "SELECT t.id, t.name AS tag_name FROM tag t "
+        "JOIN tag_on_object tob ON tob.tag_id = t.id "
+        "WHERE tob.object_id = ?",
+        verb="read", tables=("tag", "tag_on_object"),
+        cardinality="many")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ok_indexed_filter",
+        "SELECT COUNT(*) AS n FROM file_path WHERE cas_id = ?",
+        verb="read", tables=("file_path",), cardinality="one")
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ok_write",
+        "UPDATE tag SET name = ?, date_modified = ? WHERE id = ?",
+        verb="write", tables=("tag",), tx_required=True)
+
+    declare_stmt(  # sdlint: ok[sql-discipline]
+        "fixture.ok_internal",
+        "SELECT name FROM sqlite_master WHERE rowid = ?",
+        verb="read", tables=("sqlite_master",), cardinality="one")
+
+    declare_shape(  # sdlint: ok[sql-discipline]
+        "fixture.ok_shape",
+        "SELECT id FROM {i} WHERE {i} = ? ORDER BY id LIMIT ?",
+        verb="read", cardinality="many")

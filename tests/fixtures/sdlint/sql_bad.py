@@ -1,0 +1,63 @@
+"""sdlint fixture — sql-discipline KNOWN POSITIVES.
+
+Every way SQL can dodge the statement contract registry
+(store/statements.py): raw literals at execute methods (direct and
+via a local variable), dynamic SQL matching no declared shape, opaque
+expressions, unknown/dynamic run() names, un-tx'd writes, the removed
+Database.execute surface, and an out-of-central declaration.
+"""
+
+
+def literal_select(db, oid):
+    # sql-literal: raw DML literal at an execute method
+    return db.query_one("SELECT * FROM object WHERE id = ?", (oid,))
+
+
+def literal_insert(conn, pub):
+    # sql-literal: raw write literal on a connection
+    conn.execute("INSERT INTO tag (pub_id) VALUES (?)", (pub,))
+
+
+def literal_via_variable(db):
+    # sql-literal: the literal hides behind a local name
+    sql = "SELECT id FROM location"
+    return db.query(sql)
+
+
+def dynamic_unmatched(conn, table):
+    # sql-dynamic: f-string matching NO declared shape
+    conn.execute(f"UPDATE {table} SET kind = 7 WHERE kind IS NULL")
+
+
+def opaque(conn, mystery_sql):
+    # sql-opaque: the pass cannot see what runs
+    conn.execute(mystery_sql)
+
+
+def unknown_name(db):
+    # run-unknown: not in the registry
+    db.run("store.totally.unknown_statement")
+
+
+def dynamic_name(db, which):
+    # run-dynamic-name: registry linkage must be literal
+    db.run(which)
+
+
+def write_without_conn(db, oid):
+    # write-no-conn: a write-verb statement with no tx connection
+    db.run("node.object_delete", (oid,))
+
+
+def read_on_write_path(library):
+    # read-via-write-path: the removed write-wrapping execute surface
+    library.db.execute("DELETE FROM tag")
+
+
+def rogue_declare():
+    # sql-central: declaring outside store/statements.py
+    from spacedrive_tpu.store.statements import declare_stmt
+
+    declare_stmt(
+        "rogue.statement", "SELECT 1 FROM tag",
+        verb="read", tables=("tag",), cardinality="one")

@@ -1,0 +1,48 @@
+"""sdlint fixture — sql-discipline KNOWN NEGATIVES.
+
+The sanctioned forms: run() with registry names (reads bare, writes
+with conn= or run_tx), dynamic SQL bound to a declared shape,
+registry-pulled SQL text on a bare connection, and non-SQL strings at
+methods that happen to be called execute/run.
+"""
+
+
+def declared_read(db, oid):
+    return db.run("api.object.by_id", (oid,))
+
+
+def declared_write(db, oid):
+    with db.tx() as conn:
+        db.run("node.object_delete", (oid,), conn=conn)
+
+
+def declared_write_sugar(db, oid):
+    db.run_tx("node.object_delete", (oid,))
+
+
+def declared_many(db, conn, rows):
+    db.run_many("identifier.link_paths", rows, conn=conn)
+
+
+def shape_bound(conn, table, col):
+    # binds the declared store.helper.update shape
+    conn.execute(f"UPDATE {table} SET {col} = ? WHERE id = ?", (1, 2))
+
+
+def registry_sql_on_conn(conn, scratch_id):
+    from spacedrive_tpu.store import statements
+
+    conn.execute(statements.get("jobs.scratch.delete").sql,
+                 (scratch_id,))
+
+
+def not_sql(runner, job):
+    # .run()/.execute() on non-database receivers are out of scope
+    runner.run(job)
+    job.execute("not a sql string at all")
+
+
+def subprocess_run():
+    import subprocess
+
+    subprocess.run(["true"], check=False)

@@ -1,0 +1,59 @@
+"""sdlint fixture — tx-shape KNOWN POSITIVES.
+
+The commit-per-item shape in every spelling (lexical with-tx, run_tx,
+helper-without-conn, and an interprocedural opener in a loop), a
+blocking call and an await inside an open tx, a nested-tx call chain,
+and a per-iteration single-row write where executemany exists.
+"""
+
+import time
+
+
+def tx_per_item(db, items):
+    for item in items:
+        with db.tx() as conn:  # the PR 1 identifier shape
+            db.run("node.object_delete", (item,), conn=conn)
+
+
+def run_tx_per_item(db, items):
+    for item in items:
+        db.run_tx("node.object_delete", (item,))
+
+
+def helper_per_item(db, rows):
+    for row in rows:
+        db.insert("tag", row)
+
+
+def _opens_tx(db, row):
+    with db.tx() as conn:
+        db.run("node.object_delete", (row,), conn=conn)
+
+
+def opener_in_loop(db, rows):
+    for row in rows:
+        _opens_tx(db, row)
+
+
+def blocking_inside_tx(db, path):
+    with db.tx() as conn:
+        time.sleep(0.5)
+        data = open(path).read()
+        db.run("node.object_delete", (len(data),), conn=conn)
+
+
+async def await_inside_tx(db, fetch):
+    with db.tx() as conn:
+        row = await fetch()
+        db.run("node.object_delete", (row,), conn=conn)
+
+
+def nested_chain(db, rows):
+    with db.tx() as conn:
+        db.run("node.object_delete", (1,), conn=conn)
+        _opens_tx(db, rows)  # transitively BEGINs inside our tx
+
+
+def row_at_a_time(db, conn, rows):
+    for a, b in rows:
+        db.run("identifier.link_paths", (a, b, 1), conn=conn)

@@ -1,0 +1,39 @@
+"""sdlint fixture — tx-shape KNOWN NEGATIVES.
+
+One tx around the loop with per-row statements riding it, run_many
+batching, blocking work hoisted BEFORE the tx, and a helper that
+rides the caller's connection instead of opening its own.
+"""
+
+
+def one_tx_around_loop(db, items):
+    with db.tx() as conn:
+        for item in items:
+            db.run("node.object_delete", (item,), conn=conn)
+
+
+def batched(db, rows):
+    with db.tx() as conn:
+        db.run_many("identifier.link_paths", rows, conn=conn)
+
+
+def helper_rides_conn(db, conn, row):
+    db.insert("tag", row, conn=conn)
+
+
+def helpers_in_loop_on_one_tx(db, rows):
+    with db.tx() as conn:
+        for row in rows:
+            db.insert("tag", row, conn=conn)
+
+
+def blocking_before_tx(db, path):
+    data = open(path).read()
+    with db.tx() as conn:
+        db.run("node.object_delete", (len(data),), conn=conn)
+
+
+async def await_outside_tx(db, fetch):
+    row = await fetch()
+    with db.tx() as conn:
+        db.run("node.object_delete", (row,), conn=conn)

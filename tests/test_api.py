@@ -157,7 +157,8 @@ def test_backup_restore_roundtrip(env):
         assert (await router.dispatch("backups.getAll"))[0]["id"] == backup_id
         # destroy the tag, then restore
         lib_obj = node.libraries.get(uuid.UUID(lid))
-        lib_obj.db.execute("DELETE FROM tag")
+        for r in lib_obj.db.query("SELECT id FROM tag"):
+            lib_obj.db.delete("tag", r["id"])
         assert await router.dispatch("tags.list", {"library_id": lid}) == []
         await router.dispatch("backups.restore", {"backup_id": backup_id})
         tags = await router.dispatch("tags.list", {"library_id": lid})

@@ -1,13 +1,13 @@
 """sdlint framework: per-pass fixtures, the tree gate, baseline policy.
 
 This is the tier-1 hook that replaced the direct telemetry_lint run:
-`test_tree_clean_within_baseline` runs ALL seventeen passes (five
+`test_tree_clean_within_baseline` runs ALL twenty passes (five
 concurrency/invariant + the round-10 device trio + the round-11
 lifecycle trio + the round-12 resource trio + the round-13
-thread-safety trio: shared-mutation, thread-boundary,
-guard-consistency) over the repo and fails on any finding not in
-tools/sdlint/baseline.json (which may only shrink — budget enforced
-here too). The per-pass tests pin each pass to a known-positive /
+thread-safety trio + the round-16 store trio: sql-discipline,
+tx-shape, schema-parity) over the repo and fails on any finding not
+in tools/sdlint/baseline.json (which may only shrink — budget
+enforced here too). The per-pass tests pin each pass to a known-positive /
 known-negative fixture pair under tests/fixtures/sdlint/, including
 the encoded PR 1 store/db.py reader-registration deadlock shape
 (locks_bad.Pr1Database), the encoded overlap.py:166 call-time-jit
@@ -635,7 +635,8 @@ def test_every_registered_pass_ran_on_tree():
         "dtype-discipline", "host-transfer", "task-lifecycle",
         "cancellation-safety", "timeout-discipline",
         "queue-discipline", "backpressure", "unbounded-growth",
-        "shared-mutation", "thread-boundary", "guard-consistency"}
+        "shared-mutation", "thread-boundary", "guard-consistency",
+        "sql-discipline", "tx-shape", "schema-parity"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -854,3 +855,167 @@ def test_health_read_lint_catches_violations(tmp_path):
     assert "'sd_jobs_unlisted_total' outside the READS table" in text
     assert "sd_health_own_total" not in text
     assert "'sd_jobs_a_total'" not in text
+
+
+# -- sql-discipline / tx-shape / schema-parity (the round-16 store trio) ----
+
+def test_sql_discipline_flags_known_positives():
+    found = _lint_fixture("sql_bad.py", "sql-discipline")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "SELECT * FROM object WHERE id = ?" in \
+        by_code.get("sql-literal", set())
+    assert "INSERT INTO tag (pub_id) VALUES (?)" in \
+        by_code.get("sql-literal", set())
+    # the literal hidden behind a local variable still resolves
+    assert "SELECT id FROM location" in by_code.get("sql-literal", set())
+    assert any("UPDATE" in i for i in by_code.get("sql-dynamic", set()))
+    assert "conn.execute" in by_code.get("sql-opaque", set())
+    assert "store.totally.unknown_statement" in \
+        by_code.get("run-unknown", set())
+    assert "db.run" in by_code.get("run-dynamic-name", set())
+    assert "node.object_delete" in by_code.get("write-no-conn", set())
+    assert "library.db.execute" in \
+        by_code.get("read-via-write-path", set())
+    assert "rogue.statement" in by_code.get("sql-central", set())
+
+
+def test_sql_discipline_passes_known_negatives():
+    assert _lint_fixture("sql_ok.py", "sql-discipline") == []
+
+
+def test_tx_shape_flags_known_positives():
+    found = _lint_fixture("txshape_bad.py", "tx-shape")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add((f.qual, f.ident))
+    loops = by_code.get("tx-in-loop", set())
+    # all four spellings of commit-per-item
+    assert ("tx_per_item", "db.tx") in loops
+    assert ("run_tx_per_item", "db.run_tx") in loops
+    assert ("helper_per_item", "db.insert") in loops
+    assert ("opener_in_loop", "_opens_tx") in loops
+    blocking = {i for _, i in by_code.get("blocking-in-tx", set())}
+    assert {"time.sleep", "open"} <= blocking
+    assert any(q == "await_inside_tx"
+               for q, _ in by_code.get("await-in-tx", set()))
+    assert ("nested_chain", "_opens_tx") in \
+        by_code.get("nested-tx-chain", set())
+    assert ("row_at_a_time", "identifier.link_paths") in \
+        by_code.get("executemany-candidate", set())
+
+
+def test_tx_shape_passes_known_negatives():
+    assert _lint_fixture("txshape_ok.py", "tx-shape") == []
+
+
+def test_schema_parity_flags_known_positives():
+    found = _lint_fixture("schema_bad.py", "schema-parity")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "fixture.ghost_table:warp_core" in \
+        by_code.get("unknown-table", set())
+    assert "fixture.ghost_column:flux_capacitance" in \
+        by_code.get("unknown-column", set())
+    assert "fixture.ghost_qualified:tag.wormhole" in \
+        by_code.get("unknown-column", set())
+    assert "fixture.drifted_tables" in \
+        by_code.get("tables-drift", set())
+    assert "fixture.sequential_scan:file_path" in \
+        by_code.get("unindexed-filter", set())
+
+
+def test_schema_parity_passes_known_negatives():
+    assert _lint_fixture("schema_ok.py", "schema-parity") == []
+
+
+def test_sql_registry_static_runtime_parity():
+    """The AST view the passes judge must equal the runtime registry
+    the auditor enforces — name for name, verb for verb, shape for
+    shape (same drift contract as the channel/owner registries)."""
+    from spacedrive_tpu.store import statements
+    from tools.sdlint.passes import _sql
+
+    decls = _sql.registry_decls(ROOT)
+    runtime = dict(statements.STATEMENTS)
+    runtime.update(statements.SHAPES)
+    assert set(decls) == set(runtime), (
+        set(decls) ^ set(runtime))
+    for name, d in decls.items():
+        st = runtime[name]
+        assert d.verb == st.verb, name
+        assert d.shape == st.shape, name
+        assert d.tx_required == st.tx_required, name
+        assert tuple(d.tables) == st.tables, name
+        assert d.coverage == st.coverage, name
+    # the pass-side constant sets mirror statements.py
+    from tools.sdlint.passes import schema_parity
+
+    assert schema_parity.LARGE_TABLES == set(statements.LARGE_TABLES)
+
+
+def test_every_write_statement_is_tx_scoped():
+    """THE acceptance invariant for ROADMAP item 4's actor split:
+    no write-verb contract exists outside transaction scope — the
+    registry refuses autocommit writes at declare time, and this
+    pins the whole current inventory."""
+    from spacedrive_tpu.store import statements
+
+    writes = [st for st in statements.all_statements()
+              if st.verb == "write"]
+    assert writes, "inventory lost its writes?"
+    for st in writes:
+        assert st.tx_required, f"{st.name} is an autocommit write"
+    # and the registry enforces it for future declarations
+    import pytest
+
+    with pytest.raises(statements.SqlContractError):
+        statements.declare_stmt(
+            "fixture.autocommit", "DELETE FROM tag WHERE id = ?",
+            verb="write", tables=("tag",), tx_required=False)
+
+
+def test_every_declared_statement_is_referenced():
+    """Inventory↔usage drift: every exact statement name appears at a
+    run()/run_many()/run_tx() call site (or inside store/db.py's
+    engine room), every shape's pattern matches at least one dynamic
+    call site — no dead contracts. tools-coverage statements may live
+    in tools/ only."""
+    import ast
+
+    from tools.sdlint.core import load_project
+    from tools.sdlint.passes import _sql
+
+    project = load_project(ROOT)
+    decls = _sql.registry_decls(ROOT)
+    shapes = _sql.ShapeIndex(decls)
+    used_names = set()
+    matched_shapes = set()
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and arg.value in decls:
+                    used_names.add(arg.value)
+                dyn = _sql.dynamic_sql_expr(arg)
+                if dyn is not None:
+                    hit = shapes.match(dyn)
+                    if hit is not None:
+                        matched_shapes.add(hit.name)
+    # db.py builds the helper shapes' SQL from dicts (not matchable
+    # statically) and executes store.init/last_rowid internally.
+    engine_bound = {n for n in decls
+                    if n.startswith("store.helper.")}
+    unused = [n for n, d in decls.items()
+              if not d.shape and n not in used_names
+              and n not in engine_bound
+              and n != "store.init.instance_count"]
+    assert not unused, f"declared but never referenced: {unused}"
+    dead_shapes = [n for n, d in decls.items()
+                   if d.shape and n not in matched_shapes
+                   and n not in engine_bound]
+    assert not dead_shapes, f"shapes matching no call site: {dead_shapes}"

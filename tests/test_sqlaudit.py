@@ -1,0 +1,258 @@
+"""Runtime SQL auditor (store/sqlaudit.py) + statement registry.
+
+The dynamic half of the round-16 store passes: contract matching on
+every executed statement, the autocommit-write and undeclared-
+statement violations, the ad-hoc read allowance, the per-tx statement
+histogram, shape matching with registry-identifier validation, and
+the read-path/write-path split regression (reads must not serialize
+behind the write lock)."""
+
+import threading
+import time
+
+import pytest
+
+from spacedrive_tpu import sanitize
+from spacedrive_tpu.store import sqlaudit, statements
+from spacedrive_tpu.store.db import Database
+from spacedrive_tpu.telemetry import snapshot
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(str(tmp_path / "lib.db"))
+    yield d
+    d.close()
+
+
+def _metric(name, label=None):
+    fam = snapshot().get(name)
+    if fam is None:
+        return 0.0
+    if label is None:
+        return fam.get("value", 0.0)
+    for child in fam.get("labeled", []):
+        if child["labels"].get("name") == label:
+            return child["value"]
+    return 0.0
+
+
+# -- registry round-trip -----------------------------------------------------
+
+def test_registry_round_trip():
+    st = statements.get("api.tag.by_id")
+    assert st.verb == "read"
+    assert st.cardinality == "one"
+    assert st.tables == ("tag",)
+    assert statements.lookup_sql(st.sql) is st
+    # whitespace never changes identity
+    assert statements.lookup_sql(
+        "SELECT  *\n FROM tag   WHERE id = ?;") is st
+
+
+def test_registry_validation_raises():
+    E = statements.SqlContractError
+    with pytest.raises(E):
+        statements.get("no.such.statement")
+    with pytest.raises(E):  # name discipline
+        statements.declare_stmt("NotDotted", "SELECT 1 FROM tag",
+                                verb="read", cardinality="one")
+    with pytest.raises(E):  # verb vs leading keyword
+        statements.declare_stmt(
+            "fixture.verb_clash", "DELETE FROM tag WHERE id = ?",
+            verb="read", tables=("tag",), cardinality="one")
+    with pytest.raises(E):  # unknown table at declare time
+        statements.declare_stmt(
+            "fixture.ghost", "SELECT 1 FROM warp_core",
+            verb="read", tables=("warp_core",), cardinality="one")
+    with pytest.raises(E):  # duplicate SQL must reuse the name
+        statements.declare_stmt(
+            "fixture.duplicate", "SELECT * FROM tag WHERE id = ?",
+            verb="read", tables=("tag",), cardinality="one")
+
+
+def test_shape_matching_validates_registry_identifiers():
+    # a real helper-shaped INSERT matches...
+    assert statements.lookup_sql(
+        "INSERT INTO tag (pub_id, name) VALUES (?, ?)"
+    ).name == "bench.tag_insert"  # exact beats shape
+    assert statements.lookup_sql(
+        "INSERT INTO tag (pub_id, name, color) VALUES (?, ?, ?)"
+    ).name == "store.helper.insert"
+    # ...but an off-registry table does NOT (the `{i}` slot check)
+    assert statements.lookup_sql(
+        "INSERT INTO warp_core (pub_id) VALUES (?)") is None
+    assert statements.lookup_sql(
+        "UPDATE tag SET name = ? WHERE id = ?"
+    ).name == "store.helper.update"
+    assert statements.lookup_sql(
+        "UPDATE warp_core SET name = ? WHERE id = ?") is None
+
+
+def test_sql_table_renders_every_statement():
+    md = statements.sql_table_markdown()
+    for st in statements.all_statements():
+        assert f"`{st.name}`" in md
+    assert "| read |" in md and "| write |" in md
+
+
+# -- armed behavior ----------------------------------------------------------
+# conftest installs the sanitizer in raise mode, so the auditor is
+# armed for every Database this suite constructs.
+
+def test_declared_statements_flow_and_count(db):
+    tid = db.insert("tag", {"pub_id": b"t" * 16, "name": "x"})
+    before = _metric("sd_sql_statements_total", "api.tag.by_id")
+    row = db.run("api.tag.by_id", (tid,))
+    assert row["name"] == "x"
+    assert _metric("sd_sql_statements_total", "api.tag.by_id") == \
+        before + 1
+    assert _metric("sd_sql_rows_total", "api.tag.by_id") >= 1
+
+
+def test_run_cardinalities(db):
+    db.insert("tag", {"pub_id": b"u" * 16, "name": "y"})
+    assert db.run("store.init.instance_count") == 0  # scalar
+    rows = db.run("api.tag.all")                     # many
+    assert isinstance(rows, list) and len(rows) == 1
+    assert db.run("api.tag.by_id", (999,)) is None   # one
+
+
+def test_undeclared_statement_raises(db):
+    with pytest.raises(sanitize.SanitizerViolation,
+                       match="sql_undeclared"):
+        db._conn().execute("SELECT 1 FROM tag WHERE rowid > 3")
+    sanitize.reset_violations()
+
+
+def test_adhoc_allowance_covers_reads_not_writes(db):
+    # db.query IS the ad-hoc diagnostic surface
+    assert db.query("SELECT name FROM tag") == []
+    assert _metric("sd_sql_statements_total", "_adhoc") >= 1
+    # the allowance never excuses a write
+    with pytest.raises(sanitize.SanitizerViolation,
+                       match="sql_undeclared"):
+        with sqlaudit.adhoc():
+            db._conn().execute(
+                "UPDATE tag SET color = 'x' WHERE name = 'nope'")
+    sanitize.reset_violations()
+
+
+def test_autocommit_write_raises(db):
+    tid = db.insert("tag", {"pub_id": b"v" * 16, "name": "z"})
+    with pytest.raises(sanitize.SanitizerViolation,
+                       match="sql_autocommit_write"):
+        db._conn().execute(statements.get("node.object_delete").sql,
+                           (tid,))
+    sanitize.reset_violations()
+    # the same statement inside tx() is the sanctioned path
+    with db.tx() as conn:
+        db.run("api.tag.clear_assignments", (tid,), conn=conn)
+
+
+def test_write_without_conn_refused(db):
+    with pytest.raises(statements.SqlContractError,
+                       match="tx_required|pass conn"):
+        db.run("node.object_delete", (1,))
+    with pytest.raises(statements.SqlContractError):
+        db.run_many("identifier.link_paths", [("c", 1, 1)])
+    # run_tx is the single-statement sugar
+    db.run_tx("api.notification.dismiss_all")
+
+
+def test_tx_statement_histogram_observes(db):
+    before = snapshot().get("sd_sql_tx_statements", {}).get(
+        "count", 0)
+    with db.tx() as conn:
+        for i in range(5):
+            db.insert("tag", {"pub_id": bytes([i]) * 16,
+                              "name": f"t{i}"}, conn=conn)
+    fam = snapshot()["sd_sql_tx_statements"]
+    assert fam["count"] == before + 1
+    # 5 inserts counted into the committed tx's bucket
+
+
+def test_explain_sampling_counts_scans(tmp_path, monkeypatch):
+    monkeypatch.setenv("SDTPU_SQL_EXPLAIN", "1")
+    sqlaudit.disarm()
+    sqlaudit.arm("raise", sanitize.record)
+    try:
+        d = Database(str(tmp_path / "scan.db"))
+        before = _metric("sd_sql_scan_total", "bench.file_count")
+        # is_dir filter over file_path has no index — EXPLAIN flags it
+        d.run("bench.file_count")
+        assert _metric("sd_sql_scan_total", "bench.file_count") == \
+            before + 1
+        # an indexed probe is NOT a scan
+        before_ok = _metric("sd_sql_scan_total", "api.file_path.by_id")
+        d.run("api.file_path.by_id", (1,))
+        assert _metric("sd_sql_scan_total",
+                       "api.file_path.by_id") == before_ok
+        d.close()
+    finally:
+        monkeypatch.setenv("SDTPU_SQL_EXPLAIN", "0")
+        sqlaudit.disarm()
+        sqlaudit.arm("raise", sanitize.record)
+
+
+def test_executed_names_feeds_drift_surface(db):
+    db.run("store.object_count")
+    assert sqlaudit.executed_names().get("store.object_count", 0) >= 1
+
+
+# -- satellite regressions ---------------------------------------------------
+
+def test_reads_do_not_take_the_write_lock(db):
+    """The Database.execute split: a writer holding the write lock in
+    a long transaction must NOT block run()'s read path (the old
+    wrapper serialized every read behind BEGIN IMMEDIATE)."""
+    db.insert("tag", {"pub_id": b"w" * 16, "name": "held"})
+    in_tx = threading.Event()
+    release = threading.Event()
+
+    def long_writer():
+        with db.tx() as conn:
+            db.insert("tag", {"pub_id": b"x" * 16, "name": "w2"},
+                      conn=conn)
+            in_tx.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=long_writer)
+    t.start()
+    try:
+        assert in_tx.wait(timeout=10)
+        t0 = time.perf_counter()
+        rows = db.run("api.tag.all")
+        dt = time.perf_counter() - t0
+        assert any(r["name"] == "held" for r in rows)
+        # a read behind the old write-wrapping execute would block
+        # until `release` — bound it well under the writer's hold
+        assert dt < 2.0, f"read serialized behind the write lock ({dt:.2f}s)"
+    finally:
+        release.set()
+        t.join(timeout=10)
+
+
+def test_lazy_index_drop_failure_is_counted(tmp_path, monkeypatch):
+    """Satellite: the init-time lazy-index drop must not swallow
+    errors silently — it logs at debug and counts into
+    sd_store_init_warnings_total."""
+    from spacedrive_tpu.store import db as db_mod
+
+    before = _metric("sd_store_init_warnings_total")
+    real_get = statements.get
+
+    class _Boom:
+        # DDL head passes the auditor untouched; sqlite rejects the
+        # missing table — exactly the corrupt-library error class
+        sql = "CREATE INDEX idx_boom ON no_such_table_anywhere (x)"
+
+    def fake_get(name):
+        if name == "store.init.instance_count":
+            return _Boom
+        return real_get(name)
+
+    monkeypatch.setattr(db_mod.statements, "get", fake_get)
+    d = Database(str(tmp_path / "warn.db"))  # probe fails, open survives
+    d.close()
+    assert _metric("sd_store_init_warnings_total") == before + 1

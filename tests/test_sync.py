@@ -508,9 +508,8 @@ def test_quarantined_op_drains_after_schema_upgrade(pair):
     a, b = pair
     pub = uuid.uuid4().bytes
     op = a.shared_create("tag", pub, {"name": "from-the-future"})[0]
-    b.db.execute(
-        "INSERT INTO quarantined_op (op_id, timestamp, data) "
-        "VALUES (?, ?, ?)", (op.id, op.timestamp, op.pack()))
+    b.db.insert("quarantined_op", {
+        "op_id": op.id, "timestamp": op.timestamp, "data": op.pack()})
     b2 = SM(b.db, b.instance)  # "restart after upgrade"
     row = b2.db.query_one("SELECT name FROM tag WHERE pub_id = ?", (pub,))
     assert row is not None and row["name"] == "from-the-future"

@@ -80,15 +80,13 @@ def test_cleanup_removes_unreferenced(node, tmp_path):
         ])
         await asyncio.wait_for(b.done.wait(), 10)
         # Reference one cas_id from the library; the other is orphaned.
-        lib.db.execute(
-            "INSERT INTO location (pub_id, name, path) VALUES (?,?,?)",
-            (os.urandom(16), "l", str(tmp_path)))
+        lib.db.insert("location", {
+            "pub_id": os.urandom(16), "name": "l", "path": str(tmp_path)})
         loc = lib.db.query_one("SELECT id FROM location")["id"]
-        lib.db.execute(
-            "INSERT INTO file_path (pub_id, location_id, cas_id, "
-            "materialized_path, name, extension, is_dir) "
-            "VALUES (?,?,?,?,?,?,0)",
-            (os.urandom(16), loc, "11112222333344445", "/", "pic", "png"))
+        lib.db.insert("file_path", {
+            "pub_id": os.urandom(16), "location_id": loc,
+            "cas_id": "11112222333344445", "materialized_path": "/",
+            "name": "pic", "extension": "png", "is_dir": 0})
         removed = node.thumbnailer.clean_up()
         assert removed == 1
         assert node.thumbnailer.exists("11112222333344445")

@@ -87,6 +87,15 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         # The artifact should cover THIS run only, not whatever the
         # process did before (the registry is process-global).
         telemetry.reset()
+        # Arm the SQL auditor in COUNT mode so the `sql` stage carries
+        # per-statement counts and the tx histogram on unsanitized
+        # bench runs (violations count, never raise). Before any
+        # Database opens — the factory is read per connection.
+        from spacedrive_tpu import sanitize
+        from spacedrive_tpu.store import sqlaudit
+
+        if not sqlaudit.armed():
+            sqlaudit.arm("count", sanitize.record)
         # Whole-run health window: cursors established here, sampled
         # once at the end — the artifact's `health` stage shows what
         # saturated DURING the run, next to the numbers it explains.
@@ -121,15 +130,14 @@ async def run(files: int, backend: str, images: int, keep: str | None,
         dt = time.perf_counter() - t0
         assert status in (JobStatus.COMPLETED,
                           JobStatus.COMPLETED_WITH_ERRORS), (name, status)
-        n = lib.db.query_one(
-            "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0")["n"]
+        n = lib.db.run("bench.file_count")["n"]
         line = {
             "stage": name, "seconds": round(dt, 2),
             "files": n, "files_per_sec": round(n / dt, 1),
             "status": int(status),
         }
         from spacedrive_tpu.jobs.report import JobReport
-        row = lib.db.query_one("SELECT * FROM job WHERE id = ?", (jid,))
+        row = lib.db.run("jobs.report.by_id", (jid,))
         report = JobReport.from_row(row) if row else None
         if report and report.metadata.get("phase_ms"):
             # Where the ms/file goes (fetch/prep/hash/db/ops), summed
@@ -197,19 +205,13 @@ async def run(files: int, backend: str, images: int, keep: str | None,
 
         await stage("near_dup",
                     NearDupDetectorJob(location_id=loc, threshold=10))
-        near = lib.db.query_one(
-            "SELECT COUNT(*) AS n FROM media_data "
-            "WHERE phash IS NOT NULL")["n"]
-        pairs = lib.db.query_one(
-            "SELECT COUNT(*) AS n FROM near_dup_pair "
-            "WHERE distance <= 10")["n"]
+        near = lib.db.run("bench.phash_count")["n"]
+        pairs = lib.db.run("bench.pair_count")["n"]
         emit({"stage": "near_dup_hashed", "hashed_images": near,
               "near_dup_pairs": pairs})
 
-    n_objects = lib.db.query_one("SELECT COUNT(*) AS n FROM object")["n"]
-    n_paths = lib.db.query_one(
-        "SELECT COUNT(*) AS n FROM file_path WHERE is_dir = 0 "
-        "AND cas_id IS NOT NULL")["n"]
+    n_objects = lib.db.run("store.object_count")["n"]
+    n_paths = lib.db.run("bench.identified_count")["n"]
     emit({
         "stage": "summary", "identified_paths": n_paths,
         "objects": n_objects,
@@ -263,6 +265,13 @@ async def run(files: int, backend: str, images: int, keep: str | None,
               "window_s": hsnap["window_s"],
               "states": hsnap["states"],
               "attribution": hsnap["attribution"]})
+        # Store-seam evidence (round 16): which declared statements
+        # the run actually executed, by count and by rows, plus the
+        # per-tx statement histogram — a commit-per-item regression
+        # in any job shows up RIGHT HERE as a 1-2-statement spike.
+        from spacedrive_tpu.store import sqlaudit
+
+        emit({"stage": "sql", **sqlaudit.stage_summary()})
     if json_out:
         with open(json_out, "w") as f:
             json.dump({

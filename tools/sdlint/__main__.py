@@ -12,6 +12,7 @@
     python -m tools.sdlint --flag-table        # README flag table stdout
     python -m tools.sdlint --timeout-table     # README timeout table
     python -m tools.sdlint --chan-table        # README channel table
+    python -m tools.sdlint --sql-table         # README statement table
     python -m tools.sdlint --stats             # per-pass counts + wall-time
 
 Exit status: 0 when every finding is baselined (or none), 1 otherwise.
@@ -94,6 +95,9 @@ def main(argv=None) -> int:
     ap.add_argument("--owner-table", action="store_true",
                     help="print the generated thread-ownership "
                          "contract table and exit")
+    ap.add_argument("--sql-table", action="store_true",
+                    help="print the generated SQL statement-contract "
+                         "table (the store's read/write seam) and exit")
     ap.add_argument("--stats", action="store_true",
                     help="per-pass finding counts and wall-time "
                          "(informational; exit 0)")
@@ -137,6 +141,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import threadctx
         print(threadctx.owner_table_markdown())
+        return 0
+
+    if args.sql_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu.store import statements
+        print(statements.sql_table_markdown())
         return 0
 
     if args.stats:

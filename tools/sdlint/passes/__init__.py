@@ -21,6 +21,9 @@ from .unbounded_growth import UnboundedGrowthPass
 from .shared_mutation import SharedMutationPass
 from .thread_boundary import ThreadBoundaryPass
 from .guard_consistency import GuardConsistencyPass
+from .sql_discipline import SqlDisciplinePass
+from .tx_shape import TxShapePass
+from .schema_parity import SchemaParityPass
 
 PASSES = {
     p.name: p for p in (
@@ -33,6 +36,7 @@ PASSES = {
         UnboundedGrowthPass(),
         SharedMutationPass(), ThreadBoundaryPass(),
         GuardConsistencyPass(),
+        SqlDisciplinePass(), TxShapePass(), SchemaParityPass(),
     )
 }
 

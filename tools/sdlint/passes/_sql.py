@@ -1,0 +1,366 @@
+"""Shared SQL-contract derivation for the store passes.
+
+Pure-AST views of the two registries the store passes cross-check
+(same no-package-import constraint as crdt_parity / the timeout
+cross-check in backpressure):
+
+- `collect_decls` parses `declare_stmt` / `declare_shape` calls —
+  from the central registry (spacedrive_tpu/store/statements.py) and
+  from any project file (fixtures declare their own bad/ok cases).
+  Only literal arguments participate; a computed declaration is
+  invisible to the static side and is reported by sql-discipline's
+  central-registry code.
+- `models_schema` parses store/models.py into tables → columns plus
+  the index surface (pk / unique / index / lazy_index first columns)
+  schema-parity validates statements against.
+- `ShapeIndex` compiles declared shape skeletons into matchers for
+  BOTH sides of the contract: the runtime auditor matches rendered
+  SQL; here the static side matches f-string call sites whose
+  FormattedValue slots are replaced by a sentinel identifier.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import SourceFile, dotted
+
+STATEMENTS_PATH = "spacedrive_tpu/store/statements.py"
+MODELS_PATH = "spacedrive_tpu/store/models.py"
+
+# Mirrors statements.py (the runtime registry validates the same way;
+# the drift test in tests/test_sdlint.py pins the two sets equal).
+DML_HEADS = ("SELECT", "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH")
+WRITE_HEADS = ("INSERT", "UPDATE", "DELETE", "REPLACE")
+
+_WS_RE = re.compile(r"\s+")
+_IDENT = r"[A-Za-z_][A-Za-z0-9_]*"
+# The sentinel a call-site f-string slot renders to for matching.
+DYN = "sdlint_dyn"
+
+
+def normalize_sql(sql: str) -> str:
+    return _WS_RE.sub(" ", sql).strip().rstrip(";").strip()
+
+
+def sql_head(sql: str) -> str:
+    s = normalize_sql(sql)
+    return s.split(" ", 1)[0].upper() if s else ""
+
+
+@dataclass(frozen=True)
+class Decl:
+    name: str
+    sql: str                 # normalized; skeleton text for shapes
+    verb: str
+    tables: Tuple[str, ...]
+    tx_required: bool
+    cardinality: str
+    coverage: str
+    shape: bool
+    path: str
+    lineno: int
+
+
+def _const(node) -> Optional[object]:
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Tuple) and all(
+            isinstance(e, ast.Constant) for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def decls_in_tree(tree: ast.AST, relpath: str) -> List[Decl]:
+    out: List[Decl] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        last = d.split(".")[-1]
+        if last not in ("declare_stmt", "declare_shape"):
+            continue
+        args = list(node.args)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        name = _const(args[0]) if args else None
+        sql = _const(args[1]) if len(args) > 1 else None
+        if not isinstance(name, str) or not isinstance(sql, str):
+            continue  # computed declaration — invisible statically
+        verb = _const(kw.get("verb")) or ""
+        tables = _const(kw.get("tables")) or ()
+        if isinstance(tables, str):
+            tables = (tables,)
+        tx = bool(_const(kw.get("tx_required")) or False)
+        card = _const(kw.get("cardinality"))
+        if not isinstance(card, str):
+            card = "none" if verb != "read" else ""
+        coverage = _const(kw.get("coverage")) or "tier1"
+        out.append(Decl(
+            name, normalize_sql(sql), str(verb), tuple(tables), tx,
+            str(card), str(coverage), last == "declare_shape",
+            relpath, node.lineno))
+    return out
+
+
+def registry_decls(root: str) -> Dict[str, Decl]:
+    """Declarations from the central registry file (by AST)."""
+    path = os.path.join(root, STATEMENTS_PATH)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return {}
+    return {d.name: d for d in decls_in_tree(tree, STATEMENTS_PATH)}
+
+
+def project_decls(project) -> Dict[str, Decl]:
+    """Central registry + declarations inside the linted files
+    (fixtures). Project files win on name collision so fixture cases
+    stay self-contained."""
+    decls = registry_decls(project.root)
+    for src in project.files:
+        if src.relpath == STATEMENTS_PATH:
+            continue
+        for d in decls_in_tree(src.tree, src.relpath):
+            decls[d.name] = d
+    return decls
+
+
+# -- shape matching ---------------------------------------------------------
+
+class ShapeIndex:
+    """Compiled shape skeletons. `{i}`/`{w}` slots become regex groups;
+    the static side matches call-site skeletons whose dynamic slots
+    render as the DYN sentinel (registry membership of `{i}` captures
+    is the runtime auditor's job — statically the identifier is
+    unknown)."""
+
+    def __init__(self, decls: Dict[str, Decl]):
+        self.patterns: List[Tuple[re.Pattern, Decl]] = []
+        for d in decls.values():
+            if not d.shape:
+                continue
+            parts = []
+            for tok in re.split(r"(\{i\}|\{w\})", d.sql):
+                if tok == "{i}":
+                    parts.append(f"(?:{_IDENT})")
+                elif tok == "{w}":
+                    parts.append(r"(?:.*?)")
+                else:
+                    parts.append(re.escape(tok))
+            self.patterns.append(
+                (re.compile("^" + "".join(parts) + "$", re.DOTALL), d))
+
+    def match(self, rendered: str) -> Optional[Decl]:
+        rendered = normalize_sql(rendered)
+        for pat, d in self.patterns:
+            if pat.match(rendered):
+                return d
+        return None
+
+
+def render_fstring(node: ast.JoinedStr) -> str:
+    """An f-string with every dynamic slot replaced by the sentinel
+    identifier — the static half of shape matching."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append(DYN)
+    return "".join(parts)
+
+
+def literal_sql(node: ast.AST) -> Optional[str]:
+    """String constant (incl. implicit concatenation) that LOOKS like
+    DML SQL, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if sql_head(node.value) in DML_HEADS:
+            return node.value
+    return None
+
+
+def dynamic_sql_expr(node: ast.AST) -> Optional[str]:
+    """Rendered sentinel text when `node` is a dynamically-BUILT SQL
+    string (f-string, %-format, .format, +-concatenation) whose
+    constant prefix looks like DML; else None."""
+    if isinstance(node, ast.JoinedStr):
+        rendered = render_fstring(node)
+        if sql_head(rendered) in DML_HEADS:
+            return rendered
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Mod)):
+        left = node.left
+        while isinstance(left, ast.BinOp):
+            left = left.left
+        base = None
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            base = left.value
+        elif isinstance(left, ast.JoinedStr):
+            base = render_fstring(left)
+        if base is not None and sql_head(base) in DML_HEADS:
+            return _render_concat(node)
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        if d is not None and d.split(".")[-1] == "format":
+            recv = node.func
+            if isinstance(recv, ast.Attribute) and isinstance(
+                    recv.value, ast.Constant) and isinstance(
+                    recv.value.value, str):
+                if sql_head(recv.value.value) in DML_HEADS:
+                    return re.sub(r"\{[^}]*\}", DYN, recv.value.value)
+    return None
+
+
+def _render_concat(node: ast.AST) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return render_fstring(node)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _render_concat(node.left) + _render_concat(node.right)
+    return DYN
+
+
+# -- models schema ----------------------------------------------------------
+
+@dataclass
+class ModelsInfo:
+    columns: Dict[str, Set[str]] = field(default_factory=dict)
+    # per table: columns that can answer an indexed lookup (pk, unique
+    # field, first column of a unique/index/lazy_index tuple)
+    indexed: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+def models_schema(root: str) -> ModelsInfo:
+    info = ModelsInfo()
+    path = os.path.join(root, MODELS_PATH)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return info
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) == "register"):
+            continue
+        for arg in node.args:
+            if not (isinstance(arg, ast.Call)
+                    and dotted(arg.func) == "Model"):
+                continue
+            name = None
+            if arg.args and isinstance(arg.args[0], ast.Constant):
+                name = arg.args[0].value
+            if not isinstance(name, str):
+                continue
+            cols: Set[str] = set()
+            idx: Set[str] = set()
+            fields_node = arg.args[1] if len(arg.args) > 1 else None
+            if isinstance(fields_node, ast.Tuple):
+                for f in fields_node.elts:
+                    if isinstance(f, ast.Call):
+                        fd = dotted(f.func)
+                        if fd == "Field" and f.args and isinstance(
+                                f.args[0], ast.Constant):
+                            cname = f.args[0].value
+                            cols.add(cname)
+                            for k in f.keywords:
+                                if k.arg in ("primary_key", "unique") \
+                                        and isinstance(k.value,
+                                                       ast.Constant) \
+                                        and k.value.value:
+                                    idx.add(cname)
+                        elif fd == "_id":
+                            cols.add("id")
+                            idx.add("id")
+                        elif fd == "_pub_id":
+                            cols.add("pub_id")
+                            idx.add("pub_id")
+            for k in arg.keywords:
+                if k.arg in ("uniques", "indexes", "lazy_indexes") \
+                        and isinstance(k.value, ast.Tuple):
+                    for tup in k.value.elts:
+                        if isinstance(tup, ast.Tuple) and tup.elts \
+                                and isinstance(tup.elts[0],
+                                               ast.Constant):
+                            idx.add(tup.elts[0].value)
+            info.columns[name] = cols
+            info.indexed[name] = idx
+    return info
+
+
+# -- lightweight SQL introspection ------------------------------------------
+
+_STRING_RE = re.compile(r"'(?:[^']|'')*'")
+_TABLE_RE = re.compile(
+    r"\b(?:FROM|JOIN|INTO|UPDATE)\s+(" + _IDENT + r")", re.IGNORECASE)
+_QUAL_RE = re.compile(r"\b(" + _IDENT + r")\.(" + _IDENT + r"|\*)")
+_AS_RE = re.compile(r"\bAS\s+(" + _IDENT + r")", re.IGNORECASE)
+_ALIAS_RE = re.compile(
+    r"\b(?:FROM|JOIN)\s+(" + _IDENT + r")\s+(?:AS\s+)?(" + _IDENT + r")",
+    re.IGNORECASE)
+_IDENT_RE = re.compile(r"\b(" + _IDENT + r")\b")
+
+# Keywords + SQLite functions that appear in this inventory's SQL.
+SQL_WORDS = frozenset(w.upper() for w in """
+select from where and or not in as join left right inner outer on
+group by order limit offset insert into values update set delete
+replace distinct having asc desc like escape is null between exists
+case when then else end union all conflict do nothing excluded
+count max min sum avg lower upper replace coalesce length abs
+last_insert_rowid strftime glob primary key
+ignore abort fail rollback savepoint release begin immediate
+""".split())
+
+
+def strip_strings(sql: str) -> str:
+    return _STRING_RE.sub("''", sql)
+
+
+def parse_tables(sql: str) -> Set[str]:
+    s = strip_strings(normalize_sql(sql))
+    return {m.group(1) for m in _TABLE_RE.finditer(s)
+            if m.group(1) != DYN and m.group(1).upper() not in SQL_WORDS}
+
+
+def parse_identifiers(sql: str) -> Tuple[Set[str], Dict[str, str],
+                                         Set[str]]:
+    """(bare identifier tokens, alias→table map, result aliases) of a
+    statement — everything schema-parity needs to resolve columns."""
+    s = strip_strings(normalize_sql(sql))
+    aliases: Dict[str, str] = {}
+    for m in _ALIAS_RE.finditer(s):
+        tbl, al = m.group(1), m.group(2)
+        if al.upper() not in SQL_WORDS and tbl.upper() not in SQL_WORDS:
+            aliases[al] = tbl
+    result_aliases = {m.group(1) for m in _AS_RE.finditer(s)}
+    # Qualified refs (alias.col) are checked separately — strip them
+    # so neither half leaks into the bare-identifier sweep.
+    bare_src = _QUAL_RE.sub(" ", s)
+    idents = {m.group(1) for m in _IDENT_RE.finditer(bare_src)
+              if m.group(1).upper() not in SQL_WORDS}
+    return idents, aliases, result_aliases
+
+
+def parse_qualified(sql: str) -> List[Tuple[str, str]]:
+    s = strip_strings(normalize_sql(sql))
+    return [(m.group(1), m.group(2)) for m in _QUAL_RE.finditer(s)]
+
+
+def where_columns(sql: str) -> Set[str]:
+    """Identifier tokens inside WHERE/ON clauses (filter surface)."""
+    s = strip_strings(normalize_sql(sql))
+    out: Set[str] = set()
+    for m in re.finditer(
+            r"\b(?:WHERE|ON)\b(.*?)(?=\bGROUP\b|\bORDER\b|\bLIMIT\b|$)",
+            s, re.IGNORECASE | re.DOTALL):
+        clause = m.group(1)
+        for t in _IDENT_RE.finditer(clause):
+            tok = t.group(1)
+            if tok.upper() not in SQL_WORDS and tok != DYN:
+                out.add(tok)
+    return out

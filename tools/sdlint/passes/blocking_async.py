@@ -49,6 +49,7 @@ _PREFIXES = ("subprocess.", "shutil.")
 # Database / connection / cursor (this codebase's naming idiom).
 _DB_METHODS = {
     "query", "query_one", "execute", "executemany", "executescript",
+    "run", "run_many", "run_tx",
     "commit", "rollback", "insert", "insert_many", "update", "upsert",
     "delete", "tx", "checkpoint", "checkpoint_passive",
     "ensure_lazy_indexes",

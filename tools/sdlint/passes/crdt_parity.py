@@ -34,10 +34,12 @@ import re
 from typing import Dict, List, Optional, Set
 
 from ..core import Finding, Project, dotted, own_body_walk
+from . import _sql
 
 PASS = "crdt-parity"
 
 _HELPERS = {"insert", "insert_many", "update", "upsert", "delete"}
+_RUN_LASTS = {"run", "run_many", "run_tx"}
 _EMITTERS = {"bulk_shared_ops", "_insert_op_rows", "write_ops"}
 _EXEMPT_PREFIXES = ("spacedrive_tpu/sync/", "spacedrive_tpu/store/")
 _EXEMPT_FILES = {"spacedrive_tpu/backups.py"}
@@ -98,6 +100,10 @@ class CrdtParityPass:
         tables = synced_tables(project.root)
         if not tables:
             return []
+        # registry view: run("name") write statements resolve their
+        # target tables through store/statements.py (round 16 — the
+        # SQL text moved out of the call sites).
+        decls = _sql.project_decls(project)
         findings: List[Finding] = []
         for fn in project.index.funcs:
             rel = fn.src.relpath
@@ -108,7 +114,7 @@ class CrdtParityPass:
             for node in own_body_walk(fn.node):
                 if not isinstance(node, ast.Call):
                     continue
-                hit = self._write_target(node, tables)
+                hit = self._write_target(node, tables, decls)
                 if hit is None or emits:
                     continue
                 if hit in seen:
@@ -131,7 +137,8 @@ class CrdtParityPass:
         return False
 
     @staticmethod
-    def _write_target(call: ast.Call, tables: Set[str]) -> Optional[str]:
+    def _write_target(call: ast.Call, tables: Set[str],
+                      decls=None) -> Optional[str]:
         d = dotted(call.func)
         if d is None:
             return None
@@ -144,6 +151,14 @@ class CrdtParityPass:
                 hits = _sql_write_tables(sql, tables)
                 if hits:
                     return hits[0]
+        if last in _RUN_LASTS and decls and call.args:
+            name = _string_const(call.args[0])
+            if name:
+                decl = decls.get(name)
+                if decl is not None and decl.verb == "write":
+                    hits = sorted(set(decl.tables) & tables)
+                    if hits:
+                        return hits[0]
         if last in _HELPERS and recv and recv[-1] in ("db", "conn") \
                 and call.args:
             t = _string_const(call.args[0])

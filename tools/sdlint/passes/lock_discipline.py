@@ -41,8 +41,10 @@ PASS = "lock-discipline"
 
 _WAIT_LASTS = {"result", "join"}   # parameterless → cross-thread wait
 _TX_LASTS = {"tx", "write_ops"}
+# Database entry points that open their OWN tx unless handed conn=
+# (run_tx always does — it is the single-statement-tx sugar).
 _DB_HELPERS = {"insert", "insert_many", "update", "upsert", "delete",
-               "execute"}
+               "execute", "run_tx"}
 
 
 def lock_name(expr: ast.AST) -> Optional[str]:

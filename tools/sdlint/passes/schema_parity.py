@@ -1,0 +1,146 @@
+"""Pass: schema-parity — statement contracts match the model registry.
+
+Schema drift is caught at LINT time, not at the first production
+query: every declared statement's SQL is cross-validated against
+store/models.py (parsed by AST, like crdt-parity). Codes:
+
+- `unknown-table`  — a table in the declaration's `tables=` or parsed
+  from its SQL that no registered model (or SQLite internal) defines.
+- `tables-drift`   — the declared `tables=` set disagrees with the
+  tables parsed from the SQL text (the declaration IS the inventory;
+  a wrong entry poisons --sql-table and the health attribution).
+- `unknown-column` — an identifier in the SQL that is neither a
+  column of the statement's tables, a table name/alias, a result
+  alias, nor a SQL keyword/function. A column dropped from models.py
+  turns into a finding here instead of an OperationalError later.
+- `unindexed-filter` — a WHERE/ON filter over a column of a LARGE
+  table (statements.py LARGE_TABLES) with no pk/unique/index/
+  lazy_index whose leading column covers it. Advisory — bounded
+  scans waive inline/baseline with the measured reason.
+
+Shapes participate where they can: `{i}`/`{w}` slots render as an
+ignorable sentinel, so their constant parts are still checked.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..core import Finding, Project
+from . import _sql
+
+PASS = "schema-parity"
+
+EXTERNAL_TABLES = {"sqlite_master"}
+# mirrors statements.py LARGE_TABLES (drift pinned by test)
+LARGE_TABLES = {
+    "file_path", "object", "shared_operation", "shared_op_blob",
+    "relation_operation", "media_data", "near_dup_pair", "job_scratch",
+}
+
+
+class SchemaParityPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        info = _sql.models_schema(project.root)
+        if not info.columns:
+            return []
+        findings: List[Finding] = []
+        decls = _sql.project_decls(project)
+        # Judge only declarations whose source is part of THIS run's
+        # scope: fixture/incremental runs load the central registry
+        # for name resolution but must not re-report (or re-suppress)
+        # its findings without its suppression markers in view.
+        in_scope = {f.relpath for f in project.files}
+        for d in decls.values():
+            if d.path in in_scope:
+                self._check(d, info, findings)
+        return findings
+
+    def _check(self, d: _sql.Decl, info, findings: List[Finding]):
+        sql = d.sql.replace("{i}", _sql.DYN).replace("{w}", _sql.DYN)
+        known = set(info.columns) | EXTERNAL_TABLES
+        parsed = _sql.parse_tables(sql)
+        for t in set(d.tables) | parsed:
+            if t not in known and t != _sql.DYN:
+                findings.append(Finding(
+                    PASS, "unknown-table", d.path, "", f"{d.name}:{t}",
+                    f"statement {d.name!r} references table {t!r} "
+                    "which is not in the model registry", d.lineno))
+        real_parsed = {t for t in parsed if t in known}
+        if not d.shape and real_parsed and \
+                real_parsed != set(d.tables) & known:
+            missing = real_parsed - set(d.tables)
+            extra = set(d.tables) - real_parsed
+            if missing or extra:
+                findings.append(Finding(
+                    PASS, "tables-drift", d.path, "", d.name,
+                    f"statement {d.name!r}: declared tables "
+                    f"{sorted(d.tables)} vs SQL tables "
+                    f"{sorted(real_parsed)}", d.lineno))
+        self._check_columns(d, sql, info, findings)
+        self._check_filters(d, sql, info, findings)
+
+    def _check_columns(self, d, sql, info, findings):
+        idents, aliases, result_aliases = _sql.parse_identifiers(sql)
+        tables = {t for t in (set(d.tables) | _sql.parse_tables(sql))
+                  if t in info.columns}
+        col_pool: Set[str] = {"rowid", "*", _sql.DYN}
+        for t in tables:
+            col_pool |= info.columns[t]
+        # qualified refs: alias/table must resolve, column must belong
+        for prefix, col in _sql.parse_qualified(sql):
+            if prefix == _sql.DYN or col == _sql.DYN:
+                continue
+            table = aliases.get(prefix, prefix)
+            if table in info.columns:
+                if col not in info.columns[table] and col != "*" \
+                        and col != "rowid":
+                    findings.append(Finding(
+                        PASS, "unknown-column", d.path, "",
+                        f"{d.name}:{table}.{col}",
+                        f"statement {d.name!r} references "
+                        f"{table}.{col} but the model has no such "
+                        "column", d.lineno))
+        if d.shape and (_sql.DYN in sql or not tables):
+            # a `{i}` table slot means the column universe is open —
+            # only the qualified checks above can judge
+            return
+        if set(d.tables) & EXTERNAL_TABLES:
+            # SQLite internals have no registered column set
+            return
+        known_non_columns = (set(info.columns) | EXTERNAL_TABLES
+                             | set(aliases) | result_aliases
+                             | {_sql.DYN})
+        for tok in idents:
+            if tok in known_non_columns or tok in col_pool:
+                continue
+            findings.append(Finding(
+                PASS, "unknown-column", d.path, "",
+                f"{d.name}:{tok}",
+                f"statement {d.name!r} references {tok!r} which is "
+                "no column of its tables "
+                f"({sorted(tables) or 'none declared'})", d.lineno))
+
+    def _check_filters(self, d, sql, info, findings):
+        tables = {t for t in (set(d.tables) | _sql.parse_tables(sql))
+                  if t in info.columns}
+        large = tables & LARGE_TABLES
+        if not large or d.verb != "read":
+            return
+        wcols = _sql.where_columns(sql)
+        if not wcols:
+            return
+        for t in sorted(large):
+            cols_here = wcols & info.columns[t]
+            if not cols_here:
+                continue
+            if cols_here & info.indexed[t]:
+                continue  # at least one indexed access path
+            findings.append(Finding(
+                PASS, "unindexed-filter", d.path, "",
+                f"{d.name}:{t}",
+                f"statement {d.name!r} filters large table {t} on "
+                f"{sorted(cols_here)} with no declared or lazy index "
+                "— a full scan at production scale", d.lineno))

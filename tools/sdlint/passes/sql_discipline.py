@@ -1,0 +1,304 @@
+"""Pass: sql-discipline — every SQL statement executes by contract.
+
+The store's machine-checked seam (store/statements.py + Database.run)
+only holds if no SQL can reach an execute method outside it. Codes:
+
+- `sql-literal`       — a DML string literal (SELECT/INSERT/UPDATE/
+  DELETE/REPLACE/WITH) passed to an execute method (`conn.execute`,
+  `executemany`, `db.query`, `query_one`). Literals migrate to a
+  `declare_stmt` + `db.run(name)`; ad-hoc diagnostic reads belong to
+  tests (outside the lint scope), not product code.
+- `sql-dynamic`       — dynamically-BUILT SQL (f-string, `%`,
+  `.format`, `+`-concatenation) reaching an execute method whose
+  rendered skeleton matches NO declared shape. Matching a shape is
+  the sanctioned dynamic form (registry-derived identifiers, checked
+  again at runtime by the auditor).
+- `sql-opaque`        — an execute method fed an expression the pass
+  cannot see through (a name not assigned SQL in the same function, a
+  call other than `statements.get(...).sql` / `statements.sql(...)`).
+  Opaque SQL defeats the static half of the contract; route it
+  through the registry or waive with a reason.
+- `run-unknown`       — `run`/`run_many`/`run_tx` with a literal name
+  absent from the registry (typo guard, cross-AST vs statements.py).
+- `run-dynamic-name`  — `run`/`run_many`/`run_tx` with a non-literal
+  name: the registry linkage must be statically visible (same rule as
+  the timeout/channel registries).
+- `write-no-conn`     — `run`/`run_many` of a write-verb statement
+  without `conn=`: writes execute on the open tx() connection
+  (`run_tx` is the single-statement sugar). Interprocedural half: a
+  function whose `conn` parameter feeds write statements must only be
+  reached from tx scopes — checked via the same with-tx lexing
+  lock-discipline uses, one caller hop deep.
+- `read-via-write-path` — `.execute`/`.executemany` invoked on a
+  Database receiver (`*.db`): the old write-wrapping `Database
+  .execute` is gone precisely because it routed reads through the
+  write lock; nothing may grow it back.
+- `sql-central`       — `declare_stmt`/`declare_shape` outside
+  spacedrive_tpu/store/statements.py (fixtures waive inline).
+
+`store/db.py` is the whitelisted engine room: the typed helpers and
+schema bootstrap build SQL by design, and every statement they emit is
+still matched at runtime by the audited connection.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Finding, FuncInfo, Project, dotted, own_body_walk
+from . import _sql
+
+PASS = "sql-discipline"
+
+_EXEC_LASTS = {"execute", "executemany", "query", "query_one"}
+_RUN_LASTS = {"run", "run_many", "run_tx"}
+# `.run()` is ubiquitous (subprocess, CLIs, jobs) — only Database
+# receivers participate, same receiver idiom as blocking-async.
+_DB_RECEIVERS = {"db"}
+_ENGINE_ROOM = ("spacedrive_tpu/store/db.py",
+                "spacedrive_tpu/store/sqlaudit.py")
+_CENTRAL = _sql.STATEMENTS_PATH
+
+
+def _local_sql_assignments(fn: FuncInfo) -> Dict[str, ast.AST]:
+    """name → value for simple assignments whose value is (or builds)
+    SQL text, so `sql = f"..."; conn.execute(sql)` resolves."""
+    out: Dict[str, ast.AST] = {}
+    for node in own_body_walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            # `where += ...` — dynamic build-up; keep the target known
+            out.setdefault(node.target.id, node.value)
+    return out
+
+
+def _is_registry_sql_expr(node: ast.AST) -> bool:
+    """`statements.get("x").sql` / `statements.sql("x")` — SQL pulled
+    FROM the registry is contract-bound by construction."""
+    if isinstance(node, ast.Attribute) and node.attr == "sql":
+        inner = node.value
+        if isinstance(inner, ast.Call):
+            d = dotted(inner.func)
+            if d is not None and d.split(".")[-1] == "get":
+                return True
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        if d is not None and d.split(".")[-1] == "sql":
+            return True
+    return False
+
+
+class SqlDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        decls = _sql.project_decls(project)
+        shapes = _sql.ShapeIndex(decls)
+        findings: List[Finding] = []
+        # functions that execute write statements on a conn PARAMETER:
+        # qual → statement name (for the interprocedural check)
+        conn_writers: Dict[str, str] = {}
+        for fn in project.index.funcs:
+            self._scan_fn(fn, decls, shapes, findings, conn_writers)
+        self._check_conn_writers(project, conn_writers, findings)
+        for src in project.files:
+            if src.relpath == _CENTRAL:
+                continue
+            for d in _sql.decls_in_tree(src.tree, src.relpath):
+                findings.append(Finding(
+                    PASS, "sql-central", src.relpath, "", d.name,
+                    f"statement {d.name!r} declared outside the "
+                    f"central registry ({_CENTRAL})", d.lineno))
+        return findings
+
+    # -- per-function -------------------------------------------------------
+
+    def _scan_fn(self, fn: FuncInfo, decls, shapes, findings,
+                 conn_writers) -> None:
+        rel = fn.src.relpath
+        if rel.startswith(_ENGINE_ROOM) or rel == _CENTRAL:
+            return
+        assigns = None
+        in_tx = _fn_tx_lines(fn)
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            last = parts[-1]
+            recv = parts[:-1]
+            if last in _RUN_LASTS and recv \
+                    and recv[-1] in _DB_RECEIVERS:
+                self._check_run(fn, node, last, decls, findings,
+                                conn_writers, in_tx)
+                continue
+            if last not in _EXEC_LASTS or not node.args:
+                continue
+            if recv and recv[-1] == "db" and last in (
+                    "execute", "executemany"):
+                findings.append(Finding(
+                    PASS, "read-via-write-path", rel, fn.qual, d,
+                    "Database.execute is gone — it wrapped reads in a "
+                    "write transaction; use run()/run_tx()/query()",
+                    node.lineno))
+                continue
+            arg = node.args[0]
+            lit = _sql.literal_sql(arg)
+            if lit is not None:
+                findings.append(Finding(
+                    PASS, "sql-literal", rel, fn.qual,
+                    _sql.normalize_sql(lit)[:60],
+                    "raw SQL literal at an execute method — declare "
+                    "it in store/statements.py and call db.run()",
+                    node.lineno))
+                continue
+            dyn = _sql.dynamic_sql_expr(arg)
+            if dyn is None and isinstance(arg, ast.Name):
+                if assigns is None:
+                    assigns = _local_sql_assignments(fn)
+                src_expr = assigns.get(arg.id)
+                if src_expr is not None:
+                    lit = _sql.literal_sql(src_expr)
+                    if lit is not None:
+                        findings.append(Finding(
+                            PASS, "sql-literal", rel, fn.qual,
+                            _sql.normalize_sql(lit)[:60],
+                            "raw SQL literal (via local variable) at "
+                            "an execute method — declare it in "
+                            "store/statements.py", node.lineno))
+                        continue
+                    dyn = _sql.dynamic_sql_expr(src_expr)
+            if dyn is not None:
+                if shapes.match(dyn) is None:
+                    findings.append(Finding(
+                        PASS, "sql-dynamic", rel, fn.qual,
+                        _sql.normalize_sql(dyn)[:60],
+                        "dynamically-built SQL matches no declared "
+                        "shape (store/statements.py declare_shape)",
+                        node.lineno))
+                continue
+            if isinstance(arg, ast.Constant):
+                continue  # non-SQL constant (not our business)
+            if _is_registry_sql_expr(arg):
+                continue
+            if isinstance(arg, (ast.Name, ast.Attribute, ast.Call,
+                                ast.Subscript)):
+                findings.append(Finding(
+                    PASS, "sql-opaque", rel, fn.qual, d,
+                    "execute method fed SQL the pass cannot see "
+                    "through — route it through the statement "
+                    "registry", node.lineno))
+
+    def _check_run(self, fn, node, last, decls, findings,
+                   conn_writers, in_tx) -> None:
+        rel = fn.src.relpath
+        if not node.args:
+            return
+        name_node = node.args[0]
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            findings.append(Finding(
+                PASS, "run-dynamic-name", rel, fn.qual,
+                dotted(node.func) or last,
+                f"{last}() with a non-literal statement name — the "
+                "registry linkage must be statically visible",
+                node.lineno))
+            return
+        name = name_node.value
+        decl = decls.get(name)
+        if decl is None:
+            findings.append(Finding(
+                PASS, "run-unknown", rel, fn.qual, name,
+                f"statement {name!r} is not declared in "
+                "store/statements.py", node.lineno))
+            return
+        if last == "run_tx":
+            return  # opens its own tx; tx-shape watches loops
+        if decl.verb == "write":
+            conn_kw = next((kw for kw in node.keywords
+                            if kw.arg == "conn"), None)
+            if conn_kw is None:
+                findings.append(Finding(
+                    PASS, "write-no-conn", rel, fn.qual, name,
+                    f"write statement {name!r} without conn= — writes "
+                    "execute on the open tx() connection (or use "
+                    "run_tx)", node.lineno))
+            elif isinstance(conn_kw.value, ast.Name) \
+                    and node.lineno not in in_tx:
+                # conn came from a parameter (not a lexical tx): the
+                # caller side must prove tx scope. A with-binding of
+                # the same name (incl. the conditional
+                # `with (db.tx() if own_tx else nullcontext(conn))`
+                # own-tx idiom) makes the function self-sufficient.
+                arg_names = {a.arg for a in fn.node.args.args}
+                if conn_kw.value.id in arg_names and \
+                        conn_kw.value.id not in _with_bound_names(fn):
+                    conn_writers.setdefault(fn.qual, name)
+
+    # -- interprocedural: conn-parameter writers ----------------------------
+
+    def _check_conn_writers(self, project, conn_writers, findings):
+        """One hop up: every resolvable caller of a conn-parameter
+        writer must sit in a with-tx scope, receive conn itself, or
+        pass a conn kwarg/arg visibly. (Deeper chains are the runtime
+        auditor's job — autocommit writes raise.)"""
+        if not conn_writers:
+            return
+        for fn in project.index.funcs:
+            in_tx = _fn_tx_lines(fn)
+            has_conn_param = "conn" in {a.arg for a in fn.node.args.args}
+            for site in fn.calls:
+                callee = project.index.resolve(fn, site.name)
+                if callee is None or callee.qual not in conn_writers:
+                    continue
+                if has_conn_param or site.node.lineno in in_tx:
+                    continue
+                passes_conn = any(kw.arg == "conn"
+                                  for kw in site.node.keywords) or \
+                    any(isinstance(a, ast.Name) and a.id == "conn"
+                        for a in site.node.args)
+                if passes_conn:
+                    continue
+                findings.append(Finding(
+                    PASS, "write-outside-tx", fn.src.relpath, fn.qual,
+                    f"{site.name}->{conn_writers[callee.qual]}",
+                    f"calls {site.name}() which writes "
+                    f"{conn_writers[callee.qual]!r} on its conn "
+                    "parameter, but no tx() scope or conn is visible "
+                    "here", site.node.lineno))
+
+
+def _with_bound_names(fn: FuncInfo) -> set:
+    """Names bound by `with ... as <name>` anywhere in the function."""
+    out = set()
+    for node in own_body_walk(fn.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    out.add(item.optional_vars.id)
+    return out
+
+
+def _fn_tx_lines(fn: FuncInfo) -> set:
+    """Line numbers lexically inside a `with ...tx():` /
+    `with ...write_ops(...)` body in this function."""
+    out = set()
+    for node in own_body_walk(fn.node):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                d = dotted(ctx.func)
+                if d is not None and d.split(".")[-1] in (
+                        "tx", "write_ops"):
+                    for sub in ast.walk(node):
+                        if hasattr(sub, "lineno"):
+                            out.add(sub.lineno)
+    return out

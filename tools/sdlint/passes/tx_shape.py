@@ -1,0 +1,252 @@
+"""Pass: tx-shape — write transactions have the right granularity.
+
+The single-writer store lives or dies by transaction shape: a tx per
+item serializes the whole job on COMMIT latency (the PR 1 identifier
+fix), a blocking call inside a tx holds the write lock for its
+duration, and a nested tx is a guaranteed runtime error. Codes:
+
+- `tx-in-loop`        — a transaction opened PER ITERATION of a
+  For/While loop: a lexical `with ...tx()/write_ops()`, a `run_tx`,
+  a Database helper without `conn=`, or a call to a resolvable
+  function whose own body opens one. Batch under ONE tx (the
+  commit-per-item shape; sd_sql_tx_statements shows it at runtime as
+  a spike at 1-2 statements/tx).
+- `blocking-in-tx`    — a blocking call (file IO, sleep, subprocess,
+  parameterless .result()/.join(), network sends) lexically inside a
+  tx body: the write lock is held the whole time. Hashing/stat work
+  belongs BEFORE the tx.
+- `await-in-tx`       — an `await` inside a sync-with tx body (the
+  coroutine suspends holding the write lock; lock-discipline's
+  await-under-lock sibling, keyed to tx() specifically).
+- `nested-tx-chain`   — a call INSIDE a tx body (no conn= passed) to
+  a function that transitively opens its own tx. lock-discipline
+  catches the direct `db.helper()`/`.tx()` forms; this code follows
+  resolvable project-function chains.
+- `executemany-candidate` — the same single-row write statement
+  (`run(<write>)` / INSERT/UPDATE literal) executed per loop
+  iteration where a batched form (`run_many` / `insert_many`) would
+  collapse the Python/sqlite statement loop. Advisory: sites with a
+  real per-row dependency waive inline with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, FuncInfo, Project, dotted, own_body_walk
+from . import _sql
+
+PASS = "tx-shape"
+
+_TX_LASTS = {"tx", "write_ops"}
+_DB_HELPERS = {"insert", "insert_many", "update", "upsert", "delete"}
+
+_BLOCKING_LASTS = {
+    "sleep", "open", "system", "run", "check_output", "check_call",
+    "copyfile", "copytree", "rmtree", "urlopen", "sendall", "recv",
+}
+_BLOCKING_PREFIXES = ("subprocess", "shutil", "requests", "urllib")
+
+
+def _opens_own_tx(fn: FuncInfo) -> bool:
+    """Does this function's own body open a write transaction?"""
+    for node in own_body_walk(fn.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    d = dotted(item.context_expr.func)
+                    if d is not None and \
+                            d.split(".")[-1] in _TX_LASTS:
+                        return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None:
+                continue
+            last = d.split(".")[-1]
+            if last == "run_tx":
+                return True
+            if last in _DB_HELPERS and d.split(".")[-2:-1] == ["db"] \
+                    and not any(kw.arg == "conn"
+                                for kw in node.keywords):
+                return True
+    return False
+
+
+def _tx_opening_closure(project: Project) -> Set[str]:
+    """Quals of functions that open a tx directly or via resolvable
+    calls (fixed point over the call graph)."""
+    direct = {fn.qual for fn in project.index.funcs
+              if _opens_own_tx(fn)}
+    opening = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for fn in project.index.funcs:
+            if fn.qual in opening:
+                continue
+            for site in fn.calls:
+                if any(kw.arg == "conn" for kw in site.node.keywords):
+                    continue  # rides the caller's tx — not an opener
+                callee = project.index.resolve(fn, site.name)
+                if callee is not None and callee.qual in opening:
+                    opening.add(fn.qual)
+                    changed = True
+                    break
+    return opening
+
+
+def _is_blocking(call: ast.Call) -> Optional[str]:
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if d == "time.sleep" or (last == "sleep" and parts[0] == "time"):
+        return d
+    if last == "open" and len(parts) == 1:
+        return d
+    if parts[0] in _BLOCKING_PREFIXES:
+        return d
+    if last in ("result", "join") and not call.args \
+            and not call.keywords and not any(
+                "task" in p for p in parts[:-1]):
+        return d
+    return None
+
+
+class _TxWalker:
+    """Track tx nesting through one function's own statements."""
+
+    def __init__(self, fn: FuncInfo, project: Project,
+                 openers: Set[str], decls, findings: List[Finding]):
+        self.fn = fn
+        self.project = project
+        self.openers = openers
+        self.decls = decls
+        self.findings = findings
+
+    def _emit(self, code, ident, msg, lineno):
+        self.findings.append(Finding(
+            PASS, code, self.fn.src.relpath, self.fn.qual, ident,
+            msg, lineno))
+
+    def scan(self):
+        self._block(self.fn.node.body, in_tx=False, in_loop=False)
+
+    def _block(self, stmts, in_tx: bool, in_loop: bool):
+        for stmt in stmts:
+            self._stmt(stmt, in_tx, in_loop)
+
+    def _stmt(self, node, in_tx: bool, in_loop: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.With):
+            opens = False
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    d = dotted(item.context_expr.func)
+                    if d is not None and \
+                            d.split(".")[-1] in _TX_LASTS:
+                        opens = True
+                        if in_loop:
+                            self._emit(
+                                "tx-in-loop", d,
+                                f"`with {d}()` per loop iteration — "
+                                "the commit-per-item shape; batch "
+                                "the loop under ONE transaction",
+                                node.lineno)
+            self._block(node.body, in_tx or opens, in_loop)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            self._block(node.body, in_tx, in_loop=True)
+            self._block(node.orelse, in_tx, in_loop=True)
+            return
+        if isinstance(node, ast.Await):
+            if in_tx:
+                self._emit(
+                    "await-in-tx", "await",
+                    "`await` inside an open tx() — the coroutine "
+                    "suspends holding the write lock", node.lineno)
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    self._call(sub, in_tx, in_loop, awaited=True)
+            return
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub, in_tx, in_loop)
+                for inner in ast.iter_child_nodes(sub):
+                    self._stmt(inner, in_tx, in_loop)
+            else:
+                self._stmt(sub, in_tx, in_loop)
+
+    def _call(self, call: ast.Call, in_tx: bool, in_loop: bool,
+              awaited: bool = False):
+        d = dotted(call.func)
+        if d is None:
+            return
+        last = d.split(".")[-1]
+        has_conn = any(kw.arg == "conn" for kw in call.keywords)
+        # per-iteration tx openers
+        if in_loop and not has_conn:
+            if last == "run_tx":
+                self._emit(
+                    "tx-in-loop", d,
+                    "run_tx() per loop iteration — batch under ONE "
+                    "tx() with run(conn=)", call.lineno)
+            elif last in _DB_HELPERS and "db" in d.split(".")[:-1]:
+                self._emit(
+                    "tx-in-loop", d,
+                    f"db.{last}() without conn= per loop iteration "
+                    "opens a tx each time — batch under ONE tx()",
+                    call.lineno)
+            else:
+                callee = self.project.index.resolve(self.fn, d)
+                if callee is not None and callee.qual in self.openers \
+                        and last not in _TX_LASTS:
+                    self._emit(
+                        "tx-in-loop", d,
+                        f"{d}() opens its own transaction and is "
+                        "called per loop iteration", call.lineno)
+        if in_tx:
+            blocking = _is_blocking(call)
+            if blocking is not None and not awaited:
+                self._emit(
+                    "blocking-in-tx", blocking,
+                    f"blocking call `{blocking}` inside an open tx() "
+                    "holds the write lock for its duration",
+                    call.lineno)
+            if not has_conn and last not in _TX_LASTS:
+                callee = self.project.index.resolve(self.fn, d)
+                if callee is not None and callee.qual in self.openers:
+                    self._emit(
+                        "nested-tx-chain", d,
+                        f"{d}() (transitively) opens its own tx "
+                        "inside this open tx() — pass conn= through",
+                        call.lineno)
+        # executemany candidate: single-row declared write per loop
+        if in_loop and last == "run" and call.args and has_conn:
+            name_node = call.args[0]
+            if isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str):
+                decl = self.decls.get(name_node.value)
+                if decl is not None and decl.verb == "write" and \
+                        _sql.sql_head(decl.sql) in ("INSERT", "UPDATE"):
+                    self._emit(
+                        "executemany-candidate", name_node.value,
+                        f"write statement {name_node.value!r} "
+                        "executed per loop iteration — run_many() "
+                        "collapses the statement loop", call.lineno)
+
+
+class TxShapePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        decls = _sql.project_decls(project)
+        openers = _tx_opening_closure(project)
+        findings: List[Finding] = []
+        for fn in project.index.funcs:
+            _TxWalker(fn, project, openers, decls, findings).scan()
+        return findings

@@ -73,9 +73,7 @@ async def run(n_files: int, file_kb: int) -> None:
         lib, ObjectValidatorJob(location_id=loc, backend="jax", mode="fill"))
     await node.jobs.wait(jid)
     dt = time.perf_counter() - t0
-    n_done = lib.db.query_one(
-        "SELECT COUNT(*) AS n FROM file_path "
-        "WHERE integrity_checksum IS NOT NULL")["n"]
+    n_done = lib.db.run("bench.checksum_count")["n"]
     # Same-weather comparator: the round-4 ONE-DISPATCH-PER-FILE path
     # (streaming sequence-sharded windows) on a subset — the tunneled
     # link's throughput swings 100x day to day, so the amortization
