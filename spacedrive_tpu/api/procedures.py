@@ -53,6 +53,7 @@ def _json_safe(v: Any) -> Any:
 
 def register_all(router: Router) -> None:
     _core(router)
+    _fleet(router)
     _libraries(router)
     _volumes(router)
     _tags(router)
@@ -162,6 +163,93 @@ def _core(r: Router) -> None:
         # AFTER subscribing: emit fans out synchronously to the current
         # subscriber list, so the other order would skip this client.
         node.telemetry_reporter.emit_snapshot()
+        return unsub
+
+
+# -- obs. + fleet. (fleet observatory, spacedrive_tpu/fleet.py) -------------
+
+def _fleet(r: Router) -> None:
+    """The observability-federation surfaces. The obs.* queries are
+    the rspc face of the p2p obs protocol (one serve_obs dispatch for
+    every transport — p2p tunnels, HTTP fleets, loopback tests); the
+    fleet.* queries serve the merged view the poller maintains."""
+
+    def _serve(node, header):
+        from ..p2p.obs import serve_obs
+
+        return asyncio.to_thread(serve_obs, node, header)
+
+    @r.query("obs.metrics")
+    async def obs_metrics(node, _input):
+        """This node's telemetry snapshot in the obs envelope (node
+        identity + sampled-at wall clock) — what a fleet poller over
+        HTTP consumes; same payload the p2p obs.metrics handler
+        serves."""
+        return await _serve(node, {"t": "obs.metrics"})
+
+    @r.query("obs.health")
+    async def obs_health(node, _input):
+        """This node's HealthSnapshot in the obs envelope — the fleet
+        poller's per-round pull."""
+        return await _serve(node, {"t": "obs.health"})
+
+    @r.query("obs.trace")
+    async def obs_trace(node, input):
+        """This node's span-ring + flight-timeline slice, filterable
+        by {trace} and capped by {limit} — the raw material of
+        distributed trace assembly."""
+        input = input or {}
+        header: Dict[str, Any] = {"t": "obs.trace"}
+        if input.get("trace"):
+            header["trace"] = str(input["trace"])
+        if input.get("limit") is not None:
+            header["limit"] = input["limit"]
+        return await _serve(node, header)
+
+    @r.query("fleet.health")
+    async def fleet_health(node, _input):
+        """The merged fleet health view (fleet.py): one row per node
+        — the local one plus every polled peer — with states and
+        attribution re-keyed per (node, subsystem), unreachable/stale
+        peers degraded with last-seen evidence. Served from the
+        poller's cache; polls fresh when stale (loop-less embedders,
+        no-poller tests)."""
+        return await node.fleet.snapshot()
+
+    @r.query("fleet.metrics")
+    async def fleet_metrics(node, _input):
+        """Per-node cumulative metrics snapshots (local registry +
+        every reachable peer's obs.metrics, fetched on demand)."""
+        return await node.fleet.metrics()
+
+    @r.query("fleet.trace.export")
+    async def fleet_trace_export(node, input):
+        """Distributed trace assembly: every paired peer's spans +
+        timeline for {trace}, merged with the local slice into one
+        validated Chrome-trace document with per-node pid lanes and
+        skew-aligned clocks."""
+        input = input or {}
+        trace = input.get("trace")
+        if not trace:
+            raise RpcError("BAD_REQUEST",
+                           "fleet.trace.export needs {trace: <hex id>}")
+        return await node.fleet.assemble_trace(str(trace))
+
+    @r.subscription("fleet.health")
+    async def fleet_health_sub(node, _input, emit):
+        """Push every FleetHealthSnapshot the poller publishes (plus
+        one immediately so subscribers paint without waiting a poll
+        round). The ws pump coalesces these newest-wins, same as
+        node.health."""
+        def on_event(e):
+            if e.get("type") == "FleetHealthSnapshot":
+                emit(e)
+        unsub = node.events.subscribe(on_event)
+        # AFTER subscribing (the EventBus fans out synchronously to
+        # the current list); built fresh if the poller has no view.
+        view = await node.fleet.snapshot()
+        emit({"type": "FleetHealthSnapshot", "ts": view["ts"],
+              "fleet": view})
         return unsub
 
 

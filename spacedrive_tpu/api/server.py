@@ -63,10 +63,12 @@ class WsSubscriptionPump:
         data = payload.get("data")
         key = None
         if isinstance(data, dict) and data.get("type") in (
-                "TelemetrySnapshot", "HealthSnapshot"):
+                "TelemetrySnapshot", "HealthSnapshot",
+                "FleetHealthSnapshot"):
             # Snapshot-coalescing (newest wins): only the latest
-            # telemetry/health state matters to a consumer that fell
-            # behind — intermediate snapshots are stale by definition.
+            # telemetry/health/fleet state matters to a consumer that
+            # fell behind — intermediate snapshots are stale by
+            # definition.
             key = data["type"]
         return self.chan.put_nowait(payload, key=key)
 

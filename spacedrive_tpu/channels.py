@@ -613,6 +613,22 @@ declare_channel(
     "never balloons.")
 
 declare_channel(
+    "fleet.peer.snapshots", 32, "shed_oldest", "fleet",
+    "Per-peer ring of fetched obs.health snapshots (spacedrive_tpu/"
+    "fleet.py): one instance per registered peer, each entry the "
+    "peer's own HealthSnapshot plus receive metadata (rtt, estimated "
+    "clock skew, received-at). Oldest snapshots age out — the fleet "
+    "view only ever needs the freshest few — so a chatty peer cannot "
+    "grow the poller's memory.", sheds_expected=True)
+
+declare_channel(
+    "fleet.snapshots", 32, "shed_oldest", "fleet",
+    "Recent merged fleet-health views (spacedrive_tpu/fleet.py): "
+    "fleet.health serves the newest entry; history ages out "
+    "oldest-first, same shape as health.snapshots.",
+    sheds_expected=True)
+
+declare_channel(
     "health.series", 120, "shed_oldest", "health",
     "Per-series sample ring of the health observatory (spacedrive_"
     "tpu/health.py): one instance per metric series, each entry a "

@@ -164,6 +164,14 @@ declare(
     "ops/blake3_jax.DISPATCH_LOG (driver/dryrun artifacts read it).")
 
 declare(
+    "SDTPU_FLEET_INTERVAL_S", 10.0, parse_float,
+    "Seconds between fleet-observatory poll rounds (fleet.py, "
+    "supervised under node/fleet): each round pulls every paired "
+    "peer's obs.health snapshot into its bounded per-peer ring and "
+    "re-merges the fleet view. A peer whose last good snapshot is "
+    "older than 2x this interval is marked stale-degraded.")
+
+declare(
     "SDTPU_FUZZ_SEEDS", [7, 23], parse_int_csv,
     "Comma-separated RNG seeds the sync fuzz suite replays "
     "(tests/test_sync_fuzz.py).", strict=True)

@@ -1,0 +1,737 @@
+"""Fleet observatory: cross-node metrics/health federation and
+distributed trace assembly.
+
+ROADMAP items 2 and 3 (multi-host sharded fleet; million-user serving)
+cannot be debugged blind: PR 10's traceparent makes one logical
+operation span nodes, and PR 11's health observatory attributes
+saturation — but only for its OWN process. This module federates the
+observability planes over the same p2p layer the data plane uses
+(PAPER.md L2c: locations live on nodes behind the mesh):
+
+- **Poller.** A supervised task (owner ``node/fleet``, interval
+  `SDTPU_FLEET_INTERVAL_S`) pulls every registered peer's
+  ``obs.health`` snapshot (p2p/obs.py protocol; the production
+  transport is `P2PObsClient` over authenticated tunnels, with
+  loopback and rspc-HTTP clients for in-process fleets and
+  crypto-less containers) into a bounded per-peer ring
+  (`fleet.peer.snapshots`). Every fetch runs under the declared
+  ``fleet.poll`` budget; outcomes count into
+  ``sd_fleet_polls_total{outcome}``. A malformed snapshot is rejected
+  by the schema gate WITHOUT touching the ring — one poisoned peer
+  cannot corrupt the fleet view.
+- **Merger.** The fleet health view reuses PR 11's
+  saturation-attribution rules — each node's own engine already
+  named its bottlenecks — and re-keys them per ``(node, subsystem)``.
+  A peer that is unreachable or whose last good snapshot is older
+  than 2x the poll interval is marked ``degraded`` under its ``peer``
+  pseudo-subsystem with last-seen evidence inline.
+- **Trace assembly.** `assemble_trace(trace_id)` fetches every peer's
+  span-ring + flight-timeline slice for one trace id
+  (``obs.trace``, budget ``fleet.trace.fetch``) and merges them with
+  the local slice into ONE validated Chrome-trace document
+  (flight.fleet_chrome_trace): per-node pid lanes, each remote
+  node's clock aligned by the skew estimated from obs-poll RTT
+  midpoints (skew = peer_sampled_at - poll_midpoint), the offsets
+  recorded in the document's metadata.
+- **Surfaces.** The ``fleet.health`` / ``fleet.metrics`` rspc queries,
+  the ``fleet.health`` subscription (FleetHealthSnapshot events,
+  coalesced newest-wins in the ws pump), ``fleet.trace.export``, and
+  the `tools/sd_top.py --fleet` / `tools/trace_export.py --fleet`
+  operator CLIs.
+
+Design constraints: stdlib + the registry modules + health/flight
+only — importable without jax AND without the tunnel stack's
+`cryptography` dependency (the p2p obs submodule it leans on is
+deliberately crypto-free; p2p/__init__ gates the rest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import channels, flags, flight, tasks, telemetry, tracing
+from .health import STATES, validate_health_snapshot
+from .p2p.obs import OBS_PROTO
+from .telemetry import FLEET_PEERS, FLEET_PEERS_STALE, FLEET_POLLS
+from .timeouts import with_timeout
+
+__all__ = [
+    "FleetMonitor", "LoopbackObsClient", "HttpObsClient",
+    "validate_obs_response", "validate_fleet_snapshot",
+]
+
+# A peer whose freshest good snapshot is older than this many poll
+# intervals is a stale row (documented with the flag declaration).
+STALE_INTERVALS = 2.0
+
+
+# -- obs response schema gate ------------------------------------------------
+
+def validate_obs_response(what: str, resp: Any) -> List[str]:
+    """Problems with one obs response envelope (empty = valid). The
+    poller's poisoning gate: a peer answering garbage — wrong proto,
+    missing identity, a health payload that fails PR 11's snapshot
+    schema — is rejected here and its row goes stale-degraded; the
+    merged fleet view never sees the bytes."""
+    problems: List[str] = []
+    if not isinstance(resp, dict):
+        return [f"{what}: response must be a dict"]
+    if resp.get("status") != "ok":
+        return [f"{what}: status {resp.get('status')!r} "
+                f"({resp.get('error', 'no error detail')})"]
+    if resp.get("proto") != OBS_PROTO:
+        problems.append(f"{what}: obs proto {resp.get('proto')!r} != "
+                        f"ours {OBS_PROTO}")
+    if resp.get("what") != what:
+        problems.append(f"{what}: answered for {resp.get('what')!r}")
+    node = resp.get("node")
+    if not isinstance(node, dict) or \
+            not isinstance(node.get("id"), str) or \
+            not isinstance(node.get("name"), str):
+        problems.append(f"{what}: node identity must be "
+                        "{id: str, name: str}")
+    if not isinstance(resp.get("ts"), (int, float)):
+        problems.append(f"{what}: ts must be a number")
+    if what == "obs.health":
+        health = resp.get("health")
+        if not isinstance(health, dict):
+            problems.append("obs.health: health payload missing")
+        else:
+            problems.extend(
+                f"obs.health: {p}"
+                for p in validate_health_snapshot(health))
+    elif what == "obs.metrics":
+        if not isinstance(resp.get("metrics"), dict):
+            problems.append("obs.metrics: metrics payload missing")
+    elif what == "obs.trace":
+        for key in ("spans", "timeline"):
+            seq = resp.get(key)
+            if not isinstance(seq, list) or \
+                    any(not isinstance(e, dict) for e in seq):
+                problems.append(
+                    f"obs.trace: {key} must be a list of objects")
+                continue
+            # The fields the merger arithmetics over must be numeric
+            # when present — one peer's {"ts_us": null} entry must be
+            # rejected HERE, not crash the whole assembled trace.
+            for i, e in enumerate(seq):
+                bad = next((f for f in ("ts_us", "dur_us", "ms")
+                            if f in e and not isinstance(
+                                e[f], (int, float))), None)
+                if bad is not None:
+                    problems.append(
+                        f"obs.trace: {key}[{i}].{bad} must be a "
+                        "number")
+                    break
+    else:
+        problems.append(f"unknown obs kind {what!r}")
+    return problems
+
+
+# -- transports --------------------------------------------------------------
+# Every client is one async `fetch(what, trace=None) -> response
+# envelope`; the poller wraps each call in the declared fleet.* budget
+# regardless of transport. The production transport (P2PObsClient,
+# authenticated tunnels) lives in p2p/obs.py next to the serving side.
+
+class LoopbackObsClient:
+    """In-process transport fake for the obs plane (the reference's
+    in-process sync transport, core/crates/sync/tests/lib.rs:109-163):
+    serves another node object in the SAME process through the same
+    `serve_obs` dispatch the p2p handler uses — protocol semantics
+    without the tunnel. What the unit tests and crypto-less containers
+    drive."""
+
+    def __init__(self, node):
+        self.node = node
+
+    async def fetch(self, what: str,
+                    trace: Optional[str] = None) -> Any:
+        from .p2p.obs import serve_obs
+
+        header: Dict[str, Any] = {"t": what}
+        if trace:
+            header["trace"] = str(trace)
+        return await asyncio.to_thread(serve_obs, self.node, header)
+
+
+class HttpObsClient:
+    """Fetch obs snapshots from a peer's rspc HTTP host
+    (GET /rspc/obs.*, api/procedures.py) — the transport for fleets
+    whose tunnel stack is unavailable, and what the sd_top --fleet
+    self-check drives across two real processes in-container."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def _get(self, what: str, trace: Optional[str]) -> Any:
+        import json
+        import urllib.parse
+        import urllib.request
+
+        from . import timeouts
+
+        q = ""
+        if trace:
+            q = "?input=" + urllib.parse.quote(
+                json.dumps({"trace": str(trace)}))
+        endpoint = f"{self.url}/rspc/{what}{q}"
+        # The socket timeout mirrors the caller's declared budget:
+        # trace slices run under the bigger fleet.trace.fetch, the
+        # health/metrics polls under fleet.poll.
+        budget = timeouts.budget(
+            "fleet.trace.fetch" if what == "obs.trace" else "fleet.poll")
+        with urllib.request.urlopen(endpoint, timeout=budget) as resp:
+            payload = json.load(resp)
+        return payload.get("result") if isinstance(payload, dict) \
+            else None
+
+    async def fetch(self, what: str,
+                    trace: Optional[str] = None) -> Any:
+        return await asyncio.to_thread(self._get, what, trace)
+
+
+# -- the federation engine ---------------------------------------------------
+
+class FleetMonitor:
+    """Poller + merger + trace assembler, one per node (constructed at
+    bootstrap next to the HealthMonitor, reaped under ``node/fleet``).
+    Also constructible loose (node=None + explicit identity/health)
+    for CLIs building throwaway fleets around a run."""
+
+    def __init__(self, node=None, interval_s: Optional[float] = None,
+                 owner: str = "fleet", node_id: str = "",
+                 node_name: str = "", health=None):
+        self._lock = threading.Lock()
+        self.node = node
+        if node is not None:
+            node_id = node_id or node.config.id.hex()
+            node_name = node_name or node.config.name
+            health = health if health is not None else node.health
+        self.node_identity = {"id": str(node_id),
+                              "name": str(node_name)}
+        self.health = health
+        self.events = getattr(node, "events", None)
+        if interval_s is None:
+            interval_s = float(flags.get("SDTPU_FLEET_INTERVAL_S"))
+        self.interval_s = max(0.05, interval_s)
+        self._owner = owner
+        self._task: Optional[asyncio.Task] = None
+        # peer_id -> record (client, per-peer snapshot ring, liveness
+        # facts), all under _lock (contract in threadctx.py). Bounded
+        # by registered peers — paired routes plus explicit add_peer
+        # calls — not by history.
+        self._peers: Dict[str, Dict[str, Any]] = {}  # sdlint: ok[unbounded-growth]
+        self._snapshots = channels.channel("fleet.snapshots")
+        self._last: Optional[Dict[str, Any]] = None
+
+    # -- peer registry -----------------------------------------------------
+
+    def add_peer(self, peer_id: str, client, name: str = "") -> None:
+        """Register one peer (idempotent per id; the client object is
+        refreshed so a re-pair with a new route takes effect)."""
+        with self._lock:
+            rec = self._peers.get(peer_id)
+            if rec is None:
+                rec = {
+                    "peer_id": peer_id, "name": name or peer_id[:12],
+                    "client": client,
+                    "ring": channels.channel("fleet.peer.snapshots"),
+                    "last_ok": None, "rtt_s": None, "skew_s": None,
+                    "error": "",
+                }
+                self._peers[peer_id] = rec
+            else:
+                rec["client"] = client
+                if name:
+                    rec["name"] = name
+            n = len(self._peers)
+        FLEET_PEERS.set(n)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+            n = len(self._peers)
+        FLEET_PEERS.set(n)
+
+    def peer_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def refresh_p2p_peers(self) -> None:
+        """Adopt every paired p2p route as an obs peer (production
+        wiring: the same identity->route table the sync originator
+        fans out over). No-op without a p2p plane or without the
+        tunnel stack's crypto dependency."""
+        networked = getattr(getattr(self.node, "p2p", None),
+                            "networked", None)
+        if networked is None:
+            return
+        try:
+            from .p2p.identity import RemoteIdentity
+            from .p2p.obs import P2PObsClient
+        except ModuleNotFoundError:  # no cryptography: HTTP/loopback only
+            return
+        for key, route in networked.known_routes().items():
+            peer_id = key.hex()
+            with self._lock:
+                rec = self._peers.get(peer_id)
+                client = rec["client"] if rec else None
+            # Register new peers AND refresh a known peer whose route
+            # moved (re-pair after a restart on a new addr/port): the
+            # poller must follow the route table, not pin the client
+            # it first built.
+            if client is not None and (
+                    getattr(client, "addr", None),
+                    getattr(client, "port", None)) == route:
+                continue
+            self.add_peer(peer_id, P2PObsClient(
+                self.node.p2p, route[0], route[1],
+                expected=RemoteIdentity(key)))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            with self._lock:
+                self._task = tasks.spawn(
+                    "fleet-poller", self._loop(), owner=self._owner)
+
+    def stop(self) -> None:
+        with self._lock:
+            task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # a bad round must not kill the poller
+                tracing.logger.warning("fleet poll round failed: %s", e)
+
+    # -- the poller --------------------------------------------------------
+
+    async def _poll_peer(self, peer_id: str) -> None:
+        with self._lock:
+            rec = self._peers.get(peer_id)
+            client = rec["client"] if rec else None
+        if client is None:
+            return
+        t0 = time.time()
+        try:
+            resp = await with_timeout("fleet.poll",
+                                      client.fetch("obs.health"))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # ANY transport/protocol failure is "unreachable" — a
+            # handshake ProtoError, a torn frame, a JSON decode error
+            # — one bad peer must only ever cost its own row, never
+            # abort the round's gather (the healthy peers' snapshots
+            # still merge and _publish still runs).
+            FLEET_POLLS.labels(outcome="unreachable").inc()
+            with self._lock:
+                rec = self._peers.get(peer_id)
+                if rec is not None:
+                    rec["error"] = f"{type(e).__name__}: {e}"[:200]
+            return
+        t1 = time.time()
+        problems = validate_obs_response("obs.health", resp)
+        if problems:
+            # Rejected WITHOUT touching the ring: the fleet view keeps
+            # serving the last good snapshot (or a stale row) instead
+            # of whatever this peer just made up.
+            FLEET_POLLS.labels(outcome="malformed").inc()
+            with self._lock:
+                rec = self._peers.get(peer_id)
+                if rec is not None:
+                    rec["error"] = f"malformed snapshot: {problems[0]}"
+            return
+        # Clock skew from the poll's RTT midpoint: the peer sampled
+        # its wall clock roughly mid-exchange, so (peer_ts - midpoint)
+        # estimates how far ahead its clock runs — what trace assembly
+        # subtracts to land both nodes' events on one axis.
+        rtt = t1 - t0
+        skew = float(resp["ts"]) - (t0 + t1) / 2.0
+        FLEET_POLLS.labels(outcome="ok").inc()
+        with self._lock:
+            rec = self._peers.get(peer_id)
+            if rec is None:
+                return
+            rec["ring"].put_nowait({
+                "ts": round(t1, 3), "rtt_s": round(rtt, 6),
+                "skew_s": round(skew, 6), "node": resp["node"],
+                "health": resp["health"],
+            })
+            rec["last_ok"] = t1
+            rec["rtt_s"] = rtt
+            rec["skew_s"] = skew
+            rec["error"] = ""
+            if resp["node"].get("name"):
+                rec["name"] = resp["node"]["name"]
+
+    async def poll_once(self) -> Dict[str, Any]:
+        """One poll round: refresh the peer set from the p2p plane,
+        pull every peer concurrently, merge, publish."""
+        with tracing.span("fleet/poll"):
+            self.refresh_p2p_peers()
+            with self._lock:
+                ids = list(self._peers)
+            if ids:
+                await asyncio.gather(
+                    *(self._poll_peer(pid) for pid in ids))
+            return self._publish()
+
+    def _publish(self) -> Dict[str, Any]:
+        view = self.merge_view()
+        stale = sum(1 for row in view["nodes"].values()
+                    if not row["local"] and row["stale"])
+        with self._lock:
+            self._last = view
+            self._snapshots.put_nowait(view)
+            FLEET_PEERS.set(len(self._peers))
+        FLEET_PEERS_STALE.set(stale)
+        if self.events is not None:
+            self.events.emit({"type": "FleetHealthSnapshot",
+                              "ts": view["ts"], "fleet": view})
+        return view
+
+    # -- the merger --------------------------------------------------------
+
+    def _local_row(self) -> Optional[Dict[str, Any]]:
+        if self.health is None:
+            return None
+        snap = self.health.snapshot()
+        ident = dict(self.node_identity)
+        if not ident.get("id") and isinstance(snap.get("node"), dict):
+            ident = dict(snap["node"])
+        return {
+            "node": ident, "local": True, "reachable": True,
+            "stale": False, "last_seen": snap["ts"], "rtt_s": 0.0,
+            "skew_s": 0.0, "error": None,
+            "states": dict(snap["states"]),
+            "attribution": dict(snap["attribution"]),
+        }
+
+    @staticmethod
+    def _stale_row(rec: Dict[str, Any], age: Optional[float],
+                   stale_after: float) -> Dict[str, Any]:
+        """The degraded row an unreachable/stale peer renders as —
+        with last-seen evidence, per the poller's staleness rule."""
+        name = rec["name"]
+        if age is not None:
+            reason = (f"no good obs.health snapshot for {age:.1f}s "
+                      f"(stale after {stale_after:g}s)")
+        else:
+            reason = "peer never answered an obs.health poll"
+        if rec["error"]:
+            reason += f" — last error: {rec['error']}"
+        evidence: Dict[str, Any] = {
+            "last_seen": round(rec["last_ok"], 3)
+            if rec["last_ok"] else None,
+            "age_s": round(age, 3) if age is not None else None,
+            "stale_after_s": round(stale_after, 3),
+        }
+        return {
+            "node": {"id": rec["peer_id"], "name": name},
+            "local": False, "reachable": False, "stale": True,
+            "last_seen": rec["last_ok"], "rtt_s": rec["rtt_s"],
+            "skew_s": rec["skew_s"], "error": rec["error"] or None,
+            "states": {"peer": "degraded"},
+            "attribution": {"peer": [{
+                "resource": f"fleet.peer.{name}", "subsystem": "peer",
+                "severity": 1,
+                "score": round(age, 3) if age is not None else 0.0,
+                "reason": reason, "owner": "fleet",
+                "doc": "fleet.py staleness rule: a peer without a "
+                       "good snapshot inside 2x the poll interval "
+                       "is degraded, last-seen evidence inline",
+                "evidence": evidence,
+            }]},
+        }
+
+    def merge_view(self) -> Dict[str, Any]:
+        """The merged fleet health view: one row per node (local row
+        first), states/attribution re-keyed per `<node>/<subsystem>`."""
+        wall = time.time()
+        stale_after = STALE_INTERVALS * self.interval_s
+        nodes: Dict[str, Dict[str, Any]] = {}
+
+        def row_key(name: str, fallback: str) -> str:
+            key = name or fallback
+            if key in nodes:  # name collision: disambiguate by id
+                key = f"{key}#{fallback[:6]}"
+            return key
+
+        local = self._local_row()
+        if local is not None:
+            nodes[row_key(local["node"]["name"], "local")] = local
+        with self._lock:
+            peers = [(pid, dict(rec), list(rec["ring"]))
+                     for pid, rec in self._peers.items()]
+        for pid, rec, ring in peers:
+            age = (wall - rec["last_ok"]) if rec["last_ok"] else None
+            if not ring or age is None or age > stale_after:
+                row = self._stale_row(rec, age, stale_after)
+            else:
+                latest = ring[-1]
+                health = latest["health"]
+                row = {
+                    "node": dict(latest["node"]), "local": False,
+                    "reachable": True, "stale": False,
+                    "last_seen": rec["last_ok"],
+                    "rtt_s": round(rec["rtt_s"], 6)
+                    if rec["rtt_s"] is not None else None,
+                    "skew_s": round(rec["skew_s"], 6)
+                    if rec["skew_s"] is not None else None,
+                    "error": None,
+                    "states": dict(health["states"]),
+                    "attribution": dict(health["attribution"]),
+                }
+            nodes[row_key(row["node"]["name"], pid)] = row
+
+        states: Dict[str, str] = {}
+        attribution: Dict[str, List[Dict[str, Any]]] = {}
+        for node_name, row in nodes.items():
+            for sub, st in row["states"].items():
+                states[f"{node_name}/{sub}"] = st
+            for sub, entries in row["attribution"].items():
+                attribution[f"{node_name}/{sub}"] = entries
+        return {
+            "ts": round(wall, 3),
+            "interval_s": self.interval_s,
+            "stale_after_s": stale_after,
+            "node": dict(self.node_identity),
+            "nodes": nodes,
+            "states": states,
+            "attribution": attribution,
+        }
+
+    async def snapshot(self, max_age_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """The latest merged view; polls fresh when none exists or the
+        last one is older than `max_age_s` (default 2x interval) —
+        covers loop-less embedders exactly like HealthMonitor."""
+        limit = STALE_INTERVALS * self.interval_s \
+            if max_age_s is None else max_age_s
+        with self._lock:
+            last = self._last
+        if last is not None and (time.time() - last["ts"]) <= limit:
+            return last
+        return await self.poll_once()
+
+    def last_view(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._last
+
+    # -- fleet metrics -----------------------------------------------------
+
+    async def metrics(self) -> Dict[str, Any]:
+        """Per-node cumulative metrics snapshots: the local registry
+        plus every reachable peer's obs.metrics, fetched on demand
+        (cumulative families are big; nothing here is cached)."""
+        rows: Dict[str, Dict[str, Any]] = {}
+        local_name = self.node_identity["name"] or "local"
+        rows[local_name] = {
+            "node": dict(self.node_identity), "local": True,
+            "error": None,
+            # Off-loop like every other obs snapshot build: the walk
+            # visits every registered family.
+            "metrics": await asyncio.to_thread(telemetry.snapshot),
+        }
+        with self._lock:
+            peers = [(pid, rec["name"], rec["client"])
+                     for pid, rec in self._peers.items()]
+
+        async def one(pid, name, client):
+            try:
+                resp = await with_timeout("fleet.poll",
+                                          client.fetch("obs.metrics"))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                return {"node": {"id": pid, "name": name},
+                        "local": False,
+                        "error": f"{type(e).__name__}: {e}"[:200],
+                        "metrics": None}
+            problems = validate_obs_response("obs.metrics", resp)
+            if problems:
+                return {"node": {"id": pid, "name": name},
+                        "local": False, "error": problems[0],
+                        "metrics": None}
+            return {"node": dict(resp["node"]), "local": False,
+                    "error": None, "metrics": resp["metrics"]}
+
+        fetched = await asyncio.gather(
+            *(one(pid, name, client) for pid, name, client in peers))
+        for (pid, name, _client), row in zip(peers, fetched):
+            key = name if name not in rows else f"{name}#{pid[:6]}"
+            rows[key] = row
+        return {"ts": round(time.time(), 3),
+                "node": dict(self.node_identity), "nodes": rows}
+
+    # -- distributed trace assembly ----------------------------------------
+
+    async def assemble_trace(self, trace: str) -> Dict[str, Any]:
+        """Fetch every paired peer's spans+timeline for `trace` and
+        merge them with the local slice into one Chrome-trace doc with
+        per-node pid lanes and skew-aligned clocks (the skew each
+        peer's poll round estimated; a peer polled never gets 0)."""
+        trace = str(trace)
+        with tracing.span("fleet/trace", trace=trace):
+            local_name = self.node_identity["name"] or "local"
+            spans = tracing.recent_spans(
+                limit=tracing.span_ring_capacity(), trace_id=trace)
+            timeline = [ev for ev in flight.RECORDER.snapshot()
+                        if ev.get("trace") == trace]
+            rows: List[Dict[str, Any]] = [{
+                "node": local_name, "spans": spans,
+                "timeline": timeline, "skew_s": 0.0,
+            }]
+            with self._lock:
+                peers = [(pid, rec["name"], rec["client"],
+                          rec["skew_s"])
+                         for pid, rec in self._peers.items()]
+
+            async def one(name, client, skew):
+                try:
+                    resp = await with_timeout(
+                        "fleet.trace.fetch",
+                        client.fetch("obs.trace", trace=trace))
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    FLEET_POLLS.labels(outcome="unreachable").inc()
+                    return None  # assembled from who answered
+                if validate_obs_response("obs.trace", resp):
+                    FLEET_POLLS.labels(outcome="malformed").inc()
+                    return None
+                return {
+                    "node": resp["node"].get("name") or name,
+                    "spans": resp["spans"],
+                    "timeline": resp["timeline"],
+                    "skew_s": skew or 0.0,
+                }
+            fetched = await asyncio.gather(
+                *(one(name, client, skew)
+                  for _pid, name, client, skew in peers))
+            rows.extend(r for r in fetched if r is not None)
+            return flight.fleet_chrome_trace(
+                rows, trace=trace,
+                fleet_name=f"fleet via {local_name}")
+
+
+# -- fleet snapshot schema gate ----------------------------------------------
+
+def validate_fleet_snapshot(doc: Any) -> List[str]:
+    """Schema gate for a merged fleet view (the fleet.health payload
+    and the `sd_top --fleet --json` artifact body). Returns problem
+    strings (empty = valid) — the same contract shape as
+    health.validate_health_snapshot, extended per-node."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["fleet snapshot must be a dict"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        problems.append("ts must be a number")
+    if not isinstance(doc.get("node"), dict):
+        problems.append("node (the assembling node) must be a dict")
+    nodes = doc.get("nodes")
+    if not isinstance(nodes, dict) or not nodes:
+        return problems + ["nodes must be a non-empty dict"]
+    for name, row in nodes.items():
+        where = f"nodes[{name}]"
+        if not isinstance(row, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ident = row.get("node")
+        if not isinstance(ident, dict) or \
+                not isinstance(ident.get("id"), str) or \
+                not isinstance(ident.get("name"), str):
+            problems.append(f"{where}: node must be "
+                            "{id: str, name: str}")
+        for key in ("local", "reachable", "stale"):
+            if not isinstance(row.get(key), bool):
+                problems.append(f"{where}: {key} must be a bool")
+        states = row.get("states")
+        if not isinstance(states, dict) or not states:
+            problems.append(f"{where}: states must be a non-empty "
+                            "dict")
+            continue
+        for sub, st in states.items():
+            if st not in STATES:
+                problems.append(
+                    f"{where}.states[{sub}]: unknown state {st!r}")
+        if row.get("reachable") is False and \
+                states.get("peer") != "degraded":
+            problems.append(
+                f"{where}: unreachable/stale peer must carry "
+                "peer=degraded")
+        attribution = row.get("attribution")
+        if not isinstance(attribution, dict):
+            problems.append(f"{where}: attribution must be a dict")
+            continue
+        for sub, entries in attribution.items():
+            ew = f"{where}.attribution[{sub}]"
+            if sub not in states:
+                problems.append(f"{ew}: subsystem has no state")
+                continue
+            if not isinstance(entries, list) or not entries:
+                problems.append(f"{ew}: must be a non-empty list")
+                continue
+            worst = 0
+            for i, e in enumerate(entries):
+                if not isinstance(e, dict):
+                    problems.append(f"{ew}[{i}]: not an object")
+                    continue
+                for k in ("resource", "reason", "owner", "doc"):
+                    if not isinstance(e.get(k), str):
+                        problems.append(
+                            f"{ew}[{i}]: {k} must be a str")
+                if e.get("subsystem") != sub:
+                    problems.append(f"{ew}[{i}]: subsystem mismatch")
+                sev = e.get("severity")
+                if sev not in (1, 2):
+                    problems.append(
+                        f"{ew}[{i}]: severity must be 1 or 2")
+                else:
+                    worst = max(worst, sev)
+                if not isinstance(e.get("evidence"), dict):
+                    problems.append(
+                        f"{ew}[{i}]: evidence must be a dict")
+            if worst and states.get(sub) != STATES[worst]:
+                problems.append(
+                    f"{ew}: state {states.get(sub)!r} inconsistent "
+                    f"with worst attributed severity {worst}")
+    flat = doc.get("states")
+    if not isinstance(flat, dict):
+        problems.append("states must be a dict keyed node/subsystem")
+    else:
+        want = {f"{n}/{sub}": st
+                for n, row in nodes.items()
+                if isinstance(row, dict)
+                and isinstance(row.get("states"), dict)
+                for sub, st in row["states"].items()}
+        if flat != want:
+            problems.append(
+                "flattened states drifted from the per-node rows")
+    flat_attr = doc.get("attribution")
+    if not isinstance(flat_attr, dict):
+        problems.append(
+            "attribution must be a dict keyed node/subsystem")
+    else:
+        want_attr = {f"{n}/{sub}": entries
+                     for n, row in nodes.items()
+                     if isinstance(row, dict)
+                     and isinstance(row.get("attribution"), dict)
+                     for sub, entries in row["attribution"].items()}
+        if flat_attr != want_attr:
+            problems.append(
+                "flattened attribution drifted from the per-node rows")
+    return problems

@@ -26,7 +26,11 @@ id, `M` metadata naming every pid/tid — and `validate_chrome_trace()`
 is the schema gate: `tools/trace_export.py --json` self-checks through
 it in tier-1, the `node.trace.export` rspc route serves it from a live
 node, and `overlap_bench --trace` / `perf_smoke --trace` ship it next
-to their BENCH artifacts.
+to their BENCH artifacts. `fleet_chrome_trace()` is the multi-node
+composition (fleet.py distributed trace assembly): N nodes' captures
+as per-node pid-lane pairs on one skew-aligned axis, offsets recorded
+in the document metadata, behind `fleet.trace.export` and
+`tools/trace_export.py --fleet`.
 
 Design constraints: stdlib + channels/telemetry/tracing only — every
 layer (ops executors, benches, the API host) can import it without
@@ -44,7 +48,7 @@ from .telemetry import TRACE_TIMELINE_EVENTS
 
 __all__ = [
     "FlightRecorder", "RECORDER", "LANES", "chrome_trace",
-    "validate_chrome_trace",
+    "fleet_chrome_trace", "validate_chrome_trace",
 ]
 
 # The pipeline phases one batch moves through, in order. `window` is
@@ -239,26 +243,21 @@ def _timeline_tid_name(ev: Dict[str, Any]) -> str:
     return f"{prefix}{lane}"
 
 
-def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
-                 timeline: Optional[List[Dict[str, Any]]] = None,
-                 node_name: str = "node") -> Dict[str, Any]:
-    """Span ring + pipeline timeline → one Chrome-trace JSON document.
-
-    Defaults pull from the live process (the whole tracing ring, the
-    process recorder); callers with their own captures — the CLI
-    validating a fetched artifact, tests with synthetic events — pass
-    them explicitly.
-    """
-    if spans is None:
-        spans = tracing.recent_spans(limit=tracing.span_ring_capacity())
-    if timeline is None:
-        timeline = RECORDER.snapshot()
-
+def _node_trace_events(spans: List[Dict[str, Any]],
+                       timeline: List[Dict[str, Any]],
+                       node_name: str, pid_spans: int, pid_timeline: int,
+                       shift_us: int = 0
+                       ) -> Tuple[List[Dict[str, Any]],
+                                  List[Dict[str, Any]]]:
+    """One node's (meta, events) pair: span lanes under `pid_spans`,
+    timeline lanes under `pid_timeline`, every timestamp shifted by
+    `shift_us` (how the fleet merger aligns a remote node's wall clock
+    onto the assembling node's axis; 0 for the local export)."""
     events: List[Dict[str, Any]] = []
     meta: List[Dict[str, Any]] = [
-        {"ph": "M", "name": "process_name", "pid": PID_SPANS, "ts": 0,
+        {"ph": "M", "name": "process_name", "pid": pid_spans, "ts": 0,
          "args": {"name": f"{node_name}: spans"}},
-        {"ph": "M", "name": "process_name", "pid": PID_TIMELINE, "ts": 0,
+        {"ph": "M", "name": "process_name", "pid": pid_timeline, "ts": 0,
          "args": {"name": f"{node_name}: pipeline timeline"}},
     ]
 
@@ -274,14 +273,14 @@ def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
             tid = len(trace_tids) + 1
             trace_tids[trace] = tid
             meta.append({"ph": "M", "name": "thread_name",
-                         "pid": PID_SPANS, "tid": tid, "ts": 0,
+                         "pid": pid_spans, "tid": tid, "ts": 0,
                          "args": {"name": f"trace {trace}"}})
         args = {k: v for k, v in rec.items() if k not in ("span", "ms")}
         events.append({
             "ph": "X", "name": str(rec.get("span", "?")),
-            "ts": int(rec["ts_us"]),
+            "ts": max(0, int(rec["ts_us"]) + shift_us),
             "dur": max(0, int(float(rec.get("ms", 0.0)) * 1000)),
-            "pid": PID_SPANS, "tid": tid, "args": args,
+            "pid": pid_spans, "tid": tid, "args": args,
         })
 
     # Timeline lanes.
@@ -295,7 +294,7 @@ def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
             tid = len(lane_tids) + 1
             lane_tids[lane_name] = tid
             meta.append({"ph": "M", "name": "thread_name",
-                         "pid": PID_TIMELINE, "tid": tid, "ts": 0,
+                         "pid": pid_timeline, "tid": tid, "ts": 0,
                          "args": {"name": lane_name}})
         if ev.get("lane") == "window":
             name = f"bound:{ev.get('binding', '?')}"
@@ -303,11 +302,31 @@ def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
             name = f"{ev.get('lane', '?')} b{ev.get('batch', '?')}"
         args = {k: v for k, v in ev.items() if k != "ts_us"}
         events.append({
-            "ph": "X", "name": name, "ts": int(ev["ts_us"]),
+            "ph": "X", "name": name,
+            "ts": max(0, int(ev["ts_us"]) + shift_us),
             "dur": max(0, int(ev.get("dur_us", 0))),
-            "pid": PID_TIMELINE, "tid": tid, "args": args,
+            "pid": pid_timeline, "tid": tid, "args": args,
         })
+    return meta, events
 
+
+def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
+                 timeline: Optional[List[Dict[str, Any]]] = None,
+                 node_name: str = "node") -> Dict[str, Any]:
+    """Span ring + pipeline timeline → one Chrome-trace JSON document.
+
+    Defaults pull from the live process (the whole tracing ring, the
+    process recorder); callers with their own captures — the CLI
+    validating a fetched artifact, tests with synthetic events — pass
+    them explicitly.
+    """
+    if spans is None:
+        spans = tracing.recent_spans(limit=tracing.span_ring_capacity())
+    if timeline is None:
+        timeline = RECORDER.snapshot()
+
+    meta, events = _node_trace_events(
+        spans, timeline, node_name, PID_SPANS, PID_TIMELINE)
     events.sort(key=lambda e: (e["ts"], -e["dur"]))
     return {
         "displayTimeUnit": "ms",
@@ -317,6 +336,53 @@ def chrome_trace(spans: Optional[List[Dict[str, Any]]] = None,
             "timeline_events": len(timeline),
             "generator": "spacedrive_tpu flight recorder",
         },
+        "traceEvents": meta + events,
+    }
+
+
+def fleet_chrome_trace(rows: List[Dict[str, Any]],
+                       trace: Optional[str] = None,
+                       fleet_name: str = "fleet") -> Dict[str, Any]:
+    """N nodes' span/timeline captures → ONE Chrome-trace document
+    with per-node pid lanes (node i gets pids 2i+1 / 2i+2, named
+    after the node), every remote timestamp shifted onto the
+    assembling node's clock by that node's estimated skew.
+
+    `rows` are dicts: {"node": name, "spans": [...], "timeline":
+    [...], "skew_s": float} — skew_s is "how far ahead of the local
+    wall clock this node's clock runs" (fleet.py estimates it from
+    obs-poll RTT midpoints), so local_ts = remote_ts - skew. The
+    per-node offsets are recorded in otherData.clock_skew_s so the
+    correction is auditable, not silent."""
+    meta: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    skews: Dict[str, float] = {}
+    names: List[str] = []
+    for i, row in enumerate(rows):
+        name = str(row.get("node") or f"node{i}")
+        skew_s = float(row.get("skew_s") or 0.0)
+        names.append(name)
+        skews[name] = round(skew_s, 6)
+        m, e = _node_trace_events(
+            row.get("spans") or [], row.get("timeline") or [],
+            name, 2 * i + 1, 2 * i + 2,
+            shift_us=-int(skew_s * 1e6))
+        meta.extend(m)
+        events.extend(e)
+    events.sort(key=lambda e: (e["ts"], -e["dur"]))
+    other: Dict[str, Any] = {
+        "node": fleet_name,
+        "nodes": names,
+        "clock_skew_s": skews,
+        "spans": sum(1 for e in events if e["pid"] % 2 == 1),
+        "timeline_events": sum(1 for e in events if e["pid"] % 2 == 0),
+        "generator": "spacedrive_tpu fleet observatory",
+    }
+    if trace:
+        other["trace"] = str(trace)
+    return {
+        "displayTimeUnit": "ms",
+        "otherData": other,
         "traceEvents": meta + events,
     }
 

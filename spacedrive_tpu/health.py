@@ -148,9 +148,16 @@ class HealthMonitor:
     loop-less, exactly like the channels it builds on."""
 
     def __init__(self, events=None, interval_s: Optional[float] = None,
-                 owner: str = "health"):
+                 owner: str = "health", node_id: str = "",
+                 node_name: str = ""):
         self._lock = threading.Lock()
         self.events = events
+        # Node identity riding every snapshot (fleet federation needs
+        # labeled rows; skew needs the sampled-at wall clock, which
+        # `ts` has always carried). Empty strings for loose monitors
+        # (bench CLIs, tests) — the key is present either way so the
+        # schema is one shape.
+        self.node_identity = {"id": str(node_id), "name": str(node_name)}
         if interval_s is None:
             interval_s = float(flags.get("SDTPU_HEALTH_INTERVAL_S"))
         self.interval_s = max(0.05, interval_s)
@@ -296,6 +303,7 @@ class HealthMonitor:
 
             snap: Dict[str, Any] = {
                 "ts": round(wall, 3),
+                "node": dict(self.node_identity),
                 "window_s": _round(dt) if dt is not None else None,
                 "interval_s": self.interval_s,
                 "states": states,
@@ -600,6 +608,16 @@ def validate_health_snapshot(doc: Any) -> List[str]:
         return ["health snapshot must be a dict"]
     if not isinstance(doc.get("ts"), (int, float)):
         problems.append("ts must be a number")
+    node = doc.get("node")
+    if node is not None:
+        # Node identity is OPTIONAL (pre-fleet snapshots validate
+        # unchanged — backward-compatible shape) but typed when
+        # present: the fleet merger labels rows by it.
+        if not isinstance(node, dict) or \
+                not isinstance(node.get("id"), str) or \
+                not isinstance(node.get("name"), str):
+            problems.append(
+                "node must be {id: str, name: str} when present")
     if doc.get("window_s") is not None and \
             not isinstance(doc["window_s"], (int, float)):
         problems.append("window_s must be a number or null")

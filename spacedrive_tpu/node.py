@@ -17,6 +17,7 @@ import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
 from . import flags, tasks, telemetry, tracing
+from .fleet import FleetMonitor
 from .health import HealthMonitor
 from .jobs.manager import JobManager
 from .library import Libraries, Library
@@ -242,7 +243,13 @@ class Node:
         # family into bounded rings and attributes saturation; serves
         # node.health and the sd_health_state{subsystem} gauges.
         self.health = HealthMonitor(
-            self.events, owner=f"{self.task_owner}/health")
+            self.events, owner=f"{self.task_owner}/health",
+            node_id=self.config.id.hex(), node_name=self.config.name)
+        # Fleet observatory (fleet.py): polls paired peers' obs.health
+        # snapshots into bounded rings and merges the per-(node,
+        # subsystem) fleet view; serves fleet.health / fleet.metrics /
+        # fleet.trace.export.
+        self.fleet = FleetMonitor(self, owner=f"{self.task_owner}/fleet")
         self.p2p = None  # created by start_p2p (P2PManager)
         # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
         # bootstrap (cache version migration runs here), loop starts with
@@ -266,9 +273,10 @@ class Node:
         try:
             self.telemetry_reporter.start()
             self.health.start()
+            self.fleet.start()
         except RuntimeError:
             pass  # no running loop (sync tests); node.metrics and the
-            # on-demand node.health sample still work
+            # on-demand node.health / fleet.health samples still work
         self.libraries.init()
         # Dev seed (util/debug_initializer.rs): data-dir init.json.
         # BEFORE cold_resume so reset_on_startup never deletes a library
@@ -332,6 +340,7 @@ class Node:
         await self.jobs.shutdown()
         self.telemetry_reporter.stop()
         self.health.stop()
+        self.fleet.stop()
         await self.thumbnailer.stop()
         if self.p2p is not None:
             await self.p2p.stop()

@@ -1,4 +1,16 @@
-from .identity import Identity, RemoteIdentity
-from .manager import P2PManager
-
-__all__ = ["Identity", "RemoteIdentity", "P2PManager"]
+try:
+    from .identity import Identity, RemoteIdentity
+    from .manager import P2PManager
+    __all__ = ["Identity", "RemoteIdentity", "P2PManager"]
+except ModuleNotFoundError as e:  # pragma: no cover - environmental
+    # The tunnel layer needs the `cryptography` package; containers
+    # without it still import the package so the crypto-free
+    # observability submodule (p2p/obs.py: serve_obs + the fleet
+    # poller's payload shapes) stays usable. Touching P2PManager in
+    # such a runtime raises at the point of use, exactly as before.
+    # ONLY that one dependency is gated — any other missing module
+    # (msgpack, a typo'd import) must surface loudly, not read as
+    # "no crypto".
+    if (e.name or "").split(".")[0] != "cryptography":
+        raise
+    __all__ = []

@@ -25,6 +25,7 @@ from .. import tracing
 from ..timeouts import deadline, with_timeout
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity
+from .obs import OBS_KINDS, serve_obs
 from .proto import Tunnel, tunnel_handshake
 from .spaceblock import (
     SpaceblockRequest,
@@ -308,6 +309,20 @@ class P2PManager:
                 elif t == "file":
                     with tracing.span("p2p/file"):
                         await self._handle_file(tunnel, header)
+                elif t in OBS_KINDS:
+                    # Fleet observatory pull: serve the local
+                    # telemetry/health/trace snapshot. Built off-loop
+                    # (a snapshot walks the whole registry or copies
+                    # the span ring); the deadline brackets the
+                    # snapshot build AND the response send — the whole
+                    # exchange the p2p.obs contract declares, so a
+                    # wedged registry walk cannot hold a server slot
+                    # unbudgeted.
+                    with tracing.span("p2p/obs", what=t):
+                        async with deadline("p2p.obs"):
+                            resp = await asyncio.to_thread(
+                                serve_obs, self.node, header)
+                            await tunnel.send(resp)
                 elif t == "sync":
                     # handle_sync_stream opens its own continued
                     # sync.pull span parented directly on the

@@ -1,0 +1,143 @@
+"""P2p observability protocol: serve a node's telemetry, health, and
+trace slices to paired peers.
+
+The fleet observatory (spacedrive_tpu/fleet.py) cannot be built blind
+either: PR 10's traceparent already makes one logical operation span
+nodes, but each node's span ring, flight-recorder timeline, and health
+snapshots were stranded in-process. This module is the serving half of
+the federation plane — three request kinds riding the same
+authenticated tunnels as the data plane (manager.py dispatches them
+next to ping/pair/sync):
+
+- ``obs.metrics`` — the whole telemetry registry snapshot (the rspc
+  node.metrics payload) wrapped in a node-identity envelope;
+- ``obs.health``  — the health observatory's latest HealthSnapshot
+  (which itself now carries node identity + sampled-at wall clock);
+- ``obs.trace``   — a span-ring + flight-timeline slice, filterable
+  by trace id, capped at TRACE_SLICE_LIMIT entries per reply — the
+  raw material distributed trace assembly merges into one
+  Chrome-trace document.
+
+Every response is an envelope ``{status, proto, what, node, ts, ...}``
+so the poller can reject a malformed or stale-proto peer without
+poisoning its fleet view; every served request counts into
+``sd_obs_requests_total{what}``.
+
+Design constraints: this module must import WITHOUT the `cryptography`
+package (stdlib + the registry modules only) — the in-process
+loopback client (fleet.py) and the rspc obs.* queries serve the same
+snapshots through `serve_obs` in containers where the tunnel's crypto
+dependency is absent. Only `P2PObsClient` touches the tunnel layer,
+and only at call time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .. import flight, telemetry, tracing
+from ..telemetry import OBS_REQUESTS
+from ..timeouts import with_timeout
+
+__all__ = [
+    "OBS_PROTO", "OBS_KINDS", "TRACE_SLICE_LIMIT", "node_identity",
+    "serve_obs", "P2PObsClient",
+]
+
+# Observability wire version, echoed in every response envelope. Bump
+# on any payload-shape change: the poller refuses a mismatched peer
+# (one stale-proto node must degrade to a labeled stale row, never
+# corrupt the merged fleet view).
+OBS_PROTO = 1
+
+# The request kinds manager.py dispatches on (the `t` header field,
+# same discriminator scheme as ping/pair/spacedrop/file/sync).
+OBS_KINDS = ("obs.metrics", "obs.health", "obs.trace")
+
+# Per-reply cap on spans and timeline events in an obs.trace slice:
+# bounded well above the default rings (512 spans / 4096 timeline
+# events) so a whole ring ships in one reply, while a hostile `limit`
+# cannot make the responder build an unbounded copy.
+TRACE_SLICE_LIMIT = 8192
+
+
+def node_identity(node) -> Dict[str, str]:
+    """The identity envelope every obs response carries: the node's
+    config pub id + device name (the labels fleet rows render under)."""
+    try:
+        return {"id": node.config.id.hex(), "name": node.config.name}
+    except Exception:
+        return {"id": "", "name": ""}
+
+
+def _trace_slice(trace: Optional[str], limit: int) -> Dict[str, Any]:
+    """Span-ring + flight-timeline copies, newest-last, optionally
+    filtered to one trace id, each side capped at `limit`."""
+    limit = max(1, min(int(limit), TRACE_SLICE_LIMIT))
+    spans = tracing.recent_spans(limit=limit, trace_id=trace)
+    timeline = flight.RECORDER.snapshot()
+    if trace is not None:
+        timeline = [ev for ev in timeline if ev.get("trace") == trace]
+    return {"spans": spans, "timeline": timeline[-limit:]}
+
+
+def serve_obs(node, header: Dict[str, Any]) -> Dict[str, Any]:
+    """One obs request → one JSON-safe response envelope. The SINGLE
+    dispatch every transport goes through — the p2p handler
+    (manager.py), the rspc obs.* queries, and the in-process loopback
+    client (fleet.py) — so request validation and payload shape cannot
+    drift between transports. Never raises on a malformed header: a
+    hostile peer gets a status=error envelope, not a torn tunnel."""
+    what = header.get("t") if isinstance(header, dict) else None
+    if what not in OBS_KINDS:
+        OBS_REQUESTS.labels(what="error").inc()
+        return {"status": "error", "proto": OBS_PROTO,
+                "error": f"unknown obs kind {what!r}"}
+    resp: Dict[str, Any] = {
+        "status": "ok", "proto": OBS_PROTO, "what": what,
+        "node": node_identity(node), "ts": round(time.time(), 6),
+    }
+    if what == "obs.metrics":
+        resp["metrics"] = telemetry.snapshot()
+    elif what == "obs.health":
+        resp["health"] = node.health.snapshot()
+    else:  # obs.trace
+        trace = header.get("trace")
+        trace = str(trace) if trace else None
+        try:
+            limit = int(header.get("limit", TRACE_SLICE_LIMIT))
+        except (TypeError, ValueError):
+            limit = TRACE_SLICE_LIMIT
+        resp.update(_trace_slice(trace, limit))
+    OBS_REQUESTS.labels(what=what.split(".", 1)[1]).inc()
+    return resp
+
+
+class P2PObsClient:
+    """Fetch one peer's obs snapshots over an authenticated tunnel —
+    the production transport of the fleet poller. One short-lived
+    tunnel per fetch (the obs cadence is seconds, not frames; route
+    reuse belongs to the sync plane's cache): dial + handshake run
+    under the manager's p2p.connect budget, the request/response
+    exchange under p2p.obs."""
+
+    def __init__(self, p2p, addr: str, port: int, expected=None):
+        self.p2p = p2p
+        self.addr = addr
+        self.port = int(port)
+        self.expected = expected
+
+    async def fetch(self, what: str,
+                    trace: Optional[str] = None) -> Any:
+        tunnel = await self.p2p.open_stream(
+            self.addr, self.port, expected=self.expected)
+        try:
+            req: Dict[str, Any] = {"t": what, "proto": OBS_PROTO,
+                                   "tp": tracing.traceparent()}
+            if trace:
+                req["trace"] = str(trace)
+            await with_timeout("p2p.obs", tunnel.send(req))
+            return await with_timeout("p2p.obs", tunnel.recv())
+        finally:
+            tunnel.close()

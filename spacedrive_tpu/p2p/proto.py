@@ -36,6 +36,14 @@ from ..telemetry import (
 )
 from ..timeouts import deadline
 from .identity import Identity, RemoteIdentity
+# Observability request kinds (obs.metrics / obs.health / obs.trace)
+# ride the same header discriminator as ping/pair/spacedrop/file/sync;
+# re-exported here because this module IS the wire-format surface —
+# the payload builders live crypto-free in p2p/obs.py so loopback
+# transports share them. An obs response is one ordinary msgpack frame
+# under MAX_FRAME (the registry snapshot and the capped trace slice
+# both sit far below it).
+from .obs import OBS_KINDS, OBS_PROTO  # noqa: F401  (protocol surface)
 
 # Timeout discipline (tools/sdlint timeout-discipline pass): this
 # module is the TRANSPORT PRIMITIVE layer — read_frame/send/recv are

@@ -139,6 +139,12 @@ class NetworkedLibraries:
                   port: int) -> None:
         self._routes[identity.to_bytes()] = (addr, port)
 
+    def known_routes(self) -> Dict[bytes, Tuple[str, int]]:
+        """Copy of the paired identity → (addr, port) table — the
+        peer set the fleet observatory polls (fleet.py adopts every
+        entry as an obs peer)."""
+        return dict(self._routes)
+
     def _resolve(self, identity: RemoteIdentity
                  ) -> Optional[Tuple[str, int]]:
         key = identity.to_bytes()

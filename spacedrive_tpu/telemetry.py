@@ -760,6 +760,31 @@ CHAN_PUT_BLOCK_SECONDS = histogram(
     labelnames=("name",),
     buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120))
 
+# -- fleet observatory (fleet.py, p2p/obs.py) -------------------------------
+OBS_REQUESTS = counter(
+    "sd_obs_requests_total",
+    "Observability-protocol requests served to peers (p2p/obs.py "
+    "serve_obs — the p2p obs.* handler and the rspc obs.* queries "
+    "both dispatch through it), by request kind "
+    "(metrics | health | trace | error)",
+    labelnames=("what",))
+FLEET_POLLS = counter(
+    "sd_fleet_polls_total",
+    "Fleet-observatory peer poll attempts (fleet.py), by outcome: "
+    "ok | unreachable (connect/timeout failure, peer row goes "
+    "stale-degraded) | malformed (snapshot rejected by the schema "
+    "gate without touching the fleet view)",
+    labelnames=("outcome",))
+FLEET_PEERS = gauge(
+    "sd_fleet_peers",
+    "Peers currently registered with the fleet observatory's poller "
+    "(paired p2p routes plus explicitly added clients)")
+FLEET_PEERS_STALE = gauge(
+    "sd_fleet_peers_stale",
+    "Registered peers whose last good obs.health snapshot is older "
+    "than 2x the poll interval (or who never answered) — each is a "
+    "degraded row in the fleet view with last-seen evidence")
+
 # -- health observatory (health.py) -----------------------------------------
 HEALTH_STATE = gauge(
     "sd_health_state",

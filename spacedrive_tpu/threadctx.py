@@ -625,6 +625,19 @@ declare_owner(
     "note_put/note_drain run on the owning tunnel's loop.")
 
 declare_owner(
+    "fleet.FleetMonitor", "spacedrive_tpu/fleet.py::FleetMonitor",
+    {
+        "_peers": guarded_by("_lock"),
+        "_last": guarded_by("_lock"),
+        "_task": guarded_by("_lock"),
+    },
+    "Fleet observatory poller: the supervised poll loop mutates the "
+    "peer records and the cached merged view, while rspc handlers, "
+    "the sd_top CLI, and bench embedders read them on demand — the "
+    "peer map, last view, and task handle all move under the "
+    "monitor's _lock leaf.")
+
+declare_owner(
     "flight.FlightRecorder", "spacedrive_tpu/flight.py::FlightRecorder",
     {
         "ring": immutable_after_init(),

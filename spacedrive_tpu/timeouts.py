@@ -186,6 +186,21 @@ declare_timeout(
     "tools/chan_bench.py producer's bounded put on the block-policy "
     "bench channel — the measured put-block path.")
 
+# -- fleet (cross-node observability federation) ----------------------------
+
+declare_timeout(
+    "fleet.poll", 15.0,
+    "One whole obs.health/obs.metrics fetch from a paired peer "
+    "(fleet.py poll round): connect + request + response, any "
+    "transport. A hung peer costs the poller this budget, then its "
+    "row goes stale-degraded.")
+
+declare_timeout(
+    "fleet.trace.fetch", 60.0,
+    "One peer's obs.trace slice during distributed trace assembly "
+    "(fleet.py assemble_trace): span-ring + timeline copies are "
+    "bigger than health snapshots, so the budget is too.")
+
 # -- ops (device-pipeline put budgets; not wire awaits) ---------------------
 
 declare_timeout(
@@ -229,6 +244,13 @@ declare_timeout(
     "p2p.header_recv", 30.0,
     "Inbound dispatch header after an accepted handshake: a silent "
     "dialer cannot hold a server slot open.")
+
+declare_timeout(
+    "p2p.obs", 30.0,
+    "One obs.metrics/obs.health/obs.trace exchange on a tunnel "
+    "(p2p/obs.py P2PObsClient and the manager's serving side): the "
+    "request frame, the snapshot-building, and the response frame "
+    "all inside one budget.")
 
 declare_timeout(
     "p2p.pair", 60.0,

@@ -401,6 +401,12 @@ declare_span(
     "variant is the resolved backend (native/numpy/jax/oracle).")
 
 declare_span(
+    "fleet",
+    "One fleet-observatory round (fleet.py); variants: poll (pull "
+    "every paired peer's obs.health snapshot) and trace (distributed "
+    "trace assembly across the fleet).")
+
+declare_span(
     "job",
     "A job worker's whole run (jobs/worker.py); the variant is the "
     "job name. Root of the per-job trace; job.step spans nest under "

@@ -12,6 +12,8 @@ give.
     python -m tools.sd_top --url http://host:port --json    # one-shot artifact
     python -m tools.sd_top --json [--out PATH]              # self-check
     python -m tools.sd_top --input artifact.json            # validate only
+    python -m tools.sd_top --fleet --url http://host:port   # fleet matrix
+    python -m tools.sd_top --fleet --json                   # 2-node self-check
 
 - `--json` without `--url` runs the built-in SELF-CHECK: three
   synthetic saturations (a shedding channel, a slow store write lock,
@@ -25,6 +27,16 @@ give.
 - `--url` attaches to a live node over rspc HTTP; every fetched
   snapshot is validated before rendering (a malformed one exits 1).
 - `--input` validates a stored artifact (CI gating).
+- `--fleet` switches every mode to the fleet observatory: live/once/
+  json render the merged per-(node, subsystem) matrix from
+  `fleet.health`; `--fleet --json` without `--url` runs the 2-NODE
+  SELF-CHECK — a real second node process (tools/fleet_peer.py, its
+  own registry/span ring) is booted with seeded saturations, polled
+  over the obs protocol, and the artifact must attribute each seeded
+  saturation to the right declared resource ON THE REMOTE ROW (and
+  not on the local one), plus assemble one schema-clean two-lane
+  fleet trace under a single trace id. Non-zero exit on any
+  violation — the tier-1 gate for the whole federation plane.
 """
 
 from __future__ import annotations
@@ -143,6 +155,200 @@ def render_top(snap: dict, source: str = "", width: int = 100,
     return "\n".join(out)
 
 
+def fetch_fleet(url: str) -> dict:
+    """GET /rspc/fleet.health from a live node's API host."""
+    return _fetch_rspc(url, "fleet.health")
+
+
+def render_fleet(view: dict, source: str = "", width: int = 110) -> str:
+    """One text frame over a merged fleet view: a per-node liveness
+    header, then the (node, subsystem) state/attribution matrix."""
+    out = []
+    ts = time.strftime("%H:%M:%S", time.localtime(view.get("ts", 0)))
+    nodes = view.get("nodes", {})
+    out.append(f"sd_top --fleet — {source or 'fleet'}  ts={ts}  "
+               f"nodes={len(nodes)}  "
+               f"interval={_fmt(view.get('interval_s'))}s")
+    out.append("")
+    out.append(f"{'NODE':<14} {'REACH':<7} {'AGE':<8} {'RTT':<9} "
+               f"{'SKEW':<10} ERROR")
+    now = view.get("ts", time.time())
+    for name, row in sorted(nodes.items(),
+                            key=lambda kv: (not kv[1]["local"], kv[0])):
+        age = (f"{now - row['last_seen']:.1f}s"
+               if row.get("last_seen") else "-")
+        reach = "local" if row.get("local") else (
+            "ok" if row.get("reachable") else "STALE")
+        rtt = f"{row['rtt_s'] * 1e3:.1f}ms" \
+            if row.get("rtt_s") is not None else "-"
+        skew = f"{row['skew_s'] * 1e3:+.1f}ms" \
+            if row.get("skew_s") is not None else "-"
+        out.append(f"{name[:14]:<14} {reach:<7} {age:<8} {rtt:<9} "
+                   f"{skew:<10} {row.get('error') or ''}"[:width])
+    out.append("")
+    out.append(f"{'NODE':<14} {'SUBSYSTEM':<10} {'STATE':<10} "
+               "BOTTLENECK")
+    for name, row in sorted(nodes.items(),
+                            key=lambda kv: (not kv[1]["local"], kv[0])):
+        attribution = row.get("attribution", {})
+        for sub in sorted(row.get("states", {})):
+            st = row["states"][sub]
+            entries = attribution.get(sub, [])
+            top = ""
+            if entries:
+                e = entries[0]
+                top = f"{e['resource']} — {e['reason']}"
+            mark = STATE_MARK.get(st, "?")
+            out.append(f"{name[:14]:<14} {mark}{sub:<9} {st:<10} "
+                       f"{top}"[:width])
+    return "\n".join(out)
+
+
+def build_fleet_self_check() -> dict:
+    """The 2-node fleet gate: boot a REAL second node process
+    (tools/fleet_peer.py — its own registry, span ring, flight
+    recorder) with seeded saturations, poll it over the obs protocol,
+    merge the fleet view, and assemble a two-lane trace under one
+    trace id shared by both processes."""
+    import asyncio
+    import subprocess
+    import threading
+    import uuid as uuidlib
+
+    from spacedrive_tpu import health, tracing
+    from spacedrive_tpu.fleet import FleetMonitor, HttpObsClient
+
+    trace_id = f"{uuidlib.uuid4().int & ((1 << 63) - 1) | 1:x}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tools.fleet_peer",
+         "--name", "peer-b", "--trace", trace_id],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        # Bounded handshake read: a peer that wedges during boot must
+        # fail THIS gate fast, not park it on readline until the
+        # outer CI timeout.
+        box = {}
+        reader = threading.Thread(
+            target=lambda: box.__setitem__(
+                "line", proc.stdout.readline()),
+            daemon=True)
+        reader.start()
+        reader.join(timeout=120)
+        line = box.get("line", "")
+        if not line.strip():
+            raise SystemExit(
+                "sd_top: fleet peer failed to boot (no handshake "
+                "line within 120s)")
+        peer = json.loads(line)
+
+        # Local half: loose monitors (no full node needed) plus spans
+        # recorded under the SAME trace id the peer seeded.
+        local_health = health.HealthMonitor(
+            interval_s=0.05, node_id="sd-top-local",
+            node_name="sd-top")
+        with tracing.continue_trace(f"{trace_id}-2"):
+            with tracing.span("rpc/fleet.selfCheck"):
+                pass
+        monitor = FleetMonitor(
+            interval_s=0.5, node_id="sd-top-local",
+            node_name="sd-top", health=local_health)
+        monitor.add_peer(
+            peer["id"], HttpObsClient(f"http://127.0.0.1:{peer['port']}"),
+            name=peer["name"])
+
+        async def run():
+            view = await monitor.poll_once()
+            doc = await monitor.assemble_trace(trace_id)
+            return view, doc
+
+        view, doc = asyncio.run(run())
+        return {
+            "metric": "sd_top_fleet",
+            "source": "self-check",
+            "peer": peer,
+            "trace_id": trace_id,
+            "fleet": view,
+            "trace": doc,
+        }
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=20)
+        except Exception:
+            proc.kill()
+
+
+def fleet_self_check_problems(artifact: dict) -> list:
+    """Schema + semantic gate over the 2-node artifact: the remote row
+    must carry each seeded saturation attributed by declared resource
+    name (and the LOCAL row must not — separate registries is the
+    point), and the assembled trace must be schema-clean with both
+    nodes' span lanes under the one trace id."""
+    from spacedrive_tpu.fleet import validate_fleet_snapshot
+
+    view = artifact.get("fleet", {})
+    problems = validate_fleet_snapshot(view)
+    nodes = view.get("nodes", {})
+    remote = {n: row for n, row in nodes.items()
+              if isinstance(row, dict) and not row.get("local")}
+    local = {n: row for n, row in nodes.items()
+             if isinstance(row, dict) and row.get("local")}
+    if len(remote) != 1 or len(local) != 1:
+        problems.append(
+            f"fleet: want exactly 1 local + 1 remote row, got "
+            f"{len(local)}+{len(remote)}")
+        return problems
+    (rname, rrow), (_lname, lrow) = \
+        next(iter(remote.items())), next(iter(local.items()))
+    if not rrow.get("reachable"):
+        problems.append(f"fleet: remote row {rname} not reachable: "
+                        f"{rrow.get('error')}")
+        return problems
+
+    def attributed(row: dict, sub: str, resource: str) -> bool:
+        return any(e.get("resource") == resource
+                   for e in row.get("attribution", {}).get(sub, []))
+
+    for sub, resource in (("bench", "bench.shed"),
+                          ("store", "store.db.write_lock"),
+                          ("p2p", "p2p.ping")):
+        if not attributed(rrow, sub, resource):
+            problems.append(
+                f"fleet: seeded {resource} not attributed on the "
+                f"REMOTE row {rname}")
+        if attributed(lrow, sub, resource):
+            problems.append(
+                f"fleet: {resource} leaked onto the LOCAL row — "
+                "per-node attribution is not separated")
+    if rrow.get("states", {}).get("store") != "saturated":
+        problems.append("fleet: remote store state not saturated")
+    if rrow.get("skew_s") is None:
+        problems.append("fleet: remote row carries no skew estimate")
+
+    # The assembled-trace half shares trace_export's fleet gate (lane
+    # presence per node pid, skew metadata, no foreign trace ids) —
+    # one implementation for both CLIs; this gate only adds the
+    # self-check-specific facts.
+    from tools.trace_export import fleet_problems
+
+    doc = artifact.get("trace", {})
+    problems.extend(fleet_problems(doc))  # includes the schema gate
+    other = doc.get("otherData", {}) if isinstance(doc, dict) else {}
+    names = other.get("nodes", [])
+    if len(names) != 2:
+        problems.append(f"trace: want exactly 2 node lanes, "
+                        f"got {names}")
+    if other.get("trace") != artifact.get("trace_id"):
+        # With this pinned, fleet_problems' per-lane span presence +
+        # foreign-id rejection together prove both nodes contributed
+        # spans under THE seeded trace id.
+        problems.append(
+            f"trace: assembled for {other.get('trace')!r}, self-check "
+            f"seeded {artifact.get('trace_id')!r}")
+    return problems
+
+
 def build_self_check() -> dict:
     """Drive three KNOWN saturations through the real registry and a
     real HealthMonitor, so the artifact exercises every schema shape:
@@ -218,6 +424,11 @@ def main(argv=None) -> int:
                     help="render one frame instead of polling")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll seconds in live mode (default 2)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: render/validate the merged "
+                         "per-(node, subsystem) view from "
+                         "fleet.health (without --url, --json runs "
+                         "the 2-node self-check)")
     args = ap.parse_args(argv)
 
     from spacedrive_tpu import health
@@ -230,8 +441,14 @@ def main(argv=None) -> int:
             print(f"sd_top: unreadable {args.input}: {e}",
                   file=sys.stderr)
             return 1
-        problems = health.validate_health_snapshot(
-            artifact.get("health", artifact))
+        if args.fleet or artifact.get("metric") == "sd_top_fleet":
+            from spacedrive_tpu.fleet import validate_fleet_snapshot
+
+            problems = validate_fleet_snapshot(
+                artifact.get("fleet", artifact))
+        else:
+            problems = health.validate_health_snapshot(
+                artifact.get("health", artifact))
         for p in problems:
             print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
         if problems:
@@ -240,8 +457,12 @@ def main(argv=None) -> int:
         return 0
 
     if args.json and not args.url:
-        artifact = build_self_check()
-        problems = self_check_problems(artifact)
+        if args.fleet:
+            artifact = build_fleet_self_check()
+            problems = fleet_self_check_problems(artifact)
+        else:
+            artifact = build_self_check()
+            problems = self_check_problems(artifact)
         for p in problems:
             print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
         if problems:
@@ -257,6 +478,32 @@ def main(argv=None) -> int:
 
     if not args.url:
         ap.error("--url is required outside --json/--input modes")
+
+    if args.fleet:
+        from spacedrive_tpu.fleet import validate_fleet_snapshot
+
+        while True:
+            view = fetch_fleet(args.url)
+            problems = validate_fleet_snapshot(view)
+            for p in problems:
+                print(f"sd_top: SCHEMA: {p}", file=sys.stderr)
+            if problems:
+                return 1
+            if args.json:
+                artifact = {"metric": "sd_top_fleet",
+                            "source": args.url, "fleet": view}
+                if args.out:
+                    with open(args.out, "w", encoding="utf-8") as f:
+                        json.dump(artifact, f, indent=1)
+                print(json.dumps(artifact))
+                return 0
+            frame = render_fleet(view, source=args.url)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
 
     while True:
         snap = fetch_health(args.url)
