@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import WSMsgType, web
 
-from .. import channels, tasks, telemetry, threadctx, tracing
+from .. import channels, chaos, tasks, telemetry, threadctx, tracing
 from ..locations.paths import IsolatedPath
 from ..media.thumbnail import thumbnail_path
 from ..telemetry import API_REQUESTS
@@ -75,10 +75,28 @@ class WsSubscriptionPump:
     async def _drain(self) -> None:
         while True:
             payload = await self.chan.get()
+            # Chaos seam: delay = a slow consumer, wedge = a dead one
+            # that never reads — the channel above must shed while the
+            # drainer is parked (the node and its memory stay bounded;
+            # the pump itself is freed by unsubscribe/teardown
+            # cancelling this task), drop = a lost frame.
+            f = chaos.hit("api.ws.send", only=("delay", "drop", "wedge"))
+            if f is not None and await chaos.apply_async(f):
+                continue  # dropped
             await self._send(payload)
 
     async def stop(self) -> None:
         await tasks.cancel_and_gather(self._task)
+        # The subscriber is gone: drop its undelivered frames so the
+        # per-name depth gauge doesn't freeze at this DEAD instance's
+        # depth forever (found by load_bench's wedge gate: a chaos-
+        # wedged pump died at full depth and sd_chan_depth{api.ws}
+        # read "wedged" long after the consumer was reaped).
+        while True:
+            try:
+                self.chan.get_nowait()
+            except asyncio.QueueEmpty:
+                break
 
 
 @web.middleware
@@ -92,7 +110,8 @@ async def _count_requests(request: web.Request, handler):
 
 
 class ApiServer:
-    def __init__(self, node, router: Optional[Router] = None):
+    def __init__(self, node, router: Optional[Router] = None,
+                 http_inflight_cap: Optional[int] = None):
         self.node = node
         self._owner = f"{getattr(node, 'task_owner', 'proc')}/api"
         self.router = router or mount_router(node)
@@ -112,6 +131,17 @@ class ApiServer:
             self._file)
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+        # Admission window for rspc HTTP dispatch (declared channel
+        # api.http.inflight, policy shed_new): a request past capacity
+        # is refused with 503 SHED instead of queueing unbounded
+        # behind a saturated backend — the HTTP plane's version of the
+        # jobs run-queue's admission refusal. Sheds are the health
+        # observatory's named evidence for an API storm.
+        # `http_inflight_cap` narrows THIS instance below the declared
+        # ceiling (never above) — how the load harness drives the shed
+        # edge at bench scale.
+        self._inflight = channels.channel(
+            "api.http.inflight", capacity_cap=http_inflight_cap)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -189,26 +219,50 @@ class ApiServer:
 
     async def _rspc_http(self, request: web.Request) -> web.Response:
         path = request.match_info["path"]
-        if request.method == "POST":
-            try:
-                # Budgeted body read: a slow-loris client cannot pin
-                # the handler.
-                input = await with_timeout("api.http.read",
-                                           request.json())
-            except json.JSONDecodeError:
-                input = None
-            except asyncio.TimeoutError:
-                # A half-sent body must FAIL the request, not dispatch
-                # the procedure with null input.
-                return web.json_response(
-                    {"error": {"code": "TIMEOUT",
-                               "message": "request body read timed "
-                                          "out"}},
-                    status=408)
-        else:
-            raw = request.query.get("input")
-            input = json.loads(raw) if raw else None
+        if not self._inflight.put_nowait(1):
+            # Admission refusal FIRST — before the body read, so a
+            # shed request costs zero backend work (a flood of large
+            # or slow-trickled bodies must not buy a budgeted read
+            # each before being refused). The shed counts into
+            # sd_chan_shed_total{api.http.inflight}.
+            return web.json_response(
+                {"error": {"code": "SHED",
+                           "message": "API host at dispatch capacity; "
+                                      "retry with backoff"}},
+                status=503, headers={"Retry-After": "1"})
         try:
+            if request.method == "POST":
+                try:
+                    # Budgeted body read: a slow-loris client cannot
+                    # pin the handler (it occupies its admission slot
+                    # for at most the read budget).
+                    input = await with_timeout("api.http.read",
+                                               request.json())
+                except json.JSONDecodeError:
+                    input = None
+                except asyncio.TimeoutError:
+                    # A half-sent body must FAIL the request, not
+                    # dispatch the procedure with null input.
+                    return web.json_response(
+                        {"error": {"code": "TIMEOUT",
+                                   "message": "request body read "
+                                              "timed out"}},
+                        status=408)
+            else:
+                raw = request.query.get("input")
+                input = json.loads(raw) if raw else None
+            # Chaos seam (inside the admission window): delay = a slow
+            # backend — storms against it drive the shed path above;
+            # error = a failing one, reported as 503 so load clients
+            # exercise their retry discipline.
+            f = chaos.hit("api.http.dispatch", only=("delay", "error"))
+            if f is not None:
+                try:
+                    await chaos.apply_async(f)
+                except chaos.ChaosError as e:
+                    return web.json_response(
+                        {"error": {"code": "UNAVAILABLE",
+                                   "message": str(e)}}, status=503)
             # Clients (and the trace_export CLI pulling a live trace)
             # propagate their trace in X-Sdtpu-Trace; the dispatch
             # span then continues it, so an API-triggered sync/job
@@ -223,6 +277,11 @@ class ApiServer:
                 {"error": {"code": e.code, "message": e.message}},
                 status=400 if e.code == "BAD_REQUEST" else 404
                 if e.code == "NOT_FOUND" else 500)
+        finally:
+            try:
+                self._inflight.get_nowait()
+            except asyncio.QueueEmpty:  # pragma: no cover - defensive
+                pass
 
     async def _rspc_ws(self, request: web.Request) -> web.WebSocketResponse:
         ws = web.WebSocketResponse()

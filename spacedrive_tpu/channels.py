@@ -595,10 +595,29 @@ declare_channel(
     "events instead of buffering the node's event stream in RAM.")
 
 declare_channel(
+    "api.http.inflight", 256, "shed_new", "api",
+    "rspc HTTP admission window (api/server.py _rspc_http): one token "
+    "per in-flight dispatch. shed_new IS the API host's shed-load "
+    "edge — a request past capacity is refused with 503 SHED "
+    "immediately instead of queueing unbounded behind a saturated "
+    "backend (the jobs run-queue's admission refusal, for the HTTP "
+    "plane); sheds count into sd_chan_shed_total{api.http.inflight}, "
+    "which is how the health observatory attributes an API storm by "
+    "name.")
+
+declare_channel(
     "bench.chan", 256, "block", "tools",
     "tools/chan_bench.py producer/consumer burst channel: the "
     "measured put-block path (budget bench.chan.put).",
     put_budget="bench.chan.put")
+
+declare_channel(
+    "bench.load.wire", 64, "block", "tools",
+    "tools/load_bench.py stub-transport frame pipe: one instance per "
+    "direction per simulated peer, carrying the same tunnel-shaped "
+    "frames (clone pages, acks, pull pages) the TCP plane does — the "
+    "in-process wire the fleet-scale harness storms the real node "
+    "over.", put_budget="bench.load.wire.put")
 
 declare_channel(
     "bench.shed", 256, "shed_new", "tools",
@@ -694,6 +713,18 @@ declare_channel(
     "CLONE_WINDOW; a burst past it without a drain is a "
     "chan_overflow violation, and the drain itself runs under the "
     "sync.clone.drain budget at the call site.", kind="window")
+
+declare_channel(
+    "sync.clone.serve", 2, "block", "sync",
+    "Fair-share clone-serve page-fetch gate (sync/clone_serve.py): "
+    "each concurrent clone stream's next off-loop page fetch takes "
+    "one FIFO slot here, so N cloning peers round-robin the fetch "
+    "executor instead of a hot stream (fast acks, warm cache) "
+    "monopolizing it and starving slower peers — the load harness's "
+    "per-peer fairness gate measures the result. Block-wait p99 vs "
+    "the sync.clone.serve budget is the clone-overcommit signal the "
+    "health observatory attributes by name.",
+    put_budget="sync.clone.serve")
 
 declare_channel(
     "sync.ingest.events", 64, "coalesce", "sync",

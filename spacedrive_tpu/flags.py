@@ -148,6 +148,24 @@ declare(
     "per put.")
 
 declare(
+    "SDTPU_CHAOS", "", parse_str,
+    "Chaos-plane arming spec (chaos.py): `<point>=<fault>[,...];...` "
+    "with faults delay:<dur>[:<prob>] or one of error/drop/"
+    "disconnect/wedge/corrupt[:<prob>], e.g. "
+    "`p2p.tunnel.frame=drop:0.01,delay:50ms`. "
+    "Point names must be declared fault points; undeclared names and "
+    "kinds a point did not declare are REFUSED at parse. Read at "
+    "import / chaos.rearm_from_env(); empty = disarmed (one flag "
+    "check per injection site).")
+
+declare(
+    "SDTPU_CHAOS_SEED", 0, parse_int,
+    "Deterministic RNG seed for the armed chaos plane (chaos.py): "
+    "each fault point draws from its own Random seeded (seed, point "
+    "name), so a failing storm replays exactly under the same seed "
+    "regardless of how concurrent sites interleave.", strict=True)
+
+declare(
     "SDTPU_CLONE_PASSTHROUGH", True, parse_onoff,
     "Kill switch for the full-library-clone blob pass-through fast "
     "path (p2p/sync_net.py). `off` forces the per-op pull loop.")

@@ -52,11 +52,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import channels, flags, flight, tasks, telemetry, tracing
+from . import channels, chaos, flags, flight, tasks, telemetry, \
+    timeouts, tracing
 from .health import STATES, validate_health_snapshot
 from .p2p.obs import OBS_PROTO
 from .telemetry import FLEET_PEERS, FLEET_PEERS_STALE, FLEET_POLLS
-from .timeouts import with_timeout
+from .timeouts import with_backoff, with_timeout
 
 __all__ = [
     "FleetMonitor", "LoopbackObsClient", "HttpObsClient",
@@ -191,7 +192,16 @@ class HttpObsClient:
 
     async def fetch(self, what: str,
                     trace: Optional[str] = None) -> Any:
-        return await asyncio.to_thread(self._get, what, trace)
+        # Declared obs.http backoff: a transient connect failure
+        # against a restarting peer retries inside the caller's
+        # fleet.poll budget instead of failing the round outright;
+        # exhaustion surfaces the final error to the poller, which
+        # marks the row unreachable. URLError (and every socket-level
+        # refusal) is an OSError.
+        return await with_backoff(
+            "obs.http",
+            lambda: asyncio.to_thread(self._get, what, trace),
+            retry_on=(OSError,))
 
 
 # -- the federation engine ---------------------------------------------------
@@ -227,6 +237,13 @@ class FleetMonitor:
         self._peers: Dict[str, Dict[str, Any]] = {}  # sdlint: ok[unbounded-growth]
         self._snapshots = channels.channel("fleet.snapshots")
         self._last: Optional[Dict[str, Any]] = None
+        # Declared poll discipline for UNREACHABLE peers (timeouts.py
+        # fleet.peer.poll): a failed fetch parks the peer's next poll
+        # up the ladder instead of burning a fleet.poll budget every
+        # round; state evicts on success, so it is bounded by
+        # currently-unreachable peers. Never gives up — the row is
+        # already stale-degraded, and cap-cadence probes see the heal.
+        self._poll_backoff = timeouts.RetrySchedule("fleet.peer.poll")
 
     # -- peer registry -----------------------------------------------------
 
@@ -249,11 +266,40 @@ class FleetMonitor:
                 if name:
                     rec["name"] = name
             n = len(self._peers)
+        # A (re-)registered client is an affirmative route signal
+        # (fresh pair, route moved): probe it next round instead of
+        # waiting out a dead ladder from the old address.
+        self._poll_backoff.evict(peer_id)
         FLEET_PEERS.set(n)
 
     def remove_peer(self, peer_id: str) -> None:
         with self._lock:
             self._peers.pop(peer_id, None)
+            n = len(self._peers)
+        self._poll_backoff.evict(peer_id)
+        FLEET_PEERS.set(n)
+
+    def note_peer_gave_up(self, peer_id: str, reason: str,
+                          name: str = "") -> None:
+        """Hand-off from a data-plane retry ladder that exhausted
+        itself (the sync announcer's p2p.announce.reconnect give-up):
+        the peer renders as a stale-degraded row carrying the
+        give-up reason even if the observatory itself has not failed
+        a poll yet — operators see WHY sync stopped reaching it.
+        Registers an observability-less row (client None: the poller
+        skips it) when the peer was never an obs peer."""
+        with self._lock:
+            rec = self._peers.get(peer_id)
+            if rec is None:
+                rec = {
+                    "peer_id": peer_id, "name": name or peer_id[:12],
+                    "client": None,
+                    "ring": channels.channel("fleet.peer.snapshots"),
+                    "last_ok": None, "rtt_s": None, "skew_s": None,
+                    "error": "",
+                }
+                self._peers[peer_id] = rec
+            rec["error"] = str(reason)[:200]
             n = len(self._peers)
         FLEET_PEERS.set(n)
 
@@ -318,6 +364,15 @@ class FleetMonitor:
 
     # -- the poller --------------------------------------------------------
 
+    async def _fetch_health(self, client) -> Any:
+        # Chaos seam, INSIDE the fleet.poll budget: wedge parks the
+        # fetch until the budget fires and the row goes stale-degraded
+        # (disarming must let it recover — pinned by test_chaos).
+        f = chaos.hit("fleet.poll", only=("delay", "error", "wedge"))
+        if f is not None:
+            await chaos.apply_async(f)
+        return await client.fetch("obs.health")
+
     async def _poll_peer(self, peer_id: str) -> None:
         with self._lock:
             rec = self._peers.get(peer_id)
@@ -327,7 +382,7 @@ class FleetMonitor:
         t0 = time.time()
         try:
             resp = await with_timeout("fleet.poll",
-                                      client.fetch("obs.health"))
+                                      self._fetch_health(client))
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -337,6 +392,11 @@ class FleetMonitor:
             # abort the round's gather (the healthy peers' snapshots
             # still merge and _publish still runs).
             FLEET_POLLS.labels(outcome="unreachable").inc()
+            # Declared backoff instead of re-burning a fleet.poll
+            # budget on the dead peer every round: its next attempt
+            # waits the fleet.peer.poll ladder (the row is stale
+            # either way; healed peers are found at cap cadence).
+            self._poll_backoff.failure(peer_id)
             with self._lock:
                 rec = self._peers.get(peer_id)
                 if rec is not None:
@@ -361,6 +421,7 @@ class FleetMonitor:
         rtt = t1 - t0
         skew = float(resp["ts"]) - (t0 + t1) / 2.0
         FLEET_POLLS.labels(outcome="ok").inc()
+        self._poll_backoff.success(peer_id)
         with self._lock:
             rec = self._peers.get(peer_id)
             if rec is None:
@@ -384,9 +445,14 @@ class FleetMonitor:
             self.refresh_p2p_peers()
             with self._lock:
                 ids = list(self._peers)
-            if ids:
+            # Unreachable peers inside their backoff window are
+            # skipped this round (their rows render stale regardless);
+            # everyone else polls concurrently.
+            due = [pid for pid in ids
+                   if self._poll_backoff.allowed(pid)]
+            if due:
                 await asyncio.gather(
-                    *(self._poll_peer(pid) for pid in ids))
+                    *(self._poll_peer(pid) for pid in due))
             return self._publish()
 
     def _publish(self) -> Dict[str, Any]:
@@ -547,8 +613,13 @@ class FleetMonitor:
             "metrics": await asyncio.to_thread(telemetry.snapshot),
         }
         with self._lock:
+            # client None = a give-up hand-off row with no obs
+            # transport (note_peer_gave_up): it renders in the health
+            # view but cannot be fetched from — same skip as the
+            # poller's.
             peers = [(pid, rec["name"], rec["client"])
-                     for pid, rec in self._peers.items()]
+                     for pid, rec in self._peers.items()
+                     if rec["client"] is not None]
 
         async def one(pid, name, client):
             try:
@@ -596,9 +667,14 @@ class FleetMonitor:
                 "timeline": timeline, "skew_s": 0.0,
             }]
             with self._lock:
+                # Same client-None skip as the poller: a give-up
+                # hand-off row has no transport to fetch a trace
+                # slice from (and must not count an "unreachable"
+                # outcome for a peer that was never an obs peer).
                 peers = [(pid, rec["name"], rec["client"],
                           rec["skew_s"])
-                         for pid, rec in self._peers.items()]
+                         for pid, rec in self._peers.items()
+                         if rec["client"] is not None]
 
             async def one(name, client, skew):
                 try:

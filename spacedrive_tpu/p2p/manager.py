@@ -21,7 +21,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, Optional
 
-from .. import tracing
+from .. import chaos, tracing
 from ..timeouts import deadline, with_timeout
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity
@@ -109,6 +109,13 @@ class P2PManager:
                           expected: Optional[RemoteIdentity] = None
                           ) -> Tunnel:
         async with deadline("p2p.connect"):
+            # Chaos seam: error = unreachable peer (the announce
+            # loop's declared backoff path), wedge parks the dial
+            # until THIS deadline frees it.
+            f = chaos.hit("p2p.tunnel.open",
+                          only=("delay", "error", "wedge"))
+            if f is not None:
+                await chaos.apply_async(f)
             reader, writer = await asyncio.open_connection(addr, port)
             try:
                 return await tunnel_handshake(

@@ -28,7 +28,7 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
-from .. import channels
+from .. import channels, chaos
 from ..telemetry import (
     P2P_TUNNEL_BYTES_RECV,
     P2P_TUNNEL_BYTES_SENT,
@@ -107,22 +107,44 @@ class Tunnel:
     def _nonce(counter: int) -> bytes:
         return counter.to_bytes(12, "big")
 
-    def _seal(self, plain: bytes) -> bytes:
+    def _seal(self, plain: bytes, tamper: bool = False) -> bytes:
         """Encrypt + frame + count: every outbound path goes through
         here so the tunnel byte counters see ciphertext (what actually
-        crosses the wire, 4-byte length header excluded)."""
+        crosses the wire, 4-byte length header excluded). `tamper`
+        (chaos `corrupt` fault only) flips one ciphertext bit AFTER
+        sealing, so the peer's AEAD decrypt fails loudly — the
+        injected symptom of a flaky link past the checksum layer."""
         sealed = self._send.encrypt(self._nonce(self._send_ctr), plain, None)
         self._send_ctr += 1
+        if tamper:
+            sealed = bytes([sealed[0] ^ 0x01]) + sealed[1:]
         P2P_TUNNEL_BYTES_SENT.inc(len(sealed))
         write_frame(self.writer, sealed)
         return sealed
 
     async def send(self, msg: Any) -> None:
-        self._seal(msgpack.packb(msg, use_bin_type=True))
+        # Chaos seam (send half): drop = the frame is lost on the wire
+        # (never sealed, counter untouched — the peer's recv budget is
+        # what notices); corrupt = sealed then tampered (AEAD failure
+        # on the peer); delay/wedge/disconnect via the generic effects,
+        # all bounded by the caller's declared frame budget.
+        f = chaos.hit("p2p.tunnel.frame")
+        if f is not None:
+            if await chaos.apply_async(f):
+                return  # dropped
+        self._seal(msgpack.packb(msg, use_bin_type=True),
+                   tamper=f is not None and f.kind == "corrupt")
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
         self._frames.note_drain()  # drain flushes queued frames too
 
     async def recv(self) -> Any:
+        # Chaos seam (recv half): delay/wedge/disconnect only —
+        # dropping a RECEIVED frame would desync the counter nonce,
+        # which is a different bug than the one being injected.
+        f = chaos.hit("p2p.tunnel.frame",
+                      only=("delay", "disconnect", "wedge"))
+        if f is not None:
+            await chaos.apply_async(f)
         sealed = await read_frame(self.reader)  # sdlint: ok[timeout-discipline]
         P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)

@@ -21,35 +21,28 @@ import asyncio
 import uuid as uuidlib
 from typing import Dict, Optional, Tuple
 
-from .. import channels, flags, tasks, threadctx, tracing
+from .. import channels, flags, tasks, threadctx, timeouts, tracing
 from ..sync.ingest import Ingester, MessagesEvent, ReqKind, \
     pump_clone_stream
 from ..timeouts import with_timeout
+from ..sync.clone_serve import CLONE_WINDOW, serve_clone_stream, \
+    serve_gate
 from ..sync.manager import GetOpsArgs
 from ..sync.crdt import CRDTOperation
 from ..telemetry import (
     P2P_RECONNECTS,
     P2P_ROUTE_CACHE_HITS,
     P2P_ROUTE_CACHE_MISSES,
-    SYNC_CLONE_PAGES_RELAYED,
-    SYNC_CLONE_WINDOW_STALLS,
 )
 from ..tracing import logger
 from .identity import RemoteIdentity
 
 OPS_PER_REQUEST = 1000
 
-# Clone fast path flow control: pages in flight on the tunnel before
-# the originator waits for a watermark ack. The window IS the declared
-# p2p.tunnel.frames channel capacity (channels.py; default 4, scaled
-# by SDTPU_CHAN_SCALE, snapshotted at import): 4 at the bulk writers'
-# 4-16k-op pages keeps a few MB in transport buffers — enough that the
-# receiver's batched apply never starves on the wire, bounded enough
-# that a slow receiver exerts backpressure instead of ballooning
-# originator memory. Tunnel.send_nowait's runtime Window enforces the
-# same cap, so a drift between this constant and the registry is a
-# chan_overflow violation in tier-1, not silent memory growth.
-CLONE_WINDOW = channels.capacity("p2p.tunnel.frames")
+# CLONE_WINDOW and the windowed serving loop moved to the crypto-free
+# sync/clone_serve.py (round 19) so stub-transport fleets — tier-1 and
+# tools/load_bench.py — drive the REAL flow control; re-exported here
+# because this module remains the wire-facing surface.
 
 # Sync wire-format version, checked in BOTH directions: the originator
 # announces it in the new_ops header (responder refuses a mismatch), and
@@ -88,6 +81,21 @@ class NetworkedLibraries:
         # invalidated on send failure, so a steady announce stream does
         # not re-scan the discovery peer table per round.
         self._route_cache = channels.bounded_dict("p2p.route_cache")
+        # Declared reconnect discipline (timeouts.py registry): a peer
+        # that failed its last announce round is retried up the
+        # p2p.announce.reconnect ladder instead of being hammered on
+        # every local write; schedule state is evicted on success, so
+        # the maps are bounded by currently-flapping peers.
+        self._announce_backoff = timeouts.RetrySchedule(
+            "p2p.announce.reconnect")
+        # Peers already handed to the fleet observatory as stale
+        # (cleared on the next successful announce) — the hand-off
+        # happens once per outage, not once per capped retry.
+        # Bounded by currently-flapping peers.
+        self._gave_up: set = set()
+        # Fair-share page-fetch gate shared by this node's concurrent
+        # clone streams (sync/clone_serve.py).
+        self._clone_gate = serve_gate()
         self._ingest_locks: Dict[uuidlib.UUID, asyncio.Lock] = {}
         # Supervisor subtree for announce fan-outs + per-pull ingest
         # actors: Node.shutdown reaps any still in flight.
@@ -106,7 +114,18 @@ class NetworkedLibraries:
         elif kind == "delete":
             # Eviction path for the per-library maps: without it a
             # node cycling through libraries grows them forever
-            # (sdlint unbounded-growth).
+            # (sdlint unbounded-growth). The announce ladders evict
+            # with their peers — a peer no longer iterated by any
+            # announce round can never reach the success() eviction,
+            # so a flapping-then-unpaired peer would otherwise park
+            # its Backoff state forever. (An identity shared with
+            # another library rebuilds its ladder on the next
+            # failure — resetting is harmless; leaking is not.)
+            for identity in self._instances.get(library.id,
+                                                {}).values():
+                key = identity.to_bytes()
+                self._announce_backoff.evict(key)
+                self._gave_up.discard(key)
             self._instances.pop(library.id, None)
             self._ingest_locks.pop(library.id, None)
 
@@ -215,14 +234,41 @@ class NetworkedLibraries:
             if route is None:
                 continue
             key = identity.to_bytes()
+            if not self._announce_backoff.allowed(key):
+                # Backing off after a failed round: skipping is safe —
+                # the peer's pull loop drains our whole op log whenever
+                # any later announce (or its own reconnect) lands.
+                continue
             try:
                 await self._originate_one(library, identity, route)
                 self._route_cache[key] = route  # healthy: keep for next round
+                self._announce_backoff.success(key)
+                self._gave_up.discard(key)
             except (ConnectionError, OSError, asyncio.IncompleteReadError,
-                    asyncio.TimeoutError):
+                    asyncio.TimeoutError) as e:
                 self._route_cache.pop(key, None)  # stale: re-resolve next time
                 P2P_RECONNECTS.inc()
+                # Declared backoff instead of the old bare `continue`
+                # (which re-dialed a flapping peer on EVERY announce):
+                # each failure climbs the p2p.announce.reconnect
+                # ladder; exhaustion hands the peer to the fleet
+                # observatory as a stale row (operators see WHY sync
+                # stopped reaching it) and parks retries at the cap.
+                if self._announce_backoff.failure(key) is None and \
+                        key not in self._gave_up:
+                    self._note_gave_up(key, e)
                 continue  # peer offline; it will pull on reconnect
+
+    def _note_gave_up(self, key: bytes, err: BaseException) -> None:
+        self._gave_up.add(key)
+        fleet = getattr(self.node, "fleet", None)
+        if fleet is not None:
+            c = timeouts.BACKOFFS["p2p.announce.reconnect"]
+            fleet.note_peer_gave_up(
+                key.hex(),
+                f"sync announce gave up after {c.max_tries} tries "
+                f"({type(err).__name__}: {err}); retrying at the "
+                f"{c.cap_s:g}s cap")
 
     async def _originate_one(self, library, identity: RemoteIdentity,
                              route: Tuple[str, int]) -> None:
@@ -277,8 +323,13 @@ class NetworkedLibraries:
                 # per-op loop finishes the row tail.
                 if not clone_served and flags.get(
                         "SDTPU_CLONE_PASSTHROUGH"):
-                    clone_served = await self._serve_clone_stream(
-                        library, tunnel, clocks)
+                    # The windowed originator lives crypto-free in
+                    # sync/clone_serve.py (shared with the load
+                    # harness's stub transports); this node's streams
+                    # share one fair-share page-fetch gate.
+                    clone_served = await serve_clone_stream(
+                        library.sync, tunnel, clocks,
+                        gate=self._clone_gate)
                     if clone_served:
                         continue
                 ops = await asyncio.to_thread(
@@ -292,72 +343,6 @@ class NetworkedLibraries:
                 }))
         finally:
             tunnel.close()
-
-    async def _serve_clone_stream(self, library, tunnel, clocks) -> bool:
-        """Stream eligible blob pages (plus the interleaved row-format
-        ops that must precede each page's watermark advance) to the
-        pulling peer. Window invariant: at most CLONE_WINDOW unacked
-        pages in flight; each ack carries the receiver's durably
-        committed watermark, so a dropped stream resumes exactly where
-        the receiver's instance row says. Returns False (nothing sent)
-        when the peer is not a fresh clone target — the caller falls
-        through to the per-op page."""
-        # Generator construction is lazy — the SQL happens inside each
-        # next(), which runs off-loop below.
-        stream = library.sync.iter_clone_stream(clocks)  # sdlint: ok[blocking-async]
-        started = False
-        inflight = 0
-        try:
-            while True:
-                nxt = await asyncio.to_thread(next, stream, None)
-                if nxt is None:
-                    break
-                kind, item = nxt
-                if not started:
-                    await with_timeout(
-                        "p2p.frame_send",
-                        tunnel.send({"kind": "blob_stream",
-                                     "window": CLONE_WINDOW}))
-                    started = True
-                if kind == "ops":
-                    await with_timeout("p2p.frame_send", tunnel.send({
-                        "kind": "clone_ops",
-                        "ops": [op.to_wire() for op in item]}))
-                    continue
-                tunnel.send_nowait({"kind": "blob_page", **item})
-                SYNC_CLONE_PAGES_RELAYED.inc()
-                inflight += 1
-                if inflight >= CLONE_WINDOW:
-                    # One backpressure point per window instead of per
-                    # frame (the point of send_nowait): the window's
-                    # pages stream into the socket back-to-back, and a
-                    # slow receiver pauses us here, not mid-window.
-                    await with_timeout("sync.clone.drain", tunnel.drain())
-                while inflight >= CLONE_WINDOW:
-                    SYNC_CLONE_WINDOW_STALLS.inc()
-                    # Budgeted per page: the receiver's batched apply
-                    # commits a whole page behind each ack.
-                    ack = await with_timeout("sync.clone.ack",
-                                             tunnel.recv())
-                    if not isinstance(ack, dict) or ack.get("kind") != "ack":
-                        raise ConnectionError(
-                            f"clone stream: bad ack frame {ack!r}")
-                    inflight -= 1
-            # flush the final partial window
-            await with_timeout("sync.clone.drain", tunnel.drain())
-            while inflight > 0:
-                ack = await with_timeout("sync.clone.ack", tunnel.recv())
-                if not isinstance(ack, dict) or ack.get("kind") != "ack":
-                    raise ConnectionError(
-                        f"clone stream: bad ack frame {ack!r}")
-                inflight -= 1
-        except BaseException:
-            tunnel.close()  # mid-stream failure: no clean blob_done exists
-            raise
-        if started:
-            await with_timeout("p2p.frame_send",
-                               tunnel.send({"kind": "blob_done"}))
-        return started
 
     # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
 

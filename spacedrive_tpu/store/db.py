@@ -23,8 +23,9 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from . import models, sqlaudit, statements
-from .. import sanitize, telemetry
+from .. import chaos, sanitize, telemetry, timeouts
 from ..telemetry import (
+    STORE_BUSY_RETRIES,
     STORE_COMMIT_SECONDS,
     STORE_INIT_WARNINGS,
     STORE_TX,
@@ -300,7 +301,7 @@ class Database:
                 sqlaudit.tx_begin(conn)
                 yield conn
                 t_commit = time.perf_counter() if tm else 0.0
-                conn.commit()
+                self._commit_with_retry(conn)
                 sqlaudit.tx_end(conn, committed=True)
                 if tm:
                     STORE_COMMIT_SECONDS.observe(
@@ -318,6 +319,38 @@ class Database:
                         conn.execute("PRAGMA wal_checkpoint(PASSIVE)")
                 except (OSError, sqlite3.Error):
                     pass
+
+    def _commit_with_retry(self, conn: sqlite3.Connection) -> None:
+        """COMMIT under the declared `store.busy` backoff: sqlite BUSY
+        (an external process holding the file lock — WAL writers from
+        a backup tool, another node sharing the library file, or an
+        injected `store.commit` chaos fault) degrades to bounded
+        jittered latency (sd_store_busy_retries_total) instead of
+        failing the whole job's transaction. The ladder is short
+        (~2 s worst case) because the write lock is held throughout;
+        exhaustion re-raises the BUSY to the tx() caller."""
+        b: Optional[timeouts.Backoff] = None
+        while True:
+            f = chaos.hit("store.commit", only=("delay", "error"))
+            try:
+                if f is not None:
+                    if f.kind == "error":
+                        raise sqlite3.OperationalError(
+                            "database is locked")
+                    chaos.apply_sync(f)  # delay: fsync weather
+                conn.commit()
+                return
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                if b is None:
+                    b = timeouts.Backoff("store.busy")
+                d = b.next_delay()
+                if d is None:
+                    raise
+                STORE_BUSY_RETRIES.inc()
+                time.sleep(d)
 
     # NOTE: the old `execute(sql, params)` wrapper is gone. It wrapped
     # EVERY statement — reads included — in a write transaction (write

@@ -1368,6 +1368,22 @@ declare_stmt(
 # bench deliberately mimics the identify write shape byte-for-byte.)
 
 declare_stmt(
+    "bench.op_count",
+    "SELECT COUNT(*) FROM shared_operation",
+    verb="read", tables=("shared_operation",), cardinality="scalar",
+    coverage="tools",
+    doc="load_bench clone-convergence census: ground-truth ops held "
+        "by a simulated peer after its clone drains.")
+
+declare_stmt(
+    "bench.object_insert",
+    "INSERT INTO object (pub_id, kind, note) VALUES (?, ?, ?)",
+    verb="write", tables=("object",), tx_required=True,
+    coverage="tools",
+    doc="load_bench seed corpus: one blob wave's domain rows per tx "
+        "(the wave's op-log page rides the same transaction).")
+
+declare_stmt(
     "bench.file_path_insert",
     "INSERT INTO file_path (pub_id, name) VALUES (?, ?)",
     verb="write", tables=("file_path",), tx_required=True,

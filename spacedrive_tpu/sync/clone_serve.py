@@ -1,0 +1,160 @@
+"""Windowed clone-stream originator — the serving half of the
+full-library clone fast path, extracted from p2p/sync_net.py.
+
+Two reasons this lives crypto-free under sync/ instead of inside
+NetworkedLibraries:
+
+- **One protocol, every transport.** The receiver half
+  (`sync/ingest.pump_clone_stream`) always was transport-agnostic
+  (async `recv`/`send` callables); the originator half was welded to
+  the tunnel stack, so crypto-less containers — tier-1, and the
+  load harness's stub-transport fleets — could never drive the REAL
+  windowed flow control (CLONE_WINDOW in flight, per-page watermark
+  acks, drain deadlines). Now both halves speak through the same
+  tunnel-shaped duck (`send`/`send_nowait`/`drain`/`recv`/`close`),
+  and `tools/load_bench.py` storms it in-process.
+- **Fair-share serving.** With many peers cloning concurrently, each
+  stream used to requeue its next page fetch the instant an ack
+  freed its window — a hot stream (fast acks, warm cache) could
+  monopolize the executor and starve slower peers far below their
+  fair share (the load harness's starvation gate measures exactly
+  this). Page fetches now take a FIFO slot on the declared
+  ``sync.clone.serve`` block channel (capacity = concurrent fetches,
+  budget ``sync.clone.serve``): waiters are served strictly in
+  arrival order, so N streams round-robin the fetch executor and the
+  slowest peer's page rate stays a bounded fraction of the mean.
+
+Chaos seam ``sync.clone.page``: every outgoing blob page consults the
+armed chaos plane — `disconnect` is the mid-clone torn stream
+(reconnect must converge byte-identically from the receiver's durable
+watermark, pinned by tests/test_chaos.py), `drop` loses the frame so
+the ack window starves against the `sync.clone.ack` budget, `wedge`
+parks the stream against the drain/ack budgets, `delay` is link
+weather.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .. import channels, chaos
+from ..telemetry import (
+    SYNC_CLONE_PAGES_RELAYED,
+    SYNC_CLONE_WINDOW_STALLS,
+)
+from ..timeouts import with_timeout
+
+__all__ = ["CLONE_WINDOW", "serve_clone_stream", "serve_gate"]
+
+# Clone fast path flow control: pages in flight on the tunnel before
+# the originator waits for a watermark ack. The window IS the declared
+# p2p.tunnel.frames channel capacity (channels.py; default 4, scaled
+# by SDTPU_CHAN_SCALE, snapshotted at import): 4 at the bulk writers'
+# 4-16k-op pages keeps a few MB in transport buffers — enough that the
+# receiver's batched apply never starves on the wire, bounded enough
+# that a slow receiver exerts backpressure instead of ballooning
+# originator memory. Tunnel.send_nowait's runtime Window enforces the
+# same cap, so a drift between this constant and the registry is a
+# chan_overflow violation in tier-1, not silent memory growth.
+CLONE_WINDOW = channels.capacity("p2p.tunnel.frames")
+
+
+def serve_gate() -> channels.Channel:
+    """One node's fair-share page-fetch gate (a declared block
+    channel; construct once per serving component, share across its
+    concurrent clone streams)."""
+    return channels.channel("sync.clone.serve")
+
+
+async def _next_item(stream, gate: Optional[channels.Channel]):
+    """The stream's next (kind, item) — fetched off-loop under a FIFO
+    slot of the fair-share gate, so concurrent clone streams
+    round-robin the fetch executor instead of racing it."""
+    if gate is None:
+        return await asyncio.to_thread(next, stream, None)
+    await gate.put(None)
+    try:
+        return await asyncio.to_thread(next, stream, None)
+    finally:
+        gate.get_nowait()
+
+
+async def serve_clone_stream(sync, tunnel, clocks,
+                             gate: Optional[channels.Channel] = None
+                             ) -> bool:
+    """Stream eligible blob pages (plus the interleaved row-format
+    ops that must precede each page's watermark advance) to the
+    pulling peer. Window invariant: at most CLONE_WINDOW unacked
+    pages in flight; each ack carries the receiver's durably
+    committed watermark, so a dropped stream resumes exactly where
+    the receiver's instance row says. Returns False (nothing sent)
+    when the peer is not a fresh clone target — the caller falls
+    through to the per-op page.
+
+    `sync` is the library's SyncManager; `tunnel` is anything
+    tunnel-shaped (p2p Tunnel, the load harness's stub transport)."""
+    # Generator construction is lazy — the SQL happens inside each
+    # next(), which runs off-loop below.
+    stream = sync.iter_clone_stream(clocks)  # sdlint: ok[blocking-async]
+    started = False
+    inflight = 0
+    try:
+        while True:
+            nxt = await _next_item(stream, gate)
+            if nxt is None:
+                break
+            kind, item = nxt
+            if not started:
+                await with_timeout(
+                    "p2p.frame_send",
+                    tunnel.send({"kind": "blob_stream",
+                                 "window": CLONE_WINDOW}))
+                started = True
+            if kind == "ops":
+                await with_timeout("p2p.frame_send", tunnel.send({
+                    "kind": "clone_ops",
+                    "ops": [op.to_wire() for op in item]}))
+                continue
+            # Chaos seam: a dropped page starves the ack window (the
+            # sync.clone.ack budget notices), a disconnect tears the
+            # stream mid-clone, a wedge parks it against the drain
+            # budget. The counters let artifacts reconcile the
+            # receiver's observed stall with the injected cause.
+            f = chaos.hit("sync.clone.page")
+            dropped = f is not None and await chaos.apply_async(f)
+            if not dropped:
+                tunnel.send_nowait({"kind": "blob_page", **item})
+                SYNC_CLONE_PAGES_RELAYED.inc()
+            inflight += 1
+            if inflight >= CLONE_WINDOW:
+                # One backpressure point per window instead of per
+                # frame (the point of send_nowait): the window's
+                # pages stream into the socket back-to-back, and a
+                # slow receiver pauses us here, not mid-window.
+                await with_timeout("sync.clone.drain", tunnel.drain())
+            while inflight >= CLONE_WINDOW:
+                SYNC_CLONE_WINDOW_STALLS.inc()
+                # Budgeted per page: the receiver's batched apply
+                # commits a whole page behind each ack.
+                ack = await with_timeout("sync.clone.ack",
+                                         tunnel.recv())
+                if not isinstance(ack, dict) or ack.get("kind") != "ack":
+                    raise ConnectionError(
+                        f"clone stream: bad ack frame {ack!r}")
+                inflight -= 1
+        # flush the final partial window
+        await with_timeout("sync.clone.drain", tunnel.drain())
+        while inflight > 0:
+            ack = await with_timeout("sync.clone.ack", tunnel.recv())
+            if not isinstance(ack, dict) or ack.get("kind") != "ack":
+                raise ConnectionError(
+                    f"clone stream: bad ack frame {ack!r}")
+            inflight -= 1
+    except BaseException:
+        tunnel.close()  # mid-stream failure: no clean blob_done exists
+        raise
+    if started:
+        await with_timeout("p2p.frame_send",
+                           tunnel.send({"kind": "blob_done"}))
+    return started

@@ -20,7 +20,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from .. import channels, tasks
+from .. import channels, chaos, tasks
 from ..telemetry import SYNC_INGEST_PAGES
 from ..timeouts import with_timeout
 from .crdt import CRDTOperation
@@ -95,6 +95,21 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
     def _frozen(pub: bytes) -> bool:
         return sync.timestamps.get(pub, 0) < expect.get(pub, 0)
 
+    async def _send_ack(pub: bytes, fast: bool) -> None:
+        # Chaos seam: a dropped/torn ack leaves the originator's
+        # window full until its sync.clone.ack budget fires — the
+        # stream dies and the per-op pull loop finishes the tail from
+        # the durable watermark this ack would have carried.
+        f = chaos.hit("sync.clone.ack",
+                      only=("delay", "drop", "disconnect"))
+        if f is not None and await chaos.apply_async(f):
+            return  # dropped on the wire
+        await with_timeout(
+            "sync.clone.ack_send",
+            send({"kind": "ack",
+                  "ts": sync.timestamps.get(pub, 0),
+                  "fast": bool(fast)}))
+
     while True:
         frame = await with_timeout("sync.clone.frame", recv())
         kind = frame.get("kind") if isinstance(frame, dict) else None
@@ -119,11 +134,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             pub = bytes(frame["instance"])
             if pub in dirty or _frozen(pub):
                 dirty.add(pub)
-                await with_timeout(
-                    "sync.clone.ack_send",
-                    send({"kind": "ack",
-                          "ts": sync.timestamps.get(pub, 0),
-                          "fast": False}))
+                await _send_ack(pub, False)
                 fallback_pages += 1
                 continue
             n, errs, fast = await asyncio.to_thread(
@@ -138,11 +149,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             # Ack AFTER the apply committed: the watermark the ack
             # carries is durable, so a crash mid-stream re-pulls from
             # exactly the right place.
-            await with_timeout(
-                "sync.clone.ack_send",
-                send({"kind": "ack",
-                      "ts": sync.timestamps.get(pub, 0),
-                      "fast": bool(fast)}))
+            await _send_ack(pub, fast)
         else:
             raise ValueError(f"unexpected clone-stream frame: {frame!r}")
 

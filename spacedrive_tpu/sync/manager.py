@@ -24,6 +24,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from .. import chaos
 from ..store import models as M
 from ..store.db import Database
 from ..telemetry import (
@@ -743,6 +744,13 @@ class SyncManager:
         propagation works across any connected mesh."""
         if not ops:
             return 0, []
+        # Chaos seam: error fails the page like a poisoned batch (the
+        # pull loop's frozen-watermark recovery re-serves it); delay
+        # is slow-apply weather — blocking THIS worker thread is the
+        # injected symptom (every wire caller runs ingest off-loop).
+        f = chaos.hit("sync.ingest.apply", only=("delay", "error"))
+        if f is not None:
+            chaos.apply_sync(f)
         # Row-format first, indexes second: ingest's LWW compares and
         # tombstone checks are per-(model, record_id) lookups, so any
         # solo-era blob pages explode to rows before the index build

@@ -24,7 +24,8 @@ Design constraints, in order:
   round 9 the lint is sdlint's telemetry pass; the shim remains).
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
-  sanitize | jit | task | timeout | chan | health | sql.
+  sanitize | jit | task | timeout | chan | health | sql | chaos |
+  backoff.
 - **Windowed reads without resets.** Counters and histograms expose
   `snapshot_delta(cursor)` — an exact delta view since a previous
   cursor — so the health observatory (health.py) can compute windowed
@@ -619,6 +620,12 @@ STORE_WRITE_LOCK_WAIT_SECONDS = histogram(
     "sd_store_write_lock_wait_seconds",
     "Time spent waiting for the per-database write lock",
     buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1, 5, 30))
+STORE_BUSY_RETRIES = counter(
+    "sd_store_busy_retries_total",
+    "Write-transaction commits retried under the declared store.busy "
+    "backoff after sqlite BUSY (an external writer — or an injected "
+    "store.commit chaos fault — holding the file lock): the retry "
+    "degrades the fault to latency instead of failing the job")
 STORE_INIT_WARNINGS = counter(
     "sd_store_init_warnings_total",
     "Non-fatal problems swallowed while opening a library database "
@@ -805,3 +812,27 @@ TIMEOUTS_FIRED = counter(
     "Declared network-await budgets that fired, per contract name "
     "(timeouts.py registry) — which peers/paths are hanging",
     labelnames=("name",))
+
+# -- backoff contracts (timeouts.py declare_backoff) -------------------------
+BACKOFF_RETRIES = counter(
+    "sd_backoff_retries_total",
+    "Retries scheduled under a declared backoff policy (timeouts.py "
+    "registry), per policy name — each is one jittered-exponential "
+    "delay actually imposed on a failing operation",
+    labelnames=("name",))
+BACKOFF_GAVE_UP = counter(
+    "sd_backoff_gave_up_total",
+    "Backoff ladders exhausted (max_tries reached) per declared "
+    "policy name — the operation stops retrying and degrades (the "
+    "sync announcer hands the peer to the fleet observatory as "
+    "stale; callers of with_backoff see the final exception)",
+    labelnames=("name",))
+
+# -- chaos plane (chaos.py) --------------------------------------------------
+CHAOS_INJECTED = counter(
+    "sd_chaos_injected_total",
+    "Faults injected by the armed chaos plane (chaos.py, SDTPU_CHAOS "
+    "spec), per declared fault point and kind — counted BEFORE the "
+    "effect lands so artifacts reconcile observed degradation "
+    "against injected cause. 0 forever while disarmed",
+    labelnames=("name", "kind"))

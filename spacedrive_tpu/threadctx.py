@@ -625,6 +625,19 @@ declare_owner(
     "note_put/note_drain run on the owning tunnel's loop.")
 
 declare_owner(
+    "timeouts.Backoff", "spacedrive_tpu/timeouts.py::Backoff",
+    {
+        "tries": single_thread(),
+        "_gave_up_counted": single_thread(),
+    },
+    "One failing operation's retry-ladder state (timeouts.py "
+    "declare_backoff registry): instances are strictly per-use-site — "
+    "a commit retry lives inside one tx() call's thread, a "
+    "RetrySchedule ladder belongs to its owning loop — so the ladder "
+    "counter is single-thread by construction; distinct sites get "
+    "distinct instances, never a shared one.")
+
+declare_owner(
     "fleet.FleetMonitor", "spacedrive_tpu/fleet.py::FleetMonitor",
     {
         "_peers": guarded_by("_lock"),

@@ -33,15 +33,19 @@ window; equal budgets would race the legitimate decide path.
 from __future__ import annotations
 
 import asyncio
+import random
+import time
 from dataclasses import dataclass
-from typing import Any, Awaitable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple, Type
 
 from . import flags
-from .telemetry import TIMEOUTS_FIRED
+from .telemetry import BACKOFF_GAVE_UP, BACKOFF_RETRIES, TIMEOUTS_FIRED
 
 __all__ = [
     "TimeoutContract", "TIMEOUTS", "declare_timeout", "budget",
     "with_timeout", "deadline", "timeout_table_markdown",
+    "BackoffContract", "BACKOFFS", "declare_backoff", "Backoff",
+    "with_backoff", "RetrySchedule", "backoff_table_markdown",
 ]
 
 
@@ -153,6 +157,211 @@ def timeout_table_markdown() -> str:
 
 
 # ---------------------------------------------------------------------------
+# Declared retry/backoff policies — the recovery twin of the budget
+# table above. Before this registry the tree's retry loops were bare
+# `P2P_RECONNECTS.inc(); continue` shapes: fixed-interval hammering
+# with no ladder, no jitter, no give-up, and no way for a chaos test
+# to pin the discipline. Every retrying path now names a policy
+# declared here; each scheduled retry counts into
+# sd_backoff_retries_total{name} and an exhausted ladder into
+# sd_backoff_gave_up_total{name}.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BackoffContract:
+    name: str          # dotted id: "<layer>.<operation>"
+    base_s: float      # first retry delay
+    cap_s: float       # ladder ceiling
+    factor: float      # multiplier per retry
+    jitter: float      # ± fraction of the delay (thundering-herd break)
+    max_tries: int     # retries before give-up; 0 = retry forever
+    doc: str
+
+
+BACKOFFS: Dict[str, BackoffContract] = {}
+
+
+def declare_backoff(name: str, base_s: float, cap_s: float,
+                    factor: float, jitter: float, max_tries: int,
+                    doc: str) -> BackoffContract:
+    if name in BACKOFFS:
+        raise ValueError(f"backoff {name!r} declared twice")
+    if base_s <= 0 or cap_s < base_s:
+        raise ValueError(f"backoff {name!r}: want 0 < base <= cap")
+    if factor < 1.0:
+        raise ValueError(f"backoff {name!r}: factor must be >= 1")
+    if not 0.0 <= jitter < 1.0:
+        raise ValueError(f"backoff {name!r}: jitter must be in [0, 1)")
+    if max_tries < 0:
+        raise ValueError(f"backoff {name!r}: max_tries must be >= 0")
+    c = BackoffContract(name, float(base_s), float(cap_s),
+                        float(factor), float(jitter), int(max_tries),
+                        doc)
+    BACKOFFS[name] = c
+    return c
+
+
+class Backoff:
+    """One failing operation's ladder state for a declared policy.
+
+    `next_delay()` is called on each failure: it returns the jittered
+    delay to wait before the next try (counting the retry), or None
+    when the ladder is exhausted (counting the give-up) — the caller
+    stops retrying and degrades. `reset()` on success so an
+    intermittent peer climbs down. Deterministic under a seeded `rng`
+    (what the chaos tests pin); the default shares `random`'s global
+    stream, which is jitter's whole job."""
+
+    def __init__(self, name: str,
+                 rng: Optional[random.Random] = None):
+        c = BACKOFFS.get(name)
+        if c is None:
+            raise KeyError(f"undeclared backoff {name!r} (declare it "
+                           "in spacedrive_tpu/timeouts.py)")
+        self.contract = c
+        self.tries = 0
+        self._gave_up_counted = False
+        self._rng = rng
+        self._m_retry = BACKOFF_RETRIES.labels(name=name)
+        self._m_gave_up = BACKOFF_GAVE_UP.labels(name=name)
+
+    def next_delay(self) -> Optional[float]:
+        c = self.contract
+        if c.max_tries and self.tries >= c.max_tries:
+            # Counted ONCE per exhausted ladder, not once per call:
+            # RetrySchedule keeps probing a given-up key at the cap
+            # cadence, and each probe failure landing here must not
+            # re-count the same outage (the counter means "ladders
+            # exhausted", and the hand-off it documents fires once).
+            if not self._gave_up_counted:
+                # Same per-instance single-thread contract as `tries`
+                # above (threadctx.py; the armed recorder audits it).
+                self._gave_up_counted = True  # sdlint: ok[shared-mutation]
+                self._m_gave_up.inc()
+            return None
+        # Exponent clamped: an unbounded ladder (max_tries 0) parked
+        # at the cap for days would otherwise drive factor**tries past
+        # float range and raise OverflowError out of a poll loop.
+        d = min(c.cap_s, c.base_s * (c.factor ** min(self.tries, 64)))
+        # Writers span loop+worker contexts across INSTANCES, never on
+        # one instance: each ladder is strictly per-use-site (contract
+        # in threadctx.py; the armed race recorder audits it).
+        self.tries += 1  # sdlint: ok[shared-mutation]
+        if c.jitter:
+            r = (self._rng.random() if self._rng is not None
+                 else random.random())
+            d *= 1.0 + c.jitter * (2.0 * r - 1.0)
+        d *= flags.get("SDTPU_TIMEOUT_SCALE")
+        self._m_retry.inc()
+        return d
+
+    def exhausted(self) -> bool:
+        c = self.contract
+        return bool(c.max_tries) and self.tries >= c.max_tries
+
+    def reset(self) -> None:
+        self.tries = 0
+        self._gave_up_counted = False
+
+
+async def with_backoff(name: str, fn: Callable[[], Awaitable],
+                       retry_on: Tuple[Type[BaseException], ...]
+                       = (ConnectionError, OSError,
+                          asyncio.TimeoutError),
+                       rng: Optional[random.Random] = None) -> Any:
+    """Call `fn()` under the declared policy: a retryable failure
+    sleeps the ladder's next jittered delay and tries again; an
+    exhausted ladder re-raises the final failure (after counting the
+    give-up). CancelledError always propagates."""
+    b = Backoff(name, rng=rng)
+    while True:
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except retry_on:
+            d = b.next_delay()
+            if d is None:
+                raise
+            await asyncio.sleep(d)
+
+
+class RetrySchedule:
+    """Per-key backoff bookkeeping for POLL-shaped loops (the sync
+    announcer's peer fan-out, the fleet poller's round): the loop
+    itself keeps ticking, and this schedule answers "is `key` allowed
+    an attempt right now?" from each key's private ladder.
+
+    `failure(key)` advances the ladder and returns the delay until the
+    key's next allowed attempt — or None when the ladder just gave up
+    (the caller hands the key off: the announcer marks the peer stale
+    with the fleet observatory). A given-up key stays parked at the
+    policy cap (it is retried again, at cap cadence, so a healed peer
+    is eventually found without hammering a dead one). `success(key)`
+    evicts the key's state entirely — the maps are bounded by
+    currently-failing keys, not history."""
+
+    def __init__(self, name: str,
+                 rng: Optional[random.Random] = None):
+        self.name = name
+        self.contract = BACKOFFS[name] if name in BACKOFFS else None
+        if self.contract is None:
+            raise KeyError(f"undeclared backoff {name!r} (declare it "
+                           "in spacedrive_tpu/timeouts.py)")
+        self._rng = rng
+        self._ladders: Dict[Any, Backoff] = {}
+        self._retry_at: Dict[Any, float] = {}
+
+    def allowed(self, key: Any, now: Optional[float] = None) -> bool:
+        t = time.monotonic() if now is None else now
+        return t >= self._retry_at.get(key, 0.0)
+
+    def failure(self, key: Any, now: Optional[float] = None
+                ) -> Optional[float]:
+        t = time.monotonic() if now is None else now
+        b = self._ladders.get(key)
+        if b is None:
+            b = self._ladders[key] = Backoff(self.name, rng=self._rng)
+        d = b.next_delay()
+        if d is None:
+            # Gave up: park at the cap — cap-cadence probing finds a
+            # healed peer eventually; the caller does the hand-off.
+            self._retry_at[key] = t + self.contract.cap_s * \
+                flags.get("SDTPU_TIMEOUT_SCALE")
+            return None
+        self._retry_at[key] = t + d
+        return d
+
+    def gave_up(self, key: Any) -> bool:
+        b = self._ladders.get(key)
+        return b is not None and b.exhausted()
+
+    def success(self, key: Any) -> None:
+        self._ladders.pop(key, None)
+        self._retry_at.pop(key, None)
+
+    def evict(self, key: Any) -> None:
+        self.success(key)
+
+
+def backoff_table_markdown() -> str:
+    """README's generated backoff table (one row per declared
+    policy)."""
+    out = ["| Policy | Base | Cap | Factor | Jitter | Max tries "
+           "| Covers |",
+           "| --- | --- | --- | --- | --- | --- | --- |"]
+    for name in sorted(BACKOFFS):
+        c = BACKOFFS[name]
+        doc = " ".join(c.doc.split())
+        tries = str(c.max_tries) if c.max_tries else "∞"
+        out.append(
+            f"| `{name}` | {c.base_s:g}s | {c.cap_s:g}s | "
+            f"×{c.factor:g} | ±{c.jitter:.0%} | {tries} | {doc} |")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
 # THE budget namespace. Keep alphabetical within each layer; every
 # entry is enforced by the sdlint timeout-discipline pass (a network
 # await outside a declared budget fails the build).
@@ -185,6 +394,12 @@ declare_timeout(
     "bench.chan.put", 5.0,
     "tools/chan_bench.py producer's bounded put on the block-policy "
     "bench channel — the measured put-block path.")
+
+declare_timeout(
+    "bench.load.wire.put", 60.0,
+    "tools/load_bench.py stub-transport frame put: bounds a simulated "
+    "peer whose consumer half wedged, mirroring the TCP plane's "
+    "drain deadlines.")
 
 # -- fleet (cross-node observability federation) ----------------------------
 
@@ -299,6 +514,14 @@ declare_timeout(
     "interleaved ops, or blob_done) from the originator.")
 
 declare_timeout(
+    "sync.clone.serve", 120.0,
+    "One clone-serve page-fetch slot on the fair-share gate "
+    "(channels.py sync.clone.serve): with many peers cloning "
+    "concurrently, each stream's next page fetch waits its FIFO turn "
+    "here instead of letting a hot stream monopolize the executor — "
+    "a wait past this budget means the node is clone-overcommitted.")
+
+declare_timeout(
     "sync.ingest.backlog", 180.0,
     "Ingester waiting for space in its bounded request channel "
     "(channels.py sync.ingest.requests): the _pull consumer drains it "
@@ -315,3 +538,42 @@ declare_timeout(
     "Originator's wait for the responder's next pull request — the "
     "responder ingests the previous page (one tx per page) before "
     "asking again.")
+
+
+# ---------------------------------------------------------------------------
+# THE backoff namespace. Keep alphabetical within each layer; every
+# retrying loop in the tree must name a policy here (the bare
+# fixed-interval retry is the shape this registry retired).
+# ---------------------------------------------------------------------------
+
+declare_backoff(
+    "fleet.peer.poll", 10.0, 300.0, 2.0, 0.25, 0,
+    "Fleet-observatory polling of an UNREACHABLE peer (fleet.py): "
+    "after a failed obs.health fetch the peer's next poll waits this "
+    "ladder instead of burning a fleet.poll budget every round; "
+    "max_tries 0 = never gives up (the row is already stale-degraded; "
+    "cap-cadence probing notices the heal).")
+
+declare_backoff(
+    "obs.http", 0.2, 2.0, 2.0, 0.25, 3,
+    "HttpObsClient fetch retries (fleet.py): transient connect "
+    "failures against a restarting peer retry inside the caller's "
+    "fleet.poll budget; exhaustion surfaces the final error to the "
+    "poller, which marks the row unreachable.")
+
+declare_backoff(
+    "p2p.announce.reconnect", 0.5, 60.0, 2.0, 0.25, 6,
+    "Sync announce fan-out to a peer that failed its last round "
+    "(p2p/sync_net.py originate): a flapping peer is retried up this "
+    "ladder instead of being hammered on every local write; "
+    "exhaustion hands the peer to the fleet observatory as a stale "
+    "row and parks retries at the cap until it heals (peers pull on "
+    "reconnect regardless).")
+
+declare_backoff(
+    "store.busy", 0.05, 1.0, 2.0, 0.25, 5,
+    "Write-transaction commit retry on sqlite BUSY (store/db.py tx): "
+    "an external writer holding the file lock — or an injected "
+    "store.commit chaos fault — degrades to bounded latency "
+    "(sd_store_busy_retries_total) instead of failing the job; "
+    "exhaustion re-raises the BUSY.")

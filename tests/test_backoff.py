@@ -1,0 +1,210 @@
+"""Declared backoff discipline (timeouts.py declare_backoff /
+Backoff / with_backoff / RetrySchedule): ladder math under seeded
+jitter, the retry/give-up counters, the poll-shaped per-key schedule
+(the sync announcer's and fleet poller's adoption surface), the
+HttpObsClient's obs.http retry against a dead peer, and the fleet
+poller skipping an unreachable peer's round instead of re-burning
+its budget."""
+
+import asyncio
+import random
+
+import pytest
+
+from spacedrive_tpu import timeouts
+from spacedrive_tpu.telemetry import BACKOFF_GAVE_UP, BACKOFF_RETRIES
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_declare_backoff_validation():
+    try:
+        with pytest.raises(ValueError, match="declared twice"):
+            timeouts.declare_backoff("store.busy", 1, 2, 2, 0.1, 3, "")
+        with pytest.raises(ValueError, match="base <= cap"):
+            timeouts.declare_backoff("t.badcap", 2, 1, 2, 0.1, 3, "")
+        with pytest.raises(ValueError, match="factor"):
+            timeouts.declare_backoff("t.badf", 1, 2, 0.5, 0.1, 3, "")
+        with pytest.raises(ValueError, match="jitter"):
+            timeouts.declare_backoff("t.badj", 1, 2, 2, 1.5, 3, "")
+        with pytest.raises(KeyError, match="undeclared backoff"):
+            timeouts.Backoff("t.nope")
+    finally:
+        for name in ("t.badcap", "t.badf", "t.badj"):
+            timeouts.BACKOFFS.pop(name, None)
+
+
+def test_ladder_math_jitter_cap_and_give_up():
+    c = timeouts.BACKOFFS["p2p.announce.reconnect"]
+    b = timeouts.Backoff("p2p.announce.reconnect",
+                         rng=random.Random(0))
+    delays = []
+    while True:
+        d = b.next_delay()
+        if d is None:
+            break
+        delays.append(d)
+    assert len(delays) == c.max_tries
+    for k, d in enumerate(delays):
+        nominal = min(c.cap_s, c.base_s * (c.factor ** k))
+        assert nominal * (1 - c.jitter) <= d <= nominal * (1 + c.jitter)
+    assert max(delays) <= c.cap_s * (1 + c.jitter)
+    assert b.exhausted()
+    b.reset()
+    assert not b.exhausted() and b.tries == 0
+
+
+def test_ladder_counts_retries_and_give_up():
+    r0 = BACKOFF_RETRIES.labels(name="p2p.announce.reconnect").value
+    g0 = BACKOFF_GAVE_UP.labels(name="p2p.announce.reconnect").value
+    b = timeouts.Backoff("p2p.announce.reconnect",
+                         rng=random.Random(1))
+    c = b.contract
+    while b.next_delay() is not None:
+        pass
+    assert BACKOFF_RETRIES.labels(
+        name="p2p.announce.reconnect").value == r0 + c.max_tries
+    assert BACKOFF_GAVE_UP.labels(
+        name="p2p.announce.reconnect").value == g0 + 1
+
+
+def test_ladder_scales_with_timeout_scale(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    b = timeouts.Backoff("fleet.peer.poll", rng=random.Random(2))
+    d = b.next_delay()
+    c = b.contract
+    assert d is not None and d <= c.base_s * (1 + c.jitter) * 0.001
+
+
+def test_unbounded_policy_never_gives_up():
+    b = timeouts.Backoff("fleet.peer.poll", rng=random.Random(3))
+    # max_tries 0: the ladder parks at the cap — and stays finite far
+    # past float-pow range (a peer dead for days must not turn
+    # factor**tries into an OverflowError out of the poll loop).
+    for _ in range(1200):
+        d = b.next_delay()
+        assert d is not None
+        assert d <= b.contract.cap_s * (1 + b.contract.jitter)
+    assert not b.exhausted()
+
+
+def test_with_backoff_retries_then_succeeds(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    calls = [0]
+
+    async def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionError("transient")
+        return "recovered"
+
+    r0 = BACKOFF_RETRIES.labels(name="obs.http").value
+    assert _run(timeouts.with_backoff("obs.http", flaky)) == "recovered"
+    assert calls[0] == 3
+    assert BACKOFF_RETRIES.labels(name="obs.http").value == r0 + 2
+
+
+def test_with_backoff_exhaustion_reraises_final(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    g0 = BACKOFF_GAVE_UP.labels(name="obs.http").value
+
+    async def dead():
+        raise ConnectionRefusedError("still down")
+
+    with pytest.raises(ConnectionRefusedError):
+        _run(timeouts.with_backoff("obs.http", dead))
+    assert BACKOFF_GAVE_UP.labels(name="obs.http").value == g0 + 1
+
+
+def test_with_backoff_never_swallows_cancellation():
+    async def main():
+        async def hang():
+            raise asyncio.CancelledError()
+
+        with pytest.raises(asyncio.CancelledError):
+            await timeouts.with_backoff("obs.http", hang)
+    _run(main())
+
+
+def test_retry_schedule_per_key_ladders(monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "1.0")
+    rs = timeouts.RetrySchedule("p2p.announce.reconnect",
+                                rng=random.Random(4))
+    assert rs.allowed("a", now=0.0) and rs.allowed("b", now=0.0)
+    d = rs.failure("a", now=0.0)
+    assert d is not None and not rs.allowed("a", now=0.0)
+    assert rs.allowed("b", now=0.0)  # ladders are per key
+    assert rs.allowed("a", now=d + 0.01)  # window elapses
+    # exhaustion: None returned once, then parked at the cap
+    for _ in range(rs.contract.max_tries):
+        rs.failure("a", now=0.0)
+    assert rs.gave_up("a")
+    assert rs.failure("a", now=0.0) is None
+    assert not rs.allowed("a", now=rs.contract.cap_s - 1)
+    assert rs.allowed("a", now=rs.contract.cap_s + 1)
+    # success evicts ALL state: the maps stay bounded by failing keys
+    rs.success("a")
+    assert not rs.gave_up("a") and rs.allowed("a", now=0.0)
+    assert rs._ladders == {} or "a" not in rs._ladders
+    assert "a" not in rs._retry_at
+
+
+def test_http_obs_client_retries_against_dead_peer(monkeypatch):
+    """The obs.http adoption: a connection-refused peer is retried up
+    the declared ladder inside one fetch, then the final error
+    surfaces to the poller (which marks the row unreachable)."""
+    from spacedrive_tpu.fleet import HttpObsClient
+
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+    r0 = BACKOFF_RETRIES.labels(name="obs.http").value
+    client = HttpObsClient("http://127.0.0.1:9")  # discard port
+    with pytest.raises(OSError):
+        _run(client.fetch("obs.health"))
+    c = timeouts.BACKOFFS["obs.http"]
+    assert BACKOFF_RETRIES.labels(
+        name="obs.http").value == r0 + c.max_tries
+
+
+def test_fleet_poller_backs_off_unreachable_peer():
+    """A dead peer costs ONE fleet.poll budget, then its next rounds
+    are skipped until the fleet.peer.poll ladder elapses — while its
+    row keeps rendering stale-degraded. Re-registering the peer
+    (re-pair / route moved) probes it immediately."""
+    from test_fleet import _loose_monitor
+
+    from spacedrive_tpu.telemetry import FLEET_POLLS
+
+    class _Dead:
+        async def fetch(self, what, trace=None):
+            raise ConnectionRefusedError("gone")
+
+    fm = _loose_monitor(interval_s=0.05)
+    fm.add_peer("dd" * 16, _Dead(), name="delta")
+
+    def unreachable():
+        return FLEET_POLLS.labels(outcome="unreachable").value
+
+    async def main():
+        u0 = unreachable()
+        view = await fm.poll_once()
+        assert unreachable() == u0 + 1
+        assert view["nodes"]["delta"]["stale"]
+        # next round: still stale, but the dead peer is NOT re-polled
+        # (fleet.peer.poll base is 10s, far past this test)
+        view = await fm.poll_once()
+        assert unreachable() == u0 + 1
+        assert view["nodes"]["delta"]["stale"]
+        # explicit re-registration is an affirmative route signal
+        fm.add_peer("dd" * 16, _Dead(), name="delta")
+        await fm.poll_once()
+        assert unreachable() == u0 + 2
+    _run(main())
+
+
+def test_backoff_table_lists_every_policy():
+    table = timeouts.backoff_table_markdown()
+    for name in timeouts.BACKOFFS:
+        assert f"`{name}`" in table
+    assert "∞" in table  # fleet.peer.poll never gives up

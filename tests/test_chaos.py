@@ -1,0 +1,572 @@
+"""Chaos plane (spacedrive_tpu/chaos.py): the declared fault-point
+registry, the SDTPU_CHAOS spec grammar's refusal edges, seeded
+deterministic replay, the disarmed-cost budget, the static↔runtime
+fault-point drift gate, and the recovery paths the armed faults must
+prove — injected sqlite BUSY degrading to latency through the
+declared store.busy backoff, a mid-clone disconnect converging
+byte-identically after reconnect through the REAL windowed clone
+stream, a chaos-wedged ws pump shedding without wedging the node,
+and the fleet view degrading-then-recovering under seeded obs-poll
+faults with the outcome counters pinned."""
+
+import ast
+import asyncio
+import os
+import random
+import sqlite3
+import sys
+import time
+
+import pytest
+
+from spacedrive_tpu import chaos, channels, sanitize, timeouts
+from spacedrive_tpu.telemetry import (
+    BACKOFF_GAVE_UP,
+    CHAOS_INJECTED,
+    FLEET_POLLS,
+    STORE_BUSY_RETRIES,
+    TIMEOUTS_FIRED,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+try:
+    # Seed the objects package: in runtimes without `cryptography` the
+    # first attempt fails but leaves the non-crypto submodules cached,
+    # after which mount_router imports cleanly (container quirk; no-op
+    # where the dependency exists).
+    import spacedrive_tpu.objects  # noqa: F401
+except ModuleNotFoundError:
+    pass
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_after():
+    yield
+    chaos.disarm()
+
+
+def _seed_with_pattern(point: str, prob: float, want_first_fire: int,
+                       horizon: int = 8) -> int:
+    """A seed whose per-point draw sequence first fires at exactly
+    `want_first_fire` — mirrors chaos.py's (seed, name) RNG derivation
+    so the tests stay deterministic without hard-coding magic seeds."""
+    for seed in range(10_000):
+        rng = random.Random(f"{seed}:{point}")
+        draws = [rng.random() < prob for _ in range(horizon)]
+        fires = [i for i, f in enumerate(draws) if f]
+        if fires and fires[0] == want_first_fire:
+            return seed
+    raise AssertionError("no seed found (pattern too strict)")
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_declare_fault_validation():
+    try:
+        with pytest.raises(ValueError, match="declared twice"):
+            chaos.declare_fault("store.commit", "x", ("delay",), "dup")
+        with pytest.raises(ValueError, match="unknown kind"):
+            chaos.declare_fault("test.bad.kind", "x", ("explode",), "")
+        with pytest.raises(ValueError, match="no kinds"):
+            chaos.declare_fault("test.no.kinds", "x", (), "")
+    finally:
+        chaos.FAULTS.pop("test.bad.kind", None)
+        chaos.FAULTS.pop("test.no.kinds", None)
+
+
+def test_spec_refuses_undeclared_and_malformed():
+    for spec, match in (
+            ("nope.point=drop", "undeclared fault point"),
+            ("store.commit=drop", "not declared for this point"),
+            ("store.commit=explode", "unknown fault kind"),
+            ("store.commit", "want <point>=<fault>"),
+            ("store.commit=delay", "delay needs a duration"),
+            ("store.commit=delay:xyz", "bad duration"),
+            ("store.commit=delay:-1s", "bad duration"),
+            ("store.commit=delay:inf", "bad duration"),
+            ("store.commit=error:2.0", "outside"),
+            ("store.commit=error:0.5:0.5", "at most a probability"),
+    ):
+        with pytest.raises(chaos.ChaosSpecError, match=match):
+            chaos.parse_spec(spec)
+    # a refused arm() leaves the plane DISARMED, not half-armed
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.arm("nope.point=drop")
+    assert not chaos.armed()
+
+
+def test_spec_grammar_durations_and_composition():
+    parsed = chaos.parse_spec(
+        "p2p.tunnel.frame=drop:0.01,delay:50ms;"
+        "sync.clone.page=delay:0.2s:0.5;store.commit=delay:0.25")
+    frame = parsed["p2p.tunnel.frame"]
+    assert [(f.kind, f.prob) for f in frame] == [("drop", 0.01),
+                                                ("delay", 1.0)]
+    assert frame[1].delay_s == pytest.approx(0.05)
+    page = parsed["sync.clone.page"][0]
+    assert (page.delay_s, page.prob) == (pytest.approx(0.2), 0.5)
+    assert parsed["store.commit"][0].delay_s == pytest.approx(0.25)
+    # empty spec = disarmed
+    chaos.arm("")
+    assert not chaos.armed()
+
+
+# -- determinism -------------------------------------------------------------
+
+def test_seeded_replay_is_identical():
+    spec = "p2p.tunnel.frame=drop:0.4,delay:1ms:0.3"
+    chaos.arm(spec, seed=7)
+    seq1 = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+            for _ in range(64)]
+    chaos.arm(spec, seed=7)
+    seq2 = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+            for _ in range(64)]
+    assert seq1 == seq2
+    assert any(k is not None for k in seq1)
+    chaos.arm(spec, seed=8)
+    seq3 = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+            for _ in range(64)]
+    assert seq1 != seq3  # a different storm
+
+
+def test_per_point_rngs_are_independent():
+    """One site's draw sequence must not depend on how OTHER sites
+    interleave — each point draws from its own (seed, name) RNG."""
+    spec = ("p2p.tunnel.frame=drop:0.4;"
+            "sync.ingest.apply=error:0.4")
+    chaos.arm(spec, seed=3)
+    alone = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+             for _ in range(32)]
+    chaos.arm(spec, seed=3)
+    interleaved = []
+    for _ in range(32):
+        interleaved.append(getattr(
+            chaos.hit("p2p.tunnel.frame"), "kind", None))
+        try:
+            chaos.hit("sync.ingest.apply")
+        except chaos.ChaosError:  # pragma: no cover - hit never raises
+            pass
+    assert alone == interleaved
+
+
+def test_only_filter_skips_without_consuming_draws():
+    chaos.arm("p2p.tunnel.frame=drop:0.4", seed=11)
+    baseline = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+                for _ in range(16)]
+    chaos.arm("p2p.tunnel.frame=drop:0.4", seed=11)
+    for _ in range(5):  # drop not in `only`: skipped, no rng draw
+        assert chaos.hit("p2p.tunnel.frame", only=("delay",)) is None
+    again = [getattr(chaos.hit("p2p.tunnel.frame"), "kind", None)
+             for _ in range(16)]
+    assert baseline == again
+
+
+def test_disarmed_hit_is_one_flag_check():
+    """The telemetry contract: disarmed injection sites cost <5 µs
+    per call (typical ~0.1 µs — one module-global load)."""
+    chaos.disarm()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        chaos.hit("p2p.tunnel.frame")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6, f"{per_call * 1e6:.2f}us/call"
+
+
+def test_firing_counts_into_injected_total():
+    before = CHAOS_INJECTED.labels(
+        name="sync.ingest.apply", kind="error").value
+    chaos.arm("sync.ingest.apply=error:1.0", seed=1)
+    f = chaos.hit("sync.ingest.apply")
+    assert f is not None and f.kind == "error"
+    assert CHAOS_INJECTED.labels(
+        name="sync.ingest.apply", kind="error").value == before + 1
+
+
+def test_apply_async_effects():
+    async def main():
+        assert await chaos.apply_async(
+            chaos.Fault("x", "drop")) is True
+        assert await chaos.apply_async(
+            chaos.Fault("x", "delay", 0.01)) is False
+        with pytest.raises(chaos.ChaosError):
+            await chaos.apply_async(chaos.Fault("x", "error"))
+        with pytest.raises(ConnectionError):  # is-a ConnectionError
+            await chaos.apply_async(chaos.Fault("x", "disconnect"))
+    _run(main())
+
+
+# -- static<->runtime drift --------------------------------------------------
+
+def test_chaos_backoff_families_pass_the_naming_scheme():
+    """NAME_RE grew chaos|backoff: the new families are centrally
+    declared AND scheme-clean (the whole-tree telemetry pass enforces
+    the rest)."""
+    from tools.sdlint.passes.telemetry import NAME_RE
+
+    for name in ("sd_chaos_injected_total", "sd_backoff_retries_total",
+                 "sd_backoff_gave_up_total",
+                 "sd_store_busy_retries_total"):
+        assert NAME_RE.match(name), name
+        assert name in __import__(
+            "spacedrive_tpu.telemetry", fromlist=["REGISTRY"]
+        ).REGISTRY.families()
+
+
+def test_every_fault_point_has_an_injection_site():
+    """Every declared fault point must be referenced by a
+    chaos.hit("<name>") literal somewhere in the tree, and every
+    injection site must name a declared point — the same drift gate
+    the timeout/channel registries get."""
+    referenced = set()
+    for base in ("spacedrive_tpu", "tools"):
+        for dirpath, dirnames, files in os.walk(
+                os.path.join(ROOT, base)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    if isinstance(func, ast.Attribute) and \
+                            func.attr == "hit" and node.args and \
+                            isinstance(node.args[0], ast.Constant) and \
+                            isinstance(node.args[0].value, str):
+                        referenced.add(node.args[0].value)
+    declared = set(chaos.FAULTS)
+    assert declared - referenced == set(), (
+        "declared fault points nothing injects — prune or adopt")
+    assert referenced - declared == set(), (
+        "injection sites naming undeclared fault points")
+    # and every site's `only=` subset (checked at runtime by hit) is
+    # consistent with the declaration: spot-pin the recv-half rule
+    assert "drop" not in ("delay", "disconnect", "wedge")
+
+
+# -- recovery: store BUSY degrades to latency (satellite 2) ------------------
+
+def test_injected_busy_degrades_to_latency(tmp_path, monkeypatch):
+    from spacedrive_tpu.store.db import Database
+
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.01")  # fast ladder
+    db = Database(str(tmp_path / "busy.db"))
+    seed = _seed_with_pattern("store.commit", 0.6, 0)
+    before = STORE_BUSY_RETRIES.value
+    chaos.arm("store.commit=error:0.6", seed=seed)
+    row_id = db.insert("tag", {"pub_id": os.urandom(16),
+                               "name": "survives-busy"})
+    chaos.disarm()
+    assert STORE_BUSY_RETRIES.value > before
+    # the commit RETRIED and landed: fault became latency, not failure
+    row = db.query_one("SELECT name FROM tag WHERE id = ?", (row_id,))
+    assert row["name"] == "survives-busy"
+    db.close()
+
+
+def test_busy_ladder_exhaustion_reraises(tmp_path, monkeypatch):
+    from spacedrive_tpu.store.db import Database
+
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.01")
+    db = Database(str(tmp_path / "busy2.db"))
+    gave_up_before = BACKOFF_GAVE_UP.labels(name="store.busy").value
+    chaos.arm("store.commit=error:1.0", seed=1)  # every draw fires
+    with pytest.raises(sqlite3.OperationalError, match="locked"):
+        db.insert("tag", {"pub_id": os.urandom(16), "name": "doomed"})
+    chaos.disarm()
+    assert BACKOFF_GAVE_UP.labels(
+        name="store.busy").value == gave_up_before + 1
+    # the failed tx rolled back; the database stays usable
+    db.insert("tag", {"pub_id": os.urandom(16), "name": "after"})
+    rows = db.query("SELECT name FROM tag")
+    assert [r["name"] for r in rows] == ["after"]
+    db.close()
+
+
+# -- recovery: mid-clone disconnect converges (satellite 3a) -----------------
+
+def test_mid_clone_disconnect_converges_byte_identically(tmp_path):
+    """A declared `disconnect` fault tears the REAL windowed clone
+    stream mid-flight; the peer reconnects from its durable watermark
+    and must converge byte-identically — domain AND logical op stream
+    — against a chaos-free control replica. (Extends the PR 2 churn
+    fuzz: the tear is now a declared, seeded fault point instead of
+    an ad-hoc hook.)"""
+    from conftest import make_sync_manager
+
+    from spacedrive_tpu.sync.clone_serve import serve_clone_stream
+    from spacedrive_tpu.sync.ingest import pump_clone_stream
+    from spacedrive_tpu.sync.manager import BLOB_MIN_OPS, GetOpsArgs
+    from tools.load_bench import _stub_wire
+
+    origin = make_sync_manager(tmp_path, "origin")
+    n_total = 0
+    for w in range(2):  # two blob pages: the tear lands between them
+        pubs = [os.urandom(16) for _ in range(BLOB_MIN_OPS)]
+        with origin.db.tx() as conn:  # sdlint: ok[tx-shape]
+            origin.bulk_shared_ops(conn, "object", [
+                (p, "c", None, None, {"kind": 5, "note": f"w{w}"})
+                for p in pubs])
+            conn.executemany(
+                "INSERT INTO object (pub_id, kind, note) "
+                "VALUES (?, 5, ?)", [(p, f"w{w}") for p in pubs])
+        n_total += len(pubs)
+
+    async def clone(peer) -> int:
+        """Reconnect loop over the real originator+receiver pair;
+        returns stream attempts used. When the originator refuses the
+        pass-through (the peer holds partial history after a tear),
+        the tail drains through the per-op pull loop — exactly the
+        wire protocol's fallback arbitration."""
+        attempts = 0
+        while True:
+            attempts += 1
+            assert attempts < 20, "reconnect storm never converged"
+            origin_end, peer_end = _stub_wire()
+            clocks = [(k, v) for k, v in peer.timestamps.items()
+                      if k != peer.instance] or [(origin.instance, 0)]
+
+            async def serve():
+                try:
+                    served = await serve_clone_stream(
+                        origin, origin_end, clocks)
+                    if not served:
+                        await origin_end.send({"kind": "blob_done"})
+                    return served
+                except BaseException:
+                    origin_end.close()
+                    raise
+
+            async def pump():
+                first = await peer_end.recv()
+                if not isinstance(first, dict) or \
+                        first.get("kind") != "blob_stream":
+                    return 0
+                n, _fast, _fb = await pump_clone_stream(
+                    peer, peer_end.recv, peer_end.send, [])
+                return n
+
+            # return_exceptions: BOTH halves must settle before the
+            # next attempt — reconnecting while the old pump's apply
+            # is still in flight would read a stale watermark and
+            # re-pull pages the peer already holds (a real reconnect
+            # reads the durable instance row after the old stream
+            # fully dies).
+            served, _n = await asyncio.gather(
+                serve(), pump(), return_exceptions=True)
+            if isinstance(served, BaseException) or \
+                    isinstance(_n, BaseException):
+                continue  # torn mid-clone: reconnect from watermark
+            if not served:
+                # Per-op tail: a resumed peer is no longer a fresh
+                # clone target, so get_ops arbitrates the rest.
+                from conftest import drain_sync
+                await asyncio.to_thread(drain_sync, origin, peer)
+                return attempts
+
+    # Fire the disconnect on the SECOND page of the first attempt —
+    # one page durably applied, the stream torn mid-flight.
+    seed = _seed_with_pattern("sync.clone.page", 0.6, 1)
+    injected_before = CHAOS_INJECTED.labels(
+        name="sync.clone.page", kind="disconnect").value
+    chaos.arm("sync.clone.page=disconnect:0.6", seed=seed)
+    storm_peer = make_sync_manager(tmp_path, "storm-peer",
+                                   others=(origin.instance,))
+    attempts = _run(clone(storm_peer))
+    chaos.disarm()
+    assert attempts > 1, "the disconnect never forced a reconnect"
+    assert CHAOS_INJECTED.labels(
+        name="sync.clone.page",
+        kind="disconnect").value > injected_before
+
+    control_peer = make_sync_manager(tmp_path, "control-peer",
+                                     others=(origin.instance,))
+    _run(clone(control_peer))
+
+    def domain(mgr):
+        return sorted((r["pub_id"].hex(), r["kind"], r["note"])
+                      for r in mgr.db.query(
+                          "SELECT pub_id, kind, note FROM object"))
+
+    def log(mgr):
+        ops = mgr.get_ops(GetOpsArgs(clocks=[], count=100_000))
+        return sorted((o.timestamp, o.instance, o.id, o.typ.kind,
+                       repr(o.typ.record_id)) for o in ops)
+
+    assert len(domain(storm_peer)) == n_total
+    assert domain(storm_peer) == domain(control_peer) == domain(origin)
+    assert log(storm_peer) == log(control_peer) == log(origin)
+
+
+# -- recovery: wedged ws consumer sheds, never wedges (satellite 3b) ---------
+
+def test_wedged_ws_pump_sheds_without_wedging():
+    from spacedrive_tpu.api.server import WsSubscriptionPump
+    from spacedrive_tpu.telemetry import CHAN_SHED
+
+    async def main():
+        delivered = []
+
+        async def send(payload):
+            delivered.append(payload)
+
+        chaos.arm("api.ws.send=wedge:1.0", seed=1)
+        pump = WsSubscriptionPump(send, owner="test/ws-wedge")
+        cap = pump.chan.capacity
+        shed_before = CHAN_SHED.labels(name="api.ws").value
+        for i in range(3 * cap):
+            pump.offer({"id": 1, "type": "event",
+                        "data": {"type": "Tick", "seq": i}})
+            if i % 16 == 0:
+                await asyncio.sleep(0)  # the pump stays parked anyway
+        await asyncio.sleep(0.05)
+        # The drainer is wedged on its first frame, so the channel
+        # must SHED past capacity — never buffer unbounded, never
+        # wedge this loop (we are still running on it).
+        assert len(pump.chan) <= cap
+        assert CHAN_SHED.labels(name="api.ws").value - shed_before \
+            >= cap
+        assert len(delivered) == 0  # wedged before any send landed
+        # Teardown reaps the wedged task and zeroes the dead
+        # instance's depth (the load_bench wedge-gate regression).
+        await pump.stop()
+        assert len(pump.chan) == 0
+        chaos.disarm()
+        # After disarm a fresh pump drains normally.
+        pump2 = WsSubscriptionPump(send, owner="test/ws-live")
+        pump2.offer({"id": 1, "type": "event",
+                     "data": {"type": "Tick", "seq": -1}})
+        await asyncio.sleep(0.05)
+        assert len(delivered) == 1
+        await pump2.stop()
+    _run(main())
+
+
+# -- recovery: fleet view degrades then recovers (satellite 3c) --------------
+
+def test_fleet_poll_chaos_degrades_then_recovers(monkeypatch):
+    """Seeded wedge on obs polls: the peer's fetch parks until the
+    scaled fleet.poll budget fires (TIMEOUTS_FIRED pinned), its row
+    goes stale-degraded, the NEXT round backs off (no second budget
+    burned), and disarming lets the row recover — outcome counters
+    pinned at every step."""
+    from test_fleet import _FakeNode, _loose_monitor
+
+    from spacedrive_tpu.fleet import LoopbackObsClient
+
+    # Scale both the fleet.poll budget (15s -> 0.3s) and the
+    # fleet.peer.poll backoff base (10s -> 0.2s) into test time.
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.02")
+    fm = _loose_monitor(interval_s=0.05)
+    peer_id = "bb" * 16
+    fm.add_peer(peer_id, LoopbackObsClient(_FakeNode("beta")),
+                name="beta")
+
+    def outcome(kind):
+        return FLEET_POLLS.labels(outcome=kind).value
+
+    async def main():
+        view = await fm.poll_once()
+        assert view["nodes"]["beta"]["reachable"]
+
+        chaos.arm("fleet.poll=wedge:1.0", seed=1)
+        ok0, un0 = outcome("ok"), outcome("unreachable")
+        fired0 = TIMEOUTS_FIRED.labels(name="fleet.poll").value
+        view = await fm.poll_once()  # wedged: the budget frees it
+        assert outcome("unreachable") == un0 + 1
+        assert TIMEOUTS_FIRED.labels(
+            name="fleet.poll").value == fired0 + 1
+        row = view["nodes"]["beta"]
+        assert row["stale"] and not row["reachable"]
+        assert row["states"] == {"peer": "degraded"}
+
+        # Backoff discipline: the immediate next round SKIPS the dead
+        # peer instead of burning another budget on it.
+        view = await fm.poll_once()
+        assert outcome("unreachable") == un0 + 1  # unchanged
+        assert view["nodes"]["beta"]["stale"]
+
+        # Disarm + wait out the (scaled) ladder: the row recovers.
+        chaos.disarm()
+        await asyncio.sleep(0.35)
+        view = await fm.poll_once()
+        assert outcome("ok") == ok0 + 1
+        row = view["nodes"]["beta"]
+        assert row["reachable"] and not row["stale"]
+    _run(main())
+
+
+# -- announce give-up hand-off (fleet row without a poll) --------------------
+
+def test_note_peer_gave_up_renders_degraded_row():
+    fm_view = None
+    from spacedrive_tpu.fleet import validate_fleet_snapshot
+    from test_fleet import _loose_monitor
+
+    fm = _loose_monitor()
+    fm.note_peer_gave_up("cc" * 16,
+                         "sync announce gave up after 6 tries "
+                         "(ConnectionRefusedError: ...)")
+
+    async def main():
+        return await fm.poll_once()
+    fm_view = _run(main())
+    row = fm_view["nodes"][next(
+        n for n in fm_view["nodes"] if n != "alpha")]
+    assert row["stale"] and not row["reachable"]
+    assert row["states"] == {"peer": "degraded"}
+    assert "sync announce gave up" in \
+        row["attribution"]["peer"][0]["reason"]
+    assert validate_fleet_snapshot(fm_view) == []
+
+
+def _has_cryptography() -> bool:
+    try:
+        import cryptography  # noqa: F401
+        return True
+    except ModuleNotFoundError:
+        return False
+
+
+@pytest.mark.skipif(
+    not _has_cryptography(),
+    reason="announce backoff give-up needs the p2p tunnel stack "
+           "(cryptography)")
+def test_announce_backoff_gives_up_and_hands_off(tmp_path, monkeypatch):
+    """The sync_net.py:224 fix, end to end: a peer that vanishes is
+    retried up the declared p2p.announce.reconnect ladder, then
+    handed to the fleet observatory as a stale row — not hammered on
+    every announce forever."""
+    from conftest import pair_two_nodes
+
+    from spacedrive_tpu.node import Node
+
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.002")
+    a = Node(str(tmp_path / "a"))
+    b = Node(str(tmp_path / "b"))
+
+    async def main():
+        lib_a, _lib_b = await pair_two_nodes(a, b)
+        await b.p2p.stop()  # the peer vanishes
+        net = a.p2p.networked
+        key = next(iter(net.known_routes()))
+        tries = net._announce_backoff.contract.max_tries
+        for i in range(tries + 2):
+            await net.originate(lib_a)
+            await asyncio.sleep(0.01)
+        assert key in net._gave_up
+        rec_ids = a.fleet.peer_ids()
+        assert key.hex() in rec_ids
+        await a.shutdown()
+        await b.shutdown()
+    _run(main())

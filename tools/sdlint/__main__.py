@@ -89,6 +89,9 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-table", action="store_true",
                     help="print the generated README timeout table "
                          "and exit")
+    ap.add_argument("--backoff-table", action="store_true",
+                    help="print the generated README backoff-policy "
+                         "table and exit")
     ap.add_argument("--chan-table", action="store_true",
                     help="print the generated README channel table "
                          "and exit")
@@ -129,6 +132,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import timeouts
         print(timeouts.timeout_table_markdown())
+        return 0
+
+    if args.backoff_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import timeouts
+        print(timeouts.backoff_table_markdown())
         return 0
 
     if args.chan_table:

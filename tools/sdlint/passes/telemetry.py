@@ -50,7 +50,8 @@ FACTORY_NAMES = {"counter", "gauge", "histogram"}
 CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
 NAME_RE = re.compile(
     r"^sd_(jobs?|identifier|sync|p2p|store|api|trace|sanitize|jit"
-    r"|task|timeout|chan|pipeline|stage|race|health|sql|fleet|obs)"
+    r"|task|timeout|chan|pipeline|stage|race|health|sql|fleet|obs"
+    r"|chaos|backoff)"
     r"_[a-z0-9_]+$")
 
 CENTRAL_MODULE = "telemetry.py"
@@ -340,7 +341,8 @@ class _Visitor(ast.NodeVisitor):
                 f"{where}: {name!r} breaks the naming scheme "
                 f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
                 f"p2p/store/api/trace/sanitize/jit/task/timeout/chan/"
-                f"pipeline/stage/race/health/sql/fleet/obs)")
+                f"pipeline/stage/race/health/sql/fleet/obs/chaos/"
+                f"backoff)")
 
 
 def lint_source(path: str, src: str, is_central: bool,
