@@ -519,7 +519,7 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
     @r.mutation(f"{kind}s.delete", library=True,
                 invalidates=[list_key, get_key])
     def g_delete(node, library, input):
-        with library.db.tx() as conn:
+        with library.db.write_tx() as conn:
             conn.execute(f"DELETE FROM {rel} WHERE {fk} = ?",
                          (int(input["id"]),))
             conn.execute(f"DELETE FROM {kind} WHERE id = ?",
@@ -534,7 +534,7 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
                 f"SELECT 1 FROM {kind} WHERE id = ?", (gid,)) is None:
             raise RpcError("NOT_FOUND", f"no such {kind}")
         now = int(time.time())
-        with library.db.tx() as conn:
+        with library.db.write_tx() as conn:
             for oid in input["object_ids"]:
                 # skip stale ids (object deleted between the caller's
                 # list and this add): INSERT OR IGNORE does NOT
@@ -557,7 +557,7 @@ def _grouping(r: Router, kind: str, rel: str, fk: str,
     @r.mutation(f"{kind}s.removeObjects", library=True,
                 invalidates=[list_key, f"{kind}s.get"])
     def g_remove(node, library, input):
-        with library.db.tx() as conn:
+        with library.db.write_tx() as conn:
             for oid in input["object_ids"]:
                 conn.execute(
                     f"DELETE FROM {rel} WHERE {fk} = ? AND object_id = ?",
@@ -643,7 +643,7 @@ def _locations(r: Router) -> None:
             library.db.update("location", loc["id"], values, conn=conn)
         # rule re-attachment
         if "indexer_rules_ids" in input:
-            with library.db.tx() as conn:
+            with library.db.write_tx() as conn:
                 library.db.run("location.detach_rules", (loc["id"],),
                                conn=conn)
                 library.db.run_many(
@@ -1083,18 +1083,21 @@ def _jobs(r: Router) -> None:
 
     @r.mutation("jobs.clear", library=True, invalidates=["jobs.reports"])
     def jobs_clear(node, library, input):
-        library.db.run_tx(
-            "api.job.clear",
-            (bytes.fromhex(str(input["id"])), int(JobStatus.RUNNING),
-             int(JobStatus.PAUSED), int(JobStatus.QUEUED)))
+        with library.db.write_tx() as conn:
+            library.db.run(
+                "api.job.clear",
+                (bytes.fromhex(str(input["id"])), int(JobStatus.RUNNING),
+                 int(JobStatus.PAUSED), int(JobStatus.QUEUED)),
+                conn=conn)
         return None
 
     @r.mutation("jobs.clearAll", library=True, invalidates=["jobs.reports"])
     def jobs_clear_all(node, library, _input):
-        library.db.run_tx(
-            "api.job.clear_all",
-            (int(JobStatus.RUNNING), int(JobStatus.PAUSED),
-             int(JobStatus.QUEUED)))
+        with library.db.write_tx() as conn:
+            library.db.run(
+                "api.job.clear_all",
+                (int(JobStatus.RUNNING), int(JobStatus.PAUSED),
+                 int(JobStatus.QUEUED)), conn=conn)
         return None
 
     @r.mutation("jobs.generateThumbsForLocation", library=True)
@@ -1380,7 +1383,7 @@ def _preferences(r: Router) -> None:
     @r.mutation("preferences.update", library=True,
                 invalidates=["preferences.get"])
     def preferences_update(node, library, input):
-        with library.db.tx() as conn:
+        with library.db.write_tx() as conn:
             for k, v in (input.get("values") or {}).items():
                 if v is None:
                     library.db.run("api.preference.delete", (str(k),),
@@ -1409,8 +1412,9 @@ def _notifications(r: Router) -> None:
     @r.mutation("notifications.dismiss", library=True,
                 invalidates=["notifications.get"])
     def notifications_dismiss(node, library, input):
-        library.db.run_tx("api.notification.dismiss",
-                          (int(input["id"]),))
+        with library.db.write_tx() as conn:
+            library.db.run("api.notification.dismiss",
+                           (int(input["id"]),), conn=conn)
         return None
 
     @r.mutation("notifications.dismissAll",

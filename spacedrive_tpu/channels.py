@@ -715,6 +715,17 @@ declare_channel(
     "sync.clone.drain budget at the call site.", kind="window")
 
 declare_channel(
+    "store.actor.queue", 256, "block", "store",
+    "Per-library write-batch queue of the single-writer group-commit "
+    "actor (store/actor.py): every product write transaction — job "
+    "chunks, sync ingest pages, api mutations — enters as one queued "
+    "batch and is coalesced by the supervised writer thread into fat "
+    "transactions (SDTPU_STORE_GROUP_MAX / _LATENCY_S bound the "
+    "group). Producers block under the store.actor.put budget when "
+    "the writer falls behind — the write path's admission edge.",
+    put_budget="store.actor.put")
+
+declare_channel(
     "sync.clone.serve", 2, "block", "sync",
     "Fair-share clone-serve page-fetch gate (sync/clone_serve.py): "
     "each concurrent clone stream's next off-loop page fetch takes "

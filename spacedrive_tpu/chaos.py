@@ -411,6 +411,17 @@ declare_fault(
     "of job failure; delay = slow fsync weather under the write lock.")
 
 declare_fault(
+    "store.group_commit", "store/actor.py WriteActor._run_group",
+    ("delay", "error"),
+    "A coalesced group on the single-writer actor, after every batch "
+    "body ran and before COMMIT: delay parks the whole group with the "
+    "write lock held (the kill -9 durability storm's window — every "
+    "batch in the group must either commit atomically or vanish "
+    "atomically across a crash), error fails the group to all its "
+    "waiters (each one sees its transaction roll back, exactly like a "
+    "raw tx() commit failure).")
+
+declare_fault(
     "sync.clone.ack", "sync/ingest.py pump_clone_stream",
     ("delay", "drop", "disconnect"),
     "A clone-stream watermark ack leaving the receiver: drop leaves "

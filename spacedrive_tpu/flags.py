@@ -330,6 +330,41 @@ declare(
     strict=True)
 
 declare(
+    "SDTPU_STORE_ACTOR", True, parse_onoff,
+    "Kill switch for the per-library single-writer group-commit actor "
+    "(store/actor.py): `off` degrades Database.write_tx() to the raw "
+    "serialized tx() path — one commit per caller, no coalescing — "
+    "which is how load_bench measures the before/after write-path "
+    "attribution. Read per write_tx entry, so benches can flip it "
+    "mid-process.")
+
+declare(
+    "SDTPU_STORE_GROUP_LATENCY_S", 0.004, parse_float,
+    "Group-commit latency bound of the storage write actor "
+    "(store/actor.py): once a group is open, how long the writer "
+    "thread waits for more batches to coalesce before committing. "
+    "Small = snappier single writers; large = fatter transactions "
+    "under storm. The bound is a wait-for-MORE-work budget — a "
+    "running batch body never counts against it.")
+
+declare(
+    "SDTPU_STORE_GROUP_MAX", 32, parse_int,
+    "Group-commit size bound of the storage write actor "
+    "(store/actor.py): at most this many queued write batches "
+    "coalesce into one fat transaction before the actor commits "
+    "(sd_store_group_size records what it actually achieves).",
+    strict=True)
+
+declare(
+    "SDTPU_STORE_READ_POOL", 4, parse_int,
+    "Idle read-only connections the per-library pool keeps warm "
+    "(store/db.py): reads borrow a query_only connection instead of "
+    "minting one per thread, so concurrent readers stop serializing "
+    "on (and stop multiplying) the writer's WAL connection. Borrows "
+    "past the cap open a transient connection that closes on "
+    "release.", strict=True)
+
+declare(
     "SDTPU_TASK_REAP_S", 5.0, parse_float,
     "Grace period the task supervisor's shutdown reap (tasks.py, "
     "driven by Node.shutdown) waits for cancelled tasks before "

@@ -73,6 +73,13 @@ LOCK_WAIT_DEGRADED_S = 0.05
 LOCK_WAIT_SATURATED_S = 0.5
 # Store COMMIT latency, windowed p99 seconds.
 COMMIT_DEGRADED_S = 1.0
+# Group-commit end-to-end wait (enqueue → group COMMIT), windowed p99
+# seconds. Healthy groups resolve in a few ms (one fsync shared across
+# the group); tens of ms means the queue is deep or a batch body is
+# slow inside the group — and the score carries the fraction of the
+# declared store.actor.write budget burned per write.
+GROUP_WAIT_DEGRADED_S = 0.25
+GROUP_WAIT_SATURATED_S = 2.0
 # Declared network budgets firing: any firing degrades; a sustained
 # rate saturates (the peer/path is effectively down).
 TIMEOUT_SATURATED_PER_S = 0.5
@@ -478,6 +485,33 @@ def _store_findings(window) -> List[Dict[str, Any]]:
             owner="store", doc=_family_doc("sd_store_commit_seconds"),
             evidence={"sd_store_commit_seconds": cp99,
                       "hottest_statements": _hot_statements(window)}))
+    wait_rec = _win(window, "sd_store_group_wait_seconds")
+    wp99 = (wait_rec or {}).get("p99")
+    if wp99 is not None:
+        sev = 2 if wp99 >= GROUP_WAIT_SATURATED_S else \
+            1 if wp99 >= GROUP_WAIT_DEGRADED_S else 0
+        if sev:
+            budget = timeouts.budget("store.actor.write")
+            size_rec = _win(window, "sd_store_group_size")
+            finds.append(_finding(
+                "store.actor.group", "store", sev, wp99,
+                f"group-commit wait p99 {wp99:.3g}s in window "
+                f"({wp99 / budget:.1%} of the store.actor.write "
+                "budget) — the writer queue is deep or a batch body "
+                "is slow inside the group",
+                owner="store",
+                doc=_family_doc("sd_store_group_wait_seconds"),
+                evidence={
+                    "sd_store_group_wait_seconds": wp99,
+                    "sd_store_group_size": (size_rec or {}).get("p99"),
+                    "group_rate": (_win(
+                        window, "sd_store_group_commits_total")
+                        or {}).get("rate"),
+                    "shutdown_drains": (_win(
+                        window, "sd_store_group_shutdown_drains_total")
+                        or {}).get("delta"),
+                    "hottest_statements": _hot_statements(window),
+                }))
     return finds
 
 
@@ -710,6 +744,16 @@ READS: Dict[str, str] = {
         "writer serialization behind the per-database write lock",
     "sd_store_commit_seconds": "COMMIT latency of write transactions",
     "sd_store_tx_total": "write-transaction rate (lock-wait context)",
+    "sd_store_group_wait_seconds":
+        "enqueue→COMMIT wait of group-committed writes vs the "
+        "store.actor.write budget",
+    "sd_store_group_size":
+        "batches coalesced per group commit (fat-commit evidence)",
+    "sd_store_group_commits_total":
+        "group-commit rate of the per-library write actor",
+    "sd_store_group_shutdown_drains_total":
+        "write batches failed by actor shutdown (never silently "
+        "dropped)",
     "sd_sql_statements_total":
         "per-statement execution rate (hottest-statement attribution "
         "for store findings)",

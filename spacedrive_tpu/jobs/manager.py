@@ -172,8 +172,9 @@ class JobManager:
         if message is not None:
             entry.report.errors_text.append(message)
         entry.report.update(entry.library.db)
-        entry.library.db.run_tx("jobs.scratch.delete_for_job",
-                                (job_id,))
+        with entry.library.db.write_tx() as conn:
+            entry.library.db.run("jobs.scratch.delete_for_job",
+                                 (job_id,), conn=conn)
 
     def _start(self, entry: _Entry) -> None:
         worker = Worker(

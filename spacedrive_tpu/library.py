@@ -80,7 +80,7 @@ class Library:
         db_size = os.path.getsize(db.path) if os.path.exists(db.path) else 0
         # Persist the LATEST statistics snapshot (single row, replaced in
         # place — a polled query must not grow the table unboundedly).
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             db.run("library.stats.clear", conn=conn)
             db.run("library.stats.insert",
                    (objs, str(db_size), str(unique), str(total)),

@@ -112,7 +112,7 @@ def save_file_path_rows(library, location_pub_id: bytes,
     constraint and being silently dropped."""
     if not rows:
         if consume_scratch is not None:
-            with library.db.tx() as conn:
+            with library.db.write_tx() as conn:
                 _consume_scratch(conn, consume_scratch)
         return 0
     db, sync = library.db, library.sync
@@ -149,7 +149,7 @@ def save_file_path_rows(library, location_pub_id: bytes,
         _repath_rows(library, moved)
     if not fresh:
         if consume_scratch is not None:
-            with db.tx() as conn:
+            with db.write_tx() as conn:
                 _consume_scratch(conn, consume_scratch)
         return len(moved)
     specs = []
@@ -157,7 +157,7 @@ def save_file_path_rows(library, location_pub_id: bytes,
         values = _row_sync_values(row)
         values["location_id"] = location_pub_id  # FK syncs as pub_id
         specs.append((row["pub_id"], "c", None, None, values))
-    with db.tx() as conn:
+    with db.write_tx() as conn:
         n = db.insert_many(
             "file_path", fresh, conn=conn, ignore_conflicts=True)
         n_ops = sync.bulk_shared_ops(conn, "file_path", specs)
@@ -174,7 +174,7 @@ def _repath_rows(library, rows: List[Dict[str, Any]]) -> int:
     fields = ("materialized_path", "name", "extension",
               *SYNCED_UPDATE_FIELDS)
     ops = []
-    with db.tx() as conn:
+    with db.write_tx() as conn:
         for row in rows:
             values = {k: row[k] for k in fields}
             db.update("file_path", row["pub_id"], values, conn=conn,
@@ -198,12 +198,12 @@ def update_file_path_rows(library, rows: List[Dict[str, Any]],
     cas_ids as wrong dedup identity)."""
     if not rows:
         if consume_scratch is not None:
-            with library.db.tx() as conn:
+            with library.db.write_tx() as conn:
                 _consume_scratch(conn, consume_scratch)
         return 0
     db, sync = library.db, library.sync
     ops = []
-    with db.tx() as conn:
+    with db.write_tx() as conn:
         for row in rows:
             values = {k: row[k] for k in SYNCED_UPDATE_FIELDS}
             if not row.get("is_dir"):
@@ -234,14 +234,14 @@ def remove_file_path_rows(library, location_id: int,
     row and object link. Such rows are skipped."""
     if not removed:
         if consume_scratch is not None:
-            with library.db.tx() as conn:
+            with library.db.write_tx() as conn:
                 _consume_scratch(conn, consume_scratch)
         return 0
     db, sync = library.db, library.sync
     from .file_path_helper import materialized_like
     ops = []
     n = 0
-    with db.tx() as conn:
+    with db.write_tx() as conn:
         for r in removed:
             if r.get("materialized_path") is not None:
                 cur_row = db.run("indexer.path_current",
@@ -318,7 +318,7 @@ class IndexerJob(StatefulJob):
             return []
         import msgpack
         sids: List[int] = []
-        with ctx.db.tx() as conn:
+        with ctx.db.write_tx() as conn:
             for b in batches:
                 # per-row lastrowid feeds the step descriptors —
                 # executemany has no rowid surface; one tx regardless
@@ -484,7 +484,7 @@ class IndexerJob(StatefulJob):
         db = ctx.db
         sync = ctx.library.sync
         loc_path = data["location_path"]
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             specs = []
             for path, size in data["dir_sizes"].items():
                 try:

@@ -172,7 +172,7 @@ def seed_system_rules(db) -> None:
     index (seed.rs:38-69: uuid_from_u128(i)). DO NOT REORDER."""
     import time
     now = int(time.time())
-    with db.tx() as conn:  # one tx for the whole seed set
+    with db.write_tx() as conn:  # one tx for the whole seed set
         for i, factory in enumerate(SYSTEM_RULES):
             rule = factory()
             pub_id = i.to_bytes(16, "big")

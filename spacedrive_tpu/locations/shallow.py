@@ -76,6 +76,10 @@ def light_scan_location(library, location_id: int,
             params + [CHUNK_SIZE])]
         if not chunk:
             break
+        # Deliberate per-chunk commit: the cursor pages over COMMITTED
+        # rows (a crash resumes where the last chunk landed), and each
+        # chunk is one group-committed write_tx.
+        # sdlint: ok[tx-shape]
         lk, cr, errs = identify_chunk(
             library, location_id, location_path, chunk, backend)
         linked += lk

@@ -120,7 +120,7 @@ class MediaProcessorJob(StatefulJob):
                 # per-row inserts so one dead reference costs one
                 # error string, not the whole batch
                 del e
-                with db.tx() as conn:
+                with db.write_tx() as conn:
                     for md in mds:
                         try:
                             db.insert("media_data", md, conn=conn)

@@ -141,7 +141,7 @@ class NearDupDetectorJob(StatefulJob):
                 r["name"], r["extension"])
             paths.append(iso.join_on(data["location_path"]))
         hashes, errors = phash_files(paths, backend=self.backend)
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             for i, words in hashes.items():
                 blob = phash_to_bytes(words)
                 # UPDATE-then-INSERT fallback decides per ROW on
@@ -198,7 +198,7 @@ class NearDupDetectorJob(StatefulJob):
             d = int(np.sum(np.unpackbits(
                 (digests[i] ^ digests[j]).astype(">u4").view(np.uint8))))
             pair_rows.append((a, b, d, now))
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             db.run_many("dedup.upsert_pair", pair_rows, conn=conn)
         data["pairs_found"] = len(pairs)
         return StepOutcome(errors=errors, metadata={"pairs": len(pairs)})

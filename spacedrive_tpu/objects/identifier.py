@@ -168,7 +168,7 @@ def identify_chunk(library, location_id: int, location_path: str,
 
     linked = created = n_ops = 0
     own_tx = conn is None
-    with (db.tx() if own_tx else nullcontext(conn)) as conn:
+    with (db.write_tx() if own_tx else nullcontext(conn)) as conn:
         # ---- link targets: existing objects by cas_id (mod.rs:167-225).
         # With a preloaded cas_map (the job's whole-library dict,
         # maintained across chunks) the per-chunk IN() probes vanish —
@@ -365,7 +365,9 @@ class FileIdentifierJob(StatefulJob):
         cas_preload = (await asyncio.to_thread(
             db.run, "store.object_count"))["n"] <= self.CAS_PRELOAD_MAX
         if rebuild:
-            with db.tx() as conn:
+            # One tiny DDL pair at job start; not worth a thread hop.
+            # sdlint: ok[blocking-async]
+            with db.write_tx() as conn:
                 if cas_preload:
                     conn.execute(
                         "DROP INDEX IF EXISTS idx_file_path_cas_id")
@@ -649,7 +651,7 @@ class FileIdentifierJob(StatefulJob):
         errors: List[str] = []
         db = ctx.db
         try:
-            with db.tx() as conn:
+            with db.write_tx() as conn:
                 for rows, prehashed in chunks:
                     lk, cr, errs = identify_chunk(
                         ctx.library, self.location_id,
@@ -705,7 +707,7 @@ class FileIdentifierJob(StatefulJob):
     def _restore_indexes(db) -> None:
         """Recreate the bulk-dropped read indexes. Idempotent (IF NOT
         EXISTS): a no-op when they were never dropped."""
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_file_path_cas_id "
                 "ON file_path (cas_id)")

@@ -309,7 +309,7 @@ class ObjectValidatorJob(StatefulJob):
                 "mismatched": data["mismatched"],
                 "_integrity_events": integrity_events})
 
-        with db.tx() as conn:
+        with db.write_tx() as conn:
             db.run_many(
                 "validator.fill_checksum",
                 [(checksum, r["id"]) for r, _p, checksum in results],

@@ -1,0 +1,402 @@
+"""Single-writer group-commit actor: the store's product write path.
+
+BENCH_r08's ingest storm pinned saturation on `store.db.write_lock`:
+every concurrent job funnels its writes through one serialized
+connection, committing per item or per small chunk, so N writers pay
+N fsync-priced COMMITs for work that could ride one. The reference
+codebase batches exactly one writer this way (the identifier's commit
+groups); this actor generalizes that to EVERY writer.
+
+One `WriteActor` per `Database` (= per library — that IS the write
+shard: a hot library's storm queues on its own actor and cannot starve
+another library's). Product code enters through `Database.write_tx()`,
+which enqueues a ticket on the declared bounded channel
+(`store.actor.queue`) and blocks for its turn. The supervised writer
+thread drains tickets and coalesces them into one fat transaction:
+
+    BEGIN IMMEDIATE                       -- the actor, via db.tx()
+      SAVEPOINT sdtpu_wtx                 -- ticket 1's bracket
+        ... caller's batch body ...       -- runs on the CALLER's thread
+      RELEASE sdtpu_wtx
+      SAVEPOINT sdtpu_wtx                 -- ticket 2, 3, ... likewise
+      ...
+    COMMIT                                -- one fsync for the group
+
+The connection is handed to exactly one caller at a time (grant/done
+events), so SQLite never sees cross-thread interleaving. A batch body
+that raises rolls back to ITS savepoint and re-raises to its caller —
+the group goes on; the other tickets lose nothing. COMMIT failure (or
+an injected `store.group_commit` error fault) fails every coalesced
+ticket, exactly like a raw tx() commit failure. Group size is bounded
+by SDTPU_STORE_GROUP_MAX; once the backlog drains, a group that
+already coalesced work waits at most SDTPU_STORE_GROUP_LATENCY_S for
+stragglers — a lone sequential writer never pays the wait (its group
+of one commits immediately, the raw-tx latency).
+
+Crash contract: the group is one SQLite transaction. kill -9 anywhere
+inside it — including the injected pre-COMMIT delay window — either
+lands the whole group or none of it; WAL recovery on restart converges
+byte-identically with an unkilled control (tests/test_group_crash.py
+storms this). Shutdown drains loudly: tickets still queued when the
+actor stops fail with WriteActorClosed and count into
+`sd_store_group_shutdown_drains_total` — never a silently dropped
+write, never a future that resolves twice.
+
+Closure batches (`submit`) ride the same queue for callers that do not
+want to block a thread: the actor runs the closure on its own thread
+inside a ticket savepoint and resolves the returned future after the
+group commits — delivered onto the caller's event loop via
+`threadctx.call_threadsafe` when one is supplied.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, Deque, List, Optional
+
+from .. import channels, chaos, flags, threadctx, timeouts
+from ..telemetry import (
+    CHAN_PUT_BLOCK_SECONDS,
+    STORE_GROUP_COMMITS,
+    STORE_GROUP_SHUTDOWN_DRAINS,
+    STORE_GROUP_SIZE,
+    STORE_GROUP_WAIT_SECONDS,
+    TIMEOUTS_FIRED,
+)
+
+__all__ = ["WriteActor", "WriteActorClosed", "WriteTxStalled"]
+
+
+class WriteActorClosed(RuntimeError):
+    """The library's write actor has shut down (db.close / node stop);
+    the queued batch was NOT written."""
+
+
+class WriteTxStalled(RuntimeError):
+    """A store.actor.* budget expired: the writer thread (or a batch
+    body holding the grant) is wedged, not slow — surfacing beats
+    parking every producer forever."""
+
+
+class _Ticket:
+    """One queued write batch. Fields are written cross-thread, but
+    each has exactly one writer per handshake phase (enqueue → grant →
+    body → commit), with the events as the ordering edges — there is
+    no concurrent write to any field.
+
+    Slot tickets (fn is None) hand the group connection to the
+    enqueueing thread, which runs its `write_tx` body between
+    `grant_evt` and `done_evt`. Closure tickets carry `fn`, run on the
+    actor thread, and resolve `future` after the group commits.
+    """
+
+    __slots__ = (
+        "fn", "loop", "future", "enq_t",
+        "grant_evt", "done_evt", "commit_evt",
+        "conn", "grant_exc", "commit_exc",
+        "body_ok", "body_fatal", "result", "resolved",
+    )
+
+    def __init__(self, fn: Optional[Callable] = None,
+                 loop: Any = None,
+                 future: Optional[Future] = None):
+        self.fn = fn
+        self.loop = loop
+        self.future = future
+        self.enq_t = time.perf_counter()
+        self.grant_evt = threading.Event()
+        self.done_evt = threading.Event()
+        self.commit_evt = threading.Event()
+        self.conn: Optional[sqlite3.Connection] = None
+        self.grant_exc: Optional[BaseException] = None
+        self.commit_exc: Optional[BaseException] = None
+        self.body_ok = False
+        # Set when the body's savepoint bracket itself broke (ROLLBACK
+        # TO failed): the connection's transaction state is unknown, so
+        # the whole group must fail rather than commit around it.
+        self.body_fatal = False
+        self.result: Any = None
+        self.resolved = False
+
+
+class WriteActor:
+    """Per-library single-writer group-commit actor (see module doc).
+
+    Constructed eagerly by Database.__init__ (so the threadctx race
+    recorder sees every guarded write under the same lock); the writer
+    thread itself starts lazily on first enqueue — libraries that never
+    write never carry a thread.
+    """
+
+    def __init__(self, db: Any):
+        self._db = db
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # The declared channel is the CONTRACT and metering shell: its
+        # declared capacity bounds admission and its depth/high-water
+        # meters feed sd_chan_* and the health observatory. The
+        # Channel's deque core itself is loop-affine (its nowait
+        # surface wakes asyncio waiter futures), so the actual queue
+        # is this actor's own cv-guarded deque — every producer and
+        # the writer thread touch it only under _lock.
+        self._chan = channels.channel("store.actor.queue")
+        # Bounded by the declared capacity above — enqueue() blocks
+        # while len(_q) >= _chan.capacity, so this deque never exceeds
+        # the store.actor.queue contract it implements.
+        # sdlint: ok[queue-discipline]
+        self._q: Deque[_Ticket] = deque()
+        with self._lock:
+            self._stopping = False
+            self._thread: Optional[threading.Thread] = None
+        # Shard-local tallies for the bench's balance table (the
+        # sd_store_group_* families are process-global; per-library
+        # attribution needs per-actor numbers). Actor thread only.
+        self.groups = 0
+        self.batches = 0
+
+    # -- producer side -----------------------------------------------------
+
+    def enqueue(self, t: _Ticket) -> None:
+        """Queue one ticket, blocking for space under the declared
+        store.actor.put budget. Raises WriteActorClosed after stop()
+        and WriteTxStalled when the budget expires (the admission
+        edge: a wedged writer thread frees its producers here)."""
+        budget_s = timeouts.budget("store.actor.put")
+        deadline = time.monotonic() + budget_s
+        t0 = time.perf_counter()
+        waited = False
+        with self._lock:
+            if self._stopping or getattr(self._db, "_closed", False):
+                raise WriteActorClosed(
+                    f"write actor for {self._db.path!r} is stopped")
+            if self._thread is None:
+                th = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"sd-store-writer:{self._db.path}")
+                self._thread = th
+                th.start()
+            while len(self._q) >= self._chan.capacity:
+                waited = True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    TIMEOUTS_FIRED.labels(name="store.actor.put").inc()
+                    raise WriteTxStalled(
+                        f"store.actor.queue stayed full for "
+                        f"{budget_s:.1f}s (store.actor.put budget): "
+                        "the writer thread is not draining")
+                self._cv.wait(remaining)
+                if self._stopping:
+                    raise WriteActorClosed(
+                        f"write actor for {self._db.path!r} stopped "
+                        "while waiting for queue space")
+            self._q.append(t)
+            self._chan._note_depth(len(self._q))
+            self._cv.notify_all()
+        if waited:
+            CHAN_PUT_BLOCK_SECONDS.labels(
+                name="store.actor.queue").observe(
+                    time.perf_counter() - t0)
+
+    def submit(self, fn: Callable[[sqlite3.Connection], Any],
+               loop: Any = None) -> Future:
+        """Queue a closure batch: `fn(conn)` runs on the actor thread
+        inside its own savepoint, and the returned future resolves
+        with fn's result after the group COMMITs (or with the body's /
+        the group's exception). With `loop`, resolution is delivered
+        onto that event loop via threadctx.call_threadsafe; without,
+        the concurrent.futures.Future is resolved from the actor
+        thread directly (result() blocks a plain thread safely)."""
+        t = _Ticket(fn=fn, loop=loop, future=Future())
+        self.enqueue(t)
+        return t.future
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        """Stop the writer thread and fail anything still queued.
+        Called by Database.close() BEFORE it takes the write lock —
+        the actor may be holding it mid-group."""
+        with self._lock:
+            self._stopping = True
+            self._cv.notify_all()
+            th = self._thread
+        if th is not None and th is not threading.current_thread():
+            th.join(timeout=timeouts.budget("store.actor.write"))
+        # The thread drains on exit; this sweep covers tickets that
+        # raced in before the flag landed (and the never-started case).
+        self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._q:
+                    return
+                t = self._q.popleft()
+                self._chan._note_depth(len(self._q))
+                self._cv.notify_all()
+            STORE_GROUP_SHUTDOWN_DRAINS.inc()
+            self._resolve(t, WriteActorClosed(
+                f"write actor for {self._db.path!r} shut down with "
+                "this batch still queued — it was NOT written"))
+
+    # -- actor thread ------------------------------------------------------
+
+    def _next(self, timeout: Optional[float]) -> Optional[_Ticket]:
+        """Dequeue one ticket. None on stop, or when `timeout` (which
+        may be 0 for a pure backlog poll) expires; timeout=None waits
+        indefinitely for work."""
+        with self._lock:
+            while True:
+                if self._stopping:
+                    return None
+                if self._q:
+                    t = self._q.popleft()
+                    self._chan._note_depth(len(self._q))
+                    self._cv.notify_all()
+                    return t
+                if timeout is None:
+                    self._cv.wait()
+                    continue
+                if timeout <= 0:
+                    return None
+                t0 = time.monotonic()
+                self._cv.wait(timeout)
+                timeout -= time.monotonic() - t0
+
+    def _run(self) -> None:
+        while True:
+            t = self._next(None)
+            if t is None:
+                self._drain()
+                return
+            # The actor IS the tx-per-group loop — this is the one
+            # place a transaction per iteration is the design.
+            # sdlint: ok[tx-shape]
+            self._run_group(t)
+
+    def _run_group(self, first: _Ticket) -> None:
+        group_max = max(1, int(flags.get("SDTPU_STORE_GROUP_MAX")))
+        latency_s = float(flags.get("SDTPU_STORE_GROUP_LATENCY_S"))
+        group: List[_Ticket] = []
+        commit_exc: Optional[BaseException] = None
+        try:
+            with self._db.tx() as conn:
+                self._serve(first, conn, group)
+                budget_left = latency_s
+                while len(group) < group_max:
+                    nxt = self._next(0.0)  # drain the backlog first
+                    if nxt is None:
+                        # Empty queue: a group that already coalesced
+                        # concurrent work waits briefly for stragglers
+                        # (they tend to arrive in bursts); a group of
+                        # one commits NOW — a lone sequential writer
+                        # must not pay the latency bound per write.
+                        if len(group) < 2 or budget_left <= 0:
+                            break
+                        t0 = time.monotonic()
+                        nxt = self._next(budget_left)
+                        budget_left -= time.monotonic() - t0
+                        if nxt is None:
+                            break
+                    self._serve(nxt, conn, group)
+                f = chaos.hit("store.group_commit",
+                              only=("delay", "error"))
+                if f is not None:
+                    # delay: the kill -9 durability window — the group
+                    # is fully written but uncommitted. error: the
+                    # group fails to every waiter (ChaosError).
+                    chaos.apply_sync(f)
+        except BaseException as e:  # noqa: BLE001 — fanned out below
+            commit_exc = e
+        if commit_exc is None and group:
+            STORE_GROUP_COMMITS.inc()
+            STORE_GROUP_SIZE.observe(len(group))
+            self.groups += 1
+            self.batches += len(group)
+        now = time.perf_counter()
+        for t in group:
+            STORE_GROUP_WAIT_SECONDS.observe(now - t.enq_t)
+            self._resolve(t, commit_exc)
+
+    def _serve(self, t: _Ticket, conn: sqlite3.Connection,
+               group: List[_Ticket]) -> None:
+        """Run one ticket's batch body inside the open group
+        transaction. Appends to `group` when the body's writes are
+        pending in the transaction (and the ticket therefore awaits
+        the group's fate)."""
+        if t.fn is None:
+            # Slot ticket: hand the connection to the enqueueing
+            # thread; write_tx runs the body under its savepoint and
+            # returns the connection via done_evt.
+            t.conn = conn
+            t.grant_evt.set()
+            if not t.done_evt.wait(timeouts.budget("store.actor.write")):
+                TIMEOUTS_FIRED.labels(name="store.actor.write").inc()
+                raise WriteTxStalled(
+                    "a write_tx body held the group connection past "
+                    "the store.actor.write budget — failing the group "
+                    "rather than committing around a wedged writer")
+            if t.body_fatal:
+                raise sqlite3.OperationalError(
+                    "write_tx body failed AND its savepoint rollback "
+                    "failed — transaction state unknown, failing the "
+                    "group")
+            if t.body_ok:
+                group.append(t)
+            # body raised: the caller already has its exception and
+            # its savepoint is rolled back — the group moves on.
+            return
+        # Closure ticket: the body runs here, on the actor thread.
+        # Savepoint-bracket failures raise (fail the whole group —
+        # transaction state is unknown past them); body failures
+        # resolve THIS ticket with its exception and the group moves
+        # on, its savepoint rolled back.
+        conn.execute("SAVEPOINT sdtpu_wtx")
+        try:
+            t.result = t.fn(conn)
+        except Exception as body_exc:
+            conn.execute("ROLLBACK TO sdtpu_wtx")
+            conn.execute("RELEASE sdtpu_wtx")
+            self._resolve(t, body_exc)
+            return
+        conn.execute("RELEASE sdtpu_wtx")
+        group.append(t)
+
+    # -- completion --------------------------------------------------------
+
+    def _resolve(self, t: _Ticket, exc: Optional[BaseException]) -> None:
+        """Deliver a ticket's outcome exactly once. Slot tickets wake
+        their parked write_tx caller (pre-grant failures via
+        grant_evt, post-body outcomes via commit_evt); closure tickets
+        resolve their future, on the caller's loop when given."""
+        if t.resolved:
+            return
+        t.resolved = True
+        if t.fn is None:
+            if t.conn is None:  # never granted (shutdown drain)
+                t.grant_exc = exc if exc is not None else \
+                    WriteActorClosed("write actor stopped")
+                t.grant_evt.set()
+            else:
+                t.commit_exc = exc
+                t.commit_evt.set()
+            return
+        fut = t.future
+
+        def _settle() -> None:
+            try:
+                if exc is None:
+                    fut.set_result(t.result)
+                else:
+                    fut.set_exception(exc)
+            except InvalidStateError:
+                pass  # caller cancelled the future — outcome dropped
+
+        if t.loop is not None and threadctx.call_threadsafe(
+                t.loop, _settle):
+            return
+        _settle()

@@ -22,14 +22,16 @@ import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from . import actor as actor_mod
 from . import models, sqlaudit, statements
-from .. import chaos, sanitize, telemetry, timeouts
+from .. import chaos, flags, sanitize, telemetry, timeouts
 from ..telemetry import (
     STORE_BUSY_RETRIES,
     STORE_COMMIT_SECONDS,
     STORE_INIT_WARNINGS,
     STORE_TX,
     STORE_WRITE_LOCK_WAIT_SECONDS,
+    TIMEOUTS_FIRED,
 )
 
 log = logging.getLogger("spacedrive_tpu.store")
@@ -74,7 +76,17 @@ class Database:
         self._conns_lock = sanitize.tracked_lock("db._conns_lock")
         self._local = threading.local()
         self._all_conns: list[sqlite3.Connection] = []
+        # Idle read-only connections (PRAGMA query_only) kept warm for
+        # threads that have no dedicated conn: the to_thread worker
+        # pool's reads borrow instead of minting a 256 MiB-cache
+        # writer-shaped connection per thread. LIFO under _conns_lock.
+        self._read_pool: list[sqlite3.Connection] = []
         self._closed = False
+        # The per-library single-writer group-commit actor — THE
+        # product write path (write_tx / submit_write). Constructed
+        # eagerly so ownership of its guarded state is established
+        # before any writer races in; its thread starts on first use.
+        self._actor = actor_mod.WriteActor(self)
         conn = self._conn()
         with self._write_lock:
             ddl = models.all_ddl()
@@ -183,6 +195,10 @@ class Database:
     def close(self) -> None:
         """Close EVERY thread's connection. Any later use of this
         Database object raises — restore swaps in a new instance."""
+        # Stop the write actor BEFORE taking the write lock: the actor
+        # may be holding it mid-group, and it fails anything still
+        # queued loudly (WriteActorClosed, never a silent drop).
+        self._actor.stop()
         with self._write_lock:  # no transaction in flight past here
             with self._conns_lock:
                 self._closed = True
@@ -192,6 +208,7 @@ class Database:
                     except sqlite3.Error:
                         pass
                 self._all_conns.clear()
+                self._read_pool.clear()
                 self._local = threading.local()
 
     # -- reads ------------------------------------------------------------
@@ -203,11 +220,103 @@ class Database:
 
     def query(self, sql: str, params: Sequence = ()) -> List[sqlite3.Row]:
         with sqlaudit.adhoc():
-            return self._conn().execute(sql, params).fetchall()
+            with self._read_conn() as c:
+                return self._execute_read(c, sql, params).fetchall()
 
     def query_one(self, sql: str, params: Sequence = ()) -> Optional[sqlite3.Row]:
         with sqlaudit.adhoc():
-            return self._conn().execute(sql, params).fetchone()
+            with self._read_conn() as c:
+                return self._execute_read(c, sql, params).fetchone()
+
+    # -- read-only connection pool ----------------------------------------
+
+    @contextmanager
+    def _read_conn(self):
+        """Route a conn=None read. Precedence:
+
+        1. a transaction open ON THIS THREAD (a write_tx grant or a
+           raw tx()) — the read must see its own uncommitted writes;
+        2. the thread's dedicated connection when it already minted
+           one (bootstrap thread, the actor's writer thread);
+        3. a pooled read-only connection, returned on exit.
+        """
+        c = getattr(self._local, "tx_conn", None)
+        if c is not None:
+            yield c
+            return
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            yield c
+            return
+        c = self._borrow_read()
+        try:
+            yield c
+        finally:
+            self._release_read(c)
+
+    def _borrow_read(self) -> sqlite3.Connection:
+        with self._conns_lock:
+            if self._closed:
+                raise sqlite3.ProgrammingError("database is closed")
+            if self._read_pool:
+                return self._read_pool.pop()
+        conn = sqlite3.connect(self.path, timeout=30.0,
+                               check_same_thread=False,
+                               factory=sqlaudit.connection_class())
+        conn.row_factory = sqlite3.Row
+        # Reader-sized pragmas: the shared mmap does the heavy lifting;
+        # duplicating the writer's 256 MiB page cache per pooled conn
+        # would multiply memory for no read win. query_only goes last
+        # so the setup itself writes nothing and misuse fails loudly.
+        conn.execute("PRAGMA cache_size=-32768")
+        conn.execute("PRAGMA mmap_size=1073741824")
+        conn.execute("PRAGMA temp_store=MEMORY")
+        conn.execute("PRAGMA query_only=ON")
+        with self._conns_lock:
+            if self._closed:
+                conn.close()
+                raise sqlite3.ProgrammingError("database is closed")
+            self._all_conns.append(conn)
+        return conn
+
+    def _release_read(self, conn: sqlite3.Connection) -> None:
+        keep = max(0, int(flags.get("SDTPU_STORE_READ_POOL")))
+        with self._conns_lock:
+            if not self._closed and len(self._read_pool) < keep:
+                self._read_pool.append(conn)
+                return
+            # transient borrow beyond the cap (or closing): drop it
+            try:
+                self._all_conns.remove(conn)
+            except ValueError:
+                pass
+        try:
+            conn.close()
+        except sqlite3.Error:
+            pass
+
+    def _execute_read(self, conn: sqlite3.Connection, sql: str,
+                      params: Sequence) -> sqlite3.Cursor:
+        """Execute a read under the declared `store.busy` backoff:
+        WAL readers rarely see BUSY, but a pooled reader racing a
+        checkpoint (or an injected fault) must degrade to bounded
+        retry latency counted into sd_store_busy_retries_total — the
+        same attribution the commit path has — not fail the read."""
+        b: Optional[timeouts.Backoff] = None
+        while True:
+            try:
+                return conn.execute(sql, params)
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                if b is None:
+                    b = timeouts.Backoff("store.busy")
+                d = b.next_delay()
+                if d is None:
+                    raise
+                STORE_BUSY_RETRIES.inc()
+                time.sleep(d)
 
     # -- declared-statement dispatch ---------------------------------------
 
@@ -228,28 +337,38 @@ class Database:
         """
         st = statements.get(name)
         if st.verb == "read":
-            c = conn if conn is not None else self._conn()
-            cur = c.execute(st.sql, params)
-            if st.cardinality == "one":
-                row = cur.fetchone()
-                sqlaudit.note_rows(name, 1 if row is not None else 0)
-                return row
-            if st.cardinality == "scalar":
-                row = cur.fetchone()
-                sqlaudit.note_rows(name, 1 if row is not None else 0)
-                return row[0] if row is not None else None
-            rows = cur.fetchall()
-            sqlaudit.note_rows(name, len(rows))
-            return rows
+            if conn is not None:
+                return self._fetch_read(st, name,
+                                        conn.execute(st.sql, params))
+            with self._read_conn() as c:
+                return self._fetch_read(
+                    st, name, self._execute_read(c, st.sql, params))
         if st.verb == "write":
             if conn is None:
                 raise statements.SqlContractError(
                     f"{name}: write statements execute on the open "
                     "tx() connection — pass conn= (or use run_tx)")
             return conn.execute(st.sql, params)
-        # ddl / pragma: serialized like the other schema operations
+        # ddl / pragma: serialized like the other schema operations.
+        # NEVER call from inside a write_tx body — the actor holds the
+        # write lock for the whole group (deadlock by reentrancy is
+        # only saved by the RLock when tx() runs on THIS thread).
         with self._write_lock:
             return self._conn().execute(st.sql, params)
+
+    @staticmethod
+    def _fetch_read(st, name: str, cur: sqlite3.Cursor):
+        if st.cardinality == "one":
+            row = cur.fetchone()
+            sqlaudit.note_rows(name, 1 if row is not None else 0)
+            return row
+        if st.cardinality == "scalar":
+            row = cur.fetchone()
+            sqlaudit.note_rows(name, 1 if row is not None else 0)
+            return row[0] if row is not None else None
+        rows = cur.fetchall()
+        sqlaudit.note_rows(name, len(rows))
+        return rows
 
     def run_many(self, name: str, seq: Iterable[Sequence],
                  conn=None) -> sqlite3.Cursor:
@@ -266,11 +385,12 @@ class Database:
         return conn.executemany(st.sql, seq)
 
     def run_tx(self, name: str, params: Sequence = ()) -> sqlite3.Cursor:
-        """One declared write statement as its own transaction — the
-        single-statement sugar over `with tx() as c: run(..., conn=c)`.
-        Per-item loops should batch under ONE tx() instead (the
-        tx-shape pass flags run_tx inside loops)."""
-        with self.tx() as c:
+        """One declared write statement as its own write batch — the
+        single-statement sugar over `with write_tx() as c: run(...,
+        conn=c)`; it group-commits through the actor like every other
+        product write. Per-item loops should batch under ONE write_tx
+        instead (the tx-shape pass flags run_tx inside loops)."""
+        with self.write_tx() as c:
             return self.run(name, params, conn=c)
 
     # -- writes -----------------------------------------------------------
@@ -284,7 +404,12 @@ class Database:
 
     @contextmanager
     def tx(self):
-        """Serialized write transaction; the unit of atomic batching.
+        """RAW serialized write transaction; the unit of atomic
+        batching — and, since the group-commit actor, the ENGINE-ROOM
+        primitive: the actor brackets each coalesced group with one
+        tx(), and bootstrap/migration/tool paths use it directly.
+        Product code uses write_tx() instead (sdlint's tx-shape
+        `actor-bypass` code enforces that statically).
 
         Telemetry: write-lock wait and COMMIT latency are observed only
         while telemetry is enabled — the disabled path adds one module
@@ -296,6 +421,11 @@ class Database:
             if tm:
                 STORE_WRITE_LOCK_WAIT_SECONDS.observe(
                     time.perf_counter() - t_wait)
+            # Mark the open transaction on this thread so conn=None
+            # reads (and nested write_tx calls) land on it — reads
+            # inside a transaction must see its uncommitted writes.
+            prev = getattr(self._local, "tx_conn", None)
+            self._local.tx_conn = conn
             try:
                 conn.execute("BEGIN IMMEDIATE")
                 sqlaudit.tx_begin(conn)
@@ -311,6 +441,8 @@ class Database:
                 conn.rollback()
                 sqlaudit.tx_end(conn, committed=False)
                 raise
+            finally:
+                self._local.tx_conn = prev
             self._commits = getattr(self, "_commits", 0) + 1
             if self._commits % self._WAL_CHECK_EVERY == 0:
                 try:
@@ -351,6 +483,126 @@ class Database:
                     raise
                 STORE_BUSY_RETRIES.inc()
                 time.sleep(d)
+
+    # -- the product write path: group-committed batches -------------------
+
+    @contextmanager
+    def _savepoint(self, conn: sqlite3.Connection):
+        """One write batch's bracket inside an enclosing transaction.
+        Same-name savepoints stack (ROLLBACK TO targets the most
+        recent), so nested write_tx calls compose."""
+        conn.execute("SAVEPOINT sdtpu_wtx")
+        try:
+            yield
+        except BaseException:
+            conn.execute("ROLLBACK TO sdtpu_wtx")
+            conn.execute("RELEASE sdtpu_wtx")
+            raise
+        conn.execute("RELEASE sdtpu_wtx")
+
+    @contextmanager
+    def write_tx(self):
+        """Group-committed write transaction — THE product write path.
+
+        Same shape as tx() at the call site (`with db.write_tx() as
+        conn:`) but the COMMIT is the group's: this batch body runs
+        inside its own savepoint of the write actor's fat transaction,
+        coalesced with concurrent writers' batches (store/actor.py),
+        and the context exits only once that transaction is durable.
+        A body exception rolls back only THIS batch's savepoint and
+        re-raises here — the group (and the other writers) go on; a
+        group COMMIT failure raises here exactly like a raw tx()
+        commit failure would.
+
+        Nested calls — and calls inside an open raw tx() on this
+        thread — ride the enclosing transaction under a fresh
+        savepoint: no actor round-trip, no self-deadlock. The body
+        must NOT call the write-lock-taking surfaces (run on a
+        ddl/pragma statement, checkpoint*, ensure_lazy_indexes): the
+        actor holds the write lock for the whole group and those would
+        deadlock against it from a foreign thread.
+
+        SDTPU_STORE_ACTOR=off degrades to a raw tx() per batch — one
+        commit per caller, no coalescing (the bench's before/after
+        lever).
+        """
+        outer = getattr(self._local, "tx_conn", None)
+        if outer is not None:
+            with self._savepoint(outer):
+                yield outer
+            return
+        if not flags.get("SDTPU_STORE_ACTOR"):
+            with self.tx() as conn:
+                yield conn
+            return
+        t = actor_mod._Ticket()
+        self._actor.enqueue(t)
+        budget_s = timeouts.budget("store.actor.write")
+        if not t.grant_evt.wait(budget_s):
+            TIMEOUTS_FIRED.labels(name="store.actor.write").inc()
+            raise actor_mod.WriteTxStalled(
+                f"write_tx waited {budget_s:.0f}s (store.actor.write "
+                "budget) for the group connection — the writer thread "
+                "is wedged")
+        if t.grant_exc is not None:
+            raise t.grant_exc
+        conn = t.conn
+        try:
+            self._local.tx_conn = conn
+            try:
+                conn.execute("SAVEPOINT sdtpu_wtx")
+            except BaseException:
+                t.body_fatal = True
+                raise
+            try:
+                yield conn
+            except BaseException:
+                try:
+                    conn.execute("ROLLBACK TO sdtpu_wtx")
+                    conn.execute("RELEASE sdtpu_wtx")
+                except sqlite3.Error:
+                    t.body_fatal = True
+                raise
+            try:
+                conn.execute("RELEASE sdtpu_wtx")
+            except BaseException:
+                t.body_fatal = True
+                raise
+            t.body_ok = True
+        finally:
+            # Return the connection to the actor no matter what — a
+            # body that kept it would wedge every coalesced writer.
+            self._local.tx_conn = None
+            t.done_evt.set()
+        if not t.commit_evt.wait(budget_s):
+            TIMEOUTS_FIRED.labels(name="store.actor.write").inc()
+            raise actor_mod.WriteTxStalled(
+                "write_tx batch was coalesced but the group COMMIT "
+                "never resolved within the store.actor.write budget")
+        if t.commit_exc is not None:
+            raise t.commit_exc
+
+    def submit_write(self, fn, loop=None):
+        """Closure form of write_tx for callers that must not park a
+        thread: `fn(conn)` runs ON THE ACTOR THREAD inside its own
+        savepoint, and the returned concurrent.futures.Future resolves
+        with fn's result once the group commits (delivered via
+        threadctx.call_threadsafe onto `loop` when given). With
+        SDTPU_STORE_ACTOR=off the closure runs inline under a raw
+        tx() and the future comes back already resolved."""
+        if not flags.get("SDTPU_STORE_ACTOR"):
+            from concurrent.futures import Future
+
+            fut: "Future" = Future()
+            try:
+                with self.tx() as conn:
+                    res = fn(conn)
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+            else:
+                fut.set_result(res)
+            return fut
+        return self._actor.submit(fn, loop=loop)
 
     # NOTE: the old `execute(sql, params)` wrapper is gone. It wrapped
     # EVERY statement — reads included — in a write transaction (write
@@ -393,7 +645,7 @@ class Database:
         sql = f"INSERT INTO {table} ({cols}) VALUES ({ph})"
         if conn is not None:
             return conn.execute(sql, list(row.values())).lastrowid
-        with self.tx() as c:
+        with self.write_tx() as c:
             return c.execute(sql, list(row.values())).lastrowid
 
     def insert_many(self, table: str, rows: List[Dict[str, Any]],
@@ -415,7 +667,7 @@ class Database:
         if conn is not None:
             cur = conn.executemany(sql, vals)
             return cur.rowcount
-        with self.tx() as c:
+        with self.write_tx() as c:
             cur = c.executemany(sql, vals)
             return cur.rowcount
 
@@ -430,7 +682,7 @@ class Database:
         if conn is not None:
             conn.execute(sql, params)
         else:
-            with self.tx() as c:
+            with self.write_tx() as c:
                 c.execute(sql, params)
 
     def upsert(self, table: str, key: Dict[str, Any], values: Dict[str, Any],
@@ -447,7 +699,7 @@ class Database:
         if conn is not None:
             conn.execute(sql, params)
         else:
-            with self.tx() as c:
+            with self.write_tx() as c:
                 c.execute(sql, params)
 
     def delete(self, table: str, row_id: Any,
@@ -457,7 +709,7 @@ class Database:
         if conn is not None:
             conn.execute(sql, (row_id,))
         else:
-            with self.tx() as c:
+            with self.write_tx() as c:
                 c.execute(sql, (row_id,))
 
 

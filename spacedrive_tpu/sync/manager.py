@@ -293,7 +293,7 @@ class SyncManager:
             with sync.write_ops(ops) as conn:
                 db.insert_many("file_path", rows, conn=conn)
         """
-        with self.db.tx() as conn:
+        with self.db.write_tx() as conn:
             yield conn
             if self.emit_messages:
                 self._insert_op_rows(conn, ops)
@@ -588,7 +588,7 @@ class SyncManager:
                 return
             # one SMALL tx per 16-blob batch BY DESIGN: a multi-GB
             # backlog must never hold the write lock for seconds
-            with self.db.tx() as conn:  # sdlint: ok[tx-shape]
+            with self.db.write_tx() as conn:  # sdlint: ok[tx-shape]
                 for m in metas:
                     self._explode_blob_conn(conn, m)
 
@@ -771,7 +771,7 @@ class SyncManager:
         errors: List[str] = []
         ts_max: Dict[bytes, int] = {}
         failed: set = set()
-        with self.db.tx() as conn:
+        with self.db.write_tx() as conn:
             # Straggler sweep under the write lock: a bulk writer that
             # checked _solo before this pull registered the peer can
             # land one last blob between the explode above and this
@@ -972,7 +972,7 @@ class SyncManager:
             key = (is_create, tuple(sorted(values)))
             groups.setdefault(key, []).append(
                 (self._rid_bytes(rid_packed), values))
-        with self.db.tx() as conn:
+        with self.db.write_tx() as conn:
             self.db.run_many("sync.oplog.insert_shared", oplog_rows,
                              conn=conn)
             for (is_create, keys), recs in groups.items():

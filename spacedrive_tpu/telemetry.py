@@ -626,6 +626,29 @@ STORE_BUSY_RETRIES = counter(
     "backoff after sqlite BUSY (an external writer — or an injected "
     "store.commit chaos fault — holding the file lock): the retry "
     "degrades the fault to latency instead of failing the job")
+STORE_GROUP_COMMITS = counter(
+    "sd_store_group_commits_total",
+    "Fat transactions committed by the single-writer group-commit "
+    "actor (store/actor.py) — each one carries sd_store_group_size "
+    "coalesced write batches")
+STORE_GROUP_SIZE = histogram(
+    "sd_store_group_size",
+    "Write batches coalesced per group commit — 1 means the actor "
+    "found no concurrency to exploit (the raw-tx shape), the "
+    "SDTPU_STORE_GROUP_MAX ceiling means writers queue faster than "
+    "COMMIT retires them",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+STORE_GROUP_WAIT_SECONDS = histogram(
+    "sd_store_group_wait_seconds",
+    "A write batch's whole trip through the actor: queue wait + "
+    "batches coalesced ahead of it + the group COMMIT (the write "
+    "path's end-to-end latency, vs the store.actor.write budget)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120))
+STORE_GROUP_SHUTDOWN_DRAINS = counter(
+    "sd_store_group_shutdown_drains_total",
+    "Write batches failed loudly (never silently dropped) because "
+    "the actor shut down with them still queued — each one's "
+    "completion future resolves exactly once with the shutdown error")
 STORE_INIT_WARNINGS = counter(
     "sd_store_init_warnings_total",
     "Non-fatal problems swallowed while opening a library database "

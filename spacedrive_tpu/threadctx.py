@@ -713,12 +713,30 @@ declare_owner(
         "_all_conns": guarded_by("_conns_lock"),
         "_closed": guarded_by("_conns_lock"),
         "_local": guarded_by("_conns_lock"),
+        "_read_pool": guarded_by("_conns_lock"),
         "_commits": guarded_by("_write_lock"),
     },
     "The store: every job thread and the loop share one Database per "
-    "library. Connection registration/teardown serialize on the "
-    "_conns_lock leaf (the PR 1 deadlock fix); the WAL-check commit "
-    "counter only moves inside a tx, which holds _write_lock.")
+    "library. Connection registration/teardown — and the read-only "
+    "pool's borrow/release free-list — serialize on the _conns_lock "
+    "leaf (the PR 1 deadlock fix); the WAL-check commit counter only "
+    "moves inside a tx, which holds _write_lock.")
+
+declare_owner(
+    "store.WriteActor", "spacedrive_tpu/store/actor.py::WriteActor",
+    {
+        "_stopping": guarded_by("_lock"),
+        "_thread": guarded_by("_lock"),
+        "_q": guarded_by("_lock"),
+        "groups": single_thread(),
+        "batches": single_thread(),
+    },
+    "Per-library single-writer group-commit actor: every product "
+    "writer enqueues tickets (producers + the stop path mutate the "
+    "lifecycle flags under the actor's _lock/condition leaf), while "
+    "the shard tallies are the writer thread's alone — group "
+    "formation state itself lives in _run_group locals, and the "
+    "ticket handshake events are the cross-thread edges.")
 
 declare_owner(
     "sync.HLC", "spacedrive_tpu/sync/hlc.py::HLC",

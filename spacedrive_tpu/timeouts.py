@@ -539,6 +539,23 @@ declare_timeout(
     "responder ingests the previous page (one tx per page) before "
     "asking again.")
 
+# -- store (single-writer group-commit actor) -------------------------------
+
+declare_timeout(
+    "store.actor.put", 30.0,
+    "A writer waiting for space in the storage actor's bounded batch "
+    "queue (channels.py store.actor.queue): the write-path admission "
+    "edge — a wedged writer thread frees its producers here instead "
+    "of parking every job forever.")
+
+declare_timeout(
+    "store.actor.write", 600.0,
+    "A writer's whole trip through the group-commit actor "
+    "(store/actor.py): grant wait + every batch body coalesced ahead "
+    "of it + the group's COMMIT. Sized for bulk-chunk batch bodies "
+    "(a 4096-file indexer chunk riding the same group); firing means "
+    "the writer thread is wedged, not slow.")
+
 
 # ---------------------------------------------------------------------------
 # THE backoff namespace. Keep alphabetical within each layer; every

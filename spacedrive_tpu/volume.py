@@ -60,7 +60,7 @@ def save_volumes(db) -> int:
     """Upsert detected volumes into the @local volume table — one tx
     for the whole detection sweep (tx-shape: no tx per volume)."""
     vols = get_volumes()
-    with db.tx() as conn:
+    with db.write_tx() as conn:
         for v in vols:
             db.upsert(
                 "volume",

@@ -1,0 +1,101 @@
+"""Child process for the group-commit kill -9 durability storm: writes
+a deterministic corpus of (object row + CRDT op-log row) pairs through
+Database.write_tx from concurrent threads, with the declared
+`store.group_commit` chaos fault stretching the pre-COMMIT window so
+the parent's SIGKILL lands mid-group. Resumable: on start it computes
+the missing indices and writes only those, so any number of kills
+converges to the same final state. Run:
+
+    python tests/_group_crash_child.py <db_path> <n_rows> <seed> <mode>
+
+mode: "chaos" arms store.group_commit=delay (seeded); "plain" doesn't.
+Prints WRITING when the storm begins and DONE <n> when the corpus is
+complete.
+"""
+
+import hashlib
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu import chaos  # noqa: E402
+from spacedrive_tpu.store import Database  # noqa: E402
+
+THREADS = 4
+
+
+def pub(seed: int, i: int) -> bytes:
+    return hashlib.sha256(f"{seed}:{i}".encode()).digest()[:16]
+
+
+def payload(seed: int, i: int) -> bytes:
+    return hashlib.sha256(f"{seed}:{i}:data".encode()).digest()
+
+
+def main() -> None:
+    db_path, n_rows = sys.argv[1], int(sys.argv[2])
+    seed, mode = int(sys.argv[3]), sys.argv[4]
+    if mode == "chaos":
+        # Every group pauses 80 ms fully-written-but-uncommitted: the
+        # widest possible torn-group window for the parent's SIGKILL.
+        chaos.arm("store.group_commit=delay:80ms:1.0", seed=seed)
+
+    db = Database(db_path)
+    # One deterministic instance row for the op log's FK (idempotent
+    # across restarts).
+    inst_pub = pub(seed, -1)
+    row = db.query_one("SELECT id FROM instance WHERE pub_id = ?",
+                       (inst_pub,))
+    if row is not None:
+        inst_id = row["id"]
+    else:
+        inst_id = db.insert("instance", {
+            "pub_id": inst_pub, "identity": b"\x00" * 16,
+            "node_id": b"\x00" * 16, "node_name": "group-crash",
+            "node_platform": 0, "last_seen": 0, "date_created": 0,
+        })
+    existing = {r["pub_id"] for r in db.query("SELECT pub_id FROM object")}
+    missing = [i for i in range(n_rows) if pub(seed, i) not in existing]
+    print("WRITING", len(missing), flush=True)
+
+    it = iter(missing)
+    it_lock = threading.Lock()
+    errors = []
+
+    def writer() -> None:
+        while True:
+            with it_lock:
+                i = next(it, None)
+            if i is None:
+                return
+            p = pub(seed, i)
+            try:
+                # Domain + op-log write in ONE batch: the crash
+                # contract says they land together or not at all.
+                with db.write_tx() as conn:
+                    db.insert("object", {"pub_id": p}, conn=conn)
+                    db.insert("shared_operation", {
+                        "timestamp": i, "model": "object",
+                        "record_id": p, "kind": "c",
+                        "data": payload(seed, i), "instance_id": inst_id,
+                    }, conn=conn)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer) for _ in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise SystemExit(f"writer failed: {errors[0]!r}")
+    db.close()
+    print("DONE", n_rows - len(existing), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,3 +57,10 @@ def nested_chain(db, rows):
 def row_at_a_time(db, conn, rows):
     for a, b in rows:
         db.run("identifier.link_paths", (a, b, 1), conn=conn)
+
+
+def write_tx_per_item(db, items):
+    # the same commit-per-item shape through the group-commit seam
+    for item in items:
+        with db.write_tx() as conn:
+            db.run("node.object_delete", (item,), conn=conn)

@@ -1,0 +1,136 @@
+"""Group-commit durability storm: SIGKILL a node mid-group — inside
+the seeded `store.group_commit` delay window, where the whole group is
+written but uncommitted — restart cold, repeat, and require (a) no
+torn group after ANY kill (an object row and its CRDT op-log row land
+together or not at all), (b) committed work never regresses across a
+kill (WAL recovery is monotone), and (c) the storm survivor converges
+to the byte-identical canonical state of an unkilled control run —
+domain table AND op log — under the raise-mode sanitizer with zero
+violations. The subprocess + SIGKILL shape follows
+test_crash_recovery.py; the seeded-chaos gating follows
+test_load_bench.py."""
+
+import hashlib
+import os
+import signal
+import sqlite3
+import subprocess
+import sys
+import time
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_group_crash_child.py")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = 400
+SEED = 1109
+KILLS = 4
+
+
+def _child_env():
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "SDTPU_SANITIZE": "1",
+                "SDTPU_SANITIZE_MODE": "raise"})
+    return env
+
+
+def _spawn(db_path, mode):
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(db_path), str(N_ROWS), str(SEED),
+         mode],
+        cwd=ROOT, env=_child_env(), stdout=subprocess.PIPE, text=True)
+
+
+def _counts_and_tear(db_path):
+    """(objects, ops, torn) read directly — opening the db replays
+    whatever WAL state the SIGKILL left behind, exactly like the
+    restarted node does."""
+    conn = sqlite3.connect(db_path, timeout=30.0)
+    try:
+        n_obj = conn.execute("SELECT COUNT(*) FROM object").fetchone()[0]
+        n_ops = conn.execute(
+            "SELECT COUNT(*) FROM shared_operation").fetchone()[0]
+        torn = conn.execute(
+            "SELECT COUNT(*) FROM ("
+            "  SELECT pub_id FROM object "
+            "  EXCEPT SELECT record_id FROM shared_operation"
+            ") ").fetchone()[0]
+        torn += conn.execute(
+            "SELECT COUNT(*) FROM ("
+            "  SELECT record_id FROM shared_operation "
+            "  EXCEPT SELECT pub_id FROM object"
+            ") ").fetchone()[0]
+        return n_obj, n_ops, torn
+    finally:
+        conn.close()
+
+
+def _canonical_digest(db_path):
+    """Order-independent byte digest of the logical state: every
+    column except the autoincrement rowids (assignment order is thread
+    interleaving, not state)."""
+    conn = sqlite3.connect(db_path, timeout=30.0)
+    try:
+        h = hashlib.sha256()
+        for row in conn.execute(
+                "SELECT pub_id FROM object ORDER BY pub_id"):
+            h.update(row[0])
+        for row in conn.execute(
+                "SELECT timestamp, model, record_id, kind, data, "
+                "instance_id FROM shared_operation "
+                "ORDER BY record_id, timestamp"):
+            h.update(repr(row).encode())
+        return h.hexdigest()
+    finally:
+        conn.close()
+
+
+def test_group_commit_kill9_storm_converges(tmp_path):
+    control_db = tmp_path / "control" / "lib.db"
+    storm_db = tmp_path / "storm" / "lib.db"
+    control_db.parent.mkdir()
+    storm_db.parent.mkdir()
+
+    # Unkilled control: same seed, same workload, no chaos.
+    proc = _spawn(control_db, "plain")
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 0, out
+    assert f"DONE {N_ROWS}" in out
+
+    # The storm: kill -9 mid-group, cold-restart, repeat.
+    prev_committed = 0
+    interrupted = 0
+    for round_no in range(KILLS):
+        child = _spawn(storm_db, "chaos")
+        try:
+            assert child.stdout.readline().startswith("WRITING")
+            time.sleep(0.10 + 0.07 * round_no)
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait(timeout=10)
+        finally:
+            if child.poll() is None:  # pragma: no cover
+                child.kill()
+        n_obj, n_ops, torn = _counts_and_tear(storm_db)
+        assert torn == 0, (
+            f"round {round_no}: {torn} torn pair(s) — a group half-"
+            "committed across the kill")
+        assert n_obj == n_ops
+        assert n_obj >= prev_committed, (
+            f"round {round_no}: committed work regressed "
+            f"{prev_committed} -> {n_obj}")
+        if n_obj < N_ROWS:
+            interrupted += 1
+        prev_committed = n_obj
+    assert interrupted >= 1, (
+        "every storm round completed before the kill — the storm "
+        "never actually interrupted a run; widen the fault window")
+
+    # Cold restart, let it converge (chaos still armed, raise mode).
+    child = _spawn(storm_db, "chaos")
+    out, _ = child.communicate(timeout=120)
+    assert child.returncode == 0, out
+    assert "DONE" in out
+
+    n_obj, n_ops, torn = _counts_and_tear(storm_db)
+    assert (n_obj, n_ops, torn) == (N_ROWS, N_ROWS, 0)
+    assert _canonical_digest(storm_db) == _canonical_digest(control_db)

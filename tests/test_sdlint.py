@@ -891,11 +891,12 @@ def test_tx_shape_flags_known_positives():
     for f in found:
         by_code.setdefault(f.code, set()).add((f.qual, f.ident))
     loops = by_code.get("tx-in-loop", set())
-    # all four spellings of commit-per-item
+    # all five spellings of commit-per-item
     assert ("tx_per_item", "db.tx") in loops
     assert ("run_tx_per_item", "db.run_tx") in loops
     assert ("helper_per_item", "db.insert") in loops
     assert ("opener_in_loop", "_opens_tx") in loops
+    assert ("write_tx_per_item", "db.write_tx") in loops
     blocking = {i for _, i in by_code.get("blocking-in-tx", set())}
     assert {"time.sleep", "open"} <= blocking
     assert any(q == "await_inside_tx"
@@ -908,6 +909,59 @@ def test_tx_shape_flags_known_positives():
 
 def test_tx_shape_passes_known_negatives():
     assert _lint_fixture("txshape_ok.py", "tx-shape") == []
+
+
+def _lint_source(tmp_path, relpath, source, pass_name):
+    """Lint a synthetic snippet under a chosen repo-relative path —
+    actor-bypass is scoped by relpath (product vs store vs tools), so
+    the fixture directory cannot exercise it."""
+    from tools.sdlint.core import Project, SourceFile
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    src = SourceFile(str(p), relpath)
+    return run_passes(Project(ROOT, [src]), get_passes([pass_name]))
+
+
+_BYPASS_SRC = '''
+def direct_tx(db):
+    with db.tx() as conn:
+        conn.execute("DELETE FROM t")
+
+
+def direct_run_tx(library):
+    library.db.run_tx("node.object_delete", (1,))
+
+
+def through_actor(db):
+    with db.write_tx() as conn:
+        conn.execute("DELETE FROM t")
+'''
+
+
+def test_tx_shape_actor_bypass_flags_product_raw_tx(tmp_path):
+    found = _lint_source(tmp_path, "spacedrive_tpu/fake_writer.py",
+                         _BYPASS_SRC, "tx-shape")
+    by = {(f.qual, f.code) for f in found}
+    assert ("direct_tx", "actor-bypass") in by
+    assert ("direct_run_tx", "actor-bypass") in by
+    assert ("through_actor", "actor-bypass") not in by
+
+
+def test_tx_shape_actor_bypass_exempts_engine_room_and_tools(tmp_path):
+    for rel in ("spacedrive_tpu/store/fake.py", "tools/fake.py"):
+        found = _lint_source(tmp_path, rel, _BYPASS_SRC, "tx-shape")
+        assert not [f for f in found if f.code == "actor-bypass"], rel
+
+
+def test_tx_shape_actor_bypass_honors_inline_waiver(tmp_path):
+    src = (
+        "def bootstrap(db):\n"
+        "    # sdlint: ok[tx-shape]\n"
+        "    with db.tx() as conn:\n"
+        "        conn.execute('DELETE FROM t')\n")
+    found = _lint_source(tmp_path, "spacedrive_tpu/fake_boot.py", src,
+                         "tx-shape")
+    assert not [f for f in found if f.code == "actor-bypass"]
 
 
 def test_schema_parity_flags_known_positives():

@@ -1,0 +1,304 @@
+"""Group-commit write actor (store/actor.py + Database.write_tx).
+
+Pins the PR's core shapes: N concurrent writers coalesce into
+≤ ceil(N/group_max) fat transactions (sd_sql_tx_statements shows the
+fat commits), completion futures resolve exactly once — including
+actor shutdown mid-queue — a failed batch body rolls back only its
+savepoint while the rest of the group commits, injected BUSY on a
+pooled reader still lands in sd_store_busy_retries_total, reads
+route through the bounded query_only pool, and the SDTPU_STORE_ACTOR
+kill switch degrades write_tx to the raw single-writer path. The
+conftest arms the sanitizer (and with it the runtime SQL auditor) in
+raise mode, so every one of these tests is also an auditor
+raise-cleanliness check of the actor path.
+"""
+
+import math
+import os
+import sqlite3
+import threading
+import time
+
+import pytest
+
+from spacedrive_tpu.store import Database, uuid_bytes
+from spacedrive_tpu.store.actor import WriteActorClosed
+from spacedrive_tpu.telemetry import (
+    SQL_TX_STATEMENTS,
+    STORE_BUSY_RETRIES,
+    STORE_GROUP_SHUTDOWN_DRAINS,
+)
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(tmp_path / "actor.db")
+    yield d
+    d.close()
+
+
+def _tx_stats():
+    s = SQL_TX_STATEMENTS.snapshot_value()
+    return s["count"], s["sum"]
+
+
+# -- coalescing shape --------------------------------------------------------
+
+def test_concurrent_writers_coalesce_into_fat_groups(db, monkeypatch):
+    """16 concurrent single-row writers + 1 held-open closure land in
+    exactly ceil(17/8) = 3 transactions, and sd_sql_tx_statements
+    records 3 commits carrying all the statements (fat commits, not
+    the commit-per-item spike at 1-2)."""
+    monkeypatch.setenv("SDTPU_STORE_GROUP_MAX", "8")
+    n = 16
+    queued = threading.Event()
+
+    def blocker(conn):
+        # holds the first group open until every writer is queued, so
+        # group formation is deterministic rather than racy
+        queued.wait(30)
+        return "held"
+
+    fut = db.submit_write(blocker)
+    g0, b0 = db._actor.groups, db._actor.batches
+    c0, s0 = _tx_stats()
+
+    errs = []
+
+    def w(i):
+        try:
+            db.insert("object", {"pub_id": uuid_bytes()})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=w, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while len(db._actor._q) < n and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(db._actor._q) == n, "writers did not all enqueue"
+    queued.set()
+    for t in threads:
+        t.join()
+    assert fut.result(30) == "held"
+    assert not errs
+
+    groups = db._actor.groups - g0
+    assert groups == math.ceil((n + 1) / 8)  # 8 + 8 + 1
+    assert db._actor.batches - b0 == n + 1
+    assert db.query_one("SELECT COUNT(*) AS c FROM object")["c"] == n
+    c1, s1 = _tx_stats()
+    assert c1 - c0 == groups  # one committed tx per group
+    assert (s1 - s0) >= n     # carrying every writer's statements
+
+
+def test_lone_writer_commits_immediately(db):
+    """A sequential writer must not pay the group latency bound: its
+    group of one commits as soon as its body is done."""
+    t0 = time.perf_counter()
+    for _ in range(5):
+        db.insert("object", {"pub_id": uuid_bytes()})
+    # 5 writes comfortably under 5 * (latency bound + slack) — the
+    # point is they don't each park for a straggler window
+    assert time.perf_counter() - t0 < 2.0
+    assert db.query_one("SELECT COUNT(*) AS c FROM object")["c"] == 5
+
+
+# -- completion semantics ----------------------------------------------------
+
+def test_failed_batch_isolated_inside_group(db):
+    """One group: blocker + failing body + good body. The failure
+    rolls back to ITS savepoint and surfaces on ITS future; the rest
+    of the group commits."""
+    started = threading.Event()
+    release = threading.Event()
+
+    def blocker(conn):
+        db.insert("tag", {"pub_id": uuid_bytes(), "name": "held"},
+                  conn=conn)
+        started.set()
+        release.wait(30)
+
+    def boom(conn):
+        db.insert("tag", {"pub_id": uuid_bytes(), "name": "dead"},
+                  conn=conn)
+        raise ValueError("batch body failed")
+
+    f_block = db.submit_write(blocker)
+    assert started.wait(10)
+    f_bad = db.submit_write(boom)
+    f_good = db.submit_write(lambda conn: db.insert(
+        "tag", {"pub_id": uuid_bytes(), "name": "alive"}, conn=conn))
+    release.set()
+    with pytest.raises(ValueError):
+        f_bad.result(10)
+    f_good.result(10)
+    f_block.result(10)
+    names = {r["name"] for r in db.query("SELECT name FROM tag")}
+    assert names == {"held", "alive"}  # 'dead' rolled back
+
+
+def test_shutdown_mid_queue_fails_futures_exactly_once(tmp_path):
+    """Tickets still queued when the actor stops fail loudly with
+    WriteActorClosed (counted in sd_store_group_shutdown_drains_total)
+    while the in-flight group still commits; nothing resolves twice
+    and nothing hangs."""
+    db = Database(tmp_path / "shutdown.db")
+    started = threading.Event()
+    release = threading.Event()
+
+    def blocker(conn):
+        started.set()
+        release.wait(30)
+        return "committed"
+
+    f0 = db.submit_write(blocker)
+    assert started.wait(10)
+    d0 = STORE_GROUP_SHUTDOWN_DRAINS.value
+    queued = [db.submit_write(lambda conn: "never") for _ in range(3)]
+
+    closer = threading.Thread(target=db.close)
+    closer.start()
+    time.sleep(0.05)  # let close() reach the actor join
+    release.set()
+    closer.join(30)
+    assert not closer.is_alive()
+
+    # in-flight group committed; queued tickets failed exactly once
+    assert f0.result(10) == "committed"
+    for f in queued:
+        with pytest.raises(WriteActorClosed):
+            f.result(10)
+    assert STORE_GROUP_SHUTDOWN_DRAINS.value - d0 == 3
+    # post-close writes are refused, not silently dropped
+    with pytest.raises(WriteActorClosed):
+        with db.write_tx():
+            pass  # pragma: no cover
+
+
+def test_nested_write_tx_rides_outer_batch(db):
+    """A write_tx inside an open write_tx stacks a savepoint on the
+    same granted connection instead of deadlocking on the actor."""
+    with db.write_tx() as outer:
+        db.insert("object", {"pub_id": uuid_bytes()}, conn=outer)
+        with db.write_tx() as inner:
+            assert inner is outer
+            db.insert("object", {"pub_id": uuid_bytes()}, conn=inner)
+        # inner failure would roll back only the inner savepoint
+        with pytest.raises(RuntimeError):
+            with db.write_tx() as inner:
+                db.insert("object", {"pub_id": uuid_bytes()},
+                          conn=inner)
+                raise RuntimeError("inner abort")
+    assert db.query_one("SELECT COUNT(*) AS c FROM object")["c"] == 2
+
+
+# -- auditor cleanliness -----------------------------------------------------
+
+def test_declared_statements_auditor_clean_through_actor(db):
+    """Declared-statement traffic through write_tx / run(conn=) /
+    run_many raises no sql_* sanitizer violation (the conftest arms
+    the auditor in raise mode) and the per-tx statement histogram
+    sees ONE fat commit for the whole batch."""
+    c0, s0 = _tx_stats()
+    loc = db.insert("location", {"pub_id": uuid_bytes(), "path": "/x"})
+    with db.write_tx() as conn:
+        db.insert_many(
+            "file_path",
+            [{"pub_id": uuid_bytes(), "location_id": loc,
+              "materialized_path": "", "name": f"f{i}",
+              "extension": "bin"} for i in range(10)],
+            conn=conn)
+        db.run("node.object_delete", (0,), conn=conn)
+    c1, s1 = _tx_stats()
+    assert c1 - c0 == 2  # the location insert + the batch
+    assert s1 - s0 >= 3
+
+
+# -- BUSY attribution (satellite: pooled readers keep the counter) -----------
+
+class _FlakyConn:
+    """Raises BUSY on the first execute, succeeds on the second."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def execute(self, sql, params=()):
+        self.calls += 1
+        if self.calls == 1:
+            raise sqlite3.OperationalError("database is locked")
+        return ("cursor", sql, tuple(params))
+
+
+def test_injected_busy_on_pooled_reader_counts_retries(db, monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.01")
+    before = STORE_BUSY_RETRIES.value
+    flaky = _FlakyConn()
+    out = db._execute_read(flaky, "SELECT 1", ())
+    assert flaky.calls == 2 and out[0] == "cursor"
+    assert STORE_BUSY_RETRIES.value - before == 1
+
+
+def test_reader_busy_exhaustion_reraises(db, monkeypatch):
+    monkeypatch.setenv("SDTPU_TIMEOUT_SCALE", "0.001")
+
+    class _AlwaysBusy:
+        def execute(self, sql, params=()):
+            raise sqlite3.OperationalError("database is locked")
+
+    with pytest.raises(sqlite3.OperationalError):
+        db._execute_read(_AlwaysBusy(), "SELECT 1", ())
+
+
+# -- the read pool -----------------------------------------------------------
+
+def test_reads_pool_and_see_own_writes(db):
+    for i in range(4):
+        db.insert("object", {"pub_id": uuid_bytes()})
+
+    counts = []
+    errs = []
+
+    def read():
+        try:
+            for _ in range(10):
+                counts.append(db.query_one(
+                    "SELECT COUNT(*) AS c FROM object")["c"])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=read) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs and set(counts) == {4}
+    # free list never exceeds the declared pool bound
+    assert len(db._read_pool) <= int(
+        os.environ.get("SDTPU_STORE_READ_POOL", "4"))
+
+    # read-your-own-writes: a query inside an open write_tx routes to
+    # the granted tx connection, seeing uncommitted rows
+    with db.write_tx() as conn:
+        db.insert("object", {"pub_id": uuid_bytes()}, conn=conn)
+        assert db.query_one(
+            "SELECT COUNT(*) AS c FROM object")["c"] == 5
+
+
+# -- kill switch -------------------------------------------------------------
+
+def test_actor_kill_switch_degrades_to_raw_tx(tmp_path, monkeypatch):
+    monkeypatch.setenv("SDTPU_STORE_ACTOR", "0")
+    db = Database(tmp_path / "nokill.db")
+    try:
+        with db.write_tx() as conn:
+            db.insert("object", {"pub_id": uuid_bytes()}, conn=conn)
+        fut = db.submit_write(lambda conn: db.insert(
+            "object", {"pub_id": uuid_bytes()}, conn=conn))
+        fut.result(1)  # resolved inline, no actor thread involved
+        assert db._actor._thread is None
+        assert db.query_one(
+            "SELECT COUNT(*) AS c FROM object")["c"] == 2
+    finally:
+        db.close()

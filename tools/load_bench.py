@@ -71,7 +71,7 @@ try:
 except ModuleNotFoundError:
     pass
 
-from spacedrive_tpu import channels, chaos, sanitize, telemetry
+from spacedrive_tpu import channels, chaos, flags, sanitize, telemetry
 
 DEFAULT_CHAOS = (
     "sync.clone.page=disconnect:0.04;"
@@ -466,6 +466,17 @@ async def _ingest_storm(lib, peers: List[Any], ops_per_peer: int
     failed_pages = [0]
     lat: List[float] = []
     busy_before = _metric_value("sd_store_busy_retries_total")
+    size_hist = telemetry.REGISTRY.get("sd_store_group_size")
+    size_cur = size_hist.snapshot_delta()["cursor"] \
+        if size_hist is not None else None
+    # Per-shard tallies: each Database (the node's library + every
+    # peer replica) carries its own write actor — that IS the shard.
+    shard_dbs = [("library", lib.db)] + [
+        (f"peer{i}", p.db) for i, p in enumerate(peers)
+        if getattr(p, "db", None) is not None]
+    shards0 = {label: (d._actor.groups, d._actor.batches)
+               for label, d in shard_dbs
+               if getattr(d, "_actor", None) is not None}
 
     async def one(peer) -> None:
         ops = []
@@ -492,6 +503,33 @@ async def _ingest_storm(lib, peers: List[Any], ops_per_peer: int
     t0 = time.perf_counter()
     await asyncio.gather(*(one(p) for p in peers))
     wall = time.perf_counter() - t0
+
+    shards = {}
+    for label, d in shard_dbs:
+        if label not in shards0:
+            continue
+        g0, b0 = shards0[label]
+        dg = d._actor.groups - g0
+        dbatch = d._actor.batches - b0
+        if dbatch:
+            shards[label] = {
+                "groups": dg, "batches": dbatch,
+                "mean_group": round(dbatch / dg, 2) if dg else 0.0}
+    group_commit: Dict[str, Any] = {
+        "queue_high_water": _metric_value(
+            "sd_chan_high_water", name="store.actor.queue"),
+        "shards": shards,
+    }
+    if size_hist is not None:
+        d = size_hist.snapshot_delta(size_cur)
+        bounds = [f"{b:g}" for b in size_hist.buckets] + ["inf"]
+        group_commit.update({
+            "groups": d["count"],
+            "batches_coalesced": int(d["sum"]),
+            "size_histogram": {b: c for b, c in
+                               zip(bounds, d["counts"]) if c},
+        })
+
     return {"peers": len(peers),
             "ops_applied": applied[0],
             "chaos_errors": chaos_errors[0],
@@ -501,7 +539,47 @@ async def _ingest_storm(lib, peers: List[Any], ops_per_peer: int
                 - busy_before,
             "wall_s": round(wall, 3),
             "ops_per_s": round(applied[0] / wall, 1) if wall else 0.0,
-            "page_latency_ms": _lat_ms(lat)}
+            "page_latency_ms": _lat_ms(lat),
+            "group_commit": group_commit}
+
+
+async def _write_path_ab(lib, peers: List[Any], ops_per_peer: int
+                         ) -> Dict[str, Any]:
+    """Before/after attribution for the write path: the same ingest
+    burst once with the group-commit actor OFF (the seed's
+    lock-and-pray path, SDTPU_STORE_ACTOR=0) and once ON, each leg
+    with its write-lock wait total and group evidence — the artifact
+    shows where the write path's time went, not just that it got
+    faster. An unreported warm-up burst runs first: the chaos-fed
+    commit-error backoff state it leaves behind hits both measured
+    legs equally, so the comparison is order-independent."""
+    lock_h = telemetry.REGISTRY.get("sd_store_write_lock_wait_seconds")
+    prev = flags.raw("SDTPU_STORE_ACTOR")
+    out: Dict[str, Any] = {}
+    try:
+        await _ingest_storm(lib, peers, max(4, ops_per_peer // 2))
+        for label, setting in (("lock_path", "0"), ("actor_path", "1")):
+            os.environ["SDTPU_STORE_ACTOR"] = setting
+            cur = lock_h.snapshot_delta()["cursor"] \
+                if lock_h is not None else None
+            res = await _ingest_storm(lib, peers, ops_per_peer)
+            d = lock_h.snapshot_delta(cur) if lock_h is not None else {}
+            out[label] = {
+                "ops_applied": res["ops_applied"],
+                "ops_per_s": res["ops_per_s"],
+                "page_latency_ms": res["page_latency_ms"],
+                "write_lock_acquires": d.get("count", 0),
+                "write_lock_wait_s": round(d.get("sum", 0.0), 4),
+                "groups": res["group_commit"].get("groups", 0),
+                "batches_coalesced":
+                    res["group_commit"].get("batches_coalesced", 0),
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("SDTPU_STORE_ACTOR", None)
+        else:
+            os.environ["SDTPU_STORE_ACTOR"] = prev
+    return out
 
 
 async def _spacedrop_offers(node, count: int) -> Dict[str, Any]:
@@ -582,8 +660,8 @@ def _declared_resource(res: str) -> bool:
     if res in channels.CHANNELS or res in timeouts.TIMEOUTS:
         return True
     return res.startswith((
-        "store.db.", "tasks.", "sanitize.", "ops.pipeline.",
-        "fleet.peer.", "jobs."))
+        "store.db.", "store.actor.", "tasks.", "sanitize.",
+        "ops.pipeline.", "fleet.peer.", "jobs."))
 
 
 def _coalesce_wedges() -> List[str]:
@@ -692,6 +770,11 @@ async def run_bench(args) -> Dict[str, Any]:
             lib, pull_peers[:max(2, args.peers // 4)],
             ops_per_peer=args.ops_per_peer)
         checkpoint("ingest_storm")
+
+        workloads["write_path_ab"] = await _write_path_ab(
+            lib, pull_peers[:max(2, args.peers // 4)],
+            ops_per_peer=max(4, args.ops_per_peer // 4))
+        checkpoint("write_path_ab")
 
         workloads["spacedrop"] = await _spacedrop_offers(node, count=4)
 

@@ -27,6 +27,14 @@ duration, and a nested tx is a guaranteed runtime error. Codes:
   iteration where a batched form (`run_many` / `insert_many`) would
   collapse the Python/sqlite statement loop. Advisory: sites with a
   real per-row dependency waive inline with the reason.
+- `actor-bypass`       — product code (spacedrive_tpu/ outside
+  store/) opening a raw `db.tx()` or calling `run_tx()` directly.
+  The raw transaction primitive bypasses the write actor: no group
+  commit, no sd_store_group_* attribution, no store.group_commit
+  chaos coverage, and it contends with the actor for the write lock.
+  Product writers go through `write_tx()` / `submit_write()`;
+  engine-room, bootstrap and migration sites waive inline with the
+  reason.
 """
 
 from __future__ import annotations
@@ -39,7 +47,11 @@ from . import _sql
 
 PASS = "tx-shape"
 
-_TX_LASTS = {"tx", "write_ops"}
+_TX_LASTS = {"tx", "write_tx", "write_ops"}
+# Receivers that make a bare `.tx` attribute a Database transaction
+# (dotted part right before the method) — keeps actor-bypass from
+# firing on unrelated attrs that happen to be named `tx`.
+_DB_RECEIVERS = {"db", "_db", "database"}
 _DB_HELPERS = {"insert", "insert_many", "update", "upsert", "delete"}
 
 _BLOCKING_LASTS = {
@@ -240,6 +252,35 @@ class _TxWalker:
                         "collapses the statement loop", call.lineno)
 
 
+def _actor_bypass(fn: FuncInfo, findings: List[Finding]) -> None:
+    """Flag raw Database.tx()/run_tx() from product code: every
+    product writer must ride the group-commit actor (write_tx /
+    submit_write). The store package itself is the engine room — the
+    actor brackets its groups with the raw tx() — and tests/tools sit
+    outside the product write path."""
+    rel = fn.src.relpath
+    if not rel.startswith("spacedrive_tpu/") or \
+            rel.startswith("spacedrive_tpu/store/"):
+        return
+    for node in own_body_walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        parts = d.split(".")
+        raw_tx = parts[-1] == "tx" and len(parts) >= 2 \
+            and parts[-2] in _DB_RECEIVERS
+        if raw_tx or parts[-1] == "run_tx":
+            findings.append(Finding(
+                PASS, "actor-bypass", rel, fn.qual, d,
+                f"`{d}()` opens a raw transaction around the write "
+                "actor — no group commit, no sd_store_group_* "
+                "attribution, no store.group_commit chaos coverage. "
+                "Use write_tx()/submit_write(); bootstrap/migration "
+                "sites waive inline with the reason", node.lineno))
+
+
 class TxShapePass:
     name = PASS
 
@@ -249,4 +290,5 @@ class TxShapePass:
         findings: List[Finding] = []
         for fn in project.index.funcs:
             _TxWalker(fn, project, openers, decls, findings).scan()
+            _actor_bypass(fn, findings)
         return findings
