@@ -25,7 +25,9 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -700,6 +702,74 @@ static int32_t read_small(int fd, uint64_t cap, uint8_t* out,
   return OK;
 }
 
+// Sampled read for a large file via one shared read-only mapping: six
+// region memcpys out of the page cache instead of six preads. Offsets
+// come from the DECLARED size (cas.rs:43 parity — a stale index entry
+// must sample the same offsets the oracle would); every region is
+// bounds-checked against the file's real length so a file truncated
+// between index and stage degrades to ERR_SHORT_READ exactly like the
+// pread path. mmap failure (exotic filesystems, /proc files) degrades
+// to read_sampled, which reads the same bytes. A truncate racing the
+// memcpy itself can SIGBUS like any mapped reader — the same window
+// the reference's mmap-less path shrinks but does not close; callers
+// that cannot tolerate it stage through sd_stage_large instead.
+static const uint64_t MMAP_THRESHOLD = 8ull << 20;  // 8 MiB
+
+static int32_t read_sampled_mmap(int fd, uint64_t declared, uint8_t* out) {
+  struct stat st;
+  if (fstat(fd, &st) != 0) return ERR_IO;
+  const uint64_t real = (uint64_t)st.st_size;
+  if (real < HEADER_OR_FOOTER_SIZE) return ERR_SHORT_READ;
+  // Below the threshold six preads beat a mapping: mmap + munmap cost
+  // two syscalls plus a cross-thread TLB shootdown per file, which
+  // dominates for ~100 KB files staged by the thousands. Past it the
+  // shared mapping wins (one setup amortized over sparse regions).
+  if (real < MMAP_THRESHOLD) return read_sampled(fd, declared, out);
+  void* m = mmap(nullptr, (size_t)real, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) return read_sampled(fd, declared, out);
+  const uint8_t* base = (const uint8_t*)m;
+  const uint64_t jump = (declared - 2 * HEADER_OR_FOOTER_SIZE) / SAMPLE_COUNT;
+  int32_t rc = OK;
+  uint8_t* pos = out;
+  std::memcpy(pos, base, HEADER_OR_FOOTER_SIZE);
+  pos += HEADER_OR_FOOTER_SIZE;
+  for (uint64_t k = 0; k < SAMPLE_COUNT; k++) {
+    const uint64_t off = HEADER_OR_FOOTER_SIZE + k * jump;
+    if (off + SAMPLE_SIZE > real) {
+      rc = ERR_SHORT_READ;
+      break;
+    }
+    std::memcpy(pos, base + off, SAMPLE_SIZE);
+    pos += SAMPLE_SIZE;
+  }
+  if (rc == OK)
+    std::memcpy(pos, base + (real - HEADER_OR_FOOTER_SIZE),
+                HEADER_OR_FOOTER_SIZE);
+  munmap(m, (size_t)real);
+  return rc;
+}
+
+// Whole-file read for a small file, preadv straight into the packed
+// row (the destination must have cap+1 bytes: the extra byte is the
+// grew-past-class detector, landing in the row's zero padding).
+static int32_t read_small_v(int fd, uint64_t cap, uint8_t* out,
+                            int32_t* out_len) {
+  size_t done = 0;
+  for (;;) {
+    struct iovec iov = {out + done, (size_t)(cap + 1 - done)};
+    ssize_t r = preadv(fd, &iov, 1, (off_t)done);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return ERR_IO;
+    }
+    if (r == 0) break;
+    done += (size_t)r;
+    if (done > cap) return ERR_GREW;
+  }
+  *out_len = (int32_t)done;
+  return OK;
+}
+
 // Simple work-stealing-free parallel for: N items, an atomic cursor,
 // hardware_concurrency workers (the batched replacement for the
 // reference's join_all of ≤100 async tasks, file_identifier/mod.rs:107).
@@ -942,6 +1012,59 @@ void sd_stage_small(int64_t n, const char** paths, uint64_t cap, uint8_t* out,
     }
     status[i] = read_small(fd, cap, out + i * (cap + 1), &out_lens[i]);
     close(fd);
+  });
+}
+
+// Batched packed staging for the device CAS pipeline: one call stages a
+// whole batch straight into the kernel's message rows — caller-owned,
+// page-aligned pooled pages laid out [n, stride] (stride = the chunk
+// grid for payload_cap, i.e. ceil((8 + payload_cap) / 1024) * 1024, and
+// stride >= 8 + min(payload_cap, SMALL_WHOLE_CAP) + 1 when the batch
+// carries small-class rows, for the grew-detection byte). Row i becomes
+// le64(declared size) ‖ payload ‖ zeros with msg_lens[i] = 8 + payload
+// bytes — exactly build_cas_messages' layout, with no intermediate
+// Python bytes objects and no per-file memcpy on the host plane. Large
+// rows (> MINIMUM_FILE_SIZE) take the 57,344-byte sampled payload via a
+// shared mmap; small rows land whole via preadv. Per-row status lets
+// the ctypes seam degrade file-by-file instead of failing the batch;
+// any non-OK row is zeroed back to its 8-byte prefix so a reused pooled
+// page can never leak a previous batch's bytes into a digest (the
+// kernel consumes full 16-word blocks — residue would silently change
+// it).
+void sd_stage_batch(int64_t n, const char** paths, const uint64_t* sizes,
+                    uint8_t* out, int64_t stride, uint64_t payload_cap,
+                    int32_t* msg_lens, int32_t* status, int n_threads) {
+  parallel_for(n, n_threads, [&](int64_t i) {
+    uint8_t* row = out + i * stride;
+    const uint64_t declared = sizes[i];
+    le64(declared, row);
+    uint64_t payload = 0;
+    int32_t st;
+    if (declared == 0) {
+      st = ERR_EMPTY;  // no CAS ID for empty files (mod.rs:86)
+    } else {
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        st = ERR_OPEN;
+      } else {
+        if (declared > MINIMUM_FILE_SIZE && payload_cap >= LARGE_PAYLOAD) {
+          st = read_sampled_mmap(fd, declared, row + 8);
+          if (st == OK) payload = LARGE_PAYLOAD;
+        } else {
+          const uint64_t cap =
+              payload_cap < SMALL_WHOLE_CAP ? payload_cap : SMALL_WHOLE_CAP;
+          int32_t got = 0;
+          st = read_small_v(fd, cap, row + 8, &got);
+          if (st == OK) payload = (uint64_t)got;
+        }
+        close(fd);
+      }
+    }
+    uint64_t keep = 8 + payload;
+    if (st != OK) keep = 8;  // error/empty rows: prefix only, rest zeroed
+    std::memset(row + keep, 0, (size_t)((uint64_t)stride - keep));
+    msg_lens[i] = (int32_t)keep;
+    status[i] = st;
   });
 }
 
@@ -1471,3 +1594,106 @@ int32_t sd_secure_erase(const char* path, int passes) {
 }
 
 }  // extern "C"
+
+#if defined(SDIO_STAGE_SELFTEST)
+// `make stage` self-test: stage a synthetic mixed batch through
+// sd_stage_batch and verify layout, statuses and byte content against
+// the spec, with no Python in the loop. Exercises: large sampled row
+// (header/sample/footer offsets), small whole row, empty row, missing
+// path, short large file, and tail zeroing over a dirtied buffer.
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+namespace {
+
+bool write_pattern(const std::string& p, uint64_t n) {
+  FILE* f = fopen(p.c_str(), "wb");
+  if (!f) return false;
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t b = (uint8_t)((i * 131) ^ (i >> 8));
+    if (fwrite(&b, 1, 1, f) != 1) {
+      fclose(f);
+      return false;
+    }
+  }
+  fclose(f);
+  return true;
+}
+
+uint8_t pat(uint64_t i) { return (uint8_t)((i * 131) ^ (i >> 8)); }
+
+int fail(const char* what) {
+  fprintf(stderr, "sd_stage_batch self-test FAILED: %s\n", what);
+  return 1;
+}
+
+}  // namespace
+
+int main() {
+  char tmpl[] = "/tmp/sdio-stage-XXXXXX";
+  if (!mkdtemp(tmpl)) return fail("mkdtemp");
+  const std::string dir = tmpl;
+  const uint64_t large_n = MINIMUM_FILE_SIZE + 50000;  // 152,400 B
+  const std::string large_p = dir + "/large.bin";
+  const std::string small_p = dir + "/small.bin";
+  const std::string empty_p = dir + "/empty.bin";
+  const std::string short_p = dir + "/short.bin";
+  if (!write_pattern(large_p, large_n)) return fail("write large");
+  if (!write_pattern(small_p, 5000)) return fail("write small");
+  if (!write_pattern(empty_p, 0)) return fail("write empty");
+  if (!write_pattern(short_p, 4096)) return fail("write short large");
+
+  constexpr int64_t N = 5;
+  const std::string missing = dir + "/missing.bin";
+  const char* paths[N] = {large_p.c_str(), small_p.c_str(), empty_p.c_str(),
+                          missing.c_str(), short_p.c_str()};
+  const uint64_t sizes[N] = {large_n, 5000, 0, 5000, large_n};
+  // Mixed batch → the small grid: ceil((8 + 102400) / 1024) = 101.
+  const int64_t stride = 101 * 1024;
+  std::vector<uint8_t> buf((size_t)(N * stride), 0xAB);  // dirty pool page
+  int32_t lens[N], status[N];
+  sd_stage_batch(N, paths, sizes, buf.data(), stride, SMALL_WHOLE_CAP, lens,
+                 status, 0);
+
+  if (status[0] != OK || lens[0] != (int32_t)(8 + LARGE_PAYLOAD))
+    return fail("large row status/len");
+  if (status[1] != OK || lens[1] != 8 + 5000) return fail("small row");
+  if (status[2] != ERR_EMPTY || lens[2] != 8) return fail("empty row");
+  if (status[3] != ERR_OPEN) return fail("missing row");
+  if (status[4] != ERR_SHORT_READ) return fail("short-read row");
+
+  const uint8_t* r0 = buf.data();
+  uint64_t pre = 0;
+  std::memcpy(&pre, r0, 8);
+  if (pre != large_n) return fail("large prefix");
+  // Header bytes, then the first sample (offset HEADER + 0*jump — the
+  // contiguous continuation), then the footer relative to real EOF.
+  for (uint64_t i = 0; i < HEADER_OR_FOOTER_SIZE; i++)
+    if (r0[8 + i] != pat(i)) return fail("large header bytes");
+  for (uint64_t i = 0; i < SAMPLE_SIZE; i++)
+    if (r0[8 + HEADER_OR_FOOTER_SIZE + i] != pat(HEADER_OR_FOOTER_SIZE + i))
+      return fail("large sample bytes");
+  const uint64_t foot0 = large_n - HEADER_OR_FOOTER_SIZE;
+  const uint64_t foot_row = 8 + HEADER_OR_FOOTER_SIZE +
+                            SAMPLE_COUNT * SAMPLE_SIZE;
+  for (uint64_t i = 0; i < HEADER_OR_FOOTER_SIZE; i++)
+    if (r0[foot_row + i] != pat(foot0 + i)) return fail("large footer bytes");
+  for (int64_t i = lens[0]; i < stride; i++)
+    if (r0[i] != 0) return fail("large tail not zeroed");
+
+  const uint8_t* r1 = buf.data() + stride;
+  for (uint64_t i = 0; i < 5000; i++)
+    if (r1[8 + i] != pat(i)) return fail("small bytes");
+  for (int64_t i = lens[1]; i < stride; i++)
+    if (r1[i] != 0) return fail("small tail not zeroed");
+  // Error/empty rows must be scrubbed back to their prefix: a reused
+  // pooled page must never leak prior bytes through a failed row.
+  for (int64_t r = 2; r < N; r++)
+    for (int64_t i = 8; i < stride; i++)
+      if (buf[(size_t)(r * stride + i)] != 0) return fail("error row residue");
+
+  printf("sd_stage_batch self-test: OK\n");
+  return 0;
+}
+#endif  // SDIO_STAGE_SELFTEST

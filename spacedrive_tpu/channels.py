@@ -451,7 +451,11 @@ class Window(_Metered):
     window; `note_drain()` empties it (the flush/ack point). A put
     past the declared capacity is the chan_overflow breach — the
     static backpressure pass bounds bursts at the AST, this bounds
-    them at runtime."""
+    them at runtime.
+
+    Depth mutations serialize on an internal guard so windows can be
+    noted from executor threads (the staging buffer pool's stage and
+    retire workers) as well as the event loop."""
 
     def __init__(self, name: str):
         super().__init__(_contract(name))
@@ -459,24 +463,39 @@ class Window(_Metered):
             raise ValueError(
                 f"channel {name!r} is declared kind="
                 f"{self.contract.kind!r}, not a window")
+        self._depth_lock = threading.Lock()
         self._depth = 0
 
     def __len__(self) -> int:
         return self._depth
 
     def note_put(self) -> None:
-        self._depth += 1
-        self._note_depth(self._depth)
-        if self._depth > self.capacity:
+        with self._depth_lock:
+            self._depth += 1
+            depth = self._depth
+        self._note_depth(depth)
+        if depth > self.capacity:
             self._shed()  # the frame is already queued; count + flag
             _violation(
                 f"window {self.name!r} burst past its declared "
-                f"capacity ({self._depth}/{self.capacity}) without a "
+                f"capacity ({depth}/{self.capacity}) without a "
                 "drain — a wedged peer now buffers unbounded memory")
 
     def note_drain(self) -> None:
-        self._depth = 0
+        with self._depth_lock:
+            self._depth = 0
         self._note_depth(0)
+
+    def note_pop(self) -> None:
+        """Retire ONE item from the window. For windows whose items
+        return individually (the staging buffer pool's leases come
+        back one per batch retirement) rather than draining at a
+        single flush/ack point."""
+        with self._depth_lock:
+            if self._depth > 0:
+                self._depth -= 1
+            depth = self._depth
+        self._note_depth(depth)
 
 
 class BoundedDict(_Metered):
@@ -700,6 +719,19 @@ declare_channel(
     "device dispatch executor threads under the recorder's lock. "
     "History ages out oldest-first — the export shows the recent "
     "window, memory never grows with uptime.", sheds_expected=True)
+
+declare_channel(
+    "ops.stage.pool", 12, "block", "ops",
+    "Native staging buffer pool checkout window (ops/staging.py "
+    "StagePool): each depth slot's packed H2D source page — a pooled, "
+    "page-aligned anonymous mapping the C plane stages straight into "
+    "and jax reads zero-copy — counts one item from acquire until its "
+    "batch RETIRES. Capacity bounds total pooled pages "
+    "(SDTPU_STAGE_POOL_BUFFERS narrows below it): the depth-8 ring + "
+    "warmup/calibration leases + slack. An exhausted pool degrades "
+    "the batch to the Python staging path — it never allocates past "
+    "the bound — and a burst past capacity is a chan_overflow "
+    "violation.", kind="window")
 
 declare_channel(
     "p2p.route_cache", 512, "shed_oldest", "p2p",

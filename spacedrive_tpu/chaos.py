@@ -277,6 +277,16 @@ def armed_spec() -> str:
     return _spec_str
 
 
+def armed_point(name: str) -> bool:
+    """True when the armed spec names this fault point. A zero-draw
+    pre-check for sites that would otherwise loop hit() across large
+    batches (the staging seam draws per ROW so a 0.1-probability EIO
+    storm speckles a batch instead of all-or-nothing) — skipping the
+    loop when disarmed keeps the hot path at one dict probe."""
+    spec = _ARMED
+    return spec is not None and name in spec
+
+
 def hit(name: str, only: Optional[Sequence[str]] = None
         ) -> Optional[Fault]:
     """One draw at a fault point. Returns the Fault to apply, or None
@@ -401,6 +411,16 @@ declare_fault(
     "Outbound dial + handshake: error = unreachable peer (the "
     "announce loop's declared backoff path), wedge = a half-open "
     "socket the p2p.connect deadline must free.")
+
+declare_fault(
+    "stage.native.read", "ops/staging.py stage_batch_native",
+    ("delay", "error", "corrupt"),
+    "The native packed-staging seam, per ROW of a staged batch: error "
+    "= EIO from a flaky disk, corrupt = a torn/short read (both flip "
+    "the row's status so it degrades to the per-file Python reader — "
+    "identify throughput drops, digests stay bit-identical, the ring "
+    "never wedges); delay = once per batch, slow-disk weather on the "
+    "stage lane.")
 
 declare_fault(
     "store.commit", "store/db.py Database.tx",

@@ -330,6 +330,31 @@ declare(
     strict=True)
 
 declare(
+    "SDTPU_STAGE_NATIVE", "auto", lambda v: v.strip().lower(),
+    "Packed native staging backend for the device CAS pipeline "
+    "(ops/staging.py stage_batch_native → native sd_stage_batch): "
+    "`auto`/`on` stage whole batches straight into pooled page-aligned "
+    "buffers in the kernel's message layout when libsdio.so is "
+    "available; `off` forces the classic stage_files + "
+    "build_cas_messages host path with PURE-PYTHON readers (the "
+    "classic path's own native pread helpers are pinned off too — "
+    "one flag, the whole native staging plane). Fails closed to the "
+    "Python path "
+    "when the shared object is missing, per-file on bad rows "
+    "(ENOENT/EACCES/short read). Read per batch, so benches can A/B "
+    "backends mid-process (tools/overlap_bench.py --staging).")
+
+declare(
+    "SDTPU_STAGE_POOL_BUFFERS", 0, parse_int,
+    "Cap on the staging buffer pool (ops/staging.py StagePool): "
+    "pooled page-aligned H2D source pages live-recycled at batch "
+    "retirement. 0 = the declared ops.stage.pool channel capacity "
+    "(the registry ceiling); a positive value narrows below it — it "
+    "never raises it. When the pool is exhausted the batch degrades "
+    "to the Python staging path instead of allocating past the bound.",
+    strict=True)
+
+declare(
     "SDTPU_STORE_ACTOR", True, parse_onoff,
     "Kill switch for the per-library single-writer group-commit actor "
     "(store/actor.py): `off` degrades Database.write_tx() to the raw "

@@ -83,6 +83,10 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, charpp, ctypes.c_uint64, u8p, i32p, i32p,
         ctypes.c_int]
     lib.sd_stage_small.restype = None
+    lib.sd_stage_batch.argtypes = [
+        ctypes.c_int64, charpp, u64p, u8p, ctypes.c_int64,
+        ctypes.c_uint64, i32p, i32p, ctypes.c_int]
+    lib.sd_stage_batch.restype = None
     lib.sd_cas_digests.argtypes = [
         ctypes.c_int64, charpp, u64p, u8p, i32p, ctypes.c_int]
     lib.sd_cas_digests.restype = None
@@ -218,6 +222,47 @@ def stage_small(paths: Sequence[str], cap: int = SMALL_CAP,
         lib.sd_stage_small(n, _paths_array(paths), cap, _u8(out),
                            _i32(lens), _i32(status), n_threads)
     return out, lens, status
+
+
+def stage_batch(paths: Sequence[str], sizes: np.ndarray,
+                out: np.ndarray, payload_cap: int,
+                n_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Packed batched staging into a CALLER-OWNED [n, stride] uint8
+    buffer (a pooled, page-aligned ring page): row i becomes
+    le64(size) ‖ payload ‖ zeros — build_cas_messages' exact layout,
+    written by the C plane with no intermediate Python bytes objects.
+
+    `out` must be C-contiguous with stride a 1024 multiple covering
+    8 + payload_cap (plus the +1 grew-detection byte for small rows —
+    the chunk grid always leaves >= 1016 bytes of padding, so any
+    conforming grid qualifies). Returns ([n] int32 msg_lens — the
+    kernel's `lengths` operand — and [n] int32 status); non-OK rows
+    are scrubbed to their 8-byte prefix for per-file fallback at the
+    staging seam."""
+    lib = _load()
+    assert lib is not None
+    n = len(paths)
+    if out.ndim != 2 or out.dtype != np.uint8 or out.shape[0] < n or \
+            not out.flags.c_contiguous:
+        # A real exception, not an assert: a mis-shaped buffer would
+        # let the C writer scribble past the pooled page.
+        raise ValueError(
+            f"stage_batch: out must be C-contiguous uint8 [>= {n}, "
+            f"stride], got {out.dtype} {out.shape}")
+    stride = int(out.shape[1])
+    if stride < 8 + int(payload_cap) + 1 or stride % 1024:
+        raise ValueError(
+            f"stage_batch: stride {stride} cannot hold the {payload_cap}"
+            "-byte payload class (+ prefix and grew byte) on the chunk "
+            "grid")
+    sizes = np.ascontiguousarray(sizes, dtype=np.uint64)
+    msg_lens = np.zeros(n, dtype=np.int32)
+    status = np.zeros(n, dtype=np.int32)
+    if n:
+        lib.sd_stage_batch(n, _paths_array(paths), _u64(sizes), _u8(out),
+                           stride, payload_cap, _i32(msg_lens),
+                           _i32(status), n_threads)
+    return msg_lens, status
 
 
 def cas_digests(paths: Sequence[str], sizes: np.ndarray,

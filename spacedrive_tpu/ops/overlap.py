@@ -74,6 +74,7 @@ from ..telemetry import (
     PIPELINE_H2D_SECONDS,
     PIPELINE_RETIRE_STALL_SECONDS,
     PIPELINE_STAGE_STALL_SECONDS,
+    STAGE_BATCHES,
 )
 
 # Must match the declared capacity of the ops.pipeline.* channels —
@@ -123,6 +124,11 @@ class PipelineStats:
     donated_reuse: int = 0
     depth_high_water: int = 0
     per_device_batches: Dict[str, int] = field(default_factory=dict)
+    # Staging backend mix for this run (warmup + calibration +
+    # measured batches): packed zero-copy C plane vs the classic
+    # stage_files + build_cas_messages pass.
+    stage_native_batches: int = 0
+    stage_python_batches: int = 0
     # (live device arrays, words consumed, lengths consumed) sampled
     # after each dispatch when run_overlapped(track_buffers=True) —
     # the donation footprint test's probe, off by default.
@@ -160,6 +166,15 @@ class PipelineStats:
     @property
     def files_per_sec(self) -> float:
         return self.files / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def staging_backend(self) -> str:
+        """'native' / 'python' / 'mixed' — which staging plane fed this
+        run (mixed means the packed path degraded mid-run: pool
+        exhaustion or per-batch flag flips)."""
+        if self.stage_native_batches and self.stage_python_batches:
+            return "mixed"
+        return "native" if self.stage_native_batches else "python"
 
     def _component_bests(self) -> Tuple[float, float, float]:
         def best(idx: int) -> float:
@@ -268,18 +283,43 @@ def _retire(x) -> np.ndarray:
         return np.asarray(x)
 
 
-def _stage_batch(paths: Sequence[str], sizes: np.ndarray):
-    """Native-plane staging of one large-class batch → (words, lengths).
+def _stage_batch(paths: Sequence[str], sizes: np.ndarray,
+                 stats: Optional[PipelineStats] = None):
+    """Stage one large-class batch → (words, lengths, lease).
 
-    Falls back to the Python reader when the C++ plane is absent."""
+    Preferred path: packed zero-copy staging (staging.stage_batch_native
+    writes the kernel's message layout straight into a pooled page; the
+    returned lease IS that page and the caller must release it at batch
+    retirement — the ring-recycling point). Falls back to the classic
+    stage_files + build_cas_messages copy pass (lease None) whenever
+    the packed path declines: SDTPU_STAGE_NATIVE off, libsdio.so
+    absent, pool exhausted, or off-contract rows (empty files) in the
+    batch."""
     from . import blake3_jax as bj
     from . import staging
 
-    large, _small, _empty, errors = staging.stage_files(
-        list(zip(paths, sizes.tolist())))
+    files = list(zip(paths, sizes.tolist()))
+    staged = staging.stage_batch_native(files)
+    if staged is not None:
+        if staged.errors:
+            errs = list(staged.errors.values())[:3]
+            staged.release()
+            raise OSError(f"staging errors: {errs}")
+        if not staged.empty_rows:
+            if stats is not None:
+                with stats._lock:
+                    stats.stage_native_batches += 1
+            return staged.words, staged.lengths, staged.lease
+        staged.release()  # empty rows: the classic path's class split
+    large, _small, _empty, errors = staging.stage_files(files)
     if errors:
         raise OSError(f"staging errors: {list(errors.values())[:3]}")
-    return bj.build_cas_messages(large.payloads, large.sizes)
+    STAGE_BATCHES.labels(backend="python").inc()
+    if stats is not None:
+        with stats._lock:
+            stats.stage_python_batches += 1
+    words, lengths = bj.build_cas_messages(large.payloads, large.sizes)
+    return words, lengths, None
 
 
 def _h2d(words, lengths, dev, stats: Optional[PipelineStats] = None):
@@ -464,7 +504,7 @@ def _run_overlapped_impl(
 
     def _calibrate() -> Tuple[float, float, float, np.ndarray]:
         t0 = time.perf_counter()
-        words, lengths = _stage_batch(paths0, sizes0)
+        words, lengths, lease = _stage_batch(paths0, sizes0, stats)
         t_stage = time.perf_counter() - t0
         t0 = time.perf_counter()
         w, l = _h2d(words, lengths, devs[0])
@@ -474,13 +514,17 @@ def _run_overlapped_impl(
         out, _keep = _dispatch_kernel(jfn, w, l, donate)
         res = _retire(out)  # kernel + the (small) digest D2H
         t_kernel = time.perf_counter() - t0
+        if lease is not None:
+            lease.release()  # retire point: the kernel consumed it
         return t_stage, t_h2d, t_kernel, res
 
     # Warm the compile on batch 0 before the first timed sample.
-    words, lengths = _stage_batch(paths0, sizes0)
+    words, lengths, lease = _stage_batch(paths0, sizes0, stats)
     out, _keep = _dispatch_kernel(jfn, *_h2d(words, lengths, devs[0]),
                                   donate)
     _retire(out)
+    if lease is not None:
+        lease.release()
     s0 = _calibrate()
     with stats._lock:
         stats.samples.append(s0[:3])
@@ -579,20 +623,21 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                         _DEPTH_HW = stats.depth_high_water
                         PIPELINE_DEPTH_HIGH_WATER.set(_DEPTH_HW)
                 t0 = time.perf_counter()
-                words, lengths = await loop.run_in_executor(
-                    stage_pool, _stage_batch, *batches[i])
+                words, lengths, lease = await loop.run_in_executor(
+                    stage_pool, _stage_batch, batches[i][0],
+                    batches[i][1], stats)
                 # Stage lane: this batch's staging wall as the
                 # pipeline saw it (executor queue wait included — that
                 # wait IS stage-side contention).
                 RECORDER.record("stage", batch=i, t0=t0,
                                 t1=time.perf_counter(), stream=w,
                                 trace=trace, run=run_token)
-                await staged.put((i, words, lengths))
+                await staged.put((i, words, lengths, lease))
 
         async def feed() -> None:
             await asyncio.gather(*(stager(w) for w in range(n_stagers)))
             for _ in devs:
-                await staged.put((_DONE, None, None))
+                await staged.put((_DONE, None, None, None))
 
         async def dispatcher(d: int) -> None:
             dev = devs[d]
@@ -600,7 +645,7 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
             while True:
                 t0 = time.perf_counter()
                 c0 = stats.calibration_s
-                i, words, lengths = await staged.get()
+                i, words, lengths, lease = await staged.get()
                 # Subtract any calibration pause that completed during
                 # this wait: at a milestone every dispatcher idles in
                 # staged.get() BY DESIGN (stagers hold, pipeline
@@ -624,12 +669,12 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                     stats.per_device_batches[label] = (
                         stats.per_device_batches.get(label, 0) + 1)
                 PIPELINE_DEVICE_BATCHES.labels(device=label).inc()
-                await inflight.put((i, out, keep))
+                await inflight.put((i, out, keep, lease))
 
         async def retirer() -> None:
             while state["retired"] < n - 1:
                 t0 = time.perf_counter()
-                i, out, keep = await inflight.get()
+                i, out, keep, lease = await inflight.get()
                 wait = time.perf_counter() - t0
                 with stats._lock:
                     stats.retire_stall_s += wait
@@ -643,6 +688,12 @@ def _run_pipeline(batches, jfn, devs, depth: int, donate: bool,
                                 t1=time.perf_counter(), trace=trace,
                                 run=run_token)
                 del keep  # undonated: device inputs released at retire
+                if lease is not None:
+                    # Pool recycling point: retirement guarantees the
+                    # kernel consumed this batch's staged page (even on
+                    # backends where device_put aliases host memory),
+                    # so the page may be rewritten by a later batch.
+                    lease.release()
                 state["retired"] += 1
                 state["in_flight"] -= 1
                 tickets.release()

@@ -33,9 +33,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import cas, jit_registry
-from .. import flags, tracing
+from .. import channels, chaos, flags, tracing
 from ..flight import RECORDER
-from ..telemetry import STAGE_POOL_WORKERS
+from ..telemetry import (
+    STAGE_BATCHES,
+    STAGE_FALLBACK_FILES,
+    STAGE_NATIVE_BYTES,
+    STAGE_POOL_BUFFERS,
+    STAGE_POOL_HIGH_WATER,
+    STAGE_POOL_WORKERS,
+)
 
 # Monotone hashing-chunk ordinal for the flight recorder's "identify"
 # scope: host-plane chunks get timeline lanes too, so the export shows
@@ -206,7 +213,13 @@ def stage_files(
     errors: Dict[int, str] = {}
 
     from .. import native as _native
-    if _native.available():
+    # SDTPU_STAGE_NATIVE=off is the WHOLE native-staging escape hatch:
+    # it pins not just the packed path (stage_batch_native) but these
+    # classic native reads too, so "off" really means the pure-Python
+    # readers — the baseline tools/overlap_bench.py --staging python
+    # measures against.
+    mode = str(flags.get("SDTPU_STAGE_NATIVE") or "auto")
+    if mode not in ("off", "0", "no", "false") and _native.available():
         return _stage_files_native(files, large_idx, small_idx, empty_idx)
 
     large = np.zeros((len(large_idx), cas.LARGE_PAYLOAD_SIZE), dtype=np.uint8)
@@ -231,15 +244,23 @@ def stage_files(
         except EOFError as e:
             errors[idx] = str(e)
 
-    futures = [
-        _submit(read_one, "large", row, idx)
-        for row, idx in enumerate(large_idx)
-    ] + [
-        _submit(read_one, "small", row, idx)
-        for row, idx in enumerate(small_idx)
-    ]
-    for fut in futures:
-        fut.result()
+    jobs = [("large", row, idx)
+            for row, idx in enumerate(large_idx)] + \
+           [("small", row, idx)
+            for row, idx in enumerate(small_idx)]
+    if threading.current_thread().name.startswith("cas-stage"):
+        # Already ON a stage-pool worker (the depth-N pipeline stages
+        # whole batches through the same executor): submitting the
+        # per-file reads back into the pool and blocking on them can
+        # starve — depth >= workers pins every worker on a batch whose
+        # inner reads never get a thread. Nested staging reads inline;
+        # batches still parallelize across the outer workers.
+        for job in jobs:
+            read_one(*job)
+    else:
+        futures = [_submit(read_one, *job) for job in jobs]
+        for fut in futures:
+            fut.result()
 
     sizes = np.array([s for _, s in files], dtype=np.uint64)
     large_batch = StagedBatch(
@@ -250,6 +271,235 @@ def stage_files(
         small_idx, small, sizes[small_idx] if small_idx else
         np.zeros((0,), np.uint64), small_lens)
     return large_batch, small_batch, empty_idx, errors
+
+
+# -- native packed staging (zero-copy ring feed) ---------------------------
+#
+# The classic path above stages per-class payload matrices and then
+# pays a full build_cas_messages pass — allocate a fresh [B, C*1024]
+# buffer, write prefixes, copy every payload — before each H2D. The
+# packed path below hands the C plane (native/sdio.cpp sd_stage_batch)
+# a POOLED, page-aligned buffer and has it write the kernel's message
+# layout directly: le64(size) ‖ payload ‖ zeros per row, per-row status
+# for file-by-file degradation. The pooled pages are the H2D sources
+# (np.frombuffer views, no copy) and recycle at batch RETIREMENT, so
+# the pool is a declared bounded resource (ops.stage.pool window).
+
+
+@dataclass
+class StageLease:
+    """One checked-out pooled page: `arr` is the [rows, stride] uint8
+    zero-copy view over the anonymous mapping `buf`. Release returns
+    the PAGE to the pool — the numpy views die with the lease holder,
+    and the mapping itself is only reclaimed by GC once no view (or
+    jax host alias) can reach it."""
+
+    buf: "object"            # mmap.mmap backing pages
+    nbytes: int              # pooled capacity of buf (>= rows*stride)
+    arr: np.ndarray          # [rows, stride] uint8 view for this batch
+    _pool: "StagePool"
+    _released: bool = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._pool.release(self)
+
+
+class StagePool:
+    """Bounded pool of page-aligned staging pages (the donation ring's
+    H2D sources). Anonymous mmap allocations are page-aligned by
+    construction; a free page is reused for any batch whose rows fit
+    its capacity. Checkouts are metered through the declared
+    ops.stage.pool window — the capacity there (narrowable via
+    SDTPU_STAGE_POOL_BUFFERS, never raisable) IS the bound: an
+    exhausted pool returns None and the caller degrades to the Python
+    staging path rather than allocating past it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._free: List[Tuple[object, int]] = []  # [(mmap, nbytes)]
+        self._total = 0
+        self._high_water = 0
+        self._win = channels.window("ops.stage.pool")
+
+    def _cap(self) -> int:
+        cap = self._win.capacity
+        narrowed = int(flags.get("SDTPU_STAGE_POOL_BUFFERS") or 0)
+        if narrowed > 0:
+            cap = min(cap, narrowed)
+        return max(1, cap)
+
+    def acquire(self, rows: int, stride: int) -> Optional[StageLease]:
+        import mmap as _mmap
+
+        need = rows * stride
+        with self._lock:
+            mm = None
+            # Smallest free page that fits; an all-too-small free list
+            # drops one page (GC reclaims it once unreferenced) and
+            # allocates at the new size — total never passes the cap.
+            fits = [i for i, (_, cap) in enumerate(self._free)
+                    if cap >= need]
+            if fits:
+                mm, nbytes = self._free.pop(
+                    min(fits, key=lambda i: self._free[i][1]))
+            elif self._total < self._cap():
+                self._total += 1
+            elif self._free:
+                self._free.pop(0)
+            else:
+                return None  # every page checked out: degrade, not grow
+            if mm is None:
+                nbytes = need
+                mm = _mmap.mmap(-1, need)
+            in_use = self._total - len(self._free)
+            if in_use > self._high_water:
+                self._high_water = in_use
+                STAGE_POOL_HIGH_WATER.set(in_use)
+            STAGE_POOL_BUFFERS.set(in_use)
+            self._win.note_put()
+        arr = np.frombuffer(mm, dtype=np.uint8,
+                            count=need).reshape(rows, stride)
+        return StageLease(mm, nbytes, arr, self)
+
+    def release(self, lease: StageLease) -> None:
+        with self._lock:
+            self._free.append((lease.buf, lease.nbytes))
+            self._win.note_pop()
+            STAGE_POOL_BUFFERS.set(self._total - len(self._free))
+
+
+_BUF_POOL_LOCK = threading.Lock()
+_STAGE_BUF_POOL: Optional[StagePool] = None
+
+
+def stage_buffer_pool() -> StagePool:
+    """The process-wide staging page pool (one declared window meters
+    every ring)."""
+    global _STAGE_BUF_POOL
+    with _BUF_POOL_LOCK:
+        if _STAGE_BUF_POOL is None:
+            _STAGE_BUF_POOL = StagePool()
+        return _STAGE_BUF_POOL
+
+
+@dataclass
+class NativeStaged:
+    """A natively staged packed batch: row i corresponds to files[i].
+    `words`/`lengths` are the kernel operands ([B, C, 256] uint32 view
+    over the pooled page + [B] int32 message lengths); rows listed in
+    `errors` failed BOTH the native reader and the per-file Python
+    retry (their rows are scrubbed to the 8-byte prefix; ignore their
+    digests), `empty_rows` are declared-empty files (no CAS ID)."""
+
+    words: np.ndarray
+    lengths: np.ndarray
+    lease: StageLease
+    errors: Dict[int, str]
+    empty_rows: List[int]
+    fallback_files: int = 0
+
+    def release(self) -> None:
+        self.lease.release()
+
+
+def _grid_for(payload_cap: int) -> Tuple[int, int]:
+    """(chunk grid C, row stride) for a payload class — the exact
+    build_cas_messages shape."""
+    c = max(1, -(-(cas.SIZE_PREFIX_LEN + payload_cap) // 1024))
+    return c, c * 1024
+
+
+def stage_batch_native(
+    files: Sequence[Tuple[str, int]],
+    pool: Optional[StagePool] = None,
+) -> Optional[NativeStaged]:
+    """Stage a batch straight into a pooled packed buffer via the C
+    plane, or None to degrade the WHOLE batch to the Python path
+    (flag off, libsdio.so missing — the fail-closed ladder — or pool
+    exhausted). Individual bad rows (vanished file, permission, short
+    read, injected EIO) degrade PER FILE: the Python reader retries
+    into the same packed row, and only a row failing both lands in
+    `errors`. Byte parity with stage_files + build_cas_messages is
+    pinned by tests/test_staging_native.py."""
+    mode = str(flags.get("SDTPU_STAGE_NATIVE") or "auto")
+    if mode in ("off", "0", "no", "false"):
+        return None
+    from .. import native
+    if not native.available():
+        return None  # fail closed: the classic Python path
+    n = len(files)
+    if n == 0:
+        return None
+    sizes = np.array([s for _, s in files], dtype=np.uint64)
+    any_small = bool(np.any((sizes > 0) & (sizes <= cas.MINIMUM_FILE_SIZE)))
+    payload_cap = cas.MINIMUM_FILE_SIZE if any_small \
+        else cas.LARGE_PAYLOAD_SIZE
+    grid_c, stride = _grid_for(payload_cap)
+    lease = (pool or stage_buffer_pool()).acquire(n, stride)
+    if lease is None:
+        return None  # bounded resource: degrade instead of growing
+    try:
+        msg_lens, status = native.stage_batch(
+            [p for p, _ in files], sizes, lease.arr, payload_cap)
+        if chaos.armed_point("stage.native.read"):
+            f = chaos.hit("stage.native.read", only=("delay",))
+            if f is not None:
+                chaos.apply_sync(f)
+            # Per-row draws so a probability storm speckles the batch
+            # (file-by-file degradation) instead of all-or-nothing.
+            for r in range(n):
+                f = chaos.hit("stage.native.read",
+                              only=("error", "corrupt"))
+                if f is not None:
+                    status[r] = (native.ERR_IO if f.kind == "error"
+                                 else native.ERR_SHORT_READ)
+        errors: Dict[int, str] = {}
+        empty_rows: List[int] = []
+        fallback = 0
+        for r in np.nonzero(status != native.OK)[0]:
+            r = int(r)
+            if int(status[r]) == native.ERR_EMPTY:
+                empty_rows.append(r)
+                continue
+            # Per-file fallback ladder: the Python oracle reader, into
+            # the SAME packed row (zero-copy invariants hold — only
+            # the bytes of this row change).
+            path, size = files[r]
+            row = lease.arr[r]
+            try:
+                if size > cas.MINIMUM_FILE_SIZE:
+                    _read_large(path, size,
+                                row[8:8 + cas.LARGE_PAYLOAD_SIZE])
+                    plen = cas.LARGE_PAYLOAD_SIZE
+                else:
+                    with open(path, "rb") as fobj:
+                        data = fobj.read(cas.MINIMUM_FILE_SIZE + 1)
+                    if len(data) > cas.MINIMUM_FILE_SIZE:
+                        raise EOFError(
+                            f"{path}: grew past declared size {size}")
+                    row[8:8 + len(data)] = np.frombuffer(data,
+                                                         dtype=np.uint8)
+                    plen = len(data)
+                row[8 + plen:] = 0  # pooled page: scrub stale residue
+                msg_lens[r] = 8 + plen
+                status[r] = native.OK
+                fallback += 1
+            except (OSError, EOFError) as e:
+                errors[r] = f"{path}: {e}"
+                row[8:] = 0
+                msg_lens[r] = 8
+        words = lease.arr.view("<u4").reshape(n, grid_c, 256)
+        STAGE_BATCHES.labels(backend="native").inc()
+        STAGE_NATIVE_BYTES.inc(int(msg_lens.sum()))
+        if fallback:
+            STAGE_FALLBACK_FILES.inc(fallback)
+        return NativeStaged(words, msg_lens, lease, errors, empty_rows,
+                            fallback)
+    except BaseException:
+        lease.release()
+        raise
 
 
 # -- backends --------------------------------------------------------------
@@ -356,6 +606,22 @@ def _h2d_cache_path() -> Optional[str]:
     return os.path.join(d, "h2d_probe.json")
 
 
+def _h2d_probe_key() -> Optional[str]:
+    """Cache key binding a probe result to the device set it measured:
+    backend platform + device count. The on-disk cache outlives the
+    process — without the key, a stale CPU-backend probe (a laptop
+    run, a tier-1 test) would mis-calibrate the ring on a bench host
+    for up to the TTL. None (jax unavailable) disables the disk cache
+    rather than trusting an unkeyed entry."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return f"{devs[0].platform}:{len(devs)}"
+    except Exception:
+        return None
+
+
 def h2d_gbps() -> float:
     """Measured host→device link bandwidth, probed once per process and
     cached on disk for an hour (the probe itself costs a round trip, and
@@ -381,11 +647,16 @@ def h2d_gbps() -> float:
     import time
 
     cache = _h2d_cache_path()
-    if cache is not None:
+    key = _h2d_probe_key() if cache is not None else None
+    if cache is not None and key is not None:
         try:
             with open(cache) as f:
                 saved = json.load(f)
-            if time.time() - saved["t"] < _H2D_PROBE_TTL:
+            # Entries are only valid for the SAME backend + device set
+            # that measured them (pre-key entries have no "key" and
+            # re-probe once).
+            if (time.time() - saved["t"] < _H2D_PROBE_TTL
+                    and saved.get("key") == key):
                 _H2D_GBPS = float(saved["gbps"])
                 return _H2D_GBPS
         except Exception:
@@ -406,12 +677,13 @@ def h2d_gbps() -> float:
         ok = True
     except Exception:
         _H2D_GBPS = 0.0
-    if ok and cache is not None:
+    if ok and cache is not None and key is not None:
         # Only successful probes are cached: a transient jax/device
         # failure must stay per-process, not poison an hour of runs.
         try:
             with open(cache, "w") as f:
-                json.dump({"t": time.time(), "gbps": _H2D_GBPS}, f)
+                json.dump({"t": time.time(), "gbps": _H2D_GBPS,
+                           "key": key}, f)
         except OSError:
             pass
     return _H2D_GBPS

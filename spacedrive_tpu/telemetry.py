@@ -552,6 +552,33 @@ STAGE_POOL_WORKERS = gauge(
     "(ops/staging.py) — 0 when the pool is shut down, so shutdown-"
     "leak tests can see its lifecycle")
 
+# -- native packed staging (ops/staging.py stage_batch_native) --------------
+STAGE_NATIVE_BYTES = counter(
+    "sd_stage_native_bytes_total",
+    "Message bytes (prefix + payload) staged by the native packed "
+    "backend (sd_stage_batch) straight into pooled H2D source pages")
+STAGE_BATCHES = counter(
+    "sd_stage_batches_total",
+    "Batches staged for the device CAS pipeline, by backend: `native` "
+    "is the packed zero-copy path, `python` the stage_files + "
+    "build_cas_messages host path (flag off, .so missing, or pool "
+    "exhausted)",
+    labelnames=("backend",))
+STAGE_FALLBACK_FILES = counter(
+    "sd_stage_fallback_files_total",
+    "Files that degraded PER-FILE from the native packed reader to "
+    "the Python reader (bad row status: vanished, permission, short "
+    "read, chaos-injected EIO) inside an otherwise-native batch")
+STAGE_POOL_BUFFERS = gauge(
+    "sd_stage_pool_buffers",
+    "Pooled staging pages currently checked out to in-flight batches "
+    "(StagePool occupancy; the ops.stage.pool window meters the same "
+    "edge with overflow detection)")
+STAGE_POOL_HIGH_WATER = gauge(
+    "sd_stage_pool_high_water",
+    "Peak concurrent StagePool checkouts since process start — how "
+    "close the ring came to the declared pool bound")
+
 # -- sync (sync/manager.py, sync/ingest.py, sync/opblob.py) -----------------
 SYNC_OPS_ENCODED = counter(
     "sd_sync_ops_encoded_total",

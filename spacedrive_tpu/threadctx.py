@@ -619,10 +619,25 @@ declare_owner(
 declare_owner(
     "channels.Window", "spacedrive_tpu/channels.py::Window",
     {
-        "_depth": loop_only(),
+        "_depth": guarded_by("_depth_lock"),
     },
-    "External-buffer depth tracker (tunnel send_nowait window): "
-    "note_put/note_drain run on the owning tunnel's loop.")
+    "External-buffer depth tracker: the tunnel send_nowait window "
+    "notes from its owning loop, while the staging buffer pool's "
+    "window is noted from stage and retire executor threads — every "
+    "depth mutation serializes on the window's internal _depth_lock leaf.")
+
+declare_owner(
+    "staging.StagePool", "spacedrive_tpu/ops/staging.py::StagePool",
+    {
+        "_free": guarded_by("_lock"),
+        "_total": guarded_by("_lock"),
+        "_high_water": guarded_by("_lock"),
+    },
+    "Native staging buffer pool: leases are acquired on the stage "
+    "executor threads and released on the retirer, so the free list "
+    "and allocation accounting all move under the pool's _lock leaf; "
+    "occupancy is metered through the declared ops.stage.pool "
+    "window.")
 
 declare_owner(
     "timeouts.Backoff", "spacedrive_tpu/timeouts.py::Backoff",
@@ -690,6 +705,8 @@ declare_owner(
         "samples": guarded_by("_lock"),
         "depth_high_water": guarded_by("_lock"),
         "per_device_batches": guarded_by("_lock"),
+        "stage_native_batches": guarded_by("_lock"),
+        "stage_python_batches": guarded_by("_lock"),
         "files": single_thread(),
         "wall_s": single_thread(),
         "batches": single_thread(),

@@ -1,0 +1,316 @@
+"""Packed native staging (native/sdio.cpp sd_stage_batch → ops/staging
+stage_batch_native): byte parity with the classic Python path, the
+per-file and whole-batch degradation ladders, pooled-page recycling,
+and the chaos seam — all CPU-only tier-1.
+
+The acceptance shape: native digests must be bit-identical to the
+Python CAS oracle across the WHOLE degradation matrix — healthy rows,
+fallback rows, and scrubbed error rows alike — because the kernel
+consumes whatever bytes staging hands it.
+"""
+
+import os
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu import chaos, flags, native
+from spacedrive_tpu.ops import cas, staging
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="native libsdio unavailable")
+
+
+def _write(path: str, data: bytes) -> int:
+    with open(path, "wb") as f:
+        f.write(data)
+    return len(data)
+
+
+def _pattern(n: int, seed: int = 0) -> bytes:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _oracle_payload(path: str, declared: int) -> bytes:
+    """The Python reader's payload for one file — the CAS oracle's
+    input bytes."""
+    if declared <= cas.MINIMUM_FILE_SIZE:
+        with open(path, "rb") as f:
+            return f.read()
+    out = np.zeros(cas.LARGE_PAYLOAD_SIZE, np.uint8)
+    staging._read_large(path, declared, out)
+    return out.tobytes()
+
+
+def _expect_row(declared: int, payload: bytes, stride: int) -> bytes:
+    row = struct.pack("<Q", declared) + payload
+    return row + b"\x00" * (stride - len(row))
+
+
+@requires_native
+def test_make_stage_selftest():
+    """Satellite: `make -C native stage` builds and runs the C-level
+    self-test (layout, statuses, sampled offsets, pooled-page
+    scrubbing) with no Python in the loop."""
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native")
+    res = subprocess.run(["make", "-C", native_dir, "stage"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "sd_stage_batch self-test: OK" in res.stdout
+
+
+@requires_native
+def test_byte_parity_across_split(tmp_path, monkeypatch):
+    """Byte-for-byte parity with the classic path across the
+    large/small boundary (102399 / 102400 / 102401) and a deep-sample
+    large file: prefix, payload, and zero tail per packed row."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    sizes = [102399, cas.MINIMUM_FILE_SIZE, 102401, 150000, 4242]
+    files = []
+    for i, n in enumerate(sizes):
+        p = str(tmp_path / f"f{i}.bin")
+        files.append((p, _write(p, _pattern(n, seed=i))))
+
+    staged = staging.stage_batch_native(files)
+    assert staged is not None
+    try:
+        assert staged.errors == {} and staged.empty_rows == []
+        assert staged.fallback_files == 0
+        stride = staged.lease.arr.shape[1]
+        for r, (p, declared) in enumerate(files):
+            payload = _oracle_payload(p, declared)
+            assert int(staged.lengths[r]) == 8 + len(payload)
+            got = staged.lease.arr[r].tobytes()
+            assert got == _expect_row(declared, payload, stride), \
+                f"row {r} ({declared}B) diverges from the oracle"
+        # words is a zero-copy view over the SAME pooled page
+        assert staged.words.base is not None
+        assert np.shares_memory(staged.words, staged.lease.arr)
+    finally:
+        staged.release()
+
+
+@requires_native
+def test_digest_parity_with_cas_oracle(tmp_path, monkeypatch):
+    """CAS IDs computed from the packed rows equal the pure-Python
+    oracle's — the end contract every staging backend must meet."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    files = []
+    for i, n in enumerate((300, 102400, 120000, 103000)):
+        p = str(tmp_path / f"d{i}.bin")
+        files.append((p, _write(p, _pattern(n, seed=10 + i))))
+    staged = staging.stage_batch_native(files)
+    assert staged is not None
+    try:
+        for r, (p, declared) in enumerate(files):
+            payload = staged.lease.arr[
+                r, 8:int(staged.lengths[r])].tobytes()
+            assert cas.cas_id_of_payload(declared, payload) == \
+                cas.cas_id_of_payload(declared, _oracle_payload(p, declared))
+    finally:
+        staged.release()
+
+
+@requires_native
+def test_per_file_degradation_matrix(tmp_path, monkeypatch):
+    """One batch, every ladder rung at once: a healthy row stays
+    native, a vanished file (ENOENT) and a truncated file (short read)
+    fail BOTH readers into `errors` with their rows scrubbed to the
+    8-byte prefix, an empty file lands in `empty_rows`, and a grown
+    file (real bytes past the declared size) is refused by both."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    ok = str(tmp_path / "ok.bin")
+    _write(ok, _pattern(120000, seed=1))
+    gone = str(tmp_path / "gone.bin")
+    short = str(tmp_path / "short.bin")
+    _write(short, _pattern(4096, seed=2))  # declared 150000: truncated
+    empty = str(tmp_path / "empty.bin")
+    _write(empty, b"")
+    grew = str(tmp_path / "grew.bin")
+    # declared small (5000) but the real bytes crossed the small-class
+    # cap — the only grow the whole-file reader can (and must) refuse,
+    # exactly like the classic path's MINIMUM+1 sentinel read
+    _write(grew, _pattern(cas.MINIMUM_FILE_SIZE + 600, seed=3))
+
+    files = [(ok, 120000), (gone, 120000), (short, 150000),
+             (empty, 0), (grew, 5000)]
+    staged = staging.stage_batch_native(files)
+    assert staged is not None
+    try:
+        assert sorted(staged.errors) == [1, 2, 4]
+        assert staged.empty_rows == [3]
+        assert staged.fallback_files == 0
+        # the healthy row is untouched by its neighbors' failures
+        payload = staged.lease.arr[0, 8:int(staged.lengths[0])].tobytes()
+        assert payload == _oracle_payload(ok, 120000)
+        # failed + empty rows: prefix only, tail scrubbed (the kernel
+        # hashes full blocks — stale residue would corrupt digests)
+        for r in (1, 2, 3, 4):
+            assert int(staged.lengths[r]) == 8
+            assert not staged.lease.arr[r, 8:].any()
+        # error parity with the classic path: same rows, same classes
+        _l, _s, empty_idx, perrors = staging.stage_files(files)
+        assert sorted(perrors) == sorted(staged.errors)
+        assert empty_idx == staged.empty_rows
+    finally:
+        staged.release()
+
+
+@requires_native
+def test_chaos_injected_eio_falls_back_per_file(tmp_path, monkeypatch):
+    """Satellite: the declared stage.native.read fault point. A
+    probability-1.0 error storm marks every native row failed; the
+    per-file Python ladder re-reads them all into the SAME pooled rows
+    and digest parity still holds (fallback is invisible to the
+    kernel)."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    files = []
+    for i, n in enumerate((120000, 50000, 102401)):
+        p = str(tmp_path / f"c{i}.bin")
+        files.append((p, _write(p, _pattern(n, seed=20 + i))))
+    chaos.arm("stage.native.read=error:1.0", seed=11)
+    try:
+        staged = staging.stage_batch_native(files)
+        assert staged is not None
+        try:
+            assert staged.errors == {}
+            assert staged.fallback_files == len(files)
+            for r, (p, declared) in enumerate(files):
+                payload = staged.lease.arr[
+                    r, 8:int(staged.lengths[r])].tobytes()
+                assert payload == _oracle_payload(p, declared)
+        finally:
+            staged.release()
+    finally:
+        chaos.disarm()
+    assert not chaos.armed_point("stage.native.read")
+
+
+def test_whole_batch_fallback_flag_off(tmp_path, monkeypatch):
+    """SDTPU_STAGE_NATIVE=off declines the packed path entirely — the
+    fail-closed ladder's top rung."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "off")
+    p = str(tmp_path / "x.bin")
+    files = [(p, _write(p, _pattern(120000)))]
+    assert staging.stage_batch_native(files) is None
+
+
+def test_whole_batch_fallback_so_missing(tmp_path, monkeypatch):
+    """A missing shared object degrades the WHOLE batch, silently and
+    correctly, whatever the flag says."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    monkeypatch.setattr(native, "available", lambda: False)
+    p = str(tmp_path / "x.bin")
+    files = [(p, _write(p, _pattern(120000)))]
+    assert staging.stage_batch_native(files) is None
+
+
+@requires_native
+def test_pool_exhaustion_degrades_not_grows(tmp_path, monkeypatch):
+    """The pool is a declared bounded resource: with every page checked
+    out, stage_batch_native returns None (degrade to Python) instead of
+    allocating past the bound; a release makes it available again."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    monkeypatch.setenv("SDTPU_STAGE_POOL_BUFFERS", "1")
+    pool = staging.StagePool()
+    p = str(tmp_path / "x.bin")
+    files = [(p, _write(p, _pattern(120000)))]
+    held = pool.acquire(4, 58368)
+    assert held is not None
+    assert staging.stage_batch_native(files, pool=pool) is None
+    held.release()
+    staged = staging.stage_batch_native(files, pool=pool)
+    assert staged is not None
+    staged.release()
+
+
+@requires_native
+def test_pool_recycles_pages_and_scrubs_residue(tmp_path, monkeypatch):
+    """Recycled pages are reused (bounded allocation) and every packed
+    row's tail is rewritten — batch B staged into batch A's dirty page
+    must not inherit A's bytes."""
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    pool = staging.StagePool()
+    big = str(tmp_path / "big.bin")
+    big2 = str(tmp_path / "big2.bin")
+    # two large rows (2 x 58368B) so the page fits batch B's one
+    # small-grid row (103424B) and MUST be reused, not reallocated
+    files_a = [(big, _write(big, _pattern(150000, seed=5))),
+               (big2, _write(big2, _pattern(150000, seed=7)))]
+    small = str(tmp_path / "small.bin")
+    files_b = [(small, _write(small, _pattern(600, seed=6)))]
+
+    a = staging.stage_batch_native(files_a, pool=pool)
+    assert a is not None
+    page_a = id(a.lease.buf)
+    a.release()
+    assert pool._total == 1 and len(pool._free) == 1
+
+    b = staging.stage_batch_native(files_b, pool=pool)
+    assert b is not None
+    try:
+        # same pooled page, reshaped for the small grid
+        assert id(b.lease.buf) == page_a
+        assert pool._total == 1
+        assert int(b.lengths[0]) == 8 + 600
+        assert not b.lease.arr[0, 8 + 600:].any(), \
+            "stale residue from the previous batch survived the scrub"
+        payload = b.lease.arr[0, 8:608].tobytes()
+        assert payload == _oracle_payload(small, 600)
+    finally:
+        b.release()
+
+
+@requires_native
+def test_overlap_pipeline_digest_parity_and_pool_drain(tmp_path,
+                                                       monkeypatch):
+    """End to end through the depth-N ring: native and Python staging
+    produce identical digests for the same corpus, the run reports its
+    backend, and every pooled page is back on the free list when the
+    pipeline drains (recycling is keyed to batch retirement)."""
+    from tools.overlap_bench import _cheap_kernel
+
+    from spacedrive_tpu.ops import overlap
+
+    root = str(tmp_path / "corpus")
+    batches = overlap.make_sparse_corpus(root, 12, 120000, 4)
+    pool = staging.stage_buffer_pool()
+
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "on")
+    r_native, s_native = overlap.run_overlapped(
+        batches, kernel=_cheap_kernel, depth=3, calibrate_every=99)
+    monkeypatch.setenv("SDTPU_STAGE_NATIVE", "off")
+    r_python, s_python = overlap.run_overlapped(
+        batches, kernel=_cheap_kernel, depth=3, calibrate_every=99)
+
+    assert s_native.staging_backend == "native"
+    assert s_native.stage_native_batches > 0
+    assert s_python.staging_backend == "python"
+    assert s_python.stage_native_batches == 0
+    for a, b in zip(r_native, r_python):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # retirement returned every lease: nothing checked out
+    with pool._lock:
+        assert pool._total == len(pool._free)
+    assert len(pool._win) == 0
+
+
+@requires_native
+def test_stage_native_flag_modes(tmp_path, monkeypatch):
+    """auto (default) and on both engage when the .so is present; the
+    off spellings all decline."""
+    p = str(tmp_path / "x.bin")
+    files = [(p, _write(p, _pattern(120000)))]
+    for mode in ("auto", "on", "1"):
+        monkeypatch.setenv("SDTPU_STAGE_NATIVE", mode)
+        staged = staging.stage_batch_native(files)
+        assert staged is not None, mode
+        staged.release()
+    for mode in ("off", "0", "no", "false"):
+        monkeypatch.setenv("SDTPU_STAGE_NATIVE", mode)
+        assert staging.stage_batch_native(files) is None, mode

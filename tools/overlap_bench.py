@@ -49,14 +49,26 @@ def _cheap_kernel(words, lengths):
 
 
 def run_sweep(depths, links, batch=32, batches=8, file_size=120_000,
-              cheap_kernel=False, donate=None, calibrate_every=None):
+              cheap_kernel=False, donate=None, calibrate_every=None,
+              stagings=("env",)):
     """calibrate_every: None keeps run_overlapped's interleaved mid-run
     cadence (real links — the bound must come from the same weather
     window as the measurement); >= batches disables mid-run pauses
     (simulated links are deterministic, so re-sampling buys nothing
     and each pause's drain+refill denies short deep-pipeline runs
-    their steady state)."""
+    their steady state).
+
+    stagings: staging-backend axis per (link, depth) row — 'native' /
+    'python' pin SDTPU_STAGE_NATIVE for that row (same run, same
+    corpus: the A/B the BENCH artifact commits), 'env' leaves the
+    caller's flag alone. Each row records the requested axis value AND
+    the backend that actually fed it (a 'native' request degrades to
+    python when libsdio.so is absent — the artifact must say so),
+    plus the flight recorder's per-batch bound-attribution histogram
+    (which of stage/h2d/kernel bound each retired window) so a
+    staging-bound pipeline is visible as data, not inference."""
     from spacedrive_tpu.ops import overlap
+    from spacedrive_tpu import flight
 
     kernel = _cheap_kernel if cheap_kernel else None
     rows = []
@@ -67,20 +79,44 @@ def run_sweep(depths, links, batch=32, batches=8, file_size=120_000,
         from spacedrive_tpu import flags as _flags
 
         prior = _flags.raw("SDTPU_SIM_LINK_GBPS")
+        prior_stage = _flags.raw("SDTPU_STAGE_NATIVE")
         for link in links:
             if link == "real":
                 os.environ.pop("SDTPU_SIM_LINK_GBPS", None)
             else:
                 os.environ["SDTPU_SIM_LINK_GBPS"] = str(link)
             try:
-                for depth in depths:
-                    _res, stats = overlap.run_overlapped(
-                        corpus, kernel=kernel, depth=depth,
-                        donate=donate, calibrate_every=calibrate_every)
+                for depth, staging in ((d, s) for d in depths
+                                       for s in stagings):
+                    if staging == "native":
+                        os.environ["SDTPU_STAGE_NATIVE"] = "on"
+                    elif staging == "python":
+                        os.environ["SDTPU_STAGE_NATIVE"] = "off"
+                    mark = len(flight.RECORDER.snapshot())
+                    try:
+                        _res, stats = overlap.run_overlapped(
+                            corpus, kernel=kernel, depth=depth,
+                            donate=donate,
+                            calibrate_every=calibrate_every)
+                    finally:
+                        if staging != "env":
+                            if prior_stage is None:
+                                os.environ.pop("SDTPU_STAGE_NATIVE",
+                                               None)
+                            else:
+                                os.environ["SDTPU_STAGE_NATIVE"] = \
+                                    prior_stage
                     report = stats.bound_report()
+                    attribution = {}
+                    for ev in flight.RECORDER.snapshot()[mark:]:
+                        if ev.get("lane") == "window":
+                            b = ev["binding"]
+                            attribution[b] = attribution.get(b, 0) + 1
                     rows.append({
                         "depth": depth,
                         "link_gbps": link,
+                        "staging": staging,
+                        "staging_backend": stats.staging_backend,
                         "devices": stats.n_devices,
                         "donated": stats.donate,
                         "measured_files_per_sec":
@@ -103,6 +139,7 @@ def run_sweep(depths, links, batch=32, batches=8, file_size=120_000,
                             "h2d": round(stats.t_h2d_1, 4),
                             "kernel_fetch": round(stats.t_kernel_1, 4),
                         },
+                        "bound_attribution": attribution,
                         "calibrations": report["calibrations"],
                         "bound_reason": report["reason"],
                     })
@@ -125,7 +162,8 @@ def gate_failures(rows):
     strictly beat the same link's depth-1 run."""
     by_link = {}
     for r in rows:
-        by_link.setdefault(r["link_gbps"], {})[r["depth"]] = r
+        key = (r["link_gbps"], r.get("staging", "env"))
+        by_link.setdefault(key, {})[r["depth"]] = r
     bad = []
     for link, by_depth in by_link.items():
         base = by_depth.get(1)
@@ -159,6 +197,11 @@ def main() -> int:
                     help="trivially-compiling checksum kernel (CI)")
     ap.add_argument("--donate", choices=("on", "off"), default=None,
                     help="override SDTPU_DONATE_BUFFERS for the sweep")
+    ap.add_argument("--staging", default="env",
+                    help="comma-separated staging backends to A/B per "
+                         "depth row: python, native (pins "
+                         "SDTPU_STAGE_NATIVE per row), or env "
+                         "(default: the caller's flag)")
     ap.add_argument("--calibrate-every", type=int, default=None,
                     metavar="N",
                     help="mid-run calibration cadence in batches "
@@ -183,6 +226,10 @@ def main() -> int:
     links = [l if l == "real" else float(l)
              for l in args.links.split(",") if l.strip()]
     donate = None if args.donate is None else args.donate == "on"
+    stagings = [s.strip() for s in args.staging.split(",") if s.strip()]
+    for s in stagings:
+        if s not in ("python", "native", "env"):
+            ap.error(f"--staging: unknown backend {s!r}")
 
     if args.trace:
         # The trace artifact should cover THIS sweep only.
@@ -203,7 +250,8 @@ def main() -> int:
     rows = run_sweep(depths, links, batch=args.batch,
                      batches=args.batches, file_size=args.file_size,
                      cheap_kernel=args.cheap_kernel, donate=donate,
-                     calibrate_every=args.calibrate_every)
+                     calibrate_every=args.calibrate_every,
+                     stagings=stagings)
     hsnap = monitor.sample()
     health_problems = validate_health_snapshot(hsnap)
     for p in health_problems:
