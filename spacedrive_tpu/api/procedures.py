@@ -54,6 +54,7 @@ def _json_safe(v: Any) -> Any:
 def register_all(router: Router) -> None:
     _core(router)
     _fleet(router)
+    _incidents(router)
     _libraries(router)
     _volumes(router)
     _tags(router)
@@ -206,6 +207,17 @@ def _fleet(r: Router) -> None:
             header["limit"] = input["limit"]
         return await _serve(node, header)
 
+    @r.query("obs.incidents")
+    async def obs_incidents(node, input):
+        """This node's incident bundle HEADERS in the obs envelope,
+        newest-first, capped by {limit} — what the fleet poller
+        digests into per-row incident columns. Full bundles never
+        ride this route; incidents.get serves them locally."""
+        header: Dict[str, Any] = {"t": "obs.incidents"}
+        if (input or {}).get("limit") is not None:
+            header["limit"] = input["limit"]
+        return await _serve(node, header)
+
     @r.query("fleet.health")
     async def fleet_health(node, _input):
         """The merged fleet health view (fleet.py): one row per node
@@ -251,6 +263,58 @@ def _fleet(r: Router) -> None:
         emit({"type": "FleetHealthSnapshot", "ts": view["ts"],
               "fleet": view})
         return unsub
+
+
+# -- incidents. (incident observatory, spacedrive_tpu/incidents.py) ---------
+
+def _incidents(r: Router) -> None:
+    """The postmortem-triage surface: list bundle headers, pull one
+    full bundle, acknowledge it (drains the sd_incident_open backlog),
+    and stream new incidents as they freeze. All four degrade cleanly
+    when SDTPU_INCIDENTS=off (empty list / NOT_FOUND / stream of
+    nothing)."""
+
+    def _obs(node):
+        from .. import incidents
+
+        return getattr(node, "incidents", None) or incidents.current()
+
+    @r.query("incidents.list")
+    def incidents_list(node, input):
+        """Bundle headers newest-first, optional {limit}."""
+        obs = _obs(node)
+        if obs is None:
+            return []
+        return obs.list(limit=int((input or {}).get("limit", 0)))
+
+    @r.query("incidents.get")
+    def incidents_get(node, input):
+        """One full evidence bundle by {id} (disk-authoritative)."""
+        obs = _obs(node)
+        bundle = obs.get(str((input or {}).get("id", ""))) \
+            if obs is not None else None
+        if bundle is None:
+            raise RpcError("NOT_FOUND", "no such incident bundle")
+        return bundle
+
+    @r.mutation("incidents.ack")
+    def incidents_ack(node, input):
+        """Mark a bundle triaged: {id} → {acked: bool}."""
+        obs = _obs(node)
+        acked = obs.ack(str((input or {}).get("id", ""))) \
+            if obs is not None else False
+        return {"acked": acked}
+
+    @r.subscription("incidents")
+    def incidents_sub(node, _input, emit):
+        """Push each Incident event (the new bundle's header) as the
+        observatory freezes it — the operator-console live feed. No
+        initial emit: incidents.list is the paint-in query, and an
+        empty store should paint empty."""
+        def on_event(e):
+            if e.get("type") == "Incident":
+                emit(e)
+        return node.events.subscribe(on_event)
 
 
 # -- library. (api/libraries.rs) -------------------------------------------

@@ -682,6 +682,18 @@ declare_channel(
     "oldest-first.", sheds_expected=True)
 
 declare_channel(
+    "incidents.store", 64, "shed_oldest", "incidents",
+    "Incident-bundle header index of the incident observatory "
+    "(spacedrive_tpu/incidents.py) — the count bound of the on-disk "
+    "bundle store. Each entry is one frozen evidence bundle's header "
+    "plus its file path; shedding the oldest entry DELETES its file "
+    "(the eviction hook is the store's garbage collector), so the "
+    "postmortem directory can never outgrow this declared bound. "
+    "The byte cap (SDTPU_INCIDENT_STORE_MB) evicts through the same "
+    "hook; both count sd_incident_dropped_total.",
+    sheds_expected=True)
+
+declare_channel(
     "jobs.worker.commands", 16, "shed_oldest", "jobs",
     "Per-worker command inbox (pause/resume/cancel/shutdown). The "
     "drain is latest-wins, so shedding the OLDEST command under a "
@@ -782,3 +794,11 @@ declare_channel(
     "between frames; the producer's put blocks under the "
     "sync.ingest.backlog budget when the consumer wedges.",
     put_budget="sync.ingest.backlog")
+
+declare_channel(
+    "tracing.logring", 512, "shed_oldest", "tracing",
+    "Bounded in-memory log ring (tracing.LogRing, installed at Node "
+    "bootstrap under SDTPU_LOG_RING): the newest trace/span-stamped "
+    "log records, aged oldest-first, so incident bundles freeze a "
+    "log tail without unbounded buffering — stderr is write-only, "
+    "this ring is the recoverable copy.", sheds_expected=True)

@@ -397,6 +397,16 @@ declare_fault(
     "recover.")
 
 declare_fault(
+    "incidents.write", "incidents.py IncidentObservatory._write",
+    ("delay",),
+    "The WAL-style bundle write, drawn twice: once mid-body (a delay "
+    "there widens the torn-.json.tmp window) and once after the full "
+    "body lands but before the atomic rename (the complete-tmp "
+    "window). The kill -9 recovery test parks the writer in each "
+    "window and asserts restart recovers a valid bundle or none — "
+    "never a torn final file.")
+
+declare_fault(
     "p2p.tunnel.frame", "p2p/proto.py Tunnel.send/recv",
     ("delay", "drop", "disconnect", "wedge", "corrupt"),
     "One sealed frame crossing a tunnel. Send side can drop (lost "

@@ -243,12 +243,49 @@ declare(
     "node.health and rendered by tools/sd_top.py.", strict=True)
 
 declare(
+    "SDTPU_INCIDENTS", True, parse_onoff,
+    "Incident observatory master switch (incidents.py): when on, "
+    "Node bootstrap installs the process-global black box and wires "
+    "every detection surface (health states, backoff give-ups, "
+    "count-mode sanitizer violations, crash markers) to snapshot-"
+    "freeze evidence bundles. `off` makes install() a no-op.")
+
+declare(
+    "SDTPU_INCIDENT_DEGRADED_WINDOWS", 3, parse_int,
+    "Consecutive health samples a subsystem must hold `degraded` "
+    "before the incident observatory opens a health.degraded bundle "
+    "(incidents.py) — brief wobbles don't produce postmortems; "
+    "`saturated` always fires immediately.", strict=True)
+
+declare(
+    "SDTPU_INCIDENT_STORE_MB", 16.0, parse_float,
+    "Byte cap (MB) on the on-disk incident-bundle store "
+    "(incidents.py): crossing it evicts oldest bundles first and "
+    "counts sd_incident_dropped_total. The count cap is the declared "
+    "incidents.store channel capacity.")
+
+declare(
+    "SDTPU_INCIDENT_WINDOW_S", 60.0, parse_float,
+    "Per-fingerprint rate-limit window for incident bundles "
+    "(incidents.py): repeat firings of the same subsystem + resource "
+    "+ trigger kind inside the window collapse into "
+    "sd_incident_deduped_total instead of new bundles.")
+
+declare(
     "SDTPU_LOG_JSON", False, parse_flag1,
     "When on, a JSON-line formatter is installed on the "
     "`spacedrive_tpu` logger (tracing.install_json_logging): every "
     "record carries ts/level/logger/msg plus the CURRENT trace/span "
     "id (the tracing contextvar survives to_thread), so log lines "
     "correlate with node.spans and exported traces.")
+
+declare(
+    "SDTPU_LOG_RING", True, parse_onoff,
+    "Bounded in-memory log ring (tracing.LogRing, capacity declared "
+    "as the tracing.logring channel): installed at Node bootstrap "
+    "next to the JSON formatter, it keeps the newest trace/span-"
+    "stamped records in-process so incident bundles can freeze a log "
+    "tail instead of pointing at unrecoverable stderr.")
 
 declare(
     "SDTPU_PROFILE", None, parse_str,

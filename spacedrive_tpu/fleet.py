@@ -18,7 +18,10 @@ observability planes over the same p2p layer the data plane uses
   ``fleet.poll`` budget; outcomes count into
   ``sd_fleet_polls_total{outcome}``. A malformed snapshot is rejected
   by the schema gate WITHOUT touching the ring — one poisoned peer
-  cannot corrupt the fleet view.
+  cannot corrupt the fleet view. Each good round also pulls the
+  peer's ``obs.incidents`` bundle HEADERS best-effort, so every
+  fleet row carries an incident digest (open/total + newest
+  headers) even after the peer goes unreachable.
 - **Merger.** The fleet health view reuses PR 11's
   saturation-attribution rules — each node's own engine already
   named its bottlenecks — and re-keys them per ``(node, subsystem)``.
@@ -52,8 +55,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import channels, chaos, flags, flight, tasks, telemetry, \
-    timeouts, tracing
+from . import channels, chaos, flags, flight, incidents, tasks, \
+    telemetry, timeouts, tracing
 from .health import STATES, validate_health_snapshot
 from .p2p.obs import OBS_PROTO
 from .telemetry import FLEET_PEERS, FLEET_PEERS_STALE, FLEET_POLLS
@@ -67,6 +70,12 @@ __all__ = [
 # A peer whose freshest good snapshot is older than this many poll
 # intervals is a stale row (documented with the flag declaration).
 STALE_INTERVALS = 2.0
+
+# Newest bundle headers carried inline per fleet row — enough to see
+# WHAT froze on each node; the full bundle stays on the owning node
+# (pulled via its rspc incidents.get, never pushed over the fleet
+# plane).
+INCIDENT_RECENT = 3
 
 
 # -- obs response schema gate ------------------------------------------------
@@ -107,6 +116,21 @@ def validate_obs_response(what: str, resp: Any) -> List[str]:
     elif what == "obs.metrics":
         if not isinstance(resp.get("metrics"), dict):
             problems.append("obs.metrics: metrics payload missing")
+    elif what == "obs.incidents":
+        headers = resp.get("incidents")
+        if not isinstance(headers, list):
+            problems.append(
+                "obs.incidents: incidents must be a list")
+        else:
+            # Every header must pass the incident schema gate — one
+            # peer serving malformed headers is rejected whole, same
+            # poisoning rule as a malformed health snapshot.
+            for i, h in enumerate(headers):
+                sub = incidents.validate_incident_header(h)
+                if sub:
+                    problems.append(
+                        f"obs.incidents: incidents[{i}]: {sub[0]}")
+                    break
     elif what == "obs.trace":
         for key in ("spans", "timeline"):
             seq = resp.get(key)
@@ -258,7 +282,7 @@ class FleetMonitor:
                     "client": client,
                     "ring": channels.channel("fleet.peer.snapshots"),
                     "last_ok": None, "rtt_s": None, "skew_s": None,
-                    "error": "",
+                    "error": "", "incidents": [],
                 }
                 self._peers[peer_id] = rec
             else:
@@ -296,7 +320,7 @@ class FleetMonitor:
                     "client": None,
                     "ring": channels.channel("fleet.peer.snapshots"),
                     "last_ok": None, "rtt_s": None, "skew_s": None,
-                    "error": "",
+                    "error": "", "incidents": [],
                 }
                 self._peers[peer_id] = rec
             rec["error"] = str(reason)[:200]
@@ -437,6 +461,24 @@ class FleetMonitor:
             rec["error"] = ""
             if resp["node"].get("name"):
                 rec["name"] = resp["node"]["name"]
+        # Incident headers ride the same round, best-effort AFTER the
+        # health poll succeeded (a peer that can answer obs.health has
+        # a live transport): a failed or malformed header fetch keeps
+        # the last known list — headers are evidence pointers, and a
+        # transient fetch failure must not erase them from the view.
+        try:
+            iresp = await with_timeout("fleet.poll",
+                                       client.fetch("obs.incidents"))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        if validate_obs_response("obs.incidents", iresp):
+            return
+        with self._lock:
+            rec = self._peers.get(peer_id)
+            if rec is not None:
+                rec["incidents"] = list(iresp["incidents"])
 
     async def poll_once(self) -> Dict[str, Any]:
         """One poll round: refresh the peer set from the p2p plane,
@@ -471,6 +513,20 @@ class FleetMonitor:
 
     # -- the merger --------------------------------------------------------
 
+    @staticmethod
+    def _incident_summary(headers: Any) -> Dict[str, Any]:
+        """The per-row incident digest: open (unacked) / total counts
+        plus the newest INCIDENT_RECENT headers, from a newest-first
+        header list (obs.incidents payload or the local list())."""
+        rows = [dict(h) for h in headers
+                if isinstance(h, dict)] \
+            if isinstance(headers, list) else []
+        return {
+            "open": sum(1 for h in rows if not h.get("ack")),
+            "total": len(rows),
+            "recent": rows[:INCIDENT_RECENT],
+        }
+
     def _local_row(self) -> Optional[Dict[str, Any]]:
         if self.health is None:
             return None
@@ -478,12 +534,16 @@ class FleetMonitor:
         ident = dict(self.node_identity)
         if not ident.get("id") and isinstance(snap.get("node"), dict):
             ident = dict(snap["node"])
+        obs = getattr(self.node, "incidents", None) \
+            or incidents.current()
         return {
             "node": ident, "local": True, "reachable": True,
             "stale": False, "last_seen": snap["ts"], "rtt_s": 0.0,
             "skew_s": 0.0, "error": None,
             "states": dict(snap["states"]),
             "attribution": dict(snap["attribution"]),
+            "incidents": self._incident_summary(
+                obs.list() if obs is not None else []),
         }
 
     @staticmethod
@@ -510,6 +570,11 @@ class FleetMonitor:
             "local": False, "reachable": False, "stale": True,
             "last_seen": rec["last_ok"], "rtt_s": rec["rtt_s"],
             "skew_s": rec["skew_s"], "error": rec["error"] or None,
+            # Last-known headers survive unreachability on purpose: a
+            # node that crashed AFTER freezing a bundle is exactly the
+            # row whose incidents an operator needs to see.
+            "incidents": FleetMonitor._incident_summary(
+                rec.get("incidents")),
             "states": {"peer": "degraded"},
             "attribution": {"peer": [{
                 "resource": f"fleet.peer.{name}", "subsystem": "peer",
@@ -560,6 +625,8 @@ class FleetMonitor:
                     "error": None,
                     "states": dict(health["states"]),
                     "attribution": dict(health["attribution"]),
+                    "incidents": self._incident_summary(
+                        rec.get("incidents")),
                 }
             nodes[row_key(row["node"]["name"], pid)] = row
 
@@ -735,6 +802,15 @@ def validate_fleet_snapshot(doc: Any) -> List[str]:
         for key in ("local", "reachable", "stale"):
             if not isinstance(row.get(key), bool):
                 problems.append(f"{where}: {key} must be a bool")
+        inc = row.get("incidents")
+        if inc is not None:  # optional: pre-observatory rows omit it
+            if not isinstance(inc, dict) \
+                    or not isinstance(inc.get("open"), int) \
+                    or not isinstance(inc.get("total"), int) \
+                    or not isinstance(inc.get("recent"), list):
+                problems.append(
+                    f"{where}: incidents must be "
+                    "{open: int, total: int, recent: list}")
         states = row.get("states")
         if not isinstance(states, dict) or not states:
             problems.append(f"{where}: states must be a non-empty "

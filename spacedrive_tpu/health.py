@@ -48,7 +48,7 @@ from __future__ import annotations
 import asyncio
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import channels, flags, tasks, telemetry, timeouts
 from .telemetry import HEALTH_SAMPLES, HEALTH_STATE
@@ -325,7 +325,29 @@ class HealthMonitor:
         HEALTH_SAMPLES.inc()
         for sub, st in states.items():
             HEALTH_STATE.labels(subsystem=sub).set(STATES.index(st))
+        # Incident observatory last, OUTSIDE the lock: the observer
+        # snapshot-freezes evidence bundles (disk writes, counter
+        # stages) and must never extend the sampler's critical
+        # section — or break the sample on its own failure.
+        observer = _incident_observer
+        if observer is not None:
+            try:
+                observer(snap)
+            except Exception:
+                pass
         return snap
+
+
+# Incident-observatory hook (incidents.py set_incident_observer):
+# called with every computed snapshot so saturated/degraded states
+# become durable evidence bundles.
+_incident_observer: Optional[Callable[[Dict[str, Any]], None]] = None
+
+
+def set_incident_observer(
+        cb: Optional[Callable[[Dict[str, Any]], None]]) -> None:
+    global _incident_observer
+    _incident_observer = cb
 
 
 # -- the saturation engine ---------------------------------------------------
@@ -353,6 +375,7 @@ def _evaluate(window: Dict[str, Dict], dt: Optional[float],
     finds.extend(_task_findings(window, dt))
     finds.extend(_pipeline_findings(window, dt, wall))
     finds.extend(_sanitize_findings(window, dt))
+    finds.extend(_incident_findings(window, dt))
     return finds
 
 
@@ -630,6 +653,40 @@ def _sanitize_findings(window, dt) -> List[Dict[str, Any]]:
     return finds
 
 
+def _incident_findings(window, dt) -> List[Dict[str, Any]]:
+    """The observatory observes itself: evidence lost to the store
+    bound, and an untriaged backlog against the declared capacity.
+    Both land under the dynamic `incidents` subsystem — which the
+    observatory explicitly refuses to open bundles about (a black box
+    recording its own pressure forever would be the feedback loop)."""
+    finds = []
+    if dt is not None:
+        rec = _win(window, "sd_incident_dropped_total")
+        delta = (rec or {}).get("delta") or 0.0
+        if delta > 0:
+            finds.append(_finding(
+                "incidents.store", "incidents", 1, delta,
+                f"{delta:g} evidence bundle(s) evicted by the store "
+                "bound in this window — postmortems are being lost; "
+                "raise SDTPU_INCIDENT_STORE_MB or triage faster",
+                owner="incidents",
+                doc=_family_doc("sd_incident_dropped_total"),
+                evidence={"sd_incident_dropped_total": delta}))
+    rec = _win(window, "sd_incident_open")
+    open_n = (rec or {}).get("value") or 0.0
+    cap = channels.capacity("incidents.store")
+    if open_n >= 0.8 * cap:
+        finds.append(_finding(
+            "incidents.open", "incidents", 1, open_n / max(cap, 1),
+            f"{open_n:g} unacknowledged bundle(s) vs store capacity "
+            f"{cap} — the untriaged backlog is about to evict "
+            "evidence (incidents.ack drains it)",
+            owner="incidents",
+            doc=_family_doc("sd_incident_open"),
+            evidence={"sd_incident_open": open_n}))
+    return finds
+
+
 # -- artifact schema ---------------------------------------------------------
 
 def validate_health_snapshot(doc: Any) -> List[str]:
@@ -771,4 +828,10 @@ READS: Dict[str, str] = {
         "runtime-sanitizer detections by kind",
     "sd_race_candidates_total":
         "ownership-contract breaches recorded by the race recorder",
+    "sd_incident_dropped_total":
+        "evidence bundles evicted by the incident store's declared "
+        "bound (postmortems lost)",
+    "sd_incident_open":
+        "untriaged incident-bundle backlog vs the incidents.store "
+        "capacity",
 }

@@ -222,6 +222,9 @@ class Node:
         # SDTPU_LOG_JSON: trace-correlated structured logging — a
         # no-op when the flag is off, one handler per process when on.
         tracing.install_json_logging()
+        # SDTPU_LOG_RING (default on): bounded in-memory log ring so
+        # incident bundles can freeze a trace-stamped log tail.
+        tracing.install_log_ring()
         self.data_dir = os.path.abspath(data_dir)
         os.makedirs(self.data_dir, exist_ok=True)
         self.config = NodeConfig(os.path.join(self.data_dir, NODE_CONFIG_NAME))
@@ -250,6 +253,16 @@ class Node:
         # subsystem) fleet view; serves fleet.health / fleet.metrics /
         # fleet.trace.export.
         self.fleet = FleetMonitor(self, owner=f"{self.task_owner}/fleet")
+        # Incident observatory (incidents.py): the always-on black
+        # box. install() is process-global and idempotent (first node
+        # wins, like the sanitizer); recovery of a prior crash's
+        # partially-written bundle happens here, before any trigger
+        # can fire. SDTPU_INCIDENTS=off → None.
+        from . import incidents
+        self.incidents = incidents.install(
+            dir_path=os.path.join(self.data_dir, "incidents"),
+            monitor=self.health, events=self.events,
+            node_id=self.config.id.hex(), node_name=self.config.name)
         self.p2p = None  # created by start_p2p (P2PManager)
         # Thumbnailer actor (lib.rs:116 Thumbnailer::new): constructed at
         # bootstrap (cache version migration runs here), loop starts with

@@ -16,7 +16,12 @@ next to ping/pair/sync):
 - ``obs.trace``   — a span-ring + flight-timeline slice, filterable
   by trace id, capped at TRACE_SLICE_LIMIT entries per reply — the
   raw material distributed trace assembly merges into one
-  Chrome-trace document.
+  Chrome-trace document;
+- ``obs.incidents`` — the incident observatory's bundle HEADERS
+  (newest-first, capped): enough for a fleet operator to see which
+  node froze what postmortem and pull the full bundle from its rspc
+  incidents.get — full bundles never cross the fleet plane
+  unsolicited.
 
 Every response is an envelope ``{status, proto, what, node, ts, ...}``
 so the poller can reject a malformed or stale-proto peer without
@@ -41,8 +46,9 @@ from ..telemetry import OBS_REQUESTS
 from ..timeouts import with_timeout
 
 __all__ = [
-    "OBS_PROTO", "OBS_KINDS", "TRACE_SLICE_LIMIT", "node_identity",
-    "serve_obs", "P2PObsClient",
+    "OBS_PROTO", "OBS_KINDS", "TRACE_SLICE_LIMIT",
+    "INCIDENT_SLICE_LIMIT", "node_identity", "serve_obs",
+    "P2PObsClient",
 ]
 
 # Observability wire version, echoed in every response envelope. Bump
@@ -53,7 +59,12 @@ OBS_PROTO = 1
 
 # The request kinds manager.py dispatches on (the `t` header field,
 # same discriminator scheme as ping/pair/spacedrop/file/sync).
-OBS_KINDS = ("obs.metrics", "obs.health", "obs.trace")
+OBS_KINDS = ("obs.metrics", "obs.health", "obs.trace",
+             "obs.incidents")
+
+# Per-reply cap on bundle headers in an obs.incidents response —
+# headers are small, and the store itself is capped well below this.
+INCIDENT_SLICE_LIMIT = 256
 
 # Per-reply cap on spans and timeline events in an obs.trace slice:
 # bounded well above the default rings (512 spans / 4096 timeline
@@ -102,6 +113,16 @@ def serve_obs(node, header: Dict[str, Any]) -> Dict[str, Any]:
         resp["metrics"] = telemetry.snapshot()
     elif what == "obs.health":
         resp["health"] = node.health.snapshot()
+    elif what == "obs.incidents":
+        from .. import incidents as _incidents
+
+        obs = getattr(node, "incidents", None) or _incidents.current()
+        try:
+            limit = int(header.get("limit", INCIDENT_SLICE_LIMIT))
+        except (TypeError, ValueError):
+            limit = INCIDENT_SLICE_LIMIT
+        limit = max(1, min(limit, INCIDENT_SLICE_LIMIT))
+        resp["incidents"] = obs.list(limit=limit) if obs else []
     else:  # obs.trace
         trace = header.get("trace")
         trace = str(trace) if trace else None

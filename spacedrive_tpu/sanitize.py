@@ -84,7 +84,7 @@ from __future__ import annotations
 import threading
 import time
 import traceback
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from . import flags
 from .telemetry import SANITIZE_LOOP_MAX_STALL, SANITIZE_VIOLATIONS
@@ -166,6 +166,19 @@ def reset_violations() -> None:
         _violations.clear()
 
 
+# Incident-observatory hook (incidents.py set_violation_observer):
+# notified per violation recorded WITHOUT raising — count mode is
+# production, where a violation is otherwise one counter tick nobody
+# saw; raise mode already hands the evidence to the raiser.
+_violation_observer: Optional[Callable[[str, str], None]] = None
+
+
+def set_violation_observer(
+        cb: Optional[Callable[[str, str], None]]) -> None:
+    global _violation_observer
+    _violation_observer = cb
+
+
 def _record(kind: str, detail: str, may_raise: bool) -> None:
     SANITIZE_VIOLATIONS.labels(kind=kind).inc()
     entry = {
@@ -180,6 +193,12 @@ def _record(kind: str, detail: str, may_raise: bool) -> None:
             del _violations[0]
     if may_raise and _mode == "raise":
         raise SanitizerViolation(f"{kind}: {detail}")
+    observer = _violation_observer
+    if observer is not None:
+        try:
+            observer(kind, detail)
+        except Exception:
+            pass  # the black box must never break the detector
 
 
 def record(kind: str, detail: str, may_raise: bool = False) -> None:

@@ -886,3 +886,40 @@ CHAOS_INJECTED = counter(
     "effect lands so artifacts reconcile observed degradation "
     "against injected cause. 0 forever while disarmed",
     labelnames=("name", "kind"))
+
+# -- incident observatory (incidents.py) -------------------------------------
+INCIDENTS_OPENED = counter(
+    "sd_incident_opened_total",
+    "Evidence bundles snapshot-frozen by the incident observatory "
+    "(incidents.py), per declared trigger kind — each is one durable "
+    "postmortem written to the incidents.store channel's on-disk "
+    "bound",
+    labelnames=("kind",))
+INCIDENTS_DEDUPED = counter(
+    "sd_incident_deduped_total",
+    "Trigger firings collapsed into an existing fingerprint "
+    "(subsystem + resource + kind) inside its "
+    "SDTPU_INCIDENT_WINDOW_S rate-limit window — a storm shows up "
+    "here, not as a store full of identical bundles")
+INCIDENTS_DROPPED = counter(
+    "sd_incident_dropped_total",
+    "Bundles evicted from the bounded incidents.store (count cap via "
+    "the declared channel's shed_oldest, byte cap via "
+    "SDTPU_INCIDENT_STORE_MB) — evidence lost to the bound; the "
+    "health observatory flags a non-zero delta under the incidents "
+    "subsystem")
+INCIDENTS_RECOVERED = counter(
+    "sd_incident_recovered_total",
+    "Partially-written bundles found at next-boot WAL recovery, by "
+    "outcome: promoted (complete .json.tmp renamed into the store) | "
+    "discarded (torn tmp unlinked — the crash landed mid-write)",
+    labelnames=("outcome",))
+INCIDENT_OPEN = gauge(
+    "sd_incident_open",
+    "Unacknowledged bundles currently in the incidents store — the "
+    "untriaged postmortem backlog (incidents.ack drains it)")
+INCIDENT_STORE_BYTES = gauge(
+    "sd_incident_store_bytes",
+    "Bytes of bundle JSON currently held by the on-disk incidents "
+    "store, enforced below SDTPU_INCIDENT_STORE_MB by oldest-first "
+    "eviction")

@@ -692,6 +692,24 @@ declare_owner(
     "monitor's _lock leaf.")
 
 declare_owner(
+    "incidents.IncidentObservatory",
+    "spacedrive_tpu/incidents.py::IncidentObservatory",
+    {
+        "_index": guarded_by("_lock"),
+        "_last_fired": guarded_by("_lock"),
+        "_dedup": guarded_by("_lock"),
+        "_store_bytes": guarded_by("_lock"),
+        "_closed": guarded_by("_lock"),
+        "_degraded_streak": guarded_by("_lock"),
+    },
+    "Incident observatory capture engine: triggers fire from the "
+    "health sampler loop, backoff ladders on arbitrary threads, and "
+    "the sanitizer's recording sites — the bundle index, dedup "
+    "windows, store accounting, and degraded-streak map all move "
+    "under the observatory's _lock leaf (health samples arrive from "
+    "whichever thread asked the monitor to sample).")
+
+declare_owner(
     "overlap.PipelineStats",
     "spacedrive_tpu/ops/overlap.py::PipelineStats",
     {

@@ -181,6 +181,18 @@ class BackoffContract:
 
 BACKOFFS: Dict[str, BackoffContract] = {}
 
+# Incident-observatory hook (incidents.py set_give_up_observer):
+# notified once per exhausted ladder, exactly when
+# sd_backoff_gave_up_total counts it — a give-up means an operation
+# stopped retrying and degraded, which is a postmortem moment.
+_give_up_observer: Optional[Callable[[str, int], None]] = None
+
+
+def set_give_up_observer(
+        cb: Optional[Callable[[str, int], None]]) -> None:
+    global _give_up_observer
+    _give_up_observer = cb
+
 
 def declare_backoff(name: str, base_s: float, cap_s: float,
                     factor: float, jitter: float, max_tries: int,
@@ -239,6 +251,12 @@ class Backoff:
                 # above (threadctx.py; the armed recorder audits it).
                 self._gave_up_counted = True  # sdlint: ok[shared-mutation]
                 self._m_gave_up.inc()
+                observer = _give_up_observer
+                if observer is not None:
+                    try:
+                        observer(c.name, self.tries)
+                    except Exception:
+                        pass  # black box never breaks the ladder
             return None
         # Exponent clamped: an unbounded ladder (max_tries 0) parked
         # at the cap for days would otherwise drive factor**tries past

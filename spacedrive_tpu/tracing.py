@@ -235,6 +235,88 @@ def uninstall_json_logging() -> None:
             _json_handler = None
 
 
+class LogRing(logging.Handler):
+    """Bounded in-memory log ring: the recoverable copy of the
+    process's recent log lines. Records are the same JSON-safe dicts
+    the JSON formatter emits — ts/level/logger/msg plus the CURRENT
+    trace/span id — held in the declared `tracing.logring` channel
+    (shed_oldest), so the tail joins spans and the flight-recorder
+    export on one correlation key and never grows with uptime. The
+    incident observatory freezes `tail()` into every evidence bundle;
+    stderr is write-only, this ring is what survives into a
+    postmortem."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        from . import channels
+        self.ring = channels.channel("tracing.logring")
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            out: Dict[str, Any] = {
+                "ts": round(record.created, 3),
+                "level": record.levelname,
+                "logger": record.name,
+                "msg": record.getMessage(),
+            }
+            cur = _current_span.get()
+            if cur is not None:
+                out["trace"] = f"{cur[0]:x}"
+                out["span"] = f"{cur[1]:x}"
+            if record.exc_info and record.exc_info[0] is not None:
+                out["exc"] = record.exc_info[0].__name__
+            self.ring.put_nowait(out)
+        except Exception:
+            self.handleError(record)
+
+    def tail(self, limit: int = 100) -> List[Dict[str, Any]]:
+        # All ring access is serialized by the handler lock: logging
+        # holds it around every emit(), and tail() takes it here — the
+        # ring needs no loop affinity of its own.
+        with self.lock:
+            records = [dict(r) for r in self.ring]
+        limit = int(limit)
+        return records[-limit:] if limit > 0 else []
+
+
+_log_ring: Optional[LogRing] = None
+_log_ring_lock = threading.Lock()
+
+
+def install_log_ring(force: bool = False) -> bool:
+    """Attach the LogRing handler to the `spacedrive_tpu` logger when
+    the SDTPU_LOG_RING flag is on (or `force` is set). Idempotent —
+    one ring per process no matter how many nodes boot. Returns
+    whether the ring is installed afterwards."""
+    global _log_ring
+    with _log_ring_lock:
+        if _log_ring is not None:
+            return True
+        if not force and not flags.get("SDTPU_LOG_RING"):
+            return False
+        h = LogRing()
+        logger.addHandler(h)
+        _log_ring = h
+    return True
+
+
+def uninstall_log_ring() -> None:
+    """Test/embedder hook: detach the LogRing handler."""
+    global _log_ring
+    with _log_ring_lock:
+        if _log_ring is not None:
+            logger.removeHandler(_log_ring)
+            _log_ring = None
+
+
+def log_ring_tail(limit: int = 100) -> List[Dict[str, Any]]:
+    """Newest-last tail of the installed log ring ([] when the ring
+    is not installed) — the bundle-assembly entry point."""
+    with _log_ring_lock:
+        ring = _log_ring
+    return ring.tail(limit) if ring is not None else []
+
+
 # -- profiler (SDTPU_PROFILE) ----------------------------------------------
 
 # Tri-state probe cache: None = not yet probed, False = profiling off
@@ -347,8 +429,8 @@ def span(name: str, events=None, **fields):
             _span_ring.append(record)
         logger.debug("span %s", record)
         if events is not None:
-            emit = getattr(events, "emit", events)
-            emit({"type": "TraceSpan", **record})
+            emit_fn = getattr(events, "emit", events)
+            emit_fn({"type": "TraceSpan", **record})
 
 
 @contextlib.contextmanager

@@ -1,0 +1,42 @@
+"""Child process for the incident-store kill -9 WAL test: arms the
+declared `incidents.write` delay fault — stretching BOTH write windows
+(torn-tmp: half the body flushed; complete-tmp: fully written, not yet
+renamed) — then fires a stream of distinct-fingerprint incidents so
+the parent's SIGKILL lands mid-bundle-write. Run:
+
+    python tests/_incident_crash_child.py <store_dir> <seed> <n>
+
+Prints WRITING when the stream begins and DONE when it completes
+(the unkilled convergence run). A killed child leaves the `.running`
+crash marker behind — that is the point.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu import chaos  # noqa: E402
+from spacedrive_tpu.incidents import IncidentObservatory  # noqa: E402
+
+
+def main() -> None:
+    store_dir, seed, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    # Every bundle write pauses 60 ms half-flushed and 60 ms
+    # complete-but-unrenamed: the widest possible torn/complete-tmp
+    # windows for the parent's SIGKILL.
+    chaos.arm("incidents.write=delay:60ms:1.0", seed=seed)
+    obs = IncidentObservatory(dir_path=store_dir,
+                              node_id="ic", node_name="incident-crash")
+    print("WRITING", flush=True)
+    for i in range(n):
+        # Distinct resource per firing -> distinct fingerprint -> a
+        # fresh durable write each time (no dedup collapse).
+        obs.observe_give_up(f"obs.http.r{i}", 3)
+    obs.close()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

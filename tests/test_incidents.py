@@ -1,0 +1,262 @@
+"""Incident observatory: dedup/rate-limit collapse, kill -9 WAL
+recovery of the bundle store, the trigger-namespace drift gate, and
+the sd_incidents CLI self-check as a tier-1 subprocess gate.
+
+The kill -9 shape follows test_group_crash.py (child process + seeded
+chaos window + SIGKILL); the static<->runtime drift walk follows
+test_chaos.py's declared-fault-point gate.
+"""
+
+import ast
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from spacedrive_tpu import incidents
+from spacedrive_tpu.incidents import (
+    _SANITIZE_TRIGGERS,
+    TRIGGERS,
+    IncidentObservatory,
+    validate_incident_bundle,
+    validate_incident_header,
+)
+from spacedrive_tpu.telemetry import INCIDENTS_DEDUPED
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_incident_crash_child.py")
+
+
+# -- dedup / rate limit ------------------------------------------------------
+
+def test_storm_collapses_to_one_bundle_per_fingerprint_per_window(
+        tmp_path):
+    """25 firings of the same fingerprint inside the window open ONE
+    bundle; the other 24 collapse into sd_incident_deduped_total. A
+    distinct fingerprint in the same window still opens its own
+    bundle, and window expiry re-opens the first."""
+    obs = IncidentObservatory(dir_path=str(tmp_path / "store"),
+                              node_id="t", node_name="dedup-test")
+    try:
+        before = INCIDENTS_DEDUPED.value
+        for _ in range(25):
+            obs.observe_give_up("obs.http", 3)
+        headers = obs.list()
+        assert len(headers) == 1
+        fp = headers[0]["fingerprint"]
+        assert obs.deduped() == {fp: 24}
+        assert INCIDENTS_DEDUPED.value - before == 24
+
+        # Distinct fingerprint, same window: its own bundle.
+        obs.observe_give_up("fleet.peer.poll", 5)
+        assert len(obs.list()) == 2
+
+        # Window expiry: the rate limit is per-window, not forever.
+        with obs._lock:
+            obs._last_fired[fp] -= obs.window_s + 1
+        obs.observe_give_up("obs.http", 3)
+        headers = obs.list()
+        assert len(headers) == 3
+        assert sum(1 for h in headers if h["fingerprint"] == fp) == 2
+
+        # Everything it wrote validates, header and full bundle.
+        for h in headers:
+            assert validate_incident_header(h) == []
+            bundle = obs.get(h["id"])
+            assert validate_incident_bundle(bundle) == []
+    finally:
+        obs.close()
+
+
+def test_bench_artifact_incident_shape_validates(tmp_path):
+    """The bench artifacts' `incidents` section ({enabled, headers,
+    deduped}) is accepted by the sd_incidents --input validator."""
+    from tools.sd_incidents import input_problems
+
+    obs = IncidentObservatory(dir_path=str(tmp_path / "store"),
+                              node_id="t", node_name="shape-test")
+    try:
+        obs.observe_give_up("obs.http", 3)
+        artifact = {"bench": "x", "incidents": {
+            "enabled": True, "headers": obs.list(), "deduped": {}}}
+        assert input_problems(artifact) == []
+        bad = {"incidents": {"headers": [{"id": ""}], "enabled": True}}
+        assert input_problems(bad) != []
+    finally:
+        obs.close()
+
+
+# -- kill -9 mid-bundle-write ------------------------------------------------
+
+def _spawn_child(store_dir, seed):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, CHILD, str(store_dir), str(seed), "40"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+
+
+def test_kill9_mid_bundle_write_recovers_valid_or_absent(tmp_path):
+    """SIGKILL inside the seeded incidents.write windows (half-flushed
+    tmp / complete-but-unrenamed tmp) must never leave a torn FINAL
+    bundle: after every kill each surviving .json parses and
+    validates, and next-boot recovery promotes complete tmps,
+    discards torn ones, and turns the surviving crash marker into a
+    `crash` bundle."""
+    store = tmp_path / "incidents"
+    saw_tmp = False
+    for round_no in range(3):
+        child = _spawn_child(store, seed=1200 + round_no)
+        try:
+            assert child.stdout.readline().startswith("WRITING")
+            time.sleep(0.12 + 0.08 * round_no)
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait(timeout=10)
+        finally:
+            if child.poll() is None:  # pragma: no cover
+                child.kill()
+        assert child.returncode == -signal.SIGKILL
+        names = os.listdir(store)
+        saw_tmp = saw_tmp or any(n.endswith(".json.tmp") for n in names)
+        # The rename is atomic: a kill can tear only the tmp, never a
+        # final file.
+        for fn in names:
+            if fn.endswith(".json"):
+                with open(store / fn) as f:
+                    doc = json.load(f)
+                assert validate_incident_bundle(doc) == [], fn
+    assert saw_tmp, (
+        "no kill ever landed inside a bundle write — widen the "
+        "incidents.write fault window")
+    # The killed child never ran close(): the crash marker survives.
+    assert (store / ".running").exists()
+
+    # Next boot: WAL recovery.
+    obs = IncidentObservatory(dir_path=str(store),
+                              node_id="t", node_name="recovery-test")
+    try:
+        names = os.listdir(store)
+        assert not any(n.endswith(".json.tmp") for n in names)
+        headers = obs.list()
+        assert headers
+        for h in headers:
+            assert validate_incident_header(h) == []
+        kinds = {h["trigger"]["kind"] for h in headers}
+        assert "crash" in kinds
+        for fn in os.listdir(store):
+            if fn.endswith(".json"):
+                with open(store / fn) as f:
+                    assert validate_incident_bundle(json.load(f)) == []
+    finally:
+        obs.close()
+
+
+# -- static<->runtime drift --------------------------------------------------
+
+def _kind_literals(path, skip_triggers_assign):
+    """String constants in one file that exactly name a declared
+    trigger kind — excluding docstrings and (optionally) the TRIGGERS
+    declaration itself, so the registry literal doesn't count as its
+    own fire site."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    skip = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                skip.add(id(body[0].value))
+        if skip_triggers_assign and isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "TRIGGERS":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant):
+                    skip.add(id(sub))
+    found, fire_args = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and id(node) not in skip \
+                and isinstance(node.value, str) and node.value in TRIGGERS:
+            found.add(node.value)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "_fire" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            fire_args.add(node.args[0].value)
+    return found, fire_args
+
+
+def test_every_declared_trigger_has_a_fire_site():
+    """Every kind in TRIGGERS must be named at a fire site in the
+    product tree (a `_fire(...)` literal, a health-fire tuple, or the
+    sanitizer kind map), and every literal `_fire` first argument
+    must be a declared kind — the same drift gate the chaos fault
+    points get in test_chaos.py."""
+    fired, fire_args = set(), set()
+    for dirpath, dirnames, files in os.walk(
+            os.path.join(ROOT, "spacedrive_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            found, args = _kind_literals(
+                path, skip_triggers_assign=fn == "incidents.py")
+            fired |= found
+            fire_args |= args
+    assert set(TRIGGERS) - fired == set(), (
+        "declared trigger kinds nothing fires — prune or adopt")
+    assert fire_args - set(TRIGGERS) == set(), (
+        "_fire sites naming undeclared trigger kinds")
+    # The sanitizer kind map's targets are declared too (runtime half).
+    assert set(_SANITIZE_TRIGGERS.values()) <= set(TRIGGERS)
+
+
+def test_incident_families_pass_the_naming_scheme():
+    """NAME_RE grew `incident`: the observatory's families are
+    centrally declared AND scheme-clean."""
+    from tools.sdlint.passes.telemetry import NAME_RE
+
+    from spacedrive_tpu.telemetry import REGISTRY
+
+    for name in ("sd_incident_opened_total",
+                 "sd_incident_deduped_total",
+                 "sd_incident_dropped_total",
+                 "sd_incident_recovered_total",
+                 "sd_incident_open", "sd_incident_store_bytes"):
+        assert NAME_RE.match(name), name
+        assert name in REGISTRY.families(), name
+
+
+# -- the CLI self-check as a tier-1 gate -------------------------------------
+
+def test_sd_incidents_self_check_subprocess_gate(tmp_path):
+    """`sd_incidents --json` drives the capture path end to end (three
+    known saturations + an exhausted ladder + repeat pressure) and
+    gates its own artifact; the artifact then round-trips through
+    `--input`. Subprocess on purpose: the gate must hold from a cold
+    interpreter, the way CI invokes it."""
+    out = tmp_path / "selfcheck.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.sd_incidents", "--json",
+         "--out", str(out)],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    artifact = json.loads(proc.stdout)
+    assert artifact["metric"] == "sd_incidents"
+    assert len(artifact["incidents"]) == 4
+    assert sum(artifact["deduped"].values()) >= 2
+
+    check = subprocess.run(
+        [sys.executable, "-m", "tools.sd_incidents",
+         "--input", str(out)],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=60)
+    assert check.returncode == 0, check.stderr

@@ -33,8 +33,10 @@ same tunnel-shaped dicts the TCP plane carries):
 `--chaos` arms a chaos.py spec for the whole run (seeded via
 `--seed`, so a failing storm replays); `--json` emits a BENCH-style
 artifact (per-workload throughput/latency percentiles, the
-chaos/backoff/timeout/shed counters, and health observatory samples
-with saturation attribution); `--gate` exits non-zero on:
+chaos/backoff/timeout/shed counters, health observatory samples with
+saturation attribution, and the incident observatory's bundle
+headers + per-fingerprint dedup counts — the storm's own postmortem
+record); `--gate` exits non-zero on:
 
 - any sanitizer/race/chan-overflow violation,
 - a WEDGE: any coalesce channel still full at quiescence (a consumer
@@ -42,7 +44,10 @@ with saturation attribution); `--gate` exits non-zero on:
 - STARVATION: the slowest clone peer's apply rate below
   ``--fairness-floor`` x the mean (the fair-share gate's contract),
 - UNATTRIBUTED SATURATION: a health sample whose non-ok subsystem
-  carries no attribution naming a declared resource.
+  carries no attribution naming a declared resource,
+- UNATTRIBUTED INCIDENT: a frozen bundle whose trigger names no
+  declared resource (bundles under chaos are expected; causeless
+  ones mean the capture path lost the attribution).
 
     python -m tools.load_bench --json - --gate
     python -m tools.load_bench --peers 128 --chaos \\
@@ -582,6 +587,41 @@ async def _write_path_ab(lib, peers: List[Any], ops_per_peer: int
     return out
 
 
+async def _fleet_giveup(node) -> Dict[str, Any]:
+    """A dead obs peer under the real fleet poller: the HTTP
+    transport's declared obs.http ladder exhausts against a refused
+    port — counted into sd_backoff_gave_up_total AND frozen by the
+    incident observatory as a backoff.give_up bundle — while the
+    peer's row degrades to stale instead of wedging the round. Two
+    monitors on purpose (a restarted observer re-polling the same
+    dead peer): the second exhaustion repeats the same fingerprint
+    inside the incident window, so the artifact proves dedup
+    collapse, not just capture — one monitor alone won't, because the
+    poller's own give-up discipline stops re-dialing a dead peer."""
+    from spacedrive_tpu.fleet import FleetMonitor, HttpObsClient
+
+    gave_before = _metric_value("sd_backoff_gave_up_total",
+                                name="obs.http")
+    t0 = time.perf_counter()
+    view = {}
+    for _ in range(2):
+        fm = FleetMonitor(node=node, interval_s=0.2)
+        # Port 9 (discard) with no listener: every connect refuses
+        # instantly, so the ladder exhausts in milliseconds of sleep,
+        # not sockets timing out.
+        fm.add_peer("de" * 16, HttpObsClient("http://127.0.0.1:9"),
+                    name="dead-peer")
+        view = await fm.poll_once()
+    wall = time.perf_counter() - t0
+    row = view["nodes"].get("dead-peer") or {}
+    return {
+        "gave_up": _metric_value("sd_backoff_gave_up_total",
+                                 name="obs.http") - gave_before,
+        "row_stale": bool(row.get("stale")),
+        "wall_s": round(wall, 3),
+    }
+
+
 async def _spacedrop_offers(node, count: int) -> Dict[str, Any]:
     """Spacedrop offers over real tunnels — needs the `cryptography`
     package (a second in-process node + pairing); recorded as skipped
@@ -657,7 +697,8 @@ def _counter_families() -> Dict[str, Any]:
 def _declared_resource(res: str) -> bool:
     from spacedrive_tpu import timeouts
 
-    if res in channels.CHANNELS or res in timeouts.TIMEOUTS:
+    if res in channels.CHANNELS or res in timeouts.TIMEOUTS \
+            or res in timeouts.BACKOFFS or res == "node.process":
         return True
     return res.startswith((
         "store.db.", "store.actor.", "tasks.", "sanitize.",
@@ -707,6 +748,17 @@ def _gate(doc: Dict[str, Any], fairness_floor: float) -> List[str]:
                     f"unattributed saturation: {sub}={state} in "
                     f"window '{sample.get('label')}' names no "
                     "declared resource")
+    # Incident bundles the storm froze: every one must attribute a
+    # DECLARED resource by name — a bundle naming nothing declared is
+    # evidence the capture path lost the cause. (Their existence is
+    # expected under chaos; only unattributed ones fail the gate.)
+    for h in doc.get("incidents", {}).get("headers", []):
+        trig = h.get("trigger") or {}
+        if not _declared_resource(trig.get("resource", "")):
+            failures.append(
+                f"unattributed incident: {h.get('id')} "
+                f"[{trig.get('kind')}] names undeclared resource "
+                f"{trig.get('resource')!r}")
     return failures
 
 
@@ -778,6 +830,9 @@ async def run_bench(args) -> Dict[str, Any]:
 
         workloads["spacedrop"] = await _spacedrop_offers(node, count=4)
 
+        workloads["fleet_giveup"] = await _fleet_giveup(node)
+        checkpoint("fleet_giveup")
+
         # Quiescence: disarm, let pumps drain, then the wedge check.
         chaos.disarm()
         await asyncio.sleep(0.3)
@@ -800,6 +855,17 @@ async def run_bench(args) -> Dict[str, Any]:
             "workloads": workloads,
             "counters": _counter_families(),
             "health_samples": samples,
+            # The black box's postmortem record of THIS storm: bundle
+            # headers + per-fingerprint dedup counts (the node's
+            # bootstrap installed the observatory; the full bundles
+            # stay in its store until the tmp dir drops).
+            "incidents": {
+                "enabled": node.incidents is not None,
+                "headers": node.incidents.list()
+                if node.incidents is not None else [],
+                "deduped": node.incidents.deduped()
+                if node.incidents is not None else {},
+            },
             "wedged_channels": _coalesce_wedges(),
             "violations": sanitize.violations(),
         }

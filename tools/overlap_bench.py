@@ -247,6 +247,14 @@ def main() -> int:
     )
 
     monitor = HealthMonitor()
+    # A loose in-memory black box for the sweep (no store directory:
+    # the index carries the bundles inline): anything that saturates
+    # mid-sweep freezes a bundle whose header rides the artifact.
+    from spacedrive_tpu import incidents as _incidents
+
+    own_obs = _incidents.current() is None
+    obs = _incidents.install(monitor=monitor, node_id="overlap-bench",
+                             node_name="overlap-bench")
     rows = run_sweep(depths, links, batch=args.batch,
                      batches=args.batches, file_size=args.file_size,
                      cheap_kernel=args.cheap_kernel, donate=donate,
@@ -269,7 +277,16 @@ def main() -> int:
             "states": hsnap["states"],
             "attribution": hsnap["attribution"],
         },
+        "incidents": {
+            "enabled": obs is not None,
+            "headers": obs.list() if obs is not None else [],
+            "deduped": obs.deduped() if obs is not None else {},
+        },
     }
+    if own_obs and obs is not None:
+        # This sweep installed the process-global observatory; detach
+        # it so an embedding caller's later install starts clean.
+        _incidents.uninstall()
     print(json.dumps(artifact))
     if args.json:
         with open(args.json, "w") as f:
@@ -288,6 +305,17 @@ def main() -> int:
         return 1
     if args.gate:
         bad = gate_failures(rows)
+        # Same discipline as load_bench's gate: a frozen bundle is
+        # fine (the sweep may genuinely saturate), but one whose
+        # trigger names nothing declared lost its cause.
+        from tools.load_bench import _declared_resource
+
+        for h in artifact["incidents"]["headers"]:
+            trig = h.get("trigger") or {}
+            if not _declared_resource(trig.get("resource", "")):
+                bad.append((trig.get("kind"), "-",
+                            "unattributed incident",
+                            trig.get("resource")))
         for link, depth, why, val in bad:
             print(f"GATE: link={link} depth={depth}: {why} ({val})",
                   file=sys.stderr)

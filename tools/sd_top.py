@@ -171,7 +171,7 @@ def render_fleet(view: dict, source: str = "", width: int = 110) -> str:
                f"interval={_fmt(view.get('interval_s'))}s")
     out.append("")
     out.append(f"{'NODE':<14} {'REACH':<7} {'AGE':<8} {'RTT':<9} "
-               f"{'SKEW':<10} ERROR")
+               f"{'SKEW':<10} {'INC':<7} ERROR")
     now = view.get("ts", time.time())
     for name, row in sorted(nodes.items(),
                             key=lambda kv: (not kv[1]["local"], kv[0])):
@@ -183,8 +183,14 @@ def render_fleet(view: dict, source: str = "", width: int = 110) -> str:
             if row.get("rtt_s") is not None else "-"
         skew = f"{row['skew_s'] * 1e3:+.1f}ms" \
             if row.get("skew_s") is not None else "-"
+        # Incident digest: open(unacked)/total frozen bundles on that
+        # node — the "which node has an untriaged postmortem" column.
+        incd = row.get("incidents") or {}
+        inc = (f"{incd.get('open', 0)}/{incd.get('total', 0)}"
+               if incd else "-")
         out.append(f"{name[:14]:<14} {reach:<7} {age:<8} {rtt:<9} "
-                   f"{skew:<10} {row.get('error') or ''}"[:width])
+                   f"{skew:<10} {inc:<7} "
+                   f"{row.get('error') or ''}"[:width])
     out.append("")
     out.append(f"{'NODE':<14} {'SUBSYSTEM':<10} {'STATE':<10} "
                "BOTTLENECK")
