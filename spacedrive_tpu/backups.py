@@ -17,6 +17,8 @@ import uuid as uuidlib
 import zipfile
 from typing import Dict, List
 
+from . import persist
+
 
 def backups_dir(data_dir: str) -> str:
     d = os.path.join(data_dir, "backups")
@@ -85,10 +87,16 @@ def restore_backup(node, backup_id: str) -> str:
             stale = db_path + suffix
             if os.path.exists(stale):
                 os.remove(stale)
-        with z.open("library.db") as src, open(db_path, "wb") as dst:
-            dst.write(src.read())
-        with z.open("library.sdlibrary") as src, \
-                open(os.path.join(base, f"{lib_id}.sdlibrary"), "wb") as dst:
-            dst.write(src.read())
+        # Two durable artifacts land here; restore is idempotent from
+        # the zip and ordered db-before-config, so a crash between the
+        # two never leaves a config pointing at an absent/old db that
+        # a re-run can't fix.
+        # sdlint: ok[crash-atomicity]
+        persist.atomic_write("library.db_image", db_path,
+                             z.read("library.db"))
+        persist.atomic_write(
+            "library.config",
+            os.path.join(base, f"{lib_id}.sdlibrary"),
+            z.read("library.sdlibrary"))
     node.libraries._load(lib_id)
     return str(lib_id)

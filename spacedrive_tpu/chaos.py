@@ -423,6 +423,17 @@ declare_fault(
     "socket the p2p.connect deadline must free.")
 
 declare_fault(
+    "persist.crashpoint", "persist.py crashpoint (every durability edge)",
+    ("delay",),
+    "One declared durability edge inside the persist write seam "
+    "(tmp-open / tmp-partial / tmp-full / fsync-file / renamed), "
+    "drawn between every two steps of every atomic/WAL artifact "
+    "write: a delay widens that window for racing killers, and "
+    "SDTPU_PERSIST_CRASHPOINT=<artifact>:<edge> turns the same edge "
+    "into a SIGKILL — how tools/crash_grid.py proves valid-or-absent "
+    "recovery at every edge systematically.")
+
+declare_fault(
     "stage.native.read", "ops/staging.py stage_batch_native",
     ("delay", "error", "corrupt"),
     "The native packed-staging seam, per ROW of a staged batch: error "

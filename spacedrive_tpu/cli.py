@@ -65,6 +65,10 @@ def _cmd_encrypt(args) -> int:
         return 1
     password = _password(args)  # prompt before the output file exists
     try:
+        # Streaming user output to a caller-chosen path (pre-checked
+        # absent above; partial output removed in the except below) —
+        # not durable node state.
+        # sdlint: ok[io-durability]
         with open(args.path, "rb") as fin, open(out, "wb") as fout:
             encrypt_file(fin, fout, password, metadata={"name": args.path})
     except (OSError, ValueError) as e:
@@ -91,6 +95,9 @@ def _cmd_decrypt(args) -> int:
         return 1
     password = _password(args)
     try:
+        # Same streaming-user-output shape as _cmd_encrypt: the
+        # caller owns the target.
+        # sdlint: ok[io-durability]
         with open(args.path, "rb") as fin, open(out, "wb") as fout:
             decrypt_file(fin, fout, password)
     except (OSError, ValueError) as e:

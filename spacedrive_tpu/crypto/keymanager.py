@@ -18,6 +18,7 @@ import uuid as uuidlib
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from .. import persist
 from ..ops.blake3_ref import derive_key
 from .hashing import HashingAlgorithm, Params, hash_password
 from .primitives import (
@@ -106,10 +107,8 @@ class KeyManager:
             "keys": [k.to_json() for k in self._stored.values()
                      if not k.memory_only],
         }
-        tmp = self._data_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self._data_path)
+        persist.atomic_write("crypto.keyring", self._data_path,
+                             json.dumps(state))
 
     # -- onboarding / unlock -------------------------------------------------
     @property

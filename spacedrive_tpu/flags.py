@@ -272,6 +272,25 @@ declare(
     "sd_incident_deduped_total instead of new bundles.")
 
 declare(
+    "SDTPU_FS_AUDIT", "auto", lambda v: v.strip().lower(),
+    "Runtime fs auditor (persist.py, armed with the sanitizer): "
+    "interposes os.replace/os.fsync, checks the fsync-file -> rename "
+    "-> fsync-dir ordering each declared artifact's policy promises, "
+    "and flags raw product-module renames outside the persist seam "
+    "(persist_undeclared_write / persist_unfsynced_rename — raised "
+    "in tier-1, counted in production). `off` skips arming (plain "
+    "os.replace/os.fsync, zero overhead); `auto` follows "
+    "SDTPU_SANITIZE. Read once at sanitize.install().")
+
+declare(
+    "SDTPU_PERSIST_CRASHPOINT", "", parse_str,
+    "`<artifact>:<edge>` — SIGKILL this process at the named "
+    "declared durability edge inside the persist seam "
+    "(persist.crashpoint). How tools/crash_grid.py children die at "
+    "every edge of every declared artifact systematically; empty "
+    "(default) disables the kill switch.")
+
+declare(
     "SDTPU_LOG_JSON", False, parse_flag1,
     "When on, a JSON-line formatter is installed on the "
     "`spacedrive_tpu` logger (tracing.install_json_logging): every "

@@ -395,12 +395,14 @@ def write_trace_artifact(path: str, node_name: str) -> List[str]:
     drift between overlap_bench, perf_smoke, and future tools."""
     import json
 
+    from . import persist
+
     doc = chrome_trace(node_name=node_name)
     problems = validate_chrome_trace(doc)
     if problems:
         return problems
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=1)
+    persist.atomic_write("flight.trace", path,
+                         json.dumps(doc, indent=1))
     return []
 
 

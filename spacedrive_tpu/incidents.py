@@ -65,7 +65,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import channels, chaos, flags, telemetry, tracing
+from . import channels, chaos, flags, persist, telemetry, tracing
 from .telemetry import (
     INCIDENTS_DEDUPED,
     INCIDENTS_DROPPED,
@@ -429,29 +429,28 @@ class IncidentObservatory:
 
     # -- the durable store --------------------------------------------------
 
-    def _write(self, bundle: Dict[str, Any]) -> Tuple[str, int]:
-        """WAL-style bundle write: full body into `<id>.json.tmp`,
-        then one atomic rename. A crash mid-write leaves a torn tmp
-        (discarded at recovery) or a complete tmp (promoted) — never a
-        torn `<id>.json`. The declared incidents.write chaos seam
-        widens both windows so the kill -9 test can land inside them."""
-        path = os.path.join(self.dir, f"{bundle['id']}.json")
-        tmp = path + ".tmp"
-        data = json.dumps(bundle, indent=1)
-        half = len(data) // 2
-        with open(tmp, "w") as f:
-            f.write(data[:half])
+    @staticmethod
+    def _chaos_window(edge: str) -> None:
+        """The declared incidents.write chaos seam, hooked into the
+        shared persist writer's edges: `tmp-partial` (half the body
+        flushed) is the torn-tmp window, `pre-rename` (complete,
+        fsynced, unrenamed) the complete-tmp window — a delay widens
+        either so the kill -9 test can land inside it."""
+        if edge in ("tmp-partial", "pre-rename"):
             fault = chaos.hit("incidents.write", only=("delay",))
             if fault is not None:
-                f.flush()
-                chaos.apply_sync(fault)    # torn-tmp window
-            f.write(data[half:])
-            f.flush()
-            os.fsync(f.fileno())
-        fault = chaos.hit("incidents.write", only=("delay",))
-        if fault is not None:
-            chaos.apply_sync(fault)        # complete-tmp window
-        os.replace(tmp, path)
+                chaos.apply_sync(fault)
+
+    def _write(self, bundle: Dict[str, Any]) -> Tuple[str, int]:
+        """WAL-style bundle write through the declared persist seam
+        (artifact `incidents.bundle`): full body into `<id>.json.tmp`,
+        fsync, then one atomic rename. A crash mid-write leaves a torn
+        tmp (discarded at recovery) or a complete tmp (promoted) —
+        never a torn `<id>.json`."""
+        path = os.path.join(self.dir, f"{bundle['id']}.json")
+        data = json.dumps(bundle, indent=1)
+        with persist.wal_writer("incidents.bundle") as write:
+            write(path, data, chaos_point=self._chaos_window)
         return path, len(data)
 
     def _on_index_evict(self, entry: Dict[str, Any]) -> None:
@@ -494,9 +493,10 @@ class IncidentObservatory:
         return os.path.join(self.dir, _MARKER)
 
     def _write_marker(self) -> None:
-        with open(self._marker_path(), "w") as f:
-            json.dump({"pid": os.getpid(), "ts": round(time.time(), 3),
-                       "node": dict(self.node_identity)}, f)
+        persist.atomic_write(
+            "incidents.marker", self._marker_path(),
+            json.dumps({"pid": os.getpid(), "ts": round(time.time(), 3),
+                        "node": dict(self.node_identity)}))
         atexit.register(self._atexit)
 
     def _atexit(self) -> None:
@@ -521,28 +521,19 @@ class IncidentObservatory:
                 os.unlink(marker)
             except OSError:
                 pass
+        def _complete(raw: bytes) -> bool:
+            # A tmp is promotable only when it parses AND passes the
+            # full bundle schema — a torn body fails either way.
+            return not validate_incident_bundle(json.loads(raw))
+
+        for path, outcome in persist.recover(
+                "incidents.bundle", self.dir, validate=_complete):
+            if outcome == "promoted" or path.endswith(".json.tmp"):
+                INCIDENTS_RECOVERED.labels(outcome=outcome).inc()
         entries = []
         for fn in sorted(os.listdir(self.dir)):
             path = os.path.join(self.dir, fn)
-            if fn.endswith(".json.tmp"):
-                outcome = "discarded"
-                try:
-                    with open(path) as f:
-                        doc = json.load(f)
-                    if not validate_incident_bundle(doc):
-                        os.replace(path, path[:-len(".tmp")])
-                        outcome = "promoted"
-                    else:
-                        os.unlink(path)
-                except (OSError, ValueError):
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
-                INCIDENTS_RECOVERED.labels(outcome=outcome).inc()
-                if outcome == "promoted":
-                    entries.append((doc, path[:-len(".tmp")]))
-            elif fn.endswith(".json"):
+            if fn.endswith(".json"):
                 try:
                     with open(path) as f:
                         doc = json.load(f)
@@ -615,10 +606,13 @@ class IncidentObservatory:
                 with open(path) as f:
                     doc = json.load(f)
                 doc["ack"] = True
-                tmp = path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(doc, f, indent=1)
-                os.replace(tmp, path)
+                # Read-modify-write outside _lock: the index header
+                # flip above (under _lock) is the authoritative state;
+                # this file rewrite is its durable shadow, and ack is
+                # idempotent per bundle id.
+                # sdlint: ok[crash-atomicity]
+                persist.atomic_write("incidents.bundle", path,
+                                     json.dumps(doc, indent=1))
             except (OSError, ValueError):
                 pass
         return True

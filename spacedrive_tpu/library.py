@@ -17,6 +17,7 @@ import uuid as uuidlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from . import persist
 from .locations.rules import seed_system_rules
 from .store.db import Database, uuid_bytes
 from .sync.manager import SyncManager
@@ -61,10 +62,9 @@ class Library:
         return bytes.fromhex(self.config.instance_id)
 
     def save_config(self) -> None:
-        tmp = self.config_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.config.to_json(), f, indent=2)
-        os.replace(tmp, self.config_path)
+        persist.atomic_write(
+            "library.config", self.config_path,
+            json.dumps(self.config.to_json(), indent=2))
 
     def statistics(self) -> dict:
         """library.statistics procedure data (api/libraries.rs:47)."""

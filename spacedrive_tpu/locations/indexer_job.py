@@ -18,6 +18,7 @@ import asyncio
 import time
 from typing import Any, Dict, List, Optional
 
+from .. import persist
 from ..jobs.job import EarlyFinish, JobContext, StatefulJob, StepOutcome, register_job
 from ..store import statements, uuid_bytes
 from .paths import IsolatedPath
@@ -327,6 +328,9 @@ class IndexerJob(StatefulJob):
                     (ctx.job_id, msgpack.packb(b, use_bin_type=True)),
                     conn=conn)
                 sids.append(cur.lastrowid)
+        # Declared DB-backed artifact: SQLite's WAL owns durability,
+        # this records the commit under the job.scratch name.
+        persist.db_write("job.scratch", rows=len(sids))
         return sids
 
     @staticmethod

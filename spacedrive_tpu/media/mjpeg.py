@@ -137,6 +137,9 @@ def write_mjpeg_avi(path: str, frames: List, fps: int = 10,
         b"00dc" + struct.pack("<III", 0x10, off, size)
         for off, size in index_entries)
     body = b"AVI " + hdrl + movi + chunk(b"idx1", idx1)
+    # Synthesized sample media for tests/benches at a caller-chosen
+    # path — corpus content, not durable node state.
+    # sdlint: ok[io-durability]
     with open(path, "wb") as f:
         f.write(b"RIFF" + struct.pack("<I", len(body)) + body)
     return os.path.abspath(path)

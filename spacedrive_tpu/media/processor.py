@@ -153,7 +153,7 @@ class MediaProcessorJob(StatefulJob):
             await batch.done.wait()
             data["thumbs"] += batch.generated
         else:
-            ensure_thumbnail_dir(data_dir)
+            await asyncio.to_thread(ensure_thumbnail_dir, data_dir)
             for cas_id, full in entries:
                 if await asyncio.to_thread(
                         generate_thumbnail, full, data_dir, cas_id):

@@ -11,9 +11,12 @@ webp encoder); batch resize can move on-device later — decode stays CPU.
 
 from __future__ import annotations
 
+import io
 import math
 import os
 from typing import Optional, Tuple
+
+from .. import persist
 
 TARGET_PX = 262144.0    # thumbnail/mod.rs:113
 TARGET_QUALITY = 30     # thumbnail/mod.rs:117
@@ -67,8 +70,8 @@ def ensure_thumbnail_dir(data_dir: str) -> str:
     os.makedirs(root, exist_ok=True)
     version_file = os.path.join(root, VERSION_FILE)
     if not os.path.exists(version_file):
-        with open(version_file, "w") as f:
-            f.write(str(THUMBNAIL_CACHE_VERSION))
+        persist.atomic_write("media.thumbs_version", version_file,
+                             str(THUMBNAIL_CACHE_VERSION))
     return root
 
 
@@ -97,9 +100,11 @@ def encode_webp(im, out_path: str,
     w, h = scale_dimensions(im.width, im.height, target_px)
     im = im.resize((w, h), Image.LANCZOS)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    tmp = out_path + ".tmp"
-    im.save(tmp, "WEBP", quality=TARGET_QUALITY)
-    os.replace(tmp, out_path)
+    # Encode to memory, commit through the declared seam: readers
+    # (api thumb serving) never see torn webp bytes.
+    buf = io.BytesIO()
+    im.save(buf, "WEBP", quality=TARGET_QUALITY)
+    persist.atomic_write("media.thumbnail", out_path, buf.getvalue())
     return out_path
 
 

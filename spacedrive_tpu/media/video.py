@@ -30,6 +30,7 @@ import subprocess
 from functools import lru_cache
 from typing import Optional
 
+from .. import persist
 from .thumbnail import TARGET_QUALITY, scale_dimensions
 
 SEEK_PERCENTAGE = 0.10  # thumbnailer.rs seek to 10%
@@ -271,7 +272,9 @@ def generate_video_thumbnail(input_path: str, out_path: str,
             capture_output=True, timeout=60, check=True)
         if not os.path.getsize(tmp):
             raise ValueError("empty frame")
-        os.replace(tmp, out_path)
+        # ffmpeg streamed the frame into the tmp; seal applies the
+        # declared atomic-replace tail so readers never see torn webp.
+        persist.seal("media.thumbnail", tmp, out_path)
         return out_path
     except Exception:
         try:

@@ -16,7 +16,7 @@ import time
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
 
-from . import flags, tasks, telemetry, tracing
+from . import flags, persist, tasks, telemetry, tracing
 from .fleet import FleetMonitor
 from .health import HealthMonitor
 from .jobs.manager import JobManager
@@ -121,10 +121,8 @@ class NodeConfig:
         return enabled
 
     def save(self) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.raw, f, indent=2)
-        os.replace(tmp, self.path)
+        persist.atomic_write("node.config", self.path,
+                             json.dumps(self.raw, indent=2))
 
 
 class OrphanRemover:

@@ -753,6 +753,9 @@ def make_sparse_corpus(root: str, n_files: int, file_size: int,
         for i in range(b0, min(b0 + batch, n_files)):
             p = os.path.join(root, f"f{i:07d}.bin")
             if not os.path.exists(p):
+                # Bench corpus filler (sparse truncate, no payload):
+                # scratch content, regenerated on demand.
+                # sdlint: ok[io-durability]
                 with open(p, "wb") as f:
                     f.truncate(file_size)
             paths.append(p)

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import cas, jit_registry
-from .. import channels, chaos, flags, tracing
+from .. import channels, chaos, flags, persist, tracing
 from ..flight import RECORDER
 from ..telemetry import (
     STAGE_BATCHES,
@@ -622,6 +622,11 @@ def _h2d_probe_key() -> Optional[str]:
         return None
 
 
+# Advisory last-writer-wins probe cache: a racing writer's value is
+# as good as ours (same link, same hour) and a torn LOGICAL state is
+# impossible — each write replaces the whole doc and a stale/invalid
+# doc is simply re-probed.
+# sdlint: ok[crash-atomicity]
 def h2d_gbps() -> float:
     """Measured host→device link bandwidth, probed once per process and
     cached on disk for an hour (the probe itself costs a round trip, and
@@ -681,9 +686,10 @@ def h2d_gbps() -> float:
         # Only successful probes are cached: a transient jax/device
         # failure must stay per-process, not poison an hour of runs.
         try:
-            with open(cache, "w") as f:
-                json.dump({"t": time.time(), "gbps": _H2D_GBPS,
-                           "key": key}, f)
+            persist.atomic_write(
+                "stage.h2d_cache", cache,
+                json.dumps({"t": time.time(), "gbps": _H2D_GBPS,
+                            "key": key}))
         except OSError:
             pass
     return _H2D_GBPS

@@ -54,6 +54,14 @@ engine actually depends on:
   outside an open tx() is `sql_autocommit_write` — raised in tier-1,
   counted into `sd_sql_undeclared_total`/`sd_sanitize_violations_total`
   in production.
+- **Fs auditor** (round 19, armed via `persist.arm()` at install
+  unless `SDTPU_FS_AUDIT=off` — the runtime twin of sdlint's
+  io-durability / crash-atomicity passes): os.replace/os.fsync are
+  interposed; a raw product-module rename outside the declared
+  persist seam is `persist_undeclared_write`, and a rename whose
+  source was never fsynced against the artifact's declared policy is
+  `persist_unfsynced_rename` — raised in tier-1, counted into
+  `sd_persist_violations_total{kind}` in production.
 - **Cross-thread race recorder** (round 13, armed via
   `threadctx.arm()` at install unless `SDTPU_RACE_GUARD=off` — the
   runtime twin of sdlint's shared-mutation / thread-boundary /
@@ -416,6 +424,15 @@ def install() -> bool:
     from .store import sqlaudit
 
     sqlaudit.arm(_mode, _record)
+    # Arm the durability twin: the fs auditor interposes
+    # os.replace/os.fsync and judges every rename against the persist
+    # registry's declared fsync policies — breaches flow through
+    # _record as `persist_undeclared_write` / `persist_unfsynced_`
+    # `rename`. SDTPU_FS_AUDIT=off skips the wrap (persist checks it
+    # — read once, at install).
+    from . import persist
+
+    persist.arm(_mode, _record)
     _installed = True
     return True
 
@@ -443,4 +460,7 @@ def uninstall() -> None:
     from .store import sqlaudit
 
     sqlaudit.disarm()
+    from . import persist
+
+    persist.disarm()
     _installed = False

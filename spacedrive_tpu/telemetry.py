@@ -731,7 +731,8 @@ SANITIZE_VIOLATIONS = counter(
     "loop_stall | lock_across_await | lock_order_cycle | "
     "jit_retrace_budget | host_transfer | task_exception | "
     "task_orphaned | chan_overflow | data_race | sql_undeclared | "
-    "sql_autocommit_write",
+    "sql_autocommit_write | persist_undeclared_write | "
+    "persist_unfsynced_rename",
     labelnames=("kind",))
 SANITIZE_LOOP_MAX_STALL = gauge(
     "sd_sanitize_loop_max_stall_seconds",
@@ -923,3 +924,26 @@ INCIDENT_STORE_BYTES = gauge(
     "Bytes of bundle JSON currently held by the on-disk incidents "
     "store, enforced below SDTPU_INCIDENT_STORE_MB by oldest-first "
     "eviction")
+
+# -- persistence plane (persist.py) ------------------------------------------
+PERSIST_WRITES = counter(
+    "sd_persist_writes_total",
+    "Durable writes committed through the declared persistence seam "
+    "(persist.py registry), per artifact name — atomic/WAL file "
+    "commits, sealed streams, scratch acquisitions, and DB-backed "
+    "append commits all count here",
+    labelnames=("name",))
+PERSIST_FSYNC_SECONDS = histogram(
+    "sd_persist_fsync_seconds",
+    "Latency of fsync calls issued by the persist seam (file fsyncs "
+    "before rename, directory fsyncs after) — slow-disk weather on "
+    "the durability path shows up here before it shows up as job "
+    "latency")
+PERSIST_VIOLATIONS = counter(
+    "sd_persist_violations_total",
+    "Fs-auditor detections (persist.arm, with the sanitizer), by "
+    "kind: persist_undeclared_write (raw os.replace from a product "
+    "module outside the seam) | persist_unfsynced_rename (rename "
+    "with no preceding file fsync against the artifact's declared "
+    "policy) — raised in tier-1, counted in production",
+    labelnames=("kind",))

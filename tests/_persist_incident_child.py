@@ -1,0 +1,32 @@
+"""Crash-grid child for the INCIDENT STORE product path: write `n`
+incident bundles through the real IncidentObservatory pipeline. The
+parent sets `SDTPU_PERSIST_CRASHPOINT=incidents.bundle:<edge>` so the
+persist seam SIGKILLs this process at that exact durability edge of
+the first bundle write; the parent then re-opens the store (running
+its boot-time recovery) and asserts every surviving bundle is
+valid-or-absent. argv: <store_dir> <n>."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu.incidents import IncidentObservatory  # noqa: E402
+
+
+def main() -> int:
+    store_dir, n = sys.argv[1], int(sys.argv[2])
+    obs = IncidentObservatory(dir_path=store_dir, node_id="pc",
+                              node_name="persist-crash")
+    print("WRITING", flush=True)
+    for i in range(n):
+        # unique resources -> distinct fingerprints -> one bundle each
+        obs.observe_give_up(f"obs.http.r{i}", 3)
+    obs.close()
+    print(f"DONE {n}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

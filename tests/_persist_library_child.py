@@ -1,0 +1,30 @@
+"""Crash-grid child for the LIBRARY CONFIG product path: create one
+library through the real Libraries.create (db seed + instance row +
+`<uuid>.sdlibrary` config save). The parent sets
+`SDTPU_PERSIST_CRASHPOINT=library.config:<edge>` so the persist seam
+SIGKILLs this process at that durability edge of the config write; the
+parent then boots a fresh Libraries over the same data dir and asserts
+the library is either fully loadable or cleanly absent.
+argv: <data_dir>."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu.library import Libraries  # noqa: E402
+
+
+def main() -> int:
+    data_dir = sys.argv[1]
+    libs = Libraries(data_dir)
+    print("WRITING", flush=True)
+    lib = libs.create("crash-grid-library")
+    lib.db.close()
+    print(f"DONE {lib.id}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Crash-grid child for the JOB-SCRATCH SPOOL product path: spool
+row batches into `job_scratch` through the real statement registry —
+one write_tx per batch, exactly the indexer's _spool shape — until the
+parent SIGKILLs this process mid-stream. `job.scratch` is a DB-backed
+`append` artifact (fsync DELEGATED to SQLite's WAL), so the recovery
+contract is all-or-nothing PER TRANSACTION: after any kill the
+surviving row count must be an exact multiple of the batch size.
+argv: <db_path> <n_tx> <rows_per_tx>."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spacedrive_tpu import persist  # noqa: E402
+from spacedrive_tpu.store.db import Database  # noqa: E402
+
+
+def main() -> int:
+    db_path, n_tx, rows = (sys.argv[1], int(sys.argv[2]),
+                           int(sys.argv[3]))
+    db = Database(db_path)
+    job_id = b"persist-spool-job"
+    if db.run("jobs.report.by_id", (job_id,)) is None:
+        db.insert("job", {"id": job_id, "name": "spool-crash",
+                          "status": 0})
+    print("WRITING", flush=True)
+    payload = b"x" * 512
+    for _ in range(n_tx):
+        with db.write_tx() as conn:
+            for _ in range(rows):
+                db.run("jobs.scratch.insert", (job_id, payload),
+                       conn=conn)
+        persist.db_write("job.scratch", rows=rows)
+        # Pace the stream so the parent's SIGKILL deterministically
+        # lands MID-SPOOL (between txs, or inside one on a slow fs).
+        time.sleep(0.002)
+    db.close()
+    print(f"DONE {n_tx * rows}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

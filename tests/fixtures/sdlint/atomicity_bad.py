@@ -1,0 +1,27 @@
+# sdlint-scope: persist
+"""crash-atomicity known-POSITIVES (scope opted in above)."""
+
+import json
+
+from spacedrive_tpu import persist
+
+
+def restore_pair(cfg_path, node_path, doc):
+    # two artifacts, no declared ordering -> multi-commit
+    persist.atomic_write("library.config", cfg_path, doc)
+    persist.atomic_write("node.config", node_path, doc)
+
+
+class Creator:
+    def create(self, db, cfg_path, doc):
+        # artifact + DB row -> multi-commit
+        db.insert("library", {"pub_id": b"x"})
+        persist.atomic_write("library.config", cfg_path, doc)
+
+
+def bump_generation(path):
+    # read-modify-write with no lock -> rmw-unguarded
+    with open(path) as f:
+        doc = json.load(f)
+    doc["generation"] = doc.get("generation", 0) + 1
+    persist.atomic_write("crypto.keyring", path, json.dumps(doc))

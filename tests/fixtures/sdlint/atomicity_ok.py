@@ -1,0 +1,27 @@
+# sdlint-scope: persist
+"""crash-atomicity known-NEGATIVES."""
+
+import json
+import threading
+
+from spacedrive_tpu import persist
+
+_lock = threading.Lock()
+
+
+def single_commit(path, doc):
+    persist.atomic_write("node.config", path, json.dumps(doc))
+
+
+def same_artifact_twice(old_path, new_path, doc):
+    # one NAME = one recovery story; two paths of it are fine
+    persist.atomic_write("library.config", old_path, doc)
+    persist.atomic_write("library.config", new_path, doc)
+
+
+def guarded_bump(path):
+    with _lock:
+        with open(path) as f:
+            doc = json.load(f)
+        doc["generation"] = doc.get("generation", 0) + 1
+        persist.atomic_write("crypto.keyring", path, json.dumps(doc))

@@ -1,0 +1,28 @@
+# sdlint-scope: persist
+"""io-durability known-POSITIVES (scope opted in above)."""
+
+import json
+import os
+
+from spacedrive_tpu import persist
+
+
+def bare_config_save(path, doc):
+    with open(path, "w") as f:          # bare-write
+        json.dump(doc, f)
+
+
+def promote_by_rename(src, dst):
+    os.rename(src, dst)                 # rename-no-tmp (no tmp token)
+
+
+def replace_without_flush(doc_tmp, doc):
+    os.replace(doc_tmp, doc)            # replace-no-fsync (tmp ok)
+
+
+def writes_unknown_artifact(path):
+    persist.atomic_write("nope.not_declared", path, b"x")
+
+
+def writes_computed_name(which, path):
+    persist.atomic_write(f"cfg.{which}", path, b"x")  # artifact-dynamic

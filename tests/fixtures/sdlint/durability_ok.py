@@ -1,0 +1,25 @@
+# sdlint-scope: persist
+"""io-durability known-NEGATIVES: the blessed write shapes."""
+
+import json
+import os
+
+from spacedrive_tpu import persist
+
+
+def declared_save(path, doc):
+    persist.atomic_write("library.config", path, json.dumps(doc))
+
+
+def sealed_stream(part_path, target):
+    persist.seal("object.sealed", part_path, target)
+
+
+def read_only(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def flushed_replace(doc_tmp, doc, fd):
+    os.fsync(fd)
+    os.replace(doc_tmp, doc)            # fsync present, tmp source

@@ -1,0 +1,26 @@
+"""tmp-hygiene known-POSITIVES."""
+
+import os
+import shutil
+import tempfile
+
+
+def forgets_entirely(n):
+    tmp = tempfile.mkdtemp(prefix="leaky-")     # tmp-no-cleanup
+    for i in range(n):
+        with open(os.path.join(tmp, f"{i}.bin"), "wb") as f:
+            f.write(b"x")
+    return tmp
+
+
+def happy_path_only(build):
+    tmp = tempfile.mkdtemp(prefix="fragile-")   # tmp-leak-on-error
+    build(tmp)                                  # a raise here leaks
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def keeps_named_file(data):
+    f = tempfile.NamedTemporaryFile(delete=False)  # tmp-no-cleanup
+    f.write(data)
+    f.close()
+    return f.name

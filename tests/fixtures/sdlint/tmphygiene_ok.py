@@ -1,0 +1,29 @@
+"""tmp-hygiene known-NEGATIVES: cleanup by construction."""
+
+import shutil
+import tempfile
+
+from spacedrive_tpu import persist
+
+
+def guarded(build):
+    tmp = tempfile.mkdtemp(prefix="guarded-")
+    try:
+        build(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def context_managed(build):
+    with tempfile.TemporaryDirectory() as tmp:
+        build(tmp)
+
+
+def declared_scratch(build):
+    with persist.scratch("bench.workdir") as tmp:
+        build(tmp)
+
+
+def auto_deleting_file(data):
+    with tempfile.NamedTemporaryFile() as f:    # delete=True default
+        f.write(data)

@@ -1,0 +1,210 @@
+"""The crash grid, as a tier-1 gate.
+
+Two layers:
+
+- THE FULL GRID (tools/crash_grid.py --json): one subprocess child
+  SIGKILLed at EVERY declared durability edge of EVERY atomic/wal
+  artifact in the persist registry, recovery asserted valid-or-absent
+  per cell. Systematic, not sampled — a new declaration is covered the
+  moment it lands, with zero new test code.
+- PRODUCT-PATH ROUNDS: the generic grid proves the persist SEAM; these
+  rounds prove the real call sites sit on it. A child runs the actual
+  incident-store / library-create / job-scratch-spool code with
+  `SDTPU_PERSIST_CRASHPOINT=<artifact>:<edge>` armed, dies at that
+  exact edge, and the parent re-runs the site's own boot-time recovery
+  and asserts the declared story: bundles promote-or-discard, library
+  configs are loadable-or-absent, spool rows land all-or-nothing per
+  transaction.
+
+Subprocess + SIGKILL shape follows test_group_crash.py."""
+
+import json
+import os
+import signal
+import sqlite3
+import subprocess
+import sys
+import time
+
+import pytest
+
+from spacedrive_tpu import persist
+from spacedrive_tpu.incidents import (
+    IncidentObservatory,
+    validate_incident_bundle,
+)
+from spacedrive_tpu.library import Libraries
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+GRID = os.path.join(ROOT, "tools", "crash_grid.py")
+INCIDENT_CHILD = os.path.join(HERE, "_persist_incident_child.py")
+LIBRARY_CHILD = os.path.join(HERE, "_persist_library_child.py")
+SPOOL_CHILD = os.path.join(HERE, "_persist_spool_child.py")
+
+SIGKILLED = -signal.SIGKILL
+
+
+def _child_env(crashpoint=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "SDTPU_SANITIZE": "1",
+                "SDTPU_SANITIZE_MODE": "raise"})
+    env.pop("SDTPU_PERSIST_CRASHPOINT", None)
+    if crashpoint is not None:
+        env["SDTPU_PERSIST_CRASHPOINT"] = crashpoint
+    return env
+
+
+def _run_child(script, args, crashpoint=None, timeout=120):
+    return subprocess.run(
+        [sys.executable, script, *[str(a) for a in args]],
+        cwd=ROOT, env=_child_env(crashpoint),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=timeout)
+
+
+def _assert_no_tmp(directory):
+    residue = [n for n in os.listdir(directory) if n.endswith(".tmp")]
+    assert not residue, f"tmp residue survived recovery: {residue}"
+
+
+# -- the full grid ----------------------------------------------------------
+
+def test_full_grid_passes():
+    """Every declared atomic/wal artifact recovers valid-or-absent at
+    every one of its durability edges — the acceptance gate itself."""
+    proc = subprocess.run(
+        [sys.executable, GRID, "--json", "-", "--parallel", "8"],
+        cwd=ROOT, env=_child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=480)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["pass"] is True
+    assert doc["failures"] == []
+    edged = sorted(n for n in persist.ARTIFACTS
+                   if persist.edges_for(n))
+    assert doc["artifacts"] == edged
+    # every edge killed once + one unkilled control per artifact
+    want_cells = sum(len(persist.edges_for(n)) + 1 for n in edged)
+    assert doc["cells"] == want_cells
+    assert doc["kills"] == want_cells - len(edged)
+
+
+# -- product paths ----------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "edge", [*persist.edges_for("incidents.bundle"), None])
+def test_incident_store_recovers_at_every_edge(tmp_path, edge):
+    store = str(tmp_path / "incidents")
+    cp = f"incidents.bundle:{edge}" if edge else None
+    proc = _run_child(INCIDENT_CHILD, [store, 4], crashpoint=cp)
+    if edge is None:
+        assert proc.returncode == 0, proc.stdout
+        assert "DONE 4" in proc.stdout
+    else:
+        assert proc.returncode == SIGKILLED, (
+            f"edge {edge}: expected SIGKILL, got "
+            f"rc={proc.returncode}: {proc.stdout}")
+
+    # The store's own boot path: _recover() promotes complete tmps,
+    # discards torn ones, then the surviving crash marker becomes a
+    # `crash` bundle — all before we look.
+    obs = IncidentObservatory(dir_path=store, node_id="t",
+                              node_name="grid-parent")
+    try:
+        _assert_no_tmp(store)
+        headers = obs.list()
+        for h in headers:
+            full = obs.get(h["id"])
+            assert full is not None, h["id"]
+            assert validate_incident_bundle(full) == [], h["id"]
+        kinds = [h["trigger"]["kind"] for h in headers]
+        if edge in ("tmp-full", "fsync-file", "renamed"):
+            # wal promote edges: the killed write must SURVIVE
+            assert any(k != "crash" for k in kinds), (
+                f"edge {edge}: complete bundle was not promoted "
+                f"(kinds: {kinds})")
+    finally:
+        obs.close()
+
+
+@pytest.mark.parametrize(
+    "edge", [*persist.edges_for("library.config"), None])
+def test_library_create_recovers_at_every_edge(tmp_path, edge):
+    data_dir = str(tmp_path / "node")
+    cp = f"library.config:{edge}" if edge else None
+    proc = _run_child(LIBRARY_CHILD, [data_dir], crashpoint=cp)
+    if edge is None:
+        assert proc.returncode == 0, proc.stdout
+    else:
+        assert proc.returncode == SIGKILLED, (
+            f"edge {edge}: expected SIGKILL, got "
+            f"rc={proc.returncode}: {proc.stdout}")
+
+    lib_dir = os.path.join(data_dir, "libraries")
+    swept = persist.recover("library.config", lib_dir)
+    assert all(o == "discarded" for _, o in swept)  # atomic kind
+    _assert_no_tmp(lib_dir)
+
+    libs = Libraries(data_dir)
+    libs.init()  # torn config would raise right here
+    loaded = libs.list()
+    try:
+        if edge in ("renamed", None):
+            # config fully written and renamed before the kill
+            assert len(loaded) == 1
+            assert loaded[0].config.name == "crash-grid-library"
+        else:
+            # old-or-new with no old: cleanly ABSENT (orphan .db is
+            # inert residue; the load filter never looks at it)
+            assert loaded == []
+    finally:
+        for lib in loaded:
+            lib.db.close()
+
+
+def test_spool_rows_land_all_or_nothing_across_kills(tmp_path):
+    """job.scratch (`append`, fsync delegated to SQLite WAL): SIGKILL
+    the spooling child mid-stream, reopen cold, and require the row
+    count to be an exact multiple of the batch size — no half-spooled
+    step descriptors — and monotone across rounds."""
+    db_path = str(tmp_path / "lib.db")
+    rows_per_tx = 8
+
+    def _count():
+        conn = sqlite3.connect(db_path, timeout=30.0)
+        try:
+            return conn.execute(
+                "SELECT COUNT(*) FROM job_scratch").fetchone()[0]
+        finally:
+            conn.close()
+
+    prev = 0
+    for round_no in range(3):
+        child = subprocess.Popen(
+            [sys.executable, SPOOL_CHILD, db_path, "2000",
+             str(rows_per_tx)],
+            cwd=ROOT, env=_child_env(), stdout=subprocess.PIPE,
+            text=True)
+        try:
+            assert child.stdout.readline().startswith("WRITING")
+            time.sleep(0.15 + 0.1 * round_no)
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait(timeout=10)
+        finally:
+            if child.poll() is None:  # pragma: no cover
+                child.kill()
+        assert child.returncode == SIGKILLED
+        n = _count()
+        assert n % rows_per_tx == 0, (
+            f"round {round_no}: {n} rows — a spool tx half-committed "
+            "across the kill")
+        assert n >= prev, f"committed spool regressed {prev} -> {n}"
+        prev = n
+
+    # Unkilled control over the same (storm-recovered) DB: still
+    # writable, still all-or-nothing.
+    proc = _run_child(SPOOL_CHILD, [db_path, 20, rows_per_tx])
+    assert proc.returncode == 0, proc.stdout
+    final = _count()
+    assert final == prev + 20 * rows_per_tx

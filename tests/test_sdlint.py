@@ -636,7 +636,8 @@ def test_every_registered_pass_ran_on_tree():
         "cancellation-safety", "timeout-discipline",
         "queue-discipline", "backpressure", "unbounded-growth",
         "shared-mutation", "thread-boundary", "guard-consistency",
-        "sql-discipline", "tx-shape", "schema-parity"}
+        "sql-discipline", "tx-shape", "schema-parity",
+        "io-durability", "crash-atomicity", "tmp-hygiene"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -1073,3 +1074,104 @@ def test_every_declared_statement_is_referenced():
                    if d.shape and n not in matched_shapes
                    and n not in engine_bound]
     assert not dead_shapes, f"shapes matching no call site: {dead_shapes}"
+
+
+# -- io-durability / crash-atomicity / tmp-hygiene (round 19) ---------------
+
+def test_io_durability_flags_known_positives():
+    found = _lint_fixture("durability_bad.py", "io-durability")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.qual)
+    assert "bare_config_save" in by_code.get("bare-write", set())
+    assert "promote_by_rename" in by_code.get("rename-no-tmp", set())
+    assert "replace_without_flush" in \
+        by_code.get("replace-no-fsync", set())
+    assert "writes_unknown_artifact" in \
+        by_code.get("artifact-undeclared", set())
+    assert "writes_computed_name" in \
+        by_code.get("artifact-dynamic", set())
+
+
+def test_io_durability_passes_known_negatives():
+    assert _lint_fixture("durability_ok.py", "io-durability") == []
+
+
+def test_crash_atomicity_flags_known_positives():
+    found = _lint_fixture("atomicity_bad.py", "crash-atomicity")
+    multi = {f.qual for f in found if f.code == "multi-commit"}
+    assert "restore_pair" in multi
+    assert "Creator.create" in multi       # artifact + DB row
+    rmw = {f.qual for f in found if f.code == "rmw-unguarded"}
+    assert "bump_generation" in rmw
+
+
+def test_crash_atomicity_passes_known_negatives():
+    assert _lint_fixture("atomicity_ok.py", "crash-atomicity") == []
+
+
+def test_tmp_hygiene_flags_known_positives():
+    found = _lint_fixture("tmphygiene_bad.py", "tmp-hygiene")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.qual)
+    assert {"forgets_entirely", "keeps_named_file"} <= \
+        by_code.get("tmp-no-cleanup", set())
+    assert "happy_path_only" in by_code.get("tmp-leak-on-error", set())
+
+
+def test_tmp_hygiene_passes_known_negatives():
+    assert _lint_fixture("tmphygiene_ok.py", "tmp-hygiene") == []
+
+
+def test_cli_artifact_table_covers_every_declared_artifact(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--artifact-table"]) == 0
+    out = capsys.readouterr().out
+    from spacedrive_tpu import persist
+
+    for name in persist.ARTIFACTS:
+        assert f"`{name}`" in out
+    for a in persist.ARTIFACTS.values():
+        assert a.kind in out and a.fsync in out
+
+
+def test_persist_registry_static_runtime_parity():
+    """Registry↔usage drift, both directions: every persist call site
+    with a literal artifact name references a DECLARED artifact, and
+    every declared artifact is WRITTEN (or swept) somewhere in the
+    product/tools tree — no dead declarations, no shadow artifacts."""
+    import ast
+
+    from spacedrive_tpu import persist
+    from tools.sdlint.passes.io_durability import (NAMED_APIS,
+                                                   declared_artifacts)
+
+    static = declared_artifacts(ROOT)
+    assert set(static) == set(persist.ARTIFACTS), (
+        "the AST view of declare_artifact() calls must match the "
+        "imported registry")
+
+    project = load_project(ROOT)
+    referenced = set()
+    for src in project.files:
+        if src.relpath == "spacedrive_tpu/persist.py":
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            from tools.sdlint.core import dotted
+
+            d = dotted(node.func)
+            if d is None or d.rsplit(".", 1)[-1] not in NAMED_APIS:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                referenced.add(node.args[0].value)
+    undeclared = referenced - set(persist.ARTIFACTS)
+    assert not undeclared, (
+        f"persist call sites name undeclared artifacts: {undeclared}")
+    dead = set(persist.ARTIFACTS) - referenced
+    assert not dead, (
+        f"declared artifacts never written anywhere: {dead}")

@@ -1,0 +1,232 @@
+"""crash_grid — SIGKILL the persist seam at EVERY declared edge.
+
+The durability registry (spacedrive_tpu/persist.py) declares, per
+artifact, the exact edges one write passes: tmp-open → tmp-partial →
+tmp-full → [fsync-file] → renamed. This harness holds that contract to
+account the only way that counts: for every (artifact, edge) in the
+declared grid it seeds a committed payload A, spawns a CHILD process
+that writes payload B with `SDTPU_PERSIST_CRASHPOINT=<name>:<edge>`
+exported — the persist crashpoint seam SIGKILLs the child mid-write at
+precisely that edge — then runs the artifact's declared recovery and
+asserts the survivor is VALID-OR-ABSENT-OF-TEARING:
+
+- killed before the tmp is complete (tmp-open, tmp-partial): the
+  committed A must still be there, byte-identical;
+- killed with a complete tmp (tmp-full, fsync-file): `atomic`
+  artifacts must still read A (residue discarded), `wal` artifacts
+  must read B (complete tmp PROMOTED by recover — that is the WAL
+  contract);
+- killed after the rename (renamed): B, both kinds;
+- after recovery, zero `*.tmp` residue remains;
+- a CONTROL child with no crashpoint set must exit 0 and commit B.
+
+A failure in any cell names the artifact, the edge, and what was
+found instead. `--json [PATH|-]` emits the whole grid as a BENCH-style
+artifact (written through the persist seam, naturally); the exit code
+gates (0 iff every cell passed) so tests/test_crash_grid.py can wire
+the full grid into tier-1.
+
+Usage:
+    python tools/crash_grid.py [--json [PATH|-]] [--parallel N]
+    python tools/crash_grid.py --child <artifact> <path> <payload>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Padded so a half-flushed tmp (the tmp-partial window) is torn JSON,
+# never a prefix that happens to parse.
+_PAD = "x" * 256
+
+
+def _payload(v: str) -> bytes:
+    return json.dumps({"v": v, "pad": _PAD}).encode()
+
+
+def _decode(raw: bytes) -> Optional[str]:
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    return doc.get("v") if isinstance(doc, dict) else None
+
+
+def _validate(raw: bytes) -> bool:
+    return _decode(raw) in ("A", "B")
+
+
+def child_main(name: str, path: str, payload: str) -> int:
+    """One write of `payload` under artifact `name` — the process the
+    parent kills at a declared edge (or lets finish, as the control)."""
+    from spacedrive_tpu import persist
+
+    # The grid driver is THE sanctioned dynamic consumer: it
+    # iterates the registry itself, so the static-name rule is
+    # what it exists to exercise, not to obey.
+    # sdlint: ok[io-durability]
+    persist.atomic_write(name, path, _payload(payload))
+    return 0
+
+
+def _expected(kind: str, edge: str) -> Tuple[str, ...]:
+    """Which payloads may legally survive a kill at `edge` + recovery."""
+    if edge in ("tmp-open", "tmp-partial"):
+        return ("A",)                   # torn tmp discarded, A committed
+    if edge == "renamed":
+        return ("B",)                   # rename happened before the kill
+    # complete tmp (tmp-full / fsync-file): WAL promotes, atomic discards
+    return ("B",) if kind == "wal" else ("A",)
+
+
+def _spawn(name: str, path: str, payload: str,
+           crashpoint: Optional[str]) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("SDTPU_PERSIST_CRASHPOINT", None)
+    if crashpoint:
+        env["SDTPU_PERSIST_CRASHPOINT"] = crashpoint
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--child", name, path, payload],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=60)
+
+
+def run_round(name: str, kind: str, edge: Optional[str],
+              round_dir: str) -> Dict:
+    """One grid cell: seed A, kill a child writing B at `edge` (or run
+    the control to completion), recover, judge the survivor."""
+    from spacedrive_tpu import persist
+
+    os.makedirs(round_dir)
+    path = os.path.join(round_dir, "artifact.json")
+    persist.atomic_write(name, path, _payload("A"))  # committed seed
+
+    problems: List[str] = []
+    if edge is None:
+        proc = _spawn(name, path, "B", None)
+        if proc.returncode != 0:
+            problems.append(
+                f"control child exited {proc.returncode} "
+                f"(stderr: {proc.stderr.strip()[-200:]})")
+        want: Tuple[str, ...] = ("B",)
+    else:
+        proc = _spawn(name, path, "B", f"{name}:{edge}")
+        if proc.returncode != -9:
+            problems.append(
+                f"child survived the {edge} crashpoint "
+                f"(rc={proc.returncode}) — the kill seam did not fire")
+        want = _expected(kind, edge)
+
+    # sdlint: ok[io-durability]
+    recovered = persist.recover(name, round_dir, validate=_validate)
+    residue = [fn for fn in os.listdir(round_dir) if fn.endswith(".tmp")]
+    if residue:
+        problems.append(f"tmp residue survived recovery: {residue}")
+
+    if not os.path.exists(path):
+        problems.append(
+            "artifact ABSENT after recovery — the committed seed was "
+            "lost (rename tore the old copy away without the new)")
+        found = None
+    else:
+        with open(path, "rb") as f:
+            found = _decode(f.read())
+        if found not in ("A", "B"):
+            problems.append(
+                f"artifact TORN after recovery (payload {found!r})")
+        elif found not in want:
+            problems.append(
+                f"expected {'/'.join(want)} after kill at {edge}, "
+                f"found {found}")
+    return {
+        "artifact": name, "kind": kind,
+        "edge": edge or "control", "found": found,
+        "recovered": recovered, "problems": problems,
+    }
+
+
+def build_grid() -> List[Tuple[str, str, Optional[str]]]:
+    from spacedrive_tpu import persist
+
+    cells: List[Tuple[str, str, Optional[str]]] = []
+    for name in sorted(persist.ARTIFACTS):
+        edges = persist.edges_for(name)  # sdlint: ok[io-durability]
+        if not edges:
+            continue  # append (SQLite WAL owns it) / scratch (removed)
+        kind = persist.ARTIFACTS[name].kind
+        for edge in edges:
+            cells.append((name, kind, edge))
+        cells.append((name, kind, None))  # control
+    return cells
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/crash_grid.py",
+        description="kill -9 the persist seam at every declared "
+                    "durability edge; assert valid-or-absent recovery")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="PATH",
+                    help="emit the grid as a JSON artifact "
+                         "(default '-': stdout)")
+    ap.add_argument("--parallel", type=int, default=8,
+                    help="concurrent kill children (default 8)")
+    ap.add_argument("--child", nargs=3,
+                    metavar=("ARTIFACT", "PATH", "PAYLOAD"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child_main(*args.child)
+
+    from spacedrive_tpu import persist
+
+    cells = build_grid()
+    rounds: List[Dict] = []
+    with persist.scratch("bench.workdir") as root:
+        with ThreadPoolExecutor(max_workers=max(1, args.parallel)) as ex:
+            futs = [
+                ex.submit(run_round, name, kind, edge,
+                          os.path.join(root, f"r{i:03d}"))
+                for i, (name, kind, edge) in enumerate(cells)]
+            rounds = [f.result() for f in futs]
+
+    failures = [
+        f"{r['artifact']}@{r['edge']}: {p}"
+        for r in rounds for p in r["problems"]]
+    doc = {
+        "metric": "crash_grid",
+        "artifacts": sorted({r["artifact"] for r in rounds}),
+        "cells": len(rounds),
+        "kills": sum(1 for r in rounds if r["edge"] != "control"),
+        "failures": failures,
+        "pass": not failures,
+        "rounds": rounds,
+    }
+    if args.json == "-":
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        persist.atomic_write("bench.artifact", args.json,
+                             json.dumps(doc, indent=1))
+    summary = (f"crash_grid: {doc['cells']} cells "
+               f"({doc['kills']} kills) over "
+               f"{len(doc['artifacts'])} artifacts — "
+               + ("PASS" if doc["pass"] else
+                  f"{len(failures)} FAILURE(S)"))
+    print(summary, file=sys.stderr)
+    for f in failures:
+        print(f"  {f}", file=sys.stderr)
+    return 0 if doc["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -929,8 +929,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.json == "-":
             print(payload)
         else:
-            with open(args.json, "w") as f:
-                f.write(payload + "\n")
+            from spacedrive_tpu import persist
+
+            persist.atomic_write("bench.artifact", args.json,
+                                 payload + "\n")
     summary = {w: {k: v for k, v in row.items()
                    if not isinstance(v, (list, dict))}
                for w, row in doc["workloads"].items()

@@ -289,8 +289,10 @@ def main() -> int:
         _incidents.uninstall()
     print(json.dumps(artifact))
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump(artifact, f, indent=1)
+        from spacedrive_tpu import persist
+
+        persist.atomic_write("bench.artifact", args.json,
+                             json.dumps(artifact, indent=1))
     if args.trace:
         from spacedrive_tpu import flight
 

@@ -101,6 +101,9 @@ def main(argv=None) -> int:
     ap.add_argument("--sql-table", action="store_true",
                     help="print the generated SQL statement-contract "
                          "table (the store's read/write seam) and exit")
+    ap.add_argument("--artifact-table", action="store_true",
+                    help="print the generated durable-artifact "
+                         "registry table (the persist seam) and exit")
     ap.add_argument("--stats", action="store_true",
                     help="per-pass finding counts and wall-time "
                          "(informational; exit 0)")
@@ -156,6 +159,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu.store import statements
         print(statements.sql_table_markdown())
+        return 0
+
+    if args.artifact_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu import persist
+        print(persist.artifact_table_markdown())
         return 0
 
     if args.stats:

@@ -24,6 +24,9 @@ from .guard_consistency import GuardConsistencyPass
 from .sql_discipline import SqlDisciplinePass
 from .tx_shape import TxShapePass
 from .schema_parity import SchemaParityPass
+from .io_durability import IoDurabilityPass
+from .crash_atomicity import CrashAtomicityPass
+from .tmp_hygiene import TmpHygienePass
 
 PASSES = {
     p.name: p for p in (
@@ -37,6 +40,7 @@ PASSES = {
         SharedMutationPass(), ThreadBoundaryPass(),
         GuardConsistencyPass(),
         SqlDisciplinePass(), TxShapePass(), SchemaParityPass(),
+        IoDurabilityPass(), CrashAtomicityPass(), TmpHygienePass(),
     )
 }
 

@@ -1,0 +1,159 @@
+"""Pass: crash-atomicity — one function, one commit point.
+
+`persist.atomic_write` makes each ARTIFACT land whole-or-not-at-all,
+but a function that commits TWO artifacts (or an artifact plus a DB
+transaction) has a crash window between them where the pair disagrees
+— the config that points at a database image the kill arrived before,
+the index header that says "acked" over a bundle file that still says
+open. The static rule cannot prove which orderings are safe, so it
+demands the author SAY so: every multi-commit function carries an
+inline waiver whose comment states the commit order and why a crash
+between the points recovers (idempotent re-run, ordered
+db-before-config, second write advisory...). The crash grid
+(tools/crash_grid.py) then kills the process AT each declared edge
+and holds the recovery story to account.
+
+Codes:
+
+- ``multi-commit``: a function whose own body reaches two or more
+  distinct durable commit points — persist writes with different
+  artifact names, or a persist write plus a DB write
+  (`write_tx` / `db.insert` / `persist.db_write`) — with no declared
+  ordering (the waiver comment IS the declaration).
+- ``rmw-unguarded``: read-modify-write of a declared artifact (the
+  function both reads a file and persist-writes an artifact) outside
+  any lock context or O_EXCL guard: two concurrent writers interleave
+  to a torn logical state even though each WRITE is atomic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "crash-atomicity"
+
+CENTRAL = "spacedrive_tpu/persist.py"
+PRODUCT_PREFIX = "spacedrive_tpu/"
+SCOPE_MARKER = "# sdlint-scope: persist"
+
+# persist entry points that COMMIT (scratch/recover/edges_for do not).
+_PERSIST_COMMITS = {"atomic_write", "seal", "wal_writer"}
+_DB_COMMITS = {"write_tx", "db_write"}
+
+
+def _persist_commit_name(call: ast.Call, d: str) -> str:
+    """The literal artifact name iff this call is a persist commit."""
+    last = d.rsplit(".", 1)[-1]
+    if last not in _PERSIST_COMMITS or "persist." not in d:
+        return ""
+    arg = call.args[0] if call.args else None
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return ""
+
+
+def _is_db_commit(d: str) -> bool:
+    last = d.rsplit(".", 1)[-1]
+    if last in _DB_COMMITS:
+        return True
+    # `<anything>.db.insert(...)` — a row landed durably (SQLite WAL
+    # owns that commit point).
+    parts = d.split(".")
+    return last == "insert" and len(parts) >= 2 and parts[-2] == "db"
+
+
+def _has_lock_guard(fn) -> bool:
+    """Any `with <...lock...>:` / `async with <...lock...>:` block or
+    an O_EXCL open in the function's own body."""
+    for node in own_body_walk(fn.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                d = dotted(item.context_expr) or ""
+                if isinstance(item.context_expr, ast.Call):
+                    d = dotted(item.context_expr.func) or ""
+                if "lock" in d.lower() or "mutex" in d.lower():
+                    return True
+        if isinstance(node, ast.Attribute) and node.attr == "O_EXCL":
+            return True
+    return False
+
+
+def _reads_files(fn) -> bool:
+    """The function opens something for read (or json.load's a file
+    object) in its own body — the READ half of a read-modify-write."""
+    for site in fn.calls:
+        d = site.name
+        last = d.rsplit(".", 1)[-1]
+        if d == "open":
+            call = site.node
+            mode = None
+            if len(call.args) > 1:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if mode is None:
+                return True
+            if isinstance(mode, ast.Constant) and \
+                    isinstance(mode.value, str) and \
+                    "r" in mode.value and "+" not in mode.value:
+                return True
+        if d == "json.load":
+            return True
+    return False
+
+
+class CrashAtomicityPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            head = "\n".join(fn.src.lines[:5])
+            if rel == CENTRAL or not (rel.startswith(PRODUCT_PREFIX)
+                                      or SCOPE_MARKER in head):
+                continue
+            commits: List[tuple] = []   # (ident, lineno)
+            persist_names: List[str] = []
+            for site in fn.calls:
+                name = _persist_commit_name(site.node, site.name)
+                if name:
+                    commits.append((name, site.node.lineno))
+                    persist_names.append(name)
+                elif _is_db_commit(site.name):
+                    commits.append(("db", site.node.lineno))
+            idents = {c[0] for c in commits}
+            if len(idents) >= 2:
+                first = min(commits, key=lambda c: c[1])
+                emit(Finding(
+                    PASS, "multi-commit", rel, fn.qual,
+                    "+".join(sorted(idents)),
+                    "multiple durable commit points "
+                    f"({', '.join(sorted(idents))}) with no declared "
+                    "ordering: a crash between them leaves the pair "
+                    "disagreeing — declare the order and the recovery "
+                    "story in an inline waiver comment",
+                    first[1]))
+            if persist_names and _reads_files(fn) and \
+                    not _has_lock_guard(fn):
+                emit(Finding(
+                    PASS, "rmw-unguarded", rel, fn.qual,
+                    sorted(set(persist_names))[0],
+                    "read-modify-write of artifact "
+                    f"{sorted(set(persist_names))[0]!r} outside any "
+                    "lock/O_EXCL guard: concurrent writers interleave "
+                    "to a torn logical state even though each write "
+                    "is atomic",
+                    fn.node.lineno))
+        return findings

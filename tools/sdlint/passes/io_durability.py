@@ -1,0 +1,221 @@
+"""Pass: io-durability — every durable write goes through persist.py.
+
+A crash between `write()` and `close()` (or between `close()` and the
+directory catching up) turns "saved" into a torn file with a valid
+name — the exact failure the round-18 incident bundles kept
+attributing to "disk full" because nothing else could name it. The
+discipline mirrors the PR 12 timeout registry: every durable on-disk
+artifact is DECLARED by name in `spacedrive_tpu/persist.py`
+(path pattern, kind, fsync policy, recovery note — README table
+generated from the registry) and written by name through
+`persist.atomic_write` / `persist.wal_writer` / `persist.seal` /
+`persist.scratch` / `persist.db_write`.
+
+Scope: product modules under `spacedrive_tpu/` for the write-shape
+rules (tools/ write BENCH artifacts through the same seam, but their
+stdout/report plumbing is not durable state); artifact-NAME rules
+apply to every persist call site in the whole lint scope.
+
+Codes:
+
+- ``bare-write``: builtin `open()` for write/append/create (or a
+  `+` update mode) in product code — a bare file write has no tmp,
+  no fsync and no recovery story; route it through the persist seam
+  or waive it with the reason the bytes are not durable state
+  (streaming user output, caller-owned target, in-place destruction).
+- ``rename-no-tmp``: `os.rename`/`os.replace` whose SOURCE carries no
+  tmp/part token — renaming a non-scratch name is not a commit
+  protocol, it is two racing names for the same bytes. User-file
+  moves (the fs-ops jobs) waive with that reason.
+- ``replace-no-fsync``: raw `os.replace` in product code with no
+  `fsync` anywhere in the same function: the classic
+  write→rename-without-flush, durable in name only. The persist seam
+  orders fsync-file → rename → fsync-dir per declared policy.
+- ``artifact-undeclared``: a persist call names an artifact missing
+  from the `declare_artifact(...)` registry.
+- ``artifact-dynamic``: a persist call with a non-literal name — the
+  artifact table (and the crash grid built from it) must be static.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "io-durability"
+
+CENTRAL = "spacedrive_tpu/persist.py"
+PRODUCT_PREFIX = "spacedrive_tpu/"
+SCOPE_MARKER = "# sdlint-scope: persist"
+
+# persist entry points whose first argument is a declared artifact
+# name (the registry key the static table and the crash grid share).
+NAMED_APIS = {"atomic_write", "wal_writer", "scratch", "seal",
+              "db_write", "recover", "crashpoint", "edges_for",
+              "artifact"}
+
+_WRITE_MODE_CHARS = set("wax+")
+_TMP_TOKENS = ("tmp", "part", "bak", "swap", "stage")
+
+
+def declared_artifacts(root: str) -> Dict[str, str]:
+    """name -> kind from `declare_artifact(...)` calls in the central
+    registry (AST — the linted tree is never imported)."""
+    out: Dict[str, str] = {}
+    path = os.path.join(root, CENTRAL)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) == "declare_artifact"
+                and node.args):
+            continue
+        name = node.args[0]
+        if isinstance(name, ast.Constant) and isinstance(name.value, str):
+            kind = ""
+            if len(node.args) > 2 and \
+                    isinstance(node.args[2], ast.Constant):
+                kind = str(node.args[2].value)
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    kind = str(kw.value.value)
+            out[name.value] = kind
+    return out
+
+
+def _open_write_mode(call: ast.Call) -> str:
+    """The literal mode of a builtin `open()` call iff it writes."""
+    if dotted(call.func) != "open":
+        return ""
+    mode = None
+    if len(call.args) > 1:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return ""
+    if _WRITE_MODE_CHARS & set(mode.value):
+        return mode.value
+    return ""
+
+
+def _has_tmp_token(node: ast.AST) -> bool:
+    """Any tmp/part-ish token in the expression: a variable named
+    `tmp_path`, a `".part"` literal in a concat, an f-string piece."""
+    for sub in ast.walk(node):
+        text = ""
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            text = sub.value
+        elif isinstance(sub, ast.Name):
+            text = sub.id
+        elif isinstance(sub, ast.Attribute):
+            text = sub.attr
+        if text and any(t in text.lower() for t in _TMP_TOKENS):
+            return True
+    return False
+
+
+class IoDurabilityPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        declared = declared_artifacts(project.root)
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            if rel == CENTRAL:
+                continue  # the seam's own tmp-write IS the protocol
+            head = "\n".join(fn.src.lines[:5])
+            product = rel.startswith(PRODUCT_PREFIX) or \
+                SCOPE_MARKER in head
+            has_fsync = any(
+                site.name.rsplit(".", 1)[-1] == "fsync"
+                for site in fn.calls)
+            for site in fn.calls:
+                call, d = site.node, site.name
+                last = d.rsplit(".", 1)[-1]
+                if product:
+                    mode = _open_write_mode(call)
+                    if mode:
+                        emit(Finding(
+                            PASS, "bare-write", rel, fn.qual,
+                            f"open:{mode}",
+                            f"bare open(..., {mode!r}) in product "
+                            "code: no tmp, no fsync, no recovery "
+                            "story — write through the persist seam "
+                            "(persist.atomic_write / wal_writer / "
+                            "seal) or waive with the reason these "
+                            "bytes are not durable state",
+                            call.lineno))
+                    if d in ("os.rename", "os.replace"):
+                        src_arg = call.args[0] if call.args else None
+                        if src_arg is not None and \
+                                not _has_tmp_token(src_arg):
+                            emit(Finding(
+                                PASS, "rename-no-tmp", rel, fn.qual, d,
+                                f"{d} from a non-scratch source: a "
+                                "rename is only a commit protocol "
+                                "over a same-dir tmp — use "
+                                "persist.seal/atomic_write, or waive "
+                                "(user-file move)",
+                                call.lineno))
+                        if d == "os.replace" and not has_fsync:
+                            emit(Finding(
+                                PASS, "replace-no-fsync", rel, fn.qual,
+                                d,
+                                "os.replace with no fsync in the same "
+                                "function: durable in name only — the "
+                                "persist seam orders fsync-file → "
+                                "rename → fsync-dir per declared "
+                                "policy",
+                                call.lineno))
+                if last in NAMED_APIS and ("persist." in d
+                                           or d == last):
+                    # only persist-receiver calls: `scratch`/`seal`
+                    # are common words, so a bare name must resolve
+                    # to an import from persist to count.
+                    if d == last and not _imports_from_persist(
+                            fn.src.tree, last):
+                        continue
+                    arg = call.args[0] if call.args else None
+                    if not (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)):
+                        emit(Finding(
+                            PASS, "artifact-dynamic", rel, fn.qual,
+                            "non-literal",
+                            "artifact name must be a string literal "
+                            "so the registry table and the crash "
+                            "grid stay static",
+                            call.lineno))
+                        continue
+                    if arg.value not in declared:
+                        emit(Finding(
+                            PASS, "artifact-undeclared", rel, fn.qual,
+                            arg.value,
+                            f"artifact {arg.value!r} is not declared "
+                            "in spacedrive_tpu/persist.py "
+                            "(declare_artifact)",
+                            call.lineno))
+        return findings
+
+
+def _imports_from_persist(tree: ast.AST, name: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.rsplit(".", 1)[-1] == "persist":
+            if any(a.name == name for a in node.names):
+                return True
+    return False

@@ -1,0 +1,126 @@
+"""Pass: tmp-hygiene — scratch space dies with its owner.
+
+Every `tempfile.mkdtemp` before this pass had a happy-path `rmtree`
+and an error path that leaked: sync_bench left three `sync-*-bench-`
+trees per crashed run, a failed perf_smoke parked multi-GB corpora in
+/tmp until the machine noticed. Scratch space must be cleaned by
+CONSTRUCTION — `persist.scratch("name")` (a declared artifact whose
+context manager rmtrees in `finally`), a `TemporaryDirectory`
+context, or an explicit `try/finally` — not by remembering to call
+rmtree on the one path the author tested.
+
+Scope: the whole lint tree (spacedrive_tpu/ + tools/) — bench
+harnesses are where the leaks lived.
+
+Codes:
+
+- ``tmp-no-cleanup``: `mkdtemp`/`mkstemp`/`NamedTemporaryFile(
+  delete=False)` in a function with NO cleanup call at all (no
+  rmtree/remove/unlink referencing anything).
+- ``tmp-leak-on-error``: cleanup exists but only on the straight-line
+  path — nothing in a `finally`, an except handler, or a `with`
+  context guarantees it when the function raises.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding, Project, dotted, own_body_walk
+
+PASS = "tmp-hygiene"
+
+_MAKERS = {"mkdtemp", "mkstemp"}
+_CLEANERS = {"rmtree", "remove", "unlink", "rmdir", "scratch",
+             "cleanup"}
+
+
+def _tmp_maker(call: ast.Call, d: str) -> str:
+    last = d.rsplit(".", 1)[-1]
+    if last in _MAKERS:
+        return last
+    if last == "NamedTemporaryFile":
+        for kw in call.keywords:
+            if kw.arg == "delete" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return last
+    return ""
+
+
+def _mentions_cleaner(node: ast.AST) -> bool:
+    """A cleanup callable anywhere under `node` — called directly
+    (`shutil.rmtree(tmp)`) or passed as a reference
+    (`to_thread(shutil.rmtree, tmp)`)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _CLEANERS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _CLEANERS:
+            return True
+    return False
+
+
+def _guarded_cleanup(fn) -> bool:
+    """Cleanup guaranteed on error paths: a cleaner inside any
+    `finally:`/`except:` of the function's own body, or the maker's
+    result managed by a `with` block (context managers clean up in
+    __exit__)."""
+    for node in own_body_walk(fn.node):
+        if isinstance(node, ast.Try):
+            for blk in (node.finalbody, *[h.body for h in node.handlers]):
+                if any(_mentions_cleaner(stmt) for stmt in blk):
+                    return True
+    return False
+
+
+class TmpHygienePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(f: Finding) -> None:
+            if f.key() not in seen:
+                seen.add(f.key())
+                findings.append(f)
+
+        for fn in project.index.funcs:
+            rel = fn.src.relpath
+            makers = []
+            with_managed: Set[int] = set()
+            for node in own_body_walk(fn.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        cm = item.context_expr
+                        if isinstance(cm, ast.Call):
+                            with_managed.add(id(cm))
+            for site in fn.calls:
+                maker = _tmp_maker(site.node, site.name)
+                if maker and id(site.node) not in with_managed:
+                    makers.append((maker, site.node.lineno))
+            if not makers:
+                continue
+            any_cleanup = _mentions_cleaner(fn.node)
+            guarded = _guarded_cleanup(fn)
+            for maker, lineno in makers:
+                if guarded:
+                    continue
+                if not any_cleanup:
+                    emit(Finding(
+                        PASS, "tmp-no-cleanup", rel, fn.qual, maker,
+                        f"{maker} with no cleanup anywhere in the "
+                        "function: every crashed run leaks a tree — "
+                        "use persist.scratch(\"<artifact>\") or a "
+                        "try/finally rmtree",
+                        lineno))
+                else:
+                    emit(Finding(
+                        PASS, "tmp-leak-on-error", rel, fn.qual, maker,
+                        f"{maker} cleaned only on the straight-line "
+                        "path: an exception before the cleanup leaks "
+                        "the tree — move the rmtree into a finally "
+                        "(or use persist.scratch)",
+                        lineno))
+        return findings

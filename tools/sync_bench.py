@@ -45,7 +45,6 @@ import asyncio
 import json
 import os
 import sys
-import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -101,13 +100,25 @@ def _maybe_embed_telemetry(out: dict, on: bool) -> dict:
 
 async def main(n_ops: int, with_telemetry: bool = False) -> None:
     from spacedrive_tpu.node import Node
+    from spacedrive_tpu import persist
 
-    tmp = tempfile.mkdtemp(prefix="sync-bench-")
+    # Bench harness: blocking corpus teardown on the (idle) loop
+    # at exit is the measured run's own cleanup.
+    # sdlint: ok[blocking-async]
+    with persist.scratch("bench.workdir") as tmp:
+        await _run_ingest(tmp, Node, n_ops, with_telemetry)
+
+
+async def _run_ingest(tmp: str, Node, n_ops: int,
+                      with_telemetry: bool) -> None:
     a = Node(os.path.join(tmp, "a"))
     b = Node(os.path.join(tmp, "b"))
     await a.start()
     await b.start()
     lib_a = a.create_library("bench")
+    # Bench setup: the backlog WRITE is the fixture, built before
+    # the measured section starts.
+    # sdlint: ok[blocking-async]
     total = build_backlog(lib_a, n_ops)
     _maybe_reset_telemetry(with_telemetry)
 
@@ -125,6 +136,8 @@ async def main(n_ops: int, with_telemetry: bool = False) -> None:
     last = -1
     while True:
         await asyncio.sleep(0.25)
+        # One tiny COUNT per 250ms sample on the harness's own loop.
+        # sdlint: ok[blocking-async]
         n = count_b()
         if n >= total:
             break
@@ -134,6 +147,8 @@ async def main(n_ops: int, with_telemetry: bool = False) -> None:
             a.p2p.networked.originate_soon(lib_a)
         last = n
     dt = time.perf_counter() - t0
+    # Post-measurement readback; the clock is stopped.
+    # sdlint: ok[blocking-async]
     rows = lib_b.db.run("bench.tag_count")["n"]
     print(json.dumps(_maybe_embed_telemetry({
         "metric": "sync_ingest_ops_per_sec",
@@ -154,8 +169,16 @@ def encode_bench(n_ops: int, with_telemetry: bool = False) -> None:
     from spacedrive_tpu.sync import opblob
     from spacedrive_tpu.sync.crdt import pack_value, uuid4_bytes_batch
 
+    from spacedrive_tpu import persist
+
     _maybe_reset_telemetry(with_telemetry)
-    tmp = tempfile.mkdtemp(prefix="sync-encode-bench-")
+    with persist.scratch("bench.workdir") as tmp:
+        _run_encode(tmp, n_ops, with_telemetry, native, opblob,
+                    pack_value, uuid4_bytes_batch)
+
+
+def _run_encode(tmp: str, n_ops: int, with_telemetry: bool, native,
+                opblob, pack_value, uuid4_bytes_batch) -> None:
     mk = lambda name: _mk_solo(tmp, name)  # noqa: E731
 
     # The identifier's link shape: one multi-field update per file.
@@ -418,21 +441,24 @@ def _full_clone_inproc(tmp: str, n_files: int) -> dict:
                         if k != "applied"}}}
 
 
-def full_clone_bench(n_files: int, json_out: str = "",
-                     with_telemetry: bool = False) -> None:
-    from spacedrive_tpu import native
-
-    _maybe_reset_telemetry(with_telemetry)
-    tmp = tempfile.mkdtemp(prefix="sync-clone-bench-")
+def _run_clone(tmp: str, n_files: int) -> dict:
     try:
         import cryptography  # noqa: F401 — p2p tunnel dependency
         have_tcp = True
     except ModuleNotFoundError:
         have_tcp = False
     if have_tcp:
-        result = asyncio.run(_full_clone_tcp(tmp, n_files))
-    else:
-        result = _full_clone_inproc(tmp, n_files)
+        return asyncio.run(_full_clone_tcp(tmp, n_files))
+    return _full_clone_inproc(tmp, n_files)
+
+
+def full_clone_bench(n_files: int, json_out: str = "",
+                     with_telemetry: bool = False) -> None:
+    from spacedrive_tpu import native, persist
+
+    _maybe_reset_telemetry(with_telemetry)
+    with persist.scratch("bench.workdir") as tmp:
+        result = _run_clone(tmp, n_files)
     # rows the per-op comparator exploded on the origin's first ingest
     # are gone by now; count from the blob metadata instead
     out = {
@@ -456,8 +482,8 @@ def full_clone_bench(n_files: int, json_out: str = "",
     _maybe_embed_telemetry(out, with_telemetry)
     print(json.dumps(out))
     if json_out:
-        with open(json_out, "w") as f:
-            json.dump(out, f, indent=1)
+        persist.atomic_write("bench.artifact", json_out,
+                             json.dumps(out, indent=1))
 
 
 if __name__ == "__main__":

@@ -30,7 +30,6 @@ import json
 import os
 import random
 import sys
-import tempfile
 import time
 
 # The tunneled bench link moves ~10-20 MB/s on bad days; keep each
@@ -49,67 +48,72 @@ async def run(n_files: int, file_kb: int) -> None:
     from spacedrive_tpu.node import Node
     from spacedrive_tpu.objects.validator import ObjectValidatorJob
 
-    tmp = tempfile.mkdtemp(prefix="sdtpu-valbench-")
-    corpus = os.path.join(tmp, "corpus")
-    os.makedirs(corpus)
-    rng = random.Random(3)
-    total_bytes = 0
-    for i in range(n_files):
-        data = rng.randbytes(file_kb * 1024)
-        with open(os.path.join(corpus, f"f{i}.bin"), "wb") as f:
-            f.write(data)
-        total_bytes += len(data)
+    from spacedrive_tpu import persist
 
-    node = Node(os.path.join(tmp, "data"))
-    await node.start()
-    lib = node.create_library("valbench")
-    loc = create_location(lib, corpus)
-    await scan_location(node.jobs, lib, loc, backend="native",
-                        with_media=False)
-    await node.jobs.wait_idle()
+    # Bench harness: blocking corpus teardown on the (idle) loop
+    # at exit is the measured run's own cleanup.
+    # sdlint: ok[blocking-async]
+    with persist.scratch("bench.workdir") as tmp:
+        corpus = os.path.join(tmp, "corpus")
+        os.makedirs(corpus)
+        rng = random.Random(3)
+        total_bytes = 0
+        for i in range(n_files):
+            data = rng.randbytes(file_kb * 1024)
+            with open(os.path.join(corpus, f"f{i}.bin"), "wb") as f:
+                f.write(data)
+            total_bytes += len(data)
 
-    t0 = time.perf_counter()
-    jid = await node.jobs.ingest(
-        lib, ObjectValidatorJob(location_id=loc, backend="jax", mode="fill"))
-    await node.jobs.wait(jid)
-    dt = time.perf_counter() - t0
-    n_done = lib.db.run("bench.checksum_count")["n"]
-    # Same-weather comparator: the round-4 ONE-DISPATCH-PER-FILE path
-    # (streaming sequence-sharded windows) on a subset — the tunneled
-    # link's throughput swings 100x day to day, so the amortization
-    # claim is only honest against the per-file rate measured in the
-    # SAME run.
-    import glob
+        node = Node(os.path.join(tmp, "data"))
+        await node.start()
+        lib = node.create_library("valbench")
+        loc = create_location(lib, corpus)
+        await scan_location(node.jobs, lib, loc, backend="native",
+                            with_media=False)
+        await node.jobs.wait_idle()
 
-    import jax
+        t0 = time.perf_counter()
+        jid = await node.jobs.ingest(
+            lib, ObjectValidatorJob(location_id=loc, backend="jax", mode="fill"))
+        await node.jobs.wait(jid)
+        dt = time.perf_counter() - t0
+        n_done = lib.db.run("bench.checksum_count")["n"]
+        # Same-weather comparator: the round-4 ONE-DISPATCH-PER-FILE path
+        # (streaming sequence-sharded windows) on a subset — the tunneled
+        # link's throughput swings 100x day to day, so the amortization
+        # claim is only honest against the per-file rate measured in the
+        # SAME run.
+        import glob
 
-    from spacedrive_tpu.ops.seqhash import sharded_file_checksum
-    from spacedrive_tpu.parallel.mesh import batch_mesh
+        import jax
 
-    mesh = batch_mesh(list(jax.devices())[:1])
-    subset = sorted(glob.glob(os.path.join(corpus, "*.bin")))[
-        :min(20, n_files)]
-    sharded_file_checksum(mesh, subset[0])  # compile outside the timer
-    t0 = time.perf_counter()
-    for p_ in subset:
-        sharded_file_checksum(mesh, p_)
-    per_file_dt = (time.perf_counter() - t0) / len(subset)
-    per_file_fps = 1.0 / per_file_dt
+        from spacedrive_tpu.ops.seqhash import sharded_file_checksum
+        from spacedrive_tpu.parallel.mesh import batch_mesh
 
-    print(json.dumps({
-        "metric": "validator_jax_device_files_per_sec",
-        "value": round(n_done / dt, 2),
-        "unit": "files/s",
-        "mb_per_sec": round(total_bytes / dt / 1e6, 2),
-        "files": n_done,
-        "file_kb": file_kb,
-        "seconds": round(dt, 2),
-        "backend": "jax (batched small-file dispatches + StreamingShardedChecksum for large)",
-        "batched_small_files": True,
-        "per_file_dispatch_files_per_sec": round(per_file_fps, 2),
-        "batch_amortization_x": round((n_done / dt) / per_file_fps, 1),
-    }))
-    await node.shutdown()
+        mesh = batch_mesh(list(jax.devices())[:1])
+        subset = sorted(glob.glob(os.path.join(corpus, "*.bin")))[
+            :min(20, n_files)]
+        sharded_file_checksum(mesh, subset[0])  # compile outside the timer
+        t0 = time.perf_counter()
+        for p_ in subset:
+            sharded_file_checksum(mesh, p_)
+        per_file_dt = (time.perf_counter() - t0) / len(subset)
+        per_file_fps = 1.0 / per_file_dt
+
+        print(json.dumps({
+            "metric": "validator_jax_device_files_per_sec",
+            "value": round(n_done / dt, 2),
+            "unit": "files/s",
+            "mb_per_sec": round(total_bytes / dt / 1e6, 2),
+            "files": n_done,
+            "file_kb": file_kb,
+            "seconds": round(dt, 2),
+            "backend": "jax (batched small-file dispatches + StreamingShardedChecksum for large)",
+            "batched_small_files": True,
+            "per_file_dispatch_files_per_sec": round(per_file_fps, 2),
+            "batch_amortization_x": round((n_done / dt) / per_file_fps, 1),
+        }))
+        await node.shutdown()
 
 
 def kernel_figure(n_files: int, file_kb: int, iters: int = 30) -> None:
