@@ -484,3 +484,15 @@ declare(
     "SDTPU_WATCHER", "", lambda v: v.strip().lower(),
     "`poll` forces the polling watcher fallback even where inotify is "
     "available (locations/watcher.py; how Linux CI exercises it).")
+
+declare(
+    "SDTPU_WIRE_AUDIT", "auto", lambda v: v.strip().lower(),
+    "Runtime wire auditor (p2p/wire.py, armed with the sanitizer): "
+    "every frame crossing the pack/unpack seam — both tunnel "
+    "directions and the stub transports' pack calls — is matched "
+    "against its declared message contract; an undeclared kind, a "
+    "schema mismatch, a size-cap breach, or a version-const skew is "
+    "a `wire_violation` (raised in tier-1, counted in production, "
+    "sd_wire_violations_total{kind}). `off` skips arming (pack/"
+    "unpack still validate, zero audit overhead); `auto` follows "
+    "SDTPU_SANITIZE. Read once at sanitize.install().")

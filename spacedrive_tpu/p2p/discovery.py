@@ -100,8 +100,11 @@ class Discovery:
 
     def _on_datagram(self, data: bytes, addr) -> None:
         try:
-            outer = msgpack.unpackb(data, raw=False)
-            body = msgpack.unpackb(outer["body"], raw=False)
+            # The UDP beacon plane is its own signed envelope format,
+            # pre-tunnel — no size cap / frame auditor applies here, so
+            # the registry's caging doesn't either.
+            outer = msgpack.unpackb(data, raw=False)  # sdlint: ok[proto-compat]
+            body = msgpack.unpackb(outer["body"], raw=False)  # sdlint: ok[proto-compat]
             remote = RemoteIdentity(body["identity"])
             if remote == self.identity.to_remote_identity():
                 return  # our own beacon

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Optional
 
 from .. import chaos, tracing
 from ..timeouts import deadline, with_timeout
+from . import wire
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity
 from .obs import OBS_KINDS, serve_obs
@@ -135,9 +136,9 @@ class P2PManager:
             tunnel = await self.open_stream(addr, port)
             try:
                 async with deadline("p2p.ping"):
-                    await tunnel.send({"t": "ping",
-                                       "tp": tracing.traceparent()})
-                    assert await tunnel.recv() == {"t": "pong"}
+                    await tunnel.send(wire.pack(
+                        "p2p.ping", tp=tracing.traceparent()))
+                    wire.unpack("p2p.pong", await tunnel.recv())
             finally:
                 tunnel.close()
         return time.monotonic() - t0
@@ -179,12 +180,18 @@ class P2PManager:
         try:
             await with_timeout(
                 "p2p.frame_send",
-                tunnel.send({"t": "spacedrop", "req": req.to_wire(),
-                             "tp": tracing.traceparent()}))
+                tunnel.send(wire.pack(
+                    "p2p.spacedrop.offer", req=req.to_wire(),
+                    tp=tracing.traceparent())))
             # The verdict budget brackets the receiver's whole
             # interactive p2p.spacedrop.decide window (timeouts.py).
-            verdict = await with_timeout(
-                "p2p.spacedrop.verdict", tunnel.recv())
+            try:
+                verdict = wire.unpack(
+                    "p2p.spacedrop.verdict",
+                    await with_timeout("p2p.spacedrop.verdict",
+                                       tunnel.recv()))
+            except wire.WireError:
+                return "rejected"  # off-contract verdict = no consent
             if verdict != "accept":
                 return "rejected"
             self.node.events.emit({
@@ -210,16 +217,21 @@ class P2PManager:
         with tracing.span("p2p/file", peer=f"{addr}:{port}"):
             tunnel = await self.open_stream(addr, port)
             try:
-                await with_timeout("p2p.frame_send", tunnel.send({
-                    "t": "file", "library_id": library_id,
-                    "location_pub_id": location_pub_id,
-                    "file_path_pub_id": file_path_pub_id,
-                    "range_start": range_start, "range_end": range_end,
-                    "tp": tracing.traceparent()}))
-                resp = await with_timeout("p2p.file.response",
-                                          tunnel.recv())
-                if not isinstance(resp, dict) or \
-                        resp.get("status") != "ok":
+                await with_timeout("p2p.frame_send", tunnel.send(
+                    wire.pack(
+                        "p2p.file.request", library_id=library_id,
+                        location_pub_id=location_pub_id,
+                        file_path_pub_id=file_path_pub_id,
+                        range_start=range_start, range_end=range_end,
+                        tp=tracing.traceparent())))
+                try:
+                    resp = wire.unpack(
+                        "p2p.file.response",
+                        await with_timeout("p2p.file.response",
+                                           tunnel.recv()))
+                except wire.WireError:
+                    return False
+                if resp.get("status") != "ok":
                     return False
                 req = SpaceblockRequest.from_wire(resp["req"])
                 with await asyncio.to_thread(open, out_path, "wb") as out:
@@ -246,25 +258,27 @@ class P2PManager:
                     library.db.query_one,
                     "SELECT * FROM instance WHERE pub_id = ?",
                     (sync.instance,))
-                await tunnel.send({
-                    "t": "pair",
-                    "tp": tracing.traceparent(),
-                    "library_id": str(library.id),
-                    "library_name": library.config.name,
+                await tunnel.send(wire.pack(
+                    "p2p.pair.request",
+                    tp=tracing.traceparent(),
+                    library_id=str(library.id),
+                    library_name=library.config.name,
                     # Our LISTENING port (the TCP source port is
                     # ephemeral): the responder derives a route back to
                     # us from it.
-                    "listen_port": self.port,
-                    "instance": {
+                    listen_port=self.port,
+                    instance={
                         "pub_id": me["pub_id"], "identity":
                             self.identity.to_remote_identity().to_bytes(),
                         "node_id": self.node.config.id,
                         "node_name": self.node.config.name,
-                    },
-                })
-                resp = await tunnel.recv()
-                if not isinstance(resp, dict) or \
-                        resp.get("status") != "accepted":
+                    }))
+                try:
+                    resp = wire.unpack("p2p.pair.response",
+                                       await tunnel.recv())
+                except wire.WireError:
+                    return False
+                if resp.get("status") != "accepted":
                     return False
                 inst = resp["instance"]
                 await asyncio.to_thread(
@@ -302,20 +316,32 @@ class P2PManager:
             # handler span below (and sync.pull, which re-anchors to
             # the same header) lands in the caller's trace — a
             # request is one trace id end-to-end over the mesh.
+            # Each branch holds the header to its declared contract
+            # BEFORE any field is read; a WireError lands in the
+            # generic handler below — P2PError event + tunnel close,
+            # the declared disconnect path a malformed peer gets.
             with tracing.continue_trace(tp):
                 if t == "ping":
                     with tracing.span("p2p/ping"):
-                        await with_timeout("p2p.frame_send",
-                                           tunnel.send({"t": "pong"}))
+                        wire.unpack("p2p.ping", header)
+                        await with_timeout(
+                            "p2p.frame_send",
+                            tunnel.send(wire.pack("p2p.pong")))
                 elif t == "spacedrop":
                     with tracing.span("p2p/spacedrop"):
-                        await self._handle_spacedrop(tunnel, header)
+                        await self._handle_spacedrop(
+                            tunnel,
+                            wire.unpack("p2p.spacedrop.offer", header))
                 elif t == "pair":
                     with tracing.span("p2p/pair"):
-                        await self._handle_pair(tunnel, header)
+                        await self._handle_pair(
+                            tunnel,
+                            wire.unpack("p2p.pair.request", header))
                 elif t == "file":
                     with tracing.span("p2p/file"):
-                        await self._handle_file(tunnel, header)
+                        await self._handle_file(
+                            tunnel,
+                            wire.unpack("p2p.file.request", header))
                 elif t in OBS_KINDS:
                     # Fleet observatory pull: serve the local
                     # telemetry/health/trace snapshot. Built off-loop
@@ -391,9 +417,15 @@ class P2PManager:
         drop_id = uuidlib.uuid4().hex
         save_path = await self._decide_spacedrop(tunnel.remote, req, drop_id)
         if save_path is None:
-            await with_timeout("p2p.frame_send", tunnel.send("reject"))
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send(wire.pack("p2p.spacedrop.verdict",
+                                      value="reject")))
             return
-        await with_timeout("p2p.frame_send", tunnel.send("accept"))
+        await with_timeout(
+            "p2p.frame_send",
+            tunnel.send(wire.pack("p2p.spacedrop.verdict",
+                                  value="accept")))
         self._spacedrop_cancel[drop_id] = False
         # Announce the receive (with its cancellation id) in BOTH modes —
         # p2p.cancelSpacedrop needs an id even when a sync hook accepted.
@@ -421,8 +453,10 @@ class P2PManager:
 
     async def _handle_pair(self, tunnel: Tunnel, header: dict) -> None:
         if not self.on_pairing_request(tunnel.remote, header):
-            await with_timeout("p2p.frame_send",
-                               tunnel.send({"status": "rejected"}))
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send(wire.pack("p2p.pair.response",
+                                      status="rejected")))
             return
         lib = None
         for candidate in self.node.libraries.list():
@@ -455,13 +489,14 @@ class P2PManager:
             lib.db.query_one,
             "SELECT * FROM instance WHERE pub_id = ?",
             (lib.sync.instance,))
-        await with_timeout("p2p.frame_send", tunnel.send(
-            {"status": "accepted", "instance": {
-            "pub_id": me["pub_id"],
-            "identity": self.identity.to_remote_identity().to_bytes(),
-            "node_id": self.node.config.id,
-            "node_name": self.node.config.name,
-        }}))
+        await with_timeout("p2p.frame_send", tunnel.send(wire.pack(
+            "p2p.pair.response", status="accepted", instance={
+                "pub_id": me["pub_id"],
+                "identity":
+                    self.identity.to_remote_identity().to_bytes(),
+                "node_id": self.node.config.id,
+                "node_name": self.node.config.name,
+            })))
         if self.networked is not None:
             # Symmetric backfill: OUR pre-existing ops (re-pairing case)
             # flow to the initiator without waiting for a local write.
@@ -472,8 +507,10 @@ class P2PManager:
         lib = self.node.libraries.get(
             uuidlib.UUID(str(header["library_id"])))
         if lib is None:
-            await with_timeout("p2p.frame_send",
-                               tunnel.send({"status": "not_found"}))
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send(wire.pack("p2p.file.response",
+                                      status="not_found")))
             return
         loc = await asyncio.to_thread(
             lib.db.query_one,
@@ -485,8 +522,10 @@ class P2PManager:
             (bytes(header["file_path_pub_id"]),))) if loc else None
         if (row is None or loc is None or not loc["path"]
                 or row["location_id"] != loc["id"]):
-            await with_timeout("p2p.frame_send",
-                               tunnel.send({"status": "not_found"}))
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send(wire.pack("p2p.file.response",
+                                      status="not_found")))
             return
         iso = IsolatedPath.from_db_row(
             loc["id"], bool(row["is_dir"]),
@@ -494,14 +533,17 @@ class P2PManager:
             row["extension"] or "")
         full = iso.join_on(loc["path"])
         if not os.path.isfile(full):
-            await with_timeout("p2p.frame_send",
-                               tunnel.send({"status": "not_found"}))
+            await with_timeout(
+                "p2p.frame_send",
+                tunnel.send(wire.pack("p2p.file.response",
+                                      status="not_found")))
             return
         req = SpaceblockRequest(
             os.path.basename(full), os.path.getsize(full),
             header.get("range_start"), header.get("range_end"))
-        await with_timeout("p2p.frame_send",
-                           tunnel.send({"status": "ok",
-                                        "req": req.to_wire()}))
+        await with_timeout(
+            "p2p.frame_send",
+            tunnel.send(wire.pack("p2p.file.response", status="ok",
+                                  req=req.to_wire())))
         with await asyncio.to_thread(open, full, "rb") as f:
             await send_file(tunnel, req, f)

@@ -44,6 +44,7 @@ from typing import Any, Dict, Optional
 from .. import flight, telemetry, tracing
 from ..telemetry import OBS_REQUESTS
 from ..timeouts import with_timeout
+from . import wire
 
 __all__ = [
     "OBS_PROTO", "OBS_KINDS", "TRACE_SLICE_LIMIT",
@@ -51,26 +52,31 @@ __all__ = [
     "P2PObsClient",
 ]
 
-# Observability wire version, echoed in every response envelope. Bump
-# on any payload-shape change: the poller refuses a mismatched peer
-# (one stale-proto node must degrade to a labeled stale row, never
-# corrupt the merged fleet view).
-OBS_PROTO = 1
+# Observability wire version, echoed in every response envelope — a
+# REGISTRY READ (p2p/wire.py PROTO_VERSIONS), bumped there on any
+# payload-shape change: the poller refuses a mismatched peer (one
+# stale-proto node must degrade to a labeled stale row, never corrupt
+# the merged fleet view).
+OBS_PROTO = wire.proto("obs")
 
 # The request kinds manager.py dispatches on (the `t` header field,
-# same discriminator scheme as ping/pair/spacedrop/file/sync).
+# same discriminator scheme as ping/pair/spacedrop/file/sync). Each
+# IS a declared wire message name — dispatch keys and contracts
+# cannot drift.
 OBS_KINDS = ("obs.metrics", "obs.health", "obs.trace",
              "obs.incidents")
 
 # Per-reply cap on bundle headers in an obs.incidents response —
 # headers are small, and the store itself is capped well below this.
-INCIDENT_SLICE_LIMIT = 256
+# The declared slice_cap of the obs.incidents contract.
+INCIDENT_SLICE_LIMIT = wire.slice_cap("obs.incidents")
 
 # Per-reply cap on spans and timeline events in an obs.trace slice:
 # bounded well above the default rings (512 spans / 4096 timeline
 # events) so a whole ring ships in one reply, while a hostile `limit`
-# cannot make the responder build an unbounded copy.
-TRACE_SLICE_LIMIT = 8192
+# cannot make the responder build an unbounded copy. The declared
+# slice_cap of the obs.trace contract.
+TRACE_SLICE_LIMIT = wire.slice_cap("obs.trace")
 
 
 def node_identity(node) -> Dict[str, str]:
@@ -103,16 +109,23 @@ def serve_obs(node, header: Dict[str, Any]) -> Dict[str, Any]:
     what = header.get("t") if isinstance(header, dict) else None
     if what not in OBS_KINDS:
         OBS_REQUESTS.labels(what="error").inc()
-        return {"status": "error", "proto": OBS_PROTO,
-                "error": f"unknown obs kind {what!r}"}
-    resp: Dict[str, Any] = {
-        "status": "ok", "proto": OBS_PROTO, "what": what,
-        "node": node_identity(node), "ts": round(time.time(), 6),
-    }
+        return wire.pack("obs.response", status="error",
+                         error=f"unknown obs kind {what!r}")
+    try:
+        # The request kind IS its declared message name; holding the
+        # header to that contract here covers every transport (p2p
+        # handler, rspc, loopback) with one validation site. The
+        # version const is optional-on-the-wire, so proto-less
+        # loopback headers pass; a PRESENT skew is refused.
+        wire.unpack(what, header)  # sdlint: ok[wire-discipline]
+    except wire.WireError as e:
+        OBS_REQUESTS.labels(what="error").inc()
+        return wire.pack("obs.response", status="error", error=str(e))
+    extra: Dict[str, Any] = {}
     if what == "obs.metrics":
-        resp["metrics"] = telemetry.snapshot()
+        extra["metrics"] = telemetry.snapshot()
     elif what == "obs.health":
-        resp["health"] = node.health.snapshot()
+        extra["health"] = node.health.snapshot()
     elif what == "obs.incidents":
         from .. import incidents as _incidents
 
@@ -122,7 +135,7 @@ def serve_obs(node, header: Dict[str, Any]) -> Dict[str, Any]:
         except (TypeError, ValueError):
             limit = INCIDENT_SLICE_LIMIT
         limit = max(1, min(limit, INCIDENT_SLICE_LIMIT))
-        resp["incidents"] = obs.list(limit=limit) if obs else []
+        extra["incidents"] = obs.list(limit=limit) if obs else []
     else:  # obs.trace
         trace = header.get("trace")
         trace = str(trace) if trace else None
@@ -130,9 +143,11 @@ def serve_obs(node, header: Dict[str, Any]) -> Dict[str, Any]:
             limit = int(header.get("limit", TRACE_SLICE_LIMIT))
         except (TypeError, ValueError):
             limit = TRACE_SLICE_LIMIT
-        resp.update(_trace_slice(trace, limit))
+        extra.update(_trace_slice(trace, limit))
     OBS_REQUESTS.labels(what=what.split(".", 1)[1]).inc()
-    return resp
+    return wire.pack("obs.response", status="ok", what=what,
+                     node=node_identity(node),
+                     ts=round(time.time(), 6), **extra)
 
 
 class P2PObsClient:
@@ -154,10 +169,11 @@ class P2PObsClient:
         tunnel = await self.p2p.open_stream(
             self.addr, self.port, expected=self.expected)
         try:
-            req: Dict[str, Any] = {"t": what, "proto": OBS_PROTO,
-                                   "tp": tracing.traceparent()}
-            if trace:
-                req["trace"] = str(trace)
+            extra = {"trace": str(trace)} if trace else {}
+            # The fetch kind is data (one client, four request
+            # contracts): the sanctioned dynamic pack call.
+            req: Dict[str, Any] = wire.pack(  # sdlint: ok[wire-discipline]
+                what, tp=tracing.traceparent(), **extra)
             await with_timeout("p2p.obs", tunnel.send(req))
             return await with_timeout("p2p.obs", tunnel.recv())
         finally:

@@ -44,6 +44,13 @@ from .identity import Identity, RemoteIdentity
 # under MAX_FRAME (the registry snapshot and the capped trace slice
 # both sit far below it).
 from .obs import OBS_KINDS, OBS_PROTO  # noqa: F401  (protocol surface)
+# The declared wire contracts (p2p/wire.py): the tunnel is the audit
+# seam — every frame crossing it in either direction is classified
+# and validated against its declaration when the sanitizer's wire
+# auditor is armed, and the transport's frame cap IS the registry's
+# MAX_FRAME (re-exported here for compatibility).
+from . import wire
+from .wire import MAX_FRAME, audit_frame  # noqa: F401
 
 # Timeout discipline (tools/sdlint timeout-discipline pass): this
 # module is the TRANSPORT PRIMITIVE layer — read_frame/send/recv are
@@ -52,9 +59,6 @@ from .obs import OBS_KINDS, OBS_PROTO  # noqa: F401  (protocol surface)
 # pass enforces that every caller in p2p/api/sync actually provides
 # one (with_timeout / deadline). The handshake is the exception: it is
 # a self-contained exchange, so it owns its own `p2p.handshake` block.
-
-MAX_FRAME = 64 * 1024 * 1024  # sanity cap
-
 
 class ProtoError(Exception):
     pass
@@ -132,8 +136,9 @@ class Tunnel:
         if f is not None:
             if await chaos.apply_async(f):
                 return  # dropped
-        self._seal(msgpack.packb(msg, use_bin_type=True),
-                   tamper=f is not None and f.kind == "corrupt")
+        payload = msgpack.packb(msg, use_bin_type=True)
+        audit_frame(msg, "out", len(payload))
+        self._seal(payload, tamper=f is not None and f.kind == "corrupt")
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
         self._frames.note_drain()  # drain flushes queued frames too
 
@@ -149,7 +154,9 @@ class Tunnel:
         P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
-        return msgpack.unpackb(plain, raw=False, strict_map_key=False)
+        msg = msgpack.unpackb(plain, raw=False, strict_map_key=False)
+        audit_frame(msg, "in", len(plain))
+        return msg
 
     def send_nowait(self, msg: Any) -> None:
         """Seal and queue a frame WITHOUT awaiting the socket drain —
@@ -161,7 +168,9 @@ class Tunnel:
         declared p2p.tunnel.frames window; bursting past its capacity
         without a drain is a sanitizer violation (the cap that bounds
         a wedged peer's memory)."""
-        self._seal(msgpack.packb(msg, use_bin_type=True))
+        payload = msgpack.packb(msg, use_bin_type=True)
+        audit_frame(msg, "out", len(payload))
+        self._seal(payload)
         self._frames.note_put()
 
     async def drain(self) -> None:
@@ -172,6 +181,7 @@ class Tunnel:
         self._frames.note_drain()
 
     async def send_raw(self, data: bytes) -> None:
+        audit_frame(data, "out", len(data))
         self._seal(data)
         await self.writer.drain()  # sdlint: ok[timeout-discipline]
         self._frames.note_drain()
@@ -181,6 +191,7 @@ class Tunnel:
         P2P_TUNNEL_BYTES_RECV.inc(len(sealed))
         plain = self._recv.decrypt(self._nonce(self._recv_ctr), sealed, None)
         self._recv_ctr += 1
+        audit_frame(plain, "in", len(plain))
         return plain
 
     def close(self) -> None:
@@ -218,15 +229,19 @@ async def tunnel_handshake(
     eph = X25519PrivateKey.generate()
     my_pub = identity.to_remote_identity().to_bytes()
     nonce = os.urandom(16)
-    write_msg(writer, {
-        "identity": my_pub,
-        "ephemeral": _x25519_pub_bytes(eph),
-        "nonce": nonce,
-        "sig": identity.sign(_x25519_pub_bytes(eph) + nonce),
-    })
+    write_msg(writer, wire.pack(
+        "p2p.handshake.hello",
+        identity=my_pub,
+        ephemeral=_x25519_pub_bytes(eph),
+        nonce=nonce,
+        sig=identity.sign(_x25519_pub_bytes(eph) + nonce)))
     async with deadline("p2p.handshake"):
         await writer.drain()
-        hello = await read_msg(reader)
+        # The rawest decode site of all: the peer is unauthenticated
+        # until the signature check below, so the frame is held to its
+        # declared contract before any field is touched.
+        hello = wire.unpack("p2p.handshake.hello",
+                            await read_msg(reader))
     remote = RemoteIdentity(hello["identity"])
     if expected is not None and remote != expected:
         raise ProtoError("peer identity mismatch")

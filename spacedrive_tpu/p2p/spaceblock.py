@@ -14,6 +14,7 @@ import os
 from typing import BinaryIO, Callable, Optional
 
 from ..timeouts import with_timeout
+from . import wire
 from .proto import Tunnel
 
 KIB, MIB = 1024, 1024 * 1024
@@ -40,12 +41,13 @@ class SpaceblockRequest:
         self.range_end = range_end
 
     def to_wire(self) -> dict:
-        return {"name": self.name, "size": self.size,
-                "range_start": self.range_start,
-                "range_end": self.range_end}
+        return wire.pack("spaceblock.request", name=self.name,
+                         size=self.size, range_start=self.range_start,
+                         range_end=self.range_end)
 
     @classmethod
     def from_wire(cls, raw: dict) -> "SpaceblockRequest":
+        raw = wire.unpack("spaceblock.request", raw)
         return cls(raw["name"], raw["size"], raw.get("range_start"),
                    raw.get("range_end"))
 
@@ -78,6 +80,11 @@ async def send_file(tunnel: Tunnel, req: SpaceblockRequest, f: BinaryIO,
         if on_progress:
             on_progress(sent)
         ack = await with_timeout("p2p.transfer.chunk", tunnel.recv())
+        try:
+            ack = wire.unpack("spaceblock.verdict", ack)
+        except wire.WireError:
+            # An off-contract ack is no consent: stop streaming.
+            return False
         if ack != "ok":
             return False
     return True
@@ -91,15 +98,21 @@ async def receive_file(tunnel: Tunnel, req: SpaceblockRequest, out: BinaryIO,
     total = end - start
     got = 0
     while got < total:
-        chunk = await with_timeout("p2p.transfer.chunk",
-                                   tunnel.recv_raw())
+        chunk = wire.unpack(
+            "spaceblock.chunk",
+            await with_timeout("p2p.transfer.chunk",
+                               tunnel.recv_raw()))
         out.write(chunk)
         got += len(chunk)
         if on_progress:
             on_progress(got)
         if should_cancel and should_cancel():
-            await with_timeout("p2p.transfer.chunk",
-                               tunnel.send("cancel"))
+            await with_timeout(
+                "p2p.transfer.chunk",
+                tunnel.send(wire.pack("spaceblock.verdict",
+                                      value="cancel")))
             return False
-        await with_timeout("p2p.transfer.chunk", tunnel.send("ok"))
+        await with_timeout(
+            "p2p.transfer.chunk",
+            tunnel.send(wire.pack("spaceblock.verdict", value="ok")))
     return True

@@ -35,6 +35,7 @@ from ..telemetry import (
     P2P_ROUTE_CACHE_MISSES,
 )
 from ..tracing import logger
+from . import wire
 from .identity import RemoteIdentity
 
 OPS_PER_REQUEST = 1000
@@ -50,8 +51,11 @@ OPS_PER_REQUEST = 1000
 # to SERVE a mismatch — the direction that matters: a stale decoder
 # pulling v2 ops would silently read multi-field update ops, "u:a+b"
 # kinds, as creates and corrupt its replica's op log; a v2 peer would
-# likewise not understand v3's blob_stream clone frames).
-SYNC_PROTO = 3
+# likewise not understand v3's blob_stream clone frames). A REGISTRY
+# READ (p2p/wire.py PROTO_VERSIONS) since round 20 — the version the
+# announce/pull contracts' `=proto` consts enforce is by construction
+# the one this module serves.
+SYNC_PROTO = wire.proto("sync")
 
 
 class NetworkedLibraries:
@@ -285,12 +289,14 @@ class NetworkedLibraries:
                                route: Tuple[str, int]) -> None:
         tunnel = await self.p2p.open_stream(*route, expected=identity)
         try:
+            # pack() fills the t/kind discriminators and the proto
+            # const from the sync.announce declaration — the header
+            # cannot drift from what handle_sync_stream validates.
             await with_timeout(
                 "p2p.frame_send",
-                tunnel.send({"t": "sync", "kind": "new_ops",
-                             "library_id": str(library.id),
-                             "proto": SYNC_PROTO,
-                             "tp": tracing.traceparent()}))
+                tunnel.send(wire.pack(
+                    "sync.announce", library_id=str(library.id),
+                    tp=tracing.traceparent())))
             # Serve the responder's pull loop from our op log. The
             # clone fast path runs at most once per tunnel: a receiver
             # whose watermark stays frozen (persistent per-op failure)
@@ -304,16 +310,21 @@ class NetworkedLibraries:
                                          tunnel.recv())
                 if not isinstance(req, dict) or req.get("kind") == "done":
                     break
-                if int(req.get("proto", 1)) != SYNC_PROTO:
+                try:
+                    req = wire.unpack("sync.pull.request", req)
+                except wire.WireVersionError as e:
                     # A stale peer would misparse our ops (see SYNC_PROTO)
                     # — refuse to serve it rather than corrupt its log.
-                    logger.warning(
-                        "not serving sync pull: peer wire proto %s != "
-                        "ours %d", req.get("proto", 1), SYNC_PROTO)
+                    logger.warning("not serving sync pull: %s", e)
                     await with_timeout(
                         "p2p.frame_send",
-                        tunnel.send({"ops": [], "has_more": False}))
+                        tunnel.send(wire.pack("sync.pull.page",
+                                              ops=[], has_more=False)))
                     break
+                # Any OTHER contract breach propagates: the finally
+                # closes the tunnel — the declared teardown path for a
+                # peer speaking off-schema (the auditor already counted
+                # the frame when armed).
                 clocks = [(bytes(i), int(t)) for i, t in req["clocks"]]
                 # Clone fast path: a fresh peer (zero watermark for the
                 # blob-authoring instances) gets the stored blob pages
@@ -337,29 +348,33 @@ class NetworkedLibraries:
                         clocks=clocks,
                         count=min(int(req.get("count", OPS_PER_REQUEST)),
                                   OPS_PER_REQUEST)))
-                await with_timeout("p2p.frame_send", tunnel.send({
-                    "ops": [op.to_wire() for op in ops],
-                    "has_more": len(ops) >= OPS_PER_REQUEST,
-                }))
+                await with_timeout("p2p.frame_send", tunnel.send(
+                    wire.pack("sync.pull.page",
+                              ops=[op.to_wire() for op in ops],
+                              has_more=len(ops) >= OPS_PER_REQUEST)))
         finally:
             tunnel.close()
 
     # -- responder (p2p/sync/mod.rs:379-446) -------------------------------
 
     async def handle_sync_stream(self, tunnel, header: dict) -> None:
-        proto = int(header.get("proto", 1))
-        if proto != SYNC_PROTO:
-            logger.warning(
-                "refusing sync stream: peer wire proto %d != ours %d",
-                proto, SYNC_PROTO)
+        try:
+            header = wire.unpack("sync.announce", header)
+        except wire.WireVersionError as e:
+            # Version skew gets the POLITE refusal (a real v2 peer
+            # deserves a clean done, not a torn tunnel) …
+            logger.warning("refusing sync stream: %s", e)
             await with_timeout("p2p.frame_send",
-                               tunnel.send({"kind": "done"}))
+                               tunnel.send(wire.pack("sync.done")))
             return
+        # … while any other contract breach propagates to manager.py's
+        # generic handler: P2PError event + tunnel close, the declared
+        # disconnect path for an off-schema peer.
         lib = self.node.libraries.get(
             uuidlib.UUID(str(header["library_id"])))
         if lib is None:
             await with_timeout("p2p.frame_send",
-                               tunnel.send({"kind": "done"}))
+                               tunnel.send(wire.pack("sync.done")))
             return
         # Continue the originator's trace (the header's tp field):
         # this node's pull spans — and the ingester task spawned under
@@ -395,32 +410,33 @@ class NetworkedLibraries:
                     continue
                 if req.kind == ReqKind.FINISHED:
                     await with_timeout("p2p.frame_send",
-                                       tunnel.send({"kind": "done"}))
+                                       tunnel.send(wire.pack("sync.done")))
                     return
                 if req.kind != ReqKind.MESSAGES:
                     continue
-                await with_timeout("p2p.frame_send", tunnel.send({
-                    "kind": "messages",
-                    "clocks": [[i, t] for i, t in req.timestamps],
-                    "count": OPS_PER_REQUEST,
-                    "proto": SYNC_PROTO,
-                    # Trace continuity in the reverse direction too:
-                    # the pull-request frame carries this node's span
-                    # (a child of the originator's, once continued
-                    # above) so wire captures show one id everywhere.
-                    "tp": tracing.traceparent(),
-                }))
+                # Trace continuity in the reverse direction too: the
+                # pull-request frame carries this node's span (a child
+                # of the originator's, once continued above) so wire
+                # captures show one id everywhere. pack() supplies the
+                # kind/proto consts from the declaration.
+                await with_timeout("p2p.frame_send", tunnel.send(
+                    wire.pack("sync.pull.request",
+                              clocks=[[i, t] for i, t in req.timestamps],
+                              count=OPS_PER_REQUEST,
+                              tp=tracing.traceparent())))
                 # The originator runs get_ops off-loop over bulk op
                 # logs before this page arrives.
                 page = await with_timeout("sync.pull.page", tunnel.recv())
                 if isinstance(page, dict) and \
                         page.get("kind") == "blob_stream":
                     # Clone fast path: the originator answered our pull
-                    # request with a verbatim blob-page stream. Drain it
-                    # here (batched apply + per-page acks), then hand
-                    # the ingester an empty has_more page so its loop
-                    # re-requests with the advanced clocks and the
+                    # request with a verbatim blob-page stream. Hold
+                    # the stream header to its contract, drain the
+                    # stream here (batched apply + per-page acks), then
+                    # hand the ingester an empty has_more page so its
+                    # loop re-requests with the advanced clocks and the
                     # normal per-op path serves the row tail.
+                    wire.unpack("clone.stream", page)
                     n, _fast, _fb = await pump_clone_stream(
                         library.sync, tunnel.recv, tunnel.send,
                         ingester.errors)
@@ -429,6 +445,7 @@ class NetworkedLibraries:
                         instance=library.sync.instance, messages=[],
                         has_more=True))
                     continue
+                page = wire.unpack("sync.pull.page", page)
                 ops = [CRDTOperation.from_wire(raw)
                        for raw in page.get("ops", [])]
                 ingester.deliver(MessagesEvent(

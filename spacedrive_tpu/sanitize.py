@@ -62,6 +62,15 @@ engine actually depends on:
   source was never fsynced against the artifact's declared policy is
   `persist_unfsynced_rename` — raised in tier-1, counted into
   `sd_persist_violations_total{kind}` in production.
+- **Wire frame auditor** (round 20, armed via `p2p/wire.arm()` at
+  install unless `SDTPU_WIRE_AUDIT=off` — the runtime twin of
+  sdlint's wire-discipline / schema-drift / proto-compat passes):
+  every frame crossing a tunnel in either direction is classified
+  against the declared wire contracts (p2p/wire.py) — an undeclared
+  kind, a schema mismatch, a size-cap breach, or a version skew is a
+  `wire_violation` — raised in tier-1, counted into
+  `sd_wire_violations_total{kind}` in production while conforming
+  traffic feeds the `sd_wire_frames_total{name,dir}` census.
 - **Cross-thread race recorder** (round 13, armed via
   `threadctx.arm()` at install unless `SDTPU_RACE_GUARD=off` — the
   runtime twin of sdlint's shared-mutation / thread-boundary /
@@ -433,6 +442,14 @@ def install() -> bool:
     from . import persist
 
     persist.arm(_mode, _record)
+    # Arm the protocol twin: the tunnel seam classifies + validates
+    # every frame against the declared wire contracts — breaches flow
+    # through _record as `wire_violation`. SDTPU_WIRE_AUDIT=off skips
+    # the arming (wire checks it — read once, at install); pack/unpack
+    # validate regardless.
+    from .p2p import wire
+
+    wire.arm(_mode, _record)
     _installed = True
     return True
 
@@ -463,4 +480,7 @@ def uninstall() -> None:
     from . import persist
 
     persist.disarm()
+    from .p2p import wire
+
+    wire.disarm()
     _installed = False

@@ -39,6 +39,7 @@ import asyncio
 from typing import Optional
 
 from .. import channels, chaos
+from ..p2p import wire
 from ..telemetry import (
     SYNC_CLONE_PAGES_RELAYED,
     SYNC_CLONE_WINDOW_STALLS,
@@ -108,13 +109,13 @@ async def serve_clone_stream(sync, tunnel, clocks,
             if not started:
                 await with_timeout(
                     "p2p.frame_send",
-                    tunnel.send({"kind": "blob_stream",
-                                 "window": CLONE_WINDOW}))
+                    tunnel.send(wire.pack("clone.stream",
+                                          window=CLONE_WINDOW)))
                 started = True
             if kind == "ops":
-                await with_timeout("p2p.frame_send", tunnel.send({
-                    "kind": "clone_ops",
-                    "ops": [op.to_wire() for op in item]}))
+                await with_timeout("p2p.frame_send", tunnel.send(
+                    wire.pack("clone.ops",
+                              ops=[op.to_wire() for op in item])))
                 continue
             # Chaos seam: a dropped page starves the ack window (the
             # sync.clone.ack budget notices), a disconnect tears the
@@ -124,7 +125,7 @@ async def serve_clone_stream(sync, tunnel, clocks,
             f = chaos.hit("sync.clone.page")
             dropped = f is not None and await chaos.apply_async(f)
             if not dropped:
-                tunnel.send_nowait({"kind": "blob_page", **item})
+                tunnel.send_nowait(wire.pack("clone.page", **item))
                 SYNC_CLONE_PAGES_RELAYED.inc()
             inflight += 1
             if inflight >= CLONE_WINDOW:
@@ -139,7 +140,9 @@ async def serve_clone_stream(sync, tunnel, clocks,
                 # commits a whole page behind each ack.
                 ack = await with_timeout("sync.clone.ack",
                                          tunnel.recv())
-                if not isinstance(ack, dict) or ack.get("kind") != "ack":
+                try:
+                    wire.unpack("clone.ack", ack)
+                except wire.WireError:
                     raise ConnectionError(
                         f"clone stream: bad ack frame {ack!r}")
                 inflight -= 1
@@ -147,7 +150,9 @@ async def serve_clone_stream(sync, tunnel, clocks,
         await with_timeout("sync.clone.drain", tunnel.drain())
         while inflight > 0:
             ack = await with_timeout("sync.clone.ack", tunnel.recv())
-            if not isinstance(ack, dict) or ack.get("kind") != "ack":
+            try:
+                wire.unpack("clone.ack", ack)
+            except wire.WireError:
                 raise ConnectionError(
                     f"clone stream: bad ack frame {ack!r}")
             inflight -= 1
@@ -156,5 +161,5 @@ async def serve_clone_stream(sync, tunnel, clocks,
         raise
     if started:
         await with_timeout("p2p.frame_send",
-                           tunnel.send({"kind": "blob_done"}))
+                           tunnel.send(wire.pack("clone.done")))
     return started

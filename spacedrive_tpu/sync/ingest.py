@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from .. import channels, chaos, tasks
+from ..p2p import wire
 from ..telemetry import SYNC_INGEST_PAGES
 from ..timeouts import with_timeout
 from .crdt import CRDTOperation
@@ -106,9 +107,9 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             return  # dropped on the wire
         await with_timeout(
             "sync.clone.ack_send",
-            send({"kind": "ack",
-                  "ts": sync.timestamps.get(pub, 0),
-                  "fast": bool(fast)}))
+            send(wire.pack("clone.ack",
+                           ts=sync.timestamps.get(pub, 0),
+                           fast=bool(fast))))
 
     while True:
         frame = await with_timeout("sync.clone.frame", recv())
@@ -116,6 +117,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
         if kind == "blob_done":
             return applied, fast_pages, fallback_pages
         if kind == "clone_ops":
+            frame = wire.unpack("clone.ops", frame)
             ops = [CRDTOperation.from_wire(raw)
                    for raw in frame.get("ops", [])]
             live = [op for op in ops if op.instance not in dirty]
@@ -131,6 +133,7 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
                     if _frozen(pub):
                         dirty.add(pub)
         elif kind == "blob_page":
+            frame = wire.unpack("clone.page", frame)
             pub = bytes(frame["instance"])
             if pub in dirty or _frozen(pub):
                 dirty.add(pub)
@@ -151,7 +154,10 @@ async def pump_clone_stream(sync: SyncManager, recv, send,
             # exactly the right place.
             await _send_ack(pub, fast)
         else:
-            raise ValueError(f"unexpected clone-stream frame: {frame!r}")
+            # WireError IS a ValueError — pre-registry callers catching
+            # the old bare ValueError still catch this.
+            raise wire.WireError(
+                f"unexpected clone-stream frame: {frame!r}")
 
 
 class Ingester:

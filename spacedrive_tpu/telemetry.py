@@ -25,7 +25,7 @@ Design constraints, in order:
   Names follow `sd_<layer>_<what>[_total|_seconds|_bytes]` with
   layers jobs | identifier | sync | p2p | store | api | trace |
   sanitize | jit | task | timeout | chan | health | sql | chaos |
-  backoff.
+  backoff | wire.
 - **Windowed reads without resets.** Counters and histograms expose
   `snapshot_delta(cursor)` — an exact delta view since a previous
   cursor — so the health observatory (health.py) can compute windowed
@@ -947,3 +947,27 @@ PERSIST_VIOLATIONS = counter(
     "with no preceding file fsync against the artifact's declared "
     "policy) — raised in tier-1, counted in production",
     labelnames=("kind",))
+
+# -- wire plane (p2p/wire.py) ------------------------------------------------
+WIRE_FRAMES = counter(
+    "sd_wire_frames_total",
+    "Frames validated by the armed wire auditor at the pack/unpack "
+    "seam, per declared message name and direction (`in` = decoded "
+    "off a transport, `out` = encoded toward one) — the live census "
+    "of which declared contracts actually carry traffic",
+    labelnames=("name", "dir"))
+WIRE_VIOLATIONS = counter(
+    "sd_wire_violations_total",
+    "Wire-auditor detections (wire.arm, with the sanitizer), by "
+    "kind: undeclared (frame matching no declared contract) | "
+    "schema (declared kind, payload drifted from its schema) | "
+    "size_cap (frame over its declared cap) | proto_skew (version "
+    "const mismatch) — raised in tier-1, counted in production",
+    labelnames=("kind",))
+WIRE_BYTES = counter(
+    "sd_wire_bytes_total",
+    "Payload bytes carried by audited frames, per declared message "
+    "name (plaintext msgpack size at the tunnel seam — AEAD and "
+    "length-header overhead excluded), so one chatty contract's "
+    "share of the mesh is attributable by name",
+    labelnames=("name",))

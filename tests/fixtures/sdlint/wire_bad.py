@@ -1,0 +1,42 @@
+# sdlint-scope: wire
+"""wire-discipline known-POSITIVES.
+
+Every way a frame can dodge the wire registry
+(spacedrive_tpu/p2p/wire.py): hand-built discriminator dicts, dynamic
+and undeclared pack names, bare verdict literals at a send, and a
+declaration the static side cannot see.
+"""
+
+from spacedrive_tpu.p2p import wire
+
+KIND = "fx." + "computed"
+
+# computed-declaration: invisible to every static consumer
+wire.declare_message(KIND, "p2p", "both", {"t": "=fx"},
+                     size_cap=4096, timeout_budget="p2p.ping")
+
+
+def hand_built_frame():
+    # raw-kind-literal: pack() fills discriminators itself
+    return {"t": "ping", "tp": None}
+
+
+async def dynamic_name(tunnel, kind):
+    # dynamic-kind: the inventory/grid/drift checks must see the name
+    await tunnel.send(wire.pack(kind))
+
+
+def undeclared_name(raw):
+    # undeclared-kind: no such declaration
+    return wire.unpack("fx.no.such.message", raw)
+
+
+def undeclared_group():
+    # undeclared-kind: no such proto group in PROTO_VERSIONS
+    return wire.proto("fxgroup")
+
+
+async def bare_verdict(tunnel):
+    # raw-value-literal: 'ok' is spaceblock.verdict's declared value —
+    # sending it raw bypasses the values contract
+    await tunnel.send("ok")

@@ -1,0 +1,41 @@
+# sdlint-scope: wire
+"""proto-compat known-POSITIVES.
+
+The compat events the snapshot diff must catch: a schema change with
+no version bump, a declaration missing from the snapshot, a snapshot
+entry whose message is gone, and a hand-rolled proto-field compare.
+The expected snapshot rides along as a WIRE_BASELINE literal
+(fixture entries win over the committed file).
+"""
+
+from spacedrive_tpu.p2p import wire
+
+WIRE_BASELINE = {
+    # schema-no-bump: the declaration below grew field 'b' but 'p2p'
+    # is still the version this entry recorded
+    "fx.compat.msg": {
+        "proto": "p2p", "version": 1, "size_cap": 4096,
+        "schema": {"kind": "=fxmsg", "a": "str"},
+    },
+    # removed-message: nothing declares this any more
+    "fx.compat.ghost": {
+        "proto": "p2p", "version": 1, "size_cap": 4096,
+        "schema": {"kind": "=fxghost"},
+    },
+}
+
+wire.declare_message(
+    "fx.compat.msg", "p2p", "both",
+    {"kind": "=fxmsg", "a": "str", "b": "int"},
+    size_cap=4096, timeout_budget="p2p.ping")
+
+# missing-snapshot: declared, no baseline entry anywhere
+wire.declare_message(
+    "fx.compat.unsnapshotted", "p2p", "both",
+    {"kind": "=fxnew"},
+    size_cap=4096, timeout_budget="p2p.ping")
+
+
+def adhoc_version_gate(frame):
+    # adhoc-version-check: wire.unpack IS the version check
+    return frame.get("proto") == 3

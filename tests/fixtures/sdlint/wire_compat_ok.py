@@ -1,0 +1,33 @@
+# sdlint-scope: wire
+"""proto-compat known-NEGATIVES: compat events handled by the book."""
+
+from spacedrive_tpu.p2p import wire
+
+WIRE_BASELINE = {
+    # unchanged shape, same version: nothing to report
+    "fx.ok.msg": {
+        "proto": "p2p", "version": 1, "size_cap": 4096,
+        "schema": {"kind": "=fxok", "a": "str"},
+    },
+    # schema changed WITH a bump: the entry records the old version,
+    # the registry's group moved on — the diff is satisfied
+    "fx.ok.bumped": {
+        "proto": "p2p", "version": 0, "size_cap": 4096,
+        "schema": {"kind": "=fxbumped", "old": "str"},
+    },
+}
+
+wire.declare_message(
+    "fx.ok.msg", "p2p", "both",
+    {"kind": "=fxok", "a": "str"},
+    size_cap=4096, timeout_budget="p2p.ping")
+
+wire.declare_message(
+    "fx.ok.bumped", "p2p", "both",
+    {"kind": "=fxbumped", "renamed": "str"},
+    size_cap=4096, timeout_budget="p2p.ping")
+
+
+def registry_version_gate(header):
+    # the declared idiom: unpack refuses skew itself
+    return wire.unpack("sync.announce", header)

@@ -1,0 +1,42 @@
+# sdlint-scope: wire
+"""schema-drift known-POSITIVES.
+
+Field traffic off the declared schema on both sides of an exchange:
+packs that smuggle or omit fields, reads of fields no declaration
+carries, and a hand-built discriminator frame missing a required
+field.
+"""
+
+from spacedrive_tpu.p2p import wire
+
+
+def smuggled_pack():
+    # smuggled-field: 'extra' is not in p2p.pair.request's schema
+    return wire.pack("p2p.pair.request", library_id="x",
+                     library_name="y", listen_port=7373,
+                     instance={}, extra=1)
+
+
+def incomplete_pack():
+    # missing-field: library_name / listen_port / instance omitted —
+    # the call raises WireSchemaError at runtime
+    return wire.pack("p2p.pair.request", library_id="x")
+
+
+def phantom_read(raw):
+    # unknown-field-read: no declaration of sync.pull.request carries
+    # a 'cursor' field — no peer ever sends it
+    req = wire.unpack("sync.pull.request", raw)
+    return req.get("cursor")
+
+
+def phantom_subscript(raw):
+    # unknown-field-read: subscript form
+    page = wire.unpack("sync.pull.page", raw)
+    return page["total"]
+
+
+def hand_built_incomplete():
+    # missing-field: a literal clone.ack frame without 'fast'
+    # (also wire-discipline's raw-kind-literal — different pass)
+    return {"kind": "ack", "ts": 4}

@@ -1,0 +1,34 @@
+# sdlint-scope: wire
+"""schema-drift known-NEGATIVES: field traffic inside the contract."""
+
+from spacedrive_tpu.p2p import wire
+
+
+def full_pack():
+    return wire.pack("p2p.pair.request", library_id="x",
+                     library_name="y", listen_port=7373, instance={})
+
+
+def optional_omitted():
+    # optional fields ('?') and consts are pack()'s to fill
+    return wire.pack("sync.pull.request", clocks=[], count=100)
+
+
+def splat_pack(fields):
+    # **kwargs packs are statically unknowable — the runtime check
+    # owns them
+    return wire.pack("p2p.pair.request", **fields)
+
+
+def declared_reads(raw):
+    page = wire.unpack("sync.pull.page", raw)
+    return page.get("ops"), page["has_more"]
+
+
+def reassigned_var(raw, store):
+    # once the name stops holding the unpacked frame, its reads are
+    # the new value's business, not the schema's
+    page = wire.unpack("sync.pull.page", raw)
+    ops = page.get("ops")
+    page = store.lookup(ops)
+    return page["anything_at_all"]

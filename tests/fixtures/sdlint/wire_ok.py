@@ -1,0 +1,26 @@
+# sdlint-scope: wire
+"""wire-discipline known-NEGATIVES: the blessed frame shapes."""
+
+from spacedrive_tpu.p2p import wire
+
+SYNC_PROTO = wire.proto("sync")
+
+
+async def declared_pack(tunnel):
+    await tunnel.send(wire.pack("p2p.ping", tp=None))
+
+
+def declared_unpack(raw):
+    return wire.unpack("p2p.pong", raw)
+
+
+async def declared_verdict(tunnel):
+    # the values contract: the verdict goes through pack, so the
+    # declared set is enforced
+    verdict = wire.pack("spaceblock.verdict", value="ok")
+    await tunnel.send(verdict)
+
+
+def undeclared_discriminator():
+    # a dict with a t/kind value NO declaration claims is not a frame
+    return {"kind": "fixture-local-state", "tp": None}

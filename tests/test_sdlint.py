@@ -637,7 +637,8 @@ def test_every_registered_pass_ran_on_tree():
         "queue-discipline", "backpressure", "unbounded-growth",
         "shared-mutation", "thread-boundary", "guard-consistency",
         "sql-discipline", "tx-shape", "schema-parity",
-        "io-durability", "crash-atomicity", "tmp-hygiene"}
+        "io-durability", "crash-atomicity", "tmp-hygiene",
+        "wire-discipline", "schema-drift", "proto-compat"}
 
 
 DEVICE_PASSES = ("jit-stability", "dtype-discipline", "host-transfer")
@@ -1175,3 +1176,124 @@ def test_persist_registry_static_runtime_parity():
     dead = set(persist.ARTIFACTS) - referenced
     assert not dead, (
         f"declared artifacts never written anywhere: {dead}")
+
+
+# -- wire-discipline / schema-drift / proto-compat (round 20) ---------------
+
+def test_wire_discipline_flags_known_positives():
+    found = _lint_fixture("wire_bad.py", "wire-discipline")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "non-literal" in by_code.get("computed-declaration", set())
+    assert "t=ping" in by_code.get("raw-kind-literal", set())
+    assert "wire.pack" in by_code.get("dynamic-kind", set())
+    assert {"fx.no.such.message", "fxgroup"} <= \
+        by_code.get("undeclared-kind", set())
+    assert "ok" in by_code.get("raw-value-literal", set())
+
+
+def test_wire_discipline_passes_known_negatives():
+    assert _lint_fixture("wire_ok.py", "wire-discipline") == []
+
+
+def test_schema_drift_flags_known_positives():
+    found = _lint_fixture("wire_drift_bad.py", "schema-drift")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "p2p.pair.request.extra" in \
+        by_code.get("smuggled-field", set())
+    assert {"p2p.pair.request.library_name",
+            "p2p.pair.request.listen_port",
+            "p2p.pair.request.instance",
+            "clone.ack.fast"} <= by_code.get("missing-field", set())
+    assert {"sync.pull.request.cursor", "sync.pull.page.total"} <= \
+        by_code.get("unknown-field-read", set())
+
+
+def test_schema_drift_passes_known_negatives():
+    # includes the reassignment case: once a name stops holding the
+    # unpacked frame, its reads leave the schema's jurisdiction
+    assert _lint_fixture("wire_drift_ok.py", "schema-drift") == []
+
+
+def test_proto_compat_flags_known_positives():
+    found = _lint_fixture("wire_compat_bad.py", "proto-compat")
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, set()).add(f.ident)
+    assert "fx.compat.msg" in by_code.get("schema-no-bump", set())
+    assert "fx.compat.unsnapshotted" in \
+        by_code.get("missing-snapshot", set())
+    assert "fx.compat.ghost" in by_code.get("removed-message", set())
+    assert "proto-compare" in by_code.get("adhoc-version-check", set())
+
+
+def test_proto_compat_passes_known_negatives():
+    # fx.ok.bumped changed shape WITH a version bump — clean
+    assert _lint_fixture("wire_compat_ok.py", "proto-compat") == []
+
+
+def test_proto_compat_raw_decode_scoped_to_p2p():
+    """msgpack.unpackb outside the tunnel seam is flagged in the p2p
+    plane only; the discovery beacon's two decodes carry documented
+    waivers (its UDP envelope is pre-tunnel, signed, its own format)."""
+    import ast
+
+    from tools.sdlint.passes.proto_compat import ProtoCompatPass
+
+    project = load_project(ROOT)
+    found = ProtoCompatPass().run(project)
+    raw = [f for f in found if f.code == "raw-decode"]
+    assert {f.path for f in raw} == {"spacedrive_tpu/p2p/discovery.py"}
+    src = {s.relpath: s for s in project.files}[
+        "spacedrive_tpu/p2p/discovery.py"]
+    for f in raw:
+        line = src.lines[f.lineno - 1]
+        assert "sdlint: ok[proto-compat]" in line, (
+            f"undocumented raw decode at discovery.py:{f.lineno}")
+
+
+def test_wire_baseline_snapshot_matches_registry():
+    """The committed wire_baseline.json IS the current registry — a
+    declaration change without `--write-wire-baseline` (and a version
+    bump) must fail here and in the proto-compat pass."""
+    import json
+
+    from spacedrive_tpu.p2p import wire
+
+    with open(os.path.join(ROOT, "tools", "sdlint",
+                           "wire_baseline.json"),
+              encoding="utf-8") as f:
+        committed = json.load(f)["messages"]
+    assert committed == wire.baseline_snapshot()
+
+
+def test_wire_registry_static_runtime_parity():
+    """The AST view of declare_message() calls in wire.py must match
+    the imported registry message-for-message, token-for-token — a
+    computed declaration would silently blind all three passes."""
+    from spacedrive_tpu.p2p import wire
+    from tools.sdlint.passes import _wire
+
+    static = _wire.registry_decls(ROOT)
+    assert set(static) == set(wire.MESSAGES), (
+        "the AST view of declare_message() calls must match the "
+        "imported registry")
+    versions = _wire.proto_versions(ROOT)
+    assert versions == wire.PROTO_VERSIONS
+    for name, decl in static.items():
+        assert _wire.snapshot_entry(decl, versions) == \
+            wire.baseline_snapshot()[name], name
+
+
+def test_cli_wire_table_covers_every_declared_message(capsys):
+    from tools.sdlint.__main__ import main
+
+    assert main(["--wire-table"]) == 0
+    out = capsys.readouterr().out
+    from spacedrive_tpu.p2p import wire
+
+    for name in wire.MESSAGES:
+        assert f"`{name}`" in out

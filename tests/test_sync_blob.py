@@ -759,8 +759,9 @@ def test_pump_clone_stream_caps_error_history():
         def receive_blob_pages(self, pages):
             return 1, [f"op {i} failed" for i in range(100)], True
 
-    frames = [{"kind": "blob_page", "instance": b"x" * 16,
-               "max_ts": i + 1} for i in range(10)]
+    frames = [{"kind": "blob_page", "model": "object",
+               "instance": b"x" * 16, "min_ts": i + 1, "max_ts": i + 1,
+               "n_ops": 1, "data": b""} for i in range(10)]
     frames.append({"kind": "blob_done"})
 
     async def run():

@@ -1,0 +1,408 @@
+"""Declared wire contracts (spacedrive_tpu/p2p/wire.py).
+
+Unit coverage for the registry itself — pack/unpack semantics, frame
+classification, the tunnel-seam auditor and its arming switch — plus
+the transport-level regression tests the contracts promise:
+
+- oversize refusal at every seam a frame enters (the transport's
+  MAX_FRAME bound, `unpack(size=)`, the binary scalar check);
+- the AEAD tunnel round-trips every protocol family raise-clean with
+  the conftest-armed auditor watching both directions (skipped where
+  the container lacks `cryptography` — the registry itself imports
+  without it by design);
+- a two-node stub-transport load_bench smoke that must finish with a
+  zero wire-violation census while real clone frames flow.
+
+tools/wire_grid.py (gated by test_wire_grid.py) owns the systematic
+message x mutation matrix; this file owns the semantics the grid
+builds on.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from spacedrive_tpu import timeouts
+from spacedrive_tpu.p2p import wire
+from spacedrive_tpu.telemetry import WIRE_FRAMES, WIRE_VIOLATIONS
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _labeled(counter):
+    """{labels-tuple: value} snapshot of one labeled counter family."""
+    return {tuple(sorted(labels.items())): metric.value
+            for labels, metric in counter.samples() if labels}
+
+
+def _delta(before, after):
+    return {k: after[k] - before.get(k, 0.0) for k in after
+            if after[k] != before.get(k, 0.0)}
+
+
+@contextlib.contextmanager
+def _auditor():
+    """Arm the frame auditor with a collecting recorder; restore the
+    session's arming (conftest installs raise mode) on exit."""
+    prev = (wire._armed, wire._mode, wire._recorder)
+    seen = []
+    wire.arm("count",
+             lambda kind, detail, may_raise: seen.append((kind, detail)))
+    try:
+        yield seen
+    finally:
+        wire._armed, wire._mode, wire._recorder = prev
+
+
+# -- the registry itself -----------------------------------------------------
+
+def test_registry_inventory_invariants():
+    """Every declaration is internally coherent: name prefix == proto
+    group, version == the group's PROTO_VERSIONS entry, caps bounded
+    by MAX_FRAME, budgets declared in timeouts.py, exactly one payload
+    family."""
+    assert len(wire.MESSAGES) >= 26
+    for name, msg in wire.MESSAGES.items():
+        assert name.split(".")[0] == msg.group
+        assert msg.version == wire.PROTO_VERSIONS[msg.group]
+        assert 0 < msg.size_cap <= wire.MAX_FRAME
+        assert msg.timeout_budget in timeouts.TIMEOUTS
+        families = (msg.values is not None, msg.binary, bool(msg.fields))
+        assert sum(families) == 1, (name, families)
+        assert msg.doc
+
+
+def test_registry_lookups_refuse_unknowns():
+    with pytest.raises(wire.WireError, match="undeclared"):
+        wire.message("nope.frame")
+    with pytest.raises(KeyError, match="unknown wire proto group"):
+        wire.proto("nope")
+    with pytest.raises(KeyError, match="declares no slice_cap"):
+        wire.slice_cap("p2p.ping")
+    assert wire.slice_cap("obs.trace") == \
+        wire.MESSAGES["obs.trace"].slice_cap
+
+
+def test_module_constants_are_registry_reads():
+    """Satellite: the old per-module literals (TRACE_SLICE_LIMIT, the
+    obs proto rev) are now reads off the declarations — static and
+    runtime cannot drift."""
+    from spacedrive_tpu.p2p import obs
+
+    assert obs.TRACE_SLICE_LIMIT == wire.slice_cap("obs.trace")
+    assert obs.INCIDENT_SLICE_LIMIT == wire.slice_cap("obs.incidents")
+    assert obs.OBS_PROTO == wire.proto("obs")
+
+
+def test_sync_proto_is_a_registry_read():
+    pytest.importorskip("cryptography")
+    from spacedrive_tpu.p2p import sync_net
+
+    assert sync_net.SYNC_PROTO == wire.proto("sync")
+    # sync and clone version together: the clone fast path is a
+    # sync-stream answer
+    assert wire.proto("clone") == wire.proto("sync")
+
+
+# -- pack --------------------------------------------------------------------
+
+def test_pack_fills_consts_and_version_fields():
+    frame = wire.pack("sync.announce", library_id="lib")
+    assert frame == {"t": "sync", "kind": "new_ops",
+                     "library_id": "lib",
+                     "proto": wire.proto("sync")}
+
+
+def test_pack_name_is_positional_only():
+    """spaceblock.request legitimately declares a schema field called
+    `name` — pack's own name parameter must not collide with it."""
+    frame = wire.pack("spaceblock.request", name="f.bin", size=10)
+    assert frame["name"] == "f.bin" and frame["size"] == 10
+
+
+def test_pack_refuses_drift():
+    with pytest.raises(wire.WireSchemaError, match="not in the declared"):
+        wire.pack("p2p.pair.request", extra=1)
+    with pytest.raises(wire.WireSchemaError, match="missing"):
+        wire.pack("p2p.pair.request", library_id="only")
+    with pytest.raises(wire.WireSchemaError, match="must be str"):
+        wire.pack("sync.announce", library_id=7)
+    # bools are not ints, even though Python says so
+    with pytest.raises(wire.WireSchemaError, match="must be int"):
+        wire.pack("clone.ack", ts=True, fast=False)
+    with pytest.raises(wire.WireSchemaError, match="const field"):
+        wire.pack("p2p.ping", t="pong")
+
+
+def test_pack_optional_semantics():
+    assert wire.pack("p2p.ping") == {"t": "ping"}
+    # an explicit optional None rides along (peers see the key)
+    assert wire.pack("p2p.ping", tp=None) == {"t": "ping", "tp": None}
+    # float fields tolerate ints (msgpack peers send both)
+    assert wire.pack("obs.response", status="ok", ts=3)["ts"] == 3
+
+
+def test_pack_values_and_binary_frames():
+    assert wire.pack("p2p.spacedrop.verdict", value="accept") == "accept"
+    assert wire.pack("spaceblock.chunk", value=b"\x01") == b"\x01"
+    with pytest.raises(wire.WireSchemaError, match="not in declared"):
+        wire.pack("p2p.spacedrop.verdict", value="maybe")
+    with pytest.raises(wire.WireSchemaError, match="empty binary"):
+        wire.pack("spaceblock.chunk", value=b"")
+    with pytest.raises(wire.WireSchemaError, match="exactly one kwarg"):
+        wire.pack("spaceblock.chunk", data=b"\x01")
+
+
+# -- unpack ------------------------------------------------------------------
+
+def test_unpack_tolerates_unknown_inbound_fields():
+    """Forward compatibility: a newer peer may send more than we know."""
+    frame = {"t": "ping", "tp": "abc", "novel_field": 42}
+    assert wire.unpack("p2p.ping", frame) is frame
+
+
+def test_unpack_refuses_schema_drift():
+    with pytest.raises(wire.WireSchemaError, match="missing"):
+        wire.unpack("clone.ack", {"kind": "ack", "fast": True})
+    with pytest.raises(wire.WireSchemaError, match="is None"):
+        wire.unpack("clone.ack", {"kind": "ack", "ts": None, "fast": True})
+    with pytest.raises(wire.WireSchemaError, match="const field"):
+        wire.unpack("p2p.ping", {"t": "pong"})
+    with pytest.raises(wire.WireSchemaError, match="must be int"):
+        wire.unpack("clone.ack", {"kind": "ack", "ts": "7", "fast": True})
+    with pytest.raises(wire.WireSchemaError, match="map frame"):
+        wire.unpack("p2p.ping", ["t", "ping"])
+
+
+def test_unpack_version_discipline():
+    ours = wire.proto("sync")
+    good = wire.pack("sync.announce", library_id="lib")
+    assert wire.unpack("sync.announce", good) is good
+    skewed = dict(good, proto=ours + 1)
+    with pytest.raises(wire.WireVersionError, match="peer wire proto"):
+        wire.unpack("sync.announce", skewed)
+    # obs.response REQUIRES its version const; absence is a skew too
+    with pytest.raises(wire.WireVersionError, match="missing"):
+        wire.unpack("obs.response", {"status": "ok"})
+    # "=proto?" tolerates absence but still rejects a present mismatch
+    assert wire.unpack("obs.metrics", {"t": "obs.metrics"})
+    with pytest.raises(wire.WireVersionError):
+        wire.unpack("obs.metrics",
+                    {"t": "obs.metrics", "proto": wire.proto("obs") + 1})
+
+
+def test_unpack_enforces_declared_size_caps():
+    cap = wire.MESSAGES["p2p.ping"].size_cap
+    frame = wire.pack("p2p.ping")
+    assert wire.unpack("p2p.ping", frame, size=cap) is frame
+    with pytest.raises(wire.WireSizeError, match="over the declared"):
+        wire.unpack("p2p.ping", frame, size=cap + 1)
+
+
+def test_binary_frames_carry_their_own_cap():
+    cap = wire.MESSAGES["spaceblock.chunk"].size_cap
+    with pytest.raises(wire.WireSizeError):
+        wire.unpack("spaceblock.chunk", b"\x00" * (cap + 1))
+    with pytest.raises(wire.WireSchemaError, match="raw bytes"):
+        wire.unpack("spaceblock.chunk", "not-bytes")
+
+
+# -- classify ----------------------------------------------------------------
+
+def test_classify_by_discriminator_value_and_shape():
+    assert wire.classify({"t": "ping"}) == ("p2p.ping",)
+    assert wire.classify(
+        wire.pack("sync.announce", library_id="l")) == ("sync.announce",)
+    assert wire.classify("accept") == ("p2p.spacedrop.verdict",)
+    assert wire.classify("ok") == ("spaceblock.verdict",)
+    assert wire.classify(b"\x01") == ("spaceblock.chunk",)
+    assert wire.classify("zork") == ()
+    assert wire.classify({"zork": 1}) == ()
+    assert wire.classify(3.14) == ()
+
+
+def test_classify_structural_fallback_is_deterministic():
+    """The const-less status envelopes are structurally identical —
+    classification returns ALL of them, alphabetically, and the
+    auditor tries each until one unpacks clean."""
+    assert wire.classify({"status": "ok"}) == (
+        "obs.response", "p2p.file.response", "p2p.pair.response")
+
+
+# -- the tunnel-seam auditor -------------------------------------------------
+
+def test_audit_frame_census_and_violation_flow():
+    with _auditor() as seen:
+        frames_before = _labeled(WIRE_FRAMES)
+        control = wire.pack("p2p.ping")
+        assert wire.audit_frame(control, "in", 16) == "p2p.ping"
+        assert seen == []
+        grew = _delta(frames_before, _labeled(WIRE_FRAMES))
+        assert grew == {(("dir", "in"), ("name", "p2p.ping")): 1.0}
+
+        viols_before = _labeled(WIRE_VIOLATIONS)
+        assert wire.audit_frame({"t": "ping", "tp": 7}, "in", 16) is None
+        assert [kind for kind, _ in seen] == ["wire_violation"]
+        assert "p2p.ping" in seen[0][1]
+        grew = _delta(viols_before, _labeled(WIRE_VIOLATIONS))
+        assert grew == {(("kind", "schema"),): 1.0}
+
+
+def test_audit_frame_subkind_attribution():
+    cases = [
+        (dict(wire.pack("sync.announce", library_id="l"),
+              proto=wire.proto("sync") + 1), None, "proto_skew"),
+        (wire.pack("p2p.ping"),
+         wire.MESSAGES["p2p.ping"].size_cap + 1, "size_cap"),
+        ({"t": "no_such_kind"}, 8, "undeclared"),
+    ]
+    for frame, nbytes, want in cases:
+        with _auditor() as seen:
+            before = _labeled(WIRE_VIOLATIONS)
+            assert wire.audit_frame(frame, "out", nbytes) is None
+            assert len(seen) == 1
+            grew = _delta(before, _labeled(WIRE_VIOLATIONS))
+            assert grew == {(("kind", want),): 1.0}, (frame, grew)
+
+
+def test_audit_frame_disarmed_is_inert():
+    prev = (wire._armed, wire._mode, wire._recorder)
+    try:
+        wire.disarm()
+        before = _labeled(WIRE_FRAMES)
+        assert wire.audit_frame(wire.pack("p2p.ping"), "in", 8) is None
+        assert _delta(before, _labeled(WIRE_FRAMES)) == {}
+    finally:
+        wire._armed, wire._mode, wire._recorder = prev
+
+
+def test_wire_audit_off_flag_skips_arming(monkeypatch):
+    prev = (wire._armed, wire._mode, wire._recorder)
+    try:
+        wire.disarm()
+        monkeypatch.setenv("SDTPU_WIRE_AUDIT", "off")
+        wire.arm("raise", lambda kind, detail, may_raise: None)
+        assert not wire.armed()
+        # pack/unpack still validate with the auditor off
+        with pytest.raises(wire.WireSchemaError):
+            wire.pack("p2p.ping", bogus=1)
+        monkeypatch.delenv("SDTPU_WIRE_AUDIT")
+        wire.arm("count", lambda kind, detail, may_raise: None)
+        assert wire.armed()
+    finally:
+        wire._armed, wire._mode, wire._recorder = prev
+
+
+# -- transports --------------------------------------------------------------
+
+def test_transport_frame_cap_is_the_registry_bound():
+    pytest.importorskip("cryptography")
+    from spacedrive_tpu.p2p import proto
+
+    assert proto.MAX_FRAME is wire.MAX_FRAME
+
+    async def oversized_header():
+        reader = asyncio.StreamReader()
+        reader.feed_data(struct.pack(">I", wire.MAX_FRAME + 1))
+        reader.feed_eof()
+        with pytest.raises(proto.ProtoError, match="frame too large"):
+            await proto.read_frame(reader)
+
+    asyncio.run(oversized_header())
+
+
+def test_aead_tunnel_round_trips_raise_clean():
+    """Every protocol family crosses a real ChaCha20-Poly1305 tunnel
+    pair — ping, pairing, sync, clone, plus the bare-string and raw
+    chunk shapes — with the conftest-armed raise-mode auditor watching
+    both directions: any contract breach tears the test down."""
+    pytest.importorskip("cryptography")
+    from spacedrive_tpu.p2p.proto import Tunnel
+
+    frames = [
+        ("p2p.ping", wire.pack("p2p.ping", tp="t1")),
+        ("p2p.pong", wire.pack("p2p.pong")),
+        ("p2p.pair.request", wire.pack(
+            "p2p.pair.request", library_id="lib", library_name="Lib",
+            listen_port=7373, instance={"pub_id": "aa"})),
+        ("p2p.pair.response", wire.pack(
+            "p2p.pair.response", status="accepted",
+            instance={"pub_id": "bb"})),
+        ("sync.announce", wire.pack("sync.announce", library_id="lib")),
+        ("sync.pull.request", wire.pack(
+            "sync.pull.request", clocks=[], count=64)),
+        ("sync.pull.page", wire.pack(
+            "sync.pull.page", ops=[], has_more=False)),
+        ("sync.done", wire.pack("sync.done")),
+        ("clone.stream", wire.pack("clone.stream", window=4)),
+        ("clone.page", wire.pack(
+            "clone.page", model="file_path", instance=b"\x01",
+            min_ts=1, max_ts=2, n_ops=1, data=b"\x02")),
+        ("clone.ack", wire.pack("clone.ack", ts=2, fast=True)),
+        ("clone.done", wire.pack("clone.done")),
+        ("p2p.spacedrop.verdict",
+         wire.pack("p2p.spacedrop.verdict", value="accept")),
+    ]
+
+    async def round_trip():
+        s1, s2 = socket.socketpair()
+        r1, w1 = await asyncio.open_connection(sock=s1)
+        r2, w2 = await asyncio.open_connection(sock=s2)
+        k1, k2 = os.urandom(32), os.urandom(32)
+        a = Tunnel(r1, w1, send_key=k1, recv_key=k2, remote=None)
+        b = Tunnel(r2, w2, send_key=k2, recv_key=k1, remote=None)
+        try:
+            for name, frame in frames:
+                await a.send(frame)
+                got = await b.recv()
+                assert wire.unpack(name, got) == frame
+                # and back the other way, via the pipelined path
+                b.send_nowait(frame)
+                await b.drain()
+                assert wire.unpack(name, await a.recv()) == frame
+            # the raw-bytes shape (spaceblock chunks) has its own seam
+            await a.send_raw(wire.pack("spaceblock.chunk", value=b"\x07"))
+            assert await b.recv_raw() == b"\x07"
+        finally:
+            a.close()
+            b.close()
+            await asyncio.sleep(0)
+
+    asyncio.run(round_trip())
+
+
+def test_two_node_load_bench_smoke_zero_wire_violations(tmp_path):
+    """A two-peer stub-transport fleet (clone fast path end to end)
+    must finish with an EMPTY wire-violation census while real clone
+    frames flow through the audited stub seam — the production-posture
+    twin of the raise-mode tier-1 suite."""
+    artifact = tmp_path / "smoke.json"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "SDTPU_SANITIZE": "1",
+                "SDTPU_SANITIZE_MODE": "count"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.load_bench", "--peers", "2",
+         "--waves", "1", "--ops-per-wave", "256", "--events", "20",
+         "--requests", "2", "--ops-per-peer", "8", "--chaos", "",
+         "--json", str(artifact)],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(artifact.read_text())
+    assert doc["violations"] == []
+    counters = doc["counters"]
+    assert counters["sd_wire_violations_total"]["labeled"] == []
+    census = {(r["labels"]["name"], r["labels"]["dir"]): r["value"]
+              for r in counters["sd_wire_frames_total"]["labeled"]}
+    # the clone burst really crossed the audited stub wire
+    assert census.get(("clone.done", "in"), 0) > 0
+    assert census.get(("clone.done", "out"), 0) > 0
+    assert census.get(("clone.page", "in"), 0) > 0

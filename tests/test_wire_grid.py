@@ -1,0 +1,62 @@
+"""The malformed-frame grid, as a tier-1 gate.
+
+tools/wire_grid.py feeds EVERY declared wire message every applicable
+malformed shape (drop-required, truncate, type-flip, unknown-kind,
+oversize, version-skew) through both entry points — `wire.unpack` and
+the armed tunnel-seam auditor — and asserts reject-without-crash per
+cell. Systematic, not sampled: a new `declare_message` is covered the
+moment it lands, with zero new test code. Subprocess shape follows
+test_crash_grid.py."""
+
+import json
+import os
+import subprocess
+import sys
+
+from spacedrive_tpu.p2p import wire
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+GRID = os.path.join(ROOT, "tools", "wire_grid.py")
+
+
+def _child_env():
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "SDTPU_SANITIZE": "1",
+                "SDTPU_SANITIZE_MODE": "raise"})
+    return env
+
+
+def test_full_grid_passes():
+    """Every declared message rejects every malformed shape without
+    crashing, at both seams — the acceptance gate itself."""
+    proc = subprocess.run(
+        [sys.executable, GRID, "--json", "-"],
+        cwd=ROOT, env=_child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["pass"] is True
+    assert doc["failures"] == []
+    # every declared message gets a row the moment it is declared
+    assert doc["messages"] == sorted(wire.MESSAGES)
+    by_message = {}
+    for r in doc["rounds"]:
+        by_message.setdefault(r["message"], set()).add(r["mutation"])
+    for name, msg in wire.MESSAGES.items():
+        muts = by_message[name]
+        # universal cells: a clean control and an oversize mutant
+        assert {"control", "oversize"} <= muts, (name, muts)
+        if msg.values is not None:
+            assert {"truncate", "type-flip", "unknown-kind"} <= muts
+        elif msg.binary:
+            assert "type-flip" in muts
+        else:
+            assert "drop-required" in muts, (name, muts)
+        if any(f.is_proto for f in msg.fields):
+            assert "version-skew" in muts, (name, muts)
+    # the grid really went through the auditor: mutants record
+    # violations on the same census production dashboards read
+    violated = [r for r in doc["rounds"]
+                if r["mutation"] != "control" and r["violations"]]
+    assert len(violated) >= doc["mutations"] - len(doc["unaudited"])

@@ -77,6 +77,7 @@ except ModuleNotFoundError:
     pass
 
 from spacedrive_tpu import channels, chaos, flags, sanitize, telemetry
+from spacedrive_tpu.p2p import wire
 
 DEFAULT_CHAOS = (
     "sync.clone.page=disconnect:0.04;"
@@ -117,9 +118,14 @@ class _StubEnd:
         self.inbox = inbox
 
     async def send(self, msg: Any) -> None:
+        # Same audit seam as the TCP tunnel (nbytes unknown on the
+        # loopback wire — size caps are the transport's to enforce):
+        # the stub fleet storms the REAL frame contracts too.
+        wire.audit_frame(msg, "out")
         await self.out.put(msg)
 
     def send_nowait(self, msg: Any) -> None:  # sdlint: ok[queue-discipline] the buffer IS the declared bench.load.wire channel
+        wire.audit_frame(msg, "out")
         self.out.put_nowait(msg)
 
     async def drain(self) -> None:
@@ -129,6 +135,7 @@ class _StubEnd:
         frame = await self.inbox.get()
         if frame == _WIRE_CLOSED:
             raise ConnectionError("stub wire: peer end closed")
+        wire.audit_frame(frame, "in")
         return frame
 
     def close(self) -> None:
@@ -262,7 +269,7 @@ async def _clone_burst(lib, clone_peers: List[Any], attempt_s: float
                     # clean end-of-stream so its pump returns (the
                     # wire caller falls through to the per-op loop
                     # here instead).
-                    await origin_end.send({"kind": "blob_done"})
+                    await origin_end.send(wire.pack("clone.done"))
                 return served
             except BaseException:
                 origin_end.close()  # torn conn tears both ends
@@ -689,7 +696,8 @@ def _counter_families() -> Dict[str, Any]:
             "sd_store_busy_retries_total",
             "sd_sync_clone_pages_relayed_total",
             "sd_sync_clone_window_stalls_total",
-            "sd_p2p_reconnects_total")
+            "sd_p2p_reconnects_total",
+            "sd_wire_frames_total", "sd_wire_violations_total")
     snap = telemetry.snapshot()
     return {k: snap[k] for k in keep if k in snap}
 

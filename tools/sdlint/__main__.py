@@ -13,6 +13,8 @@
     python -m tools.sdlint --timeout-table     # README timeout table
     python -m tools.sdlint --chan-table        # README channel table
     python -m tools.sdlint --sql-table         # README statement table
+    python -m tools.sdlint --wire-table        # README wire-message table
+    python -m tools.sdlint --write-wire-baseline  # regen wire snapshot
     python -m tools.sdlint --stats             # per-pass counts + wall-time
 
 Exit status: 0 when every finding is baselined (or none), 1 otherwise.
@@ -104,6 +106,14 @@ def main(argv=None) -> int:
     ap.add_argument("--artifact-table", action="store_true",
                     help="print the generated durable-artifact "
                          "registry table (the persist seam) and exit")
+    ap.add_argument("--wire-table", action="store_true",
+                    help="print the generated wire message-contract "
+                         "table (the p2p frame seam) and exit")
+    ap.add_argument("--write-wire-baseline", action="store_true",
+                    help="regenerate tools/sdlint/wire_baseline.json "
+                         "from the registry (the diff IS the compat "
+                         "review; pair schema changes with a "
+                         "PROTO_VERSIONS bump)")
     ap.add_argument("--stats", action="store_true",
                     help="per-pass finding counts and wall-time "
                          "(informational; exit 0)")
@@ -165,6 +175,32 @@ def main(argv=None) -> int:
         sys.path.insert(0, args.root)
         from spacedrive_tpu import persist
         print(persist.artifact_table_markdown())
+        return 0
+
+    if args.wire_table:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu.p2p import wire
+        print(wire.wire_table_markdown())
+        return 0
+
+    if args.write_wire_baseline:
+        sys.path.insert(0, args.root)
+        from spacedrive_tpu.p2p import wire
+        from .passes import _wire
+        path = os.path.join(args.root, _wire.BASELINE_PATH)
+        doc = {
+            "_comment": "Wire-contract snapshot (proto-compat pass). "
+                        "Regenerate with --write-wire-baseline; a "
+                        "schema change must land WITH a "
+                        "PROTO_VERSIONS bump or the pass flags "
+                        "schema-no-bump.",
+            "messages": wire.baseline_snapshot(),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wire baseline written: {len(doc['messages'])} "
+              f"message(s) -> {_wire.BASELINE_PATH}")
         return 0
 
     if args.stats:

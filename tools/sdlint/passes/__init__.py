@@ -27,6 +27,9 @@ from .schema_parity import SchemaParityPass
 from .io_durability import IoDurabilityPass
 from .crash_atomicity import CrashAtomicityPass
 from .tmp_hygiene import TmpHygienePass
+from .wire_discipline import WireDisciplinePass
+from .schema_drift import SchemaDriftPass
+from .proto_compat import ProtoCompatPass
 
 PASSES = {
     p.name: p for p in (
@@ -41,6 +44,7 @@ PASSES = {
         GuardConsistencyPass(),
         SqlDisciplinePass(), TxShapePass(), SchemaParityPass(),
         IoDurabilityPass(), CrashAtomicityPass(), TmpHygienePass(),
+        WireDisciplinePass(), SchemaDriftPass(), ProtoCompatPass(),
     )
 }
 

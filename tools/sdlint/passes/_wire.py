@@ -1,0 +1,280 @@
+"""Shared wire-contract derivation for the protocol passes.
+
+Pure-AST views of the wire registry (spacedrive_tpu/p2p/wire.py) the
+three protocol passes cross-check — same no-package-import constraint
+as `_sql` / crdt_parity: the linted tree is never imported.
+
+- `decls_in_tree` parses `declare_message(...)` calls — from the
+  central registry and from any project file (fixtures declare their
+  own bad/ok cases; `project_decls` lets fixture declarations win on
+  name collision so cases stay self-contained). Only literal
+  arguments participate; a computed declaration is invisible to the
+  static side and is reported by wire-discipline's
+  computed-declaration code.
+- `MsgDecl.consts` is the t/kind discriminator surface
+  (raw-kind-literal hunts hand-built frames by it), `.fields` the
+  schema token map schema-drift validates reads/packs against.
+- `proto_versions` parses the PROTO_VERSIONS literal — the version
+  the proto-compat snapshot diff keys bumps on.
+- `snapshot_entry` renders one declaration the way
+  `wire.baseline_snapshot()` does, so the committed
+  tools/sdlint/wire_baseline.json and the AST view diff key-for-key.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Project, dotted
+
+WIRE_PATH = "spacedrive_tpu/p2p/wire.py"
+BASELINE_PATH = "tools/sdlint/wire_baseline.json"
+SCOPE_MARKER = "# sdlint-scope: wire"
+# The wire plane's product scope: the modules that speak frames.
+SCOPE_PREFIXES = ("spacedrive_tpu/p2p/", "spacedrive_tpu/sync/")
+
+PACK_APIS = ("pack", "unpack")
+REGISTRY_READS = ("proto", "slice_cap", "message")
+
+
+def in_scope(src) -> bool:
+    """Wire-plane scope: p2p/ + sync/ product modules, plus any file
+    opting in with the `# sdlint-scope: wire` marker (fixtures)."""
+    if src.relpath == WIRE_PATH:
+        return False
+    if src.relpath.startswith(SCOPE_PREFIXES):
+        return True
+    return SCOPE_MARKER in "\n".join(src.lines[:5])
+
+
+def _fold_int(node: ast.AST) -> Optional[int]:
+    """Constant-fold the int expressions declarations use
+    (`64 * 1024 * 1024`, `4096`, `48 << 20`)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left = _fold_int(node.left)
+        right = _fold_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.LShift):
+            return left << right
+    return None
+
+
+@dataclass(frozen=True)
+class MsgDecl:
+    name: str
+    proto: str
+    direction: str
+    # field name -> schema token ("str", "int?", "=ping", "=proto?");
+    # None for values/binary messages.
+    fields: Optional[Dict[str, str]]
+    values: Optional[Tuple[str, ...]]
+    binary: bool
+    size_cap: Optional[int]          # None = computed (invisible)
+    slice_cap: Optional[int]
+    timeout_budget: str
+    path: str
+    lineno: int
+
+    @property
+    def consts(self) -> Dict[str, str]:
+        """The t/kind discriminator literals this message is
+        dispatched on (`=proto` version consts excluded)."""
+        out: Dict[str, str] = {}
+        for f, tok in (self.fields or {}).items():
+            if f in ("t", "kind") and tok.startswith("=") \
+                    and tok not in ("=proto", "=proto?"):
+                out[f] = tok[1:]
+        return out
+
+    def required(self) -> List[str]:
+        """Field names pack() cannot fill itself: non-const,
+        non-optional."""
+        out = []
+        for f, tok in (self.fields or {}).items():
+            if not tok.startswith("=") and not tok.endswith("?"):
+                out.append(f)
+        return out
+
+
+def decls_in_tree(tree: ast.AST, relpath: str) -> List[MsgDecl]:
+    out: List[MsgDecl] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None or d.split(".")[-1] != "declare_message":
+            continue
+        args = list(node.args)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+
+        def _str(n) -> Optional[str]:
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                return n.value
+            return None
+
+        name = _str(args[0]) if args else None
+        proto = _str(args[1]) if len(args) > 1 else None
+        direction = _str(args[2]) if len(args) > 2 else None
+        if name is None or proto is None or direction is None:
+            continue  # computed declaration — invisible statically
+        schema_node = args[3] if len(args) > 3 else kw.get("schema")
+        fields: Optional[Dict[str, str]] = None
+        if isinstance(schema_node, ast.Dict):
+            fields = {}
+            for k, v in zip(schema_node.keys, schema_node.values):
+                fk, fv = _str(k), _str(v)
+                if fk is None or fv is None:
+                    fields = None
+                    break
+                fields[fk] = fv
+        values: Optional[Tuple[str, ...]] = None
+        vnode = kw.get("values")
+        if isinstance(vnode, ast.Tuple):
+            vals = [_str(e) for e in vnode.elts]
+            if all(v is not None for v in vals):
+                values = tuple(vals)  # type: ignore[arg-type]
+        binary = bool(isinstance(kw.get("binary"), ast.Constant)
+                      and kw["binary"].value)
+        size_cap = _fold_int(kw["size_cap"]) if "size_cap" in kw else None
+        slice_cap = _fold_int(kw["slice_cap"]) \
+            if "slice_cap" in kw else None
+        budget = _str(kw.get("timeout_budget")) or ""
+        out.append(MsgDecl(name, proto, direction, fields, values,
+                           binary, size_cap, slice_cap, budget,
+                           relpath, node.lineno))
+    return out
+
+
+def _registry_tree(root: str) -> Optional[ast.AST]:
+    path = os.path.join(root, WIRE_PATH)
+    try:
+        return ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return None
+
+
+def registry_decls(root: str) -> Dict[str, MsgDecl]:
+    tree = _registry_tree(root)
+    if tree is None:
+        return {}
+    return {d.name: d for d in decls_in_tree(tree, WIRE_PATH)}
+
+
+def project_decls(project: Project) -> Dict[str, MsgDecl]:
+    """Central registry + declarations inside the linted files
+    (fixtures). Project files win on name collision so fixture cases
+    stay self-contained."""
+    decls = registry_decls(project.root)
+    for src in project.files:
+        if src.relpath == WIRE_PATH:
+            continue
+        for d in decls_in_tree(src.tree, src.relpath):
+            decls[d.name] = d
+    return decls
+
+
+def proto_versions(root: str) -> Dict[str, int]:
+    """The PROTO_VERSIONS literal from the central registry."""
+    tree = _registry_tree(root)
+    if tree is None:
+        return {}
+    for node in ast.walk(tree):
+        # the registry annotates it (PROTO_VERSIONS: Dict[str, int])
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "PROTO_VERSIONS"
+                   for t in targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            out: Dict[str, int] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(v, ast.Constant):
+                    out[str(k.value)] = int(v.value)
+            return out
+    return {}
+
+
+def const_index(decls: Dict[str, MsgDecl]) -> Dict[str, str]:
+    """Discriminator literal -> message name ('t=ping' / 'kind=ack'
+    keys so raw-kind-literal can point at the declaration)."""
+    out: Dict[str, str] = {}
+    for name, d in decls.items():
+        for f, v in d.consts.items():
+            out[f"{f}={v}"] = name
+    return out
+
+
+def value_index(decls: Dict[str, MsgDecl]) -> Dict[str, str]:
+    """Bare-string values ('ok', 'accept', ...) -> message name."""
+    out: Dict[str, str] = {}
+    for name, d in decls.items():
+        for v in d.values or ():
+            out[v] = name
+    return out
+
+
+def snapshot_entry(d: MsgDecl, versions: Dict[str, int]) -> dict:
+    """One declaration rendered the way wire.baseline_snapshot() does
+    — the unit the proto-compat diff compares."""
+    entry: dict = {
+        "proto": d.proto,
+        "version": versions.get(d.proto, 0),
+        "size_cap": d.size_cap,
+    }
+    if d.fields is not None:
+        entry["schema"] = dict(sorted(d.fields.items()))
+    elif d.values is not None:
+        entry["values"] = list(d.values)
+    else:
+        entry["binary"] = True
+    if d.slice_cap is not None:
+        entry["slice_cap"] = d.slice_cap
+    return entry
+
+
+def imports_wire(tree: ast.AST) -> Dict[str, str]:
+    """Names bound from the wire module in this file: alias -> api
+    name ('' for the module itself). Covers `from . import wire`,
+    `from ..p2p import wire`, `from .wire import pack, unpack`,
+    `import spacedrive_tpu.p2p.wire as wire`."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").rsplit(".", 1)[-1]
+            for a in node.names:
+                if a.name == "wire":
+                    out[a.asname or a.name] = ""
+                elif mod == "wire":
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.rsplit(".", 1)[-1] == "wire":
+                    out[(a.asname or a.name).split(".")[0]] = ""
+    return out
+
+
+def wire_call(site_name: str, bound: Dict[str, str]) -> Optional[str]:
+    """The wire API a dotted call resolves to ('pack', 'unpack',
+    'proto', 'slice_cap', ...), or None if it is not a wire call."""
+    parts = site_name.split(".")
+    if len(parts) == 2 and bound.get(parts[0]) == "":
+        return parts[1]
+    if len(parts) == 1 and bound.get(parts[0], None):
+        return bound[parts[0]]
+    return None

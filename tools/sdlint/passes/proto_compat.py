@@ -1,0 +1,179 @@
+"""Pass: proto-compat — schema changes bump versions, decodes stay caged.
+
+A wire contract is a promise to OTHER nodes: changing a message's
+shape without bumping its proto group's version ships two
+incompatible decoders under one version number — the silent-corruption
+failure SYNC_PROTO's comment describes. This pass diffs the current
+registry (spacedrive_tpu/p2p/wire.py, by AST) against the COMMITTED
+snapshot `tools/sdlint/wire_baseline.json` (regenerated via
+`python -m tools.sdlint --write-wire-baseline` — reviewing that diff
+is reviewing the compat story), and polices the decode paths that
+would bypass the registry's caging.
+
+Fixtures embed their own expected snapshot as a module-level
+``WIRE_BASELINE = {...}`` dict literal (fixture entries win), so
+cases stay self-contained.
+
+Codes:
+
+- ``schema-no-bump``: a message's schema/values/size_cap changed
+  from the snapshot while its proto group's version did not — bump
+  the version in wire.PROTO_VERSIONS (both refusal directions key on
+  it) and regenerate the snapshot.
+- ``missing-snapshot``: a declared message absent from the snapshot
+  — regenerate it so the NEXT change has a baseline to diff against.
+- ``removed-message``: a snapshot message no longer declared —
+  removal is a compat event too (old peers still send it); bump and
+  regenerate.
+- ``adhoc-version-check``: comparing a frame's raw ``proto`` field
+  in wire-plane code — `wire.unpack` IS the version check (it
+  raises WireVersionError on skew); a hand-rolled compare drifts
+  from the registry's version the moment it bumps.
+- ``raw-decode``: `msgpack.unpackb` in `spacedrive_tpu/p2p/` outside
+  wire.py/proto.py — frames must enter through the tunnel seam
+  (read_msg/recv), where the size cap and the armed auditor live.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List
+
+from ..core import Finding, Project
+from . import _wire
+
+PASS = "proto-compat"
+
+# proto.py holds the transport's own decode (read_msg / Tunnel.recv —
+# the audit seam itself); everything else in p2p/ must not re-decode.
+DECODE_EXEMPT = (_wire.WIRE_PATH, "spacedrive_tpu/p2p/proto.py")
+
+
+def committed_baseline(root: str) -> Dict[str, dict]:
+    path = os.path.join(root, _wire.BASELINE_PATH)
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data.get("messages", {})
+
+
+def fixture_baselines(project: Project) -> Dict[str, dict]:
+    """Module-level ``WIRE_BASELINE = {...}`` literals in linted
+    files — the fixture-wins half of the snapshot."""
+    out: Dict[str, dict] = {}
+    for src in project.files:
+        for node in src.tree.body:
+            if not (isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "WIRE_BASELINE"
+                    for t in node.targets)):
+                continue
+            try:
+                val = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(val, dict):
+                for k, v in val.items():
+                    if isinstance(k, str) and isinstance(v, dict):
+                        out[k] = v
+    return out
+
+
+class ProtoCompatPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        decls = _wire.project_decls(project)
+        versions = _wire.proto_versions(project.root)
+        baseline = dict(committed_baseline(project.root))
+        baseline.update(fixture_baselines(project))
+
+        decl_paths = {d.path: d.lineno for d in decls.values()}
+        anchor_path = _wire.WIRE_PATH
+
+        for name, d in sorted(decls.items()):
+            entry = baseline.get(name)
+            if entry is None:
+                findings.append(Finding(
+                    PASS, "missing-snapshot", d.path, "", name,
+                    f"wire message {name!r} has no entry in "
+                    f"{_wire.BASELINE_PATH} — regenerate it "
+                    "(python -m tools.sdlint --write-wire-baseline) "
+                    "so the next change diffs against a baseline",
+                    d.lineno))
+                continue
+            cur = _wire.snapshot_entry(d, versions)
+            shape_changed = any(
+                cur.get(k) != entry.get(k)
+                for k in ("schema", "values", "binary", "size_cap",
+                          "slice_cap"))
+            if shape_changed and cur.get("version") == \
+                    entry.get("version"):
+                findings.append(Finding(
+                    PASS, "schema-no-bump", d.path, "", name,
+                    f"wire message {name!r} changed shape against "
+                    f"{_wire.BASELINE_PATH} but proto group "
+                    f"{d.proto!r} is still version "
+                    f"{cur.get('version')} — two incompatible "
+                    "decoders under one version number; bump "
+                    "PROTO_VERSIONS and regenerate the snapshot",
+                    d.lineno))
+        for name, entry in sorted(baseline.items()):
+            if name not in decls:
+                findings.append(Finding(
+                    PASS, "removed-message", anchor_path, "", name,
+                    f"snapshot message {name!r} is no longer "
+                    "declared — old peers still send it; removal is "
+                    "a compat event (bump + regenerate)",
+                    decl_paths.get(anchor_path, 1)))
+
+        for src in project.files:
+            in_scope = _wire.in_scope(src)
+            for node in ast.walk(src.tree):
+                if in_scope and isinstance(node, ast.Compare):
+                    self._check_version_compare(src, node, findings)
+                if isinstance(node, ast.Call) and \
+                        self._is_unpackb(node) and \
+                        src.relpath.startswith("spacedrive_tpu/p2p/") \
+                        and src.relpath not in DECODE_EXEMPT:
+                    findings.append(Finding(
+                        PASS, "raw-decode", src.relpath, "",
+                        "msgpack.unpackb",
+                        "raw msgpack.unpackb in the p2p plane: "
+                        "frames enter through the tunnel seam "
+                        "(read_msg/recv), where the size cap and "
+                        "the armed frame auditor live",
+                        node.lineno))
+        return findings
+
+    @staticmethod
+    def _is_unpackb(node: ast.Call) -> bool:
+        f = node.func
+        return isinstance(f, ast.Attribute) and f.attr == "unpackb"
+
+    def _check_version_compare(self, src, node: ast.Compare,
+                               findings: List[Finding]) -> None:
+        for side in (node.left, *node.comparators):
+            field = None
+            if isinstance(side, ast.Subscript) and \
+                    isinstance(side.slice, ast.Constant):
+                field = side.slice.value
+            elif isinstance(side, ast.Call) and \
+                    isinstance(side.func, ast.Attribute) and \
+                    side.func.attr == "get" and side.args and \
+                    isinstance(side.args[0], ast.Constant):
+                field = side.args[0].value
+            if field == "proto":
+                findings.append(Finding(
+                    PASS, "adhoc-version-check", src.relpath, "",
+                    "proto-compare",
+                    "hand-rolled proto-field compare: wire.unpack "
+                    "IS the version check (WireVersionError on "
+                    "skew) — a local compare drifts from the "
+                    "registry the moment PROTO_VERSIONS bumps",
+                    node.lineno))
+                return

@@ -1,0 +1,204 @@
+"""Pass: schema-drift — field traffic matches the declared schema.
+
+The cross-AST half of the wire contracts (the `_sql`-style check PR 12
+ran between call sites and statements.py, applied to frames): the
+registry (spacedrive_tpu/p2p/wire.py) declares each message's field
+tokens, and this pass holds the OTHER side of every exchange to them —
+what a sender packs, and what a receiver reads off an unpacked frame.
+The runtime auditor catches live drift; this catches it at lint time,
+including the field nobody ever sends (a read of a key no declaration
+carries is dead code at best, a silently-None `get` at worst).
+
+Scope: same wire-plane scope as wire-discipline (`spacedrive_tpu/p2p/`
++ `spacedrive_tpu/sync/` + `# sdlint-scope: wire` marker files).
+
+Codes:
+
+- ``unknown-field-read``: `x["f"]` / `x.get("f")` where `x` was
+  assigned from `wire.unpack("name", ...)` in the same function and
+  `f` is not in the declared schema — the declaration says no peer
+  ever sends it.
+- ``missing-field``: a `wire.pack("name", ...)` call with literal
+  kwargs that omits a declared required field (non-const,
+  non-optional) — the call raises WireSchemaError at runtime;
+  `**kwargs` packs are skipped (statically unknowable).
+- ``smuggled-field``: a pack kwarg (or a hand-built discriminator
+  frame's key) absent from the declared schema — undeclared fields
+  must be declared, not smuggled past the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, dotted, own_body_walk
+from . import _wire
+
+PASS = "schema-drift"
+
+
+class SchemaDriftPass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        decls = _wire.project_decls(project)
+        consts = _wire.const_index(decls)
+        findings: List[Finding] = []
+        for fn in project.index.funcs:
+            src = fn.src
+            if not _wire.in_scope(src):
+                continue
+            bound = _wire.imports_wire(src.tree)
+            self._check_packs(fn, bound, decls, findings)
+            self._check_reads(fn, bound, decls, findings)
+        for src in project.files:
+            if not _wire.in_scope(src):
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Dict):
+                    self._check_literal_frame(
+                        src, node, decls, consts, findings)
+        return findings
+
+    # -- sender side --------------------------------------------------------
+
+    def _check_packs(self, fn, bound, decls, findings) -> None:
+        for site in fn.calls:
+            if _wire.wire_call(site.name, bound) != "pack":
+                continue
+            call = site.node
+            first = call.args[0] if call.args else None
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue  # dynamic-kind (wire-discipline's finding)
+            d = decls.get(first.value)
+            if d is None or d.fields is None:
+                continue
+            if any(k.arg is None for k in call.keywords):
+                continue  # **kwargs — statically unknowable
+            given = {k.arg for k in call.keywords}
+            for f in given:
+                if f not in d.fields:
+                    findings.append(Finding(
+                        PASS, "smuggled-field", fn.src.relpath,
+                        fn.qual, f"{d.name}.{f}",
+                        f"pack({d.name!r}) passes field {f!r} absent "
+                        "from the declared schema — declare it, do "
+                        "not smuggle it",
+                        call.lineno))
+            for f in d.required():
+                if f not in given:
+                    findings.append(Finding(
+                        PASS, "missing-field", fn.src.relpath,
+                        fn.qual, f"{d.name}.{f}",
+                        f"pack({d.name!r}) omits required field "
+                        f"{f!r} (declared "
+                        f"{d.fields.get(f, '?')!r}) — the call "
+                        "raises WireSchemaError at runtime",
+                        call.lineno))
+
+    # -- receiver side ------------------------------------------------------
+
+    def _check_reads(self, fn, bound, decls, findings) -> None:
+        # name -> [(assign lineno, MsgDecl | None)]: unpack assigns
+        # carry their declaration; ANY other assign clears tracking
+        # from its line on (the var no longer holds an unpacked
+        # frame). A read resolves to the latest assign at or above
+        # its line.
+        assigns: Dict[str, List[Tuple[int, Optional[object]]]] = {}
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            d = None
+            if isinstance(node.value, ast.Call):
+                cd = dotted(node.value.func)
+                if cd is not None and \
+                        _wire.wire_call(cd, bound) == "unpack":
+                    first = node.value.args[0] \
+                        if node.value.args else None
+                    if isinstance(first, ast.Constant) and \
+                            isinstance(first.value, str):
+                        d = decls.get(first.value)
+            for t in targets:
+                assigns.setdefault(t, []).append((node.lineno, d))
+        if not assigns:
+            return
+
+        def decl_at(var: str, lineno: int):
+            # highest assign line at-or-above the read (the walk does
+            # not yield in source order)
+            best_ln, best = -1, None
+            for ln, d in assigns.get(var, ()):
+                if best_ln < ln <= lineno:
+                    best_ln, best = ln, d
+            return best
+
+        for node in own_body_walk(fn.node):
+            var = field = None
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                var, field = node.value.id, node.slice.value
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                var, field = node.func.value.id, node.args[0].value
+            if var is None:
+                continue
+            d = decl_at(var, node.lineno)
+            if d is None or d.fields is None:
+                continue
+            if field not in d.fields:
+                findings.append(Finding(
+                    PASS, "unknown-field-read", fn.src.relpath,
+                    fn.qual, f"{d.name}.{field}",
+                    f"reads field {field!r} off a frame unpacked as "
+                    f"{d.name!r}, whose declaration has no such "
+                    "field — no peer ever sends it",
+                    node.lineno))
+
+    # -- hand-built frames --------------------------------------------------
+
+    def _check_literal_frame(self, src, node: ast.Dict, decls,
+                             consts, findings) -> None:
+        name = None
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value in ("t", "kind") \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                name = consts.get(f"{k.value}={v.value}")
+        if name is None:
+            return
+        d = decls[name]
+        if d.fields is None:
+            return
+        keys = [k.value for k in node.keys
+                if isinstance(k, ast.Constant)]
+        if len(keys) != len(node.keys):
+            return  # **splat — statically unknowable
+        for f in keys:
+            if f not in d.fields:
+                findings.append(Finding(
+                    PASS, "smuggled-field", src.relpath, "",
+                    f"{name}.{f}",
+                    f"hand-built {name!r} frame carries field {f!r} "
+                    "absent from the declared schema",
+                    node.lineno))
+        for f in d.required():
+            if f not in keys:
+                findings.append(Finding(
+                    PASS, "missing-field", src.relpath, "",
+                    f"{name}.{f}",
+                    f"hand-built {name!r} frame omits required "
+                    f"field {f!r} — the receiver's unpack refuses it",
+                    node.lineno))

@@ -51,7 +51,7 @@ CLASS_NAMES = {"Counter", "Gauge", "Histogram"}
 NAME_RE = re.compile(
     r"^sd_(jobs?|identifier|sync|p2p|store|api|trace|sanitize|jit"
     r"|task|timeout|chan|pipeline|stage|race|health|sql|fleet|obs"
-    r"|chaos|backoff|incident|persist)"
+    r"|chaos|backoff|incident|persist|wire)"
     r"_[a-z0-9_]+$")
 
 CENTRAL_MODULE = "telemetry.py"
@@ -342,7 +342,7 @@ class _Visitor(ast.NodeVisitor):
                 f"sd_<layer>_<what> (layers: jobs/identifier/sync/"
                 f"p2p/store/api/trace/sanitize/jit/task/timeout/chan/"
                 f"pipeline/stage/race/health/sql/fleet/obs/chaos/"
-                f"backoff/incident/persist)")
+                f"backoff/incident/persist/wire)")
 
 
 def lint_source(path: str, src: str, is_central: bool,

@@ -1,0 +1,167 @@
+"""Pass: wire-discipline — every cross-node frame speaks by declared name.
+
+PR 12 proved the registry + static-pass + runtime-twin shape on SQL,
+round 19 on durable writes; this round applies it to the p2p wire
+surface. Every message kind a tunnel carries is DECLARED in
+`spacedrive_tpu/p2p/wire.py` (`declare_message`: schema tokens,
+direction, size cap, timeout budget) and built/validated by name
+through `wire.pack` / `wire.unpack` — so a payload cannot drift from
+its declaration, and the frame auditor armed by sanitize.install()
+holds live traffic to the same contracts.
+
+Scope: the wire-plane product modules (`spacedrive_tpu/p2p/`,
+`spacedrive_tpu/sync/`) plus files opting in with a
+`# sdlint-scope: wire` marker in their first five lines (fixtures).
+wire.py itself is exempt — it IS the registry.
+
+Codes:
+
+- ``undeclared-kind``: `wire.pack`/`wire.unpack` (or a registry read
+  like `wire.proto`/`wire.slice_cap`) naming a message absent from
+  the declarations — the call raises WireError at runtime; declare
+  the contract first.
+- ``dynamic-kind``: pack/unpack with a non-literal name — the static
+  passes, the README inventory, and the malformed-frame grid must
+  see every kind; a data-driven kind waives with the reason (the obs
+  client's four-contract fetch is the sanctioned case).
+- ``raw-kind-literal``: a hand-built dict literal carrying a declared
+  t/kind discriminator value outside wire.py — pack() fills
+  discriminators itself, so legit code never writes one; a literal
+  frame bypasses schema/const/size validation entirely.
+- ``raw-value-literal``: a declared bare-string verdict ('ok',
+  'accept', ...) passed literally to a send — the values contract
+  (`wire.pack(name, value=...)`) is how the verdict stays in its
+  declared set.
+- ``computed-declaration``: a `declare_message` call whose
+  name/schema is not literal — invisible to every static consumer
+  (this pass, the snapshot diff, the grid).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Project
+from . import _wire
+
+PASS = "wire-discipline"
+
+
+class WireDisciplinePass:
+    name = PASS
+
+    def run(self, project: Project) -> List[Finding]:
+        decls = _wire.project_decls(project)
+        consts = _wire.const_index(decls)
+        values = _wire.value_index(decls)
+        findings: List[Finding] = []
+
+        # computed-declaration applies everywhere a declaration is
+        # attempted, scope or not — the registry must stay literal.
+        for src in project.files:
+            if src.relpath == _wire.WIRE_PATH:
+                continue
+            in_scope = _wire.in_scope(src)
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call):
+                    d = node.func
+                    name = getattr(d, "attr", None) or \
+                        getattr(d, "id", None)
+                    if name == "declare_message":
+                        first = node.args[0] if node.args else None
+                        if not (isinstance(first, ast.Constant)
+                                and isinstance(first.value, str)):
+                            findings.append(Finding(
+                                PASS, "computed-declaration",
+                                src.relpath, "", "non-literal",
+                                "declare_message with a non-literal "
+                                "name: invisible to the static "
+                                "passes, the snapshot diff, and the "
+                                "malformed-frame grid",
+                                node.lineno))
+                if not in_scope:
+                    continue
+                if isinstance(node, ast.Dict):
+                    self._check_dict_literal(
+                        src, node, consts, findings)
+
+        for fn in project.index.funcs:
+            src = fn.src
+            if not _wire.in_scope(src):
+                continue
+            bound = _wire.imports_wire(src.tree)
+            for site in fn.calls:
+                api = _wire.wire_call(site.name, bound)
+                call = site.node
+                if api in _wire.PACK_APIS or api in ("slice_cap",
+                                                     "message"):
+                    first = call.args[0] if call.args else None
+                    if not (isinstance(first, ast.Constant)
+                            and isinstance(first.value, str)):
+                        findings.append(Finding(
+                            PASS, "dynamic-kind", src.relpath,
+                            fn.qual, f"wire.{api}",
+                            f"wire.{api} with a non-literal message "
+                            "name: the inventory, the grid, and the "
+                            "drift checks must see every kind — "
+                            "waive with the reason if the kind is "
+                            "genuinely data",
+                            call.lineno))
+                    elif first.value not in decls:
+                        findings.append(Finding(
+                            PASS, "undeclared-kind", src.relpath,
+                            fn.qual, first.value,
+                            f"wire message {first.value!r} is not "
+                            "declared in spacedrive_tpu/p2p/wire.py "
+                            "(declare_message)",
+                            call.lineno))
+                elif api == "proto":
+                    first = call.args[0] if call.args else None
+                    if isinstance(first, ast.Constant) and \
+                            isinstance(first.value, str) and \
+                            first.value not in _wire.proto_versions(
+                                project.root):
+                        findings.append(Finding(
+                            PASS, "undeclared-kind", src.relpath,
+                            fn.qual, first.value,
+                            f"proto group {first.value!r} is not in "
+                            "wire.PROTO_VERSIONS",
+                            call.lineno))
+                # a declared verdict string sent literally bypasses
+                # the values contract
+                last = site.name.rsplit(".", 1)[-1]
+                if last in ("send", "send_nowait") and call.args:
+                    arg = call.args[0]
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str) and \
+                            arg.value in values:
+                        findings.append(Finding(
+                            PASS, "raw-value-literal", src.relpath,
+                            fn.qual, arg.value,
+                            f"literal verdict {arg.value!r} sent "
+                            "raw: route it through wire.pack("
+                            f"{values[arg.value]!r}, value=...) so "
+                            "the declared value set is enforced",
+                            call.lineno))
+        return findings
+
+    def _check_dict_literal(self, src, node: ast.Dict, consts,
+                            findings: List[Finding]) -> None:
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and k.value in ("t", "kind")):
+                continue
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                continue
+            key = f"{k.value}={v.value}"
+            name = consts.get(key)
+            if name is not None:
+                findings.append(Finding(
+                    PASS, "raw-kind-literal", src.relpath, "", key,
+                    f"hand-built frame dict with discriminator "
+                    f"{key} (declared message {name!r}): pack() "
+                    "fills discriminators itself — a literal frame "
+                    "bypasses schema/const/size validation",
+                    node.lineno))

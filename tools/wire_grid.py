@@ -1,0 +1,286 @@
+"""wire_grid — feed EVERY declared message every malformed shape.
+
+The wire registry (spacedrive_tpu/p2p/wire.py) declares, per message,
+the exact contract a frame must meet: schema tokens, const
+discriminators, version consts, size cap. This harness holds that
+contract to account cell by cell: for every declared message it
+builds a well-formed CONTROL frame through `wire.pack` and then
+derives one mutant per applicable mutation —
+
+- ``drop-required``: the last required/const field removed;
+- ``truncate``: everything after the first field dropped (emitted
+  only when a required field is among the casualties);
+- ``type-flip``: the first typed field replaced with a wrong-typed
+  value (a truncated/garbage value for the scalar contracts);
+- ``unknown-kind``: the discriminator flipped to a value no
+  declaration claims (an out-of-set verdict for values messages);
+- ``oversize``: the transport byte count one past the declared cap;
+- ``version-skew``: the proto field set to version+1 (the 7
+  version-bearing messages).
+
+Every cell asserts REJECT-WITHOUT-CRASH, both ways frames enter:
+
+- `wire.unpack(name, mutant)` must raise a WireError subclass —
+  never any other exception, never accept;
+- `wire.audit_frame(mutant, ...)` (the armed tunnel-seam auditor)
+  must return None and record exactly one violation — of kind
+  `proto_skew` for version-skew cells and `size_cap` for oversize
+  cells;
+- the CONTROL must unpack clean and come back from the auditor with
+  a declared name and zero violations.
+
+A new declaration is covered the moment it lands, with zero new grid
+code. `--json [PATH|-]` emits the grid as a BENCH-style artifact; the
+exit code gates (0 iff every cell passed) so tests/test_wire_grid.py
+can wire the full grid into tier-1 — the same shape as
+tools/crash_grid.py for the persist seam.
+
+Usage:
+    python tools/wire_grid.py [--json [PATH|-]]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# One well-typed sample per schema token — enough to satisfy pack().
+_SAMPLES: Dict[str, Any] = {
+    "str": "x", "int": 7, "bytes": b"\x01", "bool": True,
+    "float": 1.0, "list": [], "dict": {}, "any": "x",
+}
+# One wrong-typed value per token (bools are refused for int/float by
+# the registry itself, so plain swaps suffice).
+_FLIPS: Dict[str, Any] = {
+    "str": 7, "int": "x", "bytes": 7, "bool": "x",
+    "float": "x", "list": 7, "dict": 7,
+}
+
+
+def control_frame(wire, name: str) -> Any:
+    """A well-formed frame, built the only sanctioned way."""
+    msg = wire.message(name)
+    if msg.values is not None:
+        return wire.pack(name, value=msg.values[0])
+    if msg.binary:
+        return wire.pack(name, value=b"\x01")
+    required = {f.name: _SAMPLES[f.type] for f in msg.fields
+                if f.const is None and not f.optional
+                and not f.is_proto}
+    return wire.pack(name, **required)
+
+
+def mutants(wire, name: str,
+            control: Any) -> List[Tuple[str, Any, Optional[int]]]:
+    """(mutation, frame, nbytes) cells applicable to this message."""
+    msg = wire.message(name)
+    out: List[Tuple[str, Any, Optional[int]]] = []
+
+    if msg.values is not None:
+        out.append(("truncate", control[:-1], None))
+        out.append(("type-flip", 3.14, None))
+        out.append(("unknown-kind", "__bogus_verdict__", None))
+    elif msg.binary:
+        out.append(("type-flip", 3.14, None))
+    else:
+        keys = list(control)
+        by_name = {f.name: f for f in msg.fields}
+        mandatory = [k for k in keys if not by_name[k].optional]
+        if mandatory:
+            dropped = dict(control)
+            del dropped[mandatory[-1]]
+            out.append(("drop-required", dropped, None))
+        if len(keys) > 1 and any(not by_name[k].optional
+                                 for k in keys[1:]):
+            out.append(("truncate", {keys[0]: control[keys[0]]}, None))
+        for f in msg.fields:
+            if f.name in control and f.const is None \
+                    and not f.is_proto and f.type in _FLIPS:
+                flipped = dict(control)
+                flipped[f.name] = _FLIPS[f.type]
+                out.append(("type-flip", flipped, None))
+                break
+        consts = [f.name for f in msg.fields
+                  if f.const is not None and f.name in ("t", "kind")]
+        if consts:
+            bogus = dict(control)
+            for k in consts:
+                bogus[k] = "__bogus_kind__"
+            out.append(("unknown-kind", bogus, None))
+        if any(f.is_proto for f in msg.fields):
+            skewed = dict(control)
+            for f in msg.fields:
+                if f.is_proto:
+                    skewed[f.name] = msg.version + 1
+            out.append(("version-skew", skewed, None))
+
+    out.append(("oversize", control, msg.size_cap + 1))
+    return out
+
+
+def _violation_counts(wire) -> Dict[str, float]:
+    """Per-subkind sd_wire_violations_total values — the grid reads
+    the same census production dashboards do."""
+    from spacedrive_tpu.telemetry import WIRE_VIOLATIONS
+
+    return {labels["kind"]: metric.value
+            for labels, metric in WIRE_VIOLATIONS.samples()
+            if labels}
+
+
+def _still_valid(wire, frame: Any, nbytes: Optional[int]):
+    """The declared name a frame legitimately satisfies, if any — a
+    mutation can land on ANOTHER valid contract (the status-only
+    response envelopes are structurally identical), and the auditor
+    is right to pass such a frame."""
+    for cand in wire.classify(frame):
+        try:
+            wire.unpack(cand, frame, size=nbytes)
+            return cand
+        except wire.WireError:
+            continue
+    return None
+
+
+def run_cell(wire, name: str, mutation: Optional[str], frame: Any,
+             nbytes: Optional[int], auditable: bool = True) -> Dict:
+    """Judge one (message, mutation) cell both ways frames enter."""
+    problems: List[str] = []
+    before = _violation_counts(wire)
+
+    if mutation is None:                       # control
+        try:
+            wire.unpack(name, frame, size=nbytes)
+        except Exception as e:
+            problems.append(f"control frame refused: {e!r}")
+        audited = wire.audit_frame(frame, "in", nbytes)
+        if audited is None:
+            problems.append("auditor rejected the control frame")
+        kinds = _delta(before, _violation_counts(wire))
+        if kinds:
+            problems.append(f"control recorded violations: {kinds}")
+    else:
+        try:
+            wire.unpack(name, frame, size=nbytes)
+            problems.append("mutant ACCEPTED by unpack")
+        except wire.WireError:
+            pass                               # the contract held
+        except Exception as e:                 # reject ≠ crash
+            problems.append(
+                f"mutant CRASHED unpack with non-wire {e!r}")
+        audited = None
+        try:
+            audited = wire.audit_frame(frame, "in", nbytes)
+        except Exception as e:
+            problems.append(f"mutant CRASHED the auditor: {e!r}")
+        kinds = _delta(before, _violation_counts(wire))
+        if auditable:
+            if audited is not None:
+                problems.append(
+                    f"auditor passed the mutant as {audited!r}")
+            if sum(kinds.values()) != 1:
+                problems.append(
+                    f"expected exactly one violation, got {kinds}")
+            want = {"version-skew": "proto_skew",
+                    "oversize": "size_cap"}.get(mutation)
+            # exact-subkind assertions only when classification is
+            # unambiguous: a status-only envelope matches several
+            # declarations, and the auditor reports the most
+            # actionable breach among them (skew over size)
+            if want and kinds and want not in kinds \
+                    and len(wire.classify(frame)) == 1:
+                problems.append(
+                    f"violation kind(s) {sorted(kinds)}, "
+                    f"expected {want!r}")
+
+    return {"message": name, "mutation": mutation or "control",
+            "violations": sorted(kinds) if mutation else [],
+            "audited": auditable, "problems": problems}
+
+
+def _delta(before: Dict[str, float],
+           after: Dict[str, float]) -> Dict[str, float]:
+    return {k: after[k] - before.get(k, 0.0) for k in after
+            if after[k] != before.get(k, 0.0)}
+
+
+def build_cells(wire) -> List[Tuple[str, Optional[str], Any,
+                                    Optional[int], bool]]:
+    cells = []
+    for name in sorted(wire.MESSAGES):
+        control = control_frame(wire, name)
+        cells.append((name, None, control, 1, True))
+        for mutation, frame, nbytes in mutants(wire, name, control):
+            auditable = _still_valid(wire, frame, nbytes) is None
+            cells.append((name, mutation, frame, nbytes, auditable))
+    return cells
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/wire_grid.py",
+        description="feed every declared wire message every malformed "
+                    "shape; assert reject-without-crash")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="PATH",
+                    help="emit the grid as a JSON artifact "
+                         "(default '-': stdout)")
+    args = ap.parse_args(argv)
+
+    from spacedrive_tpu.p2p import wire
+
+    # Arm the auditor in count mode (the production posture): mutant
+    # after mutant flows through the same audit seam the tunnels use,
+    # and the grid reads the violation census off the metric.
+    wire.arm("count", lambda kind, detail, may_raise: None)
+
+    rounds = []
+    try:
+        for name, mutation, frame, nbytes, auditable in \
+                build_cells(wire):
+            rounds.append(run_cell(wire, name, mutation, frame,
+                                   nbytes, auditable))
+    finally:
+        wire.disarm()
+
+    failures = [f"{r['message']}@{r['mutation']}: {p}"
+                for r in rounds for p in r["problems"]]
+    doc = {
+        "metric": "wire_grid",
+        "messages": sorted(wire.MESSAGES),
+        "cells": len(rounds),
+        "mutations": sum(1 for r in rounds
+                         if r["mutation"] != "control"),
+        # mutants that landed on ANOTHER valid contract: unpack-side
+        # assertions only (the auditor is right to pass them)
+        "unaudited": [f"{r['message']}@{r['mutation']}"
+                      for r in rounds if not r["audited"]],
+        "failures": failures,
+        "pass": not failures,
+        "rounds": rounds,
+    }
+    if args.json == "-":
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        from spacedrive_tpu import persist
+        persist.atomic_write("bench.artifact", args.json,
+                             json.dumps(doc, indent=1))
+    summary = (f"wire_grid: {doc['cells']} cells "
+               f"({doc['mutations']} mutations) over "
+               f"{len(doc['messages'])} messages — "
+               + ("PASS" if doc["pass"] else
+                  f"{len(failures)} FAILURE(S)"))
+    print(summary, file=sys.stderr)
+    for f in failures:
+        print(f"  {f}", file=sys.stderr)
+    return 0 if doc["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
